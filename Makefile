@@ -1,0 +1,15 @@
+# Dev workflow. CPU tests run on an 8-device virtual mesh; PALLAS_AXON_POOL_IPS
+# is unset so python startup skips the axon TPU claim (sitecustomize would
+# otherwise block every interpreter on the single TPU grant).
+TEST_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
+
+.PHONY: test test-fast bench lint
+
+test:
+	$(TEST_ENV) python -m pytest tests/ -x -q
+
+test-fast:
+	$(TEST_ENV) python -m pytest tests/ -x -q -m "not slow"
+
+bench:
+	python bench.py

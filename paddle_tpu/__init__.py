@@ -1,0 +1,69 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle's
+capabilities, built on JAX/XLA/Pallas/pjit.
+
+Top-level namespace parity: reference `python/paddle/__init__.py` — Tensor,
+creation/math/manipulation ops, nn, optimizer, amp, autograd, io,
+distributed, jit, vision, profiler.
+"""
+from __future__ import annotations
+
+import os
+
+# 64-bit dtypes on (paddle's default int dtype is int64). Floats still default
+# to float32 via get_default_dtype; float64 only on explicit request.
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+from .core.dtype import (  # noqa: F401,E402
+    bool_, uint8, int8, int16, int32, int64, float16, bfloat16, float32,
+    float64, complex64, complex128, float8_e4m3fn, float8_e5m2,
+    set_default_dtype, get_default_dtype, finfo, iinfo, dtype_name,
+)
+from .core.tensor import Tensor, to_tensor, is_tensor  # noqa: F401,E402
+from .core import autograd as _autograd_core  # noqa: E402
+from .core.autograd import no_grad, enable_grad, set_grad_enabled, is_grad_enabled  # noqa: F401,E402
+from .core.autograd import grad  # noqa: F401,E402
+
+from .ops import *  # noqa: F401,F403,E402
+from .ops import methods as _methods  # noqa: E402
+from .ops import dispatch  # noqa: F401,E402
+
+_methods.patch_tensor_methods()
+
+from .framework.random import seed, get_rng_state, set_rng_state  # noqa: F401,E402
+from .framework import save, load  # noqa: F401,E402
+
+from . import nn  # noqa: F401,E402
+from . import optimizer  # noqa: F401,E402
+from . import amp  # noqa: F401,E402
+from . import io  # noqa: F401,E402
+from . import jit  # noqa: F401,E402
+from . import autograd  # noqa: F401,E402
+from . import device  # noqa: F401,E402
+from . import distributed  # noqa: F401,E402
+from . import vision  # noqa: F401,E402
+from . import metric  # noqa: F401,E402
+from . import static  # noqa: F401,E402
+from . import utils  # noqa: F401,E402
+
+from .nn.layer.layers import Layer  # noqa: F401,E402
+from .hapi.model import Model  # noqa: F401,E402
+from .distributed.parallel import DataParallel  # noqa: F401,E402
+
+
+def disable_static(place=None):
+    """No-op: paddle_tpu is always in eager (dygraph) mode; compiled execution
+    is opt-in via paddle_tpu.jit.to_static. Kept for API parity."""
+
+
+def enable_static():
+    raise RuntimeError(
+        "paddle_tpu has no separate static-graph mode: use "
+        "paddle_tpu.jit.to_static(fn) to get compiled (XLA) execution.")
+
+
+def in_dynamic_mode():
+    return True

@@ -1,0 +1,15 @@
+"""AMP: autocast + GradScaler.
+
+Parity: reference `python/paddle/amp/` — `auto_cast` (O1 per-op allow/deny
+lists, O2 whole-model cast), `GradScaler` dynamic loss scaling, master
+weights (held by optimizers via multi_precision).
+
+TPU-native notes: bf16 is the native half type (no loss scaling needed —
+GradScaler becomes a near-no-op passthrough when dtype=bfloat16, matching
+the reference's bf16 path); fp16 scaling is kept for parity.
+"""
+from .auto_cast import auto_cast, amp_guard, decorate, is_auto_cast_enabled, get_amp_dtype  # noqa: F401
+from .grad_scaler import GradScaler, AmpScaler, OptimizerState  # noqa: F401
+from . import amp_lists  # noqa: F401
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler", "AmpScaler"]

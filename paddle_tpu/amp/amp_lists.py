@@ -1,0 +1,33 @@
+"""Per-op AMP allow/deny lists.
+
+Parity: reference `python/paddle/amp/amp_lists.py` (WHITE_LIST ops run in
+fp16/bf16, BLACK_LIST ops stay fp32, the rest follow inputs).
+"""
+
+# ops that benefit from half precision (MXU-bound)
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "mv", "linear", "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose", "einsum",
+    "flash_attention", "sdpa", "addmm",
+}
+
+# numerically sensitive ops that must stay fp32
+BLACK_LIST = {
+    "exp", "square", "log", "log2", "log10", "log1p", "mean", "sum", "cos_sim",
+    "softmax", "log_softmax", "cross_entropy", "softmax_with_cross_entropy",
+    "c_softmax_with_cross_entropy", "layer_norm", "group_norm", "instance_norm",
+    "batch_norm", "rms_norm", "reduce_mean", "reduce_sum", "linspace", "erf",
+    "erfinv", "pow", "logsumexp", "norm", "var", "std", "renorm", "cumsum",
+    "cumprod", "prod", "nll_loss", "bce", "bce_logits", "kl_div", "mse_loss",
+    "l1_loss", "smooth_l1",
+}
+
+EXTRA_BLACK_LIST = set()
+
+
+def white_list():
+    return set(WHITE_LIST)
+
+
+def black_list():
+    return set(BLACK_LIST) | EXTRA_BLACK_LIST

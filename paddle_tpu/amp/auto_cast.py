@@ -1,0 +1,127 @@
+"""Autocast context.
+
+Parity: reference `python/paddle/amp/auto_cast.py:462,1029` (amp_guard +
+decorate). Level O1 casts per-op via the allow/deny lists at the dispatch
+funnel (ops/dispatch.apply_op consults this module); O2 casts model
+parameters to the amp dtype up front (decorate) with fp32 master weights in
+the optimizer.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "is_auto_cast_enabled",
+           "get_amp_dtype", "amp_dtype_for_op"]
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_state = _AmpState()
+
+
+def is_auto_cast_enabled():
+    return _state.enabled
+
+
+def get_amp_dtype():
+    return _state.dtype if _state.enabled else None
+
+
+def amp_dtype_for_op(op_name: str):
+    """Called by ops.dispatch.apply_op: returns the dtype this op's float
+    inputs should be cast to under the active autocast, or None."""
+    if not _state.enabled:
+        return None
+    from . import amp_lists
+    name = op_name.lower()
+    if name in _state.custom_black or name in amp_lists.black_list():
+        return jnp.float32
+    if _state.level == "O2":
+        return _state.dtype
+    if name in _state.custom_white or name in amp_lists.white_list():
+        return _state.dtype
+    return None
+
+
+class auto_cast:
+    """Context manager / decorator. Parity: paddle.amp.auto_cast."""
+
+    def __init__(self, enable=True, custom_white_list=None,
+                 custom_black_list=None, level="O1", dtype="bfloat16",
+                 use_promote=True):
+        self.enable = enable
+        self.level = level
+        self.dtype = convert_dtype(dtype)
+        self.white = set(custom_white_list or ())
+        self.black = set(custom_black_list or ())
+        self._saved = None
+
+    def __enter__(self):
+        self._saved = (_state.enabled, _state.dtype, _state.level,
+                       _state.custom_white, _state.custom_black)
+        _state.enabled = bool(self.enable)
+        _state.dtype = self.dtype
+        _state.level = self.level
+        _state.custom_white = self.white
+        _state.custom_black = self.black
+        return self
+
+    def __exit__(self, *exc):
+        (_state.enabled, _state.dtype, _state.level,
+         _state.custom_white, _state.custom_black) = self._saved
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with auto_cast(self.enable, self.white, self.black, self.level,
+                           self.dtype):
+                return fn(*a, **k)
+        return wrapper
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None, master_grad=False,
+             excluded_layers=None):
+    """O2 decoration: cast model params to amp dtype; optimizer keeps fp32
+    master weights. Parity: paddle.amp.decorate."""
+    d = convert_dtype(dtype)
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        excluded = excluded_layers or ()
+        from ..nn.layer.norm import _BatchNormBase, LayerNorm
+        default_excluded = (_BatchNormBase, LayerNorm)
+        for m in model_list:
+            for layer in m.sublayers(include_self=True):
+                if isinstance(layer, default_excluded) or \
+                        any(isinstance(layer, e) for e in
+                            (excluded if isinstance(excluded, (list, tuple)) else (excluded,))):
+                    continue
+                for _, p in layer._parameters.items():
+                    if p is not None and jnp.issubdtype(p.dtype, jnp.floating):
+                        p._data = p._data.astype(d)
+    if optimizers is None:
+        return models if single_model else model_list
+    single_opt = not isinstance(optimizers, (list, tuple))
+    opt_list = [optimizers] if single_opt else list(optimizers)
+    for opt in opt_list:
+        opt._multi_precision = True
+    return (models if single_model else model_list,
+            optimizers if single_opt else opt_list)

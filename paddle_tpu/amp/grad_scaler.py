@@ -1,0 +1,124 @@
+"""Dynamic loss scaling.
+
+Parity: reference `python/paddle/amp/grad_scaler.py:657,62` (GradScaler /
+AmpScaler): scale loss, unscale grads, skip step on inf/nan, grow/shrink the
+scale. On TPU with bf16 this is typically disabled (bf16 has fp32's range);
+kept for fp16 parity and API compatibility.
+"""
+from __future__ import annotations
+
+import enum
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["GradScaler", "AmpScaler", "OptimizerState"]
+
+
+class OptimizerState(enum.Enum):
+    INIT = 0
+    UNSCALED = 1
+    STEPPED = 2
+
+
+class AmpScaler:
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 16,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._state = OptimizerState.INIT
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameter_list:
+            if p._grad_buffer is not None:
+                g = p._grad_buffer.astype(jnp.float32) * inv
+                if bool(jnp.any(~jnp.isfinite(g))):
+                    found = True
+                p._grad_buffer = g.astype(p._grad_buffer.dtype)
+        self._found_inf = found
+        self._state = OptimizerState.UNSCALED
+
+    def minimize(self, optimizer, loss, *args, **kwargs):
+        loss.backward()
+        self.step(optimizer)
+        self.update()
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        if self._state == OptimizerState.INIT:
+            self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._state = OptimizerState.STEPPED
+
+    def update(self):
+        if not self._enable or not self._dynamic:
+            self._state = OptimizerState.INIT
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+        self._state = OptimizerState.INIT
+
+    def get_loss_scaling(self):
+        return Tensor(jnp.asarray(self._scale, jnp.float32))
+
+    def set_init_loss_scaling(self, new_scale):
+        self._scale = float(new_scale)
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "incr_every_n_steps": self._incr_every_n_steps,
+                "decr_every_n_nan_or_inf": self._decr_every_n,
+                "good_steps": self._good_steps, "bad_steps": self._bad_steps,
+                "enable": self._enable,
+                "use_dynamic_loss_scaling": self._dynamic}
+
+    def load_state_dict(self, state):
+        self._scale = state.get("scale", self._scale)
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)
+        self._enable = state.get("enable", self._enable)
+        self._dynamic = state.get("use_dynamic_loss_scaling", self._dynamic)
+
+
+class GradScaler(AmpScaler):
+    """Parity: paddle.amp.GradScaler."""

@@ -1,0 +1,11 @@
+"""paddle_tpu.autograd — public autograd API.
+
+Parity: reference `python/paddle/autograd/` (backward, grad, PyLayer,
+saved-tensor hooks, no_grad).
+"""
+from ..core.autograd import backward, grad, no_grad, enable_grad, is_grad_enabled, set_grad_enabled  # noqa: F401
+from .py_layer import PyLayer, PyLayerContext  # noqa: F401
+from .saved_tensors_hooks import saved_tensors_hooks  # noqa: F401
+
+__all__ = ["backward", "grad", "no_grad", "enable_grad", "PyLayer",
+           "PyLayerContext", "saved_tensors_hooks"]

@@ -1,0 +1,96 @@
+"""PyLayer: user-defined forward/backward.
+
+Parity: reference `python/paddle/autograd/py_layer.py` +
+`paddle/fluid/eager/pylayer/`. The custom backward is attached to the tape
+as a GradNode whose pullback calls the user's `backward` staticmethod.
+"""
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax
+
+from ..core import autograd
+from ..core.autograd import GradNode
+from ..core.tensor import Tensor
+
+__all__ = ["PyLayer", "PyLayerContext"]
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = []
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = [t.detach() if isinstance(t, Tensor) else t for t in tensors]
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+    def mark_not_inplace(self, *args):
+        pass
+
+    def mark_non_differentiable(self, *args):
+        self._non_diff = [id(a) for a in args]
+
+    def set_materialize_grads(self, value):
+        self.materialize_grads = bool(value)
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grad_outputs):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_inputs: List[Tensor] = [a for a in args if isinstance(a, Tensor)] + \
+            [v for v in kwargs.values() if isinstance(v, Tensor)]
+        need_grad = autograd.is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs)
+        with autograd.no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outputs, (list, tuple))
+        out_list = [outputs] if single else list(outputs)
+        if need_grad:
+            out_tensors = [o for o in out_list if isinstance(o, Tensor)]
+            avals = [jax.ShapeDtypeStruct(tuple(o._data.shape), o._data.dtype)
+                     for o in out_tensors]
+
+            def vjp_fn(cots):
+                if not isinstance(cots, (list, tuple)):
+                    cots = (cots,)
+                cot_tensors = [Tensor(c) for c in cots]
+                with autograd.no_grad():
+                    grads = cls.backward(ctx, *cot_tensors)
+                if not isinstance(grads, (list, tuple)):
+                    grads = (grads,)
+                out = []
+                gi = iter(grads)
+                for t in tensor_inputs:
+                    g = next(gi, None)
+                    out.append(g._data if isinstance(g, Tensor) else g)
+                return tuple(out)
+
+            node = GradNode(cls.__name__, vjp_fn, tensor_inputs, avals,
+                            out_treedef=None)
+            for i, o in enumerate(out_tensors):
+                fresh = Tensor(o._data, stop_gradient=False)
+                fresh._grad_node = node
+                fresh._grad_out_idx = i
+                out_list[out_list.index(o)] = fresh
+        return out_list[0] if single else tuple(out_list)

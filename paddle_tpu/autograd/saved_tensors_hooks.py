@@ -1,0 +1,38 @@
+"""Saved-tensor hooks (pack/unpack) — parity with
+python/paddle/autograd/saved_tensors_hooks.py. On TPU the main use is
+offload-style recompute; the tape currently saves tensors inside jax.vjp
+residuals, so hooks apply to PyLayer ctx.save_for_backward paths."""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["saved_tensors_hooks"]
+
+
+class _HookState(threading.local):
+    def __init__(self):
+        self.pack = None
+        self.unpack = None
+
+
+_state = _HookState()
+
+
+def get_hooks():
+    return _state.pack, _state.unpack
+
+
+class saved_tensors_hooks:
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        self._saved = (_state.pack, _state.unpack)
+        _state.pack = self.pack_hook
+        _state.unpack = self.unpack_hook
+        return self
+
+    def __exit__(self, *a):
+        _state.pack, _state.unpack = self._saved
+        return False

@@ -1,0 +1,1 @@
+from . import dtype, tensor, autograd  # noqa: F401

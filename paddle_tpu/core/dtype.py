@@ -1,0 +1,107 @@
+"""Dtype system for paddle_tpu.
+
+Capability parity with the reference's dtype surface
+(`/root/reference/paddle/phi/common/data_type.h`, `float16.h`, `bfloat16.h`):
+paddle-style dtype names mapped onto JAX/numpy dtypes. TPU-first: bfloat16 is
+the preferred half precision; float64 is supported but discouraged (XLA on TPU
+emulates it slowly).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects (these ARE numpy/jax dtypes so they interop freely).
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+float8_e4m3fn = jnp.float8_e4m3fn
+float8_e5m2 = jnp.float8_e5m2
+
+_NAME_TO_DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+    "float8_e4m3fn": float8_e4m3fn,
+    "float8_e5m2": float8_e5m2,
+    # paddle aliases
+    "fp16": float16,
+    "bf16": bfloat16,
+    "fp32": float32,
+    "fp64": float64,
+}
+
+
+def convert_dtype(dtype) -> jnp.dtype:
+    """Normalize a user-provided dtype (str | np dtype | jnp dtype) to jnp dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        try:
+            return jnp.dtype(_NAME_TO_DTYPE[dtype])
+        except KeyError:
+            raise ValueError(f"Unknown dtype name: {dtype!r}")
+    return jnp.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    """Paddle-style name of a dtype."""
+    d = jnp.dtype(dtype)
+    return d.name
+
+
+def is_floating_point(dtype) -> bool:
+    d = jnp.dtype(convert_dtype(dtype))
+    return jnp.issubdtype(d, jnp.floating)
+
+
+def is_integer(dtype) -> bool:
+    d = jnp.dtype(convert_dtype(dtype))
+    return jnp.issubdtype(d, jnp.integer)
+
+
+def is_complex(dtype) -> bool:
+    d = jnp.dtype(convert_dtype(dtype))
+    return jnp.issubdtype(d, jnp.complexfloating)
+
+
+def finfo(dtype):
+    return jnp.finfo(convert_dtype(dtype))
+
+
+def iinfo(dtype):
+    return np.iinfo(np.dtype(convert_dtype(dtype)))
+
+
+# Default dtype management (reference: python/paddle/base/framework.py
+# get_default_dtype/set_default_dtype).
+_default_dtype = [jnp.float32]
+
+
+def set_default_dtype(dtype):
+    d = convert_dtype(dtype)
+    if not jnp.issubdtype(d, jnp.floating):
+        raise TypeError(f"default dtype must be floating, got {d}")
+    _default_dtype[0] = d
+
+
+def get_default_dtype():
+    return _default_dtype[0]

@@ -1,0 +1,280 @@
+"""The paddle_tpu Tensor: an eager, autograd-tracking façade over jax.Array.
+
+Capability parity with the reference's `paddle.Tensor`
+(`/root/reference/paddle/phi/api/include/tensor.h:82` +
+`paddle/fluid/pybind/eager.cc` python object): shape/dtype/place accessors,
+numpy interop, rich operators, `.backward()`, `.grad`, `.stop_gradient`.
+
+TPU-native design notes:
+  * The payload is always a `jax.Array` (or a jax tracer when the enclosing
+    code is being traced by `jax.jit` — Tensor is registered as a pytree so
+    Tensor-level programs compile to single XLA executables).
+  * There is no Place/stream plumbing: device residency is carried by the
+    jax.Array's sharding; `to()`/`cuda()` analogs map to `jax.device_put`.
+  * Mutation (`copy_`, in-place ops, `__setitem__`) rebinds the wrapped
+    functional array, which matches XLA's value semantics while preserving
+    the reference's in-place API surface.
+"""
+from __future__ import annotations
+
+import operator
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import autograd
+from .dtype import convert_dtype, get_default_dtype
+
+__all__ = ["Tensor", "to_tensor", "is_tensor"]
+
+
+class Tensor:
+    __slots__ = (
+        "_data",
+        "stop_gradient",
+        "_grad_buffer",
+        "_grad_node",
+        "_grad_out_idx",
+        "name",
+        "_is_param",
+        "__weakref__",
+    )
+
+    def __init__(self, data, stop_gradient: bool = True, name: str = ""):
+        if isinstance(data, Tensor):
+            data = data._data
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self._grad_buffer = None
+        self._grad_node = None
+        self._grad_out_idx = 0
+        self.name = name
+        self._is_param = False
+
+    # ------------------------------------------------------------------ data
+    @property
+    def data(self):
+        """The underlying jax.Array."""
+        return self._data
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    dim = ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def place(self):
+        try:
+            devs = self._data.devices()
+            return next(iter(devs))
+        except Exception:
+            return None
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def __array__(self, dtype=None):
+        arr = np.asarray(self._data)
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def item(self, *args):
+        return np.asarray(self._data).item(*args)
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __repr__(self):
+        grad_str = "" if self.stop_gradient else ", stop_gradient=False"
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}"
+                f"{grad_str},\n       {np.asarray(jax.device_get(self._data))!r})")
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.item(), spec)
+        return repr(self)
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("truth value of a multi-element Tensor is ambiguous")
+        return bool(np.asarray(self._data))
+
+    def __int__(self):
+        return int(np.asarray(self._data))
+
+    def __float__(self):
+        return float(np.asarray(self._data))
+
+    def __index__(self):
+        return operator.index(np.asarray(self._data).item())
+
+    def __hash__(self):
+        return id(self)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # -------------------------------------------------------------- autograd
+    @property
+    def grad(self) -> Optional["Tensor"]:
+        if self._grad_buffer is None:
+            return None
+        return Tensor(self._grad_buffer, stop_gradient=True)
+
+    @grad.setter
+    def grad(self, value):
+        if value is None:
+            self._grad_buffer = None
+        else:
+            self._grad_buffer = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+
+    def _accumulate_grad(self, g):
+        if g.dtype != self.dtype:
+            g = g.astype(self.dtype)
+        if self._grad_buffer is None:
+            self._grad_buffer = g
+        else:
+            self._grad_buffer = self._grad_buffer + g
+
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        autograd.backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self._grad_buffer = None
+
+    clear_gradient = clear_grad
+
+    def detach(self) -> "Tensor":
+        return Tensor(self._data, stop_gradient=True, name=self.name)
+
+    def detach_(self):
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        from ..ops.dispatch import apply_op
+        return apply_op("clone", lambda x: x, self)
+
+    @property
+    def requires_grad(self):
+        return not self.stop_gradient
+
+    @requires_grad.setter
+    def requires_grad(self, v):
+        self.stop_gradient = not v
+
+    # ------------------------------------------------------------- mutation
+    def copy_(self, value, *a):
+        """In-place copy (rebind). Breaks the autograd link like the reference's
+        inplace-on-leaf check would demand outside of no_grad."""
+        v = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+        self._data = v.astype(self.dtype) if v.dtype != self.dtype else v
+        return self
+
+    def set_value(self, value):
+        return self.copy_(value)
+
+    def fill_(self, value):
+        self._data = jnp.full(self._data.shape, value, self._data.dtype)
+        return self
+
+    def zero_(self):
+        return self.fill_(0)
+
+    def _replace_data(self, new_data):
+        """Internal: rebind payload preserving autograd metadata (optimizer use)."""
+        self._data = new_data
+        return self
+
+    # ------------------------------------------------------------ conversion
+    def astype(self, dtype) -> "Tensor":
+        from ..ops.dispatch import apply_op
+        d = convert_dtype(dtype)
+        return apply_op("cast", lambda x: x.astype(d), self)
+
+    cast = astype
+
+    def to(self, *args, **kwargs):
+        # Accept .to(dtype), .to(device_str) loosely.
+        out = self
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, str) and a in ("cpu", "tpu", "gpu"):
+                continue  # single-process device residency is jax-managed
+            else:
+                try:
+                    out = out.astype(a)
+                except Exception:
+                    pass
+        return out
+
+    def cpu(self):
+        return Tensor(jax.device_get(self._data), self.stop_gradient, self.name)
+
+    def pin_memory(self):
+        return self
+
+    def contiguous(self):
+        return self
+
+    def is_contiguous(self):
+        return True
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    """Parity: `paddle.to_tensor` (reference python/paddle/tensor/creation.py)."""
+    if isinstance(data, Tensor):
+        arr = data._data
+    elif isinstance(data, (jax.Array, np.ndarray)):
+        arr = jnp.asarray(data)
+    else:
+        np_arr = np.asarray(data)
+        if np_arr.dtype == np.float64 and dtype is None:
+            np_arr = np_arr.astype(np.dtype(get_default_dtype()))
+        arr = jnp.asarray(np_arr)
+    if dtype is not None:
+        d = convert_dtype(dtype)
+        if arr.dtype != d:
+            arr = arr.astype(d)
+    return Tensor(arr, stop_gradient=stop_gradient)
+
+
+# --------------------------------------------------------------------- pytree
+def _tensor_flatten(t: Tensor):
+    return (t._data,), (t.stop_gradient, t.name)
+
+
+def _tensor_unflatten(aux, children):
+    t = Tensor(children[0], stop_gradient=aux[0], name=aux[1])
+    return t
+
+
+jax.tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)

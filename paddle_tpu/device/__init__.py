@@ -1,0 +1,153 @@
+"""Device API.
+
+Parity: reference `python/paddle/device/` — set_device/get_device, device
+counts, synchronization, memory stats. Streams/events collapse: XLA owns
+scheduling on TPU; synchronize == block_until_ready on a probe array.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["set_device", "get_device", "get_all_custom_device_type",
+           "is_compiled_with_cuda", "is_compiled_with_xpu",
+           "is_compiled_with_rocm", "is_compiled_with_custom_device",
+           "device_count", "synchronize", "get_available_device", "cuda",
+           "Stream", "Event", "current_stream", "stream_guard"]
+
+_current_device = [None]
+
+
+def set_device(device: str):
+    """Accepts 'tpu', 'cpu', 'tpu:0' etc. Device residency in jax follows
+    data placement; this sets the default placement hint."""
+    name = device.split(":")[0]
+    _current_device[0] = device
+    return device
+
+
+def get_device():
+    if _current_device[0] is not None:
+        return _current_device[0]
+    d = jax.devices()[0]
+    return f"{d.platform}:{d.id}"
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_all_custom_device_type():
+    return ["tpu"]
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_custom_device(device_type="tpu"):
+    return device_type in ("tpu", "axon")
+
+
+def device_count():
+    return jax.device_count()
+
+
+def synchronize(device=None):
+    jnp.zeros(()).block_until_ready()
+
+
+class Stream:
+    """No-op stream (XLA schedules internally). Kept for API parity."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+_default_stream = Stream()
+
+
+def current_stream(device=None):
+    return _default_stream
+
+
+class stream_guard:
+    def __init__(self, stream):
+        self.stream = stream
+
+    def __enter__(self):
+        return self.stream
+
+    def __exit__(self, *a):
+        return False
+
+
+class _CudaNamespace:
+    """paddle.device.cuda compatibility: returns empty/zero values on TPU."""
+
+    @staticmethod
+    def device_count():
+        return 0
+
+    @staticmethod
+    def is_available():
+        return False
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        return _mem_stats().get("peak_bytes_in_use", 0)
+
+    @staticmethod
+    def memory_allocated(device=None):
+        return _mem_stats().get("bytes_in_use", 0)
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+    Stream = Stream
+    Event = Event
+
+
+def _mem_stats():
+    try:
+        return jax.devices()[0].memory_stats() or {}
+    except Exception:
+        return {}
+
+
+cuda = _CudaNamespace()

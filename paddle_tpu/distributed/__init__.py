@@ -1,0 +1,42 @@
+"""paddle_tpu.distributed — the parallelism suite over jax.sharding.
+
+Parity map (reference python/paddle/distributed/, SURVEY.md §2.5):
+  - collective API -> .collective (XLA collectives / mesh axes)
+  - fleet + hybrid topology -> .fleet (mesh axes [data,pipe,sharding,sep,model])
+  - TP/SP layers (mpu) -> .fleet.mpu
+  - auto-parallel (ProcessMesh/shard_tensor/reshard) -> .auto_parallel
+  - sharding (ZeRO 1/2/3) -> .sharding
+  - pipeline parallel -> .pipeline
+  - MoE / expert parallel -> .moe
+  - sharded checkpoint -> .checkpoint
+  - launch CLI -> .launch
+"""
+from .env import (  # noqa: F401
+    get_rank, get_world_size, init_parallel_env, is_initialized, ParallelEnv,
+)
+from .collective import (  # noqa: F401
+    Group, new_group, all_reduce, all_gather, all_gather_object, all_to_all,
+    all_to_all_single, broadcast, reduce, scatter, reduce_scatter, send, recv,
+    barrier, ReduceOp, is_available, get_backend, destroy_process_group,
+    stream, get_group, broadcast_object_list,
+)
+from .parallel import DataParallel  # noqa: F401
+
+from . import env  # noqa: F401
+from . import auto_parallel  # noqa: F401
+from .auto_parallel.api import (  # noqa: F401
+    shard_tensor, reshard, dtensor_from_local, dtensor_to_local, shard_layer,
+    shard_optimizer, to_static as dist_to_static, unshard_dtensor,
+)
+from .auto_parallel.process_mesh import ProcessMesh  # noqa: F401
+from .auto_parallel.placement_type import (  # noqa: F401
+    Placement, Shard, Replicate, Partial,
+)
+from . import fleet  # noqa: F401
+from . import sharding  # noqa: F401
+from . import checkpoint  # noqa: F401
+from . import pipeline  # noqa: F401
+from . import moe  # noqa: F401
+from . import launch  # noqa: F401
+from . import rpc  # noqa: F401
+from . import utils as dist_utils  # noqa: F401

@@ -1,0 +1,6 @@
+from .process_mesh import ProcessMesh, get_mesh, set_mesh  # noqa: F401
+from .placement_type import Placement, Shard, Replicate, Partial  # noqa: F401
+from .api import (  # noqa: F401
+    shard_tensor, reshard, dtensor_from_local, dtensor_to_local, shard_layer,
+    shard_optimizer, to_static, unshard_dtensor, DistAttr,
+)

@@ -1,0 +1,290 @@
+"""Semi-auto parallel API: shard_tensor / reshard / shard_layer /
+shard_optimizer / dtensor_from_local.
+
+Parity: reference `python/paddle/distributed/auto_parallel/api.py`
+(shard_tensor:204, reshard:726, shard_layer:827, shard_optimizer:1002,
+dtensor_from_local:640) and the C++ DistTensor + reshard function matrix
+(`phi/core/distributed/auto_parallel/reshard/`).
+
+TPU-native: a "DistTensor" is a paddle_tpu Tensor whose jax.Array carries a
+NamedSharding over the ProcessMesh's jax Mesh — placement conversion
+(the r/s/p matrix) is `jax.device_put` to the new sharding, which XLA lowers
+to the same collectives the reference's reshard functions issue explicitly
+(s→r all_gather, r→s slice, s→s' all_to_all, p→r psum, p→s reduce_scatter).
+Partial is represented stacked-along-axis (value = sum over that axis),
+since a jax.Array cannot carry pending-reduction state.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from .placement_type import Partial, Placement, Replicate, Shard
+from .process_mesh import ProcessMesh
+
+__all__ = ["shard_tensor", "reshard", "dtensor_from_local", "dtensor_to_local",
+           "shard_layer", "shard_optimizer", "to_static", "unshard_dtensor",
+           "placements_to_spec", "DistAttr"]
+
+
+def placements_to_spec(placements: Sequence[Placement], ndim: int) -> P:
+    """placements (one per mesh dim) -> PartitionSpec (one entry per tensor
+    dim). Parity role: TensorDistAttr dims_mapping."""
+    entries: List = [None] * ndim
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            d = pl.get_dim()
+            cur = entries[d]
+            name = mesh_dim  # resolved to actual axis name by caller
+            if cur is None:
+                entries[d] = name
+            elif isinstance(cur, tuple):
+                entries[d] = cur + (name,)
+            else:
+                entries[d] = (cur, name)
+    return entries
+
+
+def _build_sharding(mesh: ProcessMesh, placements, ndim):
+    jmesh = mesh.jax_mesh
+    entries = placements_to_spec(placements, ndim)
+    names = mesh.dim_names
+
+    def to_names(e):
+        if e is None:
+            return None
+        if isinstance(e, tuple):
+            return tuple(names[i] for i in e)
+        return names[e]
+    spec = P(*[to_names(e) for e in entries])
+    return NamedSharding(jmesh, spec)
+
+
+class DistAttr:
+    """Parity: TensorDistAttr (mesh + placements view)."""
+
+    def __init__(self, mesh, placements):
+        self.process_mesh = mesh
+        self.placements = list(placements)
+
+
+def _attach(t: Tensor, mesh, placements):
+    t.process_mesh = mesh
+    t.placements = list(placements)
+    return t
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements, dtype=None,
+                 place=None, stop_gradient=None):
+    """Parity: dist.shard_tensor. Returns a Tensor whose array is laid out
+    per `placements` on the mesh."""
+    t = data if isinstance(data, Tensor) else Tensor(jnp.asarray(np.asarray(data)))
+    if any(isinstance(p, Partial) for p in placements):
+        raise ValueError("shard_tensor from a global tensor cannot produce "
+                         "Partial; use dtensor_from_local.")
+    sharding = _build_sharding(mesh, placements, t._data.ndim)
+    arr = jax.device_put(t._data, sharding)
+    out = Tensor(arr, stop_gradient=t.stop_gradient if stop_gradient is None
+                 else stop_gradient, name=t.name)
+    out._is_param = t._is_param
+    return _attach(out, mesh, placements)
+
+
+def reshard(dist_tensor: Tensor, mesh: ProcessMesh, placements):
+    """Parity: dist.reshard — the full r/s/p conversion matrix."""
+    cur_pl = getattr(dist_tensor, "placements", None)
+    cur_mesh = getattr(dist_tensor, "process_mesh", None)
+    has_partial_src = cur_pl is not None and any(
+        isinstance(p, Partial) for p in cur_pl)
+    wants_partial = any(isinstance(p, Partial) for p in placements)
+
+    if has_partial_src:
+        # stacked representation: data shape (axis_size, *logical) sharded on
+        # the partial mesh axis; reduce then continue.
+        pidx = next(i for i, p in enumerate(cur_pl) if isinstance(p, Partial))
+        reduced = jnp.sum(dist_tensor._data, axis=0) \
+            if cur_pl[pidx].reduce_type == "sum" else \
+            jnp.max(dist_tensor._data, axis=0)
+        base = Tensor(reduced, stop_gradient=dist_tensor.stop_gradient)
+        new_pl = [Replicate() if isinstance(p, Partial) else p for p in cur_pl]
+        base = shard_tensor(base, cur_mesh or mesh, new_pl)
+        return reshard(base, mesh, placements)
+
+    if wants_partial:
+        raise ValueError("reshard to Partial is not supported (Partial only "
+                         "arises from local construction).")
+
+    sharding = _build_sharding(mesh, placements, dist_tensor._data.ndim)
+    arr = jax.device_put(dist_tensor._data, sharding)
+    out = Tensor(arr, stop_gradient=dist_tensor.stop_gradient,
+                 name=dist_tensor.name)
+    out._is_param = dist_tensor._is_param
+    return _attach(out, mesh, placements)
+
+
+def dtensor_from_local(local_tensor, mesh: ProcessMesh, placements):
+    """Parity: dist.dtensor_from_local (api.py:640). In single-process SPMD,
+    `local_tensor` may be a list of per-rank locals (test/bootstrap path) or
+    one local replicated across the mesh."""
+    jmesh = mesh.jax_mesh
+    locals_list = local_tensor if isinstance(local_tensor, (list, tuple)) \
+        else [local_tensor] * mesh.size
+    arrs = [l._data if isinstance(l, Tensor) else jnp.asarray(l)
+            for l in locals_list]
+
+    partial_dims = [i for i, p in enumerate(placements) if isinstance(p, Partial)]
+    if partial_dims:
+        # stacked representation (value = sum over the partial axis)
+        pdim = partial_dims[0]
+        stacked = jnp.stack(arrs, axis=0)
+        ax_name = mesh.dim_names[pdim]
+        sharding = NamedSharding(jmesh, P(ax_name))
+        arr = jax.device_put(stacked, sharding)
+        out = Tensor(arr)
+        return _attach(out, mesh, list(placements))
+
+    # assemble the global array from locals
+    shard_dims = {i: p.get_dim() for i, p in enumerate(placements)
+                  if isinstance(p, Shard)}
+    global_shape = list(arrs[0].shape)
+    for mesh_dim, tdim in shard_dims.items():
+        global_shape[tdim] *= mesh.shape[mesh_dim]
+    sharding = _build_sharding(mesh, placements, arrs[0].ndim)
+    devices = list(jmesh.devices.reshape(-1))
+    mesh_shape = mesh.shape
+
+    def local_for_device(flat_idx):
+        coords = np.unravel_index(flat_idx, mesh_shape)
+        return arrs[flat_idx % len(arrs)], coords
+
+    singles = []
+    for i, d in enumerate(devices):
+        a, _ = local_for_device(i)
+        singles.append(jax.device_put(a, d))
+    arr = jax.make_array_from_single_device_arrays(tuple(global_shape),
+                                                   sharding, singles)
+    out = Tensor(arr)
+    return _attach(out, mesh, list(placements))
+
+
+def dtensor_to_local(dist_tensor, mesh=None, placements=None):
+    """The local shard for this process (single-process: addressable shard 0)."""
+    shards = dist_tensor._data.addressable_shards
+    return Tensor(shards[0].data)
+
+
+def unshard_dtensor(dist_tensor):
+    """Gather to a replicated dense tensor. Parity: dist.unshard_dtensor."""
+    mesh = getattr(dist_tensor, "process_mesh", None)
+    if mesh is None:
+        return dist_tensor
+    return reshard(dist_tensor, mesh,
+                   [Replicate()] * len(mesh.shape))
+
+
+def shard_layer(layer, process_mesh: ProcessMesh, shard_fn: Callable = None,
+                input_fn=None, output_fn=None):
+    """Parity: dist.shard_layer (api.py:827): apply shard_fn(name, layer,
+    mesh) over sublayers to place their parameters."""
+    if shard_fn is None:
+        def shard_fn(name, sublayer, mesh):
+            for pname, p in list(sublayer._parameters.items()):
+                if p is not None:
+                    sharded = shard_tensor(p, mesh,
+                                           [Replicate()] * len(mesh.shape))
+                    p._data = sharded._data
+                    _attach(p, mesh, sharded.placements)
+    for name, sub in layer.named_sublayers(include_self=True):
+        shard_fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda l, inputs: input_fn(inputs, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda l, inputs, outputs: output_fn(outputs, process_mesh))
+    return layer
+
+
+class _ShardOptimizer:
+    """Parity: dist.shard_optimizer (+ ShardingStage1/2/3 placement policies,
+    api.py:1002,1306-1504). Wraps an optimizer so accumulators created for a
+    parameter inherit (or override via shard_fn) that parameter's placement."""
+
+    def __init__(self, optimizer, shard_fn=None):
+        self._inner = optimizer
+        self._shard_fn = shard_fn
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def step(self):
+        self._inner.step()
+        if self._shard_fn is not None:
+            for name, slot in self._inner._accumulators.items():
+                for idx, arr in slot.items():
+                    p = self._inner._parameter_list[idx]
+                    mesh = getattr(p, "process_mesh", None)
+                    if mesh is None:
+                        continue
+                    new = self._shard_fn(name, p, Tensor(arr))
+                    if new is not None:
+                        slot[idx] = new._data if isinstance(new, Tensor) else new
+        else:
+            # default: accumulators co-located with the parameter's sharding
+            for name, slot in self._inner._accumulators.items():
+                for idx, arr in slot.items():
+                    p = self._inner._parameter_list[idx]
+                    if isinstance(p._data, jax.Array) and hasattr(arr, "sharding"):
+                        if arr.sharding != p._data.sharding and \
+                                arr.shape == p._data.shape:
+                            slot[idx] = jax.device_put(arr, p._data.sharding)
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    return _ShardOptimizer(optimizer, shard_fn)
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
+    """Parity: dist.to_static -> DistModel. Compiles the dist training step
+    with paddle_tpu.jit.to_static over the already-sharded parameters."""
+    from ...jit import to_static as jit_to_static
+
+    class DistModel:
+        def __init__(self):
+            self.network = layer
+            self._loss = loss
+            self._opt = optimizer
+            self._mode = "train"
+
+            def step_fn(*batch):
+                out = layer(*batch[:-1])
+                l = loss(out, batch[-1]) if loss is not None else out
+                if optimizer is not None:
+                    l.backward()
+                    optimizer.step()
+                    optimizer.clear_grad()
+                return l
+            self._step = jit_to_static(step_fn,
+                                       state_objects=[layer] +
+                                       ([optimizer] if optimizer else []))
+
+        def train(self):
+            self._mode = "train"
+            layer.train()
+
+        def eval(self):
+            self._mode = "eval"
+            layer.eval()
+
+        def __call__(self, *batch):
+            return self._step(*batch)
+
+        def state_dict(self):
+            return layer.state_dict()
+
+    return DistModel()

@@ -1,0 +1,125 @@
+"""ProcessMesh — the device mesh abstraction.
+
+Parity: reference `python/paddle/distributed/auto_parallel/process_mesh.py`
+(+ C++ `phi/core/distributed/auto_parallel/process_mesh.h:34`).
+TPU-native: wraps `jax.sharding.Mesh` over jax.devices(); axes map onto
+ICI dimensions by construction order (outermost axis = slowest/DCN-ish,
+innermost = fastest ICI ring), which is jax's device-order behavior.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = ["ProcessMesh", "get_mesh", "set_mesh"]
+
+_global_mesh: Optional["ProcessMesh"] = None
+
+
+class ProcessMesh:
+    def __init__(self, mesh: Sequence, dim_names: Optional[List[str]] = None,
+                 process_ids=None):
+        arr = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        self._shape = list(arr.shape)
+        self._dim_names = list(dim_names)
+        self._process_ids = arr.reshape(-1).tolist()
+        devices = jax.devices()
+        if arr.size > len(devices):
+            # virtual mesh (e.g. authored for a bigger pod): keep ids; the
+            # jax Mesh is only materialized when enough devices exist.
+            self._jax_mesh = None
+        else:
+            dev_arr = np.asarray([devices[i] for i in self._process_ids],
+                                 dtype=object).reshape(arr.shape)
+            self._jax_mesh = Mesh(dev_arr, tuple(self._dim_names))
+
+    # -- reference API surface --
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self):
+        return list(self._process_ids)
+
+    @property
+    def processes(self):
+        return self.process_ids
+
+    @property
+    def size(self):
+        return int(np.prod(self._shape))
+
+    def get_dim_size(self, dim_name):
+        return self._shape[self._dim_names.index(dim_name)]
+
+    def get_rank_by_dim_and_process_id(self, dim_name, process_id):
+        idx = self._process_ids.index(process_id)
+        coords = np.unravel_index(idx, self._shape)
+        return int(coords[self._dim_names.index(dim_name)])
+
+    def get_mesh_with_dim(self, dim_name, index=None):
+        """Sub-mesh obtained by selecting/moving a dim (reference semantics)."""
+        ax = self._dim_names.index(dim_name)
+        arr = np.asarray(self._process_ids).reshape(self._shape)
+        moved = np.moveaxis(arr, ax, 0)
+        names = [dim_name] + [n for n in self._dim_names if n != dim_name]
+        if index is None:
+            return ProcessMesh(moved, names)
+        return ProcessMesh(moved[index], names[1:])
+
+    # -- TPU-native --
+    @property
+    def jax_mesh(self) -> Mesh:
+        if self._jax_mesh is None:
+            raise RuntimeError(
+                f"ProcessMesh of size {self.size} exceeds available devices "
+                f"({jax.device_count()}); materialize on a larger slice or "
+                "use XLA_FLAGS=--xla_force_host_platform_device_count.")
+        return self._jax_mesh
+
+    def __enter__(self):
+        global _global_mesh
+        self._prev = _global_mesh
+        _global_mesh = self
+        return self
+
+    def __exit__(self, *a):
+        global _global_mesh
+        _global_mesh = self._prev
+        return False
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh) and
+                self._shape == other._shape and
+                self._process_ids == other._process_ids)
+
+    def __hash__(self):
+        return hash((tuple(self._shape), tuple(self._process_ids)))
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self._shape}, dim_names={self._dim_names},"
+                f" process_ids={self._process_ids[:8]}{'...' if self.size > 8 else ''})")
+
+
+def get_mesh():
+    return _global_mesh
+
+
+def set_mesh(mesh):
+    global _global_mesh
+    _global_mesh = mesh
+    return mesh

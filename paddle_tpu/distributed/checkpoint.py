@@ -1,0 +1,2 @@
+"""Sharded checkpoint (placeholder — orbax-backed impl next)."""
+__all__ = []

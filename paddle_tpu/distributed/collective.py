@@ -1,0 +1,272 @@
+"""Groups + functional collectives.
+
+Parity: reference ProcessGroup stack (`paddle/phi/core/distributed/collective/
+process_group.h:48`, python `distributed/communication/*`). TPU-native
+collapse (SURVEY.md §5): a Group is a view over mesh axes; collectives
+inside a pjit/shard_map trace lower to XLA collectives on ICI
+(psum/all_gather/ppermute/all_to_all); outside a trace on a single process
+they are identity/local ops (world of one rank per process — the reference
+semantics for nranks==1).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops.dispatch import apply_op
+
+__all__ = ["Group", "new_group", "get_group", "all_reduce", "all_gather",
+           "all_gather_object", "all_to_all", "all_to_all_single", "broadcast",
+           "reduce", "scatter", "reduce_scatter", "send", "recv", "barrier",
+           "ReduceOp", "is_available", "get_backend", "destroy_process_group",
+           "stream"]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A logical communication group = a mesh axis name (in-trace) or a rank
+    list (process-level bookkeeping)."""
+
+    def __init__(self, rank: int, ranks: List[int], id: int = 0,
+                 axis_name: Optional[str] = None):
+        self.rank = rank
+        self.ranks = list(ranks)
+        self.id = id
+        self.axis_name = axis_name
+
+    @property
+    def nranks(self):
+        return len(self.ranks)
+
+    world_size = nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    @property
+    def process_group(self):
+        return self
+
+    def __repr__(self):
+        return f"Group(id={self.id}, nranks={self.nranks}, axis={self.axis_name})"
+
+
+_groups = {}
+_next_gid = [1]
+
+
+def _default_group():
+    if 0 not in _groups:
+        from .env import get_rank, get_world_size
+        _groups[0] = Group(get_rank(), list(range(max(get_world_size(), 1))), 0)
+    return _groups[0]
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis_name=None):
+    from .env import get_rank, get_world_size
+    if ranks is None:
+        ranks = list(range(max(get_world_size(), 1)))
+    gid = _next_gid[0]
+    _next_gid[0] += 1
+    g = Group(get_rank() if get_rank() in ranks else -1, ranks, gid, axis_name)
+    _groups[gid] = g
+    return g
+
+
+def get_group(gid=0):
+    return _groups.get(gid, _default_group())
+
+
+def is_available():
+    return True
+
+
+def get_backend(group=None):
+    return "xla"
+
+
+def destroy_process_group(group=None):
+    if group is None:
+        _groups.clear()
+    else:
+        _groups.pop(group.id, None)
+
+
+def _axis_in_trace(axis_name):
+    """True if axis_name is a bound axis in the current shard_map/pmap trace."""
+    if axis_name is None:
+        return False
+    try:
+        jax.lax.axis_index(axis_name)
+        return True
+    except NameError:
+        return False
+    except Exception:
+        return False
+
+
+def _resolve_axis(group):
+    if group is None:
+        group = _default_group()
+    return group.axis_name
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Parity: paddle.distributed.all_reduce (in-place on tensor)."""
+    axis = _resolve_axis(group)
+    if axis and _axis_in_trace(axis):
+        fns = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
+               ReduceOp.MIN: jax.lax.pmin,
+               ReduceOp.AVG: lambda x, a: jax.lax.pmean(x, a)}
+        out = apply_op("all_reduce", lambda x: fns[op](x, axis), tensor)
+        tensor._data = out._data
+        tensor._grad_node = out._grad_node
+        tensor._grad_out_idx = out._grad_out_idx
+        tensor.stop_gradient = out.stop_gradient
+    # single-rank group: identity
+    return tensor
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    ax = _resolve_axis(group)
+    if ax and _axis_in_trace(ax):
+        out = apply_op("all_gather",
+                       lambda x: jax.lax.all_gather(x, ax, tiled=False), tensor)
+        n = (group or _default_group()).nranks
+        from ..ops.manipulation import unbind
+        parts = unbind(out, 0)
+        tensor_list.clear()
+        tensor_list.extend(parts)
+        return tensor_list
+    tensor_list.clear()
+    tensor_list.append(tensor)
+    return tensor_list
+
+
+def all_gather_object(object_list, obj, group=None):
+    object_list.clear()
+    object_list.append(obj)
+    return object_list
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    ax = _resolve_axis(group)
+    if ax and _axis_in_trace(ax):
+        from ..ops.manipulation import stack, unbind
+        stacked = stack(list(in_tensor_list), axis=0)
+        out = apply_op("all_to_all",
+                       lambda x: jax.lax.all_to_all(x, ax, split_axis=0,
+                                                    concat_axis=0, tiled=False),
+                       stacked)
+        parts = unbind(out, 0)
+        out_tensor_list.clear()
+        out_tensor_list.extend(parts)
+        return out_tensor_list
+    out_tensor_list.clear()
+    out_tensor_list.extend(in_tensor_list)
+    return out_tensor_list
+
+
+def all_to_all_single(out_tensor, in_tensor, in_split_sizes=None,
+                      out_split_sizes=None, group=None, sync_op=True):
+    ax = _resolve_axis(group)
+    if ax and _axis_in_trace(ax):
+        n = (group or _default_group()).nranks
+        out = apply_op(
+            "all_to_all_single",
+            lambda x: jax.lax.all_to_all(
+                x.reshape((n, x.shape[0] // n) + x.shape[1:]), ax,
+                split_axis=0, concat_axis=0, tiled=True), in_tensor)
+        out_tensor._data = out._data.reshape(out_tensor._data.shape)
+        return out_tensor
+    out_tensor._data = in_tensor._data
+    return out_tensor
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    # In-trace SPMD: all ranks compute identically; broadcast is a no-op on
+    # replicated values. Cross-process eager: handled by checkpoint/init sync.
+    return tensor
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    return object_list
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    ax = _resolve_axis(group)
+    if ax and _axis_in_trace(ax):
+        from ..ops.manipulation import stack
+        stacked = stack(list(tensor_list), axis=0)
+        idx = jax.lax.axis_index(ax)
+        out = apply_op("scatter", lambda x: x[idx], stacked)
+        tensor._data = out._data
+        return tensor
+    if tensor_list:
+        tensor._data = tensor_list[src]._data
+    return tensor
+
+
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    ax = _resolve_axis(group)
+    if ax and _axis_in_trace(ax):
+        from ..ops.manipulation import stack
+        stacked = stack(list(tensor_list), axis=0)
+        out = apply_op("reduce_scatter",
+                       lambda x: jax.lax.psum_scatter(x, ax, scatter_dimension=0,
+                                                      tiled=False), stacked)
+        tensor._data = out._data
+        return tensor
+    if tensor_list:
+        acc = tensor_list[0]._data
+        for t in tensor_list[1:]:
+            acc = acc + t._data
+        tensor._data = acc
+    return tensor
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "point-to-point send/recv outside a pipeline schedule is not "
+        "supported; use distributed.pipeline (ppermute-based) instead.")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "point-to-point send/recv outside a pipeline schedule is not "
+        "supported; use distributed.pipeline (ppermute-based) instead.")
+
+
+def barrier(group=None):
+    jnp.zeros(()).block_until_ready()
+
+
+class _StreamNamespace:
+    """paddle.distributed.stream.* async variants — on TPU all collectives
+    are in-graph and asynchronously scheduled by XLA, so these alias the
+    sync API (sync_op is accepted and ignored)."""
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    all_to_all = staticmethod(all_to_all)
+    broadcast = staticmethod(broadcast)
+    reduce = staticmethod(reduce)
+    scatter = staticmethod(scatter)
+    reduce_scatter = staticmethod(reduce_scatter)
+
+
+stream = _StreamNamespace()

@@ -1,0 +1,83 @@
+"""Distributed environment/bootstrap.
+
+Parity: reference `python/paddle/distributed/parallel.py` env handling
+(PADDLE_TRAINER_* vars + TCPStore rendezvous). TPU-native: rendezvous is
+jax.distributed.initialize (PJRT coordination service) — the TCPStore role;
+single-process multi-device is the common TPU mode, where world_size is the
+process count (1) but the device mesh spans all chips.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = ["get_rank", "get_world_size", "init_parallel_env",
+           "is_initialized", "ParallelEnv"]
+
+_initialized = [False]
+
+
+def init_parallel_env(strategy=None):
+    """Parity: paddle.distributed.init_parallel_env. Multi-host: reads
+    coordinator address from env (PADDLE_MASTER or JAX_COORDINATOR) and
+    calls jax.distributed.initialize."""
+    if _initialized[0]:
+        return ParallelEnv()
+    coord = os.environ.get("PADDLE_MASTER") or os.environ.get("JAX_COORDINATOR")
+    nnodes = int(os.environ.get("PADDLE_NNODES", "1"))
+    if coord and nnodes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ.get("PADDLE_TRAINERS_NUM", nnodes)),
+            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+    _initialized[0] = True
+    return ParallelEnv()
+
+
+def is_initialized():
+    return _initialized[0]
+
+
+def get_rank(group=None):
+    if group is not None:
+        return group.rank
+    return jax.process_index()
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    return jax.process_count()
+
+
+class ParallelEnv:
+    """Parity: paddle.distributed.ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:0")
+
+    @property
+    def trainer_endpoints(self):
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return int(os.environ.get("PADDLE_RANK_IN_NODE", "0"))

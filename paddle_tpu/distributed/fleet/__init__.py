@@ -1,0 +1,28 @@
+"""paddle_tpu.distributed.fleet — hybrid-parallel facade.
+
+Parity: reference python/paddle/distributed/fleet/.
+"""
+from .distributed_strategy import DistributedStrategy  # noqa: F401
+from .topology import CommunicateTopology, HybridCommunicateGroup, build_mesh  # noqa: F401
+from .fleet import (  # noqa: F401
+    init, is_initialized, distributed_model, distributed_optimizer,
+    get_hybrid_communicate_group, collective_perf,
+)
+from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer  # noqa: F401
+from .meta_parallel import (  # noqa: F401
+    TensorParallel, ShardingParallel, SegmentParallel, PipelineParallel,
+)
+from .hybrid_parallel_optimizer import HybridParallelOptimizer  # noqa: F401
+from . import mpu  # noqa: F401
+from .mpu import (  # noqa: F401
+    VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
+    ParallelCrossEntropy, get_rng_state_tracker,
+)
+
+
+def __getattr__(name):
+    # live view of the hybrid group (fleet.init mutates fleet.fleet._hcg)
+    if name == "_hcg":
+        from . import fleet as _f
+        return _f._hcg
+    raise AttributeError(name)

@@ -1,0 +1,104 @@
+"""DistributedStrategy — unified parallelism config.
+
+Parity: reference `python/paddle/distributed/fleet/base/distributed_strategy.py`
+(protobuf-backed, `framework/distributed_strategy.proto:363` ~275 fields,
+see SURVEY.md A.5). TPU rebuild: one plain config object covering the axes
+that carry over — hybrid degrees+order, micro-batching, sharding stage,
+recompute, amp, fusion toggles.
+"""
+from __future__ import annotations
+
+import copy
+
+__all__ = ["DistributedStrategy"]
+
+
+_DEFAULTS = {
+    "hybrid_configs": {
+        "dp_degree": 1,
+        "mp_degree": 1,
+        "pp_degree": 1,
+        "sharding_degree": 1,
+        "sep_degree": 1,
+        "order": ["dp", "pp", "sharding", "sep", "mp"],
+    },
+    "pipeline_configs": {
+        "micro_batch_size": 1,
+        "accumulate_steps": 1,
+        "schedule_mode": "1F1B",   # FThenB | 1F1B | VPP | ZBH1
+        "p2p_cache_shape": True,
+    },
+    "sharding_configs": {
+        "stage": 1,
+        "degree": 1,
+        "offload": False,
+        "comm_overlap": True,
+    },
+    "tensor_parallel_configs": {
+        "tensor_parallel_degree": 1,
+        "tensor_init_seed": -1,
+    },
+    "amp_configs": {
+        "init_loss_scaling": 32768.0,
+        "use_dynamic_loss_scaling": True,
+        "custom_white_list": [],
+        "custom_black_list": [],
+        "use_pure_fp16": False,
+        "use_bf16": True,
+        "level": "O1",
+    },
+    "recompute_configs": {
+        "checkpoints": [],
+        "enable_offload": False,
+    },
+    "gradient_merge_configs": {"k_steps": 1, "avg": True},
+    "lars_configs": {}, "lamb_configs": {}, "dgc_configs": {},
+    "localsgd_configs": {}, "a_sync_configs": {},
+}
+
+_FLAGS = {
+    "amp": False, "recompute": False, "pipeline": False, "sharding": False,
+    "dgc": False, "lars": False, "lamb": False, "localsgd": False,
+    "gradient_merge": False, "a_sync": False, "tensor_parallel": False,
+    "heter_ccl_mode": False, "fuse_all_reduce_ops": True,
+    "find_unused_parameters": False, "without_graph_optimization": True,
+}
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self._configs = copy.deepcopy(_DEFAULTS)
+        self._flags = dict(_FLAGS)
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name in self._configs:
+            return self._configs[name]
+        if name in self._flags:
+            return self._flags[name]
+        raise AttributeError(f"DistributedStrategy has no field {name!r}")
+
+    def __setattr__(self, name, value):
+        if name.startswith("_"):
+            object.__setattr__(self, name, value)
+            return
+        if name in _DEFAULTS:
+            merged = copy.deepcopy(_DEFAULTS[name])
+            merged.update(value or {})
+            self._configs[name] = merged
+        elif name in _FLAGS:
+            self._flags[name] = bool(value)
+        else:
+            raise AttributeError(f"DistributedStrategy has no field {name!r}")
+
+    def to_dict(self):
+        return {"configs": copy.deepcopy(self._configs),
+                "flags": dict(self._flags)}
+
+    def __repr__(self):
+        on = [k for k, v in self._flags.items() if v]
+        h = self._configs["hybrid_configs"]
+        return (f"DistributedStrategy(dp={h['dp_degree']} mp={h['mp_degree']} "
+                f"pp={h['pp_degree']} sharding={h['sharding_degree']} "
+                f"sep={h['sep_degree']}, enabled={on})")

@@ -1,0 +1,157 @@
+"""Hybrid-parallel model wrappers.
+
+Parity: reference `fleet/meta_parallel/` — `TensorParallel` (tensor_parallel.
+py:28), `ShardingParallel`, `SegmentParallel` (segment_parallel.py:26),
+`PipelineParallel` with FThenB / 1F1B micro-batch schedules
+(pipeline_parallel.py:245,565,2018).
+
+TPU-native notes: parameter broadcast/sync at wrap time is a no-op in
+single-process SPMD (one copy of truth); gradient synchronization happens
+either via GSPMD (sharded batch axis) or explicitly in-trace. The PP
+wrapper here provides the reference's micro-batch semantics (gradient
+accumulation with schedule-ordered fwd/bwd); the throughput-oriented
+in-graph pipeline (shard_map + ppermute over the 'pipe' axis) lives in
+distributed.pipeline and is used by the model recipes.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...nn.layer.layers import Layer
+from .pp_layers import PipelineLayer
+
+__all__ = ["TensorParallel", "ShardingParallel", "SegmentParallel",
+           "PipelineParallel"]
+
+
+class _MetaParallelBase(Layer):
+    def __init__(self, layers, hcg, strategy):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
+
+
+class TensorParallel(_MetaParallelBase):
+    """Parity: fleet/meta_parallel/tensor_parallel.py:28."""
+
+
+class ShardingParallel(_MetaParallelBase):
+    """Parity: fleet/meta_parallel/sharding_parallel.py."""
+
+
+class SegmentParallel(_MetaParallelBase):
+    """Sequence/segment parallel wrapper (parity: segment_parallel.py:26).
+    Activations are sharded along the sequence dim over the 'sep' axis;
+    attention uses all-to-all (Ulysses) via the sp utilities."""
+
+
+class PipelineParallel(_MetaParallelBase):
+    """Parity: fleet/meta_parallel/pipeline_parallel.py (train_batch:810,
+    forward_backward_pipeline 1F1B:565)."""
+
+    def __init__(self, layers, hcg, strategy):
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError("PipelineParallel needs a PipelineLayer")
+        super().__init__(layers, hcg, strategy)
+        pcfg = strategy.pipeline_configs if strategy else {}
+        self._micro_batch_size = pcfg.get("micro_batch_size", 1)
+        self._accumulate_steps = pcfg.get("accumulate_steps", 1)
+        self._schedule = pcfg.get("schedule_mode", "1F1B")
+        self._step_callbacks = []
+
+    def register_micro_step_callback(self, fn):
+        """Parity: pipeline_parallel.py:166 micro-batch step callbacks."""
+        self._step_callbacks.append(fn)
+
+    def _split_micro(self, data):
+        from ...ops.manipulation import split
+        x, y = data
+        n = self._accumulate_steps
+        xs = split(x, n, axis=0) if n > 1 else [x]
+        ys = split(y, n, axis=0) if n > 1 else [y]
+        return list(zip(xs, ys))
+
+    def forward_backward_pipeline(self, data, scaler=None):
+        """Micro-batch schedule. On TPU every 'rank' sees the whole graph
+        (SPMD); the 1F1B ordering is realized for memory by interleaving
+        fwd/bwd over micro-batches — backward for micro i is issued as soon
+        as its forward completes in the steady state."""
+        micros = self._split_micro(data)
+        total = None
+        n = len(micros)
+        warmup = min(self._hcg.get_pipe_parallel_world_size() - 1, n) \
+            if self._schedule == "1F1B" else n
+        pending = []
+
+        def fwd(mb):
+            x, y = mb
+            out = self._layers.forward(x)
+            loss = self._layers.loss(out, y)
+            if scaler is not None:
+                loss_s = scaler.scale(loss)
+            else:
+                loss_s = loss
+            return loss, loss_s
+
+        def bwd(loss_s):
+            (loss_s / n).backward()
+
+        # warmup forwards
+        for i in range(warmup):
+            pending.append(fwd(micros[i]))
+        # steady 1F1B
+        for i in range(warmup, n):
+            loss, loss_s = pending.pop(0)
+            total = loss.detach() if total is None else total + loss.detach()
+            bwd(loss_s)
+            pending.append(fwd(micros[i]))
+            for cb in self._step_callbacks:
+                cb(i)
+        # cooldown
+        for loss, loss_s in pending:
+            total = loss.detach() if total is None else total + loss.detach()
+            bwd(loss_s)
+        return total / n if total is not None else None
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """Parity: pipeline_parallel.py:810."""
+        loss = self.forward_backward_pipeline(data, scaler)
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss
+
+    def eval_batch(self, data, compute_loss=True):
+        from ...core.autograd import no_grad
+        micros = self._split_micro(data)
+        total = None
+        with no_grad():
+            for x, y in micros:
+                out = self._layers.forward(x)
+                loss = self._layers.loss(out, y) if compute_loss else out
+                total = loss if total is None else total + loss
+        return total / len(micros)

@@ -1,0 +1,219 @@
+"""Model-parallel (TP) layers + TP RNG tracker.
+
+Parity: reference `python/paddle/distributed/fleet/layers/mpu/`
+(mp_layers.py: VocabParallelEmbedding:47, ColumnParallelLinear:334,
+RowParallelLinear:541, ParallelCrossEntropy:742; mp_ops.py c_identity/
+c_split/mp_allreduce PyLayers; random.py RNGStatesTracker:34).
+
+TPU-native: instead of explicit c_* collective ops, weights carry a
+NamedSharding over the 'model' mesh axis and forwards place GSPMD sharding
+constraints; XLA inserts the all_gather/psum on ICI exactly where the
+reference issues NCCL calls. The explicit-collective formulation remains
+available through shard_map when the 'model' axis is bound (see
+distributed.collective).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from ...nn import functional as F
+from ...nn.initializer import Constant, Normal, XavierUniform
+from ...nn.layer.layers import Layer
+from ...ops.dispatch import apply_op
+from ...framework.random import RNGState
+
+__all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
+           "RowParallelLinear", "ParallelCrossEntropy", "RNGStatesTracker",
+           "get_rng_state_tracker", "mark_sharding", "current_mesh"]
+
+MODEL_AXIS = "model"
+
+
+def current_mesh():
+    """The active hybrid mesh (set by fleet.init) or None."""
+    from . import fleet as fleet_mod
+    hcg = fleet_mod._hcg
+    return hcg.mesh if hcg is not None else None
+
+
+def _constraint(arr, spec):
+    """Apply a GSPMD sharding constraint if we're under a mesh-aware trace."""
+    mesh = current_mesh()
+    if mesh is None or isinstance(arr, (int, float)):
+        return arr
+    try:
+        return jax.lax.with_sharding_constraint(arr, NamedSharding(mesh, spec))
+    except Exception:
+        return arr
+
+
+def mark_sharding(param: Tensor, spec: P):
+    """Place a parameter according to spec on the hybrid mesh (device_put now
+    if mesh is live; always record intent for the pjit path)."""
+    param._spec = spec
+    mesh = current_mesh()
+    if mesh is not None:
+        try:
+            param._data = jax.device_put(param._data, NamedSharding(mesh, spec))
+        except Exception:
+            pass
+    return param
+
+
+class RNGStatesTracker:
+    """Named RNG streams so TP ranks can draw either identical (replicated
+    init) or axis-distinct (dropout inside TP region) randomness.
+    Parity: fleet/layers/mpu/random.py:34."""
+
+    def __init__(self):
+        self.states_ = {}
+
+    def reset(self):
+        self.states_ = {}
+
+    def add(self, name, seed):
+        if name in self.states_:
+            raise ValueError(f"rng state {name} already exists")
+        self.states_[name] = RNGState(int(seed))
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    class _Guard:
+        def __init__(self, tracker, name):
+            self.tracker, self.name = tracker, name
+
+        def __enter__(self):
+            from ...framework import random as _r
+            self._saved = _r._global
+            _r._global = self.tracker.states_[self.name]
+            return self
+
+        def __exit__(self, *a):
+            from ...framework import random as _r
+            _r._global = self._saved
+            return False
+
+    def rng_state(self, name="model_parallel_rng"):
+        if name not in self.states_:
+            self.add(name, 0)
+        return RNGStatesTracker._Guard(self, name)
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _tracker
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded over the model axis.
+    Parity: mp_layers.py:47 (c_embedding kernel + allreduce); GSPMD emits
+    the same gather+psum from the sharded jnp.take."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._num_embeddings = num_embeddings
+        self._embedding_dim = embedding_dim
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim), attr=weight_attr,
+            default_initializer=XavierUniform())
+        mark_sharding(self.weight, P(MODEL_AXIS, None))
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        return apply_op("vp_embedding_out", lambda a: _constraint(a, P()), out)
+
+
+class ColumnParallelLinear(Layer):
+    """Linear with output dim sharded over model axis.
+    Parity: mp_layers.py:334."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.gather_output = gather_output
+        self.out_features = out_features
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=XavierUniform())
+        mark_sharding(self.weight, P(None, MODEL_AXIS))
+        if has_bias:
+            self.bias = self.create_parameter((out_features,), is_bias=True)
+            mark_sharding(self.bias, P(MODEL_AXIS))
+        else:
+            self.bias = None
+            self._parameters["bias"] = None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        spec = P() if self.gather_output else \
+            P(*([None] * (out.ndim - 1) + [MODEL_AXIS]))
+        return apply_op("col_parallel_out", lambda a: _constraint(a, spec), out)
+
+
+class RowParallelLinear(Layer):
+    """Linear with input dim sharded over model axis; output is psum-reduced.
+    Parity: mp_layers.py:541."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=XavierUniform())
+        mark_sharding(self.weight, P(MODEL_AXIS, None))
+        if has_bias:
+            self.bias = self.create_parameter((out_features,), is_bias=True)
+            mark_sharding(self.bias, P())
+        else:
+            self.bias = None
+            self._parameters["bias"] = None
+
+    def forward(self, x):
+        if not self.input_is_parallel:
+            x = apply_op(
+                "row_parallel_in",
+                lambda a: _constraint(
+                    a, P(*([None] * (a.ndim - 1) + [MODEL_AXIS]))), x)
+        out = F.linear(x, self.weight, None)
+        out = apply_op("row_parallel_out", lambda a: _constraint(a, P()), out)
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+class ParallelCrossEntropy(Layer):
+    """Softmax-CE over a class dim sharded on the model axis.
+    Parity: mp_layers.py:742 (c_softmax_with_cross_entropy). GSPMD keeps the
+    logits sharded and reduces the log-sum-exp over ICI."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        def _f(logits, lab):
+            lab = lab.astype(jnp.int32)
+            if lab.ndim == logits.ndim:
+                lab = jnp.squeeze(lab, -1)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            valid = lab != self.ignore_index
+            safe = jnp.where(valid, lab, 0)
+            picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+            loss = jnp.where(valid, -picked, 0.0)
+            return loss[..., None]
+        return apply_op("parallel_cross_entropy", _f, input, label)

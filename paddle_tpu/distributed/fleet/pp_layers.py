@@ -1,0 +1,137 @@
+"""PipelineLayer: stage-partitionable model description.
+
+Parity: reference `python/paddle/distributed/fleet/meta_parallel/
+parallel_layers/pp_layers.py` (LayerDesc:56, SharedLayerDesc:76,
+PipelineLayer:257 with uniform/cost segmentation).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ...nn.layer.layers import Layer
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer"]
+
+
+class LayerDesc:
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+        if not issubclass(layer_cls, Layer):
+            raise TypeError("LayerDesc expects a Layer subclass")
+
+    def build_layer(self):
+        return self.layer_cls(*self.args, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_cls.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Weight-tied layer appearing in several stages (e.g. embedding +
+    output head). Parity: pp_layers.py:76."""
+
+    def __init__(self, key, layer_cls, forward_func=None, shared_weight_attr="weight",
+                 *args, **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    """Parity: pp_layers.py:257. Builds only this stage's layers when a
+    topology is provided; single-process SPMD mode builds all stages and the
+    stage structure drives the in-graph pipeline executor
+    (distributed.pipeline)."""
+
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform", recompute_interval=0,
+                 num_virtual_pipeline_stages=None, **kwargs):
+        super().__init__()
+        self._layers_desc = list(layers)
+        self._loss_fn = loss_fn
+        self._topo = topology
+        self._recompute_interval = recompute_interval
+        self._num_stages = num_stages or (
+            topology.get_dim("pipe") if topology else 1)
+        self._seg_method = seg_method
+        self._shared_layers = {}
+
+        self.segment_parts = self._segment(len(self._layers_desc),
+                                           self._num_stages, seg_method)
+        # SPMD single-process: materialize every stage (sharding over the
+        # 'pipe' axis happens at the array level, not by owning a subset).
+        from ...nn.layer.container import LayerList
+        built = []
+        for desc in self._layers_desc:
+            if isinstance(desc, SharedLayerDesc):
+                if desc.layer_name in self._shared_layers:
+                    built.append(_SharedRef(
+                        self._shared_layers[desc.layer_name], desc.forward_func))
+                    continue
+                layer = desc.build_layer()
+                self._shared_layers[desc.layer_name] = layer
+                built.append(layer)
+            elif isinstance(desc, LayerDesc):
+                built.append(desc.build_layer())
+            elif isinstance(desc, Layer):
+                built.append(desc)
+            elif callable(desc):
+                built.append(_FnLayer(desc))
+            else:
+                raise TypeError(f"bad pipeline entry {desc!r}")
+        self.run_function = LayerList(built)
+
+    @staticmethod
+    def _segment(n_layers, n_stages, method):
+        """Uniform (or 'layer:'-prefix cost) segmentation -> stage boundaries.
+        Parity: SegmentLayers in pp_layers.py:92."""
+        base = n_layers // n_stages
+        extra = n_layers % n_stages
+        bounds = [0]
+        for s in range(n_stages):
+            bounds.append(bounds[-1] + base + (1 if s < extra else 0))
+        return bounds
+
+    def get_stage_layers(self, stage_id):
+        lo, hi = self.segment_parts[stage_id], self.segment_parts[stage_id + 1]
+        return list(self.run_function)[lo:hi]
+
+    def forward(self, x, **kwargs):
+        for layer in self.run_function:
+            x = layer(x)
+        return x
+
+    def loss(self, output, label):
+        if self._loss_fn is None:
+            return output
+        return self._loss_fn(output, label)
+
+
+class _FnLayer(Layer):
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)
+
+
+class _SharedRef(Layer):
+    """Second occurrence of a SharedLayerDesc: reuses the first build's
+    parameters (weight tying)."""
+
+    def __init__(self, target, forward_func):
+        super().__init__()
+        self._target_ref = [target]  # avoid registering as sublayer (no dup)
+        self._forward_func = forward_func
+
+    def forward(self, x):
+        target = self._target_ref[0]
+        if self._forward_func is not None:
+            return self._forward_func(target, x)
+        return target(x)

@@ -1,0 +1,225 @@
+"""Hybrid-parallel topology: the [data, pipe, sharding, sep, model] axes.
+
+Parity: reference `python/paddle/distributed/fleet/base/topology.py:70-90`
+(CommunicateTopology) and `:189` (HybridCommunicateGroup building per-axis
+comm groups, incl. fused dp+sep and pp+mp groups at :468-565).
+
+TPU-native: the topology IS a jax.sharding.Mesh with those axis names; a
+"comm group" is a mesh-axis view (Group with axis_name), not an NCCL ring.
+Axis order maps outer->inner onto the device list, so the innermost axes
+(model/sep) ride the fastest ICI dimension — the same locality goal the
+reference achieves with its rank-ordering convention.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..collective import Group, new_group
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup", "build_mesh"]
+
+_HYBRID_AXES = ["data", "pipe", "sharding", "sep", "model"]
+
+
+def build_mesh(dp=1, pp=1, sharding=1, sep=1, mp=1, devices=None) -> Mesh:
+    """Build the hybrid mesh. Degree product must equal device count."""
+    devices = devices if devices is not None else jax.devices()
+    dims = [dp, pp, sharding, sep, mp]
+    total = int(np.prod(dims))
+    if total != len(devices):
+        raise ValueError(f"mesh degrees {dims} (={total}) != devices "
+                         f"({len(devices)})")
+    arr = np.asarray(devices, dtype=object).reshape(dims)
+    return Mesh(arr, tuple(_HYBRID_AXES))
+
+
+class CommunicateTopology:
+    """Parity: CommunicateTopology (topology.py:70)."""
+
+    def __init__(self, hybrid_group_names: Sequence[str] = _HYBRID_AXES,
+                 dims: Sequence[int] = (1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = [int(d) for d in dims]
+        self._world_size = int(np.prod(self._dims))
+        self._coords = np.array(
+            np.unravel_index(np.arange(self._world_size), self._dims)).T
+
+    def get_hybrid_group_names(self):
+        return list(self._parallel_names)
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world_size
+
+    def get_rank(self, **kwargs):
+        coord = [kwargs[n] for n in self._parallel_names]
+        return int(np.ravel_multi_index(coord, self._dims))
+
+    def get_coord(self, rank):
+        return tuple(int(c) for c in self._coords[rank])
+
+    def get_axis_list(self, axis_name, index):
+        """All ranks whose coordinate on `axis_name` equals index."""
+        ax = self._parallel_names.index(axis_name)
+        return [r for r in range(self._world_size)
+                if self._coords[r][ax] == index]
+
+    def get_comm_list(self, axis_name):
+        """Partition of ranks into groups along `axis_name` (each group
+        varies that axis, fixes the others)."""
+        ax = self._parallel_names.index(axis_name)
+        groups: Dict[tuple, List[int]] = {}
+        for r in range(self._world_size):
+            key = tuple(c for i, c in enumerate(self._coords[r]) if i != ax)
+            groups.setdefault(key, []).append(r)
+        return list(groups.values())
+
+    def get_fused_ranks(self, fused_axes):
+        """Groups varying all axes in `fused_axes` jointly (reference's
+        dp+sep / pp+mp fusion)."""
+        axes = [self._parallel_names.index(a) for a in fused_axes]
+        groups: Dict[tuple, List[int]] = {}
+        for r in range(self._world_size):
+            key = tuple(c for i, c in enumerate(self._coords[r])
+                        if i not in axes)
+            groups.setdefault(key, []).append(r)
+        return list(groups.values())
+
+
+class HybridCommunicateGroup:
+    """Parity: HybridCommunicateGroup (topology.py:189). Holds the mesh and
+    per-axis Group views + convenience accessors used by fleet wrappers."""
+
+    def __init__(self, topology: CommunicateTopology, rank: int = 0,
+                 devices=None):
+        self._topo = topology
+        self.global_rank = rank
+        self.nranks = topology.world_size()
+        names = topology.get_hybrid_group_names()
+        dims = [topology.get_dim(n) for n in names]
+        self._dp_degree = topology.get_dim("data") if "data" in names else 1
+        self._pp_degree = topology.get_dim("pipe") if "pipe" in names else 1
+        self._sharding_degree = topology.get_dim("sharding") if "sharding" in names else 1
+        self._sep_degree = topology.get_dim("sep") if "sep" in names else 1
+        self._mp_degree = topology.get_dim("model") if "model" in names else 1
+
+        devices = devices if devices is not None else jax.devices()
+        if int(np.prod(dims)) == len(devices):
+            arr = np.asarray(devices, dtype=object).reshape(dims)
+            self.mesh: Optional[Mesh] = Mesh(arr, tuple(names))
+        else:
+            self.mesh = None  # virtual topology (authored for larger slice)
+
+        self._groups: Dict[str, Group] = {}
+        coord = topology.get_coord(rank)
+        for ax, name in enumerate(names):
+            ranks_lists = topology.get_comm_list(name)
+            my = next(g for g in ranks_lists if rank in g)
+            self._groups[name] = Group(my.index(rank), my, id=ax + 1,
+                                       axis_name=name)
+
+    # ---- reference accessor surface (used by meta_parallel wrappers) ----
+    def get_parallel_mode(self):
+        if self._pp_degree > 1:
+            return "pipeline"
+        if self._sharding_degree > 1:
+            return "sharding_parallel"
+        if self._mp_degree > 1:
+            return "tensor_parallel"
+        return "data_parallel"
+
+    def topology(self):
+        return self._topo
+
+    def get_global_rank(self):
+        return self.global_rank
+
+    # data parallel
+    def get_data_parallel_rank(self):
+        return self._groups["data"].rank
+
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_data_parallel_group(self):
+        return self._groups["data"]
+
+    def get_data_parallel_group_src_rank(self):
+        return self._groups["data"].ranks[0]
+
+    # model (tensor) parallel
+    def get_model_parallel_rank(self):
+        return self._groups["model"].rank
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_model_parallel_group(self):
+        return self._groups["model"]
+
+    def get_model_parallel_group_src_rank(self):
+        return self._groups["model"].ranks[0]
+
+    # pipeline parallel
+    def get_stage_id(self):
+        return self._groups["pipe"].rank
+
+    def get_pipe_parallel_rank(self):
+        return self._groups["pipe"].rank
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_pipe_parallel_group(self):
+        return self._groups["pipe"]
+
+    def is_first_stage(self):
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self):
+        return self.get_stage_id() == self._pp_degree - 1
+
+    # sharding
+    def get_sharding_parallel_rank(self):
+        return self._groups["sharding"].rank
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sharding_parallel_group(self):
+        return self._groups["sharding"]
+
+    # sep
+    def get_sep_parallel_rank(self):
+        return self._groups["sep"].rank
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    def get_sep_parallel_group(self):
+        return self._groups["sep"]
+
+    def get_dp_sep_parallel_group(self):
+        """Fused dp+sep group (grad allreduce domain when sep>1;
+        reference topology.py:561)."""
+        fused = self._topo.get_fused_ranks(["data", "sep"])
+        my = next(g for g in fused if self.global_rank in g)
+        return Group(my.index(self.global_rank), my, id=100,
+                     axis_name=("data", "sep"))
+
+    def get_check_parallel_group(self, sharding=False):
+        return self._groups["sharding" if sharding else "model"]
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        coord = list(self._topo.get_coord(self.global_rank))
+        names = self._topo.get_hybrid_group_names()
+        coord[names.index("pipe")] = stage_id
+        return self._topo.get_rank(**dict(zip(names, coord)))

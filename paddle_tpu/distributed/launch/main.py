@@ -1,0 +1,9 @@
+"""python -m paddle_tpu.distributed.launch (placeholder CLI)."""
+
+
+def launch():
+    raise NotImplementedError("launch CLI lands with multi-host support")
+
+
+if __name__ == "__main__":
+    launch()

@@ -1,0 +1,2 @@
+"""Expert parallel MoE (placeholder)."""
+__all__ = []

@@ -1,0 +1,62 @@
+"""DataParallel wrapper.
+
+Parity: reference `paddle.DataParallel` (`python/paddle/distributed/
+parallel.py:219`) + the C++ EagerReducer. TPU-native: gradient sync is not a
+bucketed NCCL allreduce — when the train step is compiled over a mesh with
+the batch axis sharded ('data'), XLA inserts the gradient psum automatically
+(GSPMD). This wrapper therefore (a) marks the model's intended data-parallel
+axis, (b) in in-trace contexts performs grad averaging over that axis
+explicitly for parity with no-pjit flows.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from .collective import _axis_in_trace, all_reduce, ReduceOp
+from .env import get_world_size, init_parallel_env  # noqa: F401
+
+__all__ = ["DataParallel", "init_parallel_env"]
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self._group = group
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        """Average gradients over the data axis (in-trace) — the analog of
+        the reference's fused allreduce in EagerReducer."""
+        axis = self._group.axis_name if self._group else "data"
+        if not _axis_in_trace(axis):
+            return
+        for p in self._layers.parameters():
+            if p._grad_buffer is not None:
+                p._grad_buffer = jax.lax.pmean(p._grad_buffer, axis)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def no_sync(self):
+        import contextlib
+        return contextlib.nullcontext()

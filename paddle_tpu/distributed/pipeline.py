@@ -1,0 +1,2 @@
+"""Pipeline parallelism (placeholder — ppermute 1F1B next)."""
+__all__ = []

@@ -1,0 +1,10 @@
+"""paddle.distributed.rpc parity surface (not applicable on TPU SPMD; kept
+as explicit unsupported stubs, see SURVEY.md A.7)."""
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown"]
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    raise NotImplementedError("rpc is out of the TPU north-star path")
+
+
+rpc_sync = rpc_async = shutdown = init_rpc

@@ -1,0 +1,10 @@
+"""ZeRO sharding stages (placeholder — implemented in fleet.sharding next)."""
+from __future__ import annotations
+
+__all__ = ["group_sharded_parallel"]
+
+
+def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
+                           offload=False, sync_buffers=False, buffer_max_size=2**23,
+                           segment_size=2**20, sync_comm=False):
+    raise NotImplementedError("implemented in the next milestone")

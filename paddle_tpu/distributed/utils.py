@@ -1,0 +1,2 @@
+"""Distributed utils (tensor fusion etc. — next milestone)."""
+__all__ = []

@@ -1,0 +1,3 @@
+"""Framework-level utilities: RNG state, save/load."""
+from .io import save, load  # noqa: F401
+from .random import seed, get_rng_state, set_rng_state, default_rng  # noqa: F401

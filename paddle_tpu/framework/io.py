@@ -1,0 +1,58 @@
+"""paddle.save / paddle.load.
+
+Parity: reference `python/paddle/framework/io.py` — pickle-based state
+serialization for Tensors / state dicts / nested containers.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["save", "load"]
+
+
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        return {"__pt_tensor__": True, "data": np.asarray(obj._data),
+                "stop_gradient": obj.stop_gradient, "name": obj.name}
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_to_saveable(v) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def _from_saveable(obj, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get("__pt_tensor__"):
+            if return_numpy:
+                return obj["data"]
+            t = Tensor(jnp.asarray(obj["data"]),
+                       stop_gradient=obj.get("stop_gradient", True),
+                       name=obj.get("name", ""))
+            return t
+        return {k: _from_saveable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_from_saveable(v, return_numpy) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    with open(path, "rb") as f:
+        raw = pickle.load(f)
+    return _from_saveable(raw, return_numpy=configs.get("return_numpy", False))

@@ -1,0 +1,68 @@
+"""Global RNG state.
+
+Parity: reference `paddle.seed` / generator state
+(`python/paddle/framework/random.py`, `phi/core/generator.h`).
+
+TPU-native design: the state is a JAX PRNG key held in a mutable cell. Every
+random op splits the key (counter-based threefry — deterministic and
+reproducible across hosts). The cell implements the get_state/set_state
+protocol so `paddle_tpu.jit.to_static` can functionalize it: inside a traced
+train step the key is threaded as an input/output, giving *different* dropout
+masks per step under one compiled executable (the reference achieves the same
+with stateful cuRAND generators; the functional key is the XLA-friendly way).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["seed", "get_rng_state", "set_rng_state", "default_rng", "RNGState",
+           "rng_key"]
+
+
+class RNGState:
+    """A splittable PRNG stream with named sub-streams (for TP determinism)."""
+
+    def __init__(self, seed_val: int = 0):
+        self.key = jax.random.key(seed_val)
+
+    def seed(self, seed_val: int):
+        self.key = jax.random.key(seed_val)
+
+    def next_key(self):
+        self.key, sub = jax.random.split(self.key)
+        return sub
+
+    # --- state protocol (used by to_static functionalization) ---
+    def get_state(self):
+        return self.key
+
+    def set_state(self, state):
+        self.key = state
+
+
+_global = RNGState(0)
+
+
+def default_rng() -> RNGState:
+    return _global
+
+
+def seed(seed_val: int):
+    """Parity: paddle.seed."""
+    _global.seed(int(seed_val))
+    # keep TP rng-state trackers in sync lazily (they re-derive from base seed)
+    return _global
+
+
+def rng_key():
+    """Split and return a fresh subkey from the global stream."""
+    return _global.next_key()
+
+
+def get_rng_state():
+    return _global.get_state()
+
+
+def set_rng_state(state):
+    _global.set_state(state)

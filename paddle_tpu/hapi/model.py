@@ -1,0 +1,188 @@
+"""paddle.Model — Keras-like high-level train/eval/predict loop.
+
+Parity: reference `python/paddle/hapi/model.py` (Model.prepare/fit/evaluate/
+predict/save/load). The train step runs through the same eager tape; pass
+`jit=True` to prepare() to compile the whole step with to_static.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..io import DataLoader
+from .callbacks import CallbackList, ProgBarLogger
+
+__all__ = ["Model"]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self.stop_training = False
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._jit_step = None
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, jit=False,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) else \
+            ([metrics] if metrics is not None else [])
+        if jit:
+            from ..jit import to_static
+            self._jit_step = to_static(
+                self._train_step_fn,
+                state_objects=[self.network, self._optimizer])
+        return self
+
+    # ------------------------------------------------------------ core steps
+    def _train_step_fn(self, *data):
+        inputs, labels = self._split(data)
+        self.network.train()
+        outputs = self.network(*inputs)
+        losses = self._loss(outputs, *labels) if self._loss else outputs
+        loss = losses if isinstance(losses, Tensor) else losses[0]
+        loss.backward()
+        self._optimizer.step()
+        self._optimizer.clear_grad()
+        return loss
+
+    def train_batch(self, inputs, labels=None, update=True):
+        data = list(inputs if isinstance(inputs, (list, tuple)) else [inputs])
+        if labels is not None:
+            data += list(labels if isinstance(labels, (list, tuple)) else [labels])
+        if self._jit_step is not None:
+            loss = self._jit_step(*data)
+        else:
+            loss = self._train_step_fn(*data)
+        return [float(np.asarray(loss._data))]
+
+    def eval_batch(self, inputs, labels=None):
+        from ..core.autograd import no_grad
+        ins = list(inputs if isinstance(inputs, (list, tuple)) else [inputs])
+        labs = list(labels if isinstance(labels, (list, tuple)) else [labels]) \
+            if labels is not None else []
+        self.network.eval()
+        with no_grad():
+            outputs = self.network(*ins)
+            loss = self._loss(outputs, *labs) if self._loss else None
+            metrics = []
+            for m in self._metrics:
+                m.update(m.compute(outputs, *labs))
+                metrics.append(m.accumulate())
+        return ([float(np.asarray(loss._data))] if loss is not None else []), metrics
+
+    def predict_batch(self, inputs):
+        from ..core.autograd import no_grad
+        ins = list(inputs if isinstance(inputs, (list, tuple)) else [inputs])
+        self.network.eval()
+        with no_grad():
+            out = self.network(*ins)
+        return [np.asarray(o._data) for o in
+                (out if isinstance(out, (list, tuple)) else [out])]
+
+    def _split(self, data):
+        """Split a flat data tuple into (inputs, labels): convention is the
+        last element is the label (hapi default when no input spec given)."""
+        if len(data) == 1:
+            return list(data), []
+        return list(data[:-1]), [data[-1]]
+
+    # ----------------------------------------------------------------- loops
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        loader = train_data if isinstance(train_data, DataLoader) else \
+            DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
+                       drop_last=drop_last, num_workers=num_workers)
+        cbks = CallbackList((callbacks or []) + [ProgBarLogger(log_freq, verbose)])
+        cbks.set_model(self)
+        cbks.set_params({"epochs": epochs, "verbose": verbose})
+        cbks.on_train_begin()
+        step_total = 0
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                batch = batch if isinstance(batch, (list, tuple)) else [batch]
+                loss = self.train_batch(batch[:-1] if len(batch) > 1 else batch,
+                                        batch[-1:] if len(batch) > 1 else None)
+                cbks.on_train_batch_end(step, {"loss": loss})
+                step_total += 1
+                if num_iters is not None and step_total >= num_iters:
+                    break
+            cbks.on_epoch_end(epoch)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size, verbose=verbose,
+                              num_workers=num_workers)
+        cbks.on_train_end()
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        loader = eval_data if isinstance(eval_data, DataLoader) else \
+            DataLoader(eval_data, batch_size=batch_size, num_workers=num_workers)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for step, batch in enumerate(loader):
+            batch = batch if isinstance(batch, (list, tuple)) else [batch]
+            loss, _ = self.eval_batch(batch[:-1] if len(batch) > 1 else batch,
+                                      batch[-1:] if len(batch) > 1 else None)
+            losses.extend(loss)
+            if num_iters is not None and step + 1 >= num_iters:
+                break
+        result = {"loss": [float(np.mean(losses))] if losses else []}
+        for m in self._metrics:
+            result[m.name() if isinstance(m.name(), str) else m.name()[0]] = \
+                m.accumulate()
+        if verbose:
+            print("Eval:", result)
+        return result
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = test_data if isinstance(test_data, DataLoader) else \
+            DataLoader(test_data, batch_size=batch_size, num_workers=num_workers)
+        outputs = []
+        for batch in loader:
+            batch = batch if isinstance(batch, (list, tuple)) else [batch]
+            outputs.append(self.predict_batch(batch))
+        if stack_outputs and outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs]) for i in range(n_out)]
+        return outputs
+
+    # --------------------------------------------------------------- save/load
+    def save(self, path, training=True):
+        from ..framework.io import save as fsave
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        fsave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fsave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load as fload
+        self.network.set_state_dict(fload(path + ".pdparams"))
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(opt_path):
+            self._optimizer.set_state_dict(fload(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        n_params = sum(p.size for p in self.network.parameters())
+        lines = [f"{type(self.network).__name__}: {n_params:,} parameters"]
+        print("\n".join(lines))
+        return {"total_params": n_params}

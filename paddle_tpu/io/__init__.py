@@ -1,0 +1,23 @@
+"""paddle_tpu.io — Dataset/DataLoader.
+
+Parity: reference `python/paddle/io/` (Dataset, IterableDataset,
+TensorDataset, Subset, random_split, samplers, BatchSampler, DataLoader
+with multiprocess workers).
+"""
+from .dataset import (  # noqa: F401
+    Dataset, IterableDataset, TensorDataset, ComposeDataset, ChainDataset,
+    Subset, random_split, ConcatDataset,
+)
+from .sampler import (  # noqa: F401
+    Sampler, SequenceSampler, RandomSampler, WeightedRandomSampler,
+    BatchSampler, DistributedBatchSampler, SubsetRandomSampler,
+)
+from .dataloader import DataLoader, default_collate_fn, get_worker_info  # noqa: F401
+
+__all__ = [
+    "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
+    "ChainDataset", "Subset", "random_split", "ConcatDataset", "Sampler",
+    "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
+    "BatchSampler", "DistributedBatchSampler", "SubsetRandomSampler",
+    "DataLoader", "default_collate_fn", "get_worker_info",
+]

@@ -1,0 +1,12 @@
+"""paddle_tpu.jit — trace-to-compiled execution.
+
+Parity: reference `python/paddle/jit/` (to_static/SOT/save/load). The
+reference needs a bytecode VM (SOT) + AST transforms + PIR programs because
+its eager mode can't be traced; here the eager tape IS jax-traceable, so
+`to_static` is a thin stateful-to-functional adapter around `jax.jit`:
+model/optimizer/RNG state is threaded as pytree inputs/outputs, mutation is
+replayed after the call, and XLA compiles fwd+bwd+update into one program.
+"""
+from .api import to_static, not_to_static, TracedFunction, save, load, functional_call, ignore_module  # noqa: F401
+
+__all__ = ["to_static", "not_to_static", "save", "load", "functional_call"]

@@ -1,0 +1,287 @@
+"""to_static: stateful eager code -> one compiled XLA program.
+
+Parity: reference `python/paddle/jit/` — `to_static`
+(dy2static/program_translator.py:377) and the SOT bytecode tracer
+(jit/sot/). The reference captures python bytecode into StatementIR and
+replays it as a static program; here the eager tape is already
+jax-traceable, so to_static only has to *functionalize state*:
+
+  1. collect state (model params/buffers via `raw_state()`, optimizer
+     accumulators, the global RNG key) into a pytree,
+  2. jax.jit a wrapper that loads the state, runs the python function
+     (tape records ops on tracers; `.backward()` unrolls into the trace),
+     and returns (outputs, new_state),
+  3. write the new state back into the live objects after each call.
+
+Guards (SOT's graph-break keys) = the hash of all non-Tensor arguments +
+pytree structure; a new combination triggers a retrace, same as the
+reference's guard-failure recompilation.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import pickle
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..framework import random as _random
+
+__all__ = ["to_static", "not_to_static", "TracedFunction", "save", "load",
+           "functional_call", "ignore_module"]
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def _hashable(x):
+    try:
+        hash(x)
+        return x
+    except TypeError:
+        return repr(x)
+
+
+class _StateBundle:
+    """Collects/loads the mutable state of a set of stateful objects
+    (Layers, Optimizers — anything with raw_state/load_raw_state)."""
+
+    def __init__(self, objects):
+        self.objects = [o for o in objects if o is not None]
+
+    def collect(self):
+        state = {}
+        for i, obj in enumerate(self.objects):
+            state[str(i)] = obj.raw_state()
+        state["__rng__"] = _random.get_rng_state()
+        return state
+
+    def load(self, state):
+        for i, obj in enumerate(self.objects):
+            if str(i) in state:
+                obj.load_raw_state(state[str(i)])
+        if "__rng__" in state:
+            _random.set_rng_state(state["__rng__"])
+
+
+class TracedFunction:
+    """The compiled callable returned by to_static."""
+
+    def __init__(self, fn, state_objects=None, donate_state=True):
+        from ..nn.layer.layers import Layer
+        self._orig_fn = fn
+        if isinstance(fn, Layer):
+            self._callable = fn.forward
+            state_objects = [fn] + list(state_objects or [])
+        else:
+            self._callable = fn
+            state_objects = list(state_objects or [])
+        self._bundle = _StateBundle(state_objects)
+        self._cache: Dict[Any, Any] = {}
+        self._donate = donate_state
+        self.__wrapped__ = fn
+        functools.update_wrapper(self, self._callable)
+
+    # -- internals ---------------------------------------------------------
+    def _make_jitted(self, treedef, static_leaves, n_tensors):
+        bundle = self._bundle
+        call = self._callable
+
+        def functional(state, tensor_arrays):
+            bundle.load(state)
+            leaves = list(static_leaves)
+            it = iter(tensor_arrays)
+            full = [next(it) if l is _TENSOR_SLOT else l for l in leaves]
+            # Tensor args enter as fresh leaf Tensors (stop_gradient like orig)
+            args, kwargs = jax.tree_util.tree_unflatten(
+                treedef, [Tensor(v, stop_gradient=sg) if isinstance(v, jax.Array) or
+                          hasattr(v, "dtype") else v
+                          for v, sg in zip(full, self._sg_flags)])
+            out = call(*args, **kwargs)
+            out_leaves, out_treedef = jax.tree_util.tree_flatten(
+                out, is_leaf=_is_tensor)
+            out_arrays = [o._data if isinstance(o, Tensor) else o for o in out_leaves]
+            new_state = bundle.collect()
+            return out_arrays, new_state, out_treedef
+
+        # out_treedef is static per cache entry; capture via closure cell
+        out_treedef_box = []
+
+        def jittable(state, tensor_arrays):
+            out_arrays, new_state, out_treedef = functional(state, tensor_arrays)
+            if not out_treedef_box:
+                out_treedef_box.append(out_treedef)
+            return out_arrays, new_state
+
+        jitted = jax.jit(jittable)
+        return jitted, out_treedef_box
+
+    def __call__(self, *args, **kwargs):
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs),
+                                                     is_leaf=_is_tensor)
+        tensor_arrays = []
+        static_leaves = []
+        sg_flags = []
+        for l in leaves:
+            if isinstance(l, Tensor):
+                tensor_arrays.append(l._data)
+                static_leaves.append(_TENSOR_SLOT)
+                sg_flags.append(l.stop_gradient)
+            else:
+                static_leaves.append(l)
+                sg_flags.append(True)
+        self._sg_flags = sg_flags
+        key = (treedef, tuple(_hashable(l) for l in static_leaves),
+               tuple((tuple(a.shape), str(a.dtype)) for a in tensor_arrays))
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._make_jitted(treedef, static_leaves, len(tensor_arrays))
+            self._cache[key] = entry
+        jitted, out_box = entry
+        state = self._bundle.collect()
+        out_arrays, new_state = jitted(state, tensor_arrays)
+        self._bundle.load(new_state)
+        # clear any tracer grad buffers leaked by tracing
+        for obj in self._bundle.objects:
+            if hasattr(obj, "parameters"):
+                for p in obj.parameters():
+                    if p._grad_buffer is not None and \
+                            not isinstance(p._grad_buffer, (jax.Array, np.ndarray)):
+                        p._grad_buffer = None
+        out_treedef = out_box[0]
+        out_leaves = [Tensor(a) if hasattr(a, "dtype") else a for a in out_arrays]
+        return jax.tree_util.tree_unflatten(out_treedef, out_leaves)
+
+    # -- paddle API surface -----------------------------------------------
+    @property
+    def code(self):
+        import inspect
+        try:
+            return inspect.getsource(self._callable)
+        except OSError:
+            return "<source unavailable>"
+
+    def concrete_program(self):
+        return self
+
+    def rollback(self):
+        return self._orig_fn
+
+
+class _TensorSlotType:
+    def __repr__(self):
+        return "<TENSOR>"
+
+
+_TENSOR_SLOT = _TensorSlotType()
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, state_objects=None, full_graph=True, **kwargs):
+    """Parity: paddle.jit.to_static. `state_objects` lists extra stateful
+    objects (optimizers, schedulers) whose state should be threaded through
+    the compiled program — needed when the function mutates them."""
+
+    def deco(fn):
+        return TracedFunction(fn, state_objects=state_objects)
+
+    if function is not None:
+        return deco(function)
+    return deco
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+def ignore_module(modules):
+    return None
+
+
+def functional_call(layer, params_and_buffers, *args, **kwargs):
+    """Run `layer.forward` with parameters temporarily replaced by the given
+    dict of arrays (jit-friendly module application)."""
+    sd = layer.state_dict()
+    saved = {k: t._data for k, t in sd.items()}
+    try:
+        for k, v in params_and_buffers.items():
+            if k in sd:
+                sd[k]._data = v._data if isinstance(v, Tensor) else v
+        return layer(*args, **kwargs)
+    finally:
+        for k, t in sd.items():
+            t._data = saved[k]
+
+
+# -------------------------------------------------------------------- save/load
+def save(layer, path, input_spec=None, **configs):
+    """Serialize a Layer (or TracedFunction) for deployment.
+
+    Parity: paddle.jit.save (reference python/paddle/jit/api.py). Artifact:
+    `{path}.pdiparams` (pickled numpy state dict) + `{path}.pdmodel.mlir`
+    (StableHLO, when an input_spec is provided) — the StableHLO module plays
+    the role of the reference's serialized PIR program.
+    """
+    from ..nn.layer.layers import Layer
+    target = layer.__wrapped__ if isinstance(layer, TracedFunction) else layer
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    if isinstance(target, Layer):
+        sd = {k: np.asarray(v._data) for k, v in target.state_dict().items()}
+        with open(path + ".pdiparams", "wb") as f:
+            pickle.dump(sd, f)
+        if input_spec is not None:
+            import jax.export
+
+            def pure(state, *xs):
+                return functional_call(
+                    target, {k: v for k, v in state.items()},
+                    *[Tensor(x) for x in xs])._data
+
+            example_state = {k: v._data for k, v in target.state_dict().items()}
+            shapes = [jax.ShapeDtypeStruct(tuple(s.shape),
+                                           jnp.dtype(getattr(s, "dtype", jnp.float32)))
+                      for s in input_spec]
+            exported = jax.export.export(jax.jit(pure))(example_state, *shapes)
+            with open(path + ".pdmodel.mlir", "wb") as f:
+                f.write(exported.serialize())
+    else:
+        raise TypeError("jit.save expects a Layer or TracedFunction")
+
+
+def load(path, **configs):
+    """Load a saved artifact. Returns a callable running the exported
+    StableHLO if present, else the raw state dict."""
+    params_path = path + ".pdiparams"
+    model_path = path + ".pdmodel.mlir"
+    state = None
+    if os.path.exists(params_path):
+        with open(params_path, "rb") as f:
+            state = pickle.load(f)
+    if os.path.exists(model_path):
+        import jax.export
+        with open(model_path, "rb") as f:
+            exported = jax.export.deserialize(f.read())
+        jstate = {k: jnp.asarray(v) for k, v in state.items()}
+
+        def runner(*xs):
+            arrs = [x._data if isinstance(x, Tensor) else jnp.asarray(x) for x in xs]
+            return Tensor(exported.call(jstate, *arrs))
+        runner.state_dict = lambda: state
+        return runner
+    return state
+
+
+class InputSpec:
+    """Parity: paddle.static.InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        from ..core.dtype import convert_dtype
+        self.shape = tuple(-1 if s is None else int(s) for s in shape)
+        self.dtype = convert_dtype(dtype)
+        self.name = name

@@ -1,0 +1,21 @@
+"""paddle_tpu.nn — parity with python/paddle/nn/."""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layer.layers import Layer  # noqa: F401
+from .layer.container import *  # noqa: F401,F403
+from .layer.common import *  # noqa: F401,F403
+from .layer.conv import *  # noqa: F401,F403
+from .layer.norm import *  # noqa: F401,F403
+from .layer.pooling import *  # noqa: F401,F403
+from .layer.activation import *  # noqa: F401,F403
+from .layer.loss import *  # noqa: F401,F403
+
+from .layer import container, common, conv, norm, pooling, activation, loss  # noqa: F401
+
+# transformer/rnn imported lazily at the bottom (they use the above)
+from .layer.transformer import *  # noqa: F401,F403
+from .layer.rnn import *  # noqa: F401,F403
+from .layer import transformer, rnn  # noqa: F401
+
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+from .utils_ import ParamAttr  # noqa: F401

@@ -1,0 +1,212 @@
+"""Activation functionals.
+
+Parity: reference `python/paddle/nn/functional/activation.py`. All are jnp
+compositions that XLA fuses into surrounding matmuls (the reference needs
+hand-fused CUDA kernels like fused_bias_act for this; on TPU the compiler
+does it).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.dispatch import apply_op, def_op
+
+__all__ = [
+    "relu", "relu_", "relu6", "gelu", "silu", "swish", "sigmoid", "softmax",
+    "softmax_", "log_softmax", "tanh", "tanh_", "leaky_relu", "elu", "elu_",
+    "selu", "celu", "hardswish", "hardsigmoid", "hardtanh", "mish",
+    "softplus", "softshrink", "hardshrink", "tanhshrink", "thresholded_relu",
+    "glu", "swiglu", "prelu", "rrelu", "maxout", "log_sigmoid", "softsign",
+    "gumbel_softmax",
+]
+
+
+def _unary(name, fn):
+    def op(x, name=None):
+        return apply_op(name, fn, x)
+    op.__name__ = name
+    op.raw = fn
+    return op
+
+
+relu = _unary("relu", jax.nn.relu)
+relu6 = _unary("relu6", jax.nn.relu6)
+silu = _unary("silu", jax.nn.silu)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+tanh = _unary("tanh", jnp.tanh)
+log_sigmoid = _unary("log_sigmoid", jax.nn.log_sigmoid)
+softsign = _unary("softsign", jax.nn.soft_sign)
+mish = _unary("mish", jax.nn.mish)
+hardswish = _unary("hardswish", jax.nn.hard_swish)
+
+
+def relu_(x, name=None):
+    out = relu(x)
+    x._data = out._data
+    x._grad_node = out._grad_node
+    x._grad_out_idx = out._grad_out_idx
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+tanh_ = tanh
+softmax_ = None  # set below
+
+
+def gelu(x, approximate=False, name=None):
+    return apply_op("gelu", lambda a: jax.nn.gelu(a, approximate=approximate), x)
+
+
+def swish(x, name=None):
+    return apply_op("swish", jax.nn.silu, x)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...core.dtype import convert_dtype
+    d = convert_dtype(dtype)
+    def _f(a):
+        if d is not None:
+            a = a.astype(d)
+        return jax.nn.softmax(a, axis=int(axis))
+    return apply_op("softmax", _f, x)
+
+
+softmax_ = softmax
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...core.dtype import convert_dtype
+    d = convert_dtype(dtype)
+    def _f(a):
+        if d is not None:
+            a = a.astype(d)
+        return jax.nn.log_softmax(a, axis=int(axis))
+    return apply_op("log_softmax", _f, x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply_op("leaky_relu", lambda a: jax.nn.leaky_relu(a, negative_slope), x)
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply_op("elu", lambda a: jax.nn.elu(a, alpha), x)
+
+
+def elu_(x, alpha=1.0, name=None):
+    out = elu(x, alpha)
+    x._data = out._data
+    x._grad_node = out._grad_node
+    x._grad_out_idx = out._grad_out_idx
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply_op("selu",
+                    lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), x)
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply_op("celu", lambda a: jax.nn.celu(a, alpha), x)
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply_op("hardsigmoid",
+                    lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply_op("hardtanh", lambda a: jnp.clip(a, min, max), x)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    def _f(a):
+        scaled = beta * a
+        return jnp.where(scaled > threshold, a, jnp.log1p(jnp.exp(scaled)) / beta)
+    return apply_op("softplus", _f, x)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply_op("softshrink",
+                    lambda a: jnp.where(a > threshold, a - threshold,
+                                        jnp.where(a < -threshold, a + threshold, 0.0)), x)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply_op("hardshrink",
+                    lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x)
+
+
+def tanhshrink(x, name=None):
+    return apply_op("tanhshrink", lambda a: a - jnp.tanh(a), x)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply_op("thresholded_relu",
+                    lambda a: jnp.where(a > threshold, a, value), x)
+
+
+def glu(x, axis=-1, name=None):
+    return apply_op("glu", lambda a: jax.nn.glu(a, axis=axis), x)
+
+
+@def_op("swiglu")
+def swiglu(x, y=None, name=None):
+    """Parity: reference `paddle/phi/kernels/swiglu_kernel.h` — silu(x) * y.
+    If y is None, x is split in half along the last axis."""
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(x) * y
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def _f(a, w):
+        if w.size == 1:
+            wb = w.reshape(())
+        else:
+            shape = [1] * a.ndim
+            ch_axis = 1 if data_format == "NCHW" else a.ndim - 1
+            if a.ndim > 1:
+                shape[ch_axis] = w.size
+            wb = w.reshape(shape)
+        return jnp.where(a > 0, a, wb * a)
+    return apply_op("prelu", _f, x, weight)
+
+
+def rrelu(x, lower=0.125, upper=0.3333333333333333, training=True, name=None):
+    from ...framework.random import rng_key
+    if training:
+        import jax.random as jrandom
+        key = rng_key()
+        def _f(a):
+            slope = jrandom.uniform(key, a.shape, a.dtype, lower, upper)
+            return jnp.where(a >= 0, a, slope * a)
+        return apply_op("rrelu", _f, x)
+    mid = (lower + upper) / 2.0
+    return apply_op("rrelu", lambda a: jnp.where(a >= 0, a, mid * a), x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    def _f(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (groups, c // groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+    return apply_op("maxout", _f, x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework.random import rng_key
+    key = rng_key()
+    def _f(a):
+        g = jax.random.gumbel(key, a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+            # straight-through estimator
+            y = y_hard + (y - jax.lax.stop_gradient(y))
+        return y
+    return apply_op("gumbel_softmax", _f, x)

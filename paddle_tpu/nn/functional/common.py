@@ -1,0 +1,323 @@
+"""Common functionals: linear, dropout, pad, normalize, interpolate, embedding.
+
+Parity: reference `python/paddle/nn/functional/common.py` + `input.py`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...framework.random import rng_key
+from ...ops.dispatch import apply_op, def_op
+
+__all__ = [
+    "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout", "pad",
+    "zeropad2d", "normalize", "embedding", "one_hot", "interpolate",
+    "upsample", "pixel_shuffle", "pixel_unshuffle", "channel_shuffle",
+    "cosine_similarity", "bilinear", "label_smooth", "class_center_sample",
+    "fold", "unfold",
+]
+
+
+@def_op("linear")
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W (+ b). Weight layout matches the reference: (in, out)
+    (`python/paddle/nn/functional/common.py` linear)."""
+    out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return apply_op("dropout_infer", lambda a: a * (1.0 - p), x)
+        return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    key = rng_key()
+    def _f(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in [ax % a.ndim for ax in axes] else 1
+                     for i, s in enumerate(a.shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), jnp.zeros((), a.dtype))
+        return jnp.where(keep, a, jnp.zeros((), a.dtype))
+    return apply_op("dropout", _f, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=ax, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ax = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=ax, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    key = rng_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    def _f(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        coef_a = ((1.0 - p) * (1.0 + p * alpha_p ** 2)) ** -0.5
+        coef_b = -coef_a * p * alpha_p
+        return coef_a * jnp.where(keep, a, alpha_p) + coef_b
+    return apply_op("alpha_dropout", _f, x)
+
+
+def _pad_mode_to_np(mode):
+    return {"constant": "constant", "reflect": "reflect",
+            "replicate": "edge", "circular": "wrap"}[mode]
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", pad_from_left_axis=True, name=None):
+    if isinstance(pad, Tensor):
+        pad = [int(v) for v in np.asarray(pad._data)]
+    pad = [int(p) for p in pad]
+    def _f(a):
+        nd = a.ndim
+        if len(pad) == 2 * nd:
+            # full-tensor pad, paddle order: axis-major from first axis
+            widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            # partial pad on spatial dims, paddle order: last-dim-first pairs
+            n_spatial = len(pad) // 2
+            widths = [(0, 0)] * nd
+            if data_format.endswith("C"):  # NHWC-ish: spatial dims are 1..nd-1
+                spatial = list(range(1, nd - 1))
+            else:  # NCHW-ish: spatial dims are 2..nd-1
+                spatial = list(range(2, nd))
+            # paddle pads [left,right] for the LAST spatial dim first
+            for i in range(n_spatial):
+                dim = spatial[-(i + 1)] if n_spatial <= len(spatial) else i
+                widths[dim] = (pad[2 * i], pad[2 * i + 1])
+        if mode == "constant":
+            return jnp.pad(a, widths, mode="constant", constant_values=value)
+        return jnp.pad(a, widths, mode=_pad_mode_to_np(mode))
+    return apply_op("pad", _f, x)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def _f(a):
+        norm = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(norm, epsilon)
+    return apply_op("normalize", _f, x)
+
+
+@def_op("embedding")
+def embedding(x, weight, padding_idx=None, sparse=False, max_norm=None, norm_type=2.0, name=None):
+    """Parity: `python/paddle/nn/functional/input.py` embedding. TPU note:
+    gathers from an HBM-resident table; with a sharded table this becomes the
+    c_embedding/VocabParallelEmbedding path (see distributed.mpu)."""
+    w = weight
+    if padding_idx is not None:
+        pidx = padding_idx if padding_idx >= 0 else w.shape[0] + padding_idx
+        w = w.at[pidx].set(jnp.zeros((w.shape[1],), w.dtype))
+    return jnp.take(w, x, axis=0)
+
+
+def one_hot(x, num_classes, name=None):
+    return apply_op("one_hot",
+                    lambda a: jax.nn.one_hot(a, int(num_classes), dtype=jnp.float32), x)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format=None, name=None):
+    if data_format is None:
+        data_format = "NCHW" if (x.ndim == 4) else ("NCDHW" if x.ndim == 5 else "NCW")
+    channel_last = data_format[-1] == "C"
+    nd = x.ndim - 2
+    if isinstance(size, Tensor):
+        size = [int(v) for v in np.asarray(size._data)]
+    if size is not None and not isinstance(size, (list, tuple)):
+        size = [int(size)] * nd
+    if scale_factor is not None and not isinstance(scale_factor, (list, tuple)):
+        scale_factor = [float(scale_factor)] * nd
+
+    def _f(a):
+        arr = a
+        if not channel_last:
+            # move channels last for jax.image
+            perm = [0] + list(range(2, arr.ndim)) + [1]
+            arr = jnp.transpose(arr, perm)
+        spatial = arr.shape[1:-1]
+        if size is not None:
+            out_spatial = tuple(int(s) for s in size)
+        else:
+            out_spatial = tuple(int(np.floor(s * f)) for s, f in zip(spatial, scale_factor))
+        out_shape = (arr.shape[0],) + out_spatial + (arr.shape[-1],)
+        m = {"nearest": "nearest", "bilinear": "bilinear", "trilinear": "trilinear",
+             "linear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+        if mode == "nearest":
+            out = jax.image.resize(arr, out_shape, method="nearest")
+        elif align_corners and mode in ("bilinear", "linear", "trilinear", "bicubic"):
+            # jax.image.resize has no align_corners; emulate via coordinate map
+            out = _resize_align_corners(arr, out_spatial, m)
+        else:
+            out = jax.image.resize(arr, out_shape, method=m)
+        if not channel_last:
+            inv = [0, arr.ndim - 1] + list(range(1, arr.ndim - 1))
+            out = jnp.transpose(out, inv)
+        return out
+    return apply_op("interpolate", _f, x)
+
+
+def _resize_align_corners(arr, out_spatial, method):
+    # arr: (N, *spatial, C). Per-dim linear interpolation with align_corners.
+    out = arr
+    for d, new_size in enumerate(out_spatial):
+        axis = 1 + d
+        old_size = out.shape[axis]
+        if new_size == old_size:
+            continue
+        if new_size == 1 or old_size == 1:
+            idx = jnp.zeros((new_size,), jnp.int32)
+            out = jnp.take(out, idx, axis=axis)
+            continue
+        pos = jnp.linspace(0.0, old_size - 1.0, new_size)
+        lo = jnp.floor(pos).astype(jnp.int32)
+        hi = jnp.minimum(lo + 1, old_size - 1)
+        w = (pos - lo).astype(out.dtype)
+        shape = [1] * out.ndim
+        shape[axis] = new_size
+        w = w.reshape(shape)
+        out = jnp.take(out, lo, axis=axis) * (1 - w) + jnp.take(out, hi, axis=axis) * w
+    return out
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format=None, name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+@def_op("pixel_shuffle")
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = int(upscale_factor)
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        out = x.reshape(n, c // (r * r), r, r, h, w)
+        out = jnp.transpose(out, (0, 1, 4, 2, 5, 3))
+        return out.reshape(n, c // (r * r), h * r, w * r)
+    n, h, w, c = x.shape
+    out = x.reshape(n, h, w, r, r, c // (r * r))
+    out = jnp.transpose(out, (0, 1, 3, 2, 4, 5))
+    return out.reshape(n, h * r, w * r, c // (r * r))
+
+
+@def_op("pixel_unshuffle")
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = int(downscale_factor)
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        out = x.reshape(n, c, h // r, r, w // r, r)
+        out = jnp.transpose(out, (0, 1, 3, 5, 2, 4))
+        return out.reshape(n, c * r * r, h // r, w // r)
+    n, h, w, c = x.shape
+    out = x.reshape(n, h // r, r, w // r, r, c)
+    out = jnp.transpose(out, (0, 1, 3, 2, 4, 5))
+    return out.reshape(n, h // r, w // r, c * r * r)
+
+
+@def_op("channel_shuffle")
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    g = int(groups)
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        out = x.reshape(n, g, c // g, h, w)
+        out = jnp.swapaxes(out, 1, 2)
+        return out.reshape(n, c, h, w)
+    n, h, w, c = x.shape
+    out = x.reshape(n, h, w, g, c // g)
+    out = jnp.swapaxes(out, 3, 4)
+    return out.reshape(n, h, w, c)
+
+
+@def_op("cosine_similarity")
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.linalg.norm(x1, axis=axis)
+    n2 = jnp.linalg.norm(x2, axis=axis)
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+@def_op("bilinear")
+def bilinear(x1, x2, weight, bias=None, name=None):
+    # weight: (out_features, in1, in2)
+    out = jnp.einsum("bi,oij,bj->bo", x1, weight, x2)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@def_op("label_smooth")
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    k = label.shape[-1]
+    if prior_dist is not None:
+        return (1.0 - epsilon) * label + epsilon * prior_dist
+    return (1.0 - epsilon) * label + epsilon / k
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    raise NotImplementedError(
+        "class_center_sample (PartialFC) is not implemented; use "
+        "distributed.mpu.ParallelCrossEntropy for large-vocab classification.")
+
+
+@def_op("unfold")
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col. Parity: python/paddle/nn/functional/common.py unfold."""
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[0], pd[1], pd[1]]
+    n, c, h, w = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, 0), (pd[0], pd[1]), (pd[2], pd[3])))
+    oh = (xp.shape[2] - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+    ow = (xp.shape[3] - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+    patches = []
+    for i in range(ks[0]):
+        for j in range(ks[1]):
+            sl = xp[:, :, i * dl[0]: i * dl[0] + (oh - 1) * st[0] + 1: st[0],
+                    j * dl[1]: j * dl[1] + (ow - 1) * st[1] + 1: st[1]]
+            patches.append(sl)
+    out = jnp.stack(patches, axis=2)  # (N, C, kh*kw, OH, OW)
+    return out.reshape(n, c * ks[0] * ks[1], oh * ow)
+
+
+@def_op("fold")
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    os_ = output_sizes if isinstance(output_sizes, (list, tuple)) else [output_sizes] * 2
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[0], pd[1], pd[1]]
+    n, ckk, L = x.shape
+    c = ckk // (ks[0] * ks[1])
+    ph, pw = os_[0] + pd[0] + pd[1], os_[1] + pd[2] + pd[3]
+    oh = (ph - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+    ow = (pw - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+    xr = x.reshape(n, c, ks[0], ks[1], oh, ow)
+    out = jnp.zeros((n, c, ph, pw), x.dtype)
+    for i in range(ks[0]):
+        for j in range(ks[1]):
+            out = out.at[:, :, i * dl[0]: i * dl[0] + (oh - 1) * st[0] + 1: st[0],
+                         j * dl[1]: j * dl[1] + (ow - 1) * st[1] + 1: st[1]].add(xr[:, :, i, j])
+    return out[:, :, pd[0]: ph - pd[1], pd[2]: pw - pd[3]]

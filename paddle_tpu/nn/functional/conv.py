@@ -1,0 +1,153 @@
+"""Convolution functionals via lax.conv_general_dilated (XLA lowers these
+onto the MXU; on TPU, NHWC/HWIO layouts avoid transposes, but the public API
+keeps the reference's NCHW default and lets XLA's layout assignment handle it).
+
+Parity: reference `python/paddle/nn/functional/conv.py` + phi conv kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.dispatch import apply_op
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose",
+           "conv2d_transpose", "conv3d_transpose"]
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    return tuple(int(x) for x in v)
+
+
+def _norm_padding(padding, n):
+    """Returns (lax_padding, explicit) — lax padding spec for n spatial dims."""
+    if isinstance(padding, str):
+        return padding.upper()  # 'SAME' / 'VALID'
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding), int(padding))] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, (int, np.integer)) for p in padding):
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    # paddle also allows [[0,0],[0,0],[h0,h1],[w0,w1]]
+    if len(padding) == n + 2:
+        return [tuple(int(v) for v in p) for p in padding[2:]]
+    raise ValueError(f"bad padding: {padding}")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, data_format, n):
+    """weight layout (paddle): (out_c, in_c/groups, *kernel)."""
+    strides = _norm_tuple(stride, n)
+    dil = _norm_tuple(dilation, n)
+    pad = _norm_padding(padding, n)
+    channel_last = data_format[-1] == "C"
+    spatial = "DHW"[3 - n:]
+    lhs_spec = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
+    out_spec = lhs_spec
+    rhs_spec = "OI" + spatial
+
+    def _f(a, w, b):
+        dn = jax.lax.conv_dimension_numbers(a.shape, w.shape, (lhs_spec, rhs_spec, out_spec))
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=strides, padding=pad,
+            rhs_dilation=dil, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=None)
+        if b is not None:
+            shape = [1] * out.ndim
+            shape[out.ndim - 1 if channel_last else 1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+    return apply_op("conv%dd" % n, _f, x, weight, bias)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups,
+                 "NWC" if data_format == "NLC" else "NCW", 1)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format, 2)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format, 3)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                    dilation, groups, data_format, n, output_size=None):
+    """weight layout (paddle transpose conv): (in_c, out_c/groups, *kernel)."""
+    strides = _norm_tuple(stride, n)
+    dil = _norm_tuple(dilation, n)
+    opad = _norm_tuple(output_padding, n)
+    pad = _norm_padding(padding, n)
+    channel_last = data_format[-1] == "C"
+    spatial = "DHW"[3 - n:]
+    lhs_spec = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
+    rhs_spec = "IO" + spatial
+
+    def _f(a, w, b):
+        if isinstance(pad, str):
+            pads = pad
+        else:
+            # conv_transpose padding: effective = dilation*(k-1) - pad
+            ksizes = w.shape[2:]
+            pads = [(dil[i] * (ksizes[i] - 1) - pad[i][0],
+                     dil[i] * (ksizes[i] - 1) - pad[i][1] + opad[i]) for i in range(n)]
+        dn = jax.lax.conv_dimension_numbers(a.shape, w.shape, (lhs_spec, rhs_spec, lhs_spec))
+        if groups == 1:
+            out = jax.lax.conv_general_dilated(
+                a, w, window_strides=(1,) * n, padding=pads,
+                lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn,
+                transpose_kernel=False)
+        else:
+            # grouped transpose conv: split along channel axis
+            ch_ax = a.ndim - 1 if channel_last else 1
+            a_parts = jnp.split(a, groups, axis=ch_ax)
+            w_parts = jnp.split(w, groups, axis=0)
+            outs = [jax.lax.conv_general_dilated(
+                ap, wp, window_strides=(1,) * n, padding=pads,
+                lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn)
+                for ap, wp in zip(a_parts, w_parts)]
+            out = jnp.concatenate(outs, axis=ch_ax)
+        # conv-transpose needs spatially flipped kernel
+        if b is not None:
+            shape = [1] * out.ndim
+            shape[out.ndim - 1 if channel_last else 1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    # flip kernel spatially for true transpose-conv semantics
+    def _f_flipped(a, w, b):
+        w = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+        return _f(a, w, b)
+    return apply_op("conv%dd_transpose" % n, _f_flipped, x, weight, bias)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups,
+                           "NWC" if data_format == "NLC" else "NCW", 1, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, data_format, 2, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, data_format, 3, output_size)

@@ -1,0 +1,150 @@
+"""Attention functionals.
+
+Parity: reference `python/paddle/nn/functional/flash_attention.py`
+(flash_attention:242, scaled_dot_product_attention:976, flashmask_attention:1098).
+
+TPU-native: the default path is a jnp composition that XLA fuses well at
+moderate sequence lengths; for long sequences `paddle_tpu.kernels.
+flash_attention` provides a Pallas fused kernel (used automatically when
+available and shapes allow). Layouts follow the reference: (batch, seqlen,
+num_heads, head_dim).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.random import rng_key
+from ...ops.dispatch import apply_op
+
+__all__ = ["scaled_dot_product_attention", "flash_attention",
+           "flashmask_attention", "sdp_kernel"]
+
+_USE_PALLAS = [True]
+
+
+def _sdpa_ref(q, k, v, mask=None, dropout_p=0.0, causal=False, key=None, training=True):
+    """(B, S, H, D) attention, fp32 softmax accumulation."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = 1.0 / math.sqrt(d)
+    qt = jnp.swapaxes(q, 1, 2)  # B,H,S,D
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+    scores = scores.astype(jnp.float32)
+    if causal:
+        cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        scores = jnp.where(cm, scores, -jnp.inf)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            scores = jnp.where(mask, scores, -jnp.inf)
+        else:
+            scores = scores + mask.astype(scores.dtype)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and training and key is not None:
+        keep = jax.random.bernoulli(key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+    return jnp.swapaxes(out, 1, 2)  # B,S,H,D
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """Parity: nn/functional/flash_attention.py:976. Shapes (B, S, H, D)."""
+    can_pallas = (_USE_PALLAS[0] and attn_mask is None and dropout_p == 0.0)
+    if can_pallas:
+        try:
+            from ...kernels import flash_attention as pallas_fa
+            def _f(q, k, v):
+                return pallas_fa.flash_attention_bshd(q, k, v, causal=is_causal)
+            return apply_op("flash_attention", _f, query, key, value)
+        except Exception:
+            pass
+    drop_key = rng_key() if (dropout_p > 0.0 and training) else None
+    def _f(q, k, v, m):
+        return _sdpa_ref(q, k, v, m, dropout_p, is_causal, drop_key, training)
+    return apply_op("sdpa", _f, query, key, value, attn_mask)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    """Parity: nn/functional/flash_attention.py:242. Returns (out, softmax)."""
+    out = scaled_dot_product_attention(query, key, value, None, dropout,
+                                       causal, training)
+    return out, None
+
+
+def flashmask_attention(query, key, value, startend_row_indices=None,
+                        dropout=0.0, causal=True, window_size=None, name=None):
+    """Sparse-mask attention (parity: flashmask_attention:1098).
+
+    startend_row_indices: (B, H_or_1, S, 1|2|4) int32 — per-column row bounds
+    defining the mask, as in the reference. This implementation materializes
+    the boolean mask from the indices and runs the fused SDPA path; a
+    block-sparse Pallas kernel is the planned upgrade.
+    """
+    if startend_row_indices is None:
+        return scaled_dot_product_attention(query, key, value, None, dropout,
+                                            causal)
+
+    def _build_mask(idx, sq, sk):
+        # idx: (B, H, Sk, C); rows r of column c are masked per bounds
+        rows = jnp.arange(sq)[None, None, :, None]  # 1,1,Sq,1
+        c = idx.shape[-1]
+        idxb = jnp.swapaxes(idx, 2, 3)  # B,H,C,Sk
+        if causal:
+            if c == 1:
+                start = idxb[:, :, 0][:, :, None, :]  # B,H,1,Sk
+                masked = rows >= start
+            else:
+                start = idxb[:, :, 0][:, :, None, :]
+                end = idxb[:, :, 1][:, :, None, :]
+                masked = (rows >= start) & (rows < end)
+            cm = jnp.tril(jnp.ones((sq, sk), bool))
+            allow = cm[None, None] & ~masked
+        else:
+            if c == 2:
+                start_u = idxb[:, :, 0][:, :, None, :]
+                end_d = idxb[:, :, 1][:, :, None, :]
+                masked = (rows >= start_u) | (rows < end_d)
+            else:
+                start_u = idxb[:, :, 0][:, :, None, :]
+                end_u = idxb[:, :, 1][:, :, None, :]
+                start_d = idxb[:, :, 2][:, :, None, :]
+                end_d = idxb[:, :, 3][:, :, None, :]
+                masked = ((rows >= start_u) & (rows < end_u)) | \
+                         ((rows >= start_d) & (rows < end_d))
+            allow = ~masked
+        return allow
+
+    sq, sk = query.shape[1], key.shape[1]
+
+    def _f(q, k, v, idx):
+        allow = _build_mask(idx, sq, sk)
+        # broadcast mask over heads: allow is B,H,Sq,Sk (H may be 1)
+        return _sdpa_ref(q, k, v, allow, dropout, False, None, True)
+    return apply_op("flashmask_attention", _f, query, key, value,
+                    startend_row_indices)
+
+
+class sdp_kernel:
+    """Context manager for kernel selection (parity: paddle sdp_kernel)."""
+
+    def __init__(self, enable_flash=True, enable_math=True,
+                 enable_mem_efficient=True):
+        self.enable_flash = enable_flash
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = _USE_PALLAS[0]
+        _USE_PALLAS[0] = self.enable_flash
+        return self
+
+    def __exit__(self, *a):
+        _USE_PALLAS[0] = self._prev
+        return False

@@ -1,0 +1,1 @@
+from .common import embedding, one_hot  # noqa: F401

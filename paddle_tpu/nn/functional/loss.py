@@ -1,0 +1,328 @@
+"""Loss functionals.
+
+Parity: reference `python/paddle/nn/functional/loss.py` (cross_entropy with
+soft/hard labels + ignore_index + weights, bce, mse, l1, smooth_l1, nll,
+kl_div, margin/cosine/hinge family, ctc excluded this round).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...ops.dispatch import apply_op
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "mse_loss", "l1_loss",
+    "smooth_l1_loss", "nll_loss", "kl_div", "margin_ranking_loss",
+    "cosine_embedding_loss", "hinge_embedding_loss", "triplet_margin_loss",
+    "triplet_margin_with_distance_loss", "multi_label_soft_margin_loss",
+    "soft_margin_loss", "sigmoid_focal_loss", "square_error_cost",
+    "log_loss", "poisson_nll_loss", "gaussian_nll_loss", "dice_loss",
+    "npair_loss", "multi_margin_loss",
+]
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    def _f(logits, lab, w):
+        ax = axis % logits.ndim
+        logp = jax.nn.log_softmax(logits, axis=ax) if use_softmax \
+            else jnp.log(jnp.maximum(logits, 1e-30))
+        n_classes = logits.shape[ax]
+        if soft_label:
+            soft = lab
+            if label_smoothing > 0.0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / n_classes
+            loss = -jnp.sum(soft * logp, axis=ax)
+            if w is not None:
+                shape = [1] * logits.ndim
+                shape[ax] = -1
+                loss = loss * jnp.sum(soft * w.reshape(shape), axis=ax)
+            if reduction == "mean":
+                return jnp.mean(loss)
+            if reduction == "sum":
+                return jnp.sum(loss)
+            return loss
+        lab_idx = lab
+        if lab_idx.ndim == logits.ndim:  # trailing 1 dim
+            lab_idx = jnp.squeeze(lab_idx, axis=ax)
+        lab_idx = lab_idx.astype(jnp.int32)
+        valid = lab_idx != ignore_index
+        safe = jnp.where(valid, lab_idx, 0)
+        if label_smoothing > 0.0:
+            onehot = jax.nn.one_hot(safe, n_classes, axis=ax, dtype=logp.dtype)
+            smooth = onehot * (1 - label_smoothing) + label_smoothing / n_classes
+            picked = jnp.sum(smooth * logp, axis=ax)
+        else:
+            picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, ax), axis=ax)
+            picked = jnp.squeeze(picked, axis=ax)
+        loss = -picked
+        wsel = w[safe] if w is not None else jnp.ones_like(loss)
+        loss = jnp.where(valid, loss * wsel, 0.0)
+        if reduction == "mean":
+            denom = jnp.sum(jnp.where(valid, wsel, 0.0))
+            return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+
+    return apply_op("cross_entropy", _f, input, label, weight)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False,
+                               axis=-1, name=None):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    # paddle returns loss with a trailing singleton dim in this legacy API
+    from ...ops.manipulation import unsqueeze
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        from .activation import softmax
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def _f(x, y, w):
+        eps = 1e-12
+        out = -(y * jnp.log(jnp.maximum(x, eps)) +
+                (1 - y) * jnp.log(jnp.maximum(1 - x, eps)))
+        if w is not None:
+            out = out * w
+        return _reduce(out, reduction)
+    return apply_op("bce", _f, input, label, weight)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def _f(x, y, w, pw):
+        max_val = jnp.maximum(-x, 0.0)
+        if pw is not None:
+            log_w = (pw - 1) * y + 1
+            out = (1 - y) * x + log_w * (jnp.log1p(jnp.exp(-jnp.abs(x))) + max_val)
+        else:
+            out = (1 - y) * x + jnp.log1p(jnp.exp(-jnp.abs(x))) + max_val
+        if w is not None:
+            out = out * w
+        return _reduce(out, reduction)
+    return apply_op("bce_logits", _f, logit, label, weight, pos_weight)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply_op("mse_loss",
+                    lambda x, y: _reduce(jnp.square(x - y), reduction), input, label)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply_op("l1_loss",
+                    lambda x, y: _reduce(jnp.abs(x - y), reduction), input, label)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def _f(x, y):
+        d = jnp.abs(x - y)
+        out = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        # paddle's smooth_l1 multiplies by delta
+        out = out * delta
+        return _reduce(out, reduction)
+    return apply_op("smooth_l1", _f, input, label)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    def _f(logp, lab, w):
+        lab = lab.astype(jnp.int32)
+        valid = lab != ignore_index
+        safe = jnp.where(valid, lab, 0)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, 1), axis=1)
+        picked = jnp.squeeze(picked, axis=1)
+        loss = -picked
+        wsel = w[safe] if w is not None else jnp.ones_like(loss)
+        loss = jnp.where(valid, loss * wsel, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(jnp.where(valid, wsel, 0.0)), 1e-12)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+    return apply_op("nll_loss", _f, input, label, weight)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def _f(x, y):
+        if log_target:
+            out = jnp.exp(y) * (y - x)
+        else:
+            out = y * (jnp.log(jnp.maximum(y, 1e-12)) - x)
+        if reduction == "batchmean":
+            return jnp.sum(out) / x.shape[0]
+        return _reduce(out, reduction)
+    return apply_op("kl_div", _f, input, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    def _f(x, y, lab):
+        out = jnp.maximum(-lab * (x - y) + margin, 0.0)
+        return _reduce(out, reduction)
+    return apply_op("margin_ranking", _f, input, other, label)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def _f(x1, x2, lab):
+        cos = jnp.sum(x1 * x2, axis=-1) / (
+            jnp.linalg.norm(x1, axis=-1) * jnp.linalg.norm(x2, axis=-1) + 1e-12)
+        out = jnp.where(lab == 1, 1 - cos, jnp.maximum(cos - margin, 0.0))
+        return _reduce(out, reduction)
+    return apply_op("cosine_embedding", _f, input1, input2, label)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def _f(x, lab):
+        out = jnp.where(lab == 1, x, jnp.maximum(margin - x, 0.0))
+        return _reduce(out, reduction)
+    return apply_op("hinge_embedding", _f, input, label)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def _f(a, pos, neg):
+        dp = jnp.linalg.norm(a - pos + epsilon, ord=p, axis=-1)
+        dn = jnp.linalg.norm(a - neg + epsilon, ord=p, axis=-1)
+        if swap:
+            dswap = jnp.linalg.norm(pos - neg + epsilon, ord=p, axis=-1)
+            dn = jnp.minimum(dn, dswap)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+    return apply_op("triplet_margin", _f, input, positive, negative)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    if distance_function is None:
+        return triplet_margin_loss(input, positive, negative, margin=margin,
+                                   swap=swap, reduction=reduction)
+    dp = distance_function(input, positive)
+    dn = distance_function(input, negative)
+    if swap:
+        dn2 = distance_function(positive, negative)
+        from ...ops.math import minimum
+        dn = minimum(dn, dn2)
+    from ...ops.math import maximum as t_max
+    out = apply_op("triplet_dist",
+                   lambda a, b: _reduce(jnp.maximum(a - b + margin, 0.0), reduction),
+                   dp, dn)
+    return out
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean", name=None):
+    def _f(x, y, w):
+        out = -(y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x))
+        if w is not None:
+            out = out * w
+        out = jnp.mean(out, axis=-1)
+        return _reduce(out, reduction)
+    return apply_op("ml_soft_margin", _f, input, label, weight)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    def _f(x, y):
+        return _reduce(jnp.log1p(jnp.exp(-y * x)), reduction)
+    return apply_op("soft_margin", _f, input, label)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    def _f(x, lab, w):
+        n, c = x.shape
+        lab = lab.astype(jnp.int32)
+        correct = jnp.take_along_axis(x, lab[:, None], axis=1)
+        diff = jnp.maximum(margin - correct + x, 0.0) ** p
+        mask = 1.0 - jax.nn.one_hot(lab, c, dtype=x.dtype)
+        if w is not None:
+            diff = diff * w[lab][:, None]
+        out = jnp.sum(diff * mask, axis=1) / c
+        return _reduce(out, reduction)
+    return apply_op("multi_margin", _f, input, label, weight)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def _f(x, y, norm):
+        p = jax.nn.sigmoid(x)
+        ce = (1 - y) * x + jnp.log1p(jnp.exp(-jnp.abs(x))) + jnp.maximum(-x, 0.0)
+        p_t = p * y + (1 - p) * (1 - y)
+        mod = (1 - p_t) ** gamma
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        out = a_t * mod * ce
+        if norm is not None:
+            out = out / norm
+        return _reduce(out, reduction)
+    return apply_op("focal", _f, logit, label, normalizer)
+
+
+def square_error_cost(input, label):
+    return apply_op("square_error_cost", lambda x, y: jnp.square(x - y), input, label)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def _f(x, y):
+        return -y * jnp.log(x + epsilon) - (1 - y) * jnp.log(1 - x + epsilon)
+    return apply_op("log_loss", _f, input, label)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def _f(x, y):
+        if log_input:
+            out = jnp.exp(x) - y * x
+        else:
+            out = x - y * jnp.log(x + epsilon)
+        if full:
+            stirling = y * jnp.log(y + epsilon) - y + 0.5 * jnp.log(2 * jnp.pi * (y + epsilon))
+            out = out + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(out, reduction)
+    return apply_op("poisson_nll", _f, input, label)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def _f(x, y, v):
+        v = jnp.maximum(v, epsilon)
+        out = 0.5 * (jnp.log(v) + jnp.square(x - y) / v)
+        if full:
+            out = out + 0.5 * jnp.log(2 * jnp.pi)
+        return _reduce(out, reduction)
+    return apply_op("gaussian_nll", _f, input, label, variance)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def _f(x, y):
+        lab = jax.nn.one_hot(jnp.squeeze(y, -1), x.shape[-1], dtype=x.dtype)
+        red = tuple(range(1, x.ndim))
+        inter = jnp.sum(x * lab, axis=red)
+        union = jnp.sum(x, axis=red) + jnp.sum(lab, axis=red)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+    return apply_op("dice", _f, input, label)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def _f(a, p, lab):
+        batch = a.shape[0]
+        sim = a @ p.T
+        eq = (lab[:, None] == lab[None, :]).astype(a.dtype)
+        eq = eq / jnp.sum(eq, axis=1, keepdims=True)
+        xent = -jnp.sum(eq * jax.nn.log_softmax(sim, axis=1), axis=1)
+        reg = l2_reg * (jnp.sum(jnp.square(a)) + jnp.sum(jnp.square(p))) / (2 * batch)
+        return jnp.mean(xent) + reg
+    return apply_op("npair", _f, anchor, positive, labels)

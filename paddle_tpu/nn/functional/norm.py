@@ -1,0 +1,176 @@
+"""Normalization functionals.
+
+Parity: reference `python/paddle/nn/functional/norm.py` + phi kernels
+layer_norm / batch_norm / group_norm / instance_norm and the fused
+`rms_norm_kernel.h`. On TPU these are VPU-bound; XLA fuses them into
+neighbors. A Pallas fused rms_norm lives in paddle_tpu.kernels for the
+residual-add variant.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...ops.dispatch import apply_op
+
+__all__ = ["layer_norm", "batch_norm", "group_norm", "instance_norm",
+           "local_response_norm", "rms_norm", "spectral_norm"]
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(list(normalized_shape))
+
+    def _f(a, w, b):
+        axes = tuple(range(a.ndim - n_axes, a.ndim))
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) * jax.lax.rsqrt(var + epsilon)
+        if w is not None:
+            out = out * w
+        if b is not None:
+            out = out + b
+        return out
+    return apply_op("layer_norm", _f, x, weight, bias)
+
+
+def rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1,
+             residual=None, name=None):
+    """Fused-capable RMSNorm (+optional residual add).
+    Parity: reference `paddle/phi/kernels/rms_norm_kernel.h`."""
+    def _f(a, w, b, res):
+        if res is not None:
+            a = a + res
+        ax = begin_norm_axis % a.ndim
+        axes = tuple(range(ax, a.ndim))
+        ms = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=axes, keepdims=True)
+        out = (a.astype(jnp.float32) * jax.lax.rsqrt(ms + epsilon)).astype(a.dtype)
+        if w is not None:
+            out = out * w
+        if b is not None:
+            out = out + b
+        return out
+    return apply_op("rms_norm", _f, x, weight, bias, residual)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format="NCHW", use_global_stats=None, name=None):
+    """Running stats are updated in-place on the passed Tensors (the
+    reference mutates the same way: phi batch_norm kernel's mean_out/var_out)."""
+    channel_ax = 1 if data_format.startswith("NC") else -1
+    use_stats = (not training) if use_global_stats is None else use_global_stats
+
+    def _f(a, w, b, rm, rv):
+        ax = channel_ax % a.ndim
+        red_axes = tuple(i for i in range(a.ndim) if i != ax)
+        if use_stats:
+            mean, var = rm, rv
+        else:
+            mean = jnp.mean(a, axis=red_axes)
+            var = jnp.var(a, axis=red_axes)
+        shape = [1] * a.ndim
+        shape[ax] = a.shape[ax]
+        out = (a - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + epsilon)
+        if w is not None:
+            out = out * w.reshape(shape)
+        if b is not None:
+            out = out + b.reshape(shape)
+        return out, mean, var
+
+    out, batch_mean, batch_var = apply_op(
+        "batch_norm", _f, x, weight, bias,
+        running_mean.detach() if isinstance(running_mean, Tensor) else running_mean,
+        running_var.detach() if isinstance(running_var, Tensor) else running_var)
+
+    if training and not use_stats and isinstance(running_mean, Tensor):
+        m = momentum
+        running_mean._data = running_mean._data * m + batch_mean._data * (1 - m)
+        running_var._data = running_var._data * m + batch_var._data * (1 - m)
+    return out
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    def _f(a, w, b):
+        channel_last = data_format[-1] == "C"
+        if channel_last:
+            a_t = jnp.moveaxis(a, -1, 1)
+        else:
+            a_t = a
+        n, c = a_t.shape[0], a_t.shape[1]
+        g = int(num_groups)
+        grouped = a_t.reshape((n, g, c // g) + a_t.shape[2:])
+        axes = tuple(range(2, grouped.ndim))
+        mean = jnp.mean(grouped, axis=axes, keepdims=True)
+        var = jnp.var(grouped, axis=axes, keepdims=True)
+        out = (grouped - mean) * jax.lax.rsqrt(var + epsilon)
+        out = out.reshape(a_t.shape)
+        shape = [1] * a_t.ndim
+        shape[1] = c
+        if w is not None:
+            out = out * w.reshape(shape)
+        if b is not None:
+            out = out + b.reshape(shape)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    return apply_op("group_norm", _f, x, weight, bias)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-05,
+                  data_format="NCHW", name=None):
+    def _f(a, w, b):
+        axes = tuple(range(2, a.ndim))
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) * jax.lax.rsqrt(var + eps)
+        if w is not None:
+            shape = [1, -1] + [1] * (a.ndim - 2)
+            out = out * w.reshape(shape)
+        if b is not None:
+            shape = [1, -1] + [1] * (a.ndim - 2)
+            out = out + b.reshape(shape)
+        return out
+    return apply_op("instance_norm", _f, x, weight, bias)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def _f(a):
+        channel_last = data_format[-1] == "C"
+        ch_ax = a.ndim - 1 if channel_last else 1
+        sq = jnp.square(a)
+        # sum over a window of `size` channels centered at each channel
+        pad_lo = (size - 1) // 2
+        pad_hi = size - 1 - pad_lo
+        pads = [(0, 0)] * a.ndim
+        pads[ch_ax] = (pad_lo, pad_hi)
+        padded = jnp.pad(sq, pads)
+        window = [1] * a.ndim
+        window[ch_ax] = size
+        summed = jax.lax.reduce_window(padded, 0.0, jax.lax.add,
+                                       tuple(window), (1,) * a.ndim,
+                                       [(0, 0)] * a.ndim)
+        div = (k + alpha * summed) ** beta
+        return a / div
+    return apply_op("local_response_norm", _f, x)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    def _f(w):
+        wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        u = jnp.ones((wm.shape[0],), w.dtype) / np.sqrt(wm.shape[0])
+        v = jnp.ones((wm.shape[1],), w.dtype) / np.sqrt(wm.shape[1])
+        for _ in range(power_iters):
+            v = wm.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = wm @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        sigma = u @ wm @ v
+        return w / sigma
+    return apply_op("spectral_norm", _f, weight)

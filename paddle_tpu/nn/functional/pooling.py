@@ -1,0 +1,239 @@
+"""Pooling functionals via lax.reduce_window.
+
+Parity: reference `python/paddle/nn/functional/pooling.py`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.dispatch import apply_op
+
+__all__ = [
+    "avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d", "max_pool2d",
+    "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
+    "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
+    "adaptive_max_pool3d", "lp_pool1d", "lp_pool2d",
+]
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    return tuple(int(x) for x in v)
+
+
+def _pool(x, kernel_size, stride, padding, n, reducer, init, data_format,
+          ceil_mode=False, count_include_pad=True, divisor_override=None,
+          is_avg=False):
+    ks = _norm_tuple(kernel_size, n)
+    st = _norm_tuple(stride if stride is not None else kernel_size, n)
+    if isinstance(padding, str):
+        pad_spec = padding.upper()
+    else:
+        pd = _norm_tuple(padding, n) if not (isinstance(padding, (list, tuple))
+                                             and isinstance(padding[0], (list, tuple))) else padding
+        if isinstance(pd[0], tuple) or isinstance(pd[0], list):
+            pad_spec = [tuple(p) for p in pd]
+        else:
+            pad_spec = [(p, p) for p in pd]
+    channel_last = data_format[-1] == "C"
+    if channel_last:
+        window = (1,) + ks + (1,)
+        strides = (1,) + st + (1,)
+        pads = [(0, 0)] + (pad_spec if isinstance(pad_spec, list) else None) + [(0, 0)] \
+            if not isinstance(pad_spec, str) else pad_spec
+    else:
+        window = (1, 1) + ks
+        strides = (1, 1) + st
+        pads = [(0, 0), (0, 0)] + (pad_spec if isinstance(pad_spec, list) else None) \
+            if not isinstance(pad_spec, str) else pad_spec
+
+    def _f(a):
+        if isinstance(pads, str):
+            padding_cfg = pads
+        else:
+            padding_cfg = pads
+            if ceil_mode:
+                # extend right pads so that ceil-division windows fit
+                padding_cfg = list(padding_cfg)
+                sp_axes = range(1, 1 + n) if channel_last else range(2, 2 + n)
+                for i, ax in enumerate(sp_axes):
+                    size = a.shape[ax] + padding_cfg[ax][0] + padding_cfg[ax][1]
+                    k, s = window[ax], strides[ax]
+                    rem = (size - k) % s
+                    if rem != 0:
+                        padding_cfg[ax] = (padding_cfg[ax][0], padding_cfg[ax][1] + (s - rem))
+        if is_avg:
+            ones = jnp.ones_like(a)
+            summed = jax.lax.reduce_window(a, 0.0 if a.dtype != jnp.bool_ else False,
+                                           jax.lax.add, window, strides, padding_cfg)
+            if divisor_override:
+                return summed / divisor_override
+            if count_include_pad and not isinstance(padding_cfg, str):
+                div = float(np.prod(ks))
+                return summed / div
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, padding_cfg)
+            return summed / counts
+        init_val = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
+        return jax.lax.reduce_window(a, init_val, jax.lax.max, window, strides, padding_cfg)
+    return apply_op("pool", _f, x)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    return _pool(x, kernel_size, stride, padding, 1, None, 0.0, "NCW",
+                 ceil_mode, count_include_pad=not exclusive, is_avg=True)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, None, 0.0, data_format,
+                 ceil_mode, count_include_pad=not exclusive,
+                 divisor_override=divisor_override, is_avg=True)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, None, 0.0, data_format,
+                 ceil_mode, count_include_pad=not exclusive,
+                 divisor_override=divisor_override, is_avg=True)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    out = _pool(x, kernel_size, stride, padding, 1, None, None, "NCW", ceil_mode)
+    if return_mask:
+        return out, _max_pool_indices(x, kernel_size, stride, padding, 1, "NCW")
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, None, None, data_format, ceil_mode)
+    if return_mask:
+        return out, _max_pool_indices(x, kernel_size, stride, padding, 2, data_format)
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 3, None, None, data_format, ceil_mode)
+    if return_mask:
+        return out, _max_pool_indices(x, kernel_size, stride, padding, 3, data_format)
+    return out
+
+
+def _max_pool_indices(x, kernel_size, stride, padding, n, data_format):
+    """Flat spatial argmax indices, paddle-style (int64)."""
+    ks = _norm_tuple(kernel_size, n)
+    st = _norm_tuple(stride if stride is not None else kernel_size, n)
+    pd = _norm_tuple(padding, n)
+
+    def _f(a):
+        # build index array of flat spatial positions and reduce with max-by-value
+        channel_last = data_format[-1] == "C"
+        sp_shape = a.shape[1:-1] if channel_last else a.shape[2:]
+        flat = jnp.arange(int(np.prod(sp_shape)), dtype=jnp.int32).reshape(sp_shape)
+        if channel_last:
+            idx = jnp.broadcast_to(flat[None, ..., None], a.shape)
+            window = (1,) + ks + (1,)
+            strides = (1,) + st + (1,)
+            pads = [(0, 0)] + [(p, p) for p in pd] + [(0, 0)]
+        else:
+            idx = jnp.broadcast_to(flat[None, None], a.shape)
+            window = (1, 1) + ks
+            strides = (1, 1) + st
+            pads = [(0, 0), (0, 0)] + [(p, p) for p in pd]
+        neg = jnp.finfo(a.dtype).min if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
+
+        def reducer(acc, cur):
+            av, ai = acc
+            cv, ci = cur
+            take_cur = cv > av
+            return (jnp.where(take_cur, cv, av), jnp.where(take_cur, ci, ai))
+
+        _, out_idx = jax.lax.reduce_window(
+            (a, idx), (jnp.asarray(neg, a.dtype), jnp.asarray(0, jnp.int32)),
+            reducer, window, strides, pads)
+        return out_idx.astype(jnp.int64)
+    return apply_op("max_pool_indices", _f, x)
+
+
+def _adaptive_pool(x, output_size, n, is_avg, data_format):
+    os_ = _norm_tuple(output_size, n)
+
+    def _f(a):
+        channel_last = data_format[-1] == "C"
+        sp_axes = list(range(1, 1 + n)) if channel_last else list(range(2, 2 + n))
+        out = a
+        for i, ax in enumerate(sp_axes):
+            in_size = out.shape[ax]
+            o = os_[i] if os_[i] is not None else in_size
+            if in_size == o:
+                continue
+            if in_size % o == 0:
+                k = in_size // o
+                new_shape = out.shape[:ax] + (o, k) + out.shape[ax + 1:]
+                r = out.reshape(new_shape)
+                out = jnp.mean(r, axis=ax + 1) if is_avg else jnp.max(r, axis=ax + 1)
+            else:
+                # general adaptive: variable window per output position
+                starts = (np.arange(o) * in_size) // o
+                ends = ((np.arange(o) + 1) * in_size + o - 1) // o
+                pieces = []
+                for s, e in zip(starts, ends):
+                    sl = jax.lax.slice_in_dim(out, int(s), int(e), axis=ax)
+                    red = jnp.mean(sl, axis=ax, keepdims=True) if is_avg \
+                        else jnp.max(sl, axis=ax, keepdims=True)
+                    pieces.append(red)
+                out = jnp.concatenate(pieces, axis=ax)
+        return out
+    return apply_op("adaptive_pool", _f, x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, True, "NCW")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, True, data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, True, data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, False, "NCW")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, False, "NCHW")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, False, "NCDHW")
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    p = float(norm_type)
+    from ...ops.dispatch import apply_op as _ap
+    powed = _ap("lp_pow", lambda a: jnp.abs(a) ** p, x)
+    pooled = _pool(powed, kernel_size, stride, padding, 1, None, 0.0,
+                   "NCW", ceil_mode, is_avg=True)
+    ks = _norm_tuple(kernel_size, 1)
+    return _ap("lp_root", lambda a: (a * float(np.prod(ks))) ** (1.0 / p), pooled)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    p = float(norm_type)
+    from ...ops.dispatch import apply_op as _ap
+    powed = _ap("lp_pow", lambda a: jnp.abs(a) ** p, x)
+    pooled = _pool(powed, kernel_size, stride, padding, 2, None, 0.0,
+                   data_format, ceil_mode, is_avg=True)
+    ks = _norm_tuple(kernel_size, 2)
+    return _ap("lp_root", lambda a: (a * float(np.prod(ks))) ** (1.0 / p), pooled)

@@ -1,0 +1,96 @@
+"""Activation layers. Parity: reference python/paddle/nn/layer/activation.py."""
+from __future__ import annotations
+
+from .. import functional as F
+from ..initializer import Constant
+from .layers import Layer
+
+__all__ = ["ReLU", "ReLU6", "GELU", "Silu", "Swish", "Sigmoid", "Softmax",
+           "LogSoftmax", "Tanh", "LeakyReLU", "ELU", "SELU", "CELU",
+           "Hardswish", "Hardsigmoid", "Hardtanh", "Mish", "Softplus",
+           "Softshrink", "Hardshrink", "Tanhshrink", "ThresholdedReLU",
+           "GLU", "PReLU", "RReLU", "Maxout", "LogSigmoid", "Softsign",
+           "Softmax2D"]
+
+
+def _simple(fname, **defaults):
+    fn = getattr(F, fname)
+
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            merged = dict(defaults)
+            names = list(defaults.keys())
+            for i, a in enumerate(args):
+                merged[names[i]] = a
+            merged.update({k: v for k, v in kwargs.items() if k != "name"})
+            self._kw = merged
+
+        def forward(self, x):
+            return fn(x, **self._kw)
+
+    _Act.__name__ = fname.capitalize()
+    return _Act
+
+
+ReLU = _simple("relu")
+ReLU6 = _simple("relu6")
+GELU = _simple("gelu", approximate=False)
+Silu = _simple("silu")
+Swish = _simple("swish")
+Sigmoid = _simple("sigmoid")
+Tanh = _simple("tanh")
+LogSigmoid = _simple("log_sigmoid")
+Softsign = _simple("softsign")
+Mish = _simple("mish")
+Hardswish = _simple("hardswish")
+LeakyReLU = _simple("leaky_relu", negative_slope=0.01)
+ELU = _simple("elu", alpha=1.0)
+SELU = _simple("selu", scale=1.0507009873554805, alpha=1.6732632423543772)
+CELU = _simple("celu", alpha=1.0)
+Hardsigmoid = _simple("hardsigmoid")
+Hardtanh = _simple("hardtanh", min=-1.0, max=1.0)
+Softplus = _simple("softplus", beta=1.0, threshold=20.0)
+Softshrink = _simple("softshrink", threshold=0.5)
+Hardshrink = _simple("hardshrink", threshold=0.5)
+Tanhshrink = _simple("tanhshrink")
+ThresholdedReLU = _simple("thresholded_relu", threshold=1.0, value=0.0)
+GLU = _simple("glu", axis=-1)
+Softmax = _simple("softmax", axis=-1)
+LogSoftmax = _simple("log_softmax", axis=-1)
+
+
+class Softmax2D(Layer):
+    def forward(self, x):
+        return F.softmax(x, axis=-3)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            (num_parameters,), attr=weight_attr,
+            default_initializer=Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, data_format=self._data_format)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, training=self.training)
+
+
+class Maxout(Layer):
+    def __init__(self, groups, axis=1, name=None):
+        super().__init__()
+        self.groups, self.axis = groups, axis
+
+    def forward(self, x):
+        return F.maxout(x, self.groups, self.axis)

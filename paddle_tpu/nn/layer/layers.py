@@ -1,0 +1,319 @@
+"""The Layer base class (module system).
+
+Parity: reference `paddle.nn.Layer`
+(`/root/reference/python/paddle/nn/layer/layers.py:354`): parameter/buffer/
+sublayer registries, forward hooks, train/eval, state_dict/set_state_dict,
+apply, to(dtype), named_* traversals.
+
+TPU-native addition: `raw_state()`/`load_raw_state()` expose the parameter
+pytree as jax arrays so a whole Layer can cross a jax.jit/pjit boundary —
+this is the bridge the reference needs dy2static + program translation for.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dtype import convert_dtype, get_default_dtype
+from ...core.tensor import Tensor
+from ..initializer import Constant, Initializer, XavierUniform, _init_tensor
+
+__all__ = ["Layer"]
+
+
+class HookRemoveHelper:
+    _next_id = [0]
+
+    def __init__(self, hooks: dict):
+        self._hooks = hooks
+        self._id = HookRemoveHelper._next_id[0]
+        HookRemoveHelper._next_id[0] += 1
+
+    def remove(self):
+        self._hooks.pop(self._id, None)
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype=None):
+        object.__setattr__(self, "_parameters", collections.OrderedDict())
+        object.__setattr__(self, "_buffers", collections.OrderedDict())
+        object.__setattr__(self, "_sub_layers", collections.OrderedDict())
+        self._non_persistable_buffer_names = set()
+        self._forward_pre_hooks = collections.OrderedDict()
+        self._forward_post_hooks = collections.OrderedDict()
+        self.training = True
+        self._dtype = convert_dtype(dtype) or get_default_dtype()
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+
+    # ------------------------------------------------------------- registry
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Tensor) and value._is_param:
+            if params is None:
+                raise RuntimeError("call Layer.__init__() before assigning parameters")
+            params[name] = value
+            for d in (layers, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            layers[name] = value
+            for d in (params, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Tensor) and buffers is not None and name in buffers:
+            buffers[name] = value
+        else:
+            for d in (params, layers, buffers):
+                if d is not None and name in d:
+                    del d[name]
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for reg in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(reg)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"{type(self).__name__} has no attribute {name!r}")
+
+    def __delattr__(self, name):
+        for reg in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(reg)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        return list(super().__dir__()) + list(self._parameters) + \
+            list(self._buffers) + list(self._sub_layers)
+
+    # -------------------------------------------------------- param helpers
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        """Parity: Layer.create_parameter (layers.py:780 in reference)."""
+        d = convert_dtype(dtype) or self._dtype
+        init = default_initializer
+        if init is None and attr is not None:
+            init = getattr(attr, "initializer", None)
+        if init is None and attr is not None and not isinstance(attr, bool):
+            init = None
+        if attr is False:
+            return None
+        t = _init_tensor(tuple(int(s) for s in shape), d, init, is_bias=is_bias)
+        lr = getattr(attr, "learning_rate", None)
+        if lr is not None:
+            t._lr_scale = lr
+        return t
+
+    def add_parameter(self, name, parameter):
+        if parameter is not None:
+            parameter._is_param = True
+        self._parameters[name] = parameter
+        return parameter
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    # ----------------------------------------------------------- traversal
+    def named_parameters(self, prefix="", include_sublayers=True) -> Iterator[Tuple[str, Tensor]]:
+        seen = set()
+        for name, layer in self.named_sublayers(prefix=prefix, include_self=True):
+            for pname, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (name + ("." if name else "") + pname, p)
+            if not include_sublayers:
+                break
+
+    def parameters(self, include_sublayers=True) -> List[Tensor]:
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, layer in self.named_sublayers(prefix=prefix, include_self=True):
+            for bname, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (name + ("." if name else "") + bname, b)
+            if not include_sublayers:
+                break
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_sublayers(self, prefix="", include_self=False, layers_set=None):
+        if layers_set is None:
+            layers_set = set()
+        if id(self) in layers_set:
+            return
+        layers_set.add(id(self))
+        if include_self:
+            yield prefix, self
+        for name, sub in self._sub_layers.items():
+            if sub is None:
+                continue
+            sub_prefix = prefix + ("." if prefix else "") + name
+            yield from sub.named_sublayers(prefix=sub_prefix, include_self=True,
+                                           layers_set=layers_set)
+
+    def sublayers(self, include_self=False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def children(self):
+        return iter([l for l in self._sub_layers.values() if l is not None])
+
+    def named_children(self):
+        return iter([(n, l) for n, l in self._sub_layers.items() if l is not None])
+
+    def apply(self, fn):
+        for l in self.sublayers(include_self=True):
+            fn(l)
+        return self
+
+    # ------------------------------------------------------------- forward
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in list(self._forward_pre_hooks.values()):
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in list(self._forward_post_hooks.values()):
+            result = hook(self, inputs, outputs)
+            if result is not None:
+                outputs = result
+        return outputs
+
+    def register_forward_pre_hook(self, hook):
+        helper = HookRemoveHelper(self._forward_pre_hooks)
+        self._forward_pre_hooks[helper._id] = hook
+        return helper
+
+    def register_forward_post_hook(self, hook):
+        helper = HookRemoveHelper(self._forward_post_hooks)
+        self._forward_post_hooks[helper._id] = hook
+        return helper
+
+    # ---------------------------------------------------------- train/eval
+    def train(self):
+        for l in self.sublayers(include_self=True):
+            l.training = True
+        return self
+
+    def eval(self):
+        for l in self.sublayers(include_self=True):
+            l.training = False
+        return self
+
+    # ----------------------------------------------------------- state IO
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True) -> Dict[str, Tensor]:
+        out = collections.OrderedDict() if destination is None else destination
+        for name, p in self.named_parameters(prefix=structured_name_prefix):
+            out[name] = p
+        for name, layer in self.named_sublayers(prefix=structured_name_prefix,
+                                                include_self=True):
+            for bname, b in layer._buffers.items():
+                if b is None or bname in layer._non_persistable_buffer_names:
+                    continue
+                out[name + ("." if name else "") + bname] = b
+        return out
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for name, t in own.items():
+            if name in state_dict:
+                src = state_dict[name]
+                arr = src._data if isinstance(src, Tensor) else jnp.asarray(np.asarray(src))
+                if tuple(arr.shape) != tuple(t._data.shape):
+                    raise ValueError(
+                        f"shape mismatch for {name}: {arr.shape} vs {t._data.shape}")
+                t._data = arr.astype(t.dtype)
+            else:
+                missing.append(name)
+        for name in state_dict:
+            if name not in own:
+                unexpected.append(name)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+    set_dict = set_state_dict
+
+    # ------------------------------------------------------------- casting
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            d = convert_dtype(dtype)
+            self._cast_params(d)
+        return self
+
+    def astype(self, dtype):
+        self._cast_params(convert_dtype(dtype))
+        return self
+
+    def _cast_params(self, d, floats_only=True):
+        for _, p in list(self.named_parameters()) + list(self.named_buffers()):
+            if p is None:
+                continue
+            if not floats_only or jnp.issubdtype(p.dtype, jnp.floating):
+                p._data = p._data.astype(d)
+        for l in self.sublayers(include_self=True):
+            l._dtype = d
+        return self
+
+    def float(self):
+        return self._cast_params(jnp.float32)
+
+    def bfloat16(self):
+        return self._cast_params(jnp.bfloat16)
+
+    def half(self):
+        return self._cast_params(jnp.float16)
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    # ----------------------------------------------- functional-state bridge
+    def raw_state(self):
+        """Parameter+buffer pytree as jax arrays (for jit/pjit boundaries)."""
+        return {k: v._data for k, v in self.state_dict().items()}
+
+    def load_raw_state(self, raw):
+        sd = self.state_dict()
+        for k, v in raw.items():
+            if k in sd:
+                sd[k]._data = v
+
+    def full_name(self):
+        return self._name_scope
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = [f"{self.__class__.__name__}({extra}"]
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).replace("\n", "\n  ")
+            lines.append(f"  ({name}): {sub_repr}")
+        return "\n".join(lines) + ")" if len(lines) > 1 else lines[0] + ")"

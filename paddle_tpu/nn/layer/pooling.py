@@ -1,0 +1,150 @@
+"""Pooling layers. Parity: reference python/paddle/nn/layer/pooling.py."""
+from __future__ import annotations
+
+from .. import functional as F
+from .layers import Layer
+
+__all__ = ["AvgPool1D", "AvgPool2D", "AvgPool3D", "MaxPool1D", "MaxPool2D",
+           "MaxPool3D", "AdaptiveAvgPool1D", "AdaptiveAvgPool2D",
+           "AdaptiveAvgPool3D", "AdaptiveMaxPool1D", "AdaptiveMaxPool2D",
+           "AdaptiveMaxPool3D", "LPPool1D", "LPPool2D"]
+
+
+class _Pool(Layer):
+    def __init__(self, **kw):
+        super().__init__()
+        self.kw = kw
+
+
+class AvgPool1D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, exclusive=True,
+                 ceil_mode=False, name=None):
+        super().__init__(kernel_size=kernel_size, stride=stride, padding=padding,
+                         exclusive=exclusive, ceil_mode=ceil_mode)
+
+    def forward(self, x):
+        return F.avg_pool1d(x, **self.kw)
+
+
+class AvgPool2D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+        super().__init__(kernel_size=kernel_size, stride=stride, padding=padding,
+                         ceil_mode=ceil_mode, exclusive=exclusive,
+                         divisor_override=divisor_override, data_format=data_format)
+
+    def forward(self, x):
+        return F.avg_pool2d(x, **self.kw)
+
+
+class AvgPool3D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+        super().__init__(kernel_size=kernel_size, stride=stride, padding=padding,
+                         ceil_mode=ceil_mode, exclusive=exclusive,
+                         divisor_override=divisor_override, data_format=data_format)
+
+    def forward(self, x):
+        return F.avg_pool3d(x, **self.kw)
+
+
+class MaxPool1D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
+                 ceil_mode=False, name=None):
+        super().__init__(kernel_size=kernel_size, stride=stride, padding=padding,
+                         return_mask=return_mask, ceil_mode=ceil_mode)
+
+    def forward(self, x):
+        return F.max_pool1d(x, **self.kw)
+
+
+class MaxPool2D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__(kernel_size=kernel_size, stride=stride, padding=padding,
+                         return_mask=return_mask, ceil_mode=ceil_mode,
+                         data_format=data_format)
+
+    def forward(self, x):
+        return F.max_pool2d(x, **self.kw)
+
+
+class MaxPool3D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
+                 ceil_mode=False, data_format="NCDHW", name=None):
+        super().__init__(kernel_size=kernel_size, stride=stride, padding=padding,
+                         return_mask=return_mask, ceil_mode=ceil_mode,
+                         data_format=data_format)
+
+    def forward(self, x):
+        return F.max_pool3d(x, **self.kw)
+
+
+class AdaptiveAvgPool1D(_Pool):
+    def __init__(self, output_size, name=None):
+        super().__init__(output_size=output_size)
+
+    def forward(self, x):
+        return F.adaptive_avg_pool1d(x, **self.kw)
+
+
+class AdaptiveAvgPool2D(_Pool):
+    def __init__(self, output_size, data_format="NCHW", name=None):
+        super().__init__(output_size=output_size, data_format=data_format)
+
+    def forward(self, x):
+        return F.adaptive_avg_pool2d(x, **self.kw)
+
+
+class AdaptiveAvgPool3D(_Pool):
+    def __init__(self, output_size, data_format="NCDHW", name=None):
+        super().__init__(output_size=output_size, data_format=data_format)
+
+    def forward(self, x):
+        return F.adaptive_avg_pool3d(x, **self.kw)
+
+
+class AdaptiveMaxPool1D(_Pool):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__(output_size=output_size, return_mask=return_mask)
+
+    def forward(self, x):
+        return F.adaptive_max_pool1d(x, **self.kw)
+
+
+class AdaptiveMaxPool2D(_Pool):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__(output_size=output_size, return_mask=return_mask)
+
+    def forward(self, x):
+        return F.adaptive_max_pool2d(x, **self.kw)
+
+
+class AdaptiveMaxPool3D(_Pool):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__(output_size=output_size, return_mask=return_mask)
+
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, **self.kw)
+
+
+class LPPool1D(_Pool):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCL", name=None):
+        super().__init__(norm_type=norm_type, kernel_size=kernel_size,
+                         stride=stride, padding=padding, ceil_mode=ceil_mode,
+                         data_format=data_format)
+
+    def forward(self, x):
+        return F.lp_pool1d(x, **self.kw)
+
+
+class LPPool2D(_Pool):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__(norm_type=norm_type, kernel_size=kernel_size,
+                         stride=stride, padding=padding, ceil_mode=ceil_mode,
+                         data_format=data_format)
+
+    def forward(self, x):
+        return F.lp_pool2d(x, **self.kw)

@@ -1,0 +1,296 @@
+"""Recurrent layers via lax.scan (compiler-friendly sequential control flow).
+
+Parity: reference `python/paddle/nn/layer/rnn.py` (SimpleRNN/LSTM/GRU +
+cells). The reference dispatches to cuDNN fused RNN kernels; the TPU-native
+formulation is a `lax.scan` over time with the gate matmuls batched so XLA
+pipelines them onto the MXU.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...ops.dispatch import apply_op
+from ..initializer import Uniform
+from .layers import Layer
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN",
+           "SimpleRNN", "LSTM", "GRU"]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        b = batch_ref.shape[batch_dim_idx]
+        from ...ops.creation import full
+        st = self.state_shape
+        if isinstance(st[0], (list, tuple)):
+            return tuple(full([b] + list(s), init_value, dtype or "float32") for s in st)
+        return full([b] + list(st), init_value, dtype or "float32")
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        k = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-k, k)
+        self.weight_ih = self.create_parameter((hidden_size, input_size),
+                                               weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter((hidden_size, hidden_size),
+                                               weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter((hidden_size,), bias_ih_attr,
+                                             is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter((hidden_size,), bias_hh_attr,
+                                             is_bias=True, default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def _f(x, h, wih, whh, bih, bhh):
+            out = act(x @ wih.T + bih + h @ whh.T + bhh)
+            return out
+        out = apply_op("rnn_cell", _f, inputs, states, self.weight_ih,
+                       self.weight_hh, self.bias_ih, self.bias_hh)
+        return out, out
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        k = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-k, k)
+        self.weight_ih = self.create_parameter((4 * hidden_size, input_size),
+                                               weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter((4 * hidden_size, hidden_size),
+                                               weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter((4 * hidden_size,), bias_ih_attr,
+                                             is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter((4 * hidden_size,), bias_hh_attr,
+                                             is_bias=True, default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+
+        def _f(x, hh, cc, wih, whh, bih, bhh):
+            gates = x @ wih.T + bih + hh @ whh.T + bhh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            new_c = f * cc + i * g
+            new_h = o * jnp.tanh(new_c)
+            return new_h, new_c
+        new_h, new_c = apply_op("lstm_cell", _f, inputs, h, c, self.weight_ih,
+                                self.weight_hh, self.bias_ih, self.bias_hh)
+        return new_h, (new_h, new_c)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        k = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-k, k)
+        self.weight_ih = self.create_parameter((3 * hidden_size, input_size),
+                                               weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter((3 * hidden_size, hidden_size),
+                                               weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter((3 * hidden_size,), bias_ih_attr,
+                                             is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter((3 * hidden_size,), bias_hh_attr,
+                                             is_bias=True, default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def _f(x, h, wih, whh, bih, bhh):
+            xg = x @ wih.T + bih
+            hg = h @ whh.T + bhh
+            xr, xz, xn = jnp.split(xg, 3, axis=-1)
+            hr, hz, hn = jnp.split(hg, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            return (1 - z) * n + z * h
+        out = apply_op("gru_cell", _f, inputs, states, self.weight_ih,
+                       self.weight_hh, self.bias_ih, self.bias_hh)
+        return out, out
+
+
+class RNN(Layer):
+    """Wraps a cell into a sequence scanner. Parity: paddle.nn.RNN."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops import manipulation as M
+        time_axis = 0 if self.time_major else 1
+        steps = inputs.shape[time_axis]
+        xs = M.unbind(inputs, time_axis)
+        if self.is_reverse:
+            xs = xs[::-1]
+        states = initial_states
+        outs = []
+        for x in xs:
+            out, states = self.cell(x, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        outputs = M.stack(outs, axis=time_axis)
+        return outputs, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops import manipulation as M
+        st_fw, st_bw = (initial_states if initial_states is not None else (None, None))
+        out_fw, fw_states = self.rnn_fw(inputs, st_fw)
+        out_bw, bw_states = self.rnn_bw(inputs, st_bw)
+        return M.concat([out_fw, out_bw], axis=-1), (fw_states, bw_states)
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        from .container import LayerList
+        self.mode = mode
+        self.num_layers = num_layers
+        self.hidden_size = hidden_size
+        self.time_major = time_major
+        self.dropout = dropout
+        bidirect = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if bidirect else 1
+        cell_cls = {"RNN_TANH": SimpleRNNCell, "LSTM": LSTMCell,
+                    "GRU": GRUCell}[mode if mode != "RNN_RELU" else "RNN_TANH"]
+
+        def make_cell(isz):
+            if mode == "RNN_RELU":
+                return SimpleRNNCell(isz, hidden_size, "relu", weight_ih_attr,
+                                     weight_hh_attr, bias_ih_attr, bias_hh_attr)
+            return cell_cls(isz, hidden_size, weight_ih_attr, weight_hh_attr,
+                            bias_ih_attr, bias_hh_attr)
+
+        rnns = []
+        for layer_i in range(num_layers):
+            isz = input_size if layer_i == 0 else hidden_size * self.num_directions
+            if bidirect:
+                rnns.append(BiRNN(make_cell(isz), make_cell(isz), time_major))
+            else:
+                rnns.append(RNN(make_cell(isz), False, time_major))
+        self.rnns = LayerList(rnns)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from .. import functional as F
+        out = inputs
+        final_states = []
+        for i, rnn in enumerate(self.rnns):
+            st = None
+            if initial_states is not None:
+                st = self._slice_states(initial_states, i)
+            out, states = rnn(out, st)
+            final_states.append(states)
+            if self.dropout > 0.0 and i < self.num_layers - 1:
+                out = F.dropout(out, self.dropout, training=self.training)
+        return out, self._stack_states(final_states)
+
+    def _slice_states(self, initial_states, layer_i):
+        from ...ops import manipulation as M
+        d = self.num_directions
+        if self.mode == "LSTM":
+            h, c = initial_states
+            if d == 2:
+                return ((h[layer_i * 2], c[layer_i * 2]),
+                        (h[layer_i * 2 + 1], c[layer_i * 2 + 1]))
+            return (h[layer_i], c[layer_i])
+        h = initial_states
+        if d == 2:
+            return (h[layer_i * 2], h[layer_i * 2 + 1])
+        return h[layer_i]
+
+    def _stack_states(self, final_states):
+        from ...ops import manipulation as M
+        d = self.num_directions
+        if self.mode == "LSTM":
+            hs, cs = [], []
+            for st in final_states:
+                if d == 2:
+                    (h_f, c_f), (h_b, c_b) = st
+                    hs += [h_f, h_b]
+                    cs += [c_f, c_b]
+                else:
+                    h, c = st
+                    hs.append(h)
+                    cs.append(c)
+            return (M.stack(hs, 0), M.stack(cs, 0))
+        hs = []
+        for st in final_states:
+            if d == 2:
+                hs += [st[0], st[1]]
+            else:
+                hs.append(st)
+        return M.stack(hs, 0)
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        mode = "RNN_RELU" if activation == "relu" else "RNN_TANH"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kw)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 proj_size=None, **kw):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)

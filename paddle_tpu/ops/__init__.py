@@ -1,0 +1,16 @@
+"""Functional op surface (the phi-kernel-equivalent layer)."""
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .dispatch import apply_op, def_op  # noqa: F401
+
+from . import creation, math, manipulation, linalg, logic, search, random  # noqa: F401
+
+__all__ = (
+    creation.__all__ + math.__all__ + manipulation.__all__ + linalg.__all__
+    + logic.__all__ + search.__all__ + random.__all__
+)

@@ -1,0 +1,215 @@
+"""Tensor creation ops.
+
+Parity: reference `python/paddle/tensor/creation.py` (to_tensor, zeros, ones,
+full, arange, linspace, eye, empty, meshgrid, diag, tril/triu, ...).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import convert_dtype, get_default_dtype
+from ..core.tensor import Tensor, to_tensor
+from .dispatch import apply_op, def_op
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "zeros_like", "ones_like",
+    "full_like", "empty", "empty_like", "arange", "linspace", "logspace",
+    "eye", "meshgrid", "diag", "diagflat", "diag_embed", "tril", "triu",
+    "clone", "assign", "tril_indices", "triu_indices", "complex",
+    "create_parameter", "ones_like", "polar",
+]
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(s) for s in np.asarray(shape._data)]
+    if isinstance(shape, (int, np.integer)):
+        return [int(shape)]
+    return [int(s._data) if isinstance(s, Tensor) else int(s) for s in shape]
+
+
+def zeros(shape, dtype=None, name=None):
+    d = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jnp.zeros(_shape_list(shape), d))
+
+
+def ones(shape, dtype=None, name=None):
+    d = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jnp.ones(_shape_list(shape), d))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        arr = jnp.full(_shape_list(shape), fill_value)
+        if arr.dtype == jnp.float64:
+            arr = arr.astype(get_default_dtype())
+        return Tensor(arr)
+    return Tensor(jnp.full(_shape_list(shape), fill_value, convert_dtype(dtype)))
+
+
+def zeros_like(x, dtype=None, name=None):
+    x = x if isinstance(x, Tensor) else to_tensor(x)
+    d = convert_dtype(dtype) or x.dtype
+    return Tensor(jnp.zeros(x._data.shape, d))
+
+
+def ones_like(x, dtype=None, name=None):
+    x = x if isinstance(x, Tensor) else to_tensor(x)
+    d = convert_dtype(dtype) or x.dtype
+    return Tensor(jnp.ones(x._data.shape, d))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    x = x if isinstance(x, Tensor) else to_tensor(x)
+    d = convert_dtype(dtype) or x.dtype
+    return Tensor(jnp.full(x._data.shape, fill_value, d))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    for v in (start, end, step):
+        pass
+    start = start.item() if isinstance(start, Tensor) else start
+    end = end.item() if isinstance(end, Tensor) else end
+    step = step.item() if isinstance(step, Tensor) else step
+    if end is None:
+        start, end = 0, start
+    d = convert_dtype(dtype)
+    if d is None:
+        if all(isinstance(v, (int, np.integer)) for v in (start, end, step)):
+            d = jnp.int64
+        else:
+            d = get_default_dtype()
+    return Tensor(jnp.arange(start, end, step, dtype=d))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    start = start.item() if isinstance(start, Tensor) else start
+    stop = stop.item() if isinstance(stop, Tensor) else stop
+    num = num.item() if isinstance(num, Tensor) else num
+    d = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jnp.linspace(start, stop, int(num), dtype=d))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    d = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jnp.logspace(float(start), float(stop), int(num), base=float(base), dtype=d))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    d = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jnp.eye(int(num_rows), None if num_columns is None else int(num_columns), dtype=d))
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    return apply_op("meshgrid", lambda *xs: tuple(jnp.meshgrid(*xs, indexing="ij")), *args)
+
+
+@def_op("diag")
+def diag(x, offset=0, padding_value=0, name=None):
+    if x.ndim == 1 and padding_value != 0:
+        out = jnp.diag(x, k=offset)
+        mask = jnp.eye(out.shape[0], out.shape[1], k=offset, dtype=bool)
+        return jnp.where(mask, out, jnp.asarray(padding_value, out.dtype))
+    return jnp.diag(x, k=offset)
+
+
+@def_op("diagflat")
+def diagflat(x, offset=0, name=None):
+    return jnp.diagflat(x, k=offset)
+
+
+@def_op("diag_embed")
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    n = x.shape[-1] + abs(offset)
+    base = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    r = idx + max(0, -offset)
+    c = idx + max(0, offset)
+    out = base.at[..., r, c].set(x)
+    # move the two new axes into (dim1, dim2)
+    nd = out.ndim
+    d1 = dim1 % nd
+    d2 = dim2 % nd
+    if (d1, d2) != (nd - 2, nd - 1):
+        perm = [i for i in range(nd - 2)]
+        order = list(range(nd - 2))
+        # build permutation placing last two axes at d1, d2
+        perm = []
+        src = list(range(nd - 2))
+        for i in range(nd):
+            if i == d1:
+                perm.append(nd - 2)
+            elif i == d2:
+                perm.append(nd - 1)
+            else:
+                perm.append(src.pop(0))
+        out = jnp.transpose(out, perm)
+    return out
+
+
+@def_op("tril")
+def tril(x, diagonal=0, name=None):
+    return jnp.tril(x, k=diagonal)
+
+
+@def_op("triu")
+def triu(x, diagonal=0, name=None):
+    return jnp.triu(x, k=diagonal)
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), convert_dtype(dtype)))
+
+
+@def_op("clone")
+def clone(x, name=None):
+    return x
+
+
+def assign(x, output=None):
+    src = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    if output is None:
+        return Tensor(src)
+    output.copy_(src)
+    return output
+
+
+@def_op("complex")
+def complex(real, imag, name=None):
+    return jax.lax.complex(real, imag)
+
+
+@def_op("polar")
+def polar(abs, angle, name=None):
+    return jax.lax.complex(abs * jnp.cos(angle), abs * jnp.sin(angle))
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..nn.initializer import _init_tensor
+    d = convert_dtype(dtype) or get_default_dtype()
+    t = _init_tensor(tuple(_shape_list(shape)), d, default_initializer, is_bias=is_bias)
+    t.stop_gradient = False
+    t._is_param = True
+    return t

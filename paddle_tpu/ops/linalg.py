@@ -1,0 +1,329 @@
+"""Linear algebra ops (matmul rides the MXU; decompositions via lax.linalg).
+
+Parity: reference `python/paddle/tensor/linalg.py` + phi kernels
+(`paddle/phi/kernels/matmul_kernel.h`, `kernels/impl/matmul_kernel_impl.h`).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dispatch import apply_op, def_op
+
+__all__ = [
+    "matmul", "mm", "bmm", "mv", "dot", "t", "norm", "vector_norm",
+    "matrix_norm", "dist", "cross", "cholesky", "cholesky_solve", "inv",
+    "det", "slogdet", "svd", "qr", "eig", "eigh", "eigvals", "eigvalsh",
+    "matrix_power", "pinv", "solve", "triangular_solve", "lstsq", "lu",
+    "lu_unpack", "matrix_rank", "cond", "histogram", "histogramdd",
+    "bincount", "einsum", "multi_dot", "corrcoef", "cov", "householder_product",
+    "matrix_transpose", "pdist", "cdist",
+]
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def _f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return apply_op("matmul", _f, x, y)
+
+
+@def_op("mm")
+def mm(input, mat2, name=None):
+    return jnp.matmul(input, mat2)
+
+
+@def_op("bmm")
+def bmm(x, y, name=None):
+    return jnp.matmul(x, y)
+
+
+@def_op("mv")
+def mv(x, vec, name=None):
+    return jnp.matmul(x, vec)
+
+
+@def_op("dot")
+def dot(x, y, name=None):
+    return jnp.sum(x * y, axis=-1)
+
+
+@def_op("t")
+def t(input, name=None):
+    if input.ndim < 2:
+        return input
+    return jnp.swapaxes(input, -1, -2)
+
+
+@def_op("matrix_transpose")
+def matrix_transpose(x, name=None):
+    return jnp.swapaxes(x, -1, -2)
+
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    def _f(a):
+        if p is None or p == "fro":
+            if ax is None:
+                return jnp.sqrt(jnp.sum(jnp.square(jnp.abs(a))))
+            return jnp.linalg.norm(a, ord=None, axis=ax, keepdims=keepdim)
+        if p == "nuc":
+            return jnp.linalg.norm(a, ord="nuc", axis=ax, keepdims=keepdim)
+        if p == float("inf"):
+            r = jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+            return r
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
+        flat_ax = ax
+        return jnp.sum(jnp.abs(a) ** p, axis=flat_ax, keepdims=keepdim) ** (1.0 / p)
+    return apply_op("norm", _f, x)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    ax = tuple(int(a) for a in axis)
+    return apply_op("matrix_norm",
+                    lambda a: jnp.linalg.norm(a, ord=p, axis=ax, keepdims=keepdim), x)
+
+
+@def_op("dist")
+def dist(x, y, p=2, name=None):
+    d = jnp.abs(x - y)
+    if p == float("inf"):
+        return jnp.max(d)
+    if p == float("-inf"):
+        return jnp.min(d)
+    if p == 0:
+        return jnp.sum((d != 0).astype(d.dtype))
+    return jnp.sum(d ** p) ** (1.0 / p)
+
+
+@def_op("cross")
+def cross(x, y, axis=9, name=None):
+    ax = axis
+    if ax == 9:
+        # paddle default: first axis with dim 3
+        ax = next((i for i, s in enumerate(x.shape) if s == 3), -1)
+    return jnp.cross(x, y, axis=ax)
+
+
+@def_op("cholesky")
+def cholesky(x, upper=False, name=None):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2).conj() if upper else L
+
+
+@def_op("cholesky_solve")
+def cholesky_solve(x, y, upper=False, name=None):
+    return jax.scipy.linalg.cho_solve((y, not upper), x)
+
+
+@def_op("inv")
+def inv(x, name=None):
+    return jnp.linalg.inv(x)
+
+
+@def_op("det")
+def det(x, name=None):
+    return jnp.linalg.det(x)
+
+
+@def_op("slogdet")
+def slogdet(x, name=None):
+    sign, logdet = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logdet])
+
+
+@def_op("svd")
+def svd(x, full_matrices=False, name=None):
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
+
+
+@def_op("qr")
+def qr(x, mode="reduced", name=None):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+def eig(x, name=None):
+    # CPU-only in jax; run on host.
+    a = np.asarray(x._data if isinstance(x, Tensor) else x)
+    w, v = np.linalg.eig(a)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+@def_op("eigh")
+def eigh(x, UPLO="L", name=None):
+    return jnp.linalg.eigh(x, UPLO=UPLO)
+
+
+def eigvals(x, name=None):
+    a = np.asarray(x._data if isinstance(x, Tensor) else x)
+    return Tensor(jnp.asarray(np.linalg.eigvals(a)))
+
+
+@def_op("eigvalsh")
+def eigvalsh(x, UPLO="L", name=None):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+@def_op("matrix_power")
+def matrix_power(x, n, name=None):
+    return jnp.linalg.matrix_power(x, n)
+
+
+@def_op("pinv")
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+@def_op("solve")
+def solve(x, y, name=None):
+    return jnp.linalg.solve(x, y)
+
+
+@def_op("triangular_solve")
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+@def_op("lstsq")
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+@def_op("lu")
+def lu(x, pivot=True, get_infos=False, name=None):
+    lu_mat, piv = jax.scipy.linalg.lu_factor(x)
+    return lu_mat, piv.astype(jnp.int32) + 1  # paddle pivots are 1-based
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    def _f(lu_mat, piv):
+        m = lu_mat.shape[-2]
+        L = jnp.tril(lu_mat, -1) + jnp.eye(m, lu_mat.shape[-1], dtype=lu_mat.dtype)
+        L = L[..., :, :min(lu_mat.shape[-2:])] if lu_mat.shape[-2] > lu_mat.shape[-1] else L
+        U = jnp.triu(lu_mat)[..., :min(lu_mat.shape[-2:]), :]
+        perm = jnp.arange(m)
+        def body(i, p):
+            j = piv[i] - 1
+            pi, pj = p[i], p[j]
+            p = p.at[i].set(pj).at[j].set(pi)
+            return p
+        perm = jax.lax.fori_loop(0, piv.shape[-1], body, perm)
+        P = jnp.eye(m, dtype=lu_mat.dtype)[perm].T
+        return P, L, U
+    return apply_op("lu_unpack", _f, x, y)
+
+
+@def_op("matrix_rank")
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+@def_op("cond")
+def cond(x, p=None, name=None):
+    return jnp.linalg.cond(x, p=p)
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
+    def _f(a, w):
+        lo, hi = float(min), float(max)
+        if lo == 0 and hi == 0:
+            lo, hi = float(jnp.min(a)), float(jnp.max(a))
+        hist, _ = jnp.histogram(a, bins=bins, range=(lo, hi), weights=w, density=density)
+        return hist if density or w is not None else hist.astype(jnp.int64)
+    w = weight
+    return apply_op("histogram", _f, input, w)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    arr = np.asarray(x._data)
+    w = np.asarray(weights._data) if isinstance(weights, Tensor) else weights
+    hist, edges = np.histogramdd(arr, bins=bins, range=ranges, density=density, weights=w)
+    return Tensor(jnp.asarray(hist)), [Tensor(jnp.asarray(e)) for e in edges]
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    n = int(np.asarray(x._data).max()) + 1 if x.size else 0
+    length = max(n, int(minlength))
+    def _f(a, w):
+        out = jnp.bincount(a, weights=w, minlength=length, length=length)
+        return out if w is not None else out.astype(jnp.int64)
+    return apply_op("bincount", _f, x, weights)
+
+
+def einsum(equation, *operands):
+    return apply_op("einsum", lambda *ops: jnp.einsum(equation, *ops), *operands)
+
+
+@def_op("multi_dot")
+def multi_dot(x, name=None):
+    return jnp.linalg.multi_dot(list(x))
+
+
+@def_op("corrcoef")
+def corrcoef(x, rowvar=True, name=None):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+@def_op("cov")
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+@def_op("householder_product")
+def householder_product(x, tau, name=None):
+    m, n = x.shape[-2], x.shape[-1]
+    def one(mat, t):
+        q = jnp.eye(m, dtype=mat.dtype)
+        def body(i, q):
+            v = jnp.where(jnp.arange(m) < i, 0.0, mat[:, i])
+            v = v.at[i].set(1.0)
+            h = jnp.eye(m, dtype=mat.dtype) - t[i] * jnp.outer(v, v)
+            return q @ h
+        q = jax.lax.fori_loop(0, n, body, q)
+        return q[:, :n]
+    if x.ndim == 2:
+        return one(x, tau)
+    batch = x.reshape((-1,) + x.shape[-2:])
+    taub = tau.reshape((-1, tau.shape[-1]))
+    out = jax.vmap(one)(batch, taub)
+    return out.reshape(x.shape[:-2] + (m, n))
+
+
+@def_op("pdist")
+def pdist(x, p=2.0, name=None):
+    n = x.shape[0]
+    d = jnp.linalg.norm(x[:, None, :] - x[None, :, :] + 1e-30, ord=p, axis=-1)
+    iu = jnp.triu_indices(n, k=1)
+    return d[iu]
+
+
+@def_op("cdist")
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=None):
+    diff = x[..., :, None, :] - y[..., None, :, :]
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-30)
+    return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)

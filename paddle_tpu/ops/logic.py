@@ -1,0 +1,82 @@
+"""Comparison / logical / bitwise ops.
+
+Parity: reference `python/paddle/tensor/logic.py`.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dispatch import apply_op
+
+__all__ = [
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "logical_and", "logical_or", "logical_not", "logical_xor",
+    "bitwise_and", "bitwise_or", "bitwise_not", "bitwise_xor",
+    "bitwise_left_shift", "bitwise_right_shift", "is_empty", "isclose",
+    "allclose", "equal_all", "isreal", "iscomplex", "is_tensor",
+]
+
+
+def _binary(name, fn):
+    def op(x, y, name=None):
+        return apply_op(name, fn, x, y)
+    op.__name__ = name
+    return op
+
+
+equal = _binary("equal", lambda x, y: jnp.equal(x, y))
+not_equal = _binary("not_equal", lambda x, y: jnp.not_equal(x, y))
+greater_than = _binary("greater_than", lambda x, y: jnp.greater(x, y))
+greater_equal = _binary("greater_equal", lambda x, y: jnp.greater_equal(x, y))
+less_than = _binary("less_than", lambda x, y: jnp.less(x, y))
+less_equal = _binary("less_equal", lambda x, y: jnp.less_equal(x, y))
+logical_and = _binary("logical_and", lambda x, y: jnp.logical_and(x, y))
+logical_or = _binary("logical_or", lambda x, y: jnp.logical_or(x, y))
+logical_xor = _binary("logical_xor", lambda x, y: jnp.logical_xor(x, y))
+bitwise_and = _binary("bitwise_and", lambda x, y: jnp.bitwise_and(x, y))
+bitwise_or = _binary("bitwise_or", lambda x, y: jnp.bitwise_or(x, y))
+bitwise_xor = _binary("bitwise_xor", lambda x, y: jnp.bitwise_xor(x, y))
+bitwise_left_shift = _binary("bitwise_left_shift", lambda x, y: jnp.left_shift(x, y))
+bitwise_right_shift = _binary("bitwise_right_shift", lambda x, y: jnp.right_shift(x, y))
+
+
+def logical_not(x, name=None):
+    return apply_op("logical_not", jnp.logical_not, x)
+
+
+def bitwise_not(x, name=None):
+    return apply_op("bitwise_not", jnp.bitwise_not, x)
+
+
+def isreal(x, name=None):
+    return apply_op("isreal", jnp.isreal, x)
+
+
+def iscomplex(x, name=None):
+    return apply_op("iscomplex", jnp.iscomplex, x)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op("isclose",
+                    lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+                    x, y)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op("allclose",
+                    lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+                    x, y)
+
+
+def equal_all(x, y, name=None):
+    return apply_op("equal_all", lambda a, b: jnp.array_equal(a, b), x, y)
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

@@ -1,0 +1,477 @@
+"""Shape / layout manipulation ops.
+
+Parity: reference `python/paddle/tensor/manipulation.py` and the stride/
+concat/split/gather/scatter phi kernels. Gather/scatter map onto
+jnp.take / Array.at[] which XLA lowers to TPU-friendly dynamic-slice /
+scatter HLOs.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+from .dispatch import apply_op, def_op
+
+__all__ = [
+    "reshape", "transpose", "concat", "stack", "split", "chunk", "squeeze",
+    "unsqueeze", "flatten", "flip", "roll", "tile", "expand", "expand_as",
+    "broadcast_to", "broadcast_tensors", "broadcast_shape", "gather",
+    "gather_nd", "scatter", "scatter_", "scatter_nd", "scatter_nd_add",
+    "index_select", "index_sample", "index_add", "index_put", "masked_select",
+    "masked_fill", "where", "take_along_axis", "put_along_axis", "slice",
+    "strided_slice", "unbind", "unstack", "repeat_interleave", "rot90",
+    "moveaxis", "swapaxes", "as_complex", "as_real", "cast", "crop",
+    "tensordot", "unfold", "flatten_", "reshape_", "squeeze_", "unsqueeze_",
+    "atleast_1d", "atleast_2d", "atleast_3d", "select_scatter",
+    "view", "view_as", "unflatten", "dsplit", "hsplit", "vsplit",
+    "row_stack", "column_stack", "hstack", "vstack", "dstack",
+]
+
+
+def _static_shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in np.asarray(shape._data))
+    out = []
+    for s in shape:
+        out.append(int(s._data) if isinstance(s, Tensor) else int(s))
+    return tuple(out)
+
+
+def reshape(x, shape, name=None):
+    sh = _static_shape(shape)
+    return apply_op("reshape", lambda a: jnp.reshape(a, sh), x)
+
+
+def reshape_(x, shape, name=None):
+    x._data = jnp.reshape(x._data, _static_shape(shape))
+    return x
+
+
+view = reshape
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def transpose(x, perm=None, name=None):
+    if perm is not None:
+        perm = [int(p) for p in perm]
+    return apply_op("transpose", lambda a: jnp.transpose(a, perm), x)
+
+
+def concat(x, axis=0, name=None):
+    axis = int(axis._data) if isinstance(axis, Tensor) else int(axis)
+    return apply_op("concat", lambda xs: jnp.concatenate(xs, axis=axis), list(x))
+
+
+def stack(x, axis=0, name=None):
+    return apply_op("stack", lambda xs: jnp.stack(xs, axis=int(axis)), list(x))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(axis._data) if isinstance(axis, Tensor) else int(axis)
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        sections = num_or_sections
+        def _f(a):
+            return tuple(jnp.split(a, sections, axis=axis))
+    else:
+        sizes = [int(s) for s in num_or_sections]
+        # -1 placeholder support
+        if any(s == -1 for s in sizes):
+            known = builtins_sum(s for s in sizes if s != -1)
+            sizes = [dim - known if s == -1 else s for s in sizes]
+        offsets = np.cumsum(sizes)[:-1].tolist()
+        def _f(a):
+            return tuple(jnp.split(a, offsets, axis=axis))
+    return list(apply_op("split", _f, x))
+
+
+def builtins_sum(it):
+    import builtins
+    return builtins.sum(it)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, int(chunks), axis)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return split(x, num_or_indices, axis=2)
+
+
+def hsplit(x, num_or_indices, name=None):
+    return split(x, num_or_indices, axis=1 if x.ndim > 1 else 0)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return split(x, num_or_indices, axis=0)
+
+
+def squeeze(x, axis=None, name=None):
+    def _f(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(int(ax) % a.ndim for ax in axes if a.shape[int(ax) % a.ndim] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+    return apply_op("squeeze", _f, x)
+
+
+def squeeze_(x, axis=None, name=None):
+    x._data = squeeze(x.detach(), axis)._data
+    return x
+
+
+def unsqueeze(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = [int(a._data) if isinstance(a, Tensor) else int(a) for a in axes]
+    def _f(a):
+        out = a
+        for ax in axes:
+            out = jnp.expand_dims(out, ax)
+        return out
+    return apply_op("unsqueeze", _f, x)
+
+
+def unsqueeze_(x, axis, name=None):
+    x._data = unsqueeze(x.detach(), axis)._data
+    return x
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    nd = x.ndim
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+    def _f(a):
+        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
+        return jnp.reshape(a, new_shape)
+    return apply_op("flatten", _f, x)
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    x._data = flatten(x.detach(), start_axis, stop_axis)._data
+    return x
+
+
+def unflatten(x, axis, shape, name=None):
+    ax = axis % x.ndim
+    sh = _static_shape(shape)
+    def _f(a):
+        return jnp.reshape(a, a.shape[:ax] + sh + a.shape[ax + 1:])
+    return apply_op("unflatten", _f, x)
+
+
+def flip(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = tuple(int(a) for a in axes)
+    return apply_op("flip", lambda a: jnp.flip(a, axis=axes), x)
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply_op("roll", lambda a: jnp.roll(a, shifts, axis=axis), x)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply_op("rot90", lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x)
+
+
+def tile(x, repeat_times, name=None):
+    reps = _static_shape(repeat_times)
+    return apply_op("tile", lambda a: jnp.tile(a, reps), x)
+
+
+def expand(x, shape, name=None):
+    sh = list(_static_shape(shape))
+    def _f(a):
+        # paddle allows -1 meaning "keep this dim"
+        full = list(sh)
+        offset = len(full) - a.ndim
+        for i, s in enumerate(full):
+            if s == -1 and i >= offset:
+                full[i] = a.shape[i - offset]
+        return jnp.broadcast_to(a, tuple(full))
+    return apply_op("expand", _f, x)
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    return apply_op("broadcast_tensors", lambda xs: tuple(jnp.broadcast_arrays(*xs)), list(inputs))
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def cast(x, dtype):
+    from ..core.dtype import convert_dtype
+    d = convert_dtype(dtype)
+    return apply_op("cast", lambda a: a.astype(d), x)
+
+
+def gather(x, index, axis=0, name=None):
+    axis = int(axis._data) if isinstance(axis, Tensor) else int(axis)
+    def _f(a, idx):
+        return jnp.take(a, idx.reshape(-1) if idx.ndim > 1 else idx, axis=axis)
+    return apply_op("gather", _f, x, index)
+
+
+@def_op("gather_nd")
+def gather_nd(x, index, name=None):
+    idx_depth = index.shape[-1]
+    batch_shape = index.shape[:-1]
+    flat_idx = index.reshape(-1, idx_depth)
+    out = x[tuple(flat_idx[:, i] for i in range(idx_depth))]
+    return out.reshape(batch_shape + x.shape[idx_depth:])
+
+
+@def_op("scatter")
+def scatter(x, index, updates, overwrite=True, name=None):
+    idx = index.reshape(-1)
+    if overwrite:
+        return x.at[idx].set(updates)
+    zeroed = x.at[idx].set(jnp.zeros_like(updates))
+    return zeroed.at[idx].add(updates)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    x._data = scatter(x.detach(), index, updates, overwrite)._data
+    return x
+
+
+@def_op("scatter_nd")
+def scatter_nd(index, updates, shape, name=None):
+    out = jnp.zeros(tuple(int(s) for s in shape), updates.dtype)
+    idx_depth = index.shape[-1]
+    flat_idx = index.reshape(-1, idx_depth)
+    flat_updates = updates.reshape((flat_idx.shape[0],) + updates.shape[index.ndim - 1:])
+    return out.at[tuple(flat_idx[:, i] for i in range(idx_depth))].add(flat_updates)
+
+
+@def_op("scatter_nd_add")
+def scatter_nd_add(x, index, updates, name=None):
+    idx_depth = index.shape[-1]
+    flat_idx = index.reshape(-1, idx_depth)
+    flat_updates = updates.reshape((flat_idx.shape[0],) + updates.shape[index.ndim - 1:])
+    return x.at[tuple(flat_idx[:, i] for i in range(idx_depth))].add(flat_updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply_op("index_select", lambda a, i: jnp.take(a, i, axis=int(axis)), x, index)
+
+
+@def_op("index_sample")
+def index_sample(x, index):
+    rows = jnp.arange(x.shape[0])[:, None]
+    return x[rows, index]
+
+
+@def_op("index_add")
+def index_add(x, index, axis, value, name=None):
+    ax = int(axis) % x.ndim
+    moved = jnp.moveaxis(x, ax, 0)
+    vmoved = jnp.moveaxis(value, ax, 0)
+    out = moved.at[index].add(vmoved)
+    return jnp.moveaxis(out, 0, ax)
+
+
+@def_op("index_put")
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx = tuple(indices)
+    if accumulate:
+        return x.at[idx].add(value)
+    return x.at[idx].set(value)
+
+
+@def_op("masked_select")
+def masked_select(x, mask, name=None):
+    # dynamic-shape op: eager only (jit requires static sizes)
+    return x[mask]
+
+
+@def_op("masked_fill")
+def masked_fill(x, mask, value, name=None):
+    v = value if not hasattr(value, "astype") else value.astype(x.dtype)
+    return jnp.where(mask, v, x)
+
+
+@def_op("where")
+def _where3(condition, x, y, name=None):
+    return jnp.where(condition, x, y)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        from .search import nonzero
+        return nonzero(condition, as_tuple=True)
+    return _where3(condition, x, y)
+
+
+@def_op("take_along_axis")
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    idx = indices
+    if broadcast:
+        shape = list(np.broadcast_shapes(tuple(arr.shape[:axis]) + (1,) + tuple(arr.shape[axis + 1:]),
+                                         idx.shape))
+        shape[axis] = idx.shape[axis]
+        idx = jnp.broadcast_to(idx, shape)
+    return jnp.take_along_axis(arr, idx, axis=axis)
+
+
+@def_op("put_along_axis")
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True,
+                   broadcast=True, name=None):
+    vals = values if hasattr(values, "shape") else jnp.full(indices.shape, values, arr.dtype)
+    vals = jnp.broadcast_to(vals, indices.shape).astype(arr.dtype)
+    if reduce == "assign":
+        return jnp.put_along_axis(arr, indices, vals, axis=axis, inplace=False)
+    ax = axis % arr.ndim
+    idx_grid = jnp.indices(indices.shape, sparse=False)
+    full_idx = tuple(idx_grid[i] if i != ax else indices for i in range(arr.ndim))
+    if reduce in ("add", "sum"):
+        return arr.at[full_idx].add(vals)
+    if reduce in ("mul", "multiply"):
+        return arr.at[full_idx].multiply(vals)
+    if reduce == "amax":
+        return arr.at[full_idx].max(vals)
+    if reduce == "amin":
+        return arr.at[full_idx].min(vals)
+    raise ValueError(f"unsupported reduce: {reduce}")
+
+
+def slice(input, axes, starts, ends, name=None):
+    starts = [int(s._data) if isinstance(s, Tensor) else int(s) for s in starts]
+    ends = [int(e._data) if isinstance(e, Tensor) else int(e) for e in ends]
+    def _f(a):
+        idx = [jnp.s_[:]] * a.ndim
+        for ax, st, en in zip(axes, starts, ends):
+            idx[int(ax)] = jnp.s_[st:en]
+        return a[tuple(idx)]
+    return apply_op("slice", _f, input)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def _f(a):
+        idx = [jnp.s_[:]] * a.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[int(ax)] = jnp.s_[int(st):int(en):int(sd)]
+        return a[tuple(idx)]
+    return apply_op("strided_slice", _f, x)
+
+
+def unbind(input, axis=0, name=None):
+    n = input.shape[axis]
+    def _f(a):
+        return tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(a, n, axis=axis))
+    return list(apply_op("unbind", _f, input))
+
+
+def unstack(x, axis=0, num=None, name=None):
+    return unbind(x, axis)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        repeats = np.asarray(repeats._data)
+        total = int(repeats.sum())
+        return apply_op("repeat_interleave",
+                        lambda a: jnp.repeat(a, jnp.asarray(repeats), axis=axis,
+                                             total_repeat_length=total), x)
+    return apply_op("repeat_interleave", lambda a: jnp.repeat(a, int(repeats), axis=axis), x)
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply_op("moveaxis", lambda a: jnp.moveaxis(a, source, destination), x)
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply_op("swapaxes", lambda a: jnp.swapaxes(a, int(axis0), int(axis1)), x)
+
+
+@def_op("as_complex")
+def as_complex(x, name=None):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+@def_op("as_real")
+def as_real(x, name=None):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+@def_op("crop")
+def crop(x, shape=None, offsets=None, name=None):
+    offs = offsets if offsets is not None else [0] * x.ndim
+    sh = [x.shape[i] if (shape is None or shape[i] == -1) else int(shape[i]) for i in range(x.ndim)]
+    idx = tuple(jnp.s_[int(o):int(o) + int(s)] for o, s in zip(offs, sh))
+    return x[idx]
+
+
+@def_op("tensordot")
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, (list, tuple)) and len(axes) == 2:
+        axes = (tuple(axes[0]) if isinstance(axes[0], (list, tuple)) else (axes[0],),
+                tuple(axes[1]) if isinstance(axes[1], (list, tuple)) else (axes[1],))
+    return jnp.tensordot(x, y, axes=axes)
+
+
+@def_op("unfold")
+def unfold(x, axis, size, step, name=None):
+    ax = axis % x.ndim
+    n = (x.shape[ax] - size) // step + 1
+    starts = jnp.arange(n) * step
+    def take_window(s):
+        return jax.lax.dynamic_slice_in_dim(x, s, size, axis=ax)
+    out = jax.vmap(take_window)(starts)  # (n, ...) window at axis ax
+    out = jnp.moveaxis(out, 0, ax)       # windows indexed at ax
+    return jnp.moveaxis(out, ax + 1, x.ndim)  # window content to last dim
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply_op("atleast_1d", jnp.atleast_1d, t) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply_op("atleast_2d", jnp.atleast_2d, t) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply_op("atleast_3d", jnp.atleast_3d, t) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+@def_op("select_scatter")
+def select_scatter(x, values, axis, index, name=None):
+    idx = [jnp.s_[:]] * x.ndim
+    idx[axis % x.ndim] = index
+    return x.at[tuple(idx)].set(values)
+
+
+def hstack(x, name=None):
+    return apply_op("hstack", lambda xs: jnp.hstack(xs), list(x))
+
+
+def vstack(x, name=None):
+    return apply_op("vstack", lambda xs: jnp.vstack(xs), list(x))
+
+
+def dstack(x, name=None):
+    return apply_op("dstack", lambda xs: jnp.dstack(xs), list(x))
+
+
+row_stack = vstack
+
+
+def column_stack(x, name=None):
+    return apply_op("column_stack", lambda xs: jnp.column_stack(xs), list(x))

@@ -1,0 +1,152 @@
+"""Attach the op surface onto Tensor as methods/operators.
+
+Parity: the reference monkey-patches ~400 functions onto paddle.Tensor
+(`python/paddle/tensor/__init__.py` tensor_method_func list +
+`paddle/fluid/pybind/eager_math_op_patch.cc` operator overloads).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from . import creation, linalg, logic, manipulation, math, search
+from .dispatch import apply_op
+
+
+def _unwrap_index(item):
+    """Pass-through: Tensors inside the index pytree are handled by apply_op."""
+    return item
+
+
+def _getitem(self, item):
+    return apply_op("getitem", lambda a, idx: a[idx if not isinstance(idx, list) else tuple(idx)],
+                    self, item)
+
+
+def _alias(t):
+    """Snapshot a Tensor's current value+autograd identity. In-place ops must
+    record the op against this alias, not the mutated tensor itself —
+    otherwise the new grad node lists its own output as an input (a cycle),
+    the same hazard the reference guards with inplace version counters."""
+    a = Tensor(t._data, stop_gradient=t.stop_gradient, name=t.name)
+    a._grad_node = t._grad_node
+    a._grad_out_idx = t._grad_out_idx
+    return a
+
+
+def _rebind(self, out):
+    self._data = out._data
+    self._grad_node = out._grad_node
+    self._grad_out_idx = out._grad_out_idx
+    self.stop_gradient = out.stop_gradient
+    return self
+
+
+def _setitem(self, item, value):
+    out = apply_op(
+        "set_value",
+        lambda a, idx, v: a.at[idx if not isinstance(idx, list) else tuple(idx)].set(
+            v.astype(a.dtype) if hasattr(v, "astype") else v),
+        _alias(self), item, value)
+    return _rebind(self, out)
+
+
+_BINARY_DUNDERS = {
+    "__add__": math.add,
+    "__radd__": lambda x, y: math.add(y, x) if isinstance(y, Tensor) else apply_op("add", lambda a: jnp.add(y, a), x),
+    "__sub__": math.subtract,
+    "__rsub__": lambda x, y: apply_op("rsub", lambda a: jnp.subtract(y._data if isinstance(y, Tensor) else y, a), x),
+    "__mul__": math.multiply,
+    "__rmul__": lambda x, y: apply_op("rmul", lambda a: jnp.multiply(y._data if isinstance(y, Tensor) else y, a), x),
+    "__truediv__": math.divide,
+    "__rtruediv__": lambda x, y: apply_op("rdiv", lambda a: jnp.true_divide(y._data if isinstance(y, Tensor) else y, a), x),
+    "__floordiv__": math.floor_divide,
+    "__rfloordiv__": lambda x, y: apply_op("rfloordiv", lambda a: jnp.floor_divide(y._data if isinstance(y, Tensor) else y, a), x),
+    "__mod__": math.mod,
+    "__rmod__": lambda x, y: apply_op("rmod", lambda a: jnp.mod(y._data if isinstance(y, Tensor) else y, a), x),
+    "__pow__": math.pow,
+    "__rpow__": lambda x, y: apply_op("rpow", lambda a: jnp.power(y._data if isinstance(y, Tensor) else y, a), x),
+    "__matmul__": linalg.matmul,
+    "__rmatmul__": lambda x, y: apply_op("rmatmul", lambda a: jnp.matmul(y._data if isinstance(y, Tensor) else y, a), x),
+    "__eq__": logic.equal,
+    "__ne__": logic.not_equal,
+    "__lt__": logic.less_than,
+    "__le__": logic.less_equal,
+    "__gt__": logic.greater_than,
+    "__ge__": logic.greater_equal,
+    "__and__": logic.bitwise_and,
+    "__or__": logic.bitwise_or,
+    "__xor__": logic.bitwise_xor,
+    "__lshift__": logic.bitwise_left_shift,
+    "__rshift__": logic.bitwise_right_shift,
+}
+
+
+def _neg(self):
+    return math.neg(self)
+
+
+def _invert(self):
+    return logic.bitwise_not(self) if not jnp.issubdtype(self.dtype, jnp.bool_) else logic.logical_not(self)
+
+
+def _abs(self):
+    return math.abs(self)
+
+
+def _inplace(op):
+    def fn(self, other):
+        return _rebind(self, op(_alias(self), other))
+    return fn
+
+
+# Named methods lifted straight from the functional modules.
+_METHOD_SOURCES = [math, manipulation, linalg, logic, search]
+_SKIP = {"where"}  # `Tensor.where(cond...)` has different arg order; added below
+
+
+def patch_tensor_methods():
+    for name, fn in _BINARY_DUNDERS.items():
+        setattr(Tensor, name, fn)
+    Tensor.__neg__ = _neg
+    Tensor.__invert__ = _invert
+    Tensor.__abs__ = _abs
+    Tensor.__getitem__ = _getitem
+    Tensor.__setitem__ = _setitem
+    Tensor.__iadd__ = _inplace(math.add)
+    Tensor.__isub__ = _inplace(math.subtract)
+    Tensor.__imul__ = _inplace(math.multiply)
+    Tensor.__itruediv__ = _inplace(math.divide)
+
+    for mod in _METHOD_SOURCES:
+        for name in mod.__all__:
+            if name in _SKIP or hasattr(Tensor, name):
+                continue
+            fn = getattr(mod, name)
+            if callable(fn):
+                setattr(Tensor, name, fn)
+
+    # aliases / special-arg-order methods
+    Tensor.add_ = _inplace(math.add)
+    Tensor.subtract_ = _inplace(math.subtract)
+    Tensor.multiply_ = _inplace(math.multiply)
+    Tensor.scale_ = _inplace(math.scale)
+    Tensor.clip_ = _inplace_unary(math.clip)
+    Tensor.mod_ = _inplace(math.mod)
+    Tensor.where = lambda self, x, y=None: manipulation.where(self, x, y) \
+        if jnp.issubdtype(self.dtype, jnp.bool_) else manipulation.where(self > 0, x, y)
+    Tensor.tril_ = _inplace_unary(creation.tril)
+    Tensor.triu_ = _inplace_unary(creation.triu)
+    Tensor.zero_ = Tensor.zero_
+    Tensor.unsqueeze_ = manipulation.unsqueeze_
+    Tensor.squeeze_ = manipulation.squeeze_
+    Tensor.reshape_ = manipulation.reshape_
+    Tensor.flatten_ = manipulation.flatten_
+
+
+def _inplace_unary(op):
+    def fn(self, *args, **kwargs):
+        return _rebind(self, op(_alias(self), *args, **kwargs))
+    return fn

@@ -1,0 +1,152 @@
+"""Random sampling ops, driven by the global splittable PRNG stream.
+
+Parity: reference `python/paddle/tensor/random.py` (uniform/gaussian/
+randint/randperm/bernoulli/multinomial/...).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import convert_dtype, get_default_dtype
+from ..core.tensor import Tensor
+from ..framework.random import rng_key
+from .creation import _shape_list
+
+__all__ = [
+    "rand", "randn", "normal", "standard_normal", "uniform", "randint",
+    "randint_like", "randperm", "bernoulli", "multinomial", "poisson",
+    "exponential_", "uniform_", "normal_", "standard_gamma", "binomial",
+    "log_normal", "cauchy_", "geometric_",
+]
+
+
+def rand(shape, dtype=None, name=None):
+    d = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jax.random.uniform(rng_key(), _shape_list(shape), d))
+
+
+def randn(shape, dtype=None, name=None):
+    d = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jax.random.normal(rng_key(), _shape_list(shape), d))
+
+
+standard_normal = randn
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        out_shape = jnp.broadcast_shapes(
+            jnp.shape(m), jnp.shape(s)) if shape is None else tuple(_shape_list(shape))
+        z = jax.random.normal(rng_key(), out_shape, get_default_dtype())
+        return Tensor(m + s * z)
+    sh = _shape_list(shape) if shape is not None else []
+    z = jax.random.normal(rng_key(), sh, get_default_dtype())
+    return Tensor(mean + std * z)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    d = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jax.random.uniform(rng_key(), _shape_list(shape), d,
+                                     minval=min, maxval=max))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    x._data = jax.random.uniform(rng_key(), tuple(x._data.shape), x.dtype,
+                                 minval=min, maxval=max)
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x._data = mean + std * jax.random.normal(rng_key(), tuple(x._data.shape), x.dtype)
+    return x
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    d = convert_dtype(dtype)
+    return Tensor(jax.random.randint(rng_key(), _shape_list(shape), int(low), int(high), d))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    d = convert_dtype(dtype) or x.dtype
+    return Tensor(jax.random.randint(rng_key(), tuple(x._data.shape), int(low), int(high), d))
+
+
+def randperm(n, dtype="int64", name=None):
+    d = convert_dtype(dtype)
+    return Tensor(jax.random.permutation(rng_key(), int(n)).astype(d))
+
+
+def bernoulli(x, name=None):
+    p = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.bernoulli(rng_key(), p).astype(p.dtype))
+
+
+def bernoulli_(x, p=0.5, name=None):
+    x._data = jax.random.bernoulli(rng_key(), p, tuple(x._data.shape)).astype(x.dtype)
+    return x
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    p = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    logits = jnp.log(jnp.maximum(p, 1e-30))
+    if replacement:
+        out = jax.random.categorical(rng_key(), logits, axis=-1,
+                                     shape=(num_samples,) + p.shape[:-1])
+        out = jnp.moveaxis(out, 0, -1) if p.ndim > 1 else out
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(rng_key(), p.shape, logits.dtype)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(jnp.int64))
+
+
+def poisson(x, name=None):
+    lam = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.poisson(rng_key(), lam).astype(lam.dtype))
+
+
+def exponential_(x, lam=1.0, name=None):
+    u = jax.random.uniform(rng_key(), tuple(x._data.shape), x.dtype,
+                           minval=jnp.finfo(x.dtype).tiny, maxval=1.0)
+    x._data = -jnp.log(u) / lam
+    return x
+
+
+def standard_gamma(x, name=None):
+    alpha = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.gamma(rng_key(), alpha))
+
+
+def binomial(count, prob, name=None):
+    n = count._data if isinstance(count, Tensor) else jnp.asarray(count)
+    p = prob._data if isinstance(prob, Tensor) else jnp.asarray(prob)
+    return Tensor(jax.random.binomial(rng_key(), n.astype(jnp.float32),
+                                      p).astype(jnp.int64))
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    sh = _shape_list(shape) if shape is not None else []
+    z = jax.random.normal(rng_key(), sh, get_default_dtype())
+    return Tensor(jnp.exp(mean + std * z))
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    u = jax.random.uniform(rng_key(), tuple(x._data.shape), x.dtype,
+                           minval=1e-7, maxval=1.0 - 1e-7)
+    x._data = loc + scale * jnp.tan(jnp.pi * (u - 0.5))
+    return x
+
+
+def geometric_(x, probs, name=None):
+    u = jax.random.uniform(rng_key(), tuple(x._data.shape), jnp.float32,
+                           minval=1e-7, maxval=1.0)
+    x._data = (jnp.ceil(jnp.log(u) / jnp.log1p(-probs))).astype(x.dtype)
+    return x

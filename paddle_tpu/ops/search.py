@@ -1,0 +1,175 @@
+"""Search / sort / sampling-adjacent ops.
+
+Parity: reference `python/paddle/tensor/search.py`. Ops with data-dependent
+output shapes (nonzero, unique, masked_select) are eager-only — under
+`to_static`/jit the reference has the same restriction via shape inference.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dispatch import apply_op, def_op
+
+__all__ = [
+    "argmax", "argmin", "argsort", "sort", "topk", "searchsorted", "nonzero",
+    "kthvalue", "mode", "unique", "unique_consecutive", "index_sample",
+    "bucketize",
+]
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..core.dtype import convert_dtype
+    d = convert_dtype(dtype)
+    def _f(a):
+        out = jnp.argmax(a.reshape(-1) if axis is None else a,
+                         axis=None if axis is None else int(axis),
+                         keepdims=keepdim if axis is not None else False)
+        return out.astype(d)
+    return apply_op("argmax", _f, x)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..core.dtype import convert_dtype
+    d = convert_dtype(dtype)
+    def _f(a):
+        out = jnp.argmin(a.reshape(-1) if axis is None else a,
+                         axis=None if axis is None else int(axis),
+                         keepdims=keepdim if axis is not None else False)
+        return out.astype(d)
+    return apply_op("argmin", _f, x)
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def _f(a):
+        idx = jnp.argsort(a, axis=int(axis), stable=True,
+                          descending=descending)
+        return idx.astype(jnp.int64)
+    return apply_op("argsort", _f, x)
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def _f(a):
+        out = jnp.sort(a, axis=int(axis), stable=True, descending=descending)
+        return out
+    return apply_op("sort", _f, x)
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    k = int(k._data) if isinstance(k, Tensor) else int(k)
+    def _f(a):
+        ax = -1 if axis is None else int(axis)
+        moved = jnp.moveaxis(a, ax, -1)
+        vals, idx = jax.lax.top_k(moved if largest else -moved, k)
+        if not largest:
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int64), -1, ax))
+    return apply_op("topk", _f, x)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    def _f(seq, v):
+        if seq.ndim == 1:
+            out = jnp.searchsorted(seq, v, side=side)
+        else:
+            flat_seq = seq.reshape(-1, seq.shape[-1])
+            flat_v = v.reshape(-1, v.shape[-1])
+            out = jax.vmap(lambda s, vv: jnp.searchsorted(s, vv, side=side))(flat_seq, flat_v)
+            out = out.reshape(v.shape)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+    return apply_op("searchsorted", _f, sorted_sequence, values)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def nonzero(x, as_tuple=False):
+    # dynamic shape: eager-only (same restriction as reference static mode)
+    arr = np.asarray(x._data)
+    idx = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i.astype(np.int64))) for i in idx)
+    return Tensor(jnp.asarray(np.stack(idx, axis=1).astype(np.int64)))
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    k = int(k)
+    def _f(a):
+        ax = int(axis) % a.ndim
+        sorted_vals = jnp.sort(a, axis=ax)
+        sorted_idx = jnp.argsort(a, axis=ax)
+        vals = jnp.take(sorted_vals, k - 1, axis=ax)
+        idx = jnp.take(sorted_idx, k - 1, axis=ax)
+        if keepdim:
+            vals = jnp.expand_dims(vals, ax)
+            idx = jnp.expand_dims(idx, ax)
+        return vals, idx.astype(jnp.int64)
+    return apply_op("kthvalue", _f, x)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    arr = np.asarray(x._data)
+    ax = int(axis) % arr.ndim
+    moved = np.moveaxis(arr, ax, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    vals = np.empty(flat.shape[0], dtype=arr.dtype)
+    idxs = np.empty(flat.shape[0], dtype=np.int64)
+    for i, row in enumerate(flat):
+        uniq, counts = np.unique(row, return_counts=True)
+        best = uniq[np.argmax(counts[counts == counts.max()].size and counts)]
+        # paddle: the largest value among the most frequent
+        maxc = counts.max()
+        best = uniq[counts == maxc].max()
+        vals[i] = best
+        idxs[i] = np.where(row == best)[0][-1]
+    out_shape = moved.shape[:-1]
+    v = vals.reshape(out_shape)
+    ii = idxs.reshape(out_shape)
+    if keepdim:
+        v = np.expand_dims(v, ax)
+        ii = np.expand_dims(ii, ax)
+    return Tensor(jnp.asarray(v)), Tensor(jnp.asarray(ii))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    arr = np.asarray(x._data)
+    res = np.unique(arr, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        res = (res,)
+    outs = [Tensor(jnp.asarray(r if i == 0 else r.astype(np.int64)))
+            for i, r in enumerate(res)]
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    arr = np.asarray(x._data)
+    if axis is None:
+        flat = arr.reshape(-1)
+        if flat.size == 0:
+            keep = np.zeros(0, dtype=bool)
+        else:
+            keep = np.concatenate([[True], flat[1:] != flat[:-1]])
+        out = flat[keep]
+        outs = [Tensor(jnp.asarray(out))]
+        if return_inverse:
+            inv = np.cumsum(keep) - 1
+            outs.append(Tensor(jnp.asarray(inv.astype(np.int64))))
+        if return_counts:
+            pos = np.where(keep)[0]
+            counts = np.diff(np.concatenate([pos, [flat.size]]))
+            outs.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+        return outs[0] if len(outs) == 1 else tuple(outs)
+    raise NotImplementedError("unique_consecutive with axis not supported yet")
+
+
+@def_op("index_sample")
+def index_sample(x, index):
+    rows = jnp.arange(x.shape[0])[:, None]
+    return x[rows, index]

@@ -1,0 +1,6 @@
+"""paddle_tpu.optimizer — parity with python/paddle/optimizer/."""
+from . import lr  # noqa: F401
+from .optimizer import (  # noqa: F401
+    Optimizer, SGD, Momentum, Adam, AdamW, Adagrad, Adadelta, Adamax,
+    RMSProp, Lamb, NAdam, RAdam, ASGD, Rprop, LBFGS,
+)

@@ -1,0 +1,558 @@
+"""Optimizer base + the standard zoo.
+
+Parity: reference `python/paddle/optimizer/optimizer.py:127` (Optimizer base:
+regularization, grad clip, LR scheduling, accumulators) and the phi optimizer
+kernels (sgd/momentum/adam/adamw/lamb...). Updates are jnp expressions, so a
+whole `opt.step()` traces into the fused train step under to_static — the
+analog of the reference's fused_adam multi-tensor kernels is XLA fusing the
+update across parameters.
+
+Master weights: with multi_precision=True (or AMP O2), accumulators and the
+update run in fp32 while the parameter stays bf16/fp16
+(reference: fleet/utils/mix_precision_utils.py + master_weight in adamw).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import autograd
+from .lr import LRScheduler
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad",
+           "Adadelta", "Adamax", "RMSProp", "Lamb", "NAdam", "RAdam", "ASGD",
+           "Rprop", "LBFGS"]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        if parameters is None:
+            raise ValueError(
+                "paddle_tpu optimizers require an explicit parameter list "
+                "(pass model.parameters()).")
+        self._parameter_list = list(parameters)
+        self._param_groups = None
+        if self._parameter_list and isinstance(self._parameter_list[0], dict):
+            self._param_groups = self._parameter_list
+            flat = []
+            for g in self._param_groups:
+                flat.extend(g["params"])
+            self._parameter_list = flat
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        if isinstance(weight_decay, (int, float)):
+            self._weight_decay = float(weight_decay)
+        else:
+            self._weight_decay = weight_decay  # None or regularizer-like
+        # accumulators: slot name -> param index -> array
+        self._accumulators: Dict[str, Dict[int, jax.Array]] = {}
+        self._master_weights: Dict[int, jax.Array] = {}
+        self._step_count = 0
+
+    # ------------------------------------------------------------------- lr
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # ----------------------------------------------------------- accumulators
+    def _acc(self, name: str, idx: int, like: jax.Array, fill=0.0) -> jax.Array:
+        slot = self._accumulators.setdefault(name, {})
+        if idx not in slot:
+            dtype = jnp.float32 if self._multi_precision else like.dtype
+            slot[idx] = jnp.full(like.shape, fill, dtype)
+        return slot[idx]
+
+    def _set_acc(self, name: str, idx: int, value):
+        self._accumulators[name][idx] = value
+
+    def _master(self, idx: int, p: Tensor) -> jax.Array:
+        if not self._multi_precision or p.dtype == jnp.float32:
+            return p._data
+        if idx not in self._master_weights:
+            self._master_weights[idx] = p._data.astype(jnp.float32)
+        return self._master_weights[idx]
+
+    def _writeback(self, idx: int, p: Tensor, new_master):
+        if self._multi_precision and p.dtype != jnp.float32:
+            self._master_weights[idx] = new_master
+            p._data = new_master.astype(p.dtype)
+        else:
+            p._data = new_master
+
+    # ------------------------------------------------------------------ step
+    @autograd.no_grad
+    def step(self):
+        params_grads = []
+        for p in self._parameter_list:
+            if p.stop_gradient or p._grad_buffer is None:
+                continue
+            params_grads.append((p, Tensor(p._grad_buffer)))
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr = self.get_lr()
+        self._step_count += 1
+        for idx, p in enumerate(self._parameter_list):
+            match = next((g for (pp, g) in params_grads if pp is p), None)
+            if match is None:
+                continue
+            g = match._data
+            lr_scale = getattr(p, "_lr_scale", 1.0)
+            self._apply_one(idx, p, g, lr * lr_scale)
+
+    minimize_step = step
+
+    def _apply_one(self, idx: int, p: Tensor, g: jax.Array, lr: float):
+        raise NotImplementedError
+
+    def _decayed_grad(self, p, g):
+        """L2-regularizer-style decay (coupled; AdamW overrides w/ decoupled)."""
+        if isinstance(self._weight_decay, float) and self._weight_decay != 0.0:
+            return g + self._weight_decay * p._data.astype(g.dtype)
+        return g
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameter_list:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    # --------------------------------------------------------------- state IO
+    def state_dict(self):
+        out = {}
+        for name, slot in self._accumulators.items():
+            for idx, arr in slot.items():
+                out[f"{name}_{idx}"] = Tensor(arr)
+        for idx, arr in self._master_weights.items():
+            out[f"master_{idx}"] = Tensor(arr)
+        out["@step"] = self._step_count
+        if isinstance(self._learning_rate, LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        for key, v in state.items():
+            if key == "@step":
+                self._step_count = int(v)
+            elif key == "LR_Scheduler":
+                if isinstance(self._learning_rate, LRScheduler):
+                    self._learning_rate.set_state_dict(v)
+            elif key.startswith("master_"):
+                self._master_weights[int(key[7:])] = \
+                    v._data if isinstance(v, Tensor) else jnp.asarray(v)
+            else:
+                name, idx = key.rsplit("_", 1)
+                arr = v._data if isinstance(v, Tensor) else jnp.asarray(v)
+                self._accumulators.setdefault(name, {})[int(idx)] = arr
+        return self
+
+    # ------------------------------------------- functional-state (jit bridge)
+    def raw_state(self):
+        st = {f"{n}_{i}": a for n, slot in self._accumulators.items()
+              for i, a in slot.items()}
+        st.update({f"master_{i}": a for i, a in self._master_weights.items()})
+        return st
+
+    def load_raw_state(self, raw):
+        for key, arr in raw.items():
+            if key.startswith("master_"):
+                self._master_weights[int(key[7:])] = arr
+            else:
+                name, idx = key.rsplit("_", 1)
+                self._accumulators.setdefault(name, {})[int(idx)] = arr
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+
+    def _apply_one(self, idx, p, g, lr):
+        g = self._decayed_grad(p, g)
+        m = self._master(idx, p)
+        self._writeback(idx, p, m - lr * g.astype(m.dtype))
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _apply_one(self, idx, p, g, lr):
+        g = self._decayed_grad(p, g)
+        m = self._master(idx, p)
+        g = g.astype(m.dtype)
+        vel = self._acc("velocity", idx, m)
+        vel = self._momentum * vel + g
+        self._set_acc("velocity", idx, vel)
+        if self._nesterov:
+            update = g + self._momentum * vel
+        else:
+            update = vel
+        self._writeback(idx, p, m - lr * update)
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, name=None, amsgrad=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._amsgrad = amsgrad
+
+    def _apply_one(self, idx, p, g, lr):
+        g = self._decayed_grad(p, g)
+        m_w = self._master(idx, p)
+        g = g.astype(m_w.dtype)
+        m = self._acc("moment1", idx, m_w)
+        v = self._acc("moment2", idx, m_w)
+        t = self._step_count
+        m = self._beta1 * m + (1 - self._beta1) * g
+        v = self._beta2 * v + (1 - self._beta2) * g * g
+        self._set_acc("moment1", idx, m)
+        self._set_acc("moment2", idx, v)
+        mhat = m / (1 - self._beta1 ** t)
+        vhat = v / (1 - self._beta2 ** t)
+        if self._amsgrad:
+            vmax = self._acc("moment2_max", idx, m_w)
+            vmax = jnp.maximum(vmax, vhat)
+            self._set_acc("moment2_max", idx, vmax)
+            vhat = vmax
+        self._writeback(idx, p, m_w - lr * mhat / (jnp.sqrt(vhat) + self._eps))
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None,
+                 amsgrad=False):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision,
+                         name=name, amsgrad=amsgrad)
+        self._wd = float(weight_decay) if weight_decay else 0.0
+        self._apply_decay_fn = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _apply_one(self, idx, p, g, lr):
+        if self._lr_ratio is not None:
+            lr = lr * self._lr_ratio(p)
+        m_w = self._master(idx, p)
+        if self._wd != 0.0 and (self._apply_decay_fn is None or
+                                self._apply_decay_fn(p.name or f"param_{idx}")):
+            m_w = m_w * (1.0 - lr * self._wd)
+        g = g.astype(m_w.dtype)
+        m = self._acc("moment1", idx, m_w)
+        v = self._acc("moment2", idx, m_w)
+        t = self._step_count
+        m = self._beta1 * m + (1 - self._beta1) * g
+        v = self._beta2 * v + (1 - self._beta2) * g * g
+        self._set_acc("moment1", idx, m)
+        self._set_acc("moment2", idx, v)
+        mhat = m / (1 - self._beta1 ** t)
+        vhat = v / (1 - self._beta2 ** t)
+        if self._amsgrad:
+            vmax = self._acc("moment2_max", idx, m_w)
+            vmax = jnp.maximum(vmax, vhat)
+            self._set_acc("moment2_max", idx, vmax)
+            vhat = vmax
+        self._writeback(idx, p, m_w - lr * mhat / (jnp.sqrt(vhat) + self._eps))
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-06, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None, initial_accumulator_value=0.0):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _apply_one(self, idx, p, g, lr):
+        g = self._decayed_grad(p, g)
+        m_w = self._master(idx, p)
+        g = g.astype(m_w.dtype)
+        acc = self._acc("moment", idx, m_w, fill=self._init_acc)
+        acc = acc + g * g
+        self._set_acc("moment", idx, acc)
+        self._writeback(idx, p, m_w - lr * g / (jnp.sqrt(acc) + self._eps))
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._eps, self._rho = epsilon, rho
+
+    def _apply_one(self, idx, p, g, lr):
+        g = self._decayed_grad(p, g)
+        m_w = self._master(idx, p)
+        g = g.astype(m_w.dtype)
+        avg_sq = self._acc("avg_squared_grad", idx, m_w)
+        avg_up = self._acc("avg_squared_update", idx, m_w)
+        avg_sq = self._rho * avg_sq + (1 - self._rho) * g * g
+        update = -jnp.sqrt((avg_up + self._eps) / (avg_sq + self._eps)) * g
+        avg_up = self._rho * avg_up + (1 - self._rho) * update * update
+        self._set_acc("avg_squared_grad", idx, avg_sq)
+        self._set_acc("avg_squared_update", idx, avg_up)
+        self._writeback(idx, p, m_w + lr * update)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _apply_one(self, idx, p, g, lr):
+        g = self._decayed_grad(p, g)
+        m_w = self._master(idx, p)
+        g = g.astype(m_w.dtype)
+        m = self._acc("moment", idx, m_w)
+        u = self._acc("inf_norm", idx, m_w)
+        m = self._beta1 * m + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * u, jnp.abs(g))
+        self._set_acc("moment", idx, m)
+        self._set_acc("inf_norm", idx, u)
+        t = self._step_count
+        self._writeback(idx, p,
+                        m_w - lr / (1 - self._beta1 ** t) * m / (u + self._eps))
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-06, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._rho, self._eps = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _apply_one(self, idx, p, g, lr):
+        g = self._decayed_grad(p, g)
+        m_w = self._master(idx, p)
+        g = g.astype(m_w.dtype)
+        ms = self._acc("mean_square", idx, m_w)
+        mom = self._acc("momentum", idx, m_w)
+        ms = self._rho * ms + (1 - self._rho) * g * g
+        self._set_acc("mean_square", idx, ms)
+        if self._centered:
+            mg = self._acc("mean_grad", idx, m_w)
+            mg = self._rho * mg + (1 - self._rho) * g
+            self._set_acc("mean_grad", idx, mg)
+            denom = jnp.sqrt(ms - mg * mg + self._eps)
+        else:
+            denom = jnp.sqrt(ms + self._eps)
+        mom = self._momentum * mom + lr * g / denom
+        self._set_acc("momentum", idx, mom)
+        self._writeback(idx, p, m_w - mom)
+
+
+class Lamb(Optimizer):
+    """Parity: python/paddle/optimizer/lamb.py (layerwise adaptive scaling)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-06, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         multi_precision)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _apply_one(self, idx, p, g, lr):
+        m_w = self._master(idx, p)
+        g = g.astype(m_w.dtype)
+        m = self._acc("moment1", idx, m_w)
+        v = self._acc("moment2", idx, m_w)
+        t = self._step_count
+        m = self._beta1 * m + (1 - self._beta1) * g
+        v = self._beta2 * v + (1 - self._beta2) * g * g
+        self._set_acc("moment1", idx, m)
+        self._set_acc("moment2", idx, v)
+        mhat = m / (1 - self._beta1 ** t)
+        vhat = v / (1 - self._beta2 ** t)
+        r = mhat / (jnp.sqrt(vhat) + self._eps)
+        wd = self._lamb_wd
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            wd = 0.0
+        r = r + wd * m_w
+        w_norm = jnp.linalg.norm(m_w)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        self._writeback(idx, p, m_w - lr * trust * r)
+
+
+class NAdam(Adam):
+    def _apply_one(self, idx, p, g, lr):
+        g = self._decayed_grad(p, g)
+        m_w = self._master(idx, p)
+        g = g.astype(m_w.dtype)
+        m = self._acc("moment1", idx, m_w)
+        v = self._acc("moment2", idx, m_w)
+        t = self._step_count
+        m = self._beta1 * m + (1 - self._beta1) * g
+        v = self._beta2 * v + (1 - self._beta2) * g * g
+        self._set_acc("moment1", idx, m)
+        self._set_acc("moment2", idx, v)
+        mhat = self._beta1 * m / (1 - self._beta1 ** (t + 1)) + \
+            (1 - self._beta1) * g / (1 - self._beta1 ** t)
+        vhat = v / (1 - self._beta2 ** t)
+        self._writeback(idx, p, m_w - lr * mhat / (jnp.sqrt(vhat) + self._eps))
+
+
+class RAdam(Adam):
+    def _apply_one(self, idx, p, g, lr):
+        g = self._decayed_grad(p, g)
+        m_w = self._master(idx, p)
+        g = g.astype(m_w.dtype)
+        m = self._acc("moment1", idx, m_w)
+        v = self._acc("moment2", idx, m_w)
+        t = self._step_count
+        m = self._beta1 * m + (1 - self._beta1) * g
+        v = self._beta2 * v + (1 - self._beta2) * g * g
+        self._set_acc("moment1", idx, m)
+        self._set_acc("moment2", idx, v)
+        mhat = m / (1 - self._beta1 ** t)
+        rho_inf = 2 / (1 - self._beta2) - 1
+        rho_t = rho_inf - 2 * t * self._beta2 ** t / (1 - self._beta2 ** t)
+        if rho_t > 4:
+            vhat = jnp.sqrt(v / (1 - self._beta2 ** t))
+            rt = ((rho_t - 4) * (rho_t - 2) * rho_inf /
+                  ((rho_inf - 4) * (rho_inf - 2) * rho_t)) ** 0.5
+            self._writeback(idx, p, m_w - lr * rt * mhat / (vhat + self._eps))
+        else:
+            self._writeback(idx, p, m_w - lr * mhat)
+
+
+class ASGD(Optimizer):
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+
+    def _apply_one(self, idx, p, g, lr):
+        g = self._decayed_grad(p, g)
+        m_w = self._master(idx, p)
+        self._writeback(idx, p, m_w - lr * g.astype(m_w.dtype))
+
+
+class Rprop(Optimizer):
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         multi_precision)
+        self._lr_range = learning_rate_range
+        self._etas = etas
+
+    def _apply_one(self, idx, p, g, lr):
+        m_w = self._master(idx, p)
+        g = g.astype(m_w.dtype)
+        prev_g = self._acc("prev_grad", idx, m_w)
+        step = self._acc("step_size", idx, m_w, fill=self.get_lr())
+        sign = jnp.sign(g * prev_g)
+        step = jnp.where(sign > 0, jnp.minimum(step * self._etas[1], self._lr_range[1]),
+                         jnp.where(sign < 0,
+                                   jnp.maximum(step * self._etas[0], self._lr_range[0]),
+                                   step))
+        g_eff = jnp.where(sign < 0, jnp.zeros_like(g), g)
+        self._set_acc("prev_grad", idx, g_eff)
+        self._set_acc("step_size", idx, step)
+        self._writeback(idx, p, m_w - jnp.sign(g_eff) * step)
+
+
+class LBFGS(Optimizer):
+    """Simplified LBFGS (single tensor-group, history-based two-loop)."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-07, tolerance_change=1e-09, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._history_size = history_size
+        self._s_hist: List = []
+        self._y_hist: List = []
+        self._prev_flat = None
+        self._prev_grad = None
+
+    def _flatten(self, arrays):
+        return jnp.concatenate([a.reshape(-1) for a in arrays])
+
+    def step(self, closure=None):
+        if closure is not None:
+            with autograd.enable_grad():
+                loss = closure()
+        params = [p for p in self._parameter_list
+                  if not p.stop_gradient and p._grad_buffer is not None]
+        if not params:
+            return
+        flat_g = self._flatten([p._grad_buffer.astype(jnp.float32) for p in params])
+        flat_w = self._flatten([p._data.astype(jnp.float32) for p in params])
+        if self._prev_flat is not None:
+            s = flat_w - self._prev_flat
+            y = flat_g - self._prev_grad
+            if float(jnp.dot(s, y)) > 1e-10:
+                self._s_hist.append(s)
+                self._y_hist.append(y)
+                if len(self._s_hist) > self._history_size:
+                    self._s_hist.pop(0)
+                    self._y_hist.pop(0)
+        q = flat_g
+        alphas = []
+        for s, y in zip(reversed(self._s_hist), reversed(self._y_hist)):
+            rho = 1.0 / jnp.dot(y, s)
+            a = rho * jnp.dot(s, q)
+            q = q - a * y
+            alphas.append((a, rho, s, y))
+        if self._s_hist:
+            s, y = self._s_hist[-1], self._y_hist[-1]
+            q = q * (jnp.dot(s, y) / jnp.dot(y, y))
+        for a, rho, s, y in reversed(alphas):
+            b = rho * jnp.dot(y, q)
+            q = q + (a - b) * s
+        direction = -q
+        lr = self.get_lr()
+        self._prev_flat = flat_w + lr * direction
+        self._prev_grad = flat_g
+        off = 0
+        new_flat = self._prev_flat
+        for p in params:
+            n = p.size
+            p._data = new_flat[off:off + n].reshape(p._data.shape).astype(p.dtype)
+            off += n
+        return None

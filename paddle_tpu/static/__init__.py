@@ -1,0 +1,48 @@
+"""paddle_tpu.static — compatibility shims.
+
+The reference's static-graph mode (Program/Executor,
+`python/paddle/static/`) is replaced wholesale by jax.jit tracing
+(paddle_tpu.jit.to_static); see SURVEY.md §7 design stance. This module
+keeps the commonly-scripted entry points as thin adapters so reference
+scripts import cleanly.
+"""
+from ..jit.api import InputSpec  # noqa: F401
+
+__all__ = ["InputSpec", "Program", "program_guard", "default_main_program",
+           "default_startup_program"]
+
+
+class Program:
+    """Inert placeholder; compiled programs are XLA executables."""
+
+    def __init__(self):
+        self._is_start_up = False
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+
+_main = Program()
+_startup = Program()
+
+
+def default_main_program():
+    return _main
+
+
+def default_startup_program():
+    return _startup
+
+
+class program_guard:
+    def __init__(self, main_program=None, startup_program=None):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False

@@ -1,0 +1,16 @@
+"""Utilities: flags registry, nan/inf debugging, misc.
+
+Parity: reference flag system (`paddle/common/flags.h` + `flags.cc`, 179
+flags; settable via FLAGS_* env or paddle.set_flags) and nan/inf checking
+(`FLAGS_check_nan_inf`, fluid/eager/nan_inf_utils.cc).
+"""
+from .flags import set_flags, get_flags, flags  # noqa: F401
+from .nan_inf import check_numerics, enable_check_nan_inf  # noqa: F401
+
+try:  # optional alias paddle.utils.unique_name
+    from . import unique_name  # noqa: F401
+except ImportError:
+    pass
+
+__all__ = ["set_flags", "get_flags", "flags", "check_numerics",
+           "enable_check_nan_inf"]

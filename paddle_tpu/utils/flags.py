@@ -1,0 +1,74 @@
+"""Runtime flag registry.
+
+Parity: reference `paddle/common/flags.h` / `flags_native.cc`: named flags
+with defaults, env-var override (FLAGS_<name>=...), paddle.set_flags /
+get_flags API. Flags whose semantics carry to TPU keep their reference
+names (check_nan_inf, benchmark, ...); CUDA-specific ones are registered
+as inert for script compatibility.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+__all__ = ["define_flag", "set_flags", "get_flags", "flags"]
+
+_REGISTRY: Dict[str, Any] = {}
+
+
+def _env_cast(raw: str, default):
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(raw)
+    if isinstance(default, float):
+        return float(raw)
+    return raw
+
+
+def define_flag(name: str, default, help_str: str = ""):
+    env = os.environ.get("FLAGS_" + name)
+    value = _env_cast(env, default) if env is not None else default
+    _REGISTRY[name] = value
+    return value
+
+
+def set_flags(flags_dict: Dict[str, Any]):
+    for k, v in flags_dict.items():
+        key = k[6:] if k.startswith("FLAGS_") else k
+        _REGISTRY[key] = v
+
+
+def get_flags(names):
+    if isinstance(names, str):
+        names = [names]
+    out = {}
+    for n in names:
+        key = n[6:] if n.startswith("FLAGS_") else n
+        out["FLAGS_" + key] = _REGISTRY.get(key)
+    return out
+
+
+def flags(name: str, default=None):
+    if name not in _REGISTRY and default is not None:
+        define_flag(name, default)
+    return _REGISTRY.get(name, default)
+
+
+# ---- the reference's flag surface that carries over to TPU (A.6) ----
+define_flag("check_nan_inf", False, "scan op outputs for NaN/Inf")
+define_flag("check_nan_inf_level", 0, "0: error, 1: warn, 3: collect")
+define_flag("benchmark", False, "sync-and-time every op")
+define_flag("low_precision_op_list", 0, "collect AMP op statistics")
+define_flag("call_stack_level", 1, "error report verbosity")
+define_flag("deterministic", False, "force deterministic lowering (XLA)")
+define_flag("embedding_deterministic", 0, "deterministic embedding grads")
+define_flag("allocator_strategy", "auto_growth", "inert on TPU (XLA BFC)")
+define_flag("fraction_of_gpu_memory_to_use", 0.92,
+            "maps to XLA_PYTHON_CLIENT_MEM_FRACTION")
+define_flag("new_executor_serial_run", False, "debug: disable async dispatch")
+define_flag("use_stride_kernel", True, "inert: XLA has no stride kernels")
+define_flag("cudnn_deterministic", False, "alias of deterministic")
+define_flag("sync_nccl_allreduce", False, "inert: XLA collectives are in-graph")
+define_flag("tpu_matmul_precision", "default",
+            "jax default_matmul_precision for fp32 matmuls")

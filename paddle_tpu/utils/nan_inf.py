@@ -1,0 +1,52 @@
+"""NaN/Inf debugging.
+
+Parity: reference `FLAGS_check_nan_inf` + per-op scan
+(`fluid/eager/nan_inf_utils.cc`, `phi/kernels/check_numerics_kernel.h`).
+When enabled, the op-dispatch funnel checks every float output eagerly and
+raises with the op name — the same observability point as the reference's
+eager hook.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .flags import flags, set_flags
+
+__all__ = ["check_numerics", "enable_check_nan_inf", "check_nan_inf_enabled",
+           "maybe_check"]
+
+
+def enable_check_nan_inf(enable=True, level=0):
+    set_flags({"check_nan_inf": bool(enable), "check_nan_inf_level": level})
+
+
+def check_nan_inf_enabled():
+    return bool(flags("check_nan_inf", False))
+
+
+def check_numerics(x, op_name="tensor", action="raise"):
+    arr = x._data if hasattr(x, "_data") else x
+    if not jnp.issubdtype(arr.dtype, jnp.floating):
+        return x
+    bad = bool(jnp.any(~jnp.isfinite(arr)))
+    if bad:
+        n_nan = int(jnp.sum(jnp.isnan(arr)))
+        n_inf = int(jnp.sum(jnp.isinf(arr)))
+        msg = (f"[check_nan_inf] op `{op_name}` produced {n_nan} NaN / "
+               f"{n_inf} Inf values (shape={tuple(arr.shape)}, dtype={arr.dtype})")
+        if action == "raise" and int(flags("check_nan_inf_level", 0)) == 0:
+            raise FloatingPointError(msg)
+        print("WARNING:", msg)
+    return x
+
+
+def maybe_check(op_name, out_arrays):
+    """Hook used by ops.dispatch when FLAGS_check_nan_inf is on (eager only —
+    inside jit, tracing skips the host check, same as the reference's static
+    mode needing the interpreter-level hook)."""
+    for a in out_arrays:
+        if isinstance(a, jax.Array) and not isinstance(
+                a, jax.core.Tracer):
+            check_numerics(a, op_name)

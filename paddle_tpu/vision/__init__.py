@@ -1,0 +1,8 @@
+"""paddle_tpu.vision — datasets, transforms, models, ops.
+
+Parity: reference `python/paddle/vision/`.
+"""
+from . import transforms  # noqa: F401
+from . import datasets  # noqa: F401
+from . import models  # noqa: F401
+from . import ops  # noqa: F401

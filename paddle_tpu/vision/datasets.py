@@ -1,0 +1,141 @@
+"""Vision datasets. Parity: reference python/paddle/vision/datasets/
+(MNIST, Cifar10/100, FashionMNIST...). Zero-egress environment: datasets
+load from local files when present, else generate deterministic synthetic
+data (shape/dtype-faithful) so training pipelines run end-to-end.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeImageDataset"]
+
+_DATA_HOME = os.path.expanduser(os.environ.get("PADDLE_TPU_DATA_HOME",
+                                               "~/.cache/paddle_tpu/datasets"))
+
+
+class FakeImageDataset(Dataset):
+    """Deterministic synthetic image classification dataset."""
+
+    def __init__(self, num_samples, image_shape, num_classes, transform=None,
+                 seed=0):
+        self.num_samples = num_samples
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.seed = seed
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self.seed + idx)
+        img = rng.randint(0, 256, self.image_shape).astype(np.uint8)
+        label = np.asarray(idx % self.num_classes, np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return self.num_samples
+
+
+class MNIST(Dataset):
+    """MNIST from local idx files if available, else synthetic fallback."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        self.transform = transform
+        self.mode = mode
+        base = os.path.join(_DATA_HOME, "mnist")
+        prefix = "train" if mode == "train" else "t10k"
+        img_f = image_path or os.path.join(base, f"{prefix}-images-idx3-ubyte.gz")
+        lab_f = label_path or os.path.join(base, f"{prefix}-labels-idx1-ubyte.gz")
+        if os.path.exists(img_f) and os.path.exists(lab_f):
+            with gzip.open(img_f, "rb") as f:
+                data = np.frombuffer(f.read(), np.uint8, offset=16)
+            self.images = data.reshape(-1, 28, 28)
+            with gzip.open(lab_f, "rb") as f:
+                self.labels = np.frombuffer(f.read(), np.uint8, offset=8).astype(np.int64)
+        else:
+            n = 60000 if mode == "train" else 10000
+            self._fake = FakeImageDataset(n, (28, 28), 10)
+            self.images = None
+            self.labels = None
+            self._n = n
+
+    def __getitem__(self, idx):
+        if self.images is None:
+            img, label = self._fake[idx]
+        else:
+            img, label = self.images[idx], self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(label, np.int64)
+
+    def __len__(self):
+        return self._n if self.images is None else len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 from local python-pickle tarball if available, else synthetic."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.transform = transform
+        data_file = data_file or os.path.join(_DATA_HOME, "cifar-10-python.tar.gz")
+        self.num_classes = 10
+        if os.path.exists(data_file):
+            self.data, self.labels = self._load_tar(data_file, mode)
+        else:
+            n = 50000 if mode == "train" else 10000
+            self._fake = FakeImageDataset(n, (32, 32, 3), self.num_classes)
+            self.data = None
+            self._n = n
+
+    def _load_tar(self, path, mode):
+        imgs, labels = [], []
+        names = [f"data_batch_{i}" for i in range(1, 6)] if mode == "train" \
+            else ["test_batch"]
+        key = b"labels" if self.num_classes == 10 else b"fine_labels"
+        with tarfile.open(path) as tf:
+            for member in tf.getmembers():
+                if any(member.name.endswith(n) for n in names):
+                    d = pickle.load(tf.extractfile(member), encoding="bytes")
+                    imgs.append(d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+                    labels.extend(d[key])
+        return np.concatenate(imgs), np.asarray(labels, np.int64)
+
+    def __getitem__(self, idx):
+        if self.data is None:
+            img, label = self._fake[idx]
+        else:
+            img, label = self.data[idx], self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(label, np.int64)
+
+    def __len__(self):
+        return self._n if self.data is None else len(self.data)
+
+
+class Cifar100(Cifar10):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.transform = transform
+        data_file = data_file or os.path.join(_DATA_HOME, "cifar-100-python.tar.gz")
+        self.num_classes = 100
+        if os.path.exists(data_file):
+            self.data, self.labels = self._load_tar(data_file, mode)
+        else:
+            n = 50000 if mode == "train" else 10000
+            self._fake = FakeImageDataset(n, (32, 32, 3), self.num_classes)
+            self.data = None
+            self._n = n

@@ -1,0 +1,140 @@
+"""Vision ops: nms, roi_align, box utils.
+
+Parity: reference `python/paddle/vision/ops.py` (subset: nms, roi_align,
+box_coder-adjacent utilities, deform_conv2d is a planned kernel).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops.dispatch import apply_op
+
+__all__ = ["nms", "roi_align", "box_area", "box_iou", "psroi_pool", "roi_pool"]
+
+
+def box_area(boxes):
+    return apply_op("box_area",
+                    lambda b: (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]), boxes)
+
+
+def box_iou(boxes1, boxes2):
+    def _f(b1, b2):
+        area1 = (b1[:, 2] - b1[:, 0]) * (b1[:, 3] - b1[:, 1])
+        area2 = (b2[:, 2] - b2[:, 0]) * (b2[:, 3] - b2[:, 1])
+        lt = jnp.maximum(b1[:, None, :2], b2[None, :, :2])
+        rb = jnp.minimum(b1[:, None, 2:], b2[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / (area1[:, None] + area2[None, :] - inter)
+    return apply_op("box_iou", _f, boxes1, boxes2)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy NMS (host-side; dynamic output shape). Parity: vision/ops.py nms."""
+    b = np.asarray(boxes._data if isinstance(boxes, Tensor) else boxes)
+    s = np.asarray(scores._data) if scores is not None else None
+    order = np.argsort(-s) if s is not None else np.arange(len(b))
+    if category_idxs is not None:
+        cats = np.asarray(category_idxs._data if isinstance(category_idxs, Tensor)
+                          else category_idxs)
+    else:
+        cats = np.zeros(len(b), np.int64)
+    keep = []
+    area = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    suppressed = np.zeros(len(b), bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        for j in order:
+            if j == i or suppressed[j] or cats[j] != cats[i]:
+                continue
+            xx1 = max(b[i, 0], b[j, 0])
+            yy1 = max(b[i, 1], b[j, 1])
+            xx2 = min(b[i, 2], b[j, 2])
+            yy2 = min(b[i, 3], b[j, 3])
+            inter = max(0.0, xx2 - xx1) * max(0.0, yy2 - yy1)
+            iou = inter / (area[i] + area[j] - inter + 1e-10)
+            if iou > iou_threshold:
+                suppressed[j] = True
+    keep = np.asarray(keep, np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(keep))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign via bilinear gather. Parity: vision/ops.py roi_align."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def _f(feat, rois, rois_num):
+        n, c, h, w = feat.shape
+        # assign each roi to its batch image
+        batch_idx = jnp.repeat(jnp.arange(rois_num.shape[0]), rois.shape[0] //
+                               max(rois_num.shape[0], 1),
+                               total_repeat_length=rois.shape[0]) \
+            if rois_num is None else \
+            jnp.repeat(jnp.arange(rois_num.shape[0]), rois_num,
+                       total_repeat_length=rois.shape[0])
+        offset = 0.5 if aligned else 0.0
+        x1 = rois[:, 0] * spatial_scale - offset
+        y1 = rois[:, 1] * spatial_scale - offset
+        x2 = rois[:, 2] * spatial_scale - offset
+        y2 = rois[:, 3] * spatial_scale - offset
+        roi_w = jnp.maximum(x2 - x1, 1e-3)
+        roi_h = jnp.maximum(y2 - y1, 1e-3)
+        bin_w = roi_w / ow
+        bin_h = roi_h / oh
+        sr = sampling_ratio if sampling_ratio > 0 else 2
+        # sample points per bin
+        ys = y1[:, None, None, None] + bin_h[:, None, None, None] * (
+            jnp.arange(oh)[None, :, None, None] +
+            (jnp.arange(sr)[None, None, None, :] + 0.5) / sr)
+        xs = x1[:, None, None, None] + bin_w[:, None, None, None] * (
+            jnp.arange(ow)[None, :, None, None] +
+            (jnp.arange(sr)[None, None, None, :] + 0.5) / sr)
+
+        def bilinear(img, yy, xx):
+            yy = jnp.clip(yy, 0, h - 1)
+            xx = jnp.clip(xx, 0, w - 1)
+            y0 = jnp.floor(yy).astype(jnp.int32)
+            x0 = jnp.floor(xx).astype(jnp.int32)
+            y1_ = jnp.minimum(y0 + 1, h - 1)
+            x1_ = jnp.minimum(x0 + 1, w - 1)
+            wy = yy - y0
+            wx = xx - x0
+            v = (img[:, y0, x0] * (1 - wy) * (1 - wx) +
+                 img[:, y0, x1_] * (1 - wy) * wx +
+                 img[:, y1_, x0] * wy * (1 - wx) +
+                 img[:, y1_, x1_] * wy * wx)
+            return v
+
+        def per_roi(bi, ys_r, xs_r):
+            img = feat[bi]  # c,h,w
+            # ys_r: (oh, 1, sr) xs_r: (ow, 1, sr) -> grid (oh, ow, sr, sr)
+            yy = ys_r[:, None, 0, :, None]  # oh,1,sr,1
+            xx = xs_r[None, :, 0, None, :]  # 1,ow,1,sr
+            yy = jnp.broadcast_to(yy, (oh, ow, sr, sr))
+            xx = jnp.broadcast_to(xx, (oh, ow, sr, sr))
+            vals = bilinear(img, yy, xx)  # c,oh,ow,sr,sr
+            return jnp.mean(vals, axis=(-1, -2))
+
+        out = jax.vmap(per_roi)(batch_idx, ys, xs)
+        return out
+    return apply_op("roi_align", _f, x, boxes, boxes_num)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    return roi_align(x, boxes, boxes_num, output_size, spatial_scale,
+                     sampling_ratio=1, aligned=False)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    raise NotImplementedError("psroi_pool planned (position-sensitive variant)")

@@ -1,0 +1,265 @@
+"""Vision transforms (numpy/host-side preprocessing).
+
+Parity: reference `python/paddle/vision/transforms/transforms.py` — the
+common subset used by the dataset pipelines.
+"""
+from __future__ import annotations
+
+import numbers
+import random
+
+import numpy as np
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "RandomCrop",
+           "CenterCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+           "Transpose", "Pad", "RandomResizedCrop", "BrightnessTransform",
+           "ContrastTransform", "to_tensor", "normalize", "resize", "hflip",
+           "vflip", "crop", "center_crop", "pad"]
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+def _chw(img):
+    a = np.asarray(img)
+    if a.ndim == 2:
+        a = a[:, :, None]
+    return a
+
+
+def to_tensor(pic, data_format="CHW"):
+    a = _chw(pic).astype(np.float32)
+    if a.max() > 1.5:
+        a = a / 255.0
+    if data_format == "CHW":
+        a = np.transpose(a, (2, 0, 1))
+    return a
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    a = np.asarray(img, np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        shape = (-1, 1, 1)
+    else:
+        shape = (1, 1, -1)
+    return (a - mean.reshape(shape)) / std.reshape(shape)
+
+
+def resize(img, size, interpolation="bilinear"):
+    a = _chw(img)
+    h, w = a.shape[:2]
+    if isinstance(size, int):
+        if h < w:
+            oh, ow = size, int(size * w / h)
+        else:
+            oh, ow = int(size * h / w), size
+    else:
+        oh, ow = size
+    ys = (np.arange(oh) + 0.5) * h / oh - 0.5
+    xs = (np.arange(ow) + 0.5) * w / ow - 0.5
+    ys = np.clip(ys, 0, h - 1)
+    xs = np.clip(xs, 0, w - 1)
+    if interpolation == "nearest":
+        out = a[np.round(ys).astype(int)[:, None], np.round(xs).astype(int)[None, :]]
+    else:
+        y0 = np.floor(ys).astype(int)
+        x0 = np.floor(xs).astype(int)
+        y1 = np.minimum(y0 + 1, h - 1)
+        x1 = np.minimum(x0 + 1, w - 1)
+        wy = (ys - y0)[:, None, None]
+        wx = (xs - x0)[None, :, None]
+        out = (a[y0][:, x0] * (1 - wy) * (1 - wx) + a[y0][:, x1] * (1 - wy) * wx +
+               a[y1][:, x0] * wy * (1 - wx) + a[y1][:, x1] * wy * wx)
+        out = out.astype(a.dtype) if np.issubdtype(a.dtype, np.floating) else \
+            np.clip(out, 0, 255).astype(a.dtype)
+    return out
+
+
+def hflip(img):
+    return np.asarray(img)[:, ::-1]
+
+
+def vflip(img):
+    return np.asarray(img)[::-1]
+
+
+def crop(img, top, left, height, width):
+    return _chw(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    a = _chw(img)
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    h, w = a.shape[:2]
+    th, tw = output_size
+    i = int(round((h - th) / 2.0))
+    j = int(round((w - tw) / 2.0))
+    return crop(a, i, j, th, tw)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    a = _chw(img)
+    if isinstance(padding, int):
+        padding = (padding, padding, padding, padding)
+    if len(padding) == 2:
+        padding = (padding[0], padding[1], padding[0], padding[1])
+    l, t, r, b = padding
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    return np.pad(a, ((t, b), (l, r), (0, 0)), mode=mode, **kw)
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        self.mean, self.std = mean, std
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size, self.interpolation = size, interpolation
+
+    def __call__(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class RandomCrop:
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        if isinstance(size, int):
+            size = (size, size)
+        self.size = size
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def __call__(self, img):
+        a = _chw(img)
+        if self.padding is not None:
+            a = pad(a, self.padding, self.fill, self.padding_mode)
+        h, w = a.shape[:2]
+        th, tw = self.size
+        if h == th and w == tw:
+            return a
+        i = random.randint(0, h - th)
+        j = random.randint(0, w - tw)
+        return crop(a, i, j, th, tw)
+
+
+class CenterCrop:
+    def __init__(self, size, keys=None):
+        self.size = size
+
+    def __call__(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        if random.random() < self.prob:
+            return hflip(img)
+        return np.asarray(img)
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        if random.random() < self.prob:
+            return vflip(img)
+        return np.asarray(img)
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def __call__(self, img):
+        return np.transpose(_chw(img), self.order)
+
+
+class Pad:
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        self.padding, self.fill, self.padding_mode = padding, fill, padding_mode
+
+    def __call__(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class RandomResizedCrop:
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3. / 4, 4. / 3),
+                 interpolation="bilinear", keys=None):
+        if isinstance(size, int):
+            size = (size, size)
+        self.size = size
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        a = _chw(img)
+        h, w = a.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = random.uniform(*self.scale) * area
+            ar = np.exp(random.uniform(np.log(self.ratio[0]), np.log(self.ratio[1])))
+            tw = int(round(np.sqrt(target_area * ar)))
+            th = int(round(np.sqrt(target_area / ar)))
+            if 0 < tw <= w and 0 < th <= h:
+                i = random.randint(0, h - th)
+                j = random.randint(0, w - tw)
+                return resize(crop(a, i, j, th, tw), self.size, self.interpolation)
+        return resize(center_crop(a, min(h, w)), self.size, self.interpolation)
+
+
+class BrightnessTransform:
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return np.asarray(img)
+        factor = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        a = np.asarray(img).astype(np.float32) * factor
+        return np.clip(a, 0, 255).astype(np.asarray(img).dtype)
+
+
+class ContrastTransform:
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return np.asarray(img)
+        factor = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        a = np.asarray(img).astype(np.float32)
+        mean = a.mean()
+        out = (a - mean) * factor + mean
+        return np.clip(out, 0, 255).astype(np.asarray(img).dtype)

@@ -1,0 +1,9 @@
+"""Test env: force an 8-device virtual CPU platform (SURVEY.md §4: the
+reference's multi-GPU tests map onto XLA host-platform device-count
+override)."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"

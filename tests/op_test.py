@@ -1,0 +1,101 @@
+"""OpTest harness.
+
+Parity: reference `test/legacy_test/op_test.py:418` — numpy-reference
+forward checks (`check_output`, :2124) and numeric finite-difference
+gradient checks (`check_grad`, :3114), plus an eager-vs-jit parity check
+standing in for the reference's eager/static/PIR triple run.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Sequence
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+def _to_np(x):
+    if isinstance(x, Tensor):
+        return np.asarray(x._data)
+    return np.asarray(x)
+
+
+def check_output(fn: Callable, np_fn: Callable, inputs: Sequence[np.ndarray],
+                 atol=1e-5, rtol=1e-5, kwargs=None):
+    """Run `fn` on Tensors and `np_fn` on numpy arrays; compare outputs."""
+    kwargs = kwargs or {}
+    tensors = [paddle.to_tensor(i) if isinstance(i, np.ndarray) else i
+               for i in inputs]
+    out = fn(*tensors, **kwargs)
+    ref = np_fn(*inputs, **kwargs)
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    refs = ref if isinstance(ref, (list, tuple)) else [ref]
+    assert len(outs) == len(refs), f"{len(outs)} outputs vs {len(refs)} refs"
+    for o, r in zip(outs, refs):
+        np.testing.assert_allclose(_to_np(o), np.asarray(r), atol=atol,
+                                   rtol=rtol)
+    return outs
+
+
+def check_grad(fn: Callable, inputs: Sequence[np.ndarray], grad_inputs=None,
+               eps=1e-4, atol=1e-3, rtol=1e-3, kwargs=None, reduce_fn=None):
+    """Numeric finite-difference vs analytic tape gradients (float64 for
+    the numeric side, as the reference harness does)."""
+    kwargs = kwargs or {}
+    grad_idx = list(range(len(inputs))) if grad_inputs is None else grad_inputs
+    f64_inputs = [np.asarray(i, np.float64) for i in inputs]
+
+    def scalar_fn(*arrs):
+        tensors = [paddle.to_tensor(a) for a in arrs]
+        out = fn(*tensors, **kwargs)
+        if reduce_fn is not None:
+            out = reduce_fn(out)
+        elif isinstance(out, (list, tuple)):
+            out = out[0]
+        s = out.sum() if out.size > 1 else out
+        return float(_to_np(s))
+
+    # analytic grads
+    tensors = [paddle.to_tensor(a, stop_gradient=(i not in grad_idx))
+               for i, a in enumerate(f64_inputs)]
+    out = fn(*tensors, **kwargs)
+    if reduce_fn is not None:
+        out = reduce_fn(out)
+    elif isinstance(out, (list, tuple)):
+        out = out[0]
+    loss = out.sum() if out.size > 1 else out
+    loss.backward()
+
+    for i in grad_idx:
+        analytic = _to_np(tensors[i].grad) if tensors[i].grad is not None \
+            else np.zeros_like(f64_inputs[i])
+        numeric = np.zeros_like(f64_inputs[i])
+        flat = f64_inputs[i].reshape(-1)
+        num_flat = numeric.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + eps
+            f_plus = scalar_fn(*f64_inputs)
+            flat[j] = orig - eps
+            f_minus = scalar_fn(*f64_inputs)
+            flat[j] = orig
+            num_flat[j] = (f_plus - f_minus) / (2 * eps)
+        np.testing.assert_allclose(
+            analytic, numeric, atol=atol, rtol=rtol,
+            err_msg=f"gradient mismatch for input {i}")
+
+
+def check_jit_parity(fn: Callable, inputs: Sequence[np.ndarray], atol=1e-6,
+                     kwargs=None):
+    """Eager vs to_static outputs must match (the reference's
+    eager/static-parity axis of OpTest)."""
+    kwargs = kwargs or {}
+    tensors = [paddle.to_tensor(i) for i in inputs]
+    eager = fn(*tensors, **kwargs)
+    jitted = paddle.jit.to_static(lambda *a: fn(*a, **kwargs))
+    compiled = jitted(*tensors)
+    e_list = eager if isinstance(eager, (list, tuple)) else [eager]
+    c_list = compiled if isinstance(compiled, (list, tuple)) else [compiled]
+    for e, c in zip(e_list, c_list):
+        np.testing.assert_allclose(_to_np(e), _to_np(c), atol=atol)

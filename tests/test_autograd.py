@@ -1,0 +1,167 @@
+"""Autograd engine tests (parity: reference test/legacy_test backward tests
++ fluid/eager/backward.cc semantics)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_grad_accumulation():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y1 = x * 2
+    y2 = x * 3
+    (y1 + y2).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+
+def test_backward_twice_raises():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    y.backward()
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0])  # stop_gradient=True
+    z = (x * y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_detach():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * 2
+    d = y.detach()
+    assert d.stop_gradient
+    z = (y * d).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [12.0])  # d treated as const
+
+
+def test_no_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._grad_node is None
+
+
+def test_no_grad_decorator():
+    @paddle.no_grad()
+    def fn(t):
+        return t * 2
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    assert fn(x).stop_gradient
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x * 3
+    (gx,) = paddle.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), [12.0])
+    assert x.grad is None  # grad() must not touch .grad
+
+
+def test_grad_intermediate():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    mid = x * 3
+    y = mid * mid
+    (gmid,) = paddle.grad(y, mid)
+    np.testing.assert_allclose(gmid.numpy(), [12.0])
+
+
+def test_grad_unused_raises():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    z = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        paddle.grad(y, z)
+    res = paddle.grad(x * 2, [z], allow_unused=True)
+    assert res[0] is None
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(np.array([[3.0, 1.0, 2.0]]), stop_gradient=False)
+    vals, idx = paddle.topk(x, k=2)
+    vals.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1.0, 0.0, 1.0]])
+
+
+def test_nonscalar_backward_needs_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        y.backward()
+    y.backward(paddle.to_tensor([1.0, 0.5]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 1.0])
+
+
+def test_diamond_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    a = x * 2
+    b = a * 3
+    c = a * 4
+    (b + c).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [14.0])
+
+
+def test_setitem_grad():
+    x = paddle.to_tensor(np.zeros(3, np.float32), stop_gradient=False)
+    v = paddle.to_tensor([5.0], stop_gradient=False)
+    y = x * 2
+    y[1] = v[0]
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 0.0, 2.0])
+    np.testing.assert_allclose(v.grad.numpy(), [1.0])
+
+
+def test_getitem_grad():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3),
+                         stop_gradient=False)
+    y = x[0, 1:]
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[0, 1, 1], [0, 0, 0]])
+
+
+def test_pylayer():
+    class Double(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, grad):
+            return grad * 2
+
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = Double.apply(x)
+    np.testing.assert_allclose(y.numpy(), [6.0])
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_clear_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).backward()
+    assert x.grad is not None
+    x.clear_grad()
+    assert x.grad is None

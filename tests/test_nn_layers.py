@@ -1,0 +1,279 @@
+"""nn.Layer system + layer forward/backward tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+rng = np.random.RandomState(7)
+
+
+def test_linear():
+    lin = nn.Linear(4, 3)
+    x = paddle.to_tensor(rng.randn(2, 4).astype(np.float32))
+    out = lin(x)
+    assert out.shape == [2, 3]
+    ref = x.numpy() @ lin.weight.numpy() + lin.bias.numpy()
+    np.testing.assert_allclose(out.numpy(), ref, atol=1e-5)
+
+
+def test_layer_registry():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8)
+            self.fc2 = nn.Linear(8, 2)
+
+        def forward(self, x):
+            return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+    net = Net()
+    params = net.parameters()
+    assert len(params) == 4
+    names = [n for n, _ in net.named_parameters()]
+    assert "fc1.weight" in names and "fc2.bias" in names
+    subs = dict(net.named_sublayers())
+    assert "fc1" in subs
+
+
+def test_state_dict_roundtrip():
+    net = nn.Sequential(nn.Linear(3, 5), nn.ReLU(), nn.Linear(5, 2))
+    sd = net.state_dict()
+    assert set(sd) == {"0.weight", "0.bias", "2.weight", "2.bias"}
+    net2 = nn.Sequential(nn.Linear(3, 5), nn.ReLU(), nn.Linear(5, 2))
+    net2.set_state_dict(sd)
+    for k in sd:
+        np.testing.assert_array_equal(sd[k].numpy(), net2.state_dict()[k].numpy())
+
+
+def test_save_load_state():
+    import tempfile, os
+    net = nn.Linear(3, 3)
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "model.pdparams")
+        paddle.save(net.state_dict(), path)
+        loaded = paddle.load(path)
+        net2 = nn.Linear(3, 3)
+        net2.set_state_dict(loaded)
+        np.testing.assert_array_equal(net.weight.numpy(), net2.weight.numpy())
+
+
+def test_train_eval_mode():
+    net = nn.Sequential(nn.Linear(3, 3), nn.Dropout(0.5))
+    net.eval()
+    assert not net[1].training
+    x = paddle.ones([4, 3])
+    out1 = net(x)
+    out2 = net(x)
+    np.testing.assert_array_equal(out1.numpy(), out2.numpy())
+    net.train()
+    assert net[1].training
+
+
+def test_dropout_train():
+    paddle.seed(0)
+    x = paddle.ones([1000])
+    out = nn.functional.dropout(x, p=0.5, training=True)
+    kept = (out.numpy() != 0).mean()
+    assert 0.4 < kept < 0.6
+    np.testing.assert_allclose(out.numpy()[out.numpy() != 0], 2.0)
+
+
+def test_conv2d():
+    conv = nn.Conv2D(3, 8, 3, padding=1)
+    x = paddle.to_tensor(rng.randn(2, 3, 8, 8).astype(np.float32))
+    out = conv(x)
+    assert out.shape == [2, 8, 8, 8]
+    out2 = conv(x)
+    loss = out2.sum()
+    loss.backward()
+    assert conv.weight.grad is not None
+    assert conv.weight.grad.shape == [8, 3, 3, 3]
+
+
+def test_conv2d_matches_manual():
+    conv = nn.Conv2D(1, 1, 2, bias_attr=False)
+    w = np.ones((1, 1, 2, 2), np.float32)
+    conv.weight._data = paddle.to_tensor(w)._data
+    x = paddle.to_tensor(np.arange(9, dtype=np.float32).reshape(1, 1, 3, 3))
+    out = conv(x)
+    expect = np.array([[[[0+1+3+4, 1+2+4+5], [3+4+6+7, 4+5+7+8]]]], np.float32)
+    np.testing.assert_allclose(out.numpy(), expect)
+
+
+def test_pool():
+    x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    out = nn.functional.max_pool2d(x, 2)
+    np.testing.assert_allclose(out.numpy().reshape(2, 2),
+                               [[5, 7], [13, 15]])
+    avg = nn.functional.avg_pool2d(x, 2)
+    np.testing.assert_allclose(avg.numpy().reshape(2, 2),
+                               [[2.5, 4.5], [10.5, 12.5]])
+
+
+def test_adaptive_pool():
+    x = paddle.to_tensor(rng.randn(2, 3, 7, 7).astype(np.float32))
+    out = nn.functional.adaptive_avg_pool2d(x, 1)
+    np.testing.assert_allclose(out.numpy().squeeze(),
+                               x.numpy().mean(axis=(2, 3)), atol=1e-5)
+
+
+def test_layernorm():
+    ln = nn.LayerNorm(8)
+    x = paddle.to_tensor(rng.randn(2, 4, 8).astype(np.float32))
+    out = ln(x)
+    np_out = out.numpy()
+    np.testing.assert_allclose(np_out.mean(-1), 0, atol=1e-5)
+    np.testing.assert_allclose(np_out.std(-1), 1, atol=1e-2)
+
+
+def test_rmsnorm():
+    rn = nn.RMSNorm(8)
+    x = paddle.to_tensor(rng.randn(2, 8).astype(np.float32))
+    out = rn(x)
+    a = x.numpy()
+    ref = a / np.sqrt((a ** 2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(out.numpy(), ref, atol=1e-5)
+
+
+def test_batchnorm_running_stats():
+    bn = nn.BatchNorm2D(3)
+    x = paddle.to_tensor((rng.randn(4, 3, 5, 5) * 2 + 1).astype(np.float32))
+    bn.train()
+    _ = bn(x)
+    assert not np.allclose(bn._mean.numpy(), 0)
+    bn.eval()
+    out = bn(x)
+    assert out.shape == [4, 3, 5, 5]
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    idx = paddle.to_tensor(np.array([[1, 2], [3, 4]]))
+    out = emb(idx)
+    assert out.shape == [2, 2, 4]
+    np.testing.assert_allclose(out.numpy()[0, 0], emb.weight.numpy()[1])
+
+
+def test_embedding_grad():
+    emb = nn.Embedding(5, 3)
+    idx = paddle.to_tensor(np.array([0, 1, 1]))
+    out = emb(idx)
+    out.sum().backward()
+    g = emb.weight.grad.numpy()
+    np.testing.assert_allclose(g[1], 2 * np.ones(3))
+    np.testing.assert_allclose(g[0], np.ones(3))
+    np.testing.assert_allclose(g[3], np.zeros(3))
+
+
+def test_cross_entropy():
+    logits = paddle.to_tensor(rng.randn(4, 5).astype(np.float32))
+    labels = paddle.to_tensor(np.array([0, 1, 2, 3]))
+    loss = nn.functional.cross_entropy(logits, labels)
+    lg = logits.numpy()
+    p = np.exp(lg) / np.exp(lg).sum(-1, keepdims=True)
+    ref = -np.log(p[np.arange(4), [0, 1, 2, 3]]).mean()
+    np.testing.assert_allclose(float(loss.numpy()), ref, rtol=1e-5)
+
+
+def test_cross_entropy_ignore_index():
+    logits = paddle.to_tensor(rng.randn(4, 5).astype(np.float32))
+    labels = paddle.to_tensor(np.array([0, -100, 2, -100]))
+    loss = nn.functional.cross_entropy(logits, labels, ignore_index=-100)
+    lg = logits.numpy()
+    p = np.exp(lg) / np.exp(lg).sum(-1, keepdims=True)
+    ref = -np.log(p[[0, 2], [0, 2]]).mean()
+    np.testing.assert_allclose(float(loss.numpy()), ref, rtol=1e-5)
+
+
+def test_mse_l1():
+    x = paddle.to_tensor(rng.randn(3, 4).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(3, 4).astype(np.float32))
+    np.testing.assert_allclose(
+        float(nn.functional.mse_loss(x, y).numpy()),
+        ((x.numpy() - y.numpy()) ** 2).mean(), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(nn.functional.l1_loss(x, y).numpy()),
+        np.abs(x.numpy() - y.numpy()).mean(), rtol=1e-5)
+
+
+def test_multihead_attention():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.to_tensor(rng.randn(2, 5, 16).astype(np.float32))
+    out = mha(x)
+    assert out.shape == [2, 5, 16]
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, 2)
+    x = paddle.to_tensor(rng.randn(2, 6, 16).astype(np.float32))
+    out = enc(x)
+    assert out.shape == [2, 6, 16]
+    # layers must NOT share parameters
+    w0 = enc.layers[0].linear1.weight
+    w1 = enc.layers[1].linear1.weight
+    assert w0 is not w1
+
+
+def test_lstm():
+    lstm = nn.LSTM(4, 8)
+    x = paddle.to_tensor(rng.randn(2, 5, 4).astype(np.float32))
+    out, (h, c) = lstm(x)
+    assert out.shape == [2, 5, 8]
+    assert h.shape == [1, 2, 8]
+    out.sum().backward()
+    assert lstm.rnns[0].cell.weight_ih.grad is not None
+
+
+def test_gru_bidirect():
+    gru = nn.GRU(4, 8, direction="bidirect")
+    x = paddle.to_tensor(rng.randn(2, 5, 4).astype(np.float32))
+    out, h = gru(x)
+    assert out.shape == [2, 5, 16]
+
+
+def test_sequential_and_layerlist():
+    s = nn.Sequential(nn.Linear(2, 3), nn.ReLU())
+    assert len(s) == 2
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 4
+    assert len(nn.Sequential(*ll).parameters()) == 8
+
+
+def test_hooks():
+    lin = nn.Linear(2, 2)
+    calls = []
+    h = lin.register_forward_post_hook(lambda l, i, o: calls.append(1))
+    lin(paddle.ones([1, 2]))
+    assert calls == [1]
+    h.remove()
+    lin(paddle.ones([1, 2]))
+    assert calls == [1]
+
+
+def test_flash_attention_parity():
+    """SDPA (pallas or jnp path) vs naive reference."""
+    from paddle_tpu.nn.functional import scaled_dot_product_attention
+    q = paddle.to_tensor(rng.randn(2, 8, 2, 16).astype(np.float32))
+    k = paddle.to_tensor(rng.randn(2, 8, 2, 16).astype(np.float32))
+    v = paddle.to_tensor(rng.randn(2, 8, 2, 16).astype(np.float32))
+    out = scaled_dot_product_attention(q, k, v, is_causal=True)
+    # naive reference
+    qn, kn, vn = [t.numpy().transpose(0, 2, 1, 3) for t in (q, k, v)]
+    scores = qn @ kn.transpose(0, 1, 3, 2) / np.sqrt(16)
+    mask = np.tril(np.ones((8, 8), bool))
+    scores = np.where(mask, scores, -np.inf)
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    ref = (p @ vn).transpose(0, 2, 1, 3)
+    np.testing.assert_allclose(out.numpy(), ref, atol=2e-3)
+
+
+def test_bf16_cast():
+    lin = nn.Linear(4, 4)
+    lin.bfloat16()
+    assert lin.weight.dtype == paddle.bfloat16
+    x = paddle.to_tensor(rng.randn(2, 4).astype(np.float32)).astype("bfloat16")
+    assert lin(x).dtype == paddle.bfloat16

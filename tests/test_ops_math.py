@@ -1,0 +1,171 @@
+"""Op parity tests: math / reduction / elementwise (OpTest pattern)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_output, check_grad
+
+rng = np.random.RandomState(42)
+
+
+UNARY_CASES = [
+    ("sqrt", np.abs(rng.randn(3, 4)).astype(np.float32) + 0.1, np.sqrt),
+    ("exp", rng.randn(3, 4).astype(np.float32), np.exp),
+    ("log", np.abs(rng.randn(3, 4)).astype(np.float32) + 0.5, np.log),
+    ("tanh", rng.randn(3, 4).astype(np.float32), np.tanh),
+    ("sin", rng.randn(3, 4).astype(np.float32), np.sin),
+    ("cos", rng.randn(3, 4).astype(np.float32), np.cos),
+    ("abs", rng.randn(3, 4).astype(np.float32), np.abs),
+    ("floor", rng.randn(3, 4).astype(np.float32) * 3, np.floor),
+    ("ceil", rng.randn(3, 4).astype(np.float32) * 3, np.ceil),
+    ("square", rng.randn(3, 4).astype(np.float32), np.square),
+    ("sigmoid", rng.randn(3, 4).astype(np.float32),
+     lambda x: 1 / (1 + np.exp(-x))),
+    ("erf", rng.randn(3, 4).astype(np.float32),
+     lambda x: __import__("scipy.special", fromlist=["erf"]).erf(x)
+     if _has_scipy() else None),
+]
+
+
+def _has_scipy():
+    try:
+        import scipy  # noqa
+        return True
+    except ImportError:
+        return False
+
+
+@pytest.mark.parametrize("name,x,ref", [c for c in UNARY_CASES if c[2] is not None],
+                         ids=[c[0] for c in UNARY_CASES if c[2] is not None])
+def test_unary_forward(name, x, ref):
+    if name == "sigmoid":
+        fn = paddle.nn.functional.sigmoid
+    else:
+        fn = getattr(paddle, name)
+    check_output(fn, ref, [x], atol=1e-5)
+
+
+@pytest.mark.parametrize("name", ["sqrt", "exp", "tanh", "sin", "square"])
+def test_unary_grad(name):
+    x = np.abs(rng.randn(2, 3)).astype(np.float64) + 0.5
+    check_grad(getattr(paddle, name), [x])
+
+
+BINARY_CASES = [
+    ("add", np.add), ("subtract", np.subtract), ("multiply", np.multiply),
+    ("divide", np.true_divide), ("maximum", np.maximum),
+    ("minimum", np.minimum), ("pow", np.power),
+]
+
+
+@pytest.mark.parametrize("name,ref", BINARY_CASES, ids=[c[0] for c in BINARY_CASES])
+def test_binary_forward(name, ref):
+    x = (rng.rand(3, 4) + 0.5).astype(np.float32)
+    y = (rng.rand(3, 4) + 0.5).astype(np.float32)
+    check_output(getattr(paddle, name), ref, [x, y], rtol=1e-5)
+
+
+def test_binary_broadcast():
+    x = rng.randn(3, 1, 4).astype(np.float32)
+    y = rng.randn(1, 5, 4).astype(np.float32)
+    check_output(paddle.add, np.add, [x, y])
+
+
+@pytest.mark.parametrize("name", ["add", "multiply", "divide"])
+def test_binary_grad(name):
+    x = (rng.rand(2, 3) + 0.5).astype(np.float64)
+    y = (rng.rand(2, 3) + 0.5).astype(np.float64)
+    check_grad(getattr(paddle, name), [x, y])
+
+
+@pytest.mark.parametrize("axis,keepdim", [(None, False), (0, False), (1, True),
+                                          ((0, 1), False)])
+def test_sum(axis, keepdim):
+    x = rng.randn(3, 4, 5).astype(np.float32)
+    check_output(lambda t: paddle.sum(t, axis=axis, keepdim=keepdim),
+                 lambda a: np.sum(a, axis=axis, keepdims=keepdim), [x])
+
+
+def test_mean_grad():
+    x = rng.randn(3, 4).astype(np.float64)
+    check_grad(lambda t: paddle.mean(t, axis=1), [x])
+
+
+def test_max_min_prod():
+    x = rng.randn(3, 4).astype(np.float32)
+    check_output(lambda t: paddle.max(t, axis=1), lambda a: np.max(a, axis=1), [x])
+    check_output(lambda t: paddle.min(t, axis=0), lambda a: np.min(a, axis=0), [x])
+    check_output(lambda t: paddle.prod(t, axis=1), lambda a: np.prod(a, axis=1), [x])
+
+
+def test_cumsum_cumprod():
+    x = rng.randn(3, 4).astype(np.float32)
+    check_output(lambda t: paddle.cumsum(t, axis=1),
+                 lambda a: np.cumsum(a, axis=1), [x])
+    check_output(lambda t: paddle.cumprod(t, dim=0),
+                 lambda a: np.cumprod(a, axis=0), [x])
+
+
+def test_logsumexp():
+    x = rng.randn(3, 4).astype(np.float32)
+    ref = np.log(np.sum(np.exp(x), axis=1))
+    check_output(lambda t: paddle.logsumexp(t, axis=1), lambda a: ref, [x])
+
+
+def test_clip():
+    x = rng.randn(3, 4).astype(np.float32)
+    check_output(lambda t: paddle.clip(t, -0.5, 0.5),
+                 lambda a: np.clip(a, -0.5, 0.5), [x])
+
+
+def test_std_var():
+    x = rng.randn(4, 5).astype(np.float32)
+    check_output(lambda t: paddle.std(t, axis=1),
+                 lambda a: np.std(a, axis=1, ddof=1), [x], rtol=1e-4)
+    check_output(lambda t: paddle.var(t, axis=0, unbiased=False),
+                 lambda a: np.var(a, axis=0), [x], rtol=1e-4)
+
+
+def test_matmul_forward_grad():
+    x = rng.randn(3, 4).astype(np.float64)
+    y = rng.randn(4, 5).astype(np.float64)
+    check_output(paddle.matmul, np.matmul, [x, y], atol=1e-10)
+    check_grad(paddle.matmul, [x, y])
+
+
+def test_matmul_transpose_flags():
+    x = rng.randn(4, 3).astype(np.float32)
+    y = rng.randn(5, 4).astype(np.float32)
+    check_output(lambda a, b: paddle.matmul(a, b, transpose_x=True, transpose_y=True),
+                 lambda a, b: a.T @ b.T, [x, y], rtol=1e-5)
+
+
+def test_dtype_promotion():
+    xi = paddle.to_tensor(np.arange(4, dtype=np.int32))
+    xf = paddle.to_tensor(np.ones(4, dtype=np.float32))
+    assert (xi + xf).dtype == paddle.float32
+    assert (xi + xi).dtype == paddle.int32
+
+
+def test_int64_default():
+    t = paddle.arange(5)
+    assert t.dtype == paddle.int64
+    t2 = paddle.to_tensor([1, 2, 3])
+    assert t2.dtype == paddle.int64
+
+
+def test_scale():
+    x = rng.randn(3).astype(np.float32)
+    check_output(lambda t: paddle.scale(t, scale=2.0, bias=1.0),
+                 lambda a: a * 2 + 1, [x])
+    check_output(lambda t: paddle.scale(t, scale=2.0, bias=1.0,
+                                        bias_after_scale=False),
+                 lambda a: (a + 1) * 2, [x])
+
+
+def test_inplace_ops():
+    x = paddle.to_tensor([1.0, 2.0])
+    x += 1
+    np.testing.assert_allclose(x.numpy(), [2.0, 3.0])
+    x.scale_(2.0)
+    np.testing.assert_allclose(x.numpy(), [4.0, 6.0])
