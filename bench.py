@@ -1,0 +1,127 @@
+"""Benchmark: Llama decoder pretraining step on the available chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+metric = Llama pretraining MFU (the BASELINE.md north star is >= 40% MFU);
+vs_baseline = MFU / 0.40. Also reports tokens/sec/chip inside the line's
+extra fields for the record.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def peak_flops_per_chip(device_kind: str) -> float:
+    """bf16 peak FLOP/s per chip by device kind."""
+    kind = device_kind.lower()
+    table = {
+        "v5 lite": 197e12, "v5e": 197e12, "v5litepod": 197e12,
+        "v5p": 459e12, "v5": 459e12,
+        "v4": 275e12,
+        "v6": 918e12, "v6e": 918e12, "trillium": 918e12,
+        "cpu": 1e12,  # nominal, CPU fallback is correctness-only
+    }
+    for k, v in table.items():
+        if k in kind:
+            return v
+    return 197e12
+
+
+def llama_step_flops(cfg, batch, seq):
+    """Training FLOPs/step: 6*N*tokens (fwd+bwd) + attention 12*L*s^2*h."""
+    # The input-embedding lookup performs no matmul FLOPs; only the LM
+    # head's vocab matmul counts toward the 6*N model.
+    n_matmul = (
+        cfg.vocab_size * cfg.hidden_size  # LM head
+        + cfg.num_hidden_layers * (
+            2 * cfg.hidden_size * cfg.hidden_size  # q,o
+            + 2 * cfg.hidden_size * (cfg.num_key_value_heads *
+                                     cfg.hidden_size // cfg.num_attention_heads)
+            + 3 * cfg.hidden_size * cfg.intermediate_size))
+    n_params = n_matmul + (0 if cfg.tie_word_embeddings
+                           else cfg.vocab_size * cfg.hidden_size)
+    tokens = batch * seq
+    dense = 6.0 * n_matmul * tokens
+    attn = 12.0 * cfg.num_hidden_layers * batch * seq * seq * cfg.hidden_size
+    return dense + attn, n_params
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    if on_tpu:
+        # ~0.8B-param config that fits one v5e chip (16GB HBM) with AdamW
+        # fp32 states + bf16 params/activations.
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1536,
+                          intermediate_size=4096, num_hidden_layers=18,
+                          num_attention_heads=12, num_key_value_heads=12,
+                          max_position_embeddings=2048)
+        batch, seq, iters = 4, 2048, 6
+    else:
+        cfg = LlamaConfig(vocab_size=1024, hidden_size=128,
+                          intermediate_size=256, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=4,
+                          max_position_embeddings=256)
+        batch, seq, iters = 2, 128, 3
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        model.bfloat16()
+    opt = paddle.optimizer.AdamW(3e-4, parameters=model.parameters(),
+                                 multi_precision=on_tpu)
+
+    def train_step(ids, labels):
+        loss = model(ids, labels=labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    step = paddle.jit.to_static(train_step, state_objects=[model, opt])
+
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    labels = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)))
+
+    # warmup (compile)
+    loss = step(ids, labels)
+    loss._data.block_until_ready()
+    step(ids, labels)._data.block_until_ready()
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(ids, labels)
+    loss._data.block_until_ready()
+    dt = (time.perf_counter() - t0) / iters
+
+    flops, n_params = llama_step_flops(cfg, batch, seq)
+    tokens_per_s = batch * seq / dt
+    peak = peak_flops_per_chip(getattr(dev, "device_kind", dev.platform))
+    mfu = flops / dt / peak
+
+    print(json.dumps({
+        "metric": "llama_pretrain_mfu",
+        "value": round(mfu, 4),
+        "unit": "fraction_of_peak",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "tokens_per_sec_per_chip": round(tokens_per_s, 1),
+        "step_time_s": round(dt, 4),
+        "n_params": int(n_params),
+        "loss": float(np.asarray(loss._data)),
+        "device": str(getattr(dev, "device_kind", dev.platform)),
+        "config": {"hidden": cfg.hidden_size, "layers": cfg.num_hidden_layers,
+                   "batch": batch, "seq": seq},
+    }))
+
+
+if __name__ == "__main__":
+    main()

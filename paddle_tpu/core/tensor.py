@@ -39,6 +39,11 @@ class Tensor:
         "_grad_out_idx",
         "name",
         "_is_param",
+        # distributed metadata (DistTensor-analog view, see distributed/)
+        "process_mesh",
+        "placements",
+        "_spec",
+        "_lr_scale",
         "__weakref__",
     )
 

@@ -1,0 +1,6 @@
+"""Model zoo: the config-ladder families (BASELINE.md).
+
+ResNet/VGG/MobileNet live in paddle_tpu.vision.models; this package holds
+the LLM/diffusion families.
+"""
+from .llama import LlamaConfig, LlamaModel, LlamaForCausalLM, llama_tiny, llama_3_8b  # noqa: F401

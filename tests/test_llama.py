@@ -1,0 +1,99 @@
+"""Llama flagship model tests."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+rng = np.random.RandomState(0)
+
+
+def _model():
+    paddle.seed(0)
+    return LlamaForCausalLM(llama_tiny())
+
+
+def test_forward_loss_magnitude():
+    model = _model()
+    cfg = model.cfg
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (2, 16)))
+    loss = model(ids, labels=ids)
+    # random init => loss ~= ln(vocab)
+    assert abs(float(loss.numpy()) - np.log(cfg.vocab_size)) < 1.0
+
+
+def test_label_shift():
+    """Predicting input_ids as labels must NOT be trivially easy (shifted)."""
+    model = _model()
+    cfg = model.cfg
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (2, 16)))
+    opt = paddle.optimizer.AdamW(5e-3, parameters=model.parameters())
+    losses = []
+    for _ in range(5):
+        loss = model(ids, labels=ids)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]  # memorizes the fixed sequence
+    # but step-0 loss must be ~ln(V): if unshifted, attention at position i
+    # sees token i and loss would already be much lower after 1 step
+    assert losses[0] > np.log(model.cfg.vocab_size) - 1.0
+
+
+def test_ignore_index_masked_mean():
+    model = _model()
+    cfg = model.cfg
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (2, 16)))
+    labels_full = ids
+    lab_np = np.asarray(ids.numpy())
+    lab_half = lab_np.copy()
+    lab_half[:, 8:] = -100
+    loss_full = float(model(ids, labels=labels_full).numpy())
+    loss_half = float(model(ids, labels=paddle.to_tensor(lab_half)).numpy())
+    # masked mean: same scale, not halved
+    assert loss_half > 0.5 * loss_full
+
+
+def test_generate_matches_full_forward():
+    """KV-cached decode must agree with teacher-forced argmax."""
+    model = _model()
+    cfg = model.cfg
+    prompt = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (1, 8)))
+    out = model.generate(prompt, max_new_tokens=4)
+    assert out.shape == [1, 12]
+    # greedy step-by-step with full forward (no cache)
+    import jax.numpy as jnp
+    from paddle_tpu.core.autograd import no_grad
+    with no_grad():
+        seq = prompt
+        for _ in range(4):
+            logits = model(seq)
+            nxt = paddle.Tensor(jnp.argmax(logits._data[:, -1, :], axis=-1)[:, None])
+            from paddle_tpu.ops.manipulation import concat
+            seq = concat([seq, nxt], axis=1)
+    np.testing.assert_array_equal(out.numpy(), seq.numpy())
+
+
+def test_gqa_shapes():
+    cfg = llama_tiny(num_attention_heads=4, num_key_value_heads=2)
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (1, 8)))
+    logits = model(ids)
+    assert logits.shape == [1, 8, cfg.vocab_size]
+
+
+def test_recompute_matches():
+    cfg = llama_tiny()
+    paddle.seed(0)
+    m1 = LlamaForCausalLM(cfg)
+    cfg2 = llama_tiny(recompute=True)
+    paddle.seed(0)
+    m2 = LlamaForCausalLM(cfg2)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (1, 8)))
+    l1 = m1(ids, labels=ids)
+    l2 = m2(ids, labels=ids)
+    np.testing.assert_allclose(float(l1.numpy()), float(l2.numpy()), rtol=1e-5)
+    l2.backward()
+    g = m2.model.layers[0].self_attn.q_proj.weight.grad
+    assert g is not None
