@@ -1,0 +1,2 @@
+"""Pallas TPU kernels (the phi/kernels/fusion equivalents, SURVEY.md A.2)."""
+from . import flash_attention  # noqa: F401

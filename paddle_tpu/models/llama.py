@@ -215,12 +215,18 @@ def _recompute_layer(layer, x, cos, sin):
     """Activation checkpointing via jax.checkpoint over the layer's pure fn
     (parity: fleet/recompute/recompute.py RecomputeFunction)."""
     from ..jit.api import functional_call
+    from ..kernels.flash_attention import _interpret_mode
+    from ..nn.functional.flash_attention import sdp_kernel
     sd = layer.state_dict()
     keys = list(sd)
+    # interpret-mode pallas calls can't be replayed by remat; real TPU keeps
+    # the flash kernel inside the checkpointed region.
+    use_flash = not _interpret_mode()
 
     def pure(params, xx, cc, ss):
-        return functional_call(layer, dict(zip(keys, params)),
-                               Tensor(xx), Tensor(cc), Tensor(ss))._data
+        with sdp_kernel(enable_flash=use_flash):
+            return functional_call(layer, dict(zip(keys, params)),
+                                   Tensor(xx), Tensor(cc), Tensor(ss))._data
 
     ck = jax.checkpoint(pure, static_argnums=())
     return apply_op("recompute_layer",

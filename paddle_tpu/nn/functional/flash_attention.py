@@ -59,11 +59,13 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     if can_pallas:
         try:
             from ...kernels import flash_attention as pallas_fa
+            pallas_fa.check_supported(
+                tuple(query.shape), tuple(key.shape), query.dtype)
             def _f(q, k, v):
                 return pallas_fa.flash_attention_bshd(q, k, v, causal=is_causal)
             return apply_op("flash_attention", _f, query, key, value)
-        except Exception:
-            pass
+        except ValueError:
+            pass  # unsupported shape: fall through to the XLA composition
     drop_key = rng_key() if (dropout_p > 0.0 and training) else None
     def _f(q, k, v, m):
         return _sdpa_ref(q, k, v, m, dropout_p, is_causal, drop_key, training)

@@ -1,0 +1,68 @@
+"""Pallas kernel tests (interpret mode on CPU; same kernels run compiled on
+TPU). Parity target: the fused-kernel pack of SURVEY.md A.2."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.kernels.flash_attention import flash_attention_bshd
+
+rng = np.random.RandomState(0)
+
+
+def _ref_attn(q, k, v, causal):
+    D = q.shape[-1]
+    qt, kt, vt = [jnp.swapaxes(x, 1, 2) for x in (q, k, v)]
+    s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) / np.sqrt(D)
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        m = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(m, s, -jnp.inf)
+    p = jax.nn.softmax(s, -1)
+    return jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", p, vt), 1, 2)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("shape", [(1, 32, 1, 16), (2, 64, 2, 32)])
+def test_flash_fwd(causal, shape):
+    B, S, H, D = shape
+    q = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+    out = flash_attention_bshd(q, k, v, causal=causal)
+    ref = _ref_attn(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_grad(causal):
+    B, S, H, D = 1, 32, 2, 16
+    q = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+    g1 = jax.grad(lambda *a: flash_attention_bshd(*a, causal=causal).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda *a: _ref_attn(*a, causal).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_flash_cross_attention_offset():
+    """Prefill-with-cache: Sq < Sk, causal mask offset by Sk-Sq."""
+    B, H, D = 1, 1, 16
+    Sq, Sk = 8, 32
+    q = jnp.asarray(rng.randn(B, Sq, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, Sk, H, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, Sk, H, D), jnp.float32)
+    out = flash_attention_bshd(q, k, v, causal=True)
+    ref = _ref_attn(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_unsupported_shape_raises():
+    from paddle_tpu.kernels.flash_attention import check_supported
+    with pytest.raises(ValueError):
+        check_supported((1, 32, 1, 20), (1, 32, 1, 20), jnp.float32)  # D%8
+    with pytest.raises(ValueError):
+        check_supported((1, 33, 1, 16), (1, 33, 1, 16), jnp.float32)  # S%8
