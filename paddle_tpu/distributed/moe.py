@@ -1,2 +1,172 @@
-"""Expert parallel MoE (placeholder)."""
-__all__ = []
+"""Expert parallelism (MoE) over the mesh.
+
+Parity: reference MoE stack — `python/paddle/incubate/distributed/models/
+moe/moe_layer.py:99,149,263` (MoEScatter/MoEGather alltoall PyLayers +
+MoELayer), gate zoo (`moe/gate/`), capacity/routing kernels
+(`phi/kernels/number_count_kernel.h`, limit_by_capacity,
+prune_gate_by_capacity, random_routing, moe_gate_dispatch/moe_combine),
+global_scatter/global_gather collectives.
+
+TPU-native: routing is dense and static-shaped (capacity-bounded one-hot
+dispatch einsums — the standard TPU MoE formulation), so XLA keeps
+everything on the MXU with no host sync; expert parallelism shards the
+expert dim of the dispatched tensor over the 'model'(EP) axis and GSPMD
+emits the all_to_all the reference issues via global_scatter/global_gather.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn.layer.layers import Layer
+from ..ops.dispatch import apply_op
+
+__all__ = ["TopKGate", "SwitchGate", "MoELayer", "moe_dispatch_combine",
+           "number_count", "limit_by_capacity"]
+
+
+def number_count(gate_idx, upper_range):
+    """Tokens per expert. Parity: phi number_count_kernel."""
+    def _f(idx):
+        return jnp.bincount(idx.reshape(-1), length=upper_range).astype(jnp.int64)
+    return apply_op("number_count", _f, gate_idx)
+
+
+def limit_by_capacity(expert_count, capacity, n_worker=1):
+    """Clamp per-expert token counts. Parity: phi limit_by_capacity."""
+    def _f(c):
+        cap = jnp.asarray(capacity)
+        return jnp.minimum(c, cap).astype(c.dtype)
+    return apply_op("limit_by_capacity", _f, expert_count)
+
+
+def _one_hot_dispatch(gates_arr, topk, capacity):
+    """Build dispatch/combine tensors from gate probabilities.
+
+    gates_arr: (tokens, experts) softmax probabilities.
+    Returns (dispatch (tokens, experts, capacity) bool-ish float,
+             combine (tokens, experts, capacity) float weights,
+             aux_loss scalar).
+    """
+    T, E = gates_arr.shape
+    # top-k expert choice per token
+    topk_val, topk_idx = jax.lax.top_k(gates_arr, topk)           # (T, k)
+    # renormalize chosen gate weights
+    topk_val = topk_val / jnp.maximum(
+        jnp.sum(topk_val, axis=-1, keepdims=True), 1e-9)
+
+    dispatch = jnp.zeros((T, E, capacity), gates_arr.dtype)
+    combine = jnp.zeros((T, E, capacity), gates_arr.dtype)
+    # position of each token within its expert's capacity buffer
+    for j in range(topk):
+        e_j = topk_idx[:, j]                                       # (T,)
+        onehot = jax.nn.one_hot(e_j, E, dtype=gates_arr.dtype)     # (T, E)
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot          # (T, E)
+        pos_tok = jnp.sum(pos, axis=1).astype(jnp.int32)           # (T,)
+        keep = pos_tok < capacity
+        cap_onehot = jax.nn.one_hot(jnp.where(keep, pos_tok, capacity),
+                                    capacity + 1,
+                                    dtype=gates_arr.dtype)[:, :capacity]
+        d_j = onehot[:, :, None] * cap_onehot[:, None, :]          # (T,E,C)
+        dispatch = dispatch + d_j
+        combine = combine + d_j * topk_val[:, j][:, None, None]
+
+    # load-balancing aux loss (GShard): E * sum_e mean(gates_e)*mean(frac_e)
+    me = jnp.mean(gates_arr, axis=0)
+    frac = jnp.mean(dispatch.sum(axis=2), axis=0)
+    aux = E * jnp.sum(me * frac)
+    return dispatch, combine, aux
+
+
+def moe_dispatch_combine(x, gates, topk, capacity):
+    """x: (tokens, d); gates: (tokens, experts). Returns (expert_inputs
+    (experts, capacity, d), combine, aux)."""
+    def _f(xx, gg):
+        dispatch, combine, aux = _one_hot_dispatch(gg, topk, capacity)
+        expert_in = jnp.einsum("tec,td->ecd", dispatch, xx)
+        return expert_in, combine, aux
+    return apply_op("moe_dispatch", _f, x, gates)
+
+
+class TopKGate(Layer):
+    """GShard-style top-k gate. Parity: moe/gate/gshard_gate.py."""
+
+    def __init__(self, d_model, num_experts, topk=2, capacity_factor=1.25):
+        super().__init__()
+        from ..nn import Linear
+        self.wg = Linear(d_model, num_experts, bias_attr=False)
+        self.topk = topk
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+
+    def forward(self, x):
+        logits = self.wg(x)
+        return F.softmax(logits, axis=-1)
+
+
+class SwitchGate(TopKGate):
+    """top-1 gate. Parity: moe/gate/switch_gate.py."""
+
+    def __init__(self, d_model, num_experts, capacity_factor=1.25):
+        super().__init__(d_model, num_experts, topk=1,
+                         capacity_factor=capacity_factor)
+
+
+class MoELayer(Layer):
+    """Mixture-of-experts layer. Parity: moe_layer.py MoELayer.
+
+    experts: LayerList of expert networks (identical structure). With an
+    'model'/EP mesh axis live, the (experts, capacity, d) dispatched tensor
+    is sharding-constrained on the expert dim, so XLA all_to_alls tokens to
+    the expert's owner — the global_scatter/global_gather path.
+    """
+
+    def __init__(self, d_model, experts=None, gate=None, num_experts=None,
+                 topk=2, capacity_factor=1.25, group=None,
+                 recompute_interval=0):
+        super().__init__()
+        from ..nn import LayerList
+        if experts is None:
+            raise ValueError("experts list required")
+        self.experts = experts if isinstance(experts, LayerList) else \
+            LayerList(list(experts))
+        self.num_experts = num_experts or len(self.experts)
+        self.gate = gate or TopKGate(d_model, self.num_experts, topk,
+                                     capacity_factor)
+        self.topk = getattr(self.gate, "topk", topk)
+        self.capacity_factor = capacity_factor
+        self.d_model = d_model
+        self.aux_loss = None
+
+    def forward(self, x):
+        from ..ops import manipulation as M
+        orig_shape = x.shape
+        tokens = 1
+        for s in orig_shape[:-1]:
+            tokens *= s
+        xf = M.reshape(x, [tokens, self.d_model])
+        gates = self.gate(xf)
+        capacity = max(1, int(self.capacity_factor * tokens * self.topk /
+                              self.num_experts))
+        expert_in, combine, aux = moe_dispatch_combine(xf, gates, self.topk,
+                                                       capacity)
+        self.aux_loss = aux
+        # EP sharding hint: expert dim over the model axis
+        from .fleet.mpu import _constraint
+        from jax.sharding import PartitionSpec as P
+        expert_in = apply_op(
+            "ep_shard", lambda a: _constraint(a, P("model", None, None)),
+            expert_in)
+        # run experts (static python loop -> XLA sees E parallel branches)
+        parts = M.split(expert_in, self.num_experts, axis=0)
+        outs = [self.experts[e](M.squeeze(parts[e], 0))
+                for e in range(self.num_experts)]
+        expert_out = M.stack(outs, axis=0)                 # (E, C, d)
+        out = apply_op("moe_combine",
+                       lambda c, eo: jnp.einsum("tec,ecd->td", c, eo),
+                       combine, expert_out)
+        return M.reshape(out, orig_shape)

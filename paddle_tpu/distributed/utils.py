@@ -1,2 +1,124 @@
-"""Distributed utils (tensor fusion etc. — next milestone)."""
-__all__ = []
+"""Distributed utilities: sequence-parallel helpers, grad fusion bookkeeping.
+
+Parity: reference `fleet/utils/sequence_parallel_utils.py` (ScatterOp/
+GatherOp/AllGatherOp/ReduceScatterOp + Column/RowSequenceParallelLinear),
+`fleet/utils/tensor_fusion_helper.py`, `fleet/utils/hybrid_parallel_util.py`.
+
+TPU-native: the SP scatter/gather PyLayers become sharding constraints on
+the sequence dim over the 'sep' axis (GSPMD inserts the all_gather /
+reduce_scatter); gradient fusion into flat buffers is unnecessary — XLA
+fuses the gradient psum across parameters at compile time.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..ops.dispatch import apply_op
+
+__all__ = ["scatter_to_sequence_parallel", "gather_from_sequence_parallel",
+           "mark_as_sequence_parallel_parameter",
+           "register_sequence_parallel_allreduce_hooks",
+           "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+           "fused_allreduce_gradients", "all_gather_parameters"]
+
+SEP_AXIS = "sep"
+
+
+def _constraint(spec):
+    from .fleet.mpu import _constraint as c
+
+    def fn(t):
+        return apply_op("sp_constraint", lambda a: c(a, spec), t)
+    return fn
+
+
+def scatter_to_sequence_parallel(x):
+    """Shard the sequence dim over 'sep' (parity: ScatterOp,
+    sequence_parallel_utils.py:85)."""
+    nd = len(x.shape)
+    spec = P(*([None] * 0 + ["sep" if i == 1 else None for i in range(nd)])) \
+        if nd >= 2 else P()
+    return _constraint(P(None, SEP_AXIS) if nd == 3 else spec)(x)
+
+
+def gather_from_sequence_parallel(x, need_grad=True):
+    """Replicate the sequence dim (parity: GatherOp/AllGatherOp)."""
+    nd = len(x.shape)
+    return _constraint(P(*([None] * nd)))(x)
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    parameter._sequence_parallel = True if not hasattr(parameter, "__slots__") \
+        else None
+    return parameter
+
+
+def register_sequence_parallel_allreduce_hooks(layer, accumulation_steps=1):
+    """No-op under GSPMD (grad reduction follows sharding); kept for API
+    parity (sequence_parallel_utils.py:192)."""
+    return layer
+
+
+class ColumnSequenceParallelLinear:
+    """Factory returning a ColumnParallelLinear whose input is
+    sequence-sharded (all_gather on entry emitted by GSPMD)."""
+
+    def __new__(cls, in_features, out_features, weight_attr=None,
+                has_bias=True, gather_output=False, name=None, **kw):
+        from .fleet.mpu import ColumnParallelLinear
+        layer = ColumnParallelLinear(in_features, out_features,
+                                     weight_attr=weight_attr,
+                                     has_bias=has_bias,
+                                     gather_output=gather_output)
+        orig_forward = layer.forward
+
+        def forward(x):
+            return orig_forward(gather_from_sequence_parallel(x))
+        layer.forward = forward
+        return layer
+
+
+class RowSequenceParallelLinear:
+    """RowParallelLinear whose output is scattered back onto the sequence
+    axis (reduce_scatter emitted by GSPMD)."""
+
+    def __new__(cls, in_features, out_features, weight_attr=None,
+                has_bias=True, input_is_parallel=True, name=None, **kw):
+        from .fleet.mpu import RowParallelLinear
+        layer = RowParallelLinear(in_features, out_features,
+                                  weight_attr=weight_attr, has_bias=has_bias,
+                                  input_is_parallel=input_is_parallel)
+        orig_forward = layer.forward
+
+        def forward(x):
+            return scatter_to_sequence_parallel(orig_forward(x))
+        layer.forward = forward
+        return layer
+
+
+def fused_allreduce_gradients(parameter_list, hcg=None):
+    """Parity: hybrid_parallel_util.fused_allreduce_gradients. In-trace with
+    a bound 'data' axis, pmean the grads; otherwise a no-op (GSPMD path)."""
+    from .collective import _axis_in_trace
+    if not _axis_in_trace("data"):
+        return
+    for p in parameter_list:
+        if p._grad_buffer is not None:
+            p._grad_buffer = jax.lax.pmean(p._grad_buffer, "data")
+
+
+def all_gather_parameters(parameters):
+    """Materialize replicated copies of sharded parameters (stage-3 gather)."""
+    from jax.sharding import NamedSharding
+    out = []
+    for p in parameters:
+        arr = p._data
+        sh = getattr(arr, "sharding", None)
+        if sh is not None and hasattr(sh, "mesh"):
+            arr = jax.device_put(arr, NamedSharding(sh.mesh,
+                                                    P(*([None] * arr.ndim))))
+        out.append(Tensor(arr))
+    return out

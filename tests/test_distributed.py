@@ -1,0 +1,265 @@
+"""Distributed suite tests on the 8-device virtual CPU mesh.
+
+Parity model: reference reshard matrix tests (test/auto_parallel/
+reshard_*.py), spmd tests, topology tests, sharding tests — run
+single-process SPMD (SURVEY.md §4 implication)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import Partial, ProcessMesh, Replicate, Shard
+from paddle_tpu.distributed.fleet import (CommunicateTopology,
+                                          DistributedStrategy,
+                                          HybridCommunicateGroup)
+
+rng = np.random.RandomState(0)
+
+
+# ---------------------------------------------------------------- topology
+def test_topology_ranks():
+    topo = CommunicateTopology(["data", "pipe", "sharding", "sep", "model"],
+                               [2, 2, 1, 1, 2])
+    assert topo.world_size() == 8
+    assert topo.get_rank(data=1, pipe=0, sharding=0, sep=0, model=1) == 5
+    assert topo.get_coord(5) == (1, 0, 0, 0, 1)
+    comm = topo.get_comm_list("model")
+    assert [0, 1] in comm and len(comm) == 4
+    fused = topo.get_fused_ranks(["data", "sep"])
+    assert len(fused) == 4  # pipe*sharding*model combos
+
+
+def test_hcg_accessors():
+    topo = CommunicateTopology(["data", "pipe", "sharding", "sep", "model"],
+                               [2, 1, 1, 1, 4])
+    hcg = HybridCommunicateGroup(topo, rank=5)
+    assert hcg.get_model_parallel_world_size() == 4
+    assert hcg.get_data_parallel_world_size() == 2
+    assert hcg.get_model_parallel_rank() == 1
+    assert hcg.mesh is not None
+    assert dict(hcg.mesh.shape)["model"] == 4
+
+
+# ----------------------------------------------------------- shard/reshard
+def _mesh2d():
+    return ProcessMesh(np.arange(8).reshape(2, 4), ["x", "y"])
+
+
+def test_shard_tensor_placements():
+    mesh = _mesh2d()
+    t = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+    d = dist.shard_tensor(t, mesh, [Shard(0), Shard(1)])
+    assert d.placements == [Shard(0), Shard(1)]
+    shard_shape = d._data.addressable_shards[0].data.shape
+    assert shard_shape == (4, 4)
+    np.testing.assert_allclose(np.asarray(d._data), t.numpy())
+
+
+def test_reshard_r_to_s_to_r():
+    mesh = _mesh2d()
+    t = paddle.to_tensor(rng.randn(8, 8).astype(np.float32))
+    d = dist.shard_tensor(t, mesh, [Replicate(), Replicate()])
+    s = dist.reshard(d, mesh, [Shard(0), Replicate()])
+    assert s._data.addressable_shards[0].data.shape == (4, 8)
+    r = dist.reshard(s, mesh, [Replicate(), Replicate()])
+    np.testing.assert_allclose(np.asarray(r._data), t.numpy())
+
+
+def test_reshard_s_to_s():
+    mesh = _mesh2d()
+    t = paddle.to_tensor(rng.randn(8, 8).astype(np.float32))
+    s0 = dist.shard_tensor(t, mesh, [Shard(0), Replicate()])
+    s1 = dist.reshard(s0, mesh, [Shard(1), Replicate()])
+    assert s1._data.addressable_shards[0].data.shape == (8, 4)
+    np.testing.assert_allclose(np.asarray(s1._data), t.numpy())
+
+
+def test_partial_to_replicate():
+    mesh = ProcessMesh(np.arange(4), ["x"])
+    locals_ = [np.full((2, 2), float(i), np.float32) for i in range(4)]
+    d = dist.dtensor_from_local([paddle.to_tensor(l) for l in locals_],
+                                mesh, [Partial()])
+    r = dist.reshard(d, mesh, [Replicate()])
+    np.testing.assert_allclose(np.asarray(r._data),
+                               np.full((2, 2), 0.0 + 1 + 2 + 3))
+
+
+def test_partial_to_shard():
+    mesh = ProcessMesh(np.arange(4), ["x"])
+    locals_ = [np.ones((4, 2), np.float32) * (i + 1) for i in range(4)]
+    d = dist.dtensor_from_local([paddle.to_tensor(l) for l in locals_],
+                                mesh, [Partial()])
+    s = dist.reshard(d, mesh, [Shard(0)])
+    assert s._data.addressable_shards[0].data.shape == (1, 2)
+    np.testing.assert_allclose(np.asarray(s._data), np.full((4, 2), 10.0))
+
+
+def test_dtensor_from_local_shards():
+    mesh = ProcessMesh(np.arange(4), ["x"])
+    locals_ = [np.full((2, 3), float(i), np.float32) for i in range(4)]
+    d = dist.dtensor_from_local([paddle.to_tensor(l) for l in locals_],
+                                mesh, [Shard(0)])
+    assert list(d._data.shape) == [8, 3]
+    full = np.asarray(d._data)
+    for i in range(4):
+        np.testing.assert_allclose(full[2 * i:2 * i + 2], locals_[i])
+
+
+def test_unshard_and_to_local():
+    mesh = ProcessMesh(np.arange(8), ["x"])
+    t = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+    d = dist.shard_tensor(t, mesh, [Shard(0)])
+    loc = dist.dtensor_to_local(d)
+    assert loc.shape == [1, 4]
+    u = dist.unshard_dtensor(d)
+    np.testing.assert_allclose(u.numpy(), t.numpy())
+
+
+# --------------------------------------------------------------- TP via GSPMD
+def test_tp_layers_sharded_train_step():
+    from paddle_tpu.distributed.fleet import fleet
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 4, "pp_degree": 1,
+                               "sharding_degree": 1, "sep_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    from paddle_tpu.distributed.fleet.mpu import (ColumnParallelLinear,
+                                                  RowParallelLinear)
+    paddle.seed(0)
+
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.c = ColumnParallelLinear(16, 32, gather_output=False)
+            self.r = RowParallelLinear(32, 16, input_is_parallel=True)
+
+        def forward(self, x):
+            return self.r(self.c(x))
+
+    net = Net()
+    # weight actually placed on the model axis
+    wsh = net.c.weight._data.sharding
+    assert "model" in str(wsh.spec)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=net.parameters())
+
+    def step(x, y):
+        loss = paddle.nn.functional.mse_loss(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    jstep = paddle.jit.to_static(step, state_objects=[net, opt])
+    hcg = fleet.get_hybrid_communicate_group()
+    mesh = hcg.mesh
+    x = paddle.Tensor(jax.device_put(
+        jnp.asarray(rng.randn(8, 16), jnp.float32),
+        NamedSharding(mesh, P("data", None))))
+    y = paddle.Tensor(jax.device_put(
+        jnp.asarray(rng.randn(8, 16), jnp.float32),
+        NamedSharding(mesh, P("data", None))))
+    l1 = float(np.asarray(jstep(x, y)._data))
+    l2 = float(np.asarray(jstep(x, y)._data))
+    assert np.isfinite(l1) and l2 < l1
+    # params keep their TP sharding after the compiled update
+    assert "model" in str(net.c.weight._data.sharding.spec)
+
+
+# ------------------------------------------------------------ ZeRO sharding
+def test_sharding_stage_policies():
+    from paddle_tpu.distributed.fleet import fleet
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+                               "sharding_degree": 8, "sep_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    hcg = fleet.get_hybrid_communicate_group()
+    paddle.seed(0)
+    net = paddle.nn.Linear(16, 16)
+    opt = paddle.optimizer.AdamW(1e-2, parameters=net.parameters())
+    model, sopt, _ = dist.sharding.group_sharded_parallel(net, opt, "p_g_os")
+    x = paddle.randn([4, 16])
+    loss = paddle.nn.functional.mse_loss(model(x), paddle.randn([4, 16]))
+    loss.backward()
+    sopt.step()
+    sopt.clear_grad()
+    # stage3: params sharded; accumulators sharded
+    w = net.weight._data
+    assert "sharding" in str(w.sharding.spec)
+    m1 = sopt._inner._accumulators["moment1"][0]
+    assert "sharding" in str(m1.sharding.spec)
+
+
+# ------------------------------------------------------------------- MoE
+def test_moe_layer_forward_backward():
+    paddle.seed(0)
+    from paddle_tpu.distributed.moe import MoELayer, TopKGate
+    d = 8
+    experts = [paddle.nn.Sequential(paddle.nn.Linear(d, 16), paddle.nn.ReLU(),
+                                    paddle.nn.Linear(16, d))
+               for _ in range(4)]
+    moe = MoELayer(d_model=d, experts=experts, topk=2, capacity_factor=2.0)
+    x = paddle.randn([2, 6, d])
+    out = moe(x)
+    assert out.shape == [2, 6, d]
+    assert moe.aux_loss is not None
+    (out.sum() + moe.aux_loss).backward()
+    assert moe.gate.wg.weight.grad is not None
+    assert experts[0][0].weight.grad is not None
+
+
+def test_moe_capacity_drops():
+    paddle.seed(0)
+    from paddle_tpu.distributed.moe import moe_dispatch_combine
+    x = paddle.randn([16, 4])
+    gates = paddle.nn.functional.softmax(paddle.randn([16, 3]), axis=-1)
+    expert_in, combine, aux = moe_dispatch_combine(x, gates, topk=1, capacity=2)
+    assert expert_in.shape == [3, 2, 4]
+    # combine weights: each token row sums to <= 1 (dropped tokens = 0)
+    w = np.asarray(combine._data).sum(axis=(1, 2))
+    assert (w <= 1.0 + 1e-5).all()
+
+
+def test_number_count_and_capacity():
+    from paddle_tpu.distributed.moe import limit_by_capacity, number_count
+    idx = paddle.to_tensor(np.array([0, 1, 1, 2, 2, 2]))
+    c = number_count(idx, 4)
+    np.testing.assert_array_equal(c.numpy(), [1, 2, 3, 0])
+    np.testing.assert_array_equal(limit_by_capacity(c, 2).numpy(), [1, 2, 2, 0])
+
+
+# -------------------------------------------------------------- checkpoint
+def test_sharded_checkpoint_roundtrip(tmp_path):
+    mesh = ProcessMesh(np.arange(8).reshape(2, 4), ["x", "y"])
+    t = paddle.to_tensor(rng.randn(8, 8).astype(np.float32))
+    d = dist.shard_tensor(t, mesh, [Shard(0), Replicate()])
+    sd = {"w": d, "b": paddle.to_tensor(np.arange(4, dtype=np.float32))}
+    dist.checkpoint.save_state_dict(sd, str(tmp_path / "ckpt"))
+    # load into a DIFFERENTLY sharded target (reshard-on-load)
+    t2 = paddle.zeros([8, 8])
+    d2 = dist.shard_tensor(t2, mesh, [Replicate(), Shard(1)])
+    sd2 = {"w": d2, "b": paddle.zeros([4])}
+    dist.checkpoint.load_state_dict(sd2, str(tmp_path / "ckpt"))
+    np.testing.assert_allclose(np.asarray(sd2["w"]._data), t.numpy())
+    assert sd2["w"]._data.addressable_shards[0].data.shape == (8, 2)
+    np.testing.assert_allclose(np.asarray(sd2["b"]._data), [0, 1, 2, 3])
+
+
+# -------------------------------------------------------- collectives in-trace
+def test_collectives_inside_shard_map():
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh
+    devs = np.asarray(jax.devices()[:4])
+    mesh = Mesh(devs, ("data",))
+    g = dist.new_group(list(range(4)), axis_name="data")
+
+    def fn(x):
+        t = paddle.Tensor(x)
+        dist.all_reduce(t, group=g)
+        return t._data
+
+    mapped = shard_map(fn, mesh=mesh, in_specs=P("data"), out_specs=P("data"))
+    x = jnp.arange(4.0)
+    out = mapped(x)
+    np.testing.assert_allclose(np.asarray(out), np.full(4, 6.0))
