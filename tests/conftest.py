@@ -7,3 +7,11 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+# The hosting image's sitecustomize force-registers a TPU platform and
+# overrides JAX_PLATFORMS at interpreter startup, so the env var alone is
+# not enough — pin the platform through the config API before any backend
+# is initialized.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
