@@ -38,5 +38,7 @@ from . import checkpoint  # noqa: F401
 from . import pipeline  # noqa: F401
 from . import moe  # noqa: F401
 from . import launch  # noqa: F401
+from . import context_parallel  # noqa: F401
+from .context_parallel import context_parallel_attention  # noqa: F401
 from . import rpc  # noqa: F401
 from . import utils as dist_utils  # noqa: F401

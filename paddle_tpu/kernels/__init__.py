@@ -1,2 +1,4 @@
 """Pallas TPU kernels (the phi/kernels/fusion equivalents, SURVEY.md A.2)."""
 from . import flash_attention  # noqa: F401
+from . import ring_attention  # noqa: F401
+from .ring_attention import ring_flash_attention, ulysses_attention  # noqa: F401

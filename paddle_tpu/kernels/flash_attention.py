@@ -107,17 +107,16 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k):
     return out, lse
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, *,
+def _dq_kernel(q_ref, k_ref, v_ref, delta_ref, do_ref, lse_ref, dq_ref, *,
                sm_scale, causal, block_k, q_offset):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    o = o_ref[0].astype(jnp.float32)
+    delta = delta_ref[0]                       # (bq,) = sum(do*o) per row
     lse = lse_ref[0]
     bq = q.shape[0]
     S = k_ref.shape[1]
     nk = S // block_k
-    delta = jnp.sum(do * o, axis=1)  # (bq,)
 
     def body(ki, dq):
         k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
@@ -140,8 +139,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, *,
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref, *,
-                sm_scale, causal, block_q, q_offset):
+def _dkv_kernel(q_ref, k_ref, v_ref, delta_ref, do_ref, lse_ref, dk_ref,
+                dv_ref, *, sm_scale, causal, block_q, q_offset):
     ki = pl.program_id(1)
     k = k_ref[0].astype(jnp.float32)          # (bk, D)
     v = v_ref[0].astype(jnp.float32)
@@ -153,9 +152,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref, *,
         dk, dv = carry
         q = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
         do = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-        o = o_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        delta = delta_ref[0, pl.ds(qi * block_q, block_q)]
         lse = lse_ref[0, pl.ds(qi * block_q, block_q)]
-        delta = jnp.sum(do * o, axis=1)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
@@ -182,6 +180,16 @@ def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref, *,
 
 def _bwd(sm_scale, causal, block_q, block_k, res, dout):
     q, k, v, out, lse = res
+    delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)
+    return _bwd_with_delta(sm_scale, causal, block_q, block_k,
+                           q, k, v, delta, lse, dout)
+
+
+def _bwd_with_delta(sm_scale, causal, block_q, block_k, q, k, v, delta, lse,
+                    dout):
+    """delta: (BH, Sq) f32 = sum(dout*out, -1) — precomputed so callers
+    (e.g. ring attention) need not carry the full output tensor."""
     BH, Sq, D = q.shape
     Sk = k.shape[1]
     q_offset = Sk - Sq
@@ -194,14 +202,14 @@ def _bwd(sm_scale, causal, block_q, block_k, res, dout):
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
         ],
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=_interpret_mode(),
-    )(q, k, v, out, dout, lse)
+    )(q, k, v, delta, dout, lse)
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
@@ -211,7 +219,7 @@ def _bwd(sm_scale, causal, block_q, block_k, res, dout):
             pl.BlockSpec((1, Sq, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, Sq, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Sq), lambda b, i: (b, 0)),
             pl.BlockSpec((1, Sq, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, Sq), lambda b, i: (b, 0)),
         ],
@@ -224,7 +232,7 @@ def _bwd(sm_scale, causal, block_q, block_k, res, dout):
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ],
         interpret=_interpret_mode(),
-    )(q, k, v, out, dout, lse)
+    )(q, k, v, delta, dout, lse)
     return dq, dk, dv
 
 
