@@ -1,0 +1,264 @@
+"""Native runtime loader.
+
+Compiles `src/paddle_tpu_native.cc` (CPython C API; no pybind11 in this
+image) into a cached shared object on first import and exposes:
+
+  * ``ShmRing``  — POSIX shared-memory MPSC ring buffer (DataLoader worker
+    batch transport; parity with the reference's shared-memory tensor
+    transport in `python/paddle/io/dataloader/worker.py` /
+    `paddle/fluid/memory/allocation/mmap_allocator.cc`).
+  * ``TCPStore`` — TCP rendezvous KV store (parity with
+    `paddle/phi/core/distributed/store/tcp_store.cc`).
+  * ``available()`` — whether the native extension loaded.
+
+If compilation fails (no toolchain), pure-Python fallbacks with the same
+API are provided so the framework stays functional.
+"""
+from __future__ import annotations
+
+import hashlib
+import importlib.util
+import os
+import subprocess
+import sys
+import sysconfig
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "src", "paddle_tpu_native.cc")
+_BUILD_DIR = os.path.join(_HERE, "_build")
+
+_native = None
+_native_err = None
+
+
+def _source_tag() -> str:
+    with open(_SRC, "rb") as f:
+        h = hashlib.sha256(f.read()).hexdigest()[:16]
+    return f"{h}-py{sys.version_info.major}{sys.version_info.minor}"
+
+
+def _build() -> str:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    so_path = os.path.join(_BUILD_DIR, f"_paddle_tpu_native-{_source_tag()}.so")
+    if os.path.exists(so_path):
+        return so_path
+    include = sysconfig.get_paths()["include"]
+    # per-pid temp + atomic rename: N ranks on one host may build
+    # concurrently and must not corrupt the shared cache entry
+    tmp = f"{so_path}.tmp.{os.getpid()}"
+    cmd = [
+        "g++", "-O2", "-fPIC", "-shared", "-std=c++17",
+        f"-I{include}", _SRC, "-o", tmp,
+        "-lpthread", "-lrt",
+    ]
+    subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+    os.replace(tmp, so_path)
+    return so_path
+
+
+def _load():
+    global _native, _native_err
+    if _native is not None or _native_err is not None:
+        return _native
+    if os.environ.get("PADDLE_TPU_DISABLE_NATIVE"):
+        _native_err = "disabled via PADDLE_TPU_DISABLE_NATIVE"
+        return None
+    try:
+        so_path = _build()
+        spec = importlib.util.spec_from_file_location(
+            "_paddle_tpu_native", so_path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _native = mod
+    except Exception as e:  # no toolchain / sandbox: fall back to python
+        _native_err = f"{type(e).__name__}: {e}"
+        if isinstance(e, subprocess.CalledProcessError):
+            _native_err += "\n" + e.stderr.decode(errors="replace")[-2000:]
+    return _native
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def load_error():
+    _load()
+    return _native_err
+
+
+# ---------------------------------------------------------------------------
+# Pure-Python fallbacks (same API)
+# ---------------------------------------------------------------------------
+
+class _PyTCPStore:
+    """socket-based fallback with the native TCPStore's API."""
+
+    def __init__(self, host, port, is_master=False, timeout_ms=120000):
+        import socket
+        import time
+        self._timeout = timeout_ms / 1000.0
+        self._lock = threading.Lock()       # server KV lock
+        self._cli_lock = threading.Lock()   # client request/reply framing
+        if is_master:
+            self._kv = {}
+            self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._srv.bind(("", port))
+            self._srv.listen(128)
+            threading.Thread(target=self._serve, daemon=True).start()
+            host = "127.0.0.1"
+        deadline = time.monotonic() + self._timeout
+        while True:
+            try:
+                self._sock = socket.create_connection((host, port), timeout=5)
+                self._sock.settimeout(self._timeout)
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"TCPStore connect({host}:{port})")
+                time.sleep(0.05)
+
+    # -- server side -------------------------------------------------------
+    def _serve(self):
+        while True:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn):
+        import struct
+        try:
+            while True:
+                hdr = self._recvn(conn, 5)
+                if hdr is None:
+                    return
+                op, klen = struct.unpack("<BI", hdr)
+                key = self._recvn(conn, klen).decode()
+                # recv any payload BEFORE taking the lock: a stalled
+                # client mid-SET must not block every other client
+                if op == 1:  # SET
+                    (vlen,) = struct.unpack("<I", self._recvn(conn, 4))
+                    val = self._recvn(conn, vlen) if vlen else b""
+                    with self._lock:
+                        self._kv[key] = val
+                    conn.sendall(b"\x01")
+                elif op == 2:  # GET
+                    with self._lock:
+                        v = self._kv.get(key)
+                    if v is None:
+                        conn.sendall(b"\x00")
+                    else:
+                        conn.sendall(b"\x01" + struct.pack("<I", len(v)) + v)
+                elif op == 3:  # ADD
+                    (delta,) = struct.unpack("<q", self._recvn(conn, 8))
+                    with self._lock:
+                        raw = self._kv.get(key, b"\x00" * 8)
+                        cur = struct.unpack("<q", raw)[0] if len(raw) == 8 \
+                            else 0
+                        new = cur + delta
+                        self._kv[key] = struct.pack("<q", new)
+                    conn.sendall(struct.pack("<q", new))
+                elif op == 4:  # CHECK
+                    with self._lock:
+                        found = key in self._kv
+                    conn.sendall(b"\x01" if found else b"\x00")
+                elif op == 5:  # DEL
+                    with self._lock:
+                        erased = self._kv.pop(key, None) is not None
+                    conn.sendall(b"\x01" if erased else b"\x00")
+                elif op == 6:  # NUMKEYS
+                    with self._lock:
+                        n = len(self._kv)
+                    conn.sendall(struct.pack("<I", n))
+                else:
+                    return
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _recvn(conn, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                return None if not buf else buf
+            buf += chunk
+        return buf
+
+    # -- client side -------------------------------------------------------
+    def _req(self, op, key, payload=b""):
+        import struct
+        k = key.encode()
+        self._sock.sendall(struct.pack("<BI", op, len(k)) + k + payload)
+
+    def set(self, key, value):
+        import struct
+        with self._cli_lock:
+            self._req(1, key, struct.pack("<I", len(value)) + value)
+            self._recvn(self._sock, 1)
+
+    def get(self, key, wait=True):
+        import struct
+        import time
+        deadline = time.monotonic() + self._timeout
+        while True:
+            with self._cli_lock:
+                self._req(2, key)
+                found = self._recvn(self._sock, 1)
+                if found == b"\x01":
+                    (vlen,) = struct.unpack(
+                        "<I", self._recvn(self._sock, 4))
+                    return self._recvn(self._sock, vlen) if vlen else b""
+            if not wait:
+                return None
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"get({key}) timed out")
+            time.sleep(0.01)
+
+    def add(self, key, delta):
+        import struct
+        with self._cli_lock:
+            self._req(3, key, struct.pack("<q", delta))
+            return struct.unpack("<q", self._recvn(self._sock, 8))[0]
+
+    def check(self, key):
+        with self._cli_lock:
+            self._req(4, key)
+            return self._recvn(self._sock, 1) == b"\x01"
+
+    def delete_key(self, key):
+        with self._cli_lock:
+            self._req(5, key)
+            return self._recvn(self._sock, 1) == b"\x01"
+
+    def num_keys(self):
+        import struct
+        with self._cli_lock:
+            self._req(6, "")
+            return struct.unpack("<I", self._recvn(self._sock, 4))[0]
+
+
+def ShmRing(name, capacity=0, create=False):
+    mod = _load()
+    if mod is None:
+        raise RuntimeError(
+            f"native ShmRing unavailable ({_native_err}); "
+            "use num_workers with the thread-pool path instead")
+    return mod.ShmRing(name, capacity=capacity, create=create)
+
+
+def TCPStore(host, port, is_master=False, timeout_ms=120000):
+    mod = _load()
+    if mod is None:
+        return _PyTCPStore(host, port, is_master=is_master,
+                           timeout_ms=timeout_ms)
+    return mod.TCPStore(host, port, is_master=is_master, timeout_ms=timeout_ms)
+
+
+__all__ = ["ShmRing", "TCPStore", "available", "load_error"]

@@ -1,0 +1,896 @@
+// paddle_tpu native runtime: C++ components for the host-side runtime.
+//
+// TPU-native equivalents of the reference's native runtime pieces:
+//   * ShmRing  — a POSIX shared-memory MPSC ring buffer used as the
+//     DataLoader worker->parent batch transport (parity with the reference's
+//     shared-memory LoDTensor transport used by
+//     python/paddle/io/dataloader/worker.py when use_shared_memory=True).
+//   * TCPStore — a TCP key/value rendezvous store (parity with
+//     paddle/phi/core/distributed/store/tcp_store.cc) used for process
+//     bootstrap by paddle_tpu.distributed. On TPU the collectives themselves
+//     are XLA's; only the bootstrap/rendezvous role survives, so the store
+//     is a stateless request/reply server (clients poll for blocking waits).
+//
+// Exposed as the CPython extension module `_paddle_tpu_native` (built with
+// the raw CPython C API; pybind11 is not available in this image).
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <pthread.h>
+#include <stdint.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdio>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// ShmRing: MPSC byte-message ring in POSIX shared memory.
+// Layout: [RingHeader][data bytes ...]; messages are [u64 len][payload],
+// written contiguously with wraparound (a message never straddles the end:
+// if it would, the writer pads with a SKIP marker and restarts at offset 0).
+// Synchronisation: one process-shared robust mutex + two condvars.
+// ---------------------------------------------------------------------------
+
+constexpr uint64_t kRingMagic = 0x70617474707572ULL;  // "pattpur"
+constexpr uint64_t kSkipMarker = ~0ULL;
+
+struct RingHeader {
+  uint64_t magic;
+  uint64_t capacity;   // bytes in data region
+  uint64_t head;       // write offset into data region (wrapped)
+  uint64_t tail;       // read offset into data region (wrapped)
+  uint64_t used;       // bytes currently occupied
+  uint64_t n_msgs;     // messages currently queued
+  pthread_mutex_t mutex;
+  pthread_cond_t not_empty;
+  pthread_cond_t not_full;
+};
+
+struct ShmRing {
+  PyObject_HEAD
+  char name[256];
+  int fd;
+  RingHeader* hdr;
+  uint8_t* data;
+  uint64_t capacity;
+  int creator;
+  int closed;
+};
+
+// All ring deadlines use CLOCK_MONOTONIC (condvars are initialised with
+// pthread_condattr_setclock) so NTP wall-clock steps cannot fire or
+// stretch timeouts mid-training.
+static void timespec_in_ms(struct timespec* ts, long ms) {
+  clock_gettime(CLOCK_MONOTONIC, ts);
+  ts->tv_sec += ms / 1000;
+  ts->tv_nsec += (ms % 1000) * 1000000L;
+  if (ts->tv_nsec >= 1000000000L) {
+    ts->tv_sec += 1;
+    ts->tv_nsec -= 1000000000L;
+  }
+}
+
+// Lock that recovers a robust mutex whose owner died (a killed DataLoader
+// worker must not wedge the parent).
+static int robust_timedlock(pthread_mutex_t* m, struct timespec* ts) {
+  int rc = pthread_mutex_clocklock(m, CLOCK_MONOTONIC, ts);
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(m);
+    rc = 0;
+  }
+  return rc;
+}
+
+// cond_timedwait re-acquires the mutex on return; if the previous owner
+// died it reports EOWNERDEAD, which must be recovered (not treated as a
+// timeout) or a later unlock would mark the mutex ENOTRECOVERABLE and
+// wedge the ring for every surviving process.
+static int robust_cond_timedwait(pthread_cond_t* c, pthread_mutex_t* m,
+                                 struct timespec* ts) {
+  int rc = pthread_cond_timedwait(c, m, ts);
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(m);
+    rc = 0;
+  }
+  return rc;
+}
+
+static PyObject* ShmRingError;
+
+static int ShmRing_init(ShmRing* self, PyObject* args, PyObject* kwds) {
+  const char* name;
+  unsigned long long capacity = 0;
+  int create = 0;
+  static const char* kwlist[] = {"name", "capacity", "create", nullptr};
+  if (!PyArg_ParseTupleAndKeywords(args, kwds, "s|Kp",
+                                   const_cast<char**>(kwlist), &name,
+                                   &capacity, &create))
+    return -1;
+  snprintf(self->name, sizeof(self->name), "%s", name);
+  self->creator = create;
+  self->closed = 0;
+  size_t total = 0;
+  if (create) {
+    if (capacity < 4096) {
+      PyErr_SetString(ShmRingError, "capacity must be >= 4096 bytes");
+      return -1;
+    }
+    shm_unlink(name);  // stale segment from a crashed run
+    self->fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+    if (self->fd < 0) {
+      PyErr_Format(ShmRingError, "shm_open(%s) failed: %s", name,
+                   strerror(errno));
+      return -1;
+    }
+    total = sizeof(RingHeader) + capacity;
+    if (ftruncate(self->fd, (off_t)total) != 0) {
+      PyErr_Format(ShmRingError, "ftruncate failed: %s", strerror(errno));
+      close(self->fd);
+      shm_unlink(name);
+      return -1;
+    }
+  } else {
+    self->fd = shm_open(name, O_RDWR, 0600);
+    if (self->fd < 0) {
+      PyErr_Format(ShmRingError, "shm_open(%s) failed: %s", name,
+                   strerror(errno));
+      return -1;
+    }
+    struct stat st;
+    if (fstat(self->fd, &st) != 0 || (size_t)st.st_size < sizeof(RingHeader)) {
+      PyErr_SetString(ShmRingError, "shm segment too small");
+      close(self->fd);
+      return -1;
+    }
+    total = (size_t)st.st_size;
+    capacity = total - sizeof(RingHeader);
+  }
+  void* mem =
+      mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, self->fd, 0);
+  if (mem == MAP_FAILED) {
+    PyErr_Format(ShmRingError, "mmap failed: %s", strerror(errno));
+    close(self->fd);
+    if (create) shm_unlink(name);
+    return -1;
+  }
+  self->hdr = (RingHeader*)mem;
+  self->data = (uint8_t*)mem + sizeof(RingHeader);
+  self->capacity = capacity;
+  if (create) {
+    memset(self->hdr, 0, sizeof(RingHeader));
+    self->hdr->capacity = capacity;
+    pthread_mutexattr_t ma;
+    pthread_mutexattr_init(&ma);
+    pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+    pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+    pthread_mutex_init(&self->hdr->mutex, &ma);
+    pthread_mutexattr_destroy(&ma);
+    pthread_condattr_t ca;
+    pthread_condattr_init(&ca);
+    pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+    pthread_condattr_setclock(&ca, CLOCK_MONOTONIC);
+    pthread_cond_init(&self->hdr->not_empty, &ca);
+    pthread_cond_init(&self->hdr->not_full, &ca);
+    pthread_condattr_destroy(&ca);
+    std::atomic_thread_fence(std::memory_order_seq_cst);
+    self->hdr->magic = kRingMagic;
+  } else if (self->hdr->magic != kRingMagic) {
+    PyErr_SetString(ShmRingError, "shm segment not initialised");
+    munmap(mem, total);
+    close(self->fd);
+    return -1;
+  }
+  return 0;
+}
+
+static void ShmRing_close_impl(ShmRing* self, int unlink_seg) {
+  if (self->closed) return;
+  self->closed = 1;
+  munmap((void*)self->hdr, sizeof(RingHeader) + self->capacity);
+  close(self->fd);
+  if (unlink_seg) shm_unlink(self->name);
+}
+
+static void ShmRing_dealloc(ShmRing* self) {
+  ShmRing_close_impl(self, 0);
+  Py_TYPE(self)->tp_free((PyObject*)self);
+}
+
+// Contiguous free bytes from head to either tail or end-of-region.
+static bool ring_fit(RingHeader* h, uint64_t need) {
+  uint64_t cap = h->capacity;
+  if (h->used + need > cap) return false;
+  uint64_t head = h->head;
+  uint64_t room_to_end = cap - head;
+  if (need <= room_to_end) return true;
+  // must pad to end (SKIP) and restart at 0
+  return h->used + room_to_end + need <= cap && need <= h->tail;
+}
+
+static PyObject* ShmRing_push(ShmRing* self, PyObject* args, PyObject* kwds) {
+  Py_buffer buf;
+  long timeout_ms = 30000;
+  static const char* kwlist[] = {"data", "timeout_ms", nullptr};
+  if (!PyArg_ParseTupleAndKeywords(args, kwds, "y*|l",
+                                   const_cast<char**>(kwlist), &buf,
+                                   &timeout_ms))
+    return nullptr;
+  uint64_t need = 8 + (uint64_t)buf.len;
+  if (need + 8 > self->capacity) {  // +8: room for a SKIP header
+    PyBuffer_Release(&buf);
+    PyErr_Format(ShmRingError,
+                 "message of %zd bytes exceeds ring capacity %llu "
+                 "(raise FLAGS_shm_ring_bytes)",
+                 buf.len, (unsigned long long)self->capacity);
+    return nullptr;
+  }
+  RingHeader* h = self->hdr;
+  int ok = 0;
+  Py_BEGIN_ALLOW_THREADS;
+  struct timespec ts;
+  timespec_in_ms(&ts, timeout_ms);
+  if (robust_timedlock(&h->mutex, &ts) == 0) {
+    int rc = 0;
+    while (!ring_fit(h, need) && rc == 0)
+      rc = robust_cond_timedwait(&h->not_full, &h->mutex, &ts);
+    if (rc == 0) {
+      uint64_t cap = h->capacity;
+      uint64_t head = h->head;
+      if (need > cap - head) {
+        // pad the tail-end with a skip marker; consume that space
+        if (cap - head >= 8) memcpy(self->data + head, &kSkipMarker, 8);
+        h->used += cap - head;
+        head = 0;
+      }
+      uint64_t len = (uint64_t)buf.len;
+      memcpy(self->data + head, &len, 8);
+      memcpy(self->data + head + 8, buf.buf, buf.len);
+      h->head = (head + need) % cap;
+      h->used += need;
+      h->n_msgs += 1;
+      ok = 1;
+      pthread_cond_signal(&h->not_empty);
+    }
+    pthread_mutex_unlock(&h->mutex);
+  }
+  Py_END_ALLOW_THREADS;
+  PyBuffer_Release(&buf);
+  if (!ok) Py_RETURN_FALSE;
+  Py_RETURN_TRUE;
+}
+
+static PyObject* ShmRing_pop(ShmRing* self, PyObject* args, PyObject* kwds) {
+  long timeout_ms = 30000;
+  static const char* kwlist[] = {"timeout_ms", nullptr};
+  if (!PyArg_ParseTupleAndKeywords(args, kwds, "|l",
+                                   const_cast<char**>(kwlist), &timeout_ms))
+    return nullptr;
+  RingHeader* h = self->hdr;
+  std::string payload;  // copied out under the lock: space may be reused
+                        // by a writer the moment `used` shrinks
+  int ok = 0;
+  Py_BEGIN_ALLOW_THREADS;
+  struct timespec ts;
+  timespec_in_ms(&ts, timeout_ms);
+  if (robust_timedlock(&h->mutex, &ts) == 0) {
+    int rc = 0;
+    while (h->n_msgs == 0 && rc == 0)
+      rc = robust_cond_timedwait(&h->not_empty, &h->mutex, &ts);
+    if (rc == 0) {
+      uint64_t cap = h->capacity;
+      uint64_t tail = h->tail;
+      if (cap - tail < 8) {
+        h->used -= cap - tail;
+        tail = 0;
+      } else {
+        uint64_t marker;
+        memcpy(&marker, self->data + tail, 8);
+        if (marker == kSkipMarker) {
+          h->used -= cap - tail;
+          tail = 0;
+        }
+      }
+      uint64_t len;
+      memcpy(&len, self->data + tail, 8);
+      payload.assign((const char*)(self->data + tail + 8), len);
+      h->tail = (tail + 8 + len) % cap;
+      h->used -= 8 + len;
+      h->n_msgs -= 1;
+      ok = 1;
+      pthread_cond_broadcast(&h->not_full);
+    }
+    pthread_mutex_unlock(&h->mutex);
+  }
+  Py_END_ALLOW_THREADS;
+  if (!ok) Py_RETURN_NONE;
+  return PyBytes_FromStringAndSize(payload.data(), (Py_ssize_t)payload.size());
+}
+
+static PyObject* ShmRing_qsize(ShmRing* self, PyObject*) {
+  return PyLong_FromUnsignedLongLong(self->hdr->n_msgs);
+}
+
+static PyObject* ShmRing_close(ShmRing* self, PyObject*) {
+  ShmRing_close_impl(self, 0);
+  Py_RETURN_NONE;
+}
+
+static PyObject* ShmRing_unlink(ShmRing* self, PyObject*) {
+  ShmRing_close_impl(self, 1);
+  Py_RETURN_NONE;
+}
+
+static PyMethodDef ShmRing_methods[] = {
+    {"push", (PyCFunction)ShmRing_push, METH_VARARGS | METH_KEYWORDS,
+     "push(data: bytes, timeout_ms=30000) -> bool"},
+    {"pop", (PyCFunction)ShmRing_pop, METH_VARARGS | METH_KEYWORDS,
+     "pop(timeout_ms=30000) -> bytes | None"},
+    {"qsize", (PyCFunction)ShmRing_qsize, METH_NOARGS, "queued message count"},
+    {"close", (PyCFunction)ShmRing_close, METH_NOARGS, "unmap"},
+    {"unlink", (PyCFunction)ShmRing_unlink, METH_NOARGS, "unmap + unlink"},
+    {nullptr, nullptr, 0, nullptr}};
+
+static PyTypeObject ShmRingType = []() {
+  PyTypeObject t = {PyVarObject_HEAD_INIT(nullptr, 0)};
+  t.tp_name = "_paddle_tpu_native.ShmRing";
+  t.tp_basicsize = sizeof(ShmRing);
+  t.tp_flags = Py_TPFLAGS_DEFAULT;
+  t.tp_doc = "POSIX shared-memory MPSC ring buffer";
+  t.tp_new = PyType_GenericNew;
+  t.tp_init = (initproc)ShmRing_init;
+  t.tp_dealloc = (destructor)ShmRing_dealloc;
+  t.tp_methods = ShmRing_methods;
+  return t;
+}();
+
+// ---------------------------------------------------------------------------
+// TCPStore
+// Protocol: request  = u8 op | u32 keylen | key | (op payload)
+//           ops: 1=SET(u32 vallen|val) 2=GET 3=ADD(i64) 4=CHECK 5=DEL
+//                6=NUMKEYS
+//           reply: SET -> u8(1); GET -> u8 found [u32 vallen|val];
+//                  ADD -> i64 newval; CHECK -> u8 found; DEL -> u8;
+//                  NUMKEYS -> u32
+// Blocking get/wait is client-side polling over CHECK/GET.
+// ---------------------------------------------------------------------------
+
+enum StoreOp : uint8_t {
+  OP_SET = 1,
+  OP_GET = 2,
+  OP_ADD = 3,
+  OP_CHECK = 4,
+  OP_DEL = 5,
+  OP_NUMKEYS = 6,
+};
+
+static bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = (const char*)buf;
+  while (n) {
+    ssize_t k = send(fd, p, n, MSG_NOSIGNAL);
+    if (k <= 0) {
+      if (k < 0 && (errno == EINTR)) continue;
+      return false;
+    }
+    p += k;
+    n -= (size_t)k;
+  }
+  return true;
+}
+
+static bool recv_all(int fd, void* buf, size_t n) {
+  char* p = (char*)buf;
+  while (n) {
+    ssize_t k = recv(fd, p, n, 0);
+    if (k <= 0) {
+      if (k < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += k;
+    n -= (size_t)k;
+  }
+  return true;
+}
+
+struct StoreServer {
+  int listen_fd = -1;
+  std::thread accept_thread;
+  std::atomic<bool> stop{false};
+  std::mutex mu;
+  std::unordered_map<std::string, std::string> kv;
+  std::mutex conn_mu;
+  std::vector<std::thread> conns;
+  std::vector<int> conn_fds;
+
+  void handle_conn(int fd) {
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    while (!stop.load()) {
+      uint8_t op;
+      uint32_t keylen;
+      if (!recv_all(fd, &op, 1) || !recv_all(fd, &keylen, 4)) break;
+      if (keylen > (1u << 20)) break;
+      std::string key(keylen, '\0');
+      if (keylen && !recv_all(fd, &key[0], keylen)) break;
+      bool alive = true;
+      switch (op) {
+        case OP_SET: {
+          uint32_t vallen;
+          if (!recv_all(fd, &vallen, 4)) { alive = false; break; }
+          std::string val(vallen, '\0');
+          if (vallen && !recv_all(fd, &val[0], vallen)) { alive = false; break; }
+          {
+            std::lock_guard<std::mutex> g(mu);
+            kv[key] = std::move(val);
+          }
+          uint8_t ok = 1;
+          alive = send_all(fd, &ok, 1);
+          break;
+        }
+        case OP_GET: {
+          std::string val;
+          uint8_t found = 0;
+          {
+            std::lock_guard<std::mutex> g(mu);
+            auto it = kv.find(key);
+            if (it != kv.end()) {
+              found = 1;
+              val = it->second;
+            }
+          }
+          alive = send_all(fd, &found, 1);
+          if (alive && found) {
+            uint32_t vallen = (uint32_t)val.size();
+            alive = send_all(fd, &vallen, 4) &&
+                    (vallen == 0 || send_all(fd, val.data(), vallen));
+          }
+          break;
+        }
+        case OP_ADD: {
+          int64_t delta;
+          if (!recv_all(fd, &delta, 8)) { alive = false; break; }
+          int64_t newval;
+          {
+            std::lock_guard<std::mutex> g(mu);
+            int64_t cur = 0;
+            auto it = kv.find(key);
+            if (it != kv.end() && it->second.size() == 8)
+              memcpy(&cur, it->second.data(), 8);
+            newval = cur + delta;
+            std::string v(8, '\0');
+            memcpy(&v[0], &newval, 8);
+            kv[key] = std::move(v);
+          }
+          alive = send_all(fd, &newval, 8);
+          break;
+        }
+        case OP_CHECK: {
+          uint8_t found;
+          {
+            std::lock_guard<std::mutex> g(mu);
+            found = kv.count(key) ? 1 : 0;
+          }
+          alive = send_all(fd, &found, 1);
+          break;
+        }
+        case OP_DEL: {
+          uint8_t erased;
+          {
+            std::lock_guard<std::mutex> g(mu);
+            erased = kv.erase(key) ? 1 : 0;
+          }
+          alive = send_all(fd, &erased, 1);
+          break;
+        }
+        case OP_NUMKEYS: {
+          uint32_t n;
+          {
+            std::lock_guard<std::mutex> g(mu);
+            n = (uint32_t)kv.size();
+          }
+          alive = send_all(fd, &n, 4);
+          break;
+        }
+        default:
+          alive = false;
+      }
+      if (!alive) break;
+    }
+    close(fd);
+  }
+
+  bool start(const char* host, int port, std::string* err) {
+    listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd < 0) {
+      *err = strerror(errno);
+      return false;
+    }
+    int one = 1;
+    setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons((uint16_t)port);
+    addr.sin_addr.s_addr =
+        host && *host ? inet_addr(host) : htonl(INADDR_ANY);
+    if (bind(listen_fd, (sockaddr*)&addr, sizeof(addr)) != 0 ||
+        listen(listen_fd, 128) != 0) {
+      *err = strerror(errno);
+      close(listen_fd);
+      listen_fd = -1;
+      return false;
+    }
+    accept_thread = std::thread([this]() {
+      while (!stop.load()) {
+        pollfd pfd{listen_fd, POLLIN, 0};
+        int pr = poll(&pfd, 1, 200);
+        if (pr <= 0) continue;
+        int fd = accept(listen_fd, nullptr, nullptr);
+        if (fd < 0) continue;
+        std::lock_guard<std::mutex> g(conn_mu);
+        conn_fds.push_back(fd);
+        conns.emplace_back([this, fd]() { handle_conn(fd); });
+      }
+    });
+    return true;
+  }
+
+  void shutdown() {
+    bool expected = false;
+    if (!stop.compare_exchange_strong(expected, true)) return;
+    if (accept_thread.joinable()) accept_thread.join();
+    if (listen_fd >= 0) close(listen_fd);
+    {
+      // unblock handler threads stuck in recv()
+      std::lock_guard<std::mutex> g(conn_mu);
+      for (int fd : conn_fds) ::shutdown(fd, SHUT_RDWR);
+    }
+    for (auto& t : conns)
+      if (t.joinable()) t.join();
+    conns.clear();
+    conn_fds.clear();
+  }
+};
+
+struct TCPStore {
+  PyObject_HEAD
+  StoreServer* server;  // non-null on master
+  int fd;               // client connection
+  long timeout_ms;
+  // serialises request/reply transactions: the store object is a
+  // process-wide singleton used from several Python threads (heartbeats,
+  // barriers) and the GIL is released around socket IO
+  pthread_mutex_t io_mu;
+};
+
+struct IoGuard {
+  pthread_mutex_t* m;
+  explicit IoGuard(pthread_mutex_t* mu) : m(mu) { pthread_mutex_lock(m); }
+  ~IoGuard() { pthread_mutex_unlock(m); }
+};
+
+static PyObject* TCPStoreError;
+
+static int connect_with_retry(const char* host, int port, long timeout_ms) {
+  struct timespec start;
+  clock_gettime(CLOCK_MONOTONIC, &start);
+  for (;;) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons((uint16_t)port);
+    hostent* he = gethostbyname(host);
+    if (he)
+      memcpy(&addr.sin_addr, he->h_addr_list[0], he->h_length);
+    else
+      addr.sin_addr.s_addr = inet_addr(host);
+    if (connect(fd, (sockaddr*)&addr, sizeof(addr)) == 0) {
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      return fd;
+    }
+    close(fd);
+    struct timespec now;
+    clock_gettime(CLOCK_MONOTONIC, &now);
+    long elapsed = (now.tv_sec - start.tv_sec) * 1000 +
+                   (now.tv_nsec - start.tv_nsec) / 1000000;
+    if (elapsed > timeout_ms) return -1;
+    usleep(50 * 1000);
+  }
+}
+
+static int TCPStore_init(TCPStore* self, PyObject* args, PyObject* kwds) {
+  const char* host;
+  int port;
+  int is_master = 0;
+  long timeout_ms = 120000;
+  static const char* kwlist[] = {"host", "port", "is_master", "timeout_ms",
+                                 nullptr};
+  if (!PyArg_ParseTupleAndKeywords(args, kwds, "si|pl",
+                                   const_cast<char**>(kwlist), &host, &port,
+                                   &is_master, &timeout_ms))
+    return -1;
+  self->server = nullptr;
+  self->fd = -1;
+  self->timeout_ms = timeout_ms;
+  pthread_mutex_init(&self->io_mu, nullptr);
+  if (is_master) {
+    self->server = new StoreServer();
+    std::string err;
+    bool ok;
+    Py_BEGIN_ALLOW_THREADS;
+    ok = self->server->start(nullptr, port, &err);
+    Py_END_ALLOW_THREADS;
+    if (!ok) {
+      PyErr_Format(TCPStoreError, "TCPStore bind(%s:%d) failed: %s", host,
+                   port, err.c_str());
+      delete self->server;
+      self->server = nullptr;
+      return -1;
+    }
+  }
+  int fd;
+  Py_BEGIN_ALLOW_THREADS;
+  fd = connect_with_retry(is_master ? "127.0.0.1" : host, port, timeout_ms);
+  Py_END_ALLOW_THREADS;
+  if (fd < 0) {
+    PyErr_Format(TCPStoreError, "TCPStore connect(%s:%d) timed out", host,
+                 port);
+    return -1;
+  }
+  self->fd = fd;
+  return 0;
+}
+
+static void TCPStore_dealloc(TCPStore* self) {
+  pthread_mutex_destroy(&self->io_mu);
+  if (self->fd >= 0) close(self->fd);
+  if (self->server) {
+    Py_BEGIN_ALLOW_THREADS;
+    self->server->shutdown();
+    Py_END_ALLOW_THREADS;
+    delete self->server;
+  }
+  Py_TYPE(self)->tp_free((PyObject*)self);
+}
+
+static bool store_send_req(TCPStore* self, uint8_t op, const char* key,
+                           Py_ssize_t keylen, const void* payload,
+                           size_t paylen) {
+  uint32_t kl = (uint32_t)keylen;
+  return send_all(self->fd, &op, 1) && send_all(self->fd, &kl, 4) &&
+         (kl == 0 || send_all(self->fd, key, kl)) &&
+         (paylen == 0 || send_all(self->fd, payload, paylen));
+}
+
+static PyObject* TCPStore_set(TCPStore* self, PyObject* args) {
+  const char* key;
+  Py_ssize_t keylen;
+  Py_buffer val;
+  if (!PyArg_ParseTuple(args, "s#y*", &key, &keylen, &val)) return nullptr;
+  bool ok;
+  Py_BEGIN_ALLOW_THREADS;
+  IoGuard g(&self->io_mu);
+  uint32_t vallen = (uint32_t)val.len;
+  ok = store_send_req(self, OP_SET, key, keylen, nullptr, 0) &&
+       send_all(self->fd, &vallen, 4) &&
+       (vallen == 0 || send_all(self->fd, val.buf, vallen));
+  uint8_t ack;
+  ok = ok && recv_all(self->fd, &ack, 1);
+  Py_END_ALLOW_THREADS;
+  PyBuffer_Release(&val);
+  if (!ok) {
+    PyErr_SetString(TCPStoreError, "set: connection lost");
+    return nullptr;
+  }
+  Py_RETURN_NONE;
+}
+
+// returns: 1 found, 0 not found, -1 connection error
+static int store_get_once(TCPStore* self, const char* key, Py_ssize_t keylen,
+                          std::string* out) {
+  if (!store_send_req(self, OP_GET, key, keylen, nullptr, 0)) return -1;
+  uint8_t found;
+  if (!recv_all(self->fd, &found, 1)) return -1;
+  if (!found) return 0;
+  uint32_t vallen;
+  if (!recv_all(self->fd, &vallen, 4)) return -1;
+  out->resize(vallen);
+  if (vallen && !recv_all(self->fd, &(*out)[0], vallen)) return -1;
+  return 1;
+}
+
+static PyObject* TCPStore_get(TCPStore* self, PyObject* args, PyObject* kwds) {
+  const char* key;
+  Py_ssize_t keylen;
+  int wait = 1;
+  static const char* kwlist[] = {"key", "wait", nullptr};
+  if (!PyArg_ParseTupleAndKeywords(args, kwds, "s#|p",
+                                   const_cast<char**>(kwlist), &key, &keylen,
+                                   &wait))
+    return nullptr;
+  std::string val;
+  int rc = 0;
+  Py_BEGIN_ALLOW_THREADS;
+  struct timespec start;
+  clock_gettime(CLOCK_MONOTONIC, &start);
+  for (;;) {
+    {
+      IoGuard g(&self->io_mu);
+      rc = store_get_once(self, key, keylen, &val);
+    }
+    if (rc != 0 || !wait) break;
+    struct timespec now;
+    clock_gettime(CLOCK_MONOTONIC, &now);
+    long elapsed = (now.tv_sec - start.tv_sec) * 1000 +
+                   (now.tv_nsec - start.tv_nsec) / 1000000;
+    if (elapsed > self->timeout_ms) {
+      rc = -2;
+      break;
+    }
+    usleep(10 * 1000);
+  }
+  Py_END_ALLOW_THREADS;
+  if (rc == -1) {
+    PyErr_SetString(TCPStoreError, "get: connection lost");
+    return nullptr;
+  }
+  if (rc == -2) {
+    PyErr_Format(PyExc_TimeoutError, "get(%s) timed out after %ld ms", key,
+                 self->timeout_ms);
+    return nullptr;
+  }
+  if (rc == 0) Py_RETURN_NONE;
+  return PyBytes_FromStringAndSize(val.data(), (Py_ssize_t)val.size());
+}
+
+static PyObject* TCPStore_add(TCPStore* self, PyObject* args) {
+  const char* key;
+  Py_ssize_t keylen;
+  long long delta;
+  if (!PyArg_ParseTuple(args, "s#L", &key, &keylen, &delta)) return nullptr;
+  int64_t d = (int64_t)delta, newval = 0;
+  bool ok;
+  Py_BEGIN_ALLOW_THREADS;
+  IoGuard g(&self->io_mu);
+  ok = store_send_req(self, OP_ADD, key, keylen, &d, 8) &&
+       recv_all(self->fd, &newval, 8);
+  Py_END_ALLOW_THREADS;
+  if (!ok) {
+    PyErr_SetString(TCPStoreError, "add: connection lost");
+    return nullptr;
+  }
+  return PyLong_FromLongLong(newval);
+}
+
+static PyObject* TCPStore_check(TCPStore* self, PyObject* args) {
+  const char* key;
+  Py_ssize_t keylen;
+  if (!PyArg_ParseTuple(args, "s#", &key, &keylen)) return nullptr;
+  uint8_t found = 0;
+  bool ok;
+  Py_BEGIN_ALLOW_THREADS;
+  IoGuard g(&self->io_mu);
+  ok = store_send_req(self, OP_CHECK, key, keylen, nullptr, 0) &&
+       recv_all(self->fd, &found, 1);
+  Py_END_ALLOW_THREADS;
+  if (!ok) {
+    PyErr_SetString(TCPStoreError, "check: connection lost");
+    return nullptr;
+  }
+  return PyBool_FromLong(found);
+}
+
+static PyObject* TCPStore_delete_key(TCPStore* self, PyObject* args) {
+  const char* key;
+  Py_ssize_t keylen;
+  if (!PyArg_ParseTuple(args, "s#", &key, &keylen)) return nullptr;
+  uint8_t erased = 0;
+  bool ok;
+  Py_BEGIN_ALLOW_THREADS;
+  IoGuard g(&self->io_mu);
+  ok = store_send_req(self, OP_DEL, key, keylen, nullptr, 0) &&
+       recv_all(self->fd, &erased, 1);
+  Py_END_ALLOW_THREADS;
+  if (!ok) {
+    PyErr_SetString(TCPStoreError, "delete_key: connection lost");
+    return nullptr;
+  }
+  return PyBool_FromLong(erased);
+}
+
+static PyObject* TCPStore_num_keys(TCPStore* self, PyObject*) {
+  uint32_t n = 0;
+  bool ok;
+  Py_BEGIN_ALLOW_THREADS;
+  IoGuard g(&self->io_mu);
+  ok = store_send_req(self, OP_NUMKEYS, "", 0, nullptr, 0) &&
+       recv_all(self->fd, &n, 4);
+  Py_END_ALLOW_THREADS;
+  if (!ok) {
+    PyErr_SetString(TCPStoreError, "num_keys: connection lost");
+    return nullptr;
+  }
+  return PyLong_FromUnsignedLong(n);
+}
+
+static PyMethodDef TCPStore_methods[] = {
+    {"set", (PyCFunction)TCPStore_set, METH_VARARGS,
+     "set(key: str, value: bytes)"},
+    {"get", (PyCFunction)TCPStore_get, METH_VARARGS | METH_KEYWORDS,
+     "get(key, wait=True) -> bytes | None (polls until timeout when wait)"},
+    {"add", (PyCFunction)TCPStore_add, METH_VARARGS,
+     "add(key, delta) -> new i64 value"},
+    {"check", (PyCFunction)TCPStore_check, METH_VARARGS,
+     "check(key) -> bool"},
+    {"delete_key", (PyCFunction)TCPStore_delete_key, METH_VARARGS,
+     "delete_key(key) -> bool"},
+    {"num_keys", (PyCFunction)TCPStore_num_keys, METH_NOARGS,
+     "num_keys() -> int"},
+    {nullptr, nullptr, 0, nullptr}};
+
+static PyTypeObject TCPStoreType = []() {
+  PyTypeObject t = {PyVarObject_HEAD_INIT(nullptr, 0)};
+  t.tp_name = "_paddle_tpu_native.TCPStore";
+  t.tp_basicsize = sizeof(TCPStore);
+  t.tp_flags = Py_TPFLAGS_DEFAULT;
+  t.tp_doc = "TCP key/value rendezvous store (master serves; others connect)";
+  t.tp_new = PyType_GenericNew;
+  t.tp_init = (initproc)TCPStore_init;
+  t.tp_dealloc = (destructor)TCPStore_dealloc;
+  t.tp_methods = TCPStore_methods;
+  return t;
+}();
+
+// ---------------------------------------------------------------------------
+
+static PyModuleDef native_module = {PyModuleDef_HEAD_INIT,
+                                    "_paddle_tpu_native",
+                                    "paddle_tpu native runtime components",
+                                    -1,
+                                    nullptr,
+                                    nullptr,
+                                    nullptr,
+                                    nullptr,
+                                    nullptr};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__paddle_tpu_native(void) {
+  if (PyType_Ready(&ShmRingType) < 0) return nullptr;
+  if (PyType_Ready(&TCPStoreType) < 0) return nullptr;
+  PyObject* m = PyModule_Create(&native_module);
+  if (!m) return nullptr;
+  ShmRingError =
+      PyErr_NewException("_paddle_tpu_native.ShmRingError", nullptr, nullptr);
+  TCPStoreError =
+      PyErr_NewException("_paddle_tpu_native.TCPStoreError", nullptr, nullptr);
+  PyModule_AddObject(m, "ShmRingError", ShmRingError);
+  PyModule_AddObject(m, "TCPStoreError", TCPStoreError);
+  Py_INCREF(&ShmRingType);
+  PyModule_AddObject(m, "ShmRing", (PyObject*)&ShmRingType);
+  Py_INCREF(&TCPStoreType);
+  PyModule_AddObject(m, "TCPStore", (PyObject*)&TCPStoreType);
+  PyModule_AddStringConstant(m, "__version__", "0.1");
+  return m;
+}

@@ -13,9 +13,72 @@ import os
 import jax
 
 __all__ = ["get_rank", "get_world_size", "init_parallel_env",
-           "is_initialized", "ParallelEnv"]
+           "is_initialized", "ParallelEnv", "create_store", "barrier_store"]
 
 _initialized = [False]
+_store = [None]
+
+
+def create_store(endpoint=None, rank=None, timeout_ms=120000):
+    """Native TCPStore rendezvous KV (parity: reference
+    `phi/core/distributed/store/tcp_store.cc`, created in
+    `python/paddle/distributed/parallel.py:1134-1143`). On TPU the PJRT
+    coordination service does collective bootstrap; this store carries the
+    remaining roles: launch/elastic KV, barriers, user rendezvous.
+
+    Process-wide singleton: a second call must use the same endpoint (or
+    none); conflicting endpoints raise instead of silently returning the
+    first store."""
+    from .._native import TCPStore
+    endpoint = endpoint or os.environ.get("PADDLE_MASTER") \
+        or os.environ.get("MASTER_ENDPOINT", "127.0.0.1:29600")
+    if _store[0] is not None:
+        if endpoint != _store[0]._pt_endpoint:
+            raise RuntimeError(
+                f"store already created for {_store[0]._pt_endpoint}; "
+                f"cannot rebind to {endpoint}")
+        return _store[0]
+    host, _, port = endpoint.rpartition(":")
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0")) if rank is None \
+        else rank
+    store = TCPStore(host or "127.0.0.1", int(port), is_master=(rank == 0),
+                     timeout_ms=timeout_ms)
+    try:
+        store._pt_endpoint = endpoint
+    except AttributeError:  # native type: wrap in a proxy attribute holder
+        store = _StoreProxy(store, endpoint)
+    _store[0] = store
+    return store
+
+
+class _StoreProxy:
+    def __init__(self, store, endpoint):
+        self._store = store
+        self._pt_endpoint = endpoint
+
+    def __getattr__(self, name):
+        return getattr(self._store, name)
+
+
+def barrier_store(store, world_size, prefix="barrier", timeout=120):
+    """Store-based reusable process barrier (used by launch/elastic):
+    the k-th barrier on a prefix completes when the shared counter reaches
+    k*world_size, so repeated barriers on one prefix keep synchronising
+    (every rank must call it the same number of times)."""
+    import struct
+    import time
+    n = store.add(f"{prefix}/arrived", 1)
+    target = ((n + world_size - 1) // world_size) * world_size
+    deadline = time.monotonic() + timeout
+    while n < target:
+        got = store.get(f"{prefix}/arrived", wait=False)
+        if got is not None and len(got) == 8:
+            n = struct.unpack("<q", got)[0]
+        if n >= target:
+            break
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"barrier timed out at {n}/{target}")
+        time.sleep(0.01)
 
 
 def init_parallel_env(strategy=None):

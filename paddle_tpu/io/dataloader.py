@@ -2,15 +2,18 @@
 
 Parity: reference `python/paddle/io/dataloader/dataloader_iter.py:155,370`
 (single-process + multiprocess iterators, worker loop in worker.py, batch
-collation, prefetching). The reference ships batches through shared-memory
-LoDTensor transport; here workers return numpy arrays over a
-multiprocessing queue and the main process uploads to device (TPU infeed is
-host->HBM DMA; numpy + jnp.asarray is the supported path).
+collation, prefetching). Like the reference's `use_shared_memory=True`
+path, process workers ship batches through a native shared-memory ring
+(`paddle_tpu/_native`: POSIX shm + robust process-shared mutex) and the
+main process uploads to device (TPU infeed is host->HBM DMA; numpy +
+jnp.asarray is the supported path). Without the native extension, a
+thread-pool prefetch pipeline provides the overlap instead.
 """
 from __future__ import annotations
 
 import itertools
 import os
+import pickle
 import queue as queue_mod
 import threading
 from typing import Optional
@@ -24,10 +27,18 @@ from .sampler import BatchSampler
 __all__ = ["DataLoader", "default_collate_fn", "get_worker_info"]
 
 _worker_info = threading.local()
+_pool_seq = itertools.count()  # unique shm ring names per pool
 
 
 def get_worker_info():
-    return getattr(_worker_info, "info", None)
+    info = getattr(_worker_info, "info", None)
+    if info is None:
+        # process workers register in the standalone (import-light) module
+        import sys
+        ptw = sys.modules.get("paddle_tpu_worker")
+        if ptw is not None:
+            info = ptw.get_worker_info()
+    return info
 
 
 class WorkerInfo:
@@ -45,10 +56,12 @@ def default_collate_fn(batch):
         return Tensor(np.stack([np.asarray(s._data) for s in batch]))
     if isinstance(sample, np.ndarray):
         return np.stack(batch)
-    if isinstance(sample, (int, float)):
-        return np.asarray(batch)
+    # (str, bytes) before np.generic: np.str_/np.bytes_ subclass both, and
+    # string batches must stay lists (no string dtype on device)
     if isinstance(sample, (str, bytes)):
         return batch
+    if isinstance(sample, (int, float, np.generic)):
+        return np.asarray(batch)
     if isinstance(sample, dict):
         return {k: default_collate_fn([s[k] for s in batch]) for k in sample}
     if isinstance(sample, (list, tuple)):
@@ -78,14 +91,21 @@ class DataLoader:
                  drop_last=False, collate_fn=None, num_workers=0,
                  use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=120, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, mp_start_method=None):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = int(num_workers)
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
         self.prefetch_factor = prefetch_factor
+        self.use_shared_memory = use_shared_memory
+        self.persistent_workers = persistent_workers
+        # "spawn" is the safe default: jax is multithreaded, so fork() from
+        # a jax-initialised parent can deadlock the child. "fork" opt-in.
+        self.mp_start_method = mp_start_method or os.environ.get(
+            "PADDLE_TPU_DATALOADER_START_METHOD", "spawn")
         self._iterable = isinstance(dataset, IterableDataset)
+        self._shm_state = None  # persistent worker pool (map-style only)
         if self._iterable:
             self.batch_sampler = None
             self.batch_size = batch_size
@@ -106,7 +126,15 @@ class DataLoader:
         return self.__iter__()
 
     def __iter__(self):
+        if self.num_workers > 0 and self.use_shared_memory:
+            from .. import _native
+            if _native.available():
+                if self._iterable:
+                    return self._iter_shm_iterable()
+                return self._iter_shm_workers()
         if self._iterable:
+            if self.num_workers > 0:
+                return self._iter_iterable_threads()
             return self._iter_iterable()
         if self.num_workers == 0:
             return self._iter_single()
@@ -126,6 +154,260 @@ class DataLoader:
         for indices in self.batch_sampler:
             batch = [self.dataset[i] for i in indices]
             yield _to_tensor_tree(self.collate_fn(batch))
+
+    # -- native shared-memory worker pool (map-style) ----------------------
+
+    def _collate_for_worker(self):
+        # the standalone worker module resolves "default" to its
+        # numpy-only collate so light datasets avoid importing paddle_tpu
+        return ("default" if self.collate_fn is default_collate_fn
+                else self.collate_fn)
+
+    def _spawn_shm_pool(self, iterable_spec):
+        """Spawn a worker pool: one shm ring (results) and, for map-style
+        datasets (iterable_spec None), one index queue per worker (tasks).
+        Outstanding tasks are capped at num_workers*prefetch_factor, which
+        bounds both the ring occupancy and the parent's reorder buffer —
+        the reference bounds outstanding batches the same way
+        (`dataloader_iter.py:370` _outstanding_capacity)."""
+        import multiprocessing as mp
+
+        import paddle_tpu_worker as worker_mod
+
+        from .. import _native
+        from ..utils.flags import flags
+
+        so_path = _native._build()
+        capacity = int(flags("shm_ring_bytes", 128 << 20))
+        ring_name = (f"/pt_dl_{os.getpid()}_{id(self) & 0xFFFFFF}_"
+                     f"{next(_pool_seq)}")
+        ring = _native.ShmRing(ring_name, capacity=capacity, create=True)
+        ctx = mp.get_context(self.mp_start_method)
+        queues = (None if iterable_spec is not None
+                  else [ctx.Queue() for _ in range(self.num_workers)])
+        procs = []
+        for w in range(self.num_workers):
+            p = ctx.Process(
+                target=worker_mod.worker_loop,
+                args=(so_path, ring_name,
+                      queues[w] if queues is not None else None,
+                      self.dataset, self._collate_for_worker(), w,
+                      self.num_workers, w, self.worker_init_fn,
+                      iterable_spec),
+                daemon=True)
+            p.start()
+            procs.append(p)
+        return {"ring": ring, "queues": queues, "procs": procs,
+                "epoch": 0, "busy": False, "stopped": False}
+
+    @staticmethod
+    def _stop_pool(st):
+        if st is None or st["stopped"]:
+            return
+        st["stopped"] = True
+        if st["queues"] is not None:
+            for q in st["queues"]:
+                try:
+                    q.put(None)
+                except Exception:
+                    pass
+        for p in st["procs"]:
+            p.join(timeout=5)
+        for p in st["procs"]:
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=5)
+        st["ring"].unlink()
+
+    def _shm_pool_stop(self):
+        st = self._shm_state
+        self._shm_state = None
+        self._stop_pool(st)
+
+    def _pop_with_liveness(self, ring, procs, finished=()):
+        """Pop from the ring in short slices, failing fast (with an
+        actionable message) when a worker died instead of waiting out the
+        full timeout."""
+        import time
+        deadline = time.monotonic() + self.timeout
+        while True:
+            payload = ring.pop(timeout_ms=1000)
+            if payload is not None:
+                return payload
+            dead = [w for w, p in enumerate(procs)
+                    if not p.is_alive() and w not in finished]
+            if dead and ring.qsize() == 0:
+                hint = ""
+                if self.mp_start_method != "fork":
+                    hint = (
+                        f"; start method {self.mp_start_method!r} requires "
+                        "your script's entry point to be guarded with "
+                        "`if __name__ == '__main__':` (or pass "
+                        "mp_start_method='fork')")
+                raise RuntimeError(
+                    f"DataLoader worker(s) {dead} exited unexpectedly"
+                    f"{hint}")
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"DataLoader produced no batch for {self.timeout}s")
+
+    def _iter_shm_workers(self):
+        """OS-process workers + native shared-memory ring transport.
+
+        Parity: reference multiprocess DataLoader with use_shared_memory
+        (`dataloader_iter.py:370`, worker.py): workers produce collated
+        batches; transport is a POSIX shm ring (no pipe copies); the
+        parent reorders by batch index and uploads to device. Worker death
+        is detected via pop timeout + liveness check, matching the
+        reference's "DataLoader worker exited unexpectedly" behavior.
+        """
+        import paddle_tpu_worker as worker_mod
+
+        # pool acquisition: reuse the persistent pool when it is idle;
+        # a nested/concurrent iterator (or persistent_workers=False) gets
+        # its own ephemeral pool so iterators never steal each other's
+        # batches off a shared ring
+        if self.persistent_workers and (
+                self._shm_state is None or not self._shm_state["busy"]):
+            if self._shm_state is None:
+                self._shm_state = self._spawn_shm_pool(None)
+            st = self._shm_state
+            ephemeral = False
+        else:
+            st = self._spawn_shm_pool(None)
+            ephemeral = True
+        st["busy"] = True
+        st["epoch"] += 1
+        epoch = st["epoch"]
+        ring, queues, procs = st["ring"], st["queues"], st["procs"]
+
+        tasks = list(enumerate(self.batch_sampler))
+        total = len(tasks)
+        cursor = 0
+        window = max(1, self.prefetch_factor)
+        try:
+            for w in range(self.num_workers):
+                for _ in range(window):
+                    if cursor < total:
+                        bidx, idxs = tasks[cursor]
+                        queues[w].put((epoch, bidx, list(idxs)))
+                        cursor += 1
+            received = 0
+            next_idx = 0
+            buffer = {}
+            while received < total:
+                payload = self._pop_with_liveness(ring, procs)
+                kind, (ep, wid, bidx), body = pickle.loads(payload)
+                if kind == worker_mod.MSG_ERROR:
+                    raise RuntimeError(
+                        f"DataLoader worker {wid} raised:\n{body}")
+                if ep != epoch:
+                    continue  # stale batch from an abandoned epoch
+                received += 1
+                if cursor < total:  # refill the worker that freed a slot
+                    nb, idxs = tasks[cursor]
+                    queues[wid].put((epoch, nb, list(idxs)))
+                    cursor += 1
+                buffer[bidx] = body
+                while next_idx in buffer:
+                    yield _to_tensor_tree(buffer.pop(next_idx))
+                    next_idx += 1
+            while next_idx in buffer:
+                yield _to_tensor_tree(buffer.pop(next_idx))
+                next_idx += 1
+        except GeneratorExit:
+            # iterator abandoned mid-epoch; a persistent pool survives —
+            # stale in-flight batches are discarded by the epoch tag above
+            st["busy"] = False
+            if ephemeral:
+                self._stop_pool(st)
+            raise
+        except BaseException:
+            if st is self._shm_state:
+                self._shm_state = None
+            self._stop_pool(st)
+            raise
+        else:
+            st["busy"] = False
+            if ephemeral:
+                self._stop_pool(st)
+
+    def __del__(self):
+        try:
+            self._shm_pool_stop()
+        except Exception:
+            pass
+
+    def _iter_shm_iterable(self):
+        """IterableDataset over process workers: each worker iterates a
+        dataset REPLICA; sharding across replicas is the dataset's job via
+        get_worker_info() — the reference's (and torch's) IterableDataset
+        contract. Batches are yielded in arrival order, so no reorder
+        buffer exists and ring capacity is the only backpressure."""
+        import paddle_tpu_worker as worker_mod
+
+        st = self._spawn_shm_pool((self.batch_size, self.drop_last))
+        try:
+            finished = set()
+            while len(finished) < self.num_workers:
+                payload = self._pop_with_liveness(st["ring"], st["procs"],
+                                                  finished=finished)
+                kind, (ep, wid, bidx), body = pickle.loads(payload)
+                if kind == worker_mod.MSG_ERROR:
+                    raise RuntimeError(
+                        f"DataLoader worker {wid} raised:\n{body}")
+                if kind == worker_mod.MSG_DONE:
+                    finished.add(wid)
+                    continue
+                yield _to_tensor_tree(body)
+        finally:
+            self._stop_pool(st)
+
+    def _iter_iterable_threads(self):
+        """Thread fallback for IterableDataset with num_workers>0 when the
+        native ring is unavailable — same replica + get_worker_info
+        semantics as the process path, so behavior does not depend on
+        whether the native extension compiled."""
+        done_q: queue_mod.Queue = queue_mod.Queue(
+            maxsize=max(1, self.num_workers * self.prefetch_factor))
+        stop = object()
+
+        def worker(worker_id):
+            import paddle_tpu_worker
+            info = WorkerInfo(worker_id, self.num_workers, self.dataset,
+                              worker_id)
+            _worker_info.info = info
+            # also register in the standalone module so datasets that
+            # shard via paddle_tpu_worker.get_worker_info() behave the
+            # same with or without the native extension
+            paddle_tpu_worker._worker_info.info = info
+            if self.worker_init_fn is not None:
+                self.worker_init_fn(worker_id)
+            try:
+                it = iter(self.dataset)
+                while True:
+                    chunk = list(itertools.islice(it, self.batch_size))
+                    if not chunk or (len(chunk) < self.batch_size
+                                     and self.drop_last):
+                        break
+                    done_q.put(("batch", self.collate_fn(chunk)))
+            except Exception as e:  # propagate to consumer
+                done_q.put(("error", e))
+            done_q.put((stop, None))
+
+        threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+                   for w in range(self.num_workers)]
+        for t in threads:
+            t.start()
+        done = 0
+        while done < self.num_workers:
+            kind, body = done_q.get(timeout=self.timeout)
+            if kind is stop:
+                done += 1
+            elif kind == "error":
+                raise body
+            else:
+                yield _to_tensor_tree(body)
 
     def _iter_multiprocess(self):
         """Thread-pool prefetch pipeline.

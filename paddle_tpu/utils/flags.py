@@ -72,3 +72,5 @@ define_flag("cudnn_deterministic", False, "alias of deterministic")
 define_flag("sync_nccl_allreduce", False, "inert: XLA collectives are in-graph")
 define_flag("tpu_matmul_precision", "default",
             "jax default_matmul_precision for fp32 matmuls")
+define_flag("shm_ring_bytes", 128 << 20,
+            "capacity of the DataLoader shared-memory ring transport")

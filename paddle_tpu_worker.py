@@ -1,0 +1,122 @@
+"""Standalone DataLoader worker module (kept OUTSIDE the paddle_tpu
+package on purpose).
+
+Spawned workers import this module by name; because it is top-level, the
+import does NOT execute paddle_tpu/__init__ (jax + the whole framework),
+so a worker whose dataset/collate only needs numpy starts in milliseconds.
+The native shm ring .so is loaded directly by file path for the same
+reason. (If the user's dataset itself imports paddle_tpu, they opt into
+the heavier start-up — same trade-off as the reference, whose workers
+re-import paddle.)
+
+Parity: reference `python/paddle/io/dataloader/worker.py` `_worker_loop`:
+per-worker index queue of batch tasks, shared result transport
+(shared-memory tensors there; pickled batches in a shm ring here), DONE /
+ERROR control messages, `get_worker_info()` sharding contract for
+IterableDataset replicas.
+"""
+from __future__ import annotations
+
+import importlib.util
+import itertools
+import pickle
+import threading
+import traceback
+
+MSG_BATCH = 0
+MSG_DONE = 1
+MSG_ERROR = 2
+
+_worker_info = threading.local()
+
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset, seed):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+        self.seed = seed
+
+
+def get_worker_info():
+    return getattr(_worker_info, "info", None)
+
+
+def light_collate(batch):
+    """numpy-only default collate (no framework import). The parent
+    converts the stacked arrays to device tensors after transport."""
+    import numpy as np
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    # (str, bytes) before np.generic: np.str_/np.bytes_ subclass both, and
+    # string batches must stay lists (no string dtype on device)
+    if isinstance(sample, (str, bytes)):
+        return batch
+    if isinstance(sample, (int, float, np.generic)):
+        return np.asarray(batch)
+    if isinstance(sample, dict):
+        return {k: light_collate([s[k] for s in batch]) for k in sample}
+    if isinstance(sample, (list, tuple)):
+        out = [light_collate(list(col)) for col in zip(*batch)]
+        return out if isinstance(sample, list) else tuple(out)
+    return batch
+
+
+def _load_ring(so_path, ring_name):
+    spec = importlib.util.spec_from_file_location("_paddle_tpu_native",
+                                                  so_path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.ShmRing(ring_name)
+
+
+def worker_loop(so_path, ring_name, index_queue, dataset, collate,
+                worker_id, num_workers, seed, worker_init_fn,
+                iterable_spec):
+    """Worker main. Map-style: consume (epoch, batch_idx, sample_indices)
+    tasks from index_queue until a None sentinel (persistent workers serve
+    many epochs). Iterable: iterate a dataset replica — sharding across
+    workers is the dataset's job via get_worker_info(), matching the
+    reference's (and torch's) IterableDataset contract."""
+    ring = _load_ring(so_path, ring_name)
+    collate_fn = light_collate if collate == "default" else collate
+    _worker_info.info = WorkerInfo(worker_id, num_workers, dataset, seed)
+    try:
+        if worker_init_fn is not None:
+            worker_init_fn(worker_id)
+        if iterable_spec is not None:
+            batch_size, drop_last = iterable_spec
+            it = iter(dataset)
+            idx = 0
+            while True:
+                chunk = list(itertools.islice(it, batch_size))
+                if not chunk or (len(chunk) < batch_size and drop_last):
+                    break
+                _push(ring, (MSG_BATCH, (0, worker_id, idx),
+                             collate_fn(chunk)))
+                idx += 1
+            _push(ring, (MSG_DONE, (0, worker_id, 0), None))
+        else:
+            while True:
+                task = index_queue.get()
+                if task is None:
+                    break
+                epoch, batch_idx, sample_idxs = task
+                batch = [dataset[i] for i in sample_idxs]
+                _push(ring, (MSG_BATCH, (epoch, worker_id, batch_idx),
+                             collate_fn(batch)))
+    except Exception:
+        try:
+            _push(ring, (MSG_ERROR, (0, worker_id, 0),
+                         traceback.format_exc()), timeout_ms=10000)
+        except Exception:
+            pass
+    finally:
+        ring.close()
+
+
+def _push(ring, msg, timeout_ms=300000):
+    payload = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+    if not ring.push(payload, timeout_ms=timeout_ms):
+        raise TimeoutError("shm ring full for 300s; consumer gone?")

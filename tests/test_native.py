@@ -1,0 +1,236 @@
+"""Tests for the native C++ runtime (_paddle_tpu_native): shm ring
+transport, TCP store rendezvous, and the DataLoader process-worker path."""
+from __future__ import annotations
+
+import pickle
+import socket
+
+import numpy as np
+import pytest
+
+from paddle_tpu import _native
+
+
+requires_native = pytest.mark.skipif(
+    not _native.available(), reason=f"native ext unavailable: "
+    f"{_native.load_error()}")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@requires_native
+def test_shm_ring_basic():
+    ring = _native.ShmRing("/pt_t_basic", capacity=1 << 16, create=True)
+    try:
+        assert ring.push(b"hello")
+        assert ring.push(b"")
+        assert ring.qsize() == 2
+        assert ring.pop(timeout_ms=1000) == b"hello"
+        assert ring.pop(timeout_ms=1000) == b""
+        assert ring.pop(timeout_ms=50) is None  # empty -> timeout
+    finally:
+        ring.unlink()
+
+
+@requires_native
+def test_shm_ring_wraparound():
+    """Messages larger than the space left at the end must wrap (the writer
+    pads with a skip marker and restarts at offset 0)."""
+    ring = _native.ShmRing("/pt_t_wrap", capacity=1 << 14, create=True)
+    try:
+        rng = np.random.RandomState(0)
+        for i in range(200):
+            n = int(rng.randint(0, 5000))
+            msg = bytes([i % 251]) * n
+            assert ring.push(msg, timeout_ms=1000)
+            assert ring.pop(timeout_ms=1000) == msg
+    finally:
+        ring.unlink()
+
+
+@requires_native
+def test_shm_ring_oversize_rejected():
+    ring = _native.ShmRing("/pt_t_big", capacity=1 << 12, create=True)
+    try:
+        with pytest.raises(Exception, match="exceeds ring capacity"):
+            ring.push(b"x" * (1 << 13))
+    finally:
+        ring.unlink()
+
+
+@requires_native
+def test_shm_ring_cross_process():
+    import multiprocessing as mp
+    ring = _native.ShmRing("/pt_t_xproc", capacity=1 << 18, create=True)
+    ctx = mp.get_context("spawn")
+    p = ctx.Process(target=_producer, args=("/pt_t_xproc", 30), daemon=True)
+    p.start()
+    try:
+        got = []
+        for _ in range(30):
+            m = ring.pop(timeout_ms=30000)
+            assert m is not None
+            got.append(pickle.loads(m))
+        assert got == [(i, i * i) for i in range(30)]
+    finally:
+        p.join(timeout=10)
+        ring.unlink()
+
+
+def _producer(name, n):
+    from paddle_tpu._native import ShmRing
+    r = ShmRing(name)
+    for i in range(n):
+        r.push(pickle.dumps((i, i * i)), timeout_ms=30000)
+    r.close()
+
+
+def test_tcp_store_roundtrip():
+    # exercises native when available, else the pure-python fallback
+    port = _free_port()
+    master = _native.TCPStore("127.0.0.1", port, is_master=True)
+    client = _native.TCPStore("127.0.0.1", port)
+    master.set("alpha", b"1")
+    assert client.get("alpha") == b"1"
+    assert client.get("missing", wait=False) is None
+    assert client.check("alpha") and not client.check("missing")
+    assert client.add("ctr", 3) == 3
+    assert master.add("ctr", -1) == 2
+    assert client.num_keys() == 2
+    assert client.delete_key("alpha")
+    assert not client.check("alpha")
+
+
+def test_store_barrier_reusable():
+    import threading
+    from paddle_tpu.distributed.env import barrier_store
+    port = _free_port()
+    master = _native.TCPStore("127.0.0.1", port, is_master=True)
+    clients = [_native.TCPStore("127.0.0.1", port) for _ in range(3)]
+    errs = []
+
+    def arrive(store):
+        try:
+            # two consecutive barriers must BOTH synchronise (the counter
+            # is monotonic: round k completes at k*world_size)
+            barrier_store(store, 4, prefix="t")
+            barrier_store(store, 4, prefix="t")
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=arrive, args=(c,)) for c in clients]
+    for t in threads:
+        t.start()
+    barrier_store(master, 4, prefix="t")
+    barrier_store(master, 4, prefix="t")
+    for t in threads:
+        t.join(timeout=30)
+    assert not errs
+
+
+class _SquareDataset:
+    def __len__(self):
+        return 37
+
+    def __getitem__(self, i):
+        return np.full((4, 3), i, dtype=np.float32), np.int64(i)
+
+
+@requires_native
+def test_dataloader_shm_workers_ordered():
+    from paddle_tpu.io import DataLoader
+    ds = _SquareDataset()
+    dl = DataLoader(ds, batch_size=5, num_workers=2, drop_last=False)
+    seen = []
+    for xb, yb in dl:
+        assert tuple(xb.shape[1:]) == (4, 3)
+        seen.extend(np.asarray(yb._data).tolist())
+    assert seen == list(range(37))  # batch order preserved across workers
+
+
+@requires_native
+def test_dataloader_shm_worker_error_propagates():
+    from paddle_tpu.io import DataLoader
+
+    dl = DataLoader(_BoomDataset(), batch_size=2, num_workers=2, timeout=60)
+    with pytest.raises(RuntimeError, match="boom"):
+        list(dl)
+
+
+class _BoomDataset:
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        if i == 5:
+            raise ValueError("boom")
+        return np.zeros(2, dtype=np.float32)
+
+
+@requires_native
+def test_dataloader_persistent_workers_multi_epoch():
+    from paddle_tpu.io import DataLoader
+    ds = _SquareDataset()
+    dl = DataLoader(ds, batch_size=5, num_workers=2,
+                    persistent_workers=True)
+    for _ in range(3):  # same pool serves several epochs
+        seen = []
+        for _, yb in dl:
+            seen.extend(np.asarray(yb._data).tolist())
+        assert seen == list(range(37))
+        assert dl._shm_state is not None  # pool kept alive
+    procs = dl._shm_state["procs"]
+    assert all(p.is_alive() for p in procs)
+    dl._shm_pool_stop()
+    assert all(not p.is_alive() for p in procs)
+
+
+from paddle_tpu.io.dataset import IterableDataset  # noqa: E402
+
+
+class _ShardedIterable(IterableDataset):
+    """IterableDataset that shards by get_worker_info (the replica
+    contract): worker w yields w, w+W, w+2W, ..."""
+
+    def __iter__(self):
+        import paddle_tpu_worker
+        info = paddle_tpu_worker.get_worker_info()
+        wid = info.id if info else 0
+        nw = info.num_workers if info else 1
+        for i in range(wid, 20, nw):
+            yield np.int64(i)
+
+
+@requires_native
+def test_dataloader_nested_iterators_independent():
+    """Two live iterators over one DataLoader must not steal each other's
+    batches (each gets its own pool/ring)."""
+    from paddle_tpu.io import DataLoader
+    ds = _SquareDataset()
+    dl = DataLoader(ds, batch_size=5, num_workers=2,
+                    persistent_workers=True)
+    outer = iter(dl)
+    first_outer = np.asarray(next(outer)[1]._data).tolist()
+    inner = [np.asarray(yb._data).tolist() for _, yb in dl]
+    rest_outer = [np.asarray(yb._data).tolist() for _, yb in outer]
+    assert first_outer + sum(rest_outer, []) == list(range(37))
+    assert sum(inner, []) == list(range(37))
+    dl._shm_pool_stop()
+
+
+@requires_native
+def test_dataloader_shm_iterable_replicas_shard():
+    from paddle_tpu.io import DataLoader
+
+    dl = DataLoader(_ShardedIterable(), batch_size=4, num_workers=2,
+                    timeout=120)
+    seen = []
+    for yb in dl:
+        seen.extend(np.asarray(yb._data).tolist())
+    assert sorted(seen) == list(range(20))  # no duplication across replicas
