@@ -133,7 +133,7 @@ class _PyTCPStore:
         import struct
         try:
             while True:
-                hdr = self._recvn(conn, 5)
+                hdr = self._recvn(conn, 5, eof_ok=True)
                 if hdr is None:
                     return
                 op, klen = struct.unpack("<BI", hdr)
@@ -182,12 +182,18 @@ class _PyTCPStore:
             conn.close()
 
     @staticmethod
-    def _recvn(conn, n):
+    def _recvn(conn, n, eof_ok=False):
+        """Read exactly n bytes. A clean EOF before any byte returns None
+        when eof_ok (idle connection closed); any partial read raises —
+        a truncated buffer must never be parsed as a complete message."""
         buf = b""
         while len(buf) < n:
             chunk = conn.recv(n - len(buf))
             if not chunk:
-                return None if not buf else buf
+                if eof_ok and not buf:
+                    return None
+                raise ConnectionError(
+                    f"connection lost mid-message ({len(buf)}/{n} bytes)")
             buf += chunk
         return buf
 

@@ -118,6 +118,18 @@ static int robust_cond_timedwait(pthread_cond_t* c, pthread_mutex_t* m,
 
 static PyObject* ShmRingError;
 
+// tp_new zero-initialises the struct; mark the object closed (fd would
+// read as 0 == stdin) until init fully succeeds, so dealloc after a
+// failed/partial __init__ never closes an fd it does not own.
+static PyObject* ShmRing_new(PyTypeObject* type, PyObject*, PyObject*) {
+  ShmRing* self = (ShmRing*)type->tp_alloc(type, 0);
+  if (self) {
+    self->fd = -1;
+    self->closed = 1;
+  }
+  return (PyObject*)self;
+}
+
 static int ShmRing_init(ShmRing* self, PyObject* args, PyObject* kwds) {
   const char* name;
   unsigned long long capacity = 0;
@@ -129,7 +141,7 @@ static int ShmRing_init(ShmRing* self, PyObject* args, PyObject* kwds) {
     return -1;
   snprintf(self->name, sizeof(self->name), "%s", name);
   self->creator = create;
-  self->closed = 0;
+  self->closed = 1;  // flipped to 0 only on full success
   size_t total = 0;
   if (create) {
     if (capacity < 4096) {
@@ -147,6 +159,7 @@ static int ShmRing_init(ShmRing* self, PyObject* args, PyObject* kwds) {
     if (ftruncate(self->fd, (off_t)total) != 0) {
       PyErr_Format(ShmRingError, "ftruncate failed: %s", strerror(errno));
       close(self->fd);
+      self->fd = -1;
       shm_unlink(name);
       return -1;
     }
@@ -161,6 +174,7 @@ static int ShmRing_init(ShmRing* self, PyObject* args, PyObject* kwds) {
     if (fstat(self->fd, &st) != 0 || (size_t)st.st_size < sizeof(RingHeader)) {
       PyErr_SetString(ShmRingError, "shm segment too small");
       close(self->fd);
+      self->fd = -1;
       return -1;
     }
     total = (size_t)st.st_size;
@@ -171,6 +185,7 @@ static int ShmRing_init(ShmRing* self, PyObject* args, PyObject* kwds) {
   if (mem == MAP_FAILED) {
     PyErr_Format(ShmRingError, "mmap failed: %s", strerror(errno));
     close(self->fd);
+    self->fd = -1;
     if (create) shm_unlink(name);
     return -1;
   }
@@ -199,8 +214,10 @@ static int ShmRing_init(ShmRing* self, PyObject* args, PyObject* kwds) {
     PyErr_SetString(ShmRingError, "shm segment not initialised");
     munmap(mem, total);
     close(self->fd);
+    self->fd = -1;
     return -1;
   }
+  self->closed = 0;
   return 0;
 }
 
@@ -357,7 +374,7 @@ static PyTypeObject ShmRingType = []() {
   t.tp_basicsize = sizeof(ShmRing);
   t.tp_flags = Py_TPFLAGS_DEFAULT;
   t.tp_doc = "POSIX shared-memory MPSC ring buffer";
-  t.tp_new = PyType_GenericNew;
+  t.tp_new = ShmRing_new;
   t.tp_init = (initproc)ShmRing_init;
   t.tp_dealloc = (destructor)ShmRing_dealloc;
   t.tp_methods = ShmRing_methods;
@@ -590,6 +607,16 @@ struct IoGuard {
 
 static PyObject* TCPStoreError;
 
+static PyObject* TCPStore_new(PyTypeObject* type, PyObject*, PyObject*) {
+  TCPStore* self = (TCPStore*)type->tp_alloc(type, 0);
+  if (self) {
+    self->fd = -1;
+    self->server = nullptr;
+    pthread_mutex_init(&self->io_mu, nullptr);
+  }
+  return (PyObject*)self;
+}
+
 static int connect_with_retry(const char* host, int port, long timeout_ms) {
   struct timespec start;
   clock_gettime(CLOCK_MONOTONIC, &start);
@@ -630,10 +657,7 @@ static int TCPStore_init(TCPStore* self, PyObject* args, PyObject* kwds) {
                                    const_cast<char**>(kwlist), &host, &port,
                                    &is_master, &timeout_ms))
     return -1;
-  self->server = nullptr;
-  self->fd = -1;
   self->timeout_ms = timeout_ms;
-  pthread_mutex_init(&self->io_mu, nullptr);
   if (is_master) {
     self->server = new StoreServer();
     std::string err;
@@ -855,7 +879,7 @@ static PyTypeObject TCPStoreType = []() {
   t.tp_basicsize = sizeof(TCPStore);
   t.tp_flags = Py_TPFLAGS_DEFAULT;
   t.tp_doc = "TCP key/value rendezvous store (master serves; others connect)";
-  t.tp_new = PyType_GenericNew;
+  t.tp_new = TCPStore_new;
   t.tp_init = (initproc)TCPStore_init;
   t.tp_dealloc = (destructor)TCPStore_dealloc;
   t.tp_methods = TCPStore_methods;

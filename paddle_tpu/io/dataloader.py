@@ -281,17 +281,22 @@ class DataLoader:
         epoch = st["epoch"]
         ring, queues, procs = st["ring"], st["queues"], st["procs"]
 
-        tasks = list(enumerate(self.batch_sampler))
-        total = len(tasks)
-        cursor = 0
+        # stream tasks from the sampler (an epoch over a huge dataset must
+        # not materialise every index list up front); only the outstanding
+        # window lives in memory
+        total = len(self.batch_sampler)
+        task_iter = enumerate(self.batch_sampler)
         window = max(1, self.prefetch_factor)
+
+        def _feed(worker_id):
+            task = next(task_iter, None)
+            if task is not None:
+                queues[worker_id].put((epoch, task[0], list(task[1])))
+
         try:
             for w in range(self.num_workers):
                 for _ in range(window):
-                    if cursor < total:
-                        bidx, idxs = tasks[cursor]
-                        queues[w].put((epoch, bidx, list(idxs)))
-                        cursor += 1
+                    _feed(w)
             received = 0
             next_idx = 0
             buffer = {}
@@ -304,10 +309,7 @@ class DataLoader:
                 if ep != epoch:
                     continue  # stale batch from an abandoned epoch
                 received += 1
-                if cursor < total:  # refill the worker that freed a slot
-                    nb, idxs = tasks[cursor]
-                    queues[wid].put((epoch, nb, list(idxs)))
-                    cursor += 1
+                _feed(wid)  # refill the worker that freed a slot
                 buffer[bidx] = body
                 while next_idx in buffer:
                     yield _to_tensor_tree(buffer.pop(next_idx))
@@ -373,17 +375,17 @@ class DataLoader:
         stop = object()
 
         def worker(worker_id):
-            import paddle_tpu_worker
-            info = WorkerInfo(worker_id, self.num_workers, self.dataset,
-                              worker_id)
-            _worker_info.info = info
-            # also register in the standalone module so datasets that
-            # shard via paddle_tpu_worker.get_worker_info() behave the
-            # same with or without the native extension
-            paddle_tpu_worker._worker_info.info = info
-            if self.worker_init_fn is not None:
-                self.worker_init_fn(worker_id)
             try:
+                import paddle_tpu_worker
+                info = WorkerInfo(worker_id, self.num_workers, self.dataset,
+                                  worker_id)
+                _worker_info.info = info
+                # also register in the standalone module so datasets that
+                # shard via paddle_tpu_worker.get_worker_info() behave the
+                # same with or without the native extension
+                paddle_tpu_worker._worker_info.info = info
+                if self.worker_init_fn is not None:
+                    self.worker_init_fn(worker_id)
                 it = iter(self.dataset)
                 while True:
                     chunk = list(itertools.islice(it, self.batch_size))

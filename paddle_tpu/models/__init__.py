@@ -4,3 +4,10 @@ ResNet/VGG/MobileNet live in paddle_tpu.vision.models; this package holds
 the LLM/diffusion families.
 """
 from .llama import LlamaConfig, LlamaModel, LlamaForCausalLM, llama_tiny, llama_3_8b  # noqa: F401
+from .ernie import (ErnieConfig, ErnieModel, ErnieForMaskedLM,  # noqa: F401
+                    ErnieForPretraining, ErnieForSequenceClassification,
+                    ErnieForTokenClassification, ernie_tiny, ernie_3_base)
+from .dit import (DiTConfig, DiT, GaussianDiffusion, dit_tiny,  # noqa: F401
+                  dit_s_2, dit_xl_2)
+from .qwen2_moe import (Qwen2MoeConfig, Qwen2MoeForCausalLM,  # noqa: F401
+                        qwen2_moe_tiny, qwen2_moe_a14b)

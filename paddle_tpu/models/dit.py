@@ -1,0 +1,349 @@
+"""DiT — diffusion transformer + DDPM/DDIM pipeline (the "SD/DiT" rung of
+the config ladder, BASELINE.md: mixed conv+attention workload).
+
+Capability parity: the reference serves Stable Diffusion/DiT through
+PaddleMIX on `paddle.nn` conv/attention layers; this module provides the
+DiT architecture (Peebles & Xie 2023: patchify -> adaLN-Zero transformer
+blocks conditioned on timestep+class -> unpatchify) and a minimal
+DDPM/DDIM trainer/sampler natively.
+
+TPU-first notes: patchify is a conv with stride=patch (one MXU matmul per
+patch row); adaLN modulation fuses into the surrounding elementwise ops
+under XLA; the sampler loop is jittable per-step (static shapes).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..ops import manipulation as M
+from ..ops.dispatch import apply_op
+
+__all__ = ["DiTConfig", "DiT", "GaussianDiffusion", "dit_tiny", "dit_s_2",
+           "dit_xl_2"]
+
+
+@dataclass
+class DiTConfig:
+    image_size: int = 32          # latent spatial size
+    patch_size: int = 2
+    in_channels: int = 4
+    hidden_size: int = 1152
+    depth: int = 28
+    num_heads: int = 16
+    mlp_ratio: float = 4.0
+    num_classes: int = 1000      # 0 => unconditional
+    learn_sigma: bool = True
+    class_dropout_prob: float = 0.1
+
+
+def dit_tiny(**kw):
+    cfg = dict(image_size=8, patch_size=2, in_channels=3, hidden_size=64,
+               depth=2, num_heads=4, num_classes=10, learn_sigma=False)
+    cfg.update(kw)
+    return DiTConfig(**cfg)
+
+
+def dit_s_2(**kw):
+    cfg = dict(patch_size=2, hidden_size=384, depth=12, num_heads=6)
+    cfg.update(kw)
+    return DiTConfig(**cfg)
+
+
+def dit_xl_2(**kw):
+    cfg = dict(patch_size=2, hidden_size=1152, depth=28, num_heads=16)
+    cfg.update(kw)
+    return DiTConfig(**cfg)
+
+
+class TimestepEmbedder(nn.Layer):
+    """Sinusoidal timestep embedding -> 2-layer MLP (DiT convention)."""
+
+    def __init__(self, hidden_size, freq_dim=256):
+        super().__init__()
+        self.freq_dim = freq_dim
+        self.mlp = nn.Sequential(
+            nn.Linear(freq_dim, hidden_size), nn.Silu(),
+            nn.Linear(hidden_size, hidden_size))
+
+    def forward(self, t):
+        def _sincos(tt):
+            half = self.freq_dim // 2
+            freqs = jnp.exp(-math.log(10000.0)
+                            * jnp.arange(half, dtype=jnp.float32) / half)
+            args = tt.astype(jnp.float32)[:, None] * freqs[None, :]
+            return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+        emb = apply_op("t_embed", _sincos, t)
+        return self.mlp(emb)
+
+
+class LabelEmbedder(nn.Layer):
+    """Class label -> embedding, with CFG dropout to the null class."""
+
+    def __init__(self, num_classes, hidden_size, dropout_prob):
+        super().__init__()
+        self.num_classes = num_classes
+        self.dropout_prob = dropout_prob
+        self.table = nn.Embedding(num_classes + 1, hidden_size)
+
+    def forward(self, labels, train: bool):
+        if train and self.dropout_prob > 0:
+            from ..framework.random import rng_key
+            def _drop(lab):
+                key = rng_key()
+                drop = jax.random.bernoulli(key, self.dropout_prob,
+                                            lab.shape)
+                return jnp.where(drop, self.num_classes, lab)
+            labels = apply_op("cfg_drop", _drop, labels)
+        return self.table(labels)
+
+
+def _modulate(x, shift, scale):
+    return apply_op("modulate",
+                    lambda a, sh, sc: a * (1 + sc[:, None, :])
+                    + sh[:, None, :], x, shift, scale)
+
+
+class DiTBlock(nn.Layer):
+    """adaLN-Zero transformer block: LN(no affine) -> modulate -> attn/mlp,
+    gated residuals initialised at zero."""
+
+    def __init__(self, cfg: DiTConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        self.norm1 = nn.LayerNorm(h, epsilon=1e-6, weight_attr=False,
+                                  bias_attr=False)
+        self.attn_qkv = nn.Linear(h, 3 * h)
+        self.attn_out = nn.Linear(h, h)
+        self.norm2 = nn.LayerNorm(h, epsilon=1e-6, weight_attr=False,
+                                  bias_attr=False)
+        mlp_h = int(h * cfg.mlp_ratio)
+        self.mlp_fc1 = nn.Linear(h, mlp_h)
+        self.mlp_fc2 = nn.Linear(mlp_h, h)
+        self.n_heads = cfg.num_heads
+        self.head_dim = h // cfg.num_heads
+        # adaLN: 6 modulation vectors from the conditioning embedding;
+        # zero-init so each block starts as identity (adaLN-Zero)
+        zero = nn.ParamAttr(initializer=nn.initializer.Constant(0.0))
+        self.adaLN = nn.Linear(h, 6 * h, weight_attr=zero, bias_attr=zero)
+
+    def forward(self, x, c):
+        b, s, h = x.shape
+        mod = self.adaLN(F.silu(c))
+        shift_a, scale_a, gate_a, shift_m, scale_m, gate_m = [
+            apply_op("chunk", lambda a, i=i: a[:, i * h:(i + 1) * h], mod)
+            for i in range(6)]
+        # attention
+        xa = _modulate(self.norm1(x), shift_a, scale_a)
+        qkv = M.reshape(self.attn_qkv(xa), [b, s, 3, self.n_heads,
+                                            self.head_dim])
+        q = apply_op("q", lambda a: a[:, :, 0], qkv)
+        k = apply_op("k", lambda a: a[:, :, 1], qkv)
+        v = apply_op("v", lambda a: a[:, :, 2], qkv)
+        att = F.scaled_dot_product_attention(q, k, v, is_causal=False)
+        att = self.attn_out(M.reshape(att, [b, s, h]))
+        x = x + apply_op("gate", lambda g, a: g[:, None, :] * a, gate_a, att)
+        # mlp
+        xm = _modulate(self.norm2(x), shift_m, scale_m)
+        mlp = self.mlp_fc2(F.gelu(self.mlp_fc1(xm), approximate=True))
+        x = x + apply_op("gate", lambda g, a: g[:, None, :] * a, gate_m, mlp)
+        return x
+
+
+class FinalLayer(nn.Layer):
+    def __init__(self, cfg: DiTConfig, out_channels):
+        super().__init__()
+        h = cfg.hidden_size
+        self.norm = nn.LayerNorm(h, epsilon=1e-6, weight_attr=False,
+                                 bias_attr=False)
+        zero = nn.ParamAttr(initializer=nn.initializer.Constant(0.0))
+        self.adaLN = nn.Linear(h, 2 * h, weight_attr=zero, bias_attr=zero)
+        self.linear = nn.Linear(
+            h, cfg.patch_size * cfg.patch_size * out_channels,
+            weight_attr=zero, bias_attr=zero)
+
+    def forward(self, x, c):
+        h = x.shape[-1]
+        mod = self.adaLN(F.silu(c))
+        shift = apply_op("chunk", lambda a: a[:, :h], mod)
+        scale = apply_op("chunk", lambda a: a[:, h:], mod)
+        return self.linear(_modulate(self.norm(x), shift, scale))
+
+
+def _pos_embed_2d(dim, grid):
+    """Fixed sin-cos 2D positional embedding (DiT uses non-learned)."""
+    def _1d(d, pos):
+        omega = 1.0 / (10000 ** (jnp.arange(d // 2, dtype=jnp.float32)
+                                 / (d / 2.0)))
+        out = jnp.outer(pos, omega)
+        return jnp.concatenate([jnp.sin(out), jnp.cos(out)], axis=1)
+    coords = jnp.arange(grid, dtype=jnp.float32)
+    yy, xx = jnp.meshgrid(coords, coords, indexing="ij")
+    emb = jnp.concatenate([_1d(dim // 2, yy.reshape(-1)),
+                           _1d(dim // 2, xx.reshape(-1))], axis=1)
+    return emb  # (grid*grid, dim)
+
+
+class DiT(nn.Layer):
+    def __init__(self, cfg: DiTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.out_channels = cfg.in_channels * (2 if cfg.learn_sigma else 1)
+        self.x_embedder = nn.Conv2D(cfg.in_channels, cfg.hidden_size,
+                                    kernel_size=cfg.patch_size,
+                                    stride=cfg.patch_size)
+        self.t_embedder = TimestepEmbedder(cfg.hidden_size)
+        if cfg.num_classes > 0:
+            self.y_embedder = LabelEmbedder(cfg.num_classes,
+                                            cfg.hidden_size,
+                                            cfg.class_dropout_prob)
+        else:
+            self.y_embedder = None
+        grid = cfg.image_size // cfg.patch_size
+        self.register_buffer("pos_embed",
+                             Tensor(_pos_embed_2d(cfg.hidden_size, grid)),
+                             persistable=False)
+        self.blocks = nn.LayerList([DiTBlock(cfg) for _ in range(cfg.depth)])
+        self.final_layer = FinalLayer(cfg, self.out_channels)
+
+    def unpatchify(self, x):
+        cfg = self.cfg
+        p = cfg.patch_size
+        grid = cfg.image_size // p
+        c = self.out_channels
+
+        def _f(a):
+            b = a.shape[0]
+            a = a.reshape(b, grid, grid, p, p, c)
+            a = jnp.einsum("bhwpqc->bchpwq", a)
+            return a.reshape(b, c, grid * p, grid * p)
+        return apply_op("unpatchify", _f, x)
+
+    def forward(self, x, t, y=None):
+        """x: (B, C, H, W) noisy input; t: (B,) timesteps; y: (B,) labels."""
+        x = self.x_embedder(x)  # (B, hidden, H/p, W/p)
+        x = apply_op("flatten_patches",
+                     lambda a: a.reshape(a.shape[0], a.shape[1], -1)
+                     .transpose(0, 2, 1), x)
+        x = x + self.pos_embed
+        c = self.t_embedder(t)
+        if self.y_embedder is not None and y is not None:
+            c = c + self.y_embedder(y, train=self.training)
+        for blk in self.blocks:
+            x = blk(x, c)
+        x = self.final_layer(x, c)
+        return self.unpatchify(x)
+
+
+# ---------------------------------------------------------------------------
+# Diffusion process (DDPM training / DDPM+DDIM sampling)
+# ---------------------------------------------------------------------------
+
+class GaussianDiffusion:
+    """Linear-beta DDPM; epsilon-prediction objective.
+
+    train_loss(model, x0, y) -> scalar MSE(eps_hat, eps)
+    p_sample_loop / ddim_sample_loop -> images
+    """
+
+    def __init__(self, num_timesteps=1000, beta_start=1e-4, beta_end=2e-2):
+        self.T = num_timesteps
+        betas = jnp.linspace(beta_start, beta_end, num_timesteps,
+                             dtype=jnp.float32)
+        alphas = 1.0 - betas
+        acp = jnp.cumprod(alphas)
+        self.betas = betas
+        self.alphas = alphas
+        self.alphas_cumprod = acp
+        self.sqrt_acp = jnp.sqrt(acp)
+        self.sqrt_1m_acp = jnp.sqrt(1.0 - acp)
+
+    def q_sample(self, x0, t, noise):
+        """Forward noising: x_t = sqrt(acp_t) x0 + sqrt(1-acp_t) eps."""
+        a = self.sqrt_acp[t][:, None, None, None]
+        b = self.sqrt_1m_acp[t][:, None, None, None]
+        return a * x0 + b * noise
+
+    def train_loss(self, model, x0, y=None):
+        from ..framework.random import rng_key
+        def _f(x0a, *ya):
+            k1, k2 = jax.random.split(rng_key())
+            t = jax.random.randint(k1, (x0a.shape[0],), 0, self.T)
+            noise = jax.random.normal(k2, x0a.shape, x0a.dtype)
+            return t, noise
+        t, noise = apply_op("ddpm_draw", _f, x0)
+        xt = apply_op("q_sample", lambda a, tt, nn_: self.q_sample(a, tt, nn_),
+                      x0, t, noise)
+        eps = model(xt, t, y)
+        if model.cfg.learn_sigma:
+            eps = apply_op("split_eps",
+                           lambda a: a[:, :a.shape[1] // 2], eps)
+        return F.mse_loss(eps, noise)
+
+    # -- sampling ----------------------------------------------------------
+    def _model_eps(self, model, x, t, y):
+        eps = model(x, t, y)
+        if model.cfg.learn_sigma:
+            eps = apply_op("split_eps", lambda a: a[:, :a.shape[1] // 2], eps)
+        return eps
+
+    def p_sample_loop(self, model, shape, y=None, seed=0):
+        """Ancestral DDPM sampling (eager loop over T steps)."""
+        from ..core.autograd import no_grad
+        key = jax.random.PRNGKey(seed)
+        key, k0 = jax.random.split(key)
+        x = Tensor(jax.random.normal(k0, shape, jnp.float32))
+        with no_grad():
+            for i in range(self.T - 1, -1, -1):
+                t = Tensor(jnp.full((shape[0],), i, jnp.int32))
+                eps = self._model_eps(model, x, t, y)
+                beta = self.betas[i]
+                alpha = self.alphas[i]
+                coef = beta / jnp.sqrt(1.0 - self.alphas_cumprod[i])
+                key, kn = jax.random.split(key)
+                def _step(xa, ea):
+                    mean = (xa - coef * ea) / jnp.sqrt(alpha)
+                    if i == 0:
+                        return mean
+                    z = jax.random.normal(kn, xa.shape, xa.dtype)
+                    return mean + jnp.sqrt(beta) * z
+                x = apply_op("p_sample", _step, x, eps)
+        return x
+
+    def ddim_sample_loop(self, model, shape, y=None, steps=50, eta=0.0,
+                         seed=0):
+        """DDIM (deterministic when eta=0) with `steps` spaced timesteps."""
+        from ..core.autograd import no_grad
+        key = jax.random.PRNGKey(seed)
+        key, k0 = jax.random.split(key)
+        x = Tensor(jax.random.normal(k0, shape, jnp.float32))
+        ts = jnp.linspace(self.T - 1, 0, steps).astype(jnp.int32)
+        with no_grad():
+            for n in range(steps):
+                i = int(ts[n])
+                j = int(ts[n + 1]) if n + 1 < steps else -1
+                t = Tensor(jnp.full((shape[0],), i, jnp.int32))
+                eps = self._model_eps(model, x, t, y)
+                a_t = self.alphas_cumprod[i]
+                a_prev = self.alphas_cumprod[j] if j >= 0 \
+                    else jnp.asarray(1.0, jnp.float32)
+                key, kn = jax.random.split(key)
+                def _step(xa, ea):
+                    x0 = (xa - jnp.sqrt(1 - a_t) * ea) / jnp.sqrt(a_t)
+                    sigma = eta * jnp.sqrt((1 - a_prev) / (1 - a_t)
+                                           * (1 - a_t / a_prev))
+                    dir_xt = jnp.sqrt(jnp.maximum(1 - a_prev - sigma ** 2,
+                                                  0.0)) * ea
+                    out = jnp.sqrt(a_prev) * x0 + dir_xt
+                    if eta > 0:
+                        out = out + sigma * jax.random.normal(
+                            kn, xa.shape, xa.dtype)
+                    return out
+                x = apply_op("ddim_step", _step, x, eps)
+        return x

@@ -1,0 +1,304 @@
+"""ERNIE-style bidirectional encoder (BERT architecture) — the finetune
+rung of the config ladder (BASELINE.md: "ERNIE-3.0 finetune").
+
+Capability parity: the reference serves ERNIE through PaddleNLP on top of
+`paddle.nn.TransformerEncoder` (reference
+`python/paddle/nn/layer/transformer.py`) with fleet TP when sharded; this
+module provides the model natively with the same TP-sharded mpu layers as
+the Llama family (`fleet/layers/mpu/mp_layers.py` parity), so qkv/ffn
+columns/rows and the vocab embedding shard over the 'model' mesh axis and
+XLA emits the ICI collectives.
+
+Heads: masked-LM, pretraining (MLM+NSP), sequence/token classification —
+the PaddleNLP head surface a finetune user needs.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..distributed.fleet.mpu import (ColumnParallelLinear, RowParallelLinear,
+                                     VocabParallelEmbedding)
+from ..nn import functional as F
+from ..ops import manipulation as M
+from ..ops.dispatch import apply_op
+
+__all__ = ["ErnieConfig", "ErnieModel", "ErnieForMaskedLM",
+           "ErnieForPretraining", "ErnieForSequenceClassification",
+           "ErnieForTokenClassification", "ernie_tiny", "ernie_3_base"]
+
+
+@dataclass
+class ErnieConfig:
+    vocab_size: int = 40000
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 2048
+    type_vocab_size: int = 4
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    layer_norm_eps: float = 1e-12
+    pad_token_id: int = 0
+    dtype: str = "float32"
+
+
+def ernie_tiny(**kw):
+    cfg = dict(vocab_size=512, hidden_size=64, num_hidden_layers=2,
+               num_attention_heads=4, intermediate_size=128,
+               max_position_embeddings=128, type_vocab_size=2)
+    cfg.update(kw)
+    return ErnieConfig(**cfg)
+
+
+def ernie_3_base(**kw):
+    """ERNIE 3.0 base scale (12L/768H)."""
+    cfg = dict(vocab_size=40000, hidden_size=768, num_hidden_layers=12,
+               num_attention_heads=12, intermediate_size=3072)
+    cfg.update(kw)
+    return ErnieConfig(**cfg)
+
+
+class ErnieEmbeddings(nn.Layer):
+    """word + position + token_type embeddings, LN, dropout. The word
+    table is vocab-parallel over the 'model' axis."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.word_embeddings = VocabParallelEmbedding(cfg.vocab_size,
+                                                      cfg.hidden_size)
+        self.position_embeddings = nn.Embedding(cfg.max_position_embeddings,
+                                                cfg.hidden_size)
+        self.token_type_embeddings = nn.Embedding(cfg.type_vocab_size,
+                                                  cfg.hidden_size)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size,
+                                       epsilon=cfg.layer_norm_eps)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        b, s = input_ids.shape
+        if position_ids is None:
+            position_ids = apply_op(
+                "pos_ids",
+                lambda ids: jnp.broadcast_to(
+                    jnp.arange(s, dtype=jnp.int32)[None, :], (b, s)),
+                input_ids)
+        if token_type_ids is None:
+            token_type_ids = apply_op(
+                "tt_ids", lambda ids: jnp.zeros_like(ids), input_ids)
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(x))
+
+
+class ErnieSelfAttention(nn.Layer):
+    """TP-sharded bidirectional attention (flash kernel when eligible)."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        self.n_heads = cfg.num_attention_heads
+        self.head_dim = h // cfg.num_attention_heads
+        self.qkv_proj = ColumnParallelLinear(h, 3 * h, has_bias=True,
+                                             gather_output=False)
+        self.out_proj = RowParallelLinear(h, h, has_bias=True,
+                                          input_is_parallel=True)
+        self.dropout_p = cfg.attention_probs_dropout_prob
+
+    def forward(self, x, attn_mask=None):
+        b, s, _ = x.shape
+        qkv = M.reshape(self.qkv_proj(x), [b, s, 3, self.n_heads,
+                                           self.head_dim])
+        q = apply_op("qkv_split", lambda a: a[:, :, 0], qkv)
+        k = apply_op("qkv_split", lambda a: a[:, :, 1], qkv)
+        v = apply_op("qkv_split", lambda a: a[:, :, 2], qkv)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, dropout_p=self.dropout_p,
+            is_causal=False, training=self.training)
+        out = M.reshape(out, [b, s, self.n_heads * self.head_dim])
+        return self.out_proj(out)
+
+
+class ErnieEncoderLayer(nn.Layer):
+    """Post-LN transformer block (BERT convention, matching the
+    reference's TransformerEncoderLayer normalize_before=False default,
+    `python/paddle/nn/layer/transformer.py:82`)."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        h, i = cfg.hidden_size, cfg.intermediate_size
+        self.self_attn = ErnieSelfAttention(cfg)
+        self.norm1 = nn.LayerNorm(h, epsilon=cfg.layer_norm_eps)
+        self.linear1 = ColumnParallelLinear(h, i, has_bias=True,
+                                            gather_output=False)
+        self.linear2 = RowParallelLinear(i, h, has_bias=True,
+                                         input_is_parallel=True)
+        self.norm2 = nn.LayerNorm(h, epsilon=cfg.layer_norm_eps)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, x, attn_mask=None):
+        x = self.norm1(x + self.dropout(self.self_attn(x, attn_mask)))
+        ff = self.linear2(F.gelu(self.linear1(x)))
+        return self.norm2(x + self.dropout(ff))
+
+
+class ErniePooler(nn.Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.dense = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, hidden):
+        first = apply_op("cls_token", lambda a: a[:, 0], hidden)
+        return F.tanh(self.dense(first))
+
+
+def _extend_attention_mask(input_ids, attention_mask, pad_token_id):
+    """(B,S) 1/0 mask (or pad-id inference) -> additive (B,1,S,S) bias."""
+    def _f(ids, m):
+        keep = m.astype(jnp.float32) if m is not None \
+            else (ids != pad_token_id).astype(jnp.float32)
+        bias = (1.0 - keep)[:, None, None, :] * jnp.finfo(jnp.float32).min
+        return jnp.broadcast_to(bias, (ids.shape[0], 1, ids.shape[1],
+                                       ids.shape[1]))
+    if attention_mask is None:
+        return apply_op("attn_mask", lambda ids: _f(ids, None), input_ids)
+    return apply_op("attn_mask", _f, input_ids, attention_mask)
+
+
+class ErnieModel(nn.Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = ErnieEmbeddings(cfg)
+        self.encoder = nn.LayerList([ErnieEncoderLayer(cfg)
+                                     for _ in range(cfg.num_hidden_layers)])
+        self.pooler = ErniePooler(cfg)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        mask = _extend_attention_mask(input_ids, attention_mask,
+                                      self.cfg.pad_token_id)
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        for layer in self.encoder:
+            x = layer(x, mask)
+        return x, self.pooler(x)
+
+
+class _MLMHead(nn.Layer):
+    """transform + LN + tied/untied vocab projection."""
+
+    def __init__(self, cfg: ErnieConfig, embedding_weights=None):
+        super().__init__()
+        self.transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size,
+                                       epsilon=cfg.layer_norm_eps)
+        self.decoder_weight = embedding_weights
+        self.decoder_bias = self.create_parameter(
+            [cfg.vocab_size], is_bias=True,
+            default_initializer=nn.initializer.Constant(0.0))
+
+    def forward(self, hidden):
+        h = self.layer_norm(F.gelu(self.transform(hidden)))
+        return apply_op("mlm_logits",
+                        lambda a, w, b: a @ w.T + b,
+                        h, self.decoder_weight, self.decoder_bias)
+
+
+class ErnieForMaskedLM(nn.Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.ernie = ErnieModel(cfg)
+        self.cls = _MLMHead(cfg, self.ernie.embeddings.word_embeddings.weight)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                labels=None):
+        hidden, _ = self.ernie(input_ids, token_type_ids,
+                               attention_mask=attention_mask)
+        logits = self.cls(hidden)
+        if labels is None:
+            return logits
+        return _masked_ce(logits, labels)
+
+
+class ErnieForPretraining(nn.Layer):
+    """MLM + next-sentence-prediction joint objective."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.ernie = ErnieModel(cfg)
+        self.cls = _MLMHead(cfg, self.ernie.embeddings.word_embeddings.weight)
+        self.nsp = nn.Linear(cfg.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                labels=None, next_sentence_label=None):
+        hidden, pooled = self.ernie(input_ids, token_type_ids,
+                                    attention_mask=attention_mask)
+        mlm_logits = self.cls(hidden)
+        nsp_logits = self.nsp(pooled)
+        if labels is None:
+            return mlm_logits, nsp_logits
+        loss = _masked_ce(mlm_logits, labels)
+        if next_sentence_label is not None:
+            loss = loss + F.cross_entropy(
+                nsp_logits, next_sentence_label, reduction="mean")
+        return loss
+
+
+class ErnieForSequenceClassification(nn.Layer):
+    def __init__(self, cfg: ErnieConfig, num_classes=2):
+        super().__init__()
+        self.ernie = ErnieModel(cfg)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+        self.classifier = nn.Linear(cfg.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                labels=None):
+        _, pooled = self.ernie(input_ids, token_type_ids,
+                               attention_mask=attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is None:
+            return logits
+        return F.cross_entropy(logits, labels, reduction="mean")
+
+
+class ErnieForTokenClassification(nn.Layer):
+    def __init__(self, cfg: ErnieConfig, num_classes=2):
+        super().__init__()
+        self.ernie = ErnieModel(cfg)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+        self.classifier = nn.Linear(cfg.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                labels=None):
+        hidden, _ = self.ernie(input_ids, token_type_ids,
+                               attention_mask=attention_mask)
+        logits = self.classifier(self.dropout(hidden))
+        if labels is None:
+            return logits
+        return _masked_ce(logits, labels)
+
+
+def _masked_ce(logits, labels, ignore_index=-100):
+    """mean CE over positions where label != ignore_index."""
+    def _f(lg, lab):
+        v = lg.reshape(-1, lg.shape[-1])
+        t = lab.reshape(-1)
+        valid = (t != ignore_index)
+        safe_t = jnp.where(valid, t, 0)
+        logp = v - _lse(v)
+        nll = -jnp.take_along_axis(logp, safe_t[:, None], axis=-1)[:, 0]
+        vf = valid.astype(v.dtype)
+        return jnp.sum(nll * vf) / jnp.maximum(jnp.sum(vf), 1.0)
+    return apply_op("masked_ce", _f, logits, labels)
+
+
+def _lse(v):
+    m = jnp.max(v, axis=-1, keepdims=True)
+    return m + jnp.log(jnp.sum(jnp.exp(v - m), axis=-1, keepdims=True))

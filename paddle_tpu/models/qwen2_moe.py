@@ -1,0 +1,252 @@
+"""Qwen2-MoE decoder — the expert-parallel rung of the config ladder
+(BASELINE.md: "Qwen2-MoE EP").
+
+Capability parity: the reference trains Qwen2-MoE via PaddleNLP on the
+incubate MoE stack (`python/paddle/incubate/distributed/models/moe/
+moe_layer.py`, global_scatter/global_gather collectives); here the sparse
+FFN is distributed.moe.MoELayer — capacity-bounded one-hot dispatch whose
+expert dim is sharded over the 'model'(EP) mesh axis, so GSPMD emits the
+all_to_all over ICI.
+
+Architecture (Qwen2-MoE): Llama-style GQA attention with qkv bias, RoPE,
+RMSNorm; each decoder layer's FFN = top-k routed experts + a
+sigmoid-gated shared expert; load-balancing aux loss summed over layers.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..distributed.fleet.mpu import (ColumnParallelLinear, RowParallelLinear,
+                                     VocabParallelEmbedding)
+from ..distributed.moe import MoELayer, TopKGate
+from ..nn import functional as F
+from ..ops import manipulation as M
+from ..ops.dispatch import apply_op
+from .llama import _rope_cache, apply_rotary
+
+__all__ = ["Qwen2MoeConfig", "Qwen2MoeForCausalLM", "qwen2_moe_tiny",
+           "qwen2_moe_a14b"]
+
+
+@dataclass
+class Qwen2MoeConfig:
+    vocab_size: int = 151936
+    hidden_size: int = 2048
+    intermediate_size: int = 5632          # dense-layer FFN (unused if all sparse)
+    moe_intermediate_size: int = 1408
+    shared_expert_intermediate_size: int = 5632
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    num_key_value_heads: int = 16
+    num_experts: int = 60
+    num_experts_per_tok: int = 4
+    decoder_sparse_step: int = 1           # every n-th layer is sparse
+    max_position_embeddings: int = 8192
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 1e6
+    router_aux_loss_coef: float = 0.001
+    capacity_factor: float = 2.0
+    tie_word_embeddings: bool = False
+
+
+def qwen2_moe_tiny(**kw):
+    cfg = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+               moe_intermediate_size=32, shared_expert_intermediate_size=64,
+               num_hidden_layers=2, num_attention_heads=4,
+               num_key_value_heads=2, num_experts=4, num_experts_per_tok=2,
+               max_position_embeddings=128)
+    cfg.update(kw)
+    return Qwen2MoeConfig(**cfg)
+
+
+def qwen2_moe_a14b(**kw):
+    """Qwen2-57B-A14B geometry."""
+    cfg = dict(vocab_size=151936, hidden_size=3584,
+               moe_intermediate_size=2560,
+               shared_expert_intermediate_size=20480,
+               num_hidden_layers=28, num_attention_heads=28,
+               num_key_value_heads=4, num_experts=64, num_experts_per_tok=8)
+    cfg.update(kw)
+    return Qwen2MoeConfig(**cfg)
+
+
+class Qwen2MoeAttention(nn.Layer):
+    """GQA with qkv bias (Qwen2 convention), RoPE, TP-sharded projections."""
+
+    def __init__(self, cfg: Qwen2MoeConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        self.head_dim = h // cfg.num_attention_heads
+        self.n_heads = cfg.num_attention_heads
+        self.n_kv = cfg.num_key_value_heads
+        self.q_proj = ColumnParallelLinear(h, h, has_bias=True,
+                                           gather_output=False)
+        self.k_proj = ColumnParallelLinear(h, self.n_kv * self.head_dim,
+                                           has_bias=True, gather_output=False)
+        self.v_proj = ColumnParallelLinear(h, self.n_kv * self.head_dim,
+                                           has_bias=True, gather_output=False)
+        self.o_proj = RowParallelLinear(h, h, has_bias=False,
+                                        input_is_parallel=True)
+
+    def forward(self, x, cos, sin):
+        b, s, _ = x.shape
+        q = M.reshape(self.q_proj(x), [b, s, self.n_heads, self.head_dim])
+        k = M.reshape(self.k_proj(x), [b, s, self.n_kv, self.head_dim])
+        v = M.reshape(self.v_proj(x), [b, s, self.n_kv, self.head_dim])
+        q = apply_op("rope", apply_rotary, q, cos, sin)
+        k = apply_op("rope", apply_rotary, k, cos, sin)
+        if self.n_kv != self.n_heads:
+            rep = self.n_heads // self.n_kv
+            k = apply_op("repeat_kv", lambda a: jnp.repeat(a, rep, axis=2), k)
+            v = apply_op("repeat_kv", lambda a: jnp.repeat(a, rep, axis=2), v)
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        out = M.reshape(out, [b, s, self.n_heads * self.head_dim])
+        return self.o_proj(out)
+
+
+class _ExpertMLP(nn.Layer):
+    """SwiGLU expert over (capacity, d) token slabs."""
+
+    def __init__(self, hidden, inter):
+        super().__init__()
+        self.gate_proj = nn.Linear(hidden, inter, bias_attr=False)
+        self.up_proj = nn.Linear(hidden, inter, bias_attr=False)
+        self.down_proj = nn.Linear(inter, hidden, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.swiglu(self.gate_proj(x), self.up_proj(x)))
+
+
+class _SharedExpert(nn.Layer):
+    """Always-on expert with a learned sigmoid gate (Qwen2-MoE)."""
+
+    def __init__(self, cfg: Qwen2MoeConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        i = cfg.shared_expert_intermediate_size
+        self.gate_proj = ColumnParallelLinear(h, i, has_bias=False,
+                                              gather_output=False)
+        self.up_proj = ColumnParallelLinear(h, i, has_bias=False,
+                                            gather_output=False)
+        self.down_proj = RowParallelLinear(i, h, has_bias=False,
+                                           input_is_parallel=True)
+        self.shared_expert_gate = nn.Linear(h, 1, bias_attr=False)
+
+    def forward(self, x):
+        out = self.down_proj(F.swiglu(self.gate_proj(x), self.up_proj(x)))
+        gate = F.sigmoid(self.shared_expert_gate(x))
+        return apply_op("shared_gate", lambda g, o: g * o, gate, out)
+
+
+class Qwen2MoeSparseBlock(nn.Layer):
+    """Routed experts + shared expert."""
+
+    def __init__(self, cfg: Qwen2MoeConfig):
+        super().__init__()
+        experts = [_ExpertMLP(cfg.hidden_size, cfg.moe_intermediate_size)
+                   for _ in range(cfg.num_experts)]
+        gate = TopKGate(cfg.hidden_size, cfg.num_experts,
+                        topk=cfg.num_experts_per_tok,
+                        capacity_factor=cfg.capacity_factor)
+        self.moe = MoELayer(cfg.hidden_size, experts=experts, gate=gate,
+                            topk=cfg.num_experts_per_tok,
+                            capacity_factor=cfg.capacity_factor)
+        self.shared_expert = _SharedExpert(cfg)
+
+    @property
+    def aux_loss(self):
+        return self.moe.aux_loss
+
+    def forward(self, x):
+        return self.moe(x) + self.shared_expert(x)
+
+
+class Qwen2MoeDecoderLayer(nn.Layer):
+    def __init__(self, cfg: Qwen2MoeConfig, layer_idx: int):
+        super().__init__()
+        self.input_layernorm = nn.RMSNorm(cfg.hidden_size,
+                                          epsilon=cfg.rms_norm_eps)
+        self.self_attn = Qwen2MoeAttention(cfg)
+        self.post_attention_layernorm = nn.RMSNorm(cfg.hidden_size,
+                                                   epsilon=cfg.rms_norm_eps)
+        self.is_sparse = ((layer_idx + 1) % cfg.decoder_sparse_step == 0)
+        if self.is_sparse:
+            self.mlp = Qwen2MoeSparseBlock(cfg)
+        else:
+            from .llama import LlamaMLP, LlamaConfig
+            self.mlp = _ExpertMLP(cfg.hidden_size, cfg.intermediate_size)
+
+    def forward(self, x, cos, sin):
+        x = x + self.self_attn(self.input_layernorm(x), cos, sin)
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+
+class Qwen2MoeModel(nn.Layer):
+    def __init__(self, cfg: Qwen2MoeConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embed_tokens = VocabParallelEmbedding(cfg.vocab_size,
+                                                   cfg.hidden_size)
+        self.layers = nn.LayerList([Qwen2MoeDecoderLayer(cfg, i)
+                                    for i in range(cfg.num_hidden_layers)])
+        self.norm = nn.RMSNorm(cfg.hidden_size, epsilon=cfg.rms_norm_eps)
+        head_dim = cfg.hidden_size // cfg.num_attention_heads
+        cos, sin = _rope_cache(head_dim, cfg.max_position_embeddings,
+                               cfg.rope_theta)
+        self.register_buffer("rope_cos", Tensor(cos), persistable=False)
+        self.register_buffer("rope_sin", Tensor(sin), persistable=False)
+
+    def forward(self, input_ids):
+        s = input_ids.shape[1]
+        cos = apply_op("rope_slice", lambda c: c[:s], self.rope_cos)
+        sin = apply_op("rope_slice", lambda c: c[:s], self.rope_sin)
+        x = self.embed_tokens(input_ids)
+        for layer in self.layers:
+            x = layer(x, cos, sin)
+        return self.norm(x)
+
+    def aux_losses(self):
+        out = []
+        for layer in self.layers:
+            if layer.is_sparse and layer.mlp.aux_loss is not None:
+                out.append(layer.mlp.aux_loss)
+        return out
+
+
+class Qwen2MoeForCausalLM(nn.Layer):
+    def __init__(self, cfg: Qwen2MoeConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.model = Qwen2MoeModel(cfg)
+        self.lm_head = ColumnParallelLinear(cfg.hidden_size, cfg.vocab_size,
+                                            has_bias=False,
+                                            gather_output=False)
+
+    def forward(self, input_ids, labels=None):
+        h = self.model(input_ids)
+        logits = self.lm_head(h)
+        if labels is None:
+            return logits
+        from ..distributed.fleet.mpu import ParallelCrossEntropy
+        shift_logits = apply_op("shift", lambda a: a[:, :-1, :], logits)
+        shift_labels = apply_op("shift", lambda a: a[:, 1:], labels)
+        loss_t = ParallelCrossEntropy()(shift_logits, shift_labels)
+
+        def _masked_mean(l, lab):
+            valid = (lab != -100).astype(l.dtype)
+            return jnp.sum(l[..., 0] * valid) / jnp.maximum(
+                jnp.sum(valid), 1.0)
+        loss = apply_op("masked_mean", _masked_mean, loss_t, shift_labels)
+        aux = self.model.aux_losses()
+        if aux and self.cfg.router_aux_loss_coef > 0:
+            total_aux = aux[0]
+            for a in aux[1:]:
+                total_aux = total_aux + a
+            loss = loss + self.cfg.router_aux_loss_coef * total_aux
+        return loss

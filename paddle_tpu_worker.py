@@ -43,10 +43,17 @@ def get_worker_info():
 
 
 def light_collate(batch):
-    """numpy-only default collate (no framework import). The parent
-    converts the stacked arrays to device tensors after transport."""
+    """numpy-only default collate (no framework import unless the dataset
+    itself yields framework Tensors, in which case it is already loaded).
+    The parent converts stacked arrays to device tensors after
+    transport."""
+    import sys
+
     import numpy as np
     sample = batch[0]
+    pt = sys.modules.get("paddle_tpu")
+    if pt is not None and isinstance(sample, pt.Tensor):
+        return np.stack([np.asarray(s._data) for s in batch])
     if isinstance(sample, np.ndarray):
         return np.stack(batch)
     # (str, bytes) before np.generic: np.str_/np.bytes_ subclass both, and
