@@ -48,6 +48,10 @@ from . import vision  # noqa: F401,E402
 from . import metric  # noqa: F401,E402
 from . import static  # noqa: F401,E402
 from . import utils  # noqa: F401,E402
+from . import profiler  # noqa: F401,E402
+from . import distribution  # noqa: F401,E402
+from . import sparse  # noqa: F401,E402
+from . import geometric  # noqa: F401,E402
 
 from .nn.layer.layers import Layer  # noqa: F401,E402
 from .hapi.model import Model  # noqa: F401,E402

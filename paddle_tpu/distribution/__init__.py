@@ -1,0 +1,458 @@
+"""Probability distributions.
+
+Parity: reference `python/paddle/distribution/` (Distribution base with
+sample/rsample/log_prob/entropy/kl_divergence registry; Normal, Uniform,
+Categorical, Bernoulli, Beta, Gamma, Dirichlet, Exponential, Geometric,
+Gumbel, Laplace, LogNormal, Multinomial, TransformedDistribution).
+
+TPU-native: sampling draws jax PRNG keys from the framework RNG stream
+(framework.random.rng_key), so sampling is reproducible under paddle.seed
+and traceable under jit.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops.dispatch import apply_op
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "Beta", "Gamma", "Dirichlet", "Exponential", "Geometric",
+           "Gumbel", "Laplace", "LogNormal", "Multinomial",
+           "kl_divergence", "register_kl"]
+
+
+def _arr(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x, jnp.float32)
+
+
+def _key():
+    from ..framework.random import rng_key
+    return rng_key()
+
+
+class Distribution:
+    """Base. Parity: paddle.distribution.Distribution."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return apply_op("prob", jnp.exp, self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def _extend(self, shape):
+        return tuple(shape) + self._batch_shape + self._event_shape
+
+
+class Normal(Distribution):
+    """Parameters given as Tensors stay differentiable: log_prob and
+    rsample route them through apply_op, so reparameterized-gradient VI
+    (d loss/d loc, d loss/d scale) works."""
+
+    def __init__(self, loc, scale, name=None):
+        self._loc_p = loc if isinstance(loc, Tensor) else None
+        self._scale_p = scale if isinstance(scale, Tensor) else None
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def _params(self):
+        return (self._loc_p if self._loc_p is not None else self.loc,
+                self._scale_p if self._scale_p is not None else self.scale)
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self._batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(self.scale ** 2, self._batch_shape))
+
+    def sample(self, shape=()):
+        z = jax.random.normal(_key(), self._extend(shape), jnp.float32)
+        loc, scale = self._params()
+        return apply_op("normal_rsample",
+                        lambda l, s: l + s * z, loc, scale)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def _f(v, l, s):
+            var = s ** 2
+            return (-((v - l) ** 2) / (2 * var)
+                    - jnp.log(s) - 0.5 * math.log(2 * math.pi))
+        loc, scale = self._params()
+        return apply_op("normal_log_prob", _f, value, loc, scale)
+
+    def entropy(self):
+        e = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(
+            jnp.broadcast_to(self.scale, self._batch_shape))
+        return Tensor(e)
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(_key(), self._extend(shape), jnp.float32)
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def _f(v):
+            inside = (v >= self.low) & (v < self.high)
+            lp = -jnp.log(self.high - self.low)
+            return jnp.where(inside, lp, -jnp.inf)
+        return apply_op("uniform_log_prob", _f, value)
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(jnp.log(self.high - self.low),
+                                       self._batch_shape))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is None and probs is None:
+            raise ValueError("need logits or probs")
+        if logits is not None:
+            self.logits = _arr(logits)
+        else:
+            self.logits = jnp.log(jnp.maximum(_arr(probs), 1e-30))
+        super().__init__(self.logits.shape[:-1])
+
+    @property
+    def probs(self):
+        return Tensor(jax.nn.softmax(self.logits, axis=-1))
+
+    def sample(self, shape=()):
+        out = jax.random.categorical(_key(), self.logits,
+                                     shape=tuple(shape) + self._batch_shape)
+        return Tensor(out)
+
+    def log_prob(self, value):
+        def _f(v):
+            logp = jax.nn.log_softmax(self.logits, axis=-1)
+            vi = v.astype(jnp.int32)
+            b = jnp.broadcast_shapes(logp.shape[:-1], vi.shape)
+            logp_b = jnp.broadcast_to(logp, b + logp.shape[-1:])
+            vi_b = jnp.broadcast_to(vi, b)
+            return jnp.take_along_axis(logp_b, vi_b[..., None],
+                                       axis=-1)[..., 0]
+        return apply_op("categorical_log_prob", _f, value)
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        p = jnp.exp(logp)
+        return Tensor(-jnp.sum(p * logp, axis=-1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_arr = _arr(probs)
+        super().__init__(self.probs_arr.shape)
+
+    def sample(self, shape=()):
+        u = jax.random.bernoulli(_key(), self.probs_arr,
+                                 self._extend(shape))
+        return Tensor(u.astype(jnp.float32))
+
+    def log_prob(self, value):
+        def _f(v):
+            p = jnp.clip(self.probs_arr, 1e-7, 1 - 1e-7)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+        return apply_op("bernoulli_log_prob", _f, value)
+
+    def entropy(self):
+        p = jnp.clip(self.probs_arr, 1e-7, 1 - 1e-7)
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _arr(alpha)
+        self.beta = _arr(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.beta(_key(), self.alpha, self.beta,
+                                      self._extend(shape)))
+
+    def log_prob(self, value):
+        def _f(v):
+            from jax.scipy.special import betaln
+            return ((self.alpha - 1) * jnp.log(v)
+                    + (self.beta - 1) * jnp.log1p(-v)
+                    - betaln(self.alpha, self.beta))
+        return apply_op("beta_log_prob", _f, value)
+
+    @property
+    def mean(self):
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _arr(concentration)
+        self.rate = _arr(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    def sample(self, shape=()):
+        g = jax.random.gamma(_key(), self.concentration,
+                             self._extend(shape))
+        return Tensor(g / self.rate)
+
+    def log_prob(self, value):
+        def _f(v):
+            from jax.scipy.special import gammaln
+            a, b = self.concentration, self.rate
+            return (a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
+                    - gammaln(a))
+        return apply_op("gamma_log_prob", _f, value)
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration / self.rate)
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _arr(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.dirichlet(
+            _key(), self.concentration,
+            tuple(shape) + self._batch_shape))
+
+    def log_prob(self, value):
+        def _f(v):
+            from jax.scipy.special import gammaln
+            a = self.concentration
+            return (jnp.sum((a - 1) * jnp.log(v), axis=-1)
+                    + gammaln(jnp.sum(a, axis=-1))
+                    - jnp.sum(gammaln(a), axis=-1))
+        return apply_op("dirichlet_log_prob", _f, value)
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _arr(rate)
+        super().__init__(self.rate.shape)
+
+    def sample(self, shape=()):
+        e = jax.random.exponential(_key(), self._extend(shape))
+        return Tensor(e / self.rate)
+
+    def log_prob(self, value):
+        return apply_op("exp_log_prob",
+                        lambda v: jnp.log(self.rate) - self.rate * v, value)
+
+    def entropy(self):
+        return Tensor(1.0 - jnp.log(self.rate))
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k in {0,1,...} (failures before first success)."""
+
+    def __init__(self, probs, name=None):
+        self.probs_arr = _arr(probs)
+        super().__init__(self.probs_arr.shape)
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(_key(), self._extend(shape), jnp.float32,
+                               minval=1e-7, maxval=1.0)
+        k = jnp.floor(jnp.log(u) / jnp.log1p(-self.probs_arr))
+        return Tensor(k)
+
+    def log_prob(self, value):
+        return apply_op(
+            "geom_log_prob",
+            lambda v: v * jnp.log1p(-self.probs_arr)
+            + jnp.log(self.probs_arr), value)
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        g = jax.random.gumbel(_key(), self._extend(shape))
+        return Tensor(self.loc + self.scale * g)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def _f(v):
+            z = (v - self.loc) / self.scale
+            return -(z + jnp.exp(-z)) - jnp.log(self.scale)
+        return apply_op("gumbel_log_prob", _f, value)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        l = jax.random.laplace(_key(), self._extend(shape))
+        return Tensor(self.loc + self.scale * l)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def _f(v):
+            return (-jnp.abs(v - self.loc) / self.scale
+                    - jnp.log(2 * self.scale))
+        return apply_op("laplace_log_prob", _f, value)
+
+    def entropy(self):
+        return Tensor(1 + jnp.log(2 * jnp.broadcast_to(
+            self.scale, self._batch_shape)))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        self._normal = Normal(loc, scale)
+        super().__init__(self._normal._batch_shape)
+
+    def sample(self, shape=()):
+        return apply_op("exp", jnp.exp, self._normal.sample(shape))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def _f(v):
+            logv = jnp.log(v)
+            var = self.scale ** 2
+            return (-((logv - self.loc) ** 2) / (2 * var) - logv
+                    - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+        return apply_op("lognormal_log_prob", _f, value)
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs_arr = _arr(probs)
+        super().__init__(self.probs_arr.shape[:-1],
+                         self.probs_arr.shape[-1:])
+
+    def sample(self, shape=()):
+        logits = jnp.log(jnp.maximum(self.probs_arr, 1e-30))
+        draws = jax.random.categorical(
+            _key(), logits,
+            shape=(self.total_count,) + tuple(shape) + self._batch_shape)
+        k = self.probs_arr.shape[-1]
+        counts = jax.nn.one_hot(draws, k).sum(axis=0)
+        return Tensor(counts)
+
+    def log_prob(self, value):
+        def _f(v):
+            from jax.scipy.special import gammaln
+            logp = jnp.log(jnp.maximum(self.probs_arr, 1e-30))
+            return (gammaln(self.total_count + 1.0)
+                    - jnp.sum(gammaln(v + 1.0), axis=-1)
+                    + jnp.sum(v * logp, axis=-1))
+        return apply_op("multinomial_log_prob", _f, value)
+
+
+# ---------------------------------------------------------------------------
+# KL divergence registry (parity: paddle.distribution.kl_divergence +
+# register_kl decorator)
+# ---------------------------------------------------------------------------
+
+_KL_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    def deco(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p, q):
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        for (cp, cq), f in _KL_REGISTRY.items():
+            if isinstance(p, cp) and isinstance(q, cq):
+                fn = f
+                break
+    if fn is None:
+        raise NotImplementedError(
+            f"kl_divergence not registered for "
+            f"({type(p).__name__}, {type(q).__name__})")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_cat_cat(p, q):
+    lp = jax.nn.log_softmax(p.logits, axis=-1)
+    lq = jax.nn.log_softmax(q.logits, axis=-1)
+    return Tensor(jnp.sum(jnp.exp(lp) * (lp - lq), axis=-1))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bern_bern(p, q):
+    pp = jnp.clip(p.probs_arr, 1e-7, 1 - 1e-7)
+    qq = jnp.clip(q.probs_arr, 1e-7, 1 - 1e-7)
+    return Tensor(pp * (jnp.log(pp) - jnp.log(qq))
+                  + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qq)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_unif_unif(p, q):
+    out = jnp.log((q.high - q.low) / (p.high - p.low))
+    inside = (q.low <= p.low) & (p.high <= q.high)
+    return Tensor(jnp.where(inside, out, jnp.inf))

@@ -1,0 +1,114 @@
+"""Graph/geometric ops.
+
+Parity: reference `python/paddle/geometric/` — message passing
+send_u_recv / send_ue_recv / send_uv (`geometric/message_passing/send_recv.py`),
+segment_{sum,mean,max,min} (`geometric/math.py` via phi segment kernels).
+
+TPU-native: all of these are jax.ops.segment_* reductions — XLA lowers to
+sorted-scatter which stays on-device; no atomics needed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops.dispatch import apply_op
+
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
+           "segment_mean", "segment_max", "segment_min"]
+
+_REDUCERS = {
+    "sum": jax.ops.segment_sum,
+    "mean": None,  # composed from sum/count
+    "max": jax.ops.segment_max,
+    "min": jax.ops.segment_min,
+}
+
+
+def _segment(name, reduce_op, data, ids, num_segments):
+    def _f(d, i):
+        n = num_segments
+        if reduce_op == "mean":
+            s = jax.ops.segment_sum(d, i, n)
+            c = jax.ops.segment_sum(jnp.ones_like(i, d.dtype), i, n)
+            return s / jnp.maximum(c, 1).reshape(
+                (-1,) + (1,) * (d.ndim - 1))
+        out = _REDUCERS[reduce_op](d, i, n)
+        if reduce_op in ("max", "min"):
+            # empty segments come back +-inf; reference returns 0
+            return jnp.where(jnp.isfinite(out), out, 0)
+        return out
+    return apply_op(name, _f, data, ids)
+
+
+def segment_sum(data, segment_ids, name=None, num_segments=None):
+    n = num_segments or int(jnp.max(segment_ids._data)) + 1
+    return _segment("segment_sum", "sum", data, segment_ids, n)
+
+
+def segment_mean(data, segment_ids, name=None, num_segments=None):
+    n = num_segments or int(jnp.max(segment_ids._data)) + 1
+    return _segment("segment_mean", "mean", data, segment_ids, n)
+
+
+def segment_max(data, segment_ids, name=None, num_segments=None):
+    n = num_segments or int(jnp.max(segment_ids._data)) + 1
+    return _segment("segment_max", "max", data, segment_ids, n)
+
+
+def segment_min(data, segment_ids, name=None, num_segments=None):
+    n = num_segments or int(jnp.max(segment_ids._data)) + 1
+    return _segment("segment_min", "min", data, segment_ids, n)
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src] and segment-reduce onto dst.
+    Parity: paddle.geometric.send_u_recv."""
+    n = out_size or x.shape[0]
+
+    def _f(xa, s, d):
+        msgs = xa[s]
+        if reduce_op == "mean":
+            ssum = jax.ops.segment_sum(msgs, d, n)
+            c = jax.ops.segment_sum(jnp.ones_like(d, xa.dtype), d, n)
+            return ssum / jnp.maximum(c, 1).reshape(
+                (-1,) + (1,) * (xa.ndim - 1))
+        out = _REDUCERS[reduce_op](msgs, d, n)
+        if reduce_op in ("max", "min"):
+            return jnp.where(jnp.isfinite(out), out, 0)
+        return out
+    return apply_op("send_u_recv", _f, x, src_index, dst_index)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Combine node features x[src] with edge features y, reduce onto dst.
+    Parity: paddle.geometric.send_ue_recv."""
+    n = out_size or x.shape[0]
+    combine = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+               "div": jnp.divide}[message_op]
+
+    def _f(xa, ya, s, d):
+        msgs = combine(xa[s], ya)
+        if reduce_op == "mean":
+            ssum = jax.ops.segment_sum(msgs, d, n)
+            c = jax.ops.segment_sum(jnp.ones_like(d, xa.dtype), d, n)
+            return ssum / jnp.maximum(c, 1).reshape(
+                (-1,) + (1,) * (msgs.ndim - 1))
+        out = _REDUCERS[reduce_op](msgs, d, n)
+        if reduce_op in ("max", "min"):
+            return jnp.where(jnp.isfinite(out), out, 0)
+        return out
+    return apply_op("send_ue_recv", _f, x, y, src_index, dst_index)
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message x[src] (op) y[dst]. Parity: paddle.geometric.send_uv."""
+    combine = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+               "div": jnp.divide}[message_op]
+
+    def _f(xa, ya, s, d):
+        return combine(xa[s], ya[d])
+    return apply_op("send_uv", _f, x, y, src_index, dst_index)

@@ -22,10 +22,10 @@ __all__ = [
 ]
 
 
-def _unary(name, fn):
+def _unary(op_name, fn):
     def op(x, name=None):
-        return apply_op(name, fn, x)
-    op.__name__ = name
+        return apply_op(op_name, fn, x)
+    op.__name__ = op_name
     op.raw = fn
     return op
 

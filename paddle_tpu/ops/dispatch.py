@@ -27,7 +27,30 @@ def _is_tensor(x):
     return isinstance(x, Tensor)
 
 
+_profiler_mod = None
+
+
 def apply_op(name: str, fn: Callable, *args, **kwargs):
+    """Profiler-aware entry: when a Profiler is recording, every op emits a
+    host RecordEvent span (parity: RecordEvent emission in each generated
+    ad_func, `phi/api/profiler/event_tracing.h:32`). Costs one attribute
+    check when profiling is off."""
+    global _profiler_mod
+    if _profiler_mod is None:
+        from .. import profiler as _p
+        _profiler_mod = _p
+    if _profiler_mod._tracer.enabled:
+        ev = _profiler_mod.RecordEvent(
+            name, _profiler_mod.TracerEventType.Operator)
+        ev.begin()
+        try:
+            return _apply_op(name, fn, *args, **kwargs)
+        finally:
+            ev.end()
+    return _apply_op(name, fn, *args, **kwargs)
+
+
+def _apply_op(name: str, fn: Callable, *args, **kwargs):
     """Execute `fn` (a function over jax arrays) on Tensor/array args.
 
     - Tensors anywhere in (args, kwargs) — including inside lists/tuples/dicts

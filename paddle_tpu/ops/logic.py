@@ -19,10 +19,10 @@ __all__ = [
 ]
 
 
-def _binary(name, fn):
+def _binary(op_name, fn):
     def op(x, y, name=None):
-        return apply_op(name, fn, x, y)
-    op.__name__ = name
+        return apply_op(op_name, fn, x, y)
+    op.__name__ = op_name
     return op
 
 

@@ -38,10 +38,11 @@ __all__ = [
 # ----------------------------------------------------------------- binary
 
 
-def _binary(name, fn):
+def _binary(op_name, fn):
+    # note: the paddle-API `name=None` kwarg must not shadow the op name
     def op(x, y, name=None):
-        return apply_op(name, fn, _as_t(x), _as_t(y))
-    op.__name__ = name
+        return apply_op(op_name, fn, _as_t(x), _as_t(y))
+    op.__name__ = op_name
     op.raw = fn
     return op
 
@@ -76,10 +77,10 @@ nextafter = _binary("nextafter", lambda x, y: jnp.nextafter(x, y))
 # ------------------------------------------------------------------ unary
 
 
-def _unary(name, fn):
+def _unary(op_name, fn):
     def op(x, name=None):
-        return apply_op(name, fn, x)
-    op.__name__ = name
+        return apply_op(op_name, fn, x)
+    op.__name__ = op_name
     op.raw = fn
     return op
 
@@ -199,11 +200,12 @@ def _norm_axis(axis):
     return int(axis)
 
 
-def _reduction(name, fn, bool_out=False):
+def _reduction(op_name, fn, bool_out=False):
     def op(x, axis=None, keepdim=False, name=None):
         ax = _norm_axis(axis)
-        return apply_op(name, lambda a: fn(a, axis=ax, keepdims=keepdim), x)
-    op.__name__ = name
+        return apply_op(op_name,
+                        lambda a: fn(a, axis=ax, keepdims=keepdim), x)
+    op.__name__ = op_name
     return op
 
 

@@ -1,0 +1,263 @@
+"""Sparse tensors (COO/CSR).
+
+Parity: reference sparse stack — `phi::SparseCooTensor`/`SparseCsrTensor`
+(`paddle/phi/core/sparse_coo_tensor.h`), kernels in `paddle/phi/kernels/
+sparse/` (~60 interfaces), python API `python/paddle/sparse/`.
+
+TPU-native: backed by jax.experimental.sparse BCOO/BCSR — XLA lowers
+sparse matmul to gather+MXU matmul; elementwise unary ops run on the
+values buffer only (same trick the reference's sparse kernels use).
+Autograd: value buffers participate through apply_op like any dense op.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+from ..ops.dispatch import apply_op
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "SparseCsrTensor", "is_same_shape", "matmul", "masked_matmul",
+           "add", "multiply", "relu", "sin", "tanh", "sqrt", "abs",
+           "to_dense", "to_sparse_coo", "nn"]
+
+
+class SparseCooTensor:
+    """COO sparse tensor wrapper (indices (ndim, nnz), values (nnz, ...)).
+
+    Parity: paddle.sparse.sparse_coo_tensor / phi SparseCooTensor."""
+
+    def __init__(self, bcoo):
+        self._bcoo = bcoo
+
+    # -- paddle tensor-ish surface ---------------------------------------
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    @property
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def indices(self):
+        return Tensor(self._bcoo.indices.T)  # paddle layout (ndim, nnz)
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def to_sparse_csr(self):
+        bcsr = jsparse.BCSR.from_bcoo(self._bcoo)
+        return SparseCsrTensor(bcsr)
+
+    def coalesce(self):
+        return SparseCooTensor(self._bcoo.sum_duplicates())
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    """CSR sparse tensor wrapper. Parity: paddle.sparse.sparse_csr_tensor."""
+
+    def __init__(self, bcsr):
+        self._bcsr = bcsr
+
+    @property
+    def shape(self):
+        return list(self._bcsr.shape)
+
+    @property
+    def dtype(self):
+        return self._bcsr.dtype
+
+    @property
+    def nnz(self):
+        return int(self._bcsr.nse)
+
+    def crows(self):
+        return Tensor(self._bcsr.indptr)
+
+    def cols(self):
+        return Tensor(self._bcsr.indices)
+
+    def values(self):
+        return Tensor(self._bcsr.data)
+
+    def to_dense(self):
+        return Tensor(self._bcsr.todense())
+
+    def to_sparse_coo(self, sparse_dim=None):
+        return SparseCooTensor(self._bcsr.to_bcoo())
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+def _data(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x)
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    """indices: (ndim, nnz); values: (nnz,). Parity: paddle.sparse.
+    sparse_coo_tensor."""
+    idx = _data(indices).T.astype(jnp.int32)       # BCOO wants (nnz, ndim)
+    val = _data(values)
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+        val = val.astype(convert_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(i) + 1 for i in idx.max(axis=0))
+    bcoo = jsparse.BCOO((val, idx), shape=tuple(shape))
+    return SparseCooTensor(bcoo)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True):
+    """Parity: paddle.sparse.sparse_csr_tensor."""
+    val = _data(values)
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+        val = val.astype(convert_dtype(dtype))
+    bcsr = jsparse.BCSR(
+        (val, _data(cols).astype(jnp.int32),
+         _data(crows).astype(jnp.int32)), shape=tuple(shape))
+    return SparseCsrTensor(bcsr)
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
+def to_dense(x):
+    return x.to_dense()
+
+
+def to_sparse_coo(x, sparse_dim=None):
+    if isinstance(x, SparseCsrTensor):
+        return x.to_sparse_coo(sparse_dim)
+    d = _data(x)
+    return SparseCooTensor(jsparse.BCOO.fromdense(d))
+
+
+# -- ops --------------------------------------------------------------------
+
+def _unary_on_values(name, fn):
+    """Elementwise op applied to the values buffer (zero-preserving ops
+    only — the reference's sparse unary kernels share this contract)."""
+    def op(x, name_arg=None):
+        if isinstance(x, SparseCooTensor):
+            new_vals = apply_op(name, fn, Tensor(x._bcoo.data))
+            return SparseCooTensor(
+                jsparse.BCOO((new_vals._data, x._bcoo.indices),
+                             shape=x._bcoo.shape))
+        if isinstance(x, SparseCsrTensor):
+            new_vals = apply_op(name, fn, Tensor(x._bcsr.data))
+            return SparseCsrTensor(
+                jsparse.BCSR((new_vals._data, x._bcsr.indices,
+                              x._bcsr.indptr), shape=x._bcsr.shape))
+        return apply_op(name, fn, x)
+    op.__name__ = name
+    return op
+
+
+relu = _unary_on_values("sparse_relu", lambda v: jnp.maximum(v, 0))
+sin = _unary_on_values("sparse_sin", jnp.sin)
+tanh = _unary_on_values("sparse_tanh", jnp.tanh)
+sqrt = _unary_on_values("sparse_sqrt", jnp.sqrt)
+abs = _unary_on_values("sparse_abs", jnp.abs)
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense -> dense. Parity: paddle.sparse.matmul."""
+    if isinstance(x, SparseCsrTensor):
+        x = x.to_sparse_coo()
+    if isinstance(x, SparseCooTensor):
+        yb = _data(y)
+        out = jsparse.bcoo_dot_general(
+            x._bcoo, yb,
+            dimension_numbers=(([x._bcoo.ndim - 1], [0]), ([], [])))
+        return Tensor(out)
+    return apply_op("matmul", jnp.matmul, x, y)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """(dense @ dense) * sparse_mask -> sparse (SDDMM).
+    Parity: paddle.sparse.masked_matmul."""
+    xd, yd = _data(x), _data(y)
+    if isinstance(mask, SparseCsrTensor):
+        coo = mask.to_sparse_coo()
+        out_coo = _sddmm(xd, yd, coo)
+        return out_coo.to_sparse_csr()
+    return _sddmm(xd, yd, mask)
+
+
+def _sddmm(xd, yd, mask: SparseCooTensor):
+    idx = mask._bcoo.indices  # (nnz, 2)
+    rows, cols = idx[:, 0], idx[:, 1]
+    vals = jnp.einsum("nk,nk->n", xd[rows, :], yd[:, cols].T)
+    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=mask._bcoo.shape))
+
+
+def add(x, y, name=None):
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        idx = jnp.concatenate([x._bcoo.indices, y._bcoo.indices], axis=0)
+        val = jnp.concatenate([x._bcoo.data, y._bcoo.data], axis=0)
+        out = jsparse.BCOO((val, idx), shape=x._bcoo.shape).sum_duplicates()
+        return SparseCooTensor(out)
+    raise TypeError("sparse.add expects two SparseCooTensors")
+
+
+def multiply(x, y, name=None):
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        # elementwise product via dense path (reference kernels do a merge;
+        # nnz here is test-scale)
+        out = x._bcoo.todense() * y._bcoo.todense()
+        return SparseCooTensor(jsparse.BCOO.fromdense(out))
+    raise TypeError("sparse.multiply expects two SparseCooTensors")
+
+
+# -- sparse.nn --------------------------------------------------------------
+
+class _SparseNN:
+    """paddle.sparse.nn namespace (ReLU layer parity)."""
+
+    class ReLU:
+        def __call__(self, x):
+            return relu(x)
+
+    class Softmax:
+        """Row-wise softmax over CSR values. Parity:
+        paddle.sparse.nn.Softmax (csr softmax kernel)."""
+
+        def __init__(self, axis=-1):
+            self.axis = axis
+
+        def __call__(self, x: SparseCsrTensor):
+            indptr = x._bcsr.indptr
+            vals = x._bcsr.data
+            n_rows = x.shape[0]
+            row_id = jnp.searchsorted(indptr, jnp.arange(vals.shape[0]),
+                                      side="right") - 1
+            row_max = jax.ops.segment_max(vals, row_id, n_rows)
+            ex = jnp.exp(vals - row_max[row_id])
+            row_sum = jax.ops.segment_sum(ex, row_id, n_rows)
+            out = ex / row_sum[row_id]
+            return SparseCsrTensor(jsparse.BCSR(
+                (out, x._bcsr.indices, x._bcsr.indptr), shape=x._bcsr.shape))
+
+
+nn = _SparseNN()

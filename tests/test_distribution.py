@@ -1,0 +1,134 @@
+"""Probability distribution tests: moments vs numpy/scipy references,
+log_prob correctness, sampling statistics, KL registry, grad flow."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+from paddle_tpu.core.tensor import Tensor
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    paddle.seed(7)
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+def test_normal_log_prob_entropy_kl():
+    n = D.Normal(1.0, 2.0)
+    v = Tensor(np.array([0.0, 1.0, 3.0], dtype=np.float32))
+    ref = (-((np.asarray([0., 1., 3.]) - 1.0) ** 2) / (2 * 4.0)
+           - math.log(2.0) - 0.5 * math.log(2 * math.pi))
+    np.testing.assert_allclose(_np(n.log_prob(v)), ref, rtol=1e-5)
+    ent = float(_np(n.entropy()))
+    assert abs(ent - (0.5 + 0.5 * math.log(2 * math.pi) + math.log(2.0))) \
+        < 1e-5
+    m = D.Normal(0.0, 1.0)
+    kl = float(_np(D.kl_divergence(n, m)))
+    ref_kl = 0.5 * (4.0 + 1.0 - 1 - math.log(4.0))
+    assert abs(kl - ref_kl) < 1e-5
+
+
+def test_normal_sampling_moments():
+    n = D.Normal(3.0, 0.5)
+    s = _np(n.sample((20000,)))
+    assert abs(s.mean() - 3.0) < 0.02
+    assert abs(s.std() - 0.5) < 0.02
+
+
+def test_uniform_inside_outside():
+    u = D.Uniform(0.0, 2.0)
+    lp = _np(u.log_prob(Tensor(np.array([1.0, 3.0], np.float32))))
+    assert abs(lp[0] + math.log(2.0)) < 1e-6
+    assert np.isneginf(lp[1])
+    s = _np(u.sample((5000,)))
+    assert s.min() >= 0.0 and s.max() < 2.0
+
+
+def test_categorical_probs_sampling():
+    logits = np.log(np.array([0.2, 0.3, 0.5], np.float32))
+    c = D.Categorical(logits=Tensor(logits))
+    s = _np(c.sample((20000,)))
+    freq = np.bincount(s.astype(int), minlength=3) / 20000
+    np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.02)
+    lp = _np(c.log_prob(Tensor(np.array([2], np.int32))))
+    assert abs(lp[0] - math.log(0.5)) < 1e-5
+    ent = float(_np(c.entropy()))
+    assert abs(ent - (-(0.2 * math.log(0.2) + 0.3 * math.log(0.3)
+                        + 0.5 * math.log(0.5)))) < 1e-5
+
+
+def test_bernoulli_and_kl():
+    b = D.Bernoulli(0.3)
+    lp = _np(b.log_prob(Tensor(np.array([1.0, 0.0], np.float32))))
+    assert abs(lp[0] - math.log(0.3)) < 1e-5
+    assert abs(lp[1] - math.log(0.7)) < 1e-5
+    q = D.Bernoulli(0.5)
+    kl = float(_np(D.kl_divergence(b, q)))
+    ref = 0.3 * math.log(0.3 / 0.5) + 0.7 * math.log(0.7 / 0.5)
+    assert abs(kl - ref) < 1e-5
+
+
+def test_beta_gamma_dirichlet_moments():
+    be = D.Beta(2.0, 3.0)
+    s = _np(be.sample((20000,)))
+    assert abs(s.mean() - 2.0 / 5.0) < 0.01
+    ga = D.Gamma(3.0, 2.0)
+    sg = _np(ga.sample((20000,)))
+    assert abs(sg.mean() - 1.5) < 0.05
+    di = D.Dirichlet(np.array([1.0, 2.0, 3.0], np.float32))
+    sd = _np(di.sample((20000,)))
+    np.testing.assert_allclose(sd.mean(axis=0), [1 / 6, 2 / 6, 3 / 6],
+                               atol=0.02)
+    np.testing.assert_allclose(sd.sum(axis=-1), 1.0, atol=1e-5)
+
+
+def test_exponential_geometric_gumbel_laplace_lognormal():
+    e = D.Exponential(2.0)
+    se = _np(e.sample((20000,)))
+    assert abs(se.mean() - 0.5) < 0.02
+    g = D.Geometric(0.25)
+    sg = _np(g.sample((20000,)))
+    assert abs(sg.mean() - (1 - 0.25) / 0.25) < 0.15
+    gu = D.Gumbel(0.0, 1.0)
+    sgu = _np(gu.sample((20000,)))
+    assert abs(sgu.mean() - 0.5772) < 0.05
+    la = D.Laplace(1.0, 2.0)
+    sla = _np(la.sample((20000,)))
+    assert abs(sla.mean() - 1.0) < 0.1
+    ln = D.LogNormal(0.0, 0.25)
+    sln = _np(ln.sample((20000,)))
+    assert abs(sln.mean() - math.exp(0.25 ** 2 / 2)) < 0.02
+
+
+def test_multinomial_counts():
+    m = D.Multinomial(10, np.array([0.5, 0.5], np.float32))
+    s = _np(m.sample((200,)))
+    assert s.shape == (200, 2)
+    np.testing.assert_allclose(s.sum(axis=-1), 10.0)
+    lp = float(_np(m.log_prob(Tensor(np.array([5.0, 5.0], np.float32)))))
+    from math import comb, log
+    assert abs(lp - (log(comb(10, 5)) + 10 * log(0.5))) < 1e-4
+
+
+def test_log_prob_grad_flows():
+    """rsample/log_prob participate in autograd (reparameterized VI use)."""
+    loc = Tensor(np.array(0.5, np.float32))
+    loc.stop_gradient = False
+    n = D.Normal(loc, 1.0)
+    lp = n.log_prob(Tensor(np.array(1.5, np.float32)))
+    lp.backward()
+    # d/dloc log N(1.5; loc, 1) = (1.5 - loc) = 1.0
+    assert abs(float(np.asarray(loc.grad._data)) - 1.0) < 1e-5
+
+
+def test_kl_unregistered_raises():
+    with pytest.raises(NotImplementedError):
+        D.kl_divergence(D.Normal(0.0, 1.0), D.Gamma(1.0, 1.0))

@@ -1,0 +1,147 @@
+"""Sparse (COO/CSR) and geometric (segment/message-passing) op tests."""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import geometric as G
+from paddle_tpu import sparse as S
+from paddle_tpu.core.tensor import Tensor
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+def _coo():
+    # [[0, 1, 0], [2, 0, 3]]
+    idx = Tensor(np.array([[0, 1, 1], [1, 0, 2]], np.int32))
+    val = Tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    return S.sparse_coo_tensor(idx, val, shape=[2, 3])
+
+
+def test_coo_roundtrip_and_props():
+    sp = _coo()
+    assert sp.shape == [2, 3] and sp.nnz == 3
+    dense = _np(sp.to_dense())
+    np.testing.assert_allclose(dense, [[0, 1, 0], [2, 0, 3]])
+    np.testing.assert_allclose(_np(sp.values()), [1, 2, 3])
+    assert _np(sp.indices()).shape == (2, 3)
+
+
+def test_csr_roundtrip():
+    sp = S.sparse_csr_tensor(
+        crows=Tensor(np.array([0, 1, 3], np.int32)),
+        cols=Tensor(np.array([1, 0, 2], np.int32)),
+        values=Tensor(np.array([1.0, 2.0, 3.0], np.float32)),
+        shape=[2, 3])
+    np.testing.assert_allclose(_np(sp.to_dense()), [[0, 1, 0], [2, 0, 3]])
+    coo = sp.to_sparse_coo()
+    np.testing.assert_allclose(_np(coo.to_dense()), [[0, 1, 0], [2, 0, 3]])
+    back = coo.to_sparse_csr()
+    np.testing.assert_allclose(_np(back.to_dense()), [[0, 1, 0], [2, 0, 3]])
+
+
+def test_dense_conversion_helpers():
+    d = Tensor(np.array([[1.0, 0.0], [0.0, 2.0]], np.float32))
+    sp = S.to_sparse_coo(d)
+    assert sp.nnz == 2
+    np.testing.assert_allclose(_np(S.to_dense(sp)), _np(d))
+
+
+def test_sparse_unary_ops():
+    idx = Tensor(np.array([[0, 1], [0, 1]], np.int32))
+    val = Tensor(np.array([-1.0, 4.0], np.float32))
+    sp = S.sparse_coo_tensor(idx, val, shape=[2, 2])
+    np.testing.assert_allclose(_np(S.relu(sp).values()), [0.0, 4.0])
+    np.testing.assert_allclose(_np(S.sqrt(S.abs(sp)).values()), [1.0, 2.0])
+    np.testing.assert_allclose(_np(S.tanh(sp).to_dense()),
+                               np.tanh([[-1.0, 0], [0, 4.0]]), rtol=1e-6)
+
+
+def test_sparse_matmul_vs_dense():
+    sp = _coo()
+    y = Tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    out = S.matmul(sp, y)
+    ref = _np(sp.to_dense()) @ _np(y)
+    np.testing.assert_allclose(_np(out), ref, rtol=1e-6)
+
+
+def test_masked_matmul_sddmm():
+    rng = np.random.RandomState(0)
+    x = Tensor(rng.randn(4, 5).astype(np.float32))
+    y = Tensor(rng.randn(5, 4).astype(np.float32))
+    mask = S.to_sparse_coo(Tensor(np.array(
+        [[1, 0, 0, 0], [0, 1, 0, 0], [0, 0, 1, 0], [0, 0, 0, 1]],
+        np.float32)))
+    out = S.masked_matmul(x, y, mask)
+    ref = (_np(x) @ _np(y)) * np.eye(4, dtype=np.float32)
+    np.testing.assert_allclose(_np(out.to_dense()), ref, rtol=1e-5)
+
+
+def test_sparse_add_and_softmax():
+    a = _coo()
+    b = _coo()
+    s = S.add(a, b)
+    np.testing.assert_allclose(_np(s.to_dense()),
+                               2 * _np(a.to_dense()))
+    csr = S.sparse_csr_tensor(
+        crows=Tensor(np.array([0, 2, 3], np.int32)),
+        cols=Tensor(np.array([0, 1, 2], np.int32)),
+        values=Tensor(np.array([1.0, 2.0, 5.0], np.float32)),
+        shape=[2, 3])
+    sm = S.nn.Softmax()(csr)
+    vals = _np(sm.values())
+    # row 0: softmax([1,2]); row 1: softmax([5]) = 1
+    e = np.exp([1.0, 2.0])
+    np.testing.assert_allclose(vals[:2], e / e.sum(), rtol=1e-6)
+    np.testing.assert_allclose(vals[2], 1.0, rtol=1e-6)
+
+
+# ---------------------------------------------------------------- geometric
+
+def test_segment_reductions():
+    data = Tensor(np.array([[1.0], [2.0], [3.0], [4.0]], np.float32))
+    ids = Tensor(np.array([0, 0, 1, 1], np.int32))
+    np.testing.assert_allclose(_np(G.segment_sum(data, ids)), [[3.0], [7.0]])
+    np.testing.assert_allclose(_np(G.segment_mean(data, ids)),
+                               [[1.5], [3.5]])
+    np.testing.assert_allclose(_np(G.segment_max(data, ids)), [[2.0], [4.0]])
+    np.testing.assert_allclose(_np(G.segment_min(data, ids)), [[1.0], [3.0]])
+
+
+def test_send_u_recv_sum_mean_max():
+    x = Tensor(np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]], np.float32))
+    src = Tensor(np.array([0, 1, 2, 0], np.int32))
+    dst = Tensor(np.array([1, 2, 1, 0], np.int32))
+    out = G.send_u_recv(x, src, dst, reduce_op="sum")
+    # dst0 <- x0; dst1 <- x0 + x2; dst2 <- x1
+    np.testing.assert_allclose(_np(out),
+                               [[1, 2], [6, 8], [3, 4]])
+    out_mean = G.send_u_recv(x, src, dst, reduce_op="mean")
+    np.testing.assert_allclose(_np(out_mean), [[1, 2], [3, 4], [3, 4]])
+    out_max = G.send_u_recv(x, src, dst, reduce_op="max")
+    np.testing.assert_allclose(_np(out_max), [[1, 2], [5, 6], [3, 4]])
+
+
+def test_send_ue_recv_and_send_uv():
+    x = Tensor(np.array([[1.0], [2.0], [3.0]], np.float32))
+    e = Tensor(np.array([[10.0], [20.0], [30.0]], np.float32))
+    src = Tensor(np.array([0, 1, 2], np.int32))
+    dst = Tensor(np.array([2, 2, 0], np.int32))
+    out = G.send_ue_recv(x, e, src, dst, message_op="add", reduce_op="sum")
+    # dst2 <- (1+10)+(2+20)=33; dst0 <- 3+30=33
+    np.testing.assert_allclose(_np(out), [[33.0], [0.0], [33.0]])
+    uv = G.send_uv(x, x, src, dst, message_op="mul")
+    np.testing.assert_allclose(_np(uv), [[3.0], [6.0], [3.0]])
+
+
+def test_send_u_recv_grad_flow():
+    x = Tensor(np.array([[1.0], [2.0]], np.float32))
+    x.stop_gradient = False
+    src = Tensor(np.array([0, 0, 1], np.int32))
+    dst = Tensor(np.array([1, 1, 0], np.int32))
+    out = G.send_u_recv(x, src, dst, reduce_op="sum")
+    out.sum().backward()
+    # x0 sent twice, x1 once
+    np.testing.assert_allclose(np.asarray(x.grad._data), [[2.0], [1.0]])
