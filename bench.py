@@ -48,16 +48,28 @@ def llama_step_flops(cfg, batch, seq):
     return dense + attn, n_params
 
 
-def main():
+def run(use_pallas=True, shrink=0):
     import jax
-    import jax.numpy as jnp
 
     import paddle_tpu as paddle
+    from paddle_tpu.nn.functional.flash_attention import sdp_kernel
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 
+    with sdp_kernel(enable_flash=bool(use_pallas)):
+        return _run_inner(paddle, LlamaConfig, LlamaForCausalLM, jax,
+                          use_pallas, shrink)
+
+
+def _run_inner(paddle, LlamaConfig, LlamaForCausalLM, jax, use_pallas, shrink):
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
-    if on_tpu:
+    if on_tpu and shrink:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                          intermediate_size=2816, num_hidden_layers=12,
+                          num_attention_heads=8, num_key_value_heads=8,
+                          max_position_embeddings=2048)
+        batch, seq, iters = 2, 2048, 6
+    elif on_tpu:
         # ~0.8B-param config that fits one v5e chip (16GB HBM) with AdamW
         # fp32 states + bf16 params/activations.
         cfg = LlamaConfig(vocab_size=32000, hidden_size=1536,
@@ -108,7 +120,7 @@ def main():
     peak = peak_flops_per_chip(getattr(dev, "device_kind", dev.platform))
     mfu = flops / dt / peak
 
-    print(json.dumps({
+    return {
         "metric": "llama_pretrain_mfu",
         "value": round(mfu, 4),
         "unit": "fraction_of_peak",
@@ -118,8 +130,38 @@ def main():
         "n_params": int(n_params),
         "loss": float(np.asarray(loss._data)),
         "device": str(getattr(dev, "device_kind", dev.platform)),
+        "attention": "pallas_flash" if use_pallas else "xla_sdpa",
         "config": {"hidden": cfg.hidden_size, "layers": cfg.num_hidden_layers,
                    "batch": batch, "seq": seq},
+    }
+
+
+def main():
+    """Never exits non-zero: tries the Pallas flash path, then the XLA sdpa
+    fallback, then a smaller config, and as a last resort reports the error
+    inside a well-formed JSON line."""
+    import traceback
+
+    attempts = [
+        {"use_pallas": True, "shrink": 0},
+        {"use_pallas": False, "shrink": 0},
+        {"use_pallas": True, "shrink": 1},
+        {"use_pallas": False, "shrink": 1},
+    ]
+    errors = []
+    for kw in attempts:
+        try:
+            result = run(**kw)
+            if errors:
+                result["recovered_from"] = errors[-1][:300]
+            print(json.dumps(result))
+            return
+        except Exception:
+            errors.append(traceback.format_exc().strip().split("\n")[-1])
+    print(json.dumps({
+        "metric": "llama_pretrain_mfu", "value": 0.0,
+        "unit": "fraction_of_peak", "vs_baseline": 0.0,
+        "error": "; ".join(e[:200] for e in errors[-2:]),
     }))
 
 

@@ -118,7 +118,10 @@ class TracedFunction:
                 out_treedef_box.append(out_treedef)
             return out_arrays, new_state
 
-        jitted = jax.jit(jittable)
+        # Donating the state pytree lets XLA update params/optimizer
+        # accumulators in place — without it a training step holds two full
+        # copies of the optimizer state (OOM for ~1B params on one chip).
+        jitted = jax.jit(jittable, donate_argnums=(0,) if self._donate else ())
         return jitted, out_treedef_box
 
     def __call__(self, *args, **kwargs):
@@ -136,8 +139,12 @@ class TracedFunction:
                 static_leaves.append(l)
                 sg_flags.append(True)
         self._sg_flags = sg_flags
+        # sg_flags is read by the traced closure, so it MUST be part of the
+        # guard key: two calls with identical shapes but different
+        # stop_gradient patterns need distinct compiled programs.
         key = (treedef, tuple(_hashable(l) for l in static_leaves),
-               tuple((tuple(a.shape), str(a.dtype)) for a in tensor_arrays))
+               tuple((tuple(a.shape), str(a.dtype)) for a in tensor_arrays),
+               tuple(sg_flags))
         entry = self._cache.get(key)
         if entry is None:
             entry = self._make_jitted(treedef, static_leaves, len(tensor_arrays))

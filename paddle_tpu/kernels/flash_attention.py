@@ -7,12 +7,19 @@ Pallas TPU kernel rather than a vendor-library binding.
 
 Design (see /opt/skills/guides/pallas_guide.md):
   * layout (B, S, H, D) -> kernel works on (B*H, S, D);
-  * grid over (batch*heads, q blocks); K/V stream through VMEM whole
-    (fits comfortably for S <= ~8k at D=128 in bf16) while Q/O are blocked —
-    the MXU sees (block_q, D) x (D, S) matmuls;
-  * online softmax carries running max/denominator in fp32;
-  * backward = custom_vjp with a dq kernel and a dkv kernel, recomputing
-    probabilities from the saved logsumexp (no S^2 residuals).
+  * grid (BH, q blocks, k blocks) with the k dimension innermost: K/V
+    blocks stream through VMEM (Pallas double-buffers the fetches), Q and
+    the fp32 accumulator stay resident in VMEM scratch across the k loop —
+    no whole-K/V residency, so sequence length is HBM-bound, not VMEM-bound;
+  * online softmax carries running max/denominator as (block_q, 128) fp32
+    lane-broadcast scratch (TPU-legal stats layout);
+  * logsumexp is emitted as (BH, 1, Sq) so its BlockSpec (1, 1, block_q)
+    satisfies Mosaic's (8, 128) last-two-dims rule (second-to-last == array
+    dim, last % 128 == 0 or == Sq) — validated on real v5e hardware;
+  * causal runs skip fully-masked K/V blocks' compute via pl.when;
+  * backward = custom_vjp with a dq kernel (grid (BH, nq, nk)) and a dkv
+    kernel (grid (BH, nk, nq)), recomputing probabilities from the saved
+    logsumexp (no S^2 residuals).
 Falls back to the XLA composition automatically when shapes don't fit
 (caller: nn.functional.scaled_dot_product_attention).
 """
@@ -23,7 +30,9 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["flash_attention_bshd"]
 
@@ -37,145 +46,188 @@ def _interpret_mode():
     return _INTERPRET_CACHE[0]
 
 
-NEG_INF = -1e30
+NEG_INF = np.float32(-1e30)
+_STATS_LANES = 128  # lane width for the m/l running-stat scratch
+_I0 = np.int32(0)   # index-map zero: the package enables x64, and Mosaic
+                    # rejects i64 index-map results, so pin literals to i32
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
-                block_k, q_offset_blocks):
+def _causal_block_mask(s, qi, ki, block_q, block_k, q_offset):
+    """In-block causal mask: key pos <= query pos + q_offset."""
+    bq, bk = s.shape
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(k_pos <= q_pos + q_offset, s, NEG_INF)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, sm_scale, causal, block_q, block_k, nk, q_offset):
+    sm_scale = np.float32(sm_scale)  # strong f32: x64 mode makes bare
+    # python/np floats f64, which Mosaic cannot store into f32 refs
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)          # (bq, D)
-    bq = q.shape[0]
-    S = k_ref.shape[1]
-    nk = S // block_k
+    ki = pl.program_id(2)
 
-    def body(ki, carry):
-        acc, m_prev, l_prev = carry
-        k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # A K/V block is entirely above the causal diagonal iff its first key
+    # position exceeds the last query position (+offset): skip its compute.
+    contributes = (ki * block_k <= qi * block_q + (block_q - 1) + q_offset) \
+        if causal else (ki >= 0)
+
+    @pl.when(contributes)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)           # (bq, D)
+        k = k_ref[0].astype(jnp.float32)           # (bk, D)
+        v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * sm_scale
         if causal:
-            q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
-            # allow keys up to q_pos + key_offset (prefill-with-cache)
-            s = jnp.where(k_pos <= q_pos + q_offset_blocks, s, NEG_INF)
-        m_cur = jnp.max(s, axis=1)
+            s = _causal_block_mask(s, qi, ki, block_q, block_k, q_offset)
+        m_prev = m_ref[:, :1]                      # (bq, 1), lanes equal
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)  # (bq, 1)
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m_prev - m_new)
-        l_new = l_prev * alpha + jnp.sum(p, axis=1)
-        acc = acc * alpha[:, None] + jax.lax.dot_general(
+        p = jnp.exp(s - m_new)                     # (bq, bk)
+        alpha = jnp.exp(m_prev - m_new)            # (bq, 1)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-        return acc, m_new, l_new
 
-    acc0 = jnp.zeros((bq, q_ref.shape[2]), jnp.float32)
-    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq,), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, nk, body, (acc0, m0, l0))
-    safe_l = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / safe_l[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(safe_l)).astype(jnp.float32)
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        safe_l = jnp.maximum(l, np.float32(1e-30))
+        o_ref[0] = (acc_ref[...] / safe_l).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_ref[:, 0] + jnp.log(safe_l[:, 0])
 
 
 def _fwd(q, k, v, sm_scale, causal, block_q, block_k):
+    """(BH, Sq, D) x (BH, Sk, D)^2 -> out (BH, Sq, D), lse (BH, Sq) f32."""
     BH, Sq, D = q.shape
     Sk = k.shape[1]
-    grid = (BH, Sq // block_q)
-    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
-                               block_k=block_k,
-                               q_offset_blocks=Sk - Sq)
-    out, lse = pl.pallas_call(
+    nq = Sq // block_q
+    nk = Sk // block_k
+    grid = (BH, nq, nk)
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
+        block_k=block_k, nk=nk, q_offset=Sk - Sq)
+    out, lse3 = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, _I0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, _I0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, _I0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, _I0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, _I0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((BH, Sq), jnp.float32),
+            jax.ShapeDtypeStruct((BH, 1, Sq), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, _STATS_LANES), jnp.float32),
+            pltpu.VMEM((block_q, _STATS_LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret_mode(),
     )(q, k, v)
-    return out, lse
+    return out, lse3[:, 0, :]
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, delta_ref, do_ref, lse_ref, dq_ref, *,
-               sm_scale, causal, block_k, q_offset):
+def _dq_kernel(q_ref, k_ref, v_ref, delta_ref, do_ref, lse_ref, dq_ref,
+               dq_acc_ref, *, sm_scale, causal, block_q, block_k, nk,
+               q_offset):
+    sm_scale = np.float32(sm_scale)  # strong f32: x64 mode makes bare
+    # python/np floats f64, which Mosaic cannot store into f32 refs
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    delta = delta_ref[0]                       # (bq,) = sum(do*o) per row
-    lse = lse_ref[0]
-    bq = q.shape[0]
-    S = k_ref.shape[1]
-    nk = S // block_k
+    ki = pl.program_id(2)
 
-    def body(ki, dq):
-        k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
+
+    contributes = (ki * block_k <= qi * block_q + (block_q - 1) + q_offset) \
+        if causal else (ki >= 0)
+
+    @pl.when(contributes)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        delta = delta_ref[0, 0][:, None]           # (bq, 1)
+        lse = lse_ref[0, 0][:, None]               # (bq, 1)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
-            q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
-            s = jnp.where(k_pos <= q_pos + q_offset, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])
+            s = _causal_block_mask(s, qi, ki, block_q, block_k, q_offset)
+        p = jnp.exp(s - lse)                       # (bq, bk)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * sm_scale
-        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
-                                        preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dq_acc_ref[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
-    dq = jax.lax.fori_loop(0, nk, body, jnp.zeros_like(q))
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc_ref[...].astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, delta_ref, do_ref, lse_ref, dk_ref,
-                dv_ref, *, sm_scale, causal, block_q, q_offset):
+                dv_ref, dk_acc_ref, dv_acc_ref, *, sm_scale, causal, block_q,
+                block_k, nq, q_offset):
+    sm_scale = np.float32(sm_scale)  # strong f32: x64 mode makes bare
+    # python/np floats f64, which Mosaic cannot store into f32 refs
     ki = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)          # (bk, D)
-    v = v_ref[0].astype(jnp.float32)
-    bk = k.shape[0]
-    Sq = q_ref.shape[1]
-    nq = Sq // block_q
+    qi = pl.program_id(2)
 
-    def body(qi, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-        delta = delta_ref[0, pl.ds(qi * block_q, block_q)]
-        lse = lse_ref[0, pl.ds(qi * block_q, block_q)]
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
+
+    # A q block contributes to this k block iff its last query position
+    # (+offset) reaches the k block's first key position.
+    contributes = (qi * block_q + (block_q - 1) + q_offset >= ki * block_k) \
+        if causal else (qi >= 0)
+
+    @pl.when(contributes)
+    def _step():
+        k = k_ref[0].astype(jnp.float32)           # (bk, D)
+        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)           # (bq, D)
+        do = do_ref[0].astype(jnp.float32)
+        delta = delta_ref[0, 0][:, None]
+        lse = lse_ref[0, 0][:, None]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, bk), 0)
-            k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
-            s = jnp.where(k_pos <= q_pos + q_offset, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])          # (bq, bk)
-        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
+            s = _causal_block_mask(s, qi, ki, block_q, block_k, q_offset)
+        p = jnp.exp(s - lse)                       # (bq, bk)
+        dv_acc_ref[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * sm_scale
-        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
-        return dk, dv
+        ds = p * (dp - delta) * sm_scale
+        dk_acc_ref[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
-    dk0 = jnp.zeros_like(k)
-    dv0 = jnp.zeros_like(v)
-    dk, dv = jax.lax.fori_loop(0, nq, body, (dk0, dv0))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc_ref[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
 
 
 def _bwd(sm_scale, causal, block_q, block_k, res, dout):
@@ -193,46 +245,61 @@ def _bwd_with_delta(sm_scale, causal, block_q, block_k, q, k, v, delta, lse,
     BH, Sq, D = q.shape
     Sk = k.shape[1]
     q_offset = Sk - Sq
+    nq = Sq // block_q
+    nk = Sk // block_k
+    delta3 = delta[:, None, :]                     # (BH, 1, Sq)
+    lse3 = lse[:, None, :]
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
-                          block_k=block_k, q_offset=q_offset),
-        grid=(BH, Sq // block_q),
+                          block_q=block_q, block_k=block_k, nk=nk,
+                          q_offset=q_offset),
+        grid=(BH, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, _I0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, _I0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, _I0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, _I0, i)),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, _I0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, _I0, i)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, _I0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret_mode(),
-    )(q, k, v, delta, dout, lse)
+    )(q, k, v, delta3, dout, lse3)
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, q_offset=q_offset),
-        grid=(BH, Sk // block_k),
+                          block_q=block_q, block_k=block_k, nq=nq,
+                          q_offset=q_offset),
+        grid=(BH, nk, nq),
         in_specs=[
-            pl.BlockSpec((1, Sq, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, Sq), lambda b, i: (b, 0)),
-            pl.BlockSpec((1, Sq, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, Sq), lambda b, i: (b, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, _I0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, _I0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, _I0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, _I0, i)),
+            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, _I0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, _I0, i)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, _I0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, _I0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, k.dtype),
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret_mode(),
-    )(q, k, v, delta, dout, lse)
+    )(q, k, v, delta3, dout, lse3)
     return dq, dk, dv
 
 
@@ -255,24 +322,42 @@ _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
 def _pick_block(n, target):
-    b = min(target, n)
+    """Pick a block along a sequence axis: either the whole axis (always
+    legal — BlockSpec dims equal to the array dims pass the Mosaic (8,128)
+    rule) or a divisor that is a multiple of 128. The 128 constraint comes
+    from the q axis, whose (1, 1, block_q) lse/delta specs put block_q in
+    the lane dimension; k blocks share the same picker so both stay
+    MXU-tile aligned."""
+    if n <= target or n % 128 != 0:
+        return n
+    b = target
     while n % b != 0:
-        b //= 2
-    return max(b, 1)
+        b -= 128
+    return max(b, 128)
+
+
+def _pick_block_q(sq, target=256):
+    return _pick_block(sq, target)
+
+
+def _pick_block_k(sk, target=512):
+    return _pick_block(sk, target)
 
 
 def check_supported(q_shape, k_shape, dtype):
     """Raises ValueError for shapes the kernel doesn't support (caller falls
-    back to the XLA composition)."""
+    back to the XLA composition). K/V stream through VMEM in blocks, so
+    sequence length is not VMEM-bound; only tiling legality is checked."""
     B, Sq, H, D = q_shape
     Sk = k_shape[1]
     if D > 256 or D % 8 != 0:
         raise ValueError(f"head_dim {D} unsupported")
     if Sq % 8 != 0 or Sk % 8 != 0:
         raise ValueError("seq len must be multiple of 8")
-    # VMEM budget: whole K/V per (batch,head) must fit
-    if Sk * D * max(jnp.dtype(dtype).itemsize, 2) > 8 * 1024 * 1024:
-        raise ValueError("K/V too large for single-pass VMEM streaming")
+    if Sq % 128 != 0 and Sq > 1024:
+        raise ValueError("long Sq must be a multiple of 128")
+    if Sk % 128 != 0 and Sk > 1024:
+        raise ValueError("long Sk must be a multiple of 128")
 
 
 def flash_attention_bshd(q, k, v, causal=False, sm_scale=None):
@@ -282,8 +367,8 @@ def flash_attention_bshd(q, k, v, causal=False, sm_scale=None):
     check_supported(tuple(q.shape), tuple(k.shape), q.dtype)
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(D)
-    block_q = _pick_block(Sq, 256)
-    block_k = _pick_block(Sk, 512)
+    block_q = _pick_block_q(Sq)
+    block_k = _pick_block_k(Sk)
 
     def to_bhsd(x):
         return jnp.swapaxes(x, 1, 2).reshape(x.shape[0] * x.shape[2],

@@ -32,7 +32,7 @@ from jax import lax
 
 from .flash_attention import _bwd_with_delta as _flash_step_bwd
 from .flash_attention import _fwd as _flash_step_fwd
-from .flash_attention import _pick_block, check_supported
+from .flash_attention import _pick_block_k, _pick_block_q, check_supported
 
 __all__ = ["ring_flash_attention", "ulysses_attention"]
 
@@ -200,8 +200,8 @@ def ring_flash_attention(q, k, v, axis_name="sep", causal=True, sm_scale=None):
     check_supported((B, S, H, D), (B, S, H, D), q.dtype)
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(D)
-    block_q = _pick_block(S, 256)
-    block_k = _pick_block(S, 512)
+    block_q = _pick_block_q(S)
+    block_k = _pick_block_k(S)
 
     def to_flat(x):
         return jnp.swapaxes(x, 1, 2).reshape(x.shape[0] * x.shape[2],

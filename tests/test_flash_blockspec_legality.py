@@ -1,0 +1,84 @@
+"""Mosaic BlockSpec legality checks for the Pallas attention kernels.
+
+Round-1 lesson: interpret=True hides TPU tiling violations from CPU tests
+(the lse (1, block_q) out-spec crashed only on real hardware). These tests
+replicate Mosaic's `_check_block_mappings` rule — the last two dims of every
+block shape must be divisible by (8, 128) respectively, or equal the
+corresponding array dims — and assert it over every BlockSpec the kernels
+construct, for a sweep of realistic TPU shapes.
+"""
+import pytest
+
+from paddle_tpu.kernels.flash_attention import (_pick_block_q, _pick_block_k,
+                                                check_supported)
+
+
+def mosaic_legal(block_shape, array_shape):
+    """Mosaic TPU rule (jax/_src/pallas/mosaic/lowering.py
+    _check_block_mappings): last two block dims divisible by (8, 128) or
+    equal to the respective array dims."""
+    if len(block_shape) < 2:
+        return True
+    bs, bl = block_shape[-2], block_shape[-1]
+    as_, al = array_shape[-2], array_shape[-1]
+    ok_s = bs % 8 == 0 or bs == as_
+    ok_l = bl % 128 == 0 or bl == al
+    return ok_s and ok_l
+
+
+def _attention_blockspecs(BH, Sq, Sk, D):
+    """Enumerate (block_shape, array_shape) pairs exactly as the fwd/dq/dkv
+    pallas_calls construct them."""
+    bq = _pick_block_q(Sq)
+    bk = _pick_block_k(Sk)
+    assert Sq % bq == 0 and Sk % bk == 0
+    specs = []
+    # fwd + dq: q/o/do blocks, k/v blocks, lse/delta blocks
+    specs += [((1, bq, D), (BH, Sq, D)), ((1, bk, D), (BH, Sk, D)),
+              ((1, 1, bq), (BH, 1, Sq))]
+    # dkv: same block shapes, k-major grid
+    specs += [((1, bk, D), (BH, Sk, D)), ((1, bq, D), (BH, Sq, D)),
+              ((1, 1, bq), (BH, 1, Sq))]
+    return specs
+
+
+SHAPES = [
+    # (BH, Sq, Sk, D): bench shape, long ctx, cross-attn, GQA-ish, small
+    (48, 2048, 2048, 128),
+    (8, 8192, 8192, 128),
+    (8, 32768, 32768, 128),
+    (4, 128, 512, 64),
+    (12, 2048, 2048, 64),
+    (2, 640, 640, 128),
+    (1, 8, 8, 128),
+    (16, 256, 256, 96),
+    (8, 4096, 4096, 256),
+]
+
+
+@pytest.mark.parametrize("BH,Sq,Sk,D", SHAPES)
+def test_blockspecs_tpu_legal(BH, Sq, Sk, D):
+    check_supported((1, Sq, BH, D), (1, Sk, BH, D), "bfloat16")
+    for block, array in _attention_blockspecs(BH, Sq, Sk, D):
+        assert mosaic_legal(block, array), (
+            f"illegal block {block} for array {array} "
+            f"(Sq={Sq}, Sk={Sk}, D={D})")
+
+
+def test_unsupported_shapes_raise():
+    with pytest.raises(ValueError):
+        check_supported((1, 2048, 8, 384), (1, 2048, 8, 384), "bfloat16")  # D
+    with pytest.raises(ValueError):
+        check_supported((1, 2044, 8, 128), (1, 2044, 8, 128), "bfloat16")  # S%8
+    with pytest.raises(ValueError):
+        # long non-128-multiple sequence must fall back to XLA
+        check_supported((1, 1288, 8, 128), (1, 1288, 8, 128), "bfloat16")
+
+
+def test_pick_blocks_divide_and_tile():
+    for s in (8, 128, 256, 640, 1024, 2048, 4096, 8192, 32768, 1152, 896):
+        bq = _pick_block_q(s)
+        bk = _pick_block_k(s)
+        assert s % bq == 0 and s % bk == 0
+        assert bq == s or bq % 128 == 0
+        assert bk == s or bk % 8 == 0

@@ -66,3 +66,35 @@ def test_unsupported_shape_raises():
         check_supported((1, 32, 1, 20), (1, 32, 1, 20), jnp.float32)  # D%8
     with pytest.raises(ValueError):
         check_supported((1, 33, 1, 16), (1, 33, 1, 16), jnp.float32)  # S%8
+
+
+def test_flash_multiblock_streaming_numerics():
+    """Force nq>1, nk>1 so the cross-block online-softmax accumulation,
+    pl.when init/finalize, and causal block-skip paths are exercised (the
+    default pickers use a single block at these small sizes)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.kernels.flash_attention import _flash_core
+
+    rng = np.random.RandomState(7)
+    BH, S, D = 3, 64, 128
+    q, k, v = [jnp.asarray(rng.randn(BH, S, D), jnp.float32) for _ in range(3)]
+
+    def ref(q, k, v, causal):
+        s = jnp.einsum("bqd,bkd->bqk", q, k) / np.sqrt(D)
+        if causal:
+            s = jnp.where(jnp.tril(jnp.ones((S, S), bool)), s, -jnp.inf)
+        return jnp.einsum("bqk,bkd->bqd", jax.nn.softmax(s, -1), v)
+
+    for causal in (False, True):
+        out = _flash_core(q, k, v, 1.0 / np.sqrt(D), causal, 16, 16)
+        r = ref(q, k, v, causal)
+        assert float(jnp.max(jnp.abs(out - r))) < 2e-5
+        g = jax.grad(lambda a, b, c: _flash_core(
+            a, b, c, 1.0 / np.sqrt(D), causal, 16, 16).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda a, b, c: ref(a, b, c, causal).sum(),
+                      argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g, gr):
+            assert float(jnp.max(jnp.abs(a - b))) < 2e-4

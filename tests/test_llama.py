@@ -97,3 +97,35 @@ def test_recompute_matches():
     l2.backward()
     g = m2.model.layers[0].self_attn.q_proj.weight.grad
     assert g is not None
+
+
+def test_to_static_guard_includes_stop_gradient():
+    """Regression: two calls with identical shapes but different
+    stop_gradient patterns must not share a compiled program."""
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+
+    lin = paddle.nn.Linear(4, 4)
+
+    def step(x):
+        y = lin(x).sum()
+        y.backward()
+        g = x.grad
+        out = g.clone() if g is not None else paddle.zeros_like(x)
+        for p in lin.parameters():
+            p.clear_grad()
+        return out
+
+    traced = paddle.jit.to_static(step, state_objects=[lin])
+    x1 = paddle.ones([2, 4])
+    x1.stop_gradient = False
+    g1 = traced(x1)
+    x2 = paddle.ones([2, 4])
+    x2.stop_gradient = True
+    g2 = traced(x2)
+    x3 = paddle.ones([2, 4])
+    x3.stop_gradient = False
+    g3 = traced(x3)
+    assert float(jnp.abs(g1._data).sum()) > 0  # grads flow when requested
+    assert float(jnp.abs(g2._data).sum()) == 0  # no grads when stopped
+    assert float(jnp.abs(g1._data - g3._data).sum()) == 0
