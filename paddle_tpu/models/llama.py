@@ -26,14 +26,15 @@ import jax.numpy as jnp
 from .. import nn
 from ..core.tensor import Tensor
 from ..distributed.fleet.mpu import (ColumnParallelLinear, RowParallelLinear,
-                                     VocabParallelEmbedding, _constraint)
+                                     VocabParallelEmbedding, _constraint,
+                                     mark_sharding)
 from ..nn import functional as F
 from ..ops import manipulation as M
 from ..ops.dispatch import apply_op
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM", "llama_tiny",
-           "llama_3_8b"]
+__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM",
+           "LlamaForCausalLMPipe", "llama_tiny", "llama_3_8b"]
 
 
 @dataclass
@@ -234,6 +235,29 @@ def _recompute_layer(layer, x, cos, sin):
                     *[sd[k] for k in keys], x, cos, sin)
 
 
+def _head_and_loss(h, labels, lm_head, tied_weight):
+    """LM head + shifted masked-mean cross entropy (shared by the plain and
+    pipelined causal-LM heads)."""
+    if lm_head is None:
+        logits = apply_op("tied_head", lambda a, ww: a @ ww.T, h, tied_weight)
+    else:
+        logits = lm_head(h)
+    if labels is None:
+        return logits
+    from ..distributed.fleet.mpu import ParallelCrossEntropy
+    # next-token objective: logits[:, :-1] predict labels[:, 1:]
+    shift_logits = apply_op("shift", lambda a: a[:, :-1, :], logits)
+    shift_labels = apply_op("shift", lambda a: a[:, 1:], labels)
+    loss_t = ParallelCrossEntropy()(shift_logits, shift_labels)
+
+    # masked mean over valid (non-ignore_index) positions
+    def _masked_mean(l, lab):
+        valid = (lab != -100).astype(l.dtype)
+        return jnp.sum(l[..., 0] * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+
+    return apply_op("masked_mean", _masked_mean, loss_t, shift_labels)
+
+
 class LlamaForCausalLM(nn.Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
@@ -251,24 +275,11 @@ class LlamaForCausalLM(nn.Layer):
             h, caches = self.model(input_ids, caches)
         else:
             h = self.model(input_ids)
-        if self.lm_head is None:
-            w = self.model.embed_tokens.weight
-            logits = apply_op("tied_head", lambda a, ww: a @ ww.T, h, w)
-        else:
-            logits = self.lm_head(h)
+        tied = self.model.embed_tokens.weight if self.lm_head is None else None
+        out = _head_and_loss(h, labels, self.lm_head, tied)
         if labels is not None:
-            from ..distributed.fleet.mpu import ParallelCrossEntropy
-            # next-token objective: logits[:, :-1] predict labels[:, 1:]
-            shift_logits = apply_op("shift", lambda a: a[:, :-1, :], logits)
-            shift_labels = apply_op("shift", lambda a: a[:, 1:], labels)
-            loss_t = ParallelCrossEntropy()(shift_logits, shift_labels)
-            # masked mean over valid (non-ignore_index) positions
-            def _masked_mean(l, lab):
-                valid = (lab != -100).astype(l.dtype)
-                return jnp.sum(l[..., 0] * valid) / jnp.maximum(jnp.sum(valid), 1.0)
-            loss = apply_op("masked_mean", _masked_mean, loss_t, shift_labels)
-            return loss
-        return (logits, caches) if caches is not None else logits
+            return out
+        return (out, caches) if caches is not None else out
 
     # -------------------------------------------------------- generation
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
@@ -295,3 +306,195 @@ class LlamaForCausalLM(nn.Layer):
                 out_ids.append(nxt)
                 logits, caches = self.forward(nxt, caches=caches)
             return M.concat(out_ids, axis=1)
+
+
+# ------------------------------------------------------------------ pipeline
+class _LlamaStage(nn.Layer):
+    """One pipeline chunk: `n_layers` consecutive decoder layers."""
+
+    def __init__(self, config: LlamaConfig, n_layers: int):
+        super().__init__()
+        self.layers = nn.LayerList([LlamaDecoderLayer(config)
+                                    for _ in range(n_layers)])
+
+    def forward(self, x, cos, sin):
+        for layer in self.layers:
+            x = layer(x, cos, sin)
+        return x
+
+
+class LlamaForCausalLMPipe(nn.Layer):
+    """Pipeline-parallel Llama with decoder chunks stacked over 'pipe'.
+
+    Parity: the reference expresses pipelined models as a `PipelineLayer`
+    of LayerDescs segmented across stages and scheduled by
+    `PipelineParallel.forward_backward_pipeline` (1F1B,
+    `fleet/meta_parallel/pipeline_parallel.py:565`) or
+    `PipelineParallelWithInterleave` (`:1161`), moving activations with
+    NCCL p2p per micro-step.
+
+    TPU-native: embedding / final norm / LM head are replicated over the
+    pipe axis (sharded over model/data as usual); the homogeneous decoder
+    stack is partitioned into `num_stages * n_virtual` chunks whose
+    parameters are stacked into (n_virtual, num_stages, ...) arrays sharded
+    over 'pipe', and the whole micro-batch schedule runs as one compiled
+    lax.scan with ppermute edges (distributed.pipeline.pipeline_forward).
+    jax AD derives the reverse pipeline; jax.checkpoint bounds activation
+    memory the way 1F1B does. TP composes: the shard_map is manual only on
+    'pipe', so GSPMD still shards the mpu layers inside each stage.
+    """
+
+    def __init__(self, config: LlamaConfig, num_stages: int = 2,
+                 num_microbatches: int = 2, n_virtual: int = 1):
+        super().__init__()
+        self.cfg = config
+        self.num_stages = int(num_stages)
+        self.num_microbatches = int(num_microbatches)
+        self.n_virtual = int(n_virtual)
+        n_chunks = self.num_stages * self.n_virtual
+        if config.num_hidden_layers % n_chunks != 0:
+            raise ValueError(
+                f"num_hidden_layers ({config.num_hidden_layers}) must divide "
+                f"into num_stages*n_virtual ({n_chunks}) chunks")
+        self.layers_per_chunk = config.num_hidden_layers // n_chunks
+
+        self.embed_tokens = VocabParallelEmbedding(config.vocab_size,
+                                                   config.hidden_size)
+        self.norm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size, has_bias=False,
+                gather_output=False)
+        head_dim = config.hidden_size // config.num_attention_heads
+        cos, sin = _rope_cache(head_dim, config.max_position_embeddings,
+                               config.rope_theta)
+        self.register_buffer("rope_cos", Tensor(cos), persistable=False)
+        self.register_buffer("rope_sin", Tensor(sin), persistable=False)
+
+        # Stage template (held out of sublayer registration: its params are
+        # placeholders rebound functionally with per-chunk slices).
+        tmpl = _LlamaStage(config, self.layers_per_chunk)
+        self._tmpl = [tmpl]
+        tmpl_sd = tmpl.state_dict()
+        self._stage_keys = list(tmpl_sd.keys())
+
+        # Build each chunk with its own init randomness and stack:
+        # leaf -> (n_virtual, num_stages, *shape), sharded P(None,'pipe',...)
+        stacks = {k: [] for k in self._stage_keys}
+        for _ in range(n_chunks):
+            blk = _LlamaStage(config, self.layers_per_chunk)
+            sd = blk.state_dict()
+            for k in self._stage_keys:
+                stacks[k].append(sd[k]._data)
+        for k in self._stage_keys:
+            arr = jnp.stack(stacks[k], axis=0)
+            arr = arr.reshape(self.n_virtual, self.num_stages, *arr.shape[1:])
+            p = Tensor(arr, stop_gradient=False)
+            p._is_param = True
+            base_spec = getattr(tmpl_sd[k], "_spec", None)
+            tail = tuple(base_spec) if base_spec is not None else \
+                tuple([None] * (arr.ndim - 2))
+            self.add_parameter(self._pname(k), p)
+            mark_sharding(p, P(None, "pipe", *tail))
+
+    @staticmethod
+    def _pname(key):
+        return "pipe_stages__" + key.replace(".", "__")
+
+    @classmethod
+    def from_causal_lm(cls, model: "LlamaForCausalLM", num_stages: int = 2,
+                       num_microbatches: int = 2, n_virtual: int = 1):
+        """Build a pipelined model carrying `model`'s weights (chunk c holds
+        decoder layers [c*L/C, (c+1)*L/C) at ring pass c // num_stages,
+        device c % num_stages)."""
+        pipe = cls(model.cfg, num_stages=num_stages,
+                   num_microbatches=num_microbatches, n_virtual=n_virtual)
+        pipe.embed_tokens.weight.set_value(model.model.embed_tokens.weight)
+        pipe.norm.weight.set_value(model.model.norm.weight)
+        if pipe.lm_head is not None:
+            pipe.lm_head.weight.set_value(model.lm_head.weight)
+        plain_sd = model.state_dict()
+        n_chunks = pipe.num_stages * pipe.n_virtual
+        for k in pipe._stage_keys:
+            # template key: "layers.<j>.<suffix>"
+            _, j, suffix = k.split(".", 2)
+            leaf = pipe._parameters[pipe._pname(k)]
+            arr = leaf._data
+            for c in range(n_chunks):
+                i = c * pipe.layers_per_chunk + int(j)
+                v, d = divmod(c, pipe.num_stages)
+                src = plain_sd[f"model.layers.{i}.{suffix}"]._data
+                arr = arr.at[v, d].set(src.astype(arr.dtype))
+            leaf._data = arr
+        return pipe
+
+    def forward(self, input_ids, labels=None):
+        from ..distributed.fleet.mpu import current_mesh
+        from ..distributed.pipeline import pipeline_forward
+        from ..jit.api import functional_call
+        from ..kernels.flash_attention import _interpret_mode
+        from ..nn.functional.flash_attention import sdp_kernel
+
+        cfg = self.cfg
+        b, s = input_ids.shape
+        cos = apply_op("rope_slice", lambda c: c[:s], self.rope_cos)
+        sin = apply_op("rope_slice", lambda c: c[:s], self.rope_sin)
+        x = self.embed_tokens(input_ids)
+        if cfg.sequence_parallel:
+            x = apply_op("sp_shard",
+                         lambda a: _constraint(a, P("data", "sep", None)), x)
+
+        tmpl = self._tmpl[0]
+        keys = self._stage_keys
+        leaves = [self._parameters[self._pname(k)] for k in keys]
+        mesh = current_mesh()
+        use_pipe = (mesh is not None and "pipe" in mesh.shape
+                    and mesh.shape["pipe"] == self.num_stages
+                    and self.num_stages > 1)
+        # interpret-mode pallas calls can't be replayed by remat; real TPU
+        # keeps the flash kernel inside the checkpointed stage.
+        use_flash = not _interpret_mode()
+
+        def stage_raw(params, xx, cc, ss):
+            with sdp_kernel(enable_flash=use_flash):
+                return functional_call(tmpl, {k: v for k, v in params.items()},
+                                       Tensor(xx), Tensor(cc), Tensor(ss))._data
+
+        if use_pipe:
+            n_micro = self.num_microbatches
+            if b % n_micro != 0:
+                raise ValueError(f"batch {b} not divisible by "
+                                 f"num_microbatches {n_micro}")
+
+            def pipe_raw(*arrs):
+                pl, (xx, cc, ss) = arrs[:len(keys)], arrs[len(keys):]
+                params = dict(zip(keys, pl))
+                if self.n_virtual == 1:
+                    params = {k: a[0] for k, a in params.items()}
+                micro = xx.reshape(n_micro, b // n_micro, *xx.shape[1:])
+                out = pipeline_forward(
+                    params, micro,
+                    lambda p, xm, cc_, ss_: stage_raw(p, xm, cc_, ss_),
+                    mesh, extras=(cc, ss), n_virtual=self.n_virtual,
+                    remat=True)
+                return out.reshape(b, *out.shape[2:])
+
+            x = apply_op("llama_pipeline", pipe_raw, *leaves, x, cos, sin)
+        else:
+            # No live pipe mesh: run chunks sequentially (same math).
+            def seq_raw(*arrs):
+                pl, (xx, cc, ss) = arrs[:len(keys)], arrs[len(keys):]
+                y = xx
+                for v in range(self.n_virtual):
+                    for d in range(self.num_stages):
+                        pv = {k: a[v, d] for k, a in zip(keys, pl)}
+                        y = stage_raw(pv, y, cc, ss)
+                return y
+
+            x = apply_op("llama_pipeline_seq", seq_raw, *leaves, x, cos, sin)
+
+        x = self.norm(x)
+        tied = self.embed_tokens.weight if self.lm_head is None else None
+        return _head_and_loss(x, labels, self.lm_head, tied)

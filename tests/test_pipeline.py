@@ -78,3 +78,125 @@ def test_fthenb_schedule_order():
     sch = PipelineMicroScheduler(n_stages=2, n_micro=3, schedule="FThenB")
     assert list(sch.steps()) == [("F", 0), ("F", 1), ("F", 2),
                                  ("B", 0), ("B", 1), ("B", 2)]
+
+
+def test_pipeline_interleaved_matches_sequential():
+    """Circular (virtual-pipeline) schedule: chunks visit the device ring
+    n_virtual times; parity vs running all chunks sequentially."""
+    n_stages, n_virtual, n_micro, d = 2, 2, 4, 8
+    rng = np.random.RandomState(2)
+    ws = [jnp.asarray(rng.randn(d, d) * 0.3, jnp.float32)
+          for _ in range(n_stages * n_virtual)]
+    params = stack_stage_params([{"w": w} for w in ws], n_virtual=n_virtual)
+    assert params["w"].shape == (n_virtual, n_stages, d, d)
+    xs = jnp.asarray(rng.randn(n_micro, 2, d), jnp.float32)
+    mesh = _mesh(n_stages)
+
+    def stage_fn(p, x, scale):
+        return jnp.tanh(x @ p["w"]) * scale
+
+    sc = jnp.float32(1.1)
+    out = pipeline_forward(params, xs, stage_fn, mesh, remat=False,
+                           extras=(sc,), n_virtual=n_virtual)
+    ref = xs
+    for w in ws:
+        ref = jnp.tanh(ref @ w) * sc
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_pipeline_interleaved_backward():
+    n_stages, n_virtual, n_micro, d = 2, 2, 4, 4
+    rng = np.random.RandomState(3)
+    ws = [jnp.asarray(rng.randn(d, d) * 0.3, jnp.float32)
+          for _ in range(n_stages * n_virtual)]
+    params = stack_stage_params([{"w": w} for w in ws], n_virtual=n_virtual)
+    xs = jnp.asarray(rng.randn(n_micro, 2, d), jnp.float32)
+    mesh = _mesh(n_stages)
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"])
+
+    def loss_pipe(p):
+        out = pipeline_forward(p, xs, stage_fn, mesh, remat=True,
+                               n_virtual=n_virtual)
+        return jnp.sum(out ** 2)
+
+    def loss_ref(wl):
+        y = xs
+        for w in wl:
+            y = jnp.tanh(y @ w)
+        return jnp.sum(y ** 2)
+
+    g_pipe = jax.grad(loss_pipe)(params)["w"]
+    g_ref = jax.grad(loss_ref)(ws)
+    for c in range(n_stages * n_virtual):
+        v, d_ = divmod(c, n_stages)
+        np.testing.assert_allclose(np.asarray(g_pipe[v, d_]),
+                                   np.asarray(g_ref[c]), atol=1e-4)
+
+
+class TestLlamaPipe:
+    """pp=2 x mp=2 x dp=2 pipelined Llama matches the plain model's loss
+    trajectory (VERDICT r1 item 3)."""
+
+    @pytest.fixture(autouse=True)
+    def _fleet(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed.fleet import fleet, DistributedStrategy
+        st = DistributedStrategy()
+        st.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 2,
+                             "sharding_degree": 1, "sep_degree": 1}
+        fleet.init(is_collective=True, strategy=st)
+        paddle.seed(0)
+        yield
+        fleet._hcg = None
+
+    def test_llama_pipe_loss_trajectory_matches_plain(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                             LlamaForCausalLMPipe, llama_tiny)
+        cfg = llama_tiny(num_hidden_layers=4)
+        plain = LlamaForCausalLM(cfg)
+        pipe = LlamaForCausalLMPipe.from_causal_lm(
+            plain, num_stages=2, num_microbatches=2, n_virtual=2)
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (4, 16)))
+        labels = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (4, 16)))
+        opt_p = paddle.optimizer.AdamW(1e-3, parameters=plain.parameters())
+        opt_q = paddle.optimizer.AdamW(1e-3, parameters=pipe.parameters())
+        for i in range(3):
+            l1 = plain(ids, labels=labels)
+            l1.backward()
+            opt_p.step()
+            opt_p.clear_grad()
+            l2 = pipe(ids, labels=labels)
+            l2.backward()
+            opt_q.step()
+            opt_q.clear_grad()
+            v1 = float(np.asarray(l1._data))
+            v2 = float(np.asarray(l2._data))
+            assert abs(v1 - v2) < 2e-4, (i, v1, v2)
+
+    def test_llama_pipe_to_static_step(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.models.llama import LlamaForCausalLMPipe, llama_tiny
+        cfg = llama_tiny(num_hidden_layers=4)
+        pipe = LlamaForCausalLMPipe(cfg, num_stages=2, num_microbatches=2,
+                                    n_virtual=2)
+        opt = paddle.optimizer.AdamW(1e-3, parameters=pipe.parameters())
+
+        def train_step(ids, labels):
+            loss = pipe(ids, labels=labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        step = paddle.jit.to_static(train_step, state_objects=[pipe, opt])
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (4, 16)))
+        labels = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (4, 16)))
+        losses = [float(np.asarray(step(ids, labels)._data))
+                  for _ in range(3)]
+        assert losses[-1] < losses[0]
+        assert all(np.isfinite(l) for l in losses)
