@@ -7,11 +7,16 @@ MoELayer), gate zoo (`moe/gate/`), capacity/routing kernels
 prune_gate_by_capacity, random_routing, moe_gate_dispatch/moe_combine),
 global_scatter/global_gather collectives.
 
-TPU-native: routing is dense and static-shaped (capacity-bounded one-hot
-dispatch einsums — the standard TPU MoE formulation), so XLA keeps
-everything on the MXU with no host sync; expert parallelism shards the
-expert dim of the dispatched tensor over the 'model'(EP) axis and GSPMD
-emits the all_to_all the reference issues via global_scatter/global_gather.
+TPU-native: routing is static-shaped sort-based dispatch (the
+moe_gate_dispatch/moe_combine kernel pair, built from argsort +
+scatter/gather instead of CUDA kernels) — O(T·k + E·C) memory, no
+(T, E, C) one-hot tensors, no host sync. All experts execute as ONE
+batched computation (vmap over stacked expert parameters), so the HLO is
+O(1) in the number of experts. Expert parallelism shards the expert dim of
+the dispatched (E, C, d) tensor over the 'model'(EP) axis and GSPMD emits
+the all_to_all the reference issues via global_scatter/global_gather; the
+explicit-collective formulation (`global_scatter`/`global_gather` below)
+is available for shard_map code.
 """
 from __future__ import annotations
 
@@ -26,7 +31,8 @@ from ..nn.layer.layers import Layer
 from ..ops.dispatch import apply_op
 
 __all__ = ["TopKGate", "SwitchGate", "MoELayer", "moe_dispatch_combine",
-           "number_count", "limit_by_capacity"]
+           "moe_combine", "number_count", "limit_by_capacity",
+           "global_scatter", "global_gather"]
 
 
 def number_count(gate_idx, upper_range):
@@ -44,52 +50,92 @@ def limit_by_capacity(expert_count, capacity, n_worker=1):
     return apply_op("limit_by_capacity", _f, expert_count)
 
 
-def _one_hot_dispatch(gates_arr, topk, capacity):
-    """Build dispatch/combine tensors from gate probabilities.
+def _sort_dispatch(x, gates, topk, capacity):
+    """Sort-based capacity routing (the moe_gate_dispatch kernel).
 
-    gates_arr: (tokens, experts) softmax probabilities.
-    Returns (dispatch (tokens, experts, capacity) bool-ish float,
-             combine (tokens, experts, capacity) float weights,
-             aux_loss scalar).
+    x: (T, d); gates: (T, E) softmax probabilities.
+    Returns (expert_in (E, C, d), slot_tok (E*C,) int token index per slot,
+    slot_w (E*C,) combine weight per slot — 0 for empty slots, aux scalar).
+
+    Tokens are assigned to their top-k experts; assignments are sorted by
+    expert id (stable, so earlier tokens win capacity), positions within
+    each expert group come from the group offsets, and assignments past
+    `capacity` are dropped — all static shapes, no host sync.
     """
-    T, E = gates_arr.shape
-    # top-k expert choice per token
-    topk_val, topk_idx = jax.lax.top_k(gates_arr, topk)           # (T, k)
-    # renormalize chosen gate weights
+    T, d = x.shape
+    E = gates.shape[1]
+    N = T * topk
+    topk_val, topk_idx = jax.lax.top_k(gates, topk)            # (T, k)
     topk_val = topk_val / jnp.maximum(
         jnp.sum(topk_val, axis=-1, keepdims=True), 1e-9)
-
-    dispatch = jnp.zeros((T, E, capacity), gates_arr.dtype)
-    combine = jnp.zeros((T, E, capacity), gates_arr.dtype)
-    # position of each token within its expert's capacity buffer
-    for j in range(topk):
-        e_j = topk_idx[:, j]                                       # (T,)
-        onehot = jax.nn.one_hot(e_j, E, dtype=gates_arr.dtype)     # (T, E)
-        pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot          # (T, E)
-        pos_tok = jnp.sum(pos, axis=1).astype(jnp.int32)           # (T,)
-        keep = pos_tok < capacity
-        cap_onehot = jax.nn.one_hot(jnp.where(keep, pos_tok, capacity),
-                                    capacity + 1,
-                                    dtype=gates_arr.dtype)[:, :capacity]
-        d_j = onehot[:, :, None] * cap_onehot[:, None, :]          # (T,E,C)
-        dispatch = dispatch + d_j
-        combine = combine + d_j * topk_val[:, j][:, None, None]
-
-    # load-balancing aux loss (GShard): E * sum_e mean(gates_e)*mean(frac_e)
-    me = jnp.mean(gates_arr, axis=0)
-    frac = jnp.mean(dispatch.sum(axis=2), axis=0)
+    flat_e = topk_idx.reshape(-1)                              # (N,)
+    flat_w = topk_val.reshape(-1)
+    flat_t = jnp.arange(N, dtype=flat_e.dtype) // topk         # token ids
+    order = jnp.argsort(flat_e, stable=True)
+    se, st, sw = flat_e[order], flat_t[order], flat_w[order]
+    counts = jnp.bincount(flat_e, length=E)                    # (E,)
+    starts = jnp.cumsum(counts) - counts
+    pos = jnp.arange(N, dtype=counts.dtype) - starts[se]
+    keep = pos < capacity
+    # slot id within the flat (E*C,) buffer; dropped tokens -> sentinel E*C
+    slot = jnp.where(keep, se * capacity + pos, E * capacity)
+    z = jnp.zeros((E * capacity + 1,), st.dtype)
+    slot_tok = z.at[slot].set(st)[:-1]
+    slot_w = jnp.zeros((E * capacity + 1,), gates.dtype).at[slot].set(sw)[:-1]
+    slot_valid = jnp.zeros((E * capacity + 1,), bool).at[slot].set(True)[:-1]
+    expert_in = jnp.where(slot_valid[:, None], x[slot_tok], 0)
+    expert_in = expert_in.reshape(E, capacity, d)
+    # load-balancing aux loss (GShard): E * sum_e mean(gates_e)*frac_e
+    me = jnp.mean(gates, axis=0)
+    frac = jnp.minimum(counts, capacity).astype(gates.dtype) / T
     aux = E * jnp.sum(me * frac)
-    return dispatch, combine, aux
+    return expert_in, slot_tok, slot_w * slot_valid, aux
+
+
+def _sort_combine(expert_out, slot_tok, slot_w, num_tokens):
+    """Scatter-add expert outputs back to tokens (the moe_combine kernel)."""
+    EC, d = expert_out.shape[0] * expert_out.shape[1], expert_out.shape[2]
+    flat = expert_out.reshape(EC, d) * slot_w[:, None]
+    return jnp.zeros((num_tokens, d), expert_out.dtype).at[slot_tok].add(flat)
 
 
 def moe_dispatch_combine(x, gates, topk, capacity):
     """x: (tokens, d); gates: (tokens, experts). Returns (expert_inputs
-    (experts, capacity, d), combine, aux)."""
+    (experts, capacity, d), combine_info (slot_tok, slot_w), aux).
+    Feed combine_info to `moe_combine` after running the experts."""
     def _f(xx, gg):
-        dispatch, combine, aux = _one_hot_dispatch(gg, topk, capacity)
-        expert_in = jnp.einsum("tec,td->ecd", dispatch, xx)
-        return expert_in, combine, aux
+        expert_in, slot_tok, slot_w, aux = _sort_dispatch(xx, gg, topk,
+                                                          capacity)
+        return expert_in, (slot_tok, slot_w), aux
     return apply_op("moe_dispatch", _f, x, gates)
+
+
+def moe_combine(expert_out, combine_info, num_tokens):
+    """expert_out: (E, C, d); combine_info from moe_dispatch_combine."""
+    slot_tok, slot_w = combine_info
+    return apply_op(
+        "moe_combine",
+        lambda eo, stok, sw: _sort_combine(eo, stok, sw, num_tokens),
+        expert_out, slot_tok, slot_w)
+
+
+# ------------------------------------------------- explicit EP collectives
+def global_scatter(local_expert_inputs, axis="model"):
+    """Inside shard_map: exchange per-expert token slabs so each EP rank
+    holds its own experts' tokens from every rank.
+
+    (E, C, d) -> (E/n, n*C, d) over mesh axis `axis` (n = axis size).
+    Parity: global_scatter collective
+    (`fluid/operators/collective/global_scatter_op.cc`)."""
+    return jax.lax.all_to_all(local_expert_inputs, axis,
+                              split_axis=0, concat_axis=1, tiled=True)
+
+
+def global_gather(local_expert_outputs, axis="model"):
+    """Inverse of global_scatter: (E/n, n*C, d) -> (E, C, d).
+    Parity: global_gather collective."""
+    return jax.lax.all_to_all(local_expert_outputs, axis,
+                              split_axis=1, concat_axis=0, tiled=True)
 
 
 class TopKGate(Layer):
@@ -119,7 +165,9 @@ class SwitchGate(TopKGate):
 class MoELayer(Layer):
     """Mixture-of-experts layer. Parity: moe_layer.py MoELayer.
 
-    experts: LayerList of expert networks (identical structure). With an
+    experts: LayerList of expert networks (identical structure). All
+    experts run as ONE vmapped computation over their stacked parameters —
+    compile time and HLO size are O(1) in the expert count. With a
     'model'/EP mesh axis live, the (experts, capacity, d) dispatched tensor
     is sharding-constrained on the expert dim, so XLA all_to_alls tokens to
     the expert's owner — the global_scatter/global_gather path.
@@ -143,7 +191,11 @@ class MoELayer(Layer):
         self.aux_loss = None
 
     def forward(self, x):
+        from ..jit.api import functional_call
         from ..ops import manipulation as M
+        from .fleet.mpu import _constraint
+        from jax.sharding import PartitionSpec as P
+
         orig_shape = x.shape
         tokens = 1
         for s in orig_shape[:-1]:
@@ -152,21 +204,32 @@ class MoELayer(Layer):
         gates = self.gate(xf)
         capacity = max(1, int(self.capacity_factor * tokens * self.topk /
                               self.num_experts))
-        expert_in, combine, aux = moe_dispatch_combine(xf, gates, self.topk,
-                                                       capacity)
+        E = self.num_experts
+        topk = self.topk
+        tmpl = self.experts[0]
+        keys = list(tmpl.state_dict().keys())
+        # all expert parameters enter the tape op so grads flow per expert
+        expert_params = [self.experts[e].state_dict()[k]
+                         for e in range(E) for k in keys]
+
+        def _f(xx, gg, *flat):
+            expert_in, slot_tok, slot_w, aux = _sort_dispatch(
+                xx, gg, topk, capacity)
+            # EP sharding hint: expert dim over the model axis (GSPMD emits
+            # the global_scatter all_to_all here)
+            expert_in = _constraint(expert_in, P("model", None, None))
+            stacked = {k: jnp.stack([flat[e * len(keys) + j]
+                                     for e in range(E)])
+                       for j, k in enumerate(keys)}
+
+            def one(params, xin):
+                return functional_call(tmpl, params, Tensor(xin))._data
+
+            expert_out = jax.vmap(one)(stacked, expert_in)    # (E, C, d)
+            expert_out = _constraint(expert_out, P("model", None, None))
+            out = _sort_combine(expert_out, slot_tok, slot_w, tokens)
+            return out, aux
+
+        out, aux = apply_op("moe_layer", _f, xf, gates, *expert_params)
         self.aux_loss = aux
-        # EP sharding hint: expert dim over the model axis
-        from .fleet.mpu import _constraint
-        from jax.sharding import PartitionSpec as P
-        expert_in = apply_op(
-            "ep_shard", lambda a: _constraint(a, P("model", None, None)),
-            expert_in)
-        # run experts (static python loop -> XLA sees E parallel branches)
-        parts = M.split(expert_in, self.num_experts, axis=0)
-        outs = [self.experts[e](M.squeeze(parts[e], 0))
-                for e in range(self.num_experts)]
-        expert_out = M.stack(outs, axis=0)                 # (E, C, d)
-        out = apply_op("moe_combine",
-                       lambda c, eo: jnp.einsum("tec,ecd->td", c, eo),
-                       combine, expert_out)
         return M.reshape(out, orig_shape)
