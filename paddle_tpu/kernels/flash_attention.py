@@ -2,8 +2,9 @@
 
 Capability parity: reference flash-attention integration
 (`paddle/phi/kernels/gpu/flash_attn_kernel.cu` dynloading FA2, python API
-`python/paddle/nn/functional/flash_attention.py:242`). Rebuilt as a native
-Pallas TPU kernel rather than a vendor-library binding.
+`python/paddle/nn/functional/flash_attention.py:242` flash_attention,
+`:1098` flashmask_attention, varlen `flash_attn_unpadded`). Rebuilt as a
+native Pallas TPU kernel rather than a vendor-library binding.
 
 Design (see /opt/skills/guides/pallas_guide.md):
   * layout (B, S, H, D) -> kernel works on (B*H, S, D);
@@ -17,6 +18,14 @@ Design (see /opt/skills/guides/pallas_guide.md):
     satisfies Mosaic's (8, 128) last-two-dims rule (second-to-last == array
     dim, last % 128 == 0 or == Sq) — validated on real v5e hardware;
   * causal runs skip fully-masked K/V blocks' compute via pl.when;
+  * varlen (cu_seqlens) runs pass per-token segment ids as (B, 1, S) int32
+    blocks; cross-segment scores are masked in-block and K/V blocks whose
+    segment range doesn't overlap the q block's are skipped entirely;
+  * flashmask runs pass the (B, Hm, Sk, C) startend_row_indices as
+    (B*Hm, C, Sk) column-bound blocks — the mask is reconstructed per
+    (q block, k block) tile from O(S*C) bounds, never materialized as a
+    dense (B, H, Sq, Sk) tensor; for the causal C==1 (document-mask) case,
+    K/V blocks that the bounds mask out completely are skipped;
   * backward = custom_vjp with a dq kernel (grid (BH, nq, nk)) and a dkv
     kernel (grid (BH, nk, nq)), recomputing probabilities from the saved
     logsumexp (no S^2 residuals).
@@ -34,7 +43,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["flash_attention_bshd"]
+__all__ = ["flash_attention_bshd", "flash_attention_varlen_bshd",
+           "flashmask_attention_bshd"]
 
 _INTERPRET_CACHE = [None]
 
@@ -60,12 +70,118 @@ def _causal_block_mask(s, qi, ki, block_q, block_k, q_offset):
     return jnp.where(k_pos <= q_pos + q_offset, s, NEG_INF)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-                *, sm_scale, causal, block_q, block_k, nk, q_offset):
+def _flashmask_block_mask(s, qi, ki, block_q, block_k, q_offset, fm_blk,
+                          fm_causal, fm_cols):
+    """Apply the flashmask column bounds to an in-block score tile.
+
+    fm_blk: (C, block_k) int32 row bounds for this k block (reference
+    startend_row_indices semantics, flash_attention.py:1098). Row indices
+    are query positions; flashmask requires Sq == Sk (enforced by the
+    wrapper) so the frame matches the XLA fallback exactly.
+    """
+    bq, bk = s.shape
+    rows = (qi * block_q
+            + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0))
+    b = fm_blk.astype(jnp.int32)
+    if fm_causal:
+        if fm_cols == 1:
+            masked = rows >= b[0][None, :]
+        else:
+            masked = (rows >= b[0][None, :]) & (rows < b[1][None, :])
+    else:
+        if fm_cols == 2:
+            masked = (rows >= b[0][None, :]) | (rows < b[1][None, :])
+        else:
+            masked = (((rows >= b[0][None, :]) & (rows < b[1][None, :]))
+                      | ((rows >= b[2][None, :]) & (rows < b[3][None, :])))
+    return jnp.where(masked, NEG_INF, s)
+
+
+def _apply_masks(s, qi, ki, *, block_q, block_k, q_offset, causal,
+                 segq_blk=None, segk_blk=None, posq_blk=None, posk_blk=None,
+                 fm_blk=None, fm_causal=True, fm_cols=0):
+    if causal and segq_blk is None:
+        s = _causal_block_mask(s, qi, ki, block_q, block_k, q_offset)
+    if segq_blk is not None:
+        allow = segq_blk[:, None] == segk_blk[None, :]
+        if causal:
+            # per-sequence causal: key's position within its sequence must
+            # not exceed the query's (length-difference-adjusted) position —
+            # a single packed-global offset would be wrong when per-sequence
+            # q/k lengths differ
+            allow = jnp.logical_and(allow,
+                                    posk_blk[None, :] <= posq_blk[:, None])
+        s = jnp.where(allow, s, NEG_INF)
+    if fm_cols:
+        s = _flashmask_block_mask(s, qi, ki, block_q, block_k, q_offset,
+                                  fm_blk, fm_causal, fm_cols)
+    return s
+
+
+def _masked_exp(s, ref):
+    """exp(s - ref) that yields exactly 0 for masked (-1e30) scores even
+    when `ref` is itself -1e30 (row with no valid key seen yet)."""
+    return jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - ref))
+
+
+def _unpack_refs(refs, n_fixed, use_seg, fm_cols):
+    """Split the variadic pallas ref list into (fixed inputs, segq, segk,
+    fm, rest)."""
+    fixed = refs[:n_fixed]
+    idx = n_fixed
+    segq_ref = segk_ref = fm_ref = None
+    if use_seg:
+        segq_ref, segk_ref = refs[idx], refs[idx + 1]
+        idx += 2
+    if fm_cols:
+        fm_ref = refs[idx]
+        idx += 1
+    return fixed, segq_ref, segk_ref, fm_ref, refs[idx:]
+
+
+def _block_contributes(qi, ki, *, block_q, block_k, q_offset, causal,
+                       segq_blk, segk_blk, posq_blk=None, posk_blk=None,
+                       fm_blk=None, fm_causal=True, fm_cols=0):
+    """Whether this (q block, k block) tile can contain any unmasked score
+    (cheap bound checks -> pl.when skips the matmuls entirely)."""
+    if causal and segq_blk is None:
+        contributes = ki * block_k <= qi * block_q + (block_q - 1) + q_offset
+    else:
+        contributes = ki >= 0
+    if segq_blk is not None:
+        # contiguous segment ids: ranges must overlap
+        overlap = jnp.logical_and(jnp.min(segq_blk) <= jnp.max(segk_blk),
+                                  jnp.max(segq_blk) >= jnp.min(segk_blk))
+        contributes = jnp.logical_and(contributes, overlap)
+        if causal:
+            # the packed-global causal bound is invalid with per-sequence
+            # alignment; skip instead when both blocks sit in one shared
+            # sequence and every key position exceeds every query position
+            one_seq = jnp.logical_and(
+                jnp.min(segq_blk) == jnp.max(segk_blk),
+                jnp.max(segq_blk) == jnp.min(segk_blk))
+            all_future = jnp.min(posk_blk) > jnp.max(posq_blk)
+            contributes = jnp.logical_and(
+                contributes,
+                jnp.logical_not(jnp.logical_and(one_seq, all_future)))
+    if fm_cols == 1 and fm_causal and fm_blk is not None:
+        # document mask: every row/col masked iff first q row >= max(start)
+        q0 = qi * block_q
+        any_open = q0 < jnp.max(fm_blk[0])
+        contributes = jnp.logical_and(contributes, any_open)
+    return contributes
+
+
+def _fwd_kernel(*refs, sm_scale, causal, block_q, block_k, nk, q_offset,
+                use_seg, fm_causal, fm_cols):
     sm_scale = np.float32(sm_scale)  # strong f32: x64 mode makes bare
     # python/np floats f64, which Mosaic cannot store into f32 refs
+    (q_ref, k_ref, v_ref), segq_ref, segk_ref, fm_ref, rest = _unpack_refs(
+        refs, 3, use_seg, fm_cols)
+    o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
+    masked_rows = use_seg or fm_cols  # rows may see no valid key yet
 
     @pl.when(ki == 0)
     def _init():
@@ -73,10 +189,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    # A K/V block is entirely above the causal diagonal iff its first key
-    # position exceeds the last query position (+offset): skip its compute.
-    contributes = (ki * block_k <= qi * block_q + (block_q - 1) + q_offset) \
-        if causal else (ki >= 0)
+    segq_blk = segq_ref[0, 0] if use_seg else None
+    posq_blk = segq_ref[0, 1] if use_seg else None
+    segk_blk = segk_ref[0, 0] if use_seg else None
+    posk_blk = segk_ref[0, 1] if use_seg else None
+    fm_blk = fm_ref[0] if fm_cols else None
+    contributes = _block_contributes(
+        qi, ki, block_q=block_q, block_k=block_k, q_offset=q_offset,
+        causal=causal, segq_blk=segq_blk, segk_blk=segk_blk,
+        posq_blk=posq_blk, posk_blk=posk_blk, fm_blk=fm_blk,
+        fm_causal=fm_causal, fm_cols=fm_cols)
 
     @pl.when(contributes)
     def _step():
@@ -86,14 +208,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s * sm_scale
-        if causal:
-            s = _causal_block_mask(s, qi, ki, block_q, block_k, q_offset)
+        s = _apply_masks(s, qi, ki, block_q=block_q, block_k=block_k,
+                         q_offset=q_offset, causal=causal, segq_blk=segq_blk,
+                         segk_blk=segk_blk, posq_blk=posq_blk,
+                         posk_blk=posk_blk, fm_blk=fm_blk,
+                         fm_causal=fm_causal, fm_cols=fm_cols)
         m_prev = m_ref[:, :1]                      # (bq, 1), lanes equal
         l_prev = l_ref[:, :1]
         m_cur = jnp.max(s, axis=1, keepdims=True)  # (bq, 1)
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)                     # (bq, bk)
-        alpha = jnp.exp(m_prev - m_new)            # (bq, 1)
+        p = _masked_exp(s, m_new) if masked_rows else jnp.exp(s - m_new)
+        alpha = jnp.where(m_prev <= NEG_INF / 2, 0.0,
+                          jnp.exp(m_prev - m_new)) if masked_rows else \
+            jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
@@ -108,24 +235,77 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         lse_ref[0, 0] = m_ref[:, 0] + jnp.log(safe_l[:, 0])
 
 
-def _fwd(q, k, v, sm_scale, causal, block_q, block_k):
-    """(BH, Sq, D) x (BH, Sk, D)^2 -> out (BH, Sq, D), lse (BH, Sq) f32."""
+def _extra_in_specs(B, H, Sq, Sk, block_q, block_k, use_seg, fm_cols, fm_heads,
+                    kmajor=False):
+    """BlockSpecs for the optional segment-id / flashmask inputs.
+
+    Grid order is (bh, i=q block, j=k block) — or (bh, j, i) for the dkv
+    kernel (kmajor=True)."""
+    specs = []
+    if kmajor:
+        def qmap(idx):
+            return lambda b, j, i, _f=idx: _f(b, i, j)
+    else:
+        def qmap(idx):
+            return idx
+
+    def bdiv(b):
+        # b // H via lax.div (b >= 0): jnp floor-division lowers through an
+        # i64 convert under x64, which Mosaic cannot lower (infinite
+        # recursion in its convert fallback — found on real v5e)
+        return jax.lax.div(b, jnp.asarray(H, jnp.int32))
+
+    if use_seg:
+        # rows: [segment id, causal position-within-sequence]
+        specs.append(pl.BlockSpec(
+            (1, 2, block_q), qmap(lambda b, i, j: (bdiv(b), _I0, i))))
+        specs.append(pl.BlockSpec(
+            (1, 2, block_k), qmap(lambda b, i, j: (bdiv(b), _I0, j))))
+    if fm_cols:
+        if fm_heads == 1:
+            specs.append(pl.BlockSpec(
+                (1, fm_cols, block_k),
+                qmap(lambda b, i, j: (bdiv(b), _I0, j))))
+        else:
+            specs.append(pl.BlockSpec(
+                (1, fm_cols, block_k), qmap(lambda b, i, j: (b, _I0, j))))
+    return specs
+
+
+def _fwd(q, k, v, sm_scale, causal, block_q, block_k, seg=None, fm=None,
+         fm_causal=True, H=1):
+    """(BH, Sq, D) x (BH, Sk, D)^2 -> out (BH, Sq, D), lse (BH, Sq) f32.
+
+    seg: optional (segq (B,2,Sq), segk (B,2,Sk)) int32 [segment id;
+    causal position-within-sequence] rows.
+    fm: optional (B*Hm, C, Sk) flashmask bounds."""
     BH, Sq, D = q.shape
     Sk = k.shape[1]
     nq = Sq // block_q
     nk = Sk // block_k
     grid = (BH, nq, nk)
+    use_seg = seg is not None
+    fm_cols = fm.shape[1] if fm is not None else 0
+    fm_heads = (fm.shape[0] * H) // BH if fm is not None else 1
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
-        block_k=block_k, nk=nk, q_offset=Sk - Sq)
+        block_k=block_k, nk=nk, q_offset=Sk - Sq, use_seg=use_seg,
+        fm_causal=fm_causal, fm_cols=fm_cols)
+    in_specs = [
+        pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, _I0)),
+        pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, _I0)),
+        pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, _I0)),
+    ] + _extra_in_specs(BH // H, H, Sq, Sk, block_q, block_k, use_seg,
+                        fm_cols, fm_heads)
+    args = [q, k, v]
+    if use_seg:
+        args += [seg[0], seg[1]]
+    if fm_cols:
+        args.append(fm)
     out, lse3 = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, _I0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, _I0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, _I0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, _I0)),
             pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, _I0, i)),
@@ -142,24 +322,35 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret_mode(),
-    )(q, k, v)
+    )(*args)
     return out, lse3[:, 0, :]
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, delta_ref, do_ref, lse_ref, dq_ref,
-               dq_acc_ref, *, sm_scale, causal, block_q, block_k, nk,
-               q_offset):
+def _dq_kernel(*refs, sm_scale, causal, block_q, block_k, nk, q_offset,
+               use_seg, fm_causal, fm_cols):
     sm_scale = np.float32(sm_scale)  # strong f32: x64 mode makes bare
     # python/np floats f64, which Mosaic cannot store into f32 refs
+    (q_ref, k_ref, v_ref, delta_ref, do_ref, lse_ref), segq_ref, segk_ref, \
+        fm_ref, rest = _unpack_refs(refs, 6, use_seg, fm_cols)
+    dq_ref, dq_acc_ref = rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
+    masked_rows = use_seg or fm_cols
 
     @pl.when(ki == 0)
     def _init():
         dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
 
-    contributes = (ki * block_k <= qi * block_q + (block_q - 1) + q_offset) \
-        if causal else (ki >= 0)
+    segq_blk = segq_ref[0, 0] if use_seg else None
+    posq_blk = segq_ref[0, 1] if use_seg else None
+    segk_blk = segk_ref[0, 0] if use_seg else None
+    posk_blk = segk_ref[0, 1] if use_seg else None
+    fm_blk = fm_ref[0] if fm_cols else None
+    contributes = _block_contributes(
+        qi, ki, block_q=block_q, block_k=block_k, q_offset=q_offset,
+        causal=causal, segq_blk=segq_blk, segk_blk=segk_blk,
+        posq_blk=posq_blk, posk_blk=posk_blk, fm_blk=fm_blk,
+        fm_causal=fm_causal, fm_cols=fm_cols)
 
     @pl.when(contributes)
     def _step():
@@ -171,9 +362,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, delta_ref, do_ref, lse_ref, dq_ref,
         v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
-        if causal:
-            s = _causal_block_mask(s, qi, ki, block_q, block_k, q_offset)
-        p = jnp.exp(s - lse)                       # (bq, bk)
+        s = _apply_masks(s, qi, ki, block_q=block_q, block_k=block_k,
+                         q_offset=q_offset, causal=causal, segq_blk=segq_blk,
+                         segk_blk=segk_blk, posq_blk=posq_blk,
+                         posk_blk=posk_blk, fm_blk=fm_blk,
+                         fm_causal=fm_causal, fm_cols=fm_cols)
+        p = _masked_exp(s, lse) if masked_rows else jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * sm_scale
@@ -185,23 +379,34 @@ def _dq_kernel(q_ref, k_ref, v_ref, delta_ref, do_ref, lse_ref, dq_ref,
         dq_ref[0] = dq_acc_ref[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, delta_ref, do_ref, lse_ref, dk_ref,
-                dv_ref, dk_acc_ref, dv_acc_ref, *, sm_scale, causal, block_q,
-                block_k, nq, q_offset):
+def _dkv_kernel(*refs, sm_scale, causal, block_q, block_k, nq, q_offset,
+                use_seg, fm_causal, fm_cols):
     sm_scale = np.float32(sm_scale)  # strong f32: x64 mode makes bare
     # python/np floats f64, which Mosaic cannot store into f32 refs
+    (q_ref, k_ref, v_ref, delta_ref, do_ref, lse_ref), segq_ref, segk_ref, \
+        fm_ref, rest = _unpack_refs(refs, 6, use_seg, fm_cols)
+    dk_ref, dv_ref, dk_acc_ref, dv_acc_ref = rest
     ki = pl.program_id(1)
     qi = pl.program_id(2)
+    masked_rows = use_seg or fm_cols
 
     @pl.when(qi == 0)
     def _init():
         dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
 
-    # A q block contributes to this k block iff its last query position
-    # (+offset) reaches the k block's first key position.
-    contributes = (qi * block_q + (block_q - 1) + q_offset >= ki * block_k) \
-        if causal else (qi >= 0)
+    segq_blk = segq_ref[0, 0] if use_seg else None
+    posq_blk = segq_ref[0, 1] if use_seg else None
+    segk_blk = segk_ref[0, 0] if use_seg else None
+    posk_blk = segk_ref[0, 1] if use_seg else None
+    fm_blk = fm_ref[0] if fm_cols else None
+    # same skip predicate as fwd/dq: the causal bound "k block start <= q
+    # block end (+offset)" is symmetric in the two grid orders
+    contributes = _block_contributes(
+        qi, ki, block_q=block_q, block_k=block_k, q_offset=q_offset,
+        causal=causal, segq_blk=segq_blk, segk_blk=segk_blk,
+        posq_blk=posq_blk, posk_blk=posk_blk, fm_blk=fm_blk,
+        fm_causal=fm_causal, fm_cols=fm_cols)
 
     @pl.when(contributes)
     def _step():
@@ -213,9 +418,12 @@ def _dkv_kernel(q_ref, k_ref, v_ref, delta_ref, do_ref, lse_ref, dk_ref,
         lse = lse_ref[0, 0][:, None]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
-        if causal:
-            s = _causal_block_mask(s, qi, ki, block_q, block_k, q_offset)
-        p = jnp.exp(s - lse)                       # (bq, bk)
+        s = _apply_masks(s, qi, ki, block_q=block_q, block_k=block_k,
+                         q_offset=q_offset, causal=causal, segq_blk=segq_blk,
+                         segk_blk=segk_blk, posq_blk=posq_blk,
+                         posk_blk=posk_blk, fm_blk=fm_blk,
+                         fm_causal=fm_causal, fm_cols=fm_cols)
+        p = _masked_exp(s, lse) if masked_rows else jnp.exp(s - lse)
         dv_acc_ref[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -230,16 +438,18 @@ def _dkv_kernel(q_ref, k_ref, v_ref, delta_ref, do_ref, lse_ref, dk_ref,
         dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
 
 
-def _bwd(sm_scale, causal, block_q, block_k, res, dout):
+def _bwd(sm_scale, causal, block_q, block_k, res, dout, seg=None, fm=None,
+         fm_causal=True, H=1):
     q, k, v, out, lse = res
     delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)
     return _bwd_with_delta(sm_scale, causal, block_q, block_k,
-                           q, k, v, delta, lse, dout)
+                           q, k, v, delta, lse, dout, seg=seg, fm=fm,
+                           fm_causal=fm_causal, H=H)
 
 
 def _bwd_with_delta(sm_scale, causal, block_q, block_k, q, k, v, delta, lse,
-                    dout):
+                    dout, seg=None, fm=None, fm_causal=True, H=1):
     """delta: (BH, Sq) f32 = sum(dout*out, -1) — precomputed so callers
     (e.g. ring attention) need not carry the full output tensor."""
     BH, Sq, D = q.shape
@@ -249,11 +459,22 @@ def _bwd_with_delta(sm_scale, causal, block_q, block_k, q, k, v, delta, lse,
     nk = Sk // block_k
     delta3 = delta[:, None, :]                     # (BH, 1, Sq)
     lse3 = lse[:, None, :]
+    use_seg = seg is not None
+    fm_cols = fm.shape[1] if fm is not None else 0
+    fm_heads = (fm.shape[0] * H) // BH if fm is not None else 1
+    B = BH // H
+
+    extra_args = []
+    if use_seg:
+        extra_args += [seg[0], seg[1]]
+    if fm_cols:
+        extra_args.append(fm)
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, nk=nk,
-                          q_offset=q_offset),
+                          q_offset=q_offset, use_seg=use_seg,
+                          fm_causal=fm_causal, fm_cols=fm_cols),
         grid=(BH, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, _I0)),
@@ -262,19 +483,21 @@ def _bwd_with_delta(sm_scale, causal, block_q, block_k, q, k, v, delta, lse,
             pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, _I0, i)),
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, _I0)),
             pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, _I0, i)),
-        ],
+        ] + _extra_in_specs(B, H, Sq, Sk, block_q, block_k, use_seg, fm_cols,
+                            fm_heads),
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, _I0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret_mode(),
-    )(q, k, v, delta3, dout, lse3)
+    )(q, k, v, delta3, dout, lse3, *extra_args)
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, nq=nq,
-                          q_offset=q_offset),
+                          q_offset=q_offset, use_seg=use_seg,
+                          fm_causal=fm_causal, fm_cols=fm_cols),
         grid=(BH, nk, nq),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, _I0)),
@@ -283,7 +506,8 @@ def _bwd_with_delta(sm_scale, causal, block_q, block_k, q, k, v, delta, lse,
             pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, _I0, i)),
             pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, _I0)),
             pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, _I0, i)),
-        ],
+        ] + _extra_in_specs(B, H, Sq, Sk, block_q, block_k, use_seg, fm_cols,
+                            fm_heads, kmajor=True),
         out_specs=[
             pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, _I0)),
             pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, _I0)),
@@ -299,10 +523,11 @@ def _bwd_with_delta(sm_scale, causal, block_q, block_k, q, k, v, delta, lse,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret_mode(),
-    )(q, k, v, delta3, dout, lse3)
+    )(q, k, v, delta3, dout, lse3, *extra_args)
     return dq, dk, dv
 
 
+# ------------------------------------------------------------- plain core
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash_core(q, k, v, sm_scale, causal, block_q, block_k):
     out, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k)
@@ -319,6 +544,65 @@ def _flash_core_bwd(sm_scale, causal, block_q, block_k, res, dout):
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def _int_zero(x):
+    """float0 cotangent for integer primal inputs of custom_vjp rules."""
+    return np.zeros(x.shape, jax.dtypes.float0)
+
+
+# ----------------------------------------------------------- varlen core
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_core_seg(q, k, v, segq, segk, sm_scale, causal, block_q, block_k,
+                    H):
+    out, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k,
+                  seg=(segq, segk), H=H)
+    return out
+
+
+def _flash_core_seg_fwd(q, k, v, segq, segk, sm_scale, causal, block_q,
+                        block_k, H):
+    out, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k,
+                    seg=(segq, segk), H=H)
+    return out, (q, k, v, out, lse, segq, segk)
+
+
+def _flash_core_seg_bwd(sm_scale, causal, block_q, block_k, H, res, dout):
+    q, k, v, out, lse, segq, segk = res
+    dq, dk, dv = _bwd(sm_scale, causal, block_q, block_k,
+                      (q, k, v, out, lse), dout, seg=(segq, segk), H=H)
+    return dq, dk, dv, _int_zero(segq), _int_zero(segk)
+
+
+_flash_core_seg.defvjp(_flash_core_seg_fwd, _flash_core_seg_bwd)
+
+
+# -------------------------------------------------------- flashmask core
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash_core_fm(q, k, v, fm, sm_scale, causal, block_q, block_k,
+                   fm_causal, H):
+    out, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k, fm=fm,
+                  fm_causal=fm_causal, H=H)
+    return out
+
+
+def _flash_core_fm_fwd(q, k, v, fm, sm_scale, causal, block_q, block_k,
+                       fm_causal, H):
+    out, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, fm=fm,
+                    fm_causal=fm_causal, H=H)
+    return out, (q, k, v, out, lse, fm)
+
+
+def _flash_core_fm_bwd(sm_scale, causal, block_q, block_k, fm_causal, H,
+                       res, dout):
+    q, k, v, out, lse, fm = res
+    dq, dk, dv = _bwd(sm_scale, causal, block_q, block_k,
+                      (q, k, v, out, lse), dout, fm=fm, fm_causal=fm_causal,
+                      H=H)
+    return dq, dk, dv, _int_zero(fm)
+
+
+_flash_core_fm.defvjp(_flash_core_fm_fwd, _flash_core_fm_bwd)
 
 
 def _pick_block(n, target):
@@ -360,6 +644,15 @@ def check_supported(q_shape, k_shape, dtype):
         raise ValueError("long Sk must be a multiple of 128")
 
 
+def _to_bhsd(x):
+    return jnp.swapaxes(x, 1, 2).reshape(x.shape[0] * x.shape[2],
+                                         x.shape[1], x.shape[3])
+
+
+def _from_bhsd(out, B, H, Sq, D):
+    return jnp.swapaxes(out.reshape(B, H, Sq, D), 1, 2)
+
+
 def flash_attention_bshd(q, k, v, causal=False, sm_scale=None):
     """q,k,v: (B, S, H, D) -> out (B, Sq, H, D)."""
     B, Sq, H, D = q.shape
@@ -369,15 +662,96 @@ def flash_attention_bshd(q, k, v, causal=False, sm_scale=None):
         sm_scale = 1.0 / math.sqrt(D)
     block_q = _pick_block_q(Sq)
     block_k = _pick_block_k(Sk)
+    out = _flash_core(_to_bhsd(q), _to_bhsd(k), _to_bhsd(v), float(sm_scale),
+                      bool(causal), int(block_q), int(block_k))
+    return _from_bhsd(out, B, H, Sq, D)
 
-    def to_bhsd(x):
-        return jnp.swapaxes(x, 1, 2).reshape(x.shape[0] * x.shape[2],
-                                             x.shape[1], x.shape[3])
 
-    qf = to_bhsd(q)
-    kf = to_bhsd(k)
-    vf = to_bhsd(v)
-    out = _flash_core(qf, kf, vf, float(sm_scale), bool(causal),
-                      int(block_q), int(block_k))
-    out = out.reshape(B, H, Sq, D)
-    return jnp.swapaxes(out, 1, 2)
+def _positions_in_segments(seg):
+    """Per-token position within its (contiguous) segment: (B, S) -> (B, S).
+    pos[p] = p - start_of_segment(p), via a cumulative max over boundary
+    indices."""
+    B, S = seg.shape
+    p = jnp.arange(S, dtype=jnp.int32)[None, :]
+    boundary = jnp.where(seg != jnp.roll(seg, 1, axis=1), p, 0)
+    boundary = boundary.at[:, 0].set(0)
+    start = jax.lax.cummax(boundary, axis=1)
+    return p - start
+
+
+def flash_attention_varlen_bshd(q, k, v, q_segment_ids, kv_segment_ids,
+                                causal=False, sm_scale=None,
+                                q_positions=None, kv_positions=None):
+    """Varlen (packed) flash attention via per-token segment ids.
+
+    q,k,v: (B, S, H, D); segment ids: (B, Sq)/(B, Sk) int32 — tokens attend
+    only within their segment (the cu_seqlens formulation of the reference's
+    flash_attn_unpadded packs sequences along S; nn.functional converts
+    cu_seqlens to segment ids). K/V blocks with no segment overlap are
+    skipped.
+
+    Causal masking is PER-SEQUENCE: key position-within-sequence <= query
+    position-within-sequence (positions derived from the segment ids, or
+    passed explicitly via q_positions/kv_positions — flash_attn_unpadded
+    adjusts q positions by the per-sequence k/q length difference for
+    cross-attention packing)."""
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    check_supported(tuple(q.shape), tuple(k.shape), q.dtype)
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(D)
+    block_q = _pick_block_q(Sq)
+    block_k = _pick_block_k(Sk)
+    ids_q = q_segment_ids.astype(jnp.int32).reshape(B, Sq)
+    ids_k = kv_segment_ids.astype(jnp.int32).reshape(B, Sk)
+    if causal:
+        pos_q = (q_positions.astype(jnp.int32).reshape(B, Sq)
+                 if q_positions is not None else _positions_in_segments(ids_q))
+        pos_k = (kv_positions.astype(jnp.int32).reshape(B, Sk)
+                 if kv_positions is not None
+                 else _positions_in_segments(ids_k))
+    else:
+        pos_q = jnp.zeros((B, Sq), jnp.int32)
+        pos_k = jnp.zeros((B, Sk), jnp.int32)
+    segq = jnp.stack([ids_q, pos_q], axis=1)       # (B, 2, Sq)
+    segk = jnp.stack([ids_k, pos_k], axis=1)
+    out = _flash_core_seg(_to_bhsd(q), _to_bhsd(k), _to_bhsd(v), segq, segk,
+                          float(sm_scale), bool(causal), int(block_q),
+                          int(block_k), int(H))
+    return _from_bhsd(out, B, H, Sq, D)
+
+
+def flashmask_attention_bshd(q, k, v, startend_row_indices, causal=True,
+                             sm_scale=None):
+    """Block-sparse flashmask attention (parity: flashmask_attention:1098).
+
+    startend_row_indices: (B, 1|H, Sk, C) int32 with C in {1, 2} (causal)
+    or {2, 4} (non-causal) — per-key-column masked row ranges. The mask is
+    reconstructed tile-by-tile inside the kernel from O(S*C) bounds; no
+    dense (B, H, Sq, Sk) tensor is ever built."""
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    check_supported(tuple(q.shape), tuple(k.shape), q.dtype)
+    if Sq != Sk:
+        # bounds are query-row indices in a square score matrix; the XLA
+        # fallback defines the same frame, so reject rectangles identically
+        raise ValueError("flashmask requires Sq == Sk")
+    Hm = startend_row_indices.shape[1]
+    C = startend_row_indices.shape[3]
+    if Hm not in (1, H):
+        raise ValueError(f"flashmask heads dim {Hm} must be 1 or {H}")
+    if causal and C not in (1, 2):
+        raise ValueError("causal flashmask needs 1 or 2 bound columns")
+    if not causal and C not in (2, 4):
+        raise ValueError("non-causal flashmask needs 2 or 4 bound columns")
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(D)
+    block_q = _pick_block_q(Sq)
+    block_k = _pick_block_k(Sk)
+    # (B, Hm, Sk, C) -> (B*Hm, C, Sk)
+    fm = jnp.swapaxes(startend_row_indices.astype(jnp.int32), 2, 3)
+    fm = fm.reshape(B * Hm, C, Sk)
+    out = _flash_core_fm(_to_bhsd(q), _to_bhsd(k), _to_bhsd(v), fm,
+                         float(sm_scale), bool(causal), int(block_q),
+                         int(block_k), bool(causal), int(H))
+    return _from_bhsd(out, B, H, Sq, D)

@@ -20,16 +20,17 @@ from ...framework.random import rng_key
 from ...ops.dispatch import apply_op
 
 __all__ = ["scaled_dot_product_attention", "flash_attention",
-           "flashmask_attention", "sdp_kernel"]
+           "flash_attn_unpadded", "flashmask_attention", "sdp_kernel"]
 
 _USE_PALLAS = [True]
 
 
-def _sdpa_ref(q, k, v, mask=None, dropout_p=0.0, causal=False, key=None, training=True):
+def _sdpa_ref(q, k, v, mask=None, dropout_p=0.0, causal=False, key=None,
+              training=True, scale=None):
     """(B, S, H, D) attention, fp32 softmax accumulation."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    scale = 1.0 / math.sqrt(d)
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
     qt = jnp.swapaxes(q, 1, 2)  # B,H,S,D
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
@@ -81,18 +82,141 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     return out, None
 
 
+def _segment_ids_from_cu(cu, total):
+    """cu_seqlens (B+1,) prefix sums -> per-position segment ids (total,)."""
+    pos = jnp.arange(total)
+    return jnp.searchsorted(cu[1:].astype(pos.dtype), pos,
+                            side="right").astype(jnp.int32)
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
+                        causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """Varlen (packed) flash attention. Parity: flash_attn_unpadded
+    (reference nn/functional/flash_attention.py).
+
+    query/key/value: (total_tokens, num_heads, head_dim) — sequences packed
+    along dim 0; cu_seqlens_*: (batch+1,) int32 prefix sums. Runs the Pallas
+    varlen kernel (segment-id masking with block skipping) when shapes
+    allow; falls back to a masked XLA composition otherwise.
+    """
+    total_q, H, D = query.shape
+    total_k = key.shape[0]
+
+    def _seg_pos(cq, ck):
+        """Segment ids + per-sequence causal positions. The query position
+        is adjusted by the per-sequence (k_len - q_len) difference so
+        causal means "key pos-in-seq <= query pos-in-seq + len_diff(seq)"
+        — a single packed-global offset is wrong when the differences are
+        non-uniform."""
+        segq = _segment_ids_from_cu(cq, total_q)
+        segk = _segment_ids_from_cu(ck, total_k)
+        pq = jnp.arange(total_q) - jnp.take(cq, segq, mode="clip")
+        pk = jnp.arange(total_k) - jnp.take(ck, segk, mode="clip")
+        qlen = jnp.diff(cq)
+        klen = jnp.diff(ck)
+        ldiff = jnp.take(klen, segq, mode="clip") - jnp.take(qlen, segq,
+                                                             mode="clip")
+        return segq, segk, (pq + ldiff).astype(jnp.int32), pk.astype(jnp.int32)
+
+    can_pallas = _USE_PALLAS[0] and dropout == 0.0
+    if can_pallas:
+        try:
+            from ...kernels import flash_attention as pallas_fa
+            pallas_fa.check_supported((1, total_q, H, D), (1, total_k, H, D),
+                                      query.dtype)
+
+            def _f(q, k, v, cq, ck):
+                segq, segk, pq, pk = _seg_pos(cq, ck)
+                return pallas_fa.flash_attention_varlen_bshd(
+                    q[None], k[None], v[None], segq[None], segk[None],
+                    causal=causal, sm_scale=scale, q_positions=pq[None],
+                    kv_positions=pk[None])[0]
+
+            out = apply_op("flash_attn_unpadded", _f, query, key, value,
+                           cu_seqlens_q, cu_seqlens_k)
+            return out, None
+        except ValueError:
+            pass
+
+    drop_key = rng_key() if (dropout > 0.0 and training) else None
+
+    def _f(q, k, v, cq, ck):
+        segq, segk, pq, pk = _seg_pos(cq, ck)
+        allow = segq[:, None] == segk[None, :]
+        if causal:
+            allow = allow & (pk[None, :] <= pq[:, None])
+        return _sdpa_ref(q[None], k[None], v[None], allow[None, None],
+                         dropout, False, drop_key, training, scale=scale)[0]
+
+    out = apply_op("flash_attn_unpadded", _f, query, key, value,
+                   cu_seqlens_q, cu_seqlens_k)
+    return out, None
+
+
 def flashmask_attention(query, key, value, startend_row_indices=None,
                         dropout=0.0, causal=True, window_size=None, name=None):
     """Sparse-mask attention (parity: flashmask_attention:1098).
 
-    startend_row_indices: (B, H_or_1, S, 1|2|4) int32 — per-column row bounds
-    defining the mask, as in the reference. This implementation materializes
-    the boolean mask from the indices and runs the fused SDPA path; a
-    block-sparse Pallas kernel is the planned upgrade.
+    startend_row_indices: (B, H_or_1, S, 1|2|4) int32 — per-column row
+    bounds defining the mask, as in the reference. Runs a block-sparse
+    Pallas kernel that rebuilds the mask tile-by-tile from the O(S*C)
+    bounds (skipping fully-masked K/V blocks for the causal document-mask
+    case); falls back to a dense-mask XLA composition for unsupported
+    shapes or dropout.
     """
+    if window_size is not None:
+        if startend_row_indices is not None:
+            raise ValueError(
+                "pass either window_size or startend_row_indices, not both")
+        # sliding window -> flashmask bounds. Causal (left w): key col c is
+        # masked for rows >= c + w + 1 (C==1). Non-causal (left, right):
+        # masked for rows >= c + left + 1 or rows < c - right (C==2).
+        w = window_size if isinstance(window_size, (tuple, list)) \
+            else (window_size, window_size)
+        sk = key.shape[1]
+        b = query.shape[0]
+        from ...core.tensor import Tensor
+        cols = jnp.arange(sk)
+        start = jnp.minimum(cols + int(w[0]) + 1, sk).astype(jnp.int32)
+        if causal:
+            idx = start[None, None, :, None]
+            startend_row_indices = Tensor(
+                jnp.broadcast_to(idx, (b, 1, sk, 1)))
+        else:
+            end = jnp.maximum(cols - int(w[1]), 0).astype(jnp.int32)
+            idx = jnp.stack([start, end], axis=-1)[None, None]
+            startend_row_indices = Tensor(
+                jnp.broadcast_to(idx, (b, 1, sk, 2)))
     if startend_row_indices is None:
         return scaled_dot_product_attention(query, key, value, None, dropout,
                                             causal)
+    B, Sq, H, D = query.shape
+    if Sq != key.shape[1]:
+        raise ValueError("flashmask_attention requires Sq == Sk (row bounds "
+                         "index a square score matrix)")
+    can_pallas = _USE_PALLAS[0] and dropout == 0.0
+    if can_pallas:
+        try:
+            from ...kernels import flash_attention as pallas_fa
+            pallas_fa.check_supported(tuple(query.shape), tuple(key.shape),
+                                      query.dtype)
+            C = startend_row_indices.shape[-1]
+            if causal and C not in (1, 2):
+                raise ValueError("unsupported bound count")
+            if not causal and C not in (2, 4):
+                raise ValueError("unsupported bound count")
+
+            def _f(q, k, v, idx):
+                return pallas_fa.flashmask_attention_bshd(q, k, v, idx,
+                                                          causal=causal)
+
+            return apply_op("flashmask_attention", _f, query, key, value,
+                            startend_row_indices)
+        except ValueError:
+            pass
 
     def _build_mask(idx, sq, sk):
         # idx: (B, H, Sk, C); rows r of column c are masked per bounds
@@ -125,11 +249,12 @@ def flashmask_attention(query, key, value, startend_row_indices=None,
         return allow
 
     sq, sk = query.shape[1], key.shape[1]
+    drop_key = rng_key() if dropout > 0.0 else None
 
     def _f(q, k, v, idx):
         allow = _build_mask(idx, sq, sk)
         # broadcast mask over heads: allow is B,H,Sq,Sk (H may be 1)
-        return _sdpa_ref(q, k, v, allow, dropout, False, None, True)
+        return _sdpa_ref(q, k, v, allow, dropout, False, drop_key, True)
     return apply_op("flashmask_attention", _f, query, key, value,
                     startend_row_indices)
 

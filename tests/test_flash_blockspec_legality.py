@@ -82,3 +82,23 @@ def test_pick_blocks_divide_and_tile():
         assert s % bq == 0 and s % bk == 0
         assert bq == s or bq % 128 == 0
         assert bk == s or bk % 8 == 0
+
+
+def _varlen_flashmask_blockspecs(B, H, Sq, Sk, D, C):
+    """Extra BlockSpecs the varlen/flashmask kernels add: segment id+pos
+    blocks (1, 2, block) over (B, 2, S) arrays and bound blocks
+    (1, C, block_k) over (B*Hm, C, Sk) arrays."""
+    bq = _pick_block_q(Sq)
+    bk = _pick_block_k(Sk)
+    return [((1, 2, bq), (B, 2, Sq)), ((1, 2, bk), (B, 2, Sk)),
+            ((1, C, bk), (B, C, Sk)), ((1, C, bk), (B * H, C, Sk))]
+
+
+@pytest.mark.parametrize("BH,Sq,Sk,D", SHAPES)
+@pytest.mark.parametrize("C", [1, 2, 4])
+def test_varlen_flashmask_blockspecs_tpu_legal(BH, Sq, Sk, D, C):
+    H = 4 if BH % 4 == 0 else 1
+    for block, array in _varlen_flashmask_blockspecs(BH // H, H, Sq, Sk, D, C):
+        assert mosaic_legal(block, array), (
+            f"illegal block {block} for array {array} "
+            f"(Sq={Sq}, Sk={Sk}, C={C})")
