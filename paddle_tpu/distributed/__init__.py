@@ -12,7 +12,8 @@ Parity map (reference python/paddle/distributed/, SURVEY.md §2.5):
   - launch CLI -> .launch
 """
 from .env import (  # noqa: F401
-    get_rank, get_world_size, init_parallel_env, is_initialized, ParallelEnv,
+    barrier_store, create_store, get_rank, get_world_size, init_parallel_env,
+    is_initialized, ParallelEnv,
 )
 from .collective import (  # noqa: F401
     Group, new_group, all_reduce, all_gather, all_gather_object, all_to_all,

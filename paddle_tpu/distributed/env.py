@@ -82,17 +82,21 @@ def barrier_store(store, world_size, prefix="barrier", timeout=120):
 
 
 def init_parallel_env(strategy=None):
-    """Parity: paddle.distributed.init_parallel_env. Multi-host: reads
-    coordinator address from env (PADDLE_MASTER or JAX_COORDINATOR) and
-    calls jax.distributed.initialize."""
+    """Parity: paddle.distributed.init_parallel_env. Multi-host/-process:
+    reads the coordinator address from env (PADDLE_MASTER or
+    JAX_COORDINATOR, set by paddle_tpu.distributed.launch) and calls
+    jax.distributed.initialize — the PJRT coordination service plays the
+    reference TCPStore+NCCL-bootstrap role. Must run before any other jax
+    backend use in the process."""
     if _initialized[0]:
         return ParallelEnv()
     coord = os.environ.get("PADDLE_MASTER") or os.environ.get("JAX_COORDINATOR")
-    nnodes = int(os.environ.get("PADDLE_NNODES", "1"))
-    if coord and nnodes > 1:
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                               os.environ.get("PADDLE_NNODES", "1")))
+    if coord and world > 1:
         jax.distributed.initialize(
             coordinator_address=coord,
-            num_processes=int(os.environ.get("PADDLE_TRAINERS_NUM", nnodes)),
+            num_processes=world,
             process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
     _initialized[0] = True
     return ParallelEnv()

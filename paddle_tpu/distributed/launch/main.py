@@ -1,9 +1,152 @@
-"""python -m paddle_tpu.distributed.launch (placeholder CLI)."""
+"""python -m paddle_tpu.distributed.launch — multi-process / multi-host
+launcher.
+
+Parity: reference launch stack — `python/paddle/distributed/launch/
+controllers/controller.py:28-192` (Controller spawning per-rank Containers,
+watch loop), `controllers/collective.py:22` (rank env construction), and
+the fake-multinode pattern (`test/collective/test_communication_api_base.py:
+62-76`: N launchers on localhost sharing one --master).
+
+TPU-native: one process per host is the norm (a process owns all local
+chips); rendezvous is jax.distributed.initialize (PJRT coordination
+service) — the launcher's job is rank bookkeeping, environment setup,
+child supervision, and the TCPStore KV for launch-level coordination.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+__all__ = ["launch", "main"]
 
 
-def launch():
-    raise NotImplementedError("launch CLI lands with multi-host support")
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch",
+        description="Launch a distributed paddle_tpu job")
+    p.add_argument("--nnodes", type=int,
+                   default=int(os.environ.get("PADDLE_NNODES", "1")),
+                   help="number of nodes (hosts) in the job")
+    p.add_argument("--node_rank", "--rank", type=int, dest="node_rank",
+                   default=int(os.environ.get("PADDLE_NODE_RANK", "0")),
+                   help="rank of this node in [0, nnodes)")
+    p.add_argument("--master", type=str,
+                   default=os.environ.get("PADDLE_MASTER", ""),
+                   help="coordinator endpoint host:port (required when "
+                        "nnodes > 1)")
+    p.add_argument("--nproc_per_node", type=int,
+                   default=int(os.environ.get("PADDLE_NPROC_PER_NODE", "1")),
+                   help="processes per node (1 per TPU host is the norm)")
+    p.add_argument("--devices", type=str, default=None,
+                   help="visible device ids for this node (informational "
+                        "on TPU; one process owns all local chips)")
+    p.add_argument("--log_dir", type=str, default=None,
+                   help="per-rank stdout/stderr capture directory")
+    p.add_argument("--run_mode", type=str, default="collective",
+                   help="collective (default); ps/rpc modes are not "
+                        "supported on TPU")
+    p.add_argument("training_script", type=str,
+                   help="script to run (or module with -m inside the script)")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _rank_env(args, local_rank):
+    """Per-process environment (parity: CollectiveController.build_pod
+    rank env, `launch/controllers/collective.py:22`)."""
+    nproc = args.nproc_per_node
+    world = args.nnodes * nproc
+    rank = args.node_rank * nproc + local_rank
+    env = dict(os.environ)
+    env.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(world),
+        "PADDLE_NNODES": str(args.nnodes),
+        "PADDLE_NODE_RANK": str(args.node_rank),
+        "PADDLE_RANK_IN_NODE": str(local_rank),
+        "PADDLE_LOCAL_SIZE": str(nproc),
+        "PADDLE_WORLD_SIZE": str(world),
+    })
+    if args.master:
+        env["PADDLE_MASTER"] = args.master
+    if args.devices is not None:
+        env["PADDLE_DEVICES"] = args.devices
+    return env
+
+
+def launch(argv=None):
+    """Spawn nproc_per_node child processes with rank env and supervise
+    them. Returns the first non-zero child exit code (0 on full success).
+    Parity: ControllerBase.run/watch (`controllers/controller.py:28-192`)."""
+    args = _parse_args(argv)
+    if args.nnodes > 1 and not args.master:
+        raise SystemExit("--master host:port is required when --nnodes > 1")
+    if args.nproc_per_node > 1 and not args.master:
+        # single-node multi-process still needs a coordinator so the
+        # children call jax.distributed.initialize (reference launcher
+        # auto-assigns a localhost master)
+        import socket
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        args.master = f"127.0.0.1:{s.getsockname()[1]}"
+        s.close()
+    if args.run_mode != "collective":
+        raise SystemExit(f"run_mode {args.run_mode!r} is not supported; "
+                         "only 'collective' exists on the TPU backend")
+
+    script_cmd = [sys.executable, "-u", args.training_script]
+    script_cmd += list(args.training_script_args)
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+
+    procs = []
+    for local_rank in range(args.nproc_per_node):
+        env = _rank_env(args, local_rank)
+        stdout = stderr = None
+        if args.log_dir:
+            rank = env["PADDLE_TRAINER_ID"]
+            stdout = open(os.path.join(args.log_dir,
+                                       f"workerlog.{rank}"), "wb")
+            stderr = subprocess.STDOUT
+        procs.append(subprocess.Popen(script_cmd, env=env, stdout=stdout,
+                                      stderr=stderr))
+
+    # watch loop: first failure tears the pod down (controller.py watch)
+    exit_code = 0
+    try:
+        pending = {p.pid: p for p in procs}
+        while pending:
+            for pid, p in list(pending.items()):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                del pending[pid]
+                if rc != 0 and exit_code == 0:
+                    exit_code = rc
+                    for q in pending.values():
+                        q.terminate()
+            time.sleep(0.1)
+    except KeyboardInterrupt:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        exit_code = exit_code or 130
+    finally:
+        deadline = time.monotonic() + 10
+        for p in procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+    return exit_code
+
+
+def main():
+    sys.exit(launch())
 
 
 if __name__ == "__main__":
-    launch()
+    main()

@@ -21,13 +21,30 @@ __all__ = ["seed", "get_rng_state", "set_rng_state", "default_rng", "RNGState",
 
 
 class RNGState:
-    """A splittable PRNG stream with named sub-streams (for TP determinism)."""
+    """A splittable PRNG stream with named sub-streams (for TP determinism).
+
+    Key creation is lazy: materializing a PRNG key initializes the XLA
+    backend, and `import paddle_tpu` must stay backend-free so
+    `jax.distributed.initialize` (init_parallel_env) can run first in
+    multi-host processes."""
 
     def __init__(self, seed_val: int = 0):
-        self.key = jax.random.key(seed_val)
+        self._seed = int(seed_val)
+        self._key = None
+
+    @property
+    def key(self):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
+        return self._key
+
+    @key.setter
+    def key(self, value):
+        self._key = value
 
     def seed(self, seed_val: int):
-        self.key = jax.random.key(seed_val)
+        self._seed = int(seed_val)
+        self._key = None
 
     def next_key(self):
         self.key, sub = jax.random.split(self.key)
