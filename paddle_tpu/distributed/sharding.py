@@ -44,10 +44,15 @@ def _mesh():
 
 def shard_spec_for(shape, axis_size, existing_spec=None):
     """Choose a dim to shard over 'sharding' (first divisible, not already
-    sharded); None if nothing fits."""
+    sharded); None if nothing fits or the tensor is already placed on the
+    sharding axis."""
     entries = list(existing_spec) if existing_spec is not None else [None] * len(shape)
     while len(entries) < len(shape):
         entries.append(None)
+    for e in entries:
+        taken = e if isinstance(e, (tuple, list)) else (e,)
+        if SHARDING_AXIS in taken:
+            return None  # already sharded over the axis
     for d, s in enumerate(shape):
         if entries[d] is None and s % axis_size == 0 and s >= axis_size:
             entries[d] = SHARDING_AXIS
@@ -82,11 +87,10 @@ class _ShardingStageBase:
         cur_spec = getattr(cur, "spec", None)
         spec = shard_spec_for(arr.shape, size, cur_spec)
         if spec is None:
+            # nothing shardable left — includes "already placed", so the
+            # per-step path is a no-op once state carries its sharding
             return arr
-        try:
-            return jax.device_put(arr, NamedSharding(mesh, spec))
-        except Exception:
-            return arr
+        return jax.device_put(arr, NamedSharding(mesh, spec))
 
     # shard_fn protocol: (acc_name, param, acc_tensor) -> new acc tensor
     def __call__(self, name, param, acc):
@@ -143,14 +147,36 @@ class DygraphShardingOptimizer:
     def step(self):
         if self.stage >= 2:
             self.reduce_gradients()
+        params = self._inner._parameter_list
         self._inner.step()
         for name, slot in self._inner._accumulators.items():
             for idx, arr in slot.items():
-                p = self._inner._parameter_list[idx]
+                p = params[idx]
                 new = self._policy(name, p, Tensor(arr))
                 slot[idx] = new._data
         if self.stage >= 3:
-            self._policy.apply_params(self._inner._parameter_list)
+            self._policy.apply_params(params)
+        else:
+            # stages 1/2 keep parameters replicated: the eager update math
+            # propagates the accumulators' sharded layout onto the updated
+            # params, so re-replicate over the mesh (the reference's
+            # post-update broadcast of owned shards). Mesh-replicated, not
+            # single-device: committing to one device would clash with the
+            # mesh-resident optimizer state in later steps.
+            mesh = self._policy._jax_mesh()
+            if mesh is not None:
+                for p in params:
+                    spec = getattr(getattr(p._data, "sharding", None),
+                                   "spec", None)
+                    if spec is None:
+                        continue
+                    flat = [e for ent in spec if ent is not None
+                            for e in (ent if isinstance(ent, tuple) else
+                                      (ent,))]
+                    if SHARDING_AXIS in flat:
+                        p._data = jax.device_put(
+                            p._data,
+                            NamedSharding(mesh, P(*([None] * p._data.ndim))))
 
     def clear_grad(self, *a, **k):
         self._inner.clear_grad(*a, **k)

@@ -1,0 +1,147 @@
+"""ZeRO stage 1/2/3 verification (VERDICT r1 weak #5 / next #8).
+
+Not just "asserted" sharding: these tests measure per-device
+addressable-shard bytes to prove optimizer-state / gradient / parameter
+memory actually shrinks, and train sharded vs unsharded side by side to
+prove the loss trajectory is unchanged. Reference semantics:
+`fleet/meta_optimizers/dygraph_optimizer/dygraph_sharding_optimizer.py:53,580`.
+"""
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.fleet import fleet, DistributedStrategy
+
+
+def _init_sharding_mesh(degree=8):
+    st = DistributedStrategy()
+    st.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+                         "sharding_degree": degree, "sep_degree": 1}
+    fleet.init(is_collective=True, strategy=st)
+    return fleet.get_hybrid_communicate_group()
+
+
+def _local_bytes(arr):
+    """Bytes of this array resident on device 0 (one shard's share)."""
+    for s in arr.addressable_shards:
+        if s.device == jax.devices()[0]:
+            return int(np.prod(s.data.shape)) * s.data.dtype.itemsize
+    return 0
+
+
+def _make(seed=0, h=64):
+    paddle.seed(seed)
+    return paddle.nn.Sequential(paddle.nn.Linear(h, h), paddle.nn.GELU(),
+                                paddle.nn.Linear(h, h))
+
+
+@pytest.mark.parametrize("level,stage", [("os", 1), ("os_g", 2),
+                                         ("p_g_os", 3)])
+def test_zero_shard_bytes_shrink(level, stage):
+    _init_sharding_mesh(8)
+    h = 64
+    net = _make(h=h)
+    opt = paddle.optimizer.AdamW(1e-2, parameters=net.parameters())
+    model, sopt, _ = dist.sharding.group_sharded_parallel(net, opt, level)
+    x = paddle.randn([8, h])
+    y = paddle.randn([8, h])
+    for _ in range(2):
+        loss = paddle.nn.functional.mse_loss(model(x), y)
+        loss.backward()
+        sopt.step()
+        sopt.clear_grad()
+
+    w = net[0].weight
+    full_bytes = int(np.prod(w.shape)) * 4
+    # optimizer accumulators sharded at every stage: 1/8 resident locally
+    m1 = sopt._inner._accumulators["moment1"][0]
+    assert _local_bytes(m1) == full_bytes // 8, (
+        f"stage {stage}: moment1 not sharded ({_local_bytes(m1)} bytes)")
+    # stage >= 2: gradients land sharded after reduce_gradients
+    loss = paddle.nn.functional.mse_loss(model(x), y)
+    loss.backward()
+    sopt.reduce_gradients()
+    g = net[0].weight._grad_buffer
+    if stage >= 2:
+        assert _local_bytes(g) == full_bytes // 8, "stage>=2 grad not sharded"
+    # stage 3: parameters sharded too
+    if stage >= 3:
+        assert _local_bytes(w._data) == full_bytes // 8, "stage3 param full"
+    else:
+        assert _local_bytes(w._data) == full_bytes, "param should be full"
+    sopt.clear_grad()
+    fleet._hcg = None
+
+
+def test_zero_stage3_matches_unsharded_trajectory():
+    """5 AdamW steps: stage-3 sharded training reproduces the unsharded
+    loss trajectory."""
+    _init_sharding_mesh(8)
+    h = 64
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(8, h).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(8, h).astype(np.float32))
+
+    def run(level):
+        net = _make(seed=7, h=h)
+        opt = paddle.optimizer.AdamW(1e-2, parameters=net.parameters())
+        if level is not None:
+            net, opt, _ = dist.sharding.group_sharded_parallel(net, opt,
+                                                               level)
+        losses = []
+        for _ in range(5):
+            loss = paddle.nn.functional.mse_loss(net(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(np.asarray(loss._data)))
+        return losses
+
+    base = run(None)
+    sharded = run("p_g_os")
+    np.testing.assert_allclose(sharded, base, rtol=1e-5, atol=1e-6)
+    assert base[-1] < base[0]
+    fleet._hcg = None
+
+
+def test_zero_compiled_step_keeps_state_sharded():
+    """Under to_static the accumulators stay sharded across compiled steps
+    (no per-step host replacement: _place is an identity once placed)."""
+    _init_sharding_mesh(8)
+    h = 64
+    net = _make(h=h)
+    opt = paddle.optimizer.AdamW(1e-2, parameters=net.parameters())
+    model, sopt, _ = dist.sharding.group_sharded_parallel(net, opt, "os_g")
+    x = paddle.randn([8, h])
+    y = paddle.randn([8, h])
+
+    def step(a, b):
+        loss = paddle.nn.functional.mse_loss(model(a), b)
+        loss.backward()
+        sopt.step()
+        sopt.clear_grad()
+        return loss
+
+    # one eager step creates + shards the accumulators
+    step(x, y)
+    cstep = paddle.jit.to_static(step, state_objects=[net, sopt._inner])
+    l1 = float(np.asarray(cstep(x, y)._data))
+    l2 = float(np.asarray(cstep(x, y)._data))
+    assert np.isfinite(l1) and l2 < l1
+    m1 = sopt._inner._accumulators["moment1"][0]
+    full_bytes = int(np.prod(net[0].weight.shape)) * 4
+    assert _local_bytes(m1) == full_bytes // 8
+    fleet._hcg = None
+
+
+def test_shard_spec_for_no_double_placement():
+    """A tensor already sharded over 'sharding' must not get a second dim
+    placed on the same axis (was masked by a silent except)."""
+    from paddle_tpu.distributed.sharding import shard_spec_for
+    from jax.sharding import PartitionSpec as P
+    assert shard_spec_for((64, 64), 8) == P("sharding", None)
+    assert shard_spec_for((64, 64), 8, P("sharding", None)) is None
+    assert shard_spec_for((6, 64), 8) == P(None, "sharding")
+    assert shard_spec_for((6, 7), 8) is None
