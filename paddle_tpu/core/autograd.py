@@ -106,6 +106,7 @@ class GradNode:
     __slots__ = (
         "name",
         "vjp_fn",
+        "fwd_closed",
         "inputs",
         "out_avals",
         "out_treedef",
@@ -115,9 +116,13 @@ class GradNode:
     )
 
     def __init__(self, name: str, vjp_fn: Callable, inputs: Sequence[Any],
-                 out_avals: List[jax.ShapeDtypeStruct], out_treedef=None):
+                 out_avals: List[jax.ShapeDtypeStruct], out_treedef=None,
+                 fwd_closed: Optional[Callable] = None):
         self.name = name
         self.vjp_fn = vjp_fn
+        # array-level forward closure — re-differentiated for create_graph
+        # (the saved pullback hides the primal dependence)
+        self.fwd_closed = fwd_closed
         self.inputs = list(inputs)  # Tensors
         self.out_avals = out_avals
         self.out_treedef = out_treedef
@@ -133,6 +138,7 @@ class GradNode:
 
     def release(self):
         self.vjp_fn = None
+        self.fwd_closed = None
         self.inputs = []
         self.out_cots = [None] * self.n_outputs
         self._released = True
@@ -161,6 +167,31 @@ def _topo_order(root_nodes: Sequence[GradNode]) -> List[GradNode]:
     return order
 
 
+def _vjp_on_tape(node, cots):
+    """Recompute this op's vjp THROUGH the dispatch funnel so the gradient
+    computation is itself a taped op over (primal inputs, cotangents) —
+    the create_graph path. Primals are read from the node's input Tensors
+    (in-place-updated primals follow PyTorch-style staleness semantics)."""
+    from ..ops.dispatch import apply_op
+
+    n_in = len(node.inputs)
+    treedef = node.out_treedef
+    n_out = node.n_outputs
+    fwd = node.fwd_closed
+
+    def dbl(*arrs):
+        prim = arrs[:n_in]
+        cot = list(arrs[n_in:])
+        _, pull = jax.vjp(fwd, *prim)
+        if treedef is not None:
+            ct = jax.tree_util.tree_unflatten(treedef, cot)
+        else:
+            ct = cot[0] if n_out == 1 else tuple(cot)
+        return tuple(pull(ct))
+
+    return apply_op(node.name + "_grad", dbl, *node.inputs, *cots)
+
+
 def _zero_cotangent(aval):
     """Zero cotangent for an unused output; float0 for non-inexact outputs
     (e.g. the indices output of topk), matching jax.vjp's expectations."""
@@ -171,13 +202,20 @@ def _zero_cotangent(aval):
 
 
 def backward(tensors, grad_tensors=None, retain_graph: bool = False,
-             _capture: Optional[dict] = None):
+             _capture: Optional[dict] = None, create_graph: bool = False):
     """Run reverse-mode accumulation from `tensors` into leaf `.grad`s.
 
     Parity: `egr::RunBackward` (reference fluid/eager/backward.cc:105):
     seed root cotangents, walk nodes in reverse-topo order, invoke each
     node's pullback, scatter cotangents along edges, accumulate into leaf
     grads at `GradNodeAccumulation` (here: Tensor.grad on leaves).
+
+    create_graph=True routes every pullback through the dispatch funnel as
+    a re-differentiated op over (primal inputs, cotangents) — so the
+    gradient computation itself lands on the tape and `grad()` composes to
+    higher orders (parity: GeneralGrad + create_graph,
+    fluid/eager/backward.cc:103). Uses the node's saved forward closure;
+    the jax.vjp pullback alone hides the primal dependence.
     """
     from .tensor import Tensor  # local import to avoid cycle
 
@@ -218,8 +256,12 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False,
                     "backward() on a non-scalar tensor requires grad_tensors "
                     f"(got shape {t.shape})")
             seed = jnp.ones(t.shape, t.dtype)
+        elif isinstance(g, Tensor):
+            # keep the Tensor (with its graph) under create_graph so
+            # d(grad)/d(grad_outputs) chains through
+            seed = g if create_graph else g.data
         else:
-            seed = g.data if isinstance(g, Tensor) else jnp.asarray(g)
+            seed = jnp.asarray(g)
         node.accumulate(t._grad_out_idx, seed)
         root_nodes.append(node)
 
@@ -234,7 +276,9 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False,
             c if c is not None else _zero_cotangent(av)
             for c, av in zip(node.out_cots, node.out_avals)
         ]
-        if node.out_treedef is not None:
+        if create_graph and node.fwd_closed is not None:
+            in_grads = _vjp_on_tape(node, cots)
+        elif node.out_treedef is not None:
             in_grads = node.vjp_fn(jax.tree_util.tree_unflatten(node.out_treedef, cots))
         else:
             in_grads = node.vjp_fn(cots[0] if node.n_outputs == 1 else tuple(cots))
@@ -265,16 +309,11 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         outputs = [outputs]
     if isinstance(inputs, Tensor):
         inputs = [inputs]
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True (higher-order eager grad) is not supported yet; "
-            "use paddle_tpu.jit functional transforms (jax.grad composition) "
-            "for higher-order derivatives.")
-
     # Redirect accumulation into a side table so .grad is untouched.
     capture = {id(t): None for t in inputs}
-    retain = True if retain_graph is None else retain_graph
-    backward(outputs, grad_outputs, retain_graph=retain, _capture=capture)
+    retain = True if (retain_graph is None or create_graph) else retain_graph
+    backward(outputs, grad_outputs, retain_graph=retain, _capture=capture,
+             create_graph=create_graph)
 
     results = []
     for i, t in enumerate(inputs):
@@ -283,5 +322,11 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
             raise RuntimeError(
                 f"Input {i} is unreachable from outputs "
                 "(pass allow_unused=True to return None).")
-        results.append(Tensor(g, stop_gradient=True) if g is not None else None)
+        if g is None:
+            results.append(None)
+        elif isinstance(g, Tensor):
+            # create_graph: the grad carries its own tape for higher orders
+            results.append(g)
+        else:
+            results.append(Tensor(g, stop_gradient=True))
     return results

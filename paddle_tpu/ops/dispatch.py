@@ -98,7 +98,8 @@ def _apply_op(name: str, fn: Callable, *args, **kwargs):
     node = None
     if need_grad:
         avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in out_leaves]
-        node = GradNode(name, vjp_fn, tensors, avals, out_treedef)
+        node = GradNode(name, vjp_fn, tensors, avals, out_treedef,
+                        fwd_closed=closed)
     for idx, o in enumerate(out_leaves):
         differentiable = need_grad and jnp.issubdtype(o.dtype, jnp.inexact)
         t = Tensor(o, stop_gradient=not differentiable)

@@ -165,3 +165,51 @@ def test_clear_grad():
     assert x.grad is not None
     x.clear_grad()
     assert x.grad is None
+
+
+# ---------------------------------------------------- higher-order grad
+def test_double_grad():
+    """paddle.grad(create_graph=True) composes to second order
+    (parity: GeneralGrad + create_graph, fluid/eager/backward.cc:103)."""
+    x = paddle.to_tensor(np.array([2.0, 3.0], np.float32),
+                         stop_gradient=False)
+    y = (x ** 3).sum()
+    (g1,) = paddle.grad(y, [x], create_graph=True)
+    np.testing.assert_allclose(np.asarray(g1._data), [12.0, 27.0])
+    (g2,) = paddle.grad(g1.sum(), [x])
+    np.testing.assert_allclose(np.asarray(g2._data), [12.0, 18.0])
+
+
+def test_triple_grad():
+    x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    y = (x ** 3).sum()
+    (h1,) = paddle.grad(y, [x], create_graph=True)
+    (h2,) = paddle.grad(h1.sum(), [x], create_graph=True)
+    (h3,) = paddle.grad(h2.sum(), [x])
+    np.testing.assert_allclose(np.asarray(h3._data), [6.0])
+
+
+def test_mixed_partial_double_grad():
+    a = paddle.to_tensor(np.array([5.0], np.float32), stop_gradient=False)
+    b = paddle.to_tensor(np.array([7.0], np.float32), stop_gradient=False)
+    z = (a * a * b).sum()                      # dz/da = 2ab; d2z/dadb = 2a
+    (ga,) = paddle.grad(z, [a], create_graph=True)
+    np.testing.assert_allclose(np.asarray(ga._data), [70.0])
+    (gab,) = paddle.grad(ga.sum(), [b])
+    np.testing.assert_allclose(np.asarray(gab._data), [10.0])
+
+
+def test_double_grad_through_nn():
+    """Gradient-penalty pattern: grad of a grad-norm w.r.t. params."""
+    paddle.seed(0)
+    lin = paddle.nn.Linear(4, 1)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(3, 4).astype(np.float32),
+                         stop_gradient=False)
+    y = lin(x).sum()
+    (gx,) = paddle.grad(y, [x], create_graph=True)
+    penalty = (gx ** 2).sum()
+    (gw,) = paddle.grad(penalty, [lin.weight])
+    # d penalty / dW = 2 * W broadcast over rows: gx rows == W^T
+    np.testing.assert_allclose(np.asarray(gw._data),
+                               2 * 3 * np.asarray(lin.weight._data),
+                               rtol=1e-5)
