@@ -129,11 +129,19 @@ class _CudaNamespace:
 
     @staticmethod
     def max_memory_allocated(device=None):
-        return _mem_stats().get("peak_bytes_in_use", 0)
+        return max_memory_allocated(device)
 
     @staticmethod
     def memory_allocated(device=None):
-        return _mem_stats().get("bytes_in_use", 0)
+        return memory_allocated(device)
+
+    @staticmethod
+    def memory_reserved(device=None):
+        return memory_reserved(device)
+
+    @staticmethod
+    def max_memory_reserved(device=None):
+        return max_memory_reserved(device)
 
     @staticmethod
     def empty_cache():
@@ -151,3 +159,97 @@ def _mem_stats():
 
 
 cuda = _CudaNamespace()
+
+
+# ----------------------------------------------------------- memory stats
+# Parity: reference memory stats API (`paddle/phi/core/memory/stats.h`,
+# `paddle.device.cuda.max_memory_allocated`). On TPU the allocator is
+# XLA's: per-device counters come from PJRT `Device.memory_stats()`
+# (bytes_in_use / peak_bytes_in_use). Where the backend doesn't publish
+# stats (CPU, tunneled devices), fall back to summing live jax arrays and
+# track the peak as a high-water mark over observations.
+_mem_peaks = {}   # per-device high-water mark of observed bytes_in_use
+_mem_floor = {}   # backend peak counter value at the last reset()
+
+
+def _device_obj(device=None):
+    if device is None or isinstance(device, (int,)):
+        return jax.local_devices()[device or 0]
+    return device
+
+
+def _live_bytes(dev):
+    total = 0
+    for arr in jax.live_arrays():
+        try:
+            for sh in arr.addressable_shards:
+                if sh.device == dev:
+                    total += int(sh.data.size) * sh.data.dtype.itemsize
+        except Exception:
+            continue
+    return total
+
+
+def memory_stats(device=None):
+    """Raw per-device allocator stats dict (may be backend-limited).
+
+    The backend's peak_bytes_in_use counter is monotone over the process
+    lifetime; reset_max_memory_allocated() records it as a floor, and the
+    reported peak after a reset is the backend counter only once it rises
+    above the floor (otherwise the best-effort max of bytes_in_use
+    observations since the reset)."""
+    dev = _device_obj(device)
+    stats = dev.memory_stats()
+    if stats is None:
+        stats = {"bytes_in_use": _live_bytes(dev)}
+    key = id(dev)
+    in_use = stats.get("bytes_in_use", 0)
+    backend_peak = stats.get("peak_bytes_in_use", 0)
+    floor = _mem_floor.get(key, 0)
+    peak = max(_mem_peaks.get(key, 0), in_use,
+               backend_peak if backend_peak > floor else 0)
+    _mem_peaks[key] = peak
+    stats["peak_bytes_in_use"] = peak
+    return stats
+
+
+def memory_allocated(device=None):
+    """Bytes currently allocated on the device.
+    Parity: paddle.device.cuda.memory_allocated."""
+    return int(memory_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None):
+    """Peak allocated bytes. Parity: cuda.max_memory_allocated."""
+    return int(memory_stats(device).get("peak_bytes_in_use", 0))
+
+
+def memory_reserved(device=None):
+    """Bytes reserved by the allocator pool (== limit when published).
+    Parity: cuda.memory_reserved."""
+    s = memory_stats(device)
+    return int(s.get("bytes_reserved", s.get("bytes_limit",
+                                             s.get("bytes_in_use", 0))))
+
+
+def max_memory_reserved(device=None):
+    s = memory_stats(device)
+    return int(s.get("peak_bytes_reserved", s.get("peak_bytes_in_use", 0)))
+
+
+def reset_max_memory_allocated(device=None):
+    dev = _device_obj(device)
+    _mem_peaks[id(dev)] = 0
+    stats = dev.memory_stats() or {}
+    # remember the monotone backend counter so pre-reset peaks don't leak
+    # into post-reset reads
+    _mem_floor[id(dev)] = stats.get("peak_bytes_in_use", 0)
+
+
+def reset_max_memory_reserved(device=None):
+    reset_max_memory_allocated(device)
+
+
+__all__ += ["memory_stats", "memory_allocated", "max_memory_allocated",
+            "memory_reserved", "max_memory_reserved",
+            "reset_max_memory_allocated", "reset_max_memory_reserved"]

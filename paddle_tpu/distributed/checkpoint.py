@@ -24,7 +24,7 @@ import numpy as np
 from ..core.tensor import Tensor
 
 __all__ = ["save_state_dict", "load_state_dict", "LocalTensorMetadata",
-           "async_save_state_dict"]
+           "async_save_state_dict", "wait_async_saves", "get_metadata"]
 
 
 class LocalTensorMetadata:
@@ -66,16 +66,58 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
     return path
 
 
-_async_threads = []
+_async_ckptr = [None]
 
 
 def async_save_state_dict(state_dict, path, **kw):
-    """Async save (reference: save_state_dict.py:46 background queue)."""
-    t = threading.Thread(target=save_state_dict, args=(dict(state_dict), path),
-                         kwargs=kw, daemon=True)
-    t.start()
-    _async_threads.append(t)
-    return t
+    """Async save (reference: save_state_dict.py:46 background queue).
+
+    Uses orbax's AsyncCheckpointer: `save()` returns only after the
+    per-shard device->host snapshot, so the caller may mutate/donate the
+    live arrays immediately (the next optimizer step cannot corrupt the
+    save), and the file writes proceed in the background — shard-aware on
+    multi-host, no full-array gather. `wait_async_saves()` is the
+    completion barrier."""
+    import orbax.checkpoint as ocp
+    if _async_ckptr[0] is None:
+        _async_ckptr[0] = ocp.AsyncCheckpointer(
+            ocp.StandardCheckpointHandler())
+    ckptr = _async_ckptr[0]
+    path = os.path.abspath(path)
+    os.makedirs(path, exist_ok=True)
+    flat = _unwrap(state_dict)
+    target = os.path.join(path, "state")
+    if os.path.exists(target):
+        import shutil
+        ckptr.wait_until_finished()  # never delete under an in-flight write
+        shutil.rmtree(target)
+    ckptr.save(target, args=ocp.args.StandardSave(flat))
+    return ckptr
+
+
+def wait_async_saves(timeout=None):
+    """Block until all pending async saves complete (re-raises writer
+    errors). Call before exiting or before reusing a checkpoint dir."""
+    if _async_ckptr[0] is not None:
+        _async_ckptr[0].wait_until_finished()
+
+
+def get_metadata(state_dict):
+    """Per-tensor shard metadata for the CURRENT process (parity:
+    save_state_dict.py:91-145 metadata gather): name -> list of
+    LocalTensorMetadata for each addressable shard."""
+    meta = {}
+    for k, v in _unwrap(state_dict).items():
+        if hasattr(v, "addressable_shards"):
+            meta[k] = [LocalTensorMetadata(
+                tuple(idx.start or 0 for idx in sh.index),
+                tuple(sh.data.shape), str(v.dtype))
+                for sh in v.addressable_shards]
+        else:
+            arr = np.asarray(v)
+            meta[k] = [LocalTensorMetadata((0,) * arr.ndim, arr.shape,
+                                           str(arr.dtype))]
+    return meta
 
 
 def load_state_dict(state_dict, path, process_group=None,
