@@ -92,11 +92,15 @@ static void timespec_in_ms(struct timespec* ts, long ms) {
 }
 
 // Lock that recovers a robust mutex whose owner died (a killed DataLoader
-// worker must not wedge the parent).
-static int robust_timedlock(pthread_mutex_t* m, struct timespec* ts) {
+// worker must not wedge the parent). `recovered` is set when EOWNERDEAD
+// fired: the dead owner may have left a half-written header, so the caller
+// MUST validate ring invariants before trusting it.
+static int robust_timedlock(pthread_mutex_t* m, struct timespec* ts,
+                            int* recovered) {
   int rc = pthread_mutex_clocklock(m, CLOCK_MONOTONIC, ts);
   if (rc == EOWNERDEAD) {
     pthread_mutex_consistent(m);
+    if (recovered) *recovered = 1;
     rc = 0;
   }
   return rc;
@@ -107,13 +111,38 @@ static int robust_timedlock(pthread_mutex_t* m, struct timespec* ts) {
 // timeout) or a later unlock would mark the mutex ENOTRECOVERABLE and
 // wedge the ring for every surviving process.
 static int robust_cond_timedwait(pthread_cond_t* c, pthread_mutex_t* m,
-                                 struct timespec* ts) {
+                                 struct timespec* ts, int* recovered) {
   int rc = pthread_cond_timedwait(c, m, ts);
   if (rc == EOWNERDEAD) {
     pthread_mutex_consistent(m);
+    if (recovered) *recovered = 1;
     rc = 0;
   }
   return rc;
+}
+
+// Header invariants. push/pop advance (head|tail, used, n_msgs) together
+// under the lock, and a message never straddles the region end, so a
+// consistent header always satisfies head == (tail + used) % capacity.
+// A SIGKILLed owner can leave any prefix of its stores applied; a recovered
+// lock must re-check before parsing, else a mis-framed ring yields an
+// out-of-bounds payload.assign in pop.
+static bool ring_header_valid(const RingHeader* h, uint64_t cap) {
+  if (h->magic != kRingMagic || h->capacity != cap) return false;
+  if (h->head >= cap || h->tail >= cap || h->used > cap) return false;
+  if (h->head != (h->tail + h->used) % cap) return false;
+  if (h->n_msgs > 0 && h->used < 8 * h->n_msgs) return false;
+  if (h->n_msgs == 0 && h->used != 0 && h->used != cap - h->tail)
+    return false;  // only tail-end skip padding may remain
+  return true;
+}
+
+// Poison the ring (magic cleared) and wake every waiter so they observe
+// the corruption instead of blocking forever.
+static void ring_poison(RingHeader* h) {
+  h->magic = 0;
+  pthread_cond_broadcast(&h->not_empty);
+  pthread_cond_broadcast(&h->not_full);
 }
 
 static PyObject* ShmRingError;
@@ -264,14 +293,29 @@ static PyObject* ShmRing_push(ShmRing* self, PyObject* args, PyObject* kwds) {
   }
   RingHeader* h = self->hdr;
   int ok = 0;
+  int corrupt = 0;
   Py_BEGIN_ALLOW_THREADS;
   struct timespec ts;
   timespec_in_ms(&ts, timeout_ms);
-  if (robust_timedlock(&h->mutex, &ts) == 0) {
+  int recovered = 0;
+  if (robust_timedlock(&h->mutex, &ts, &recovered) == 0) {
     int rc = 0;
-    while (!ring_fit(h, need) && rc == 0)
-      rc = robust_cond_timedwait(&h->not_full, &h->mutex, &ts);
-    if (rc == 0) {
+    while (!corrupt && !ring_fit(h, need) && rc == 0) {
+      if ((recovered && !ring_header_valid(h, self->capacity)) ||
+          h->magic != kRingMagic) {
+        corrupt = 1;
+        break;
+      }
+      recovered = 0;
+      rc = robust_cond_timedwait(&h->not_full, &h->mutex, &ts, &recovered);
+    }
+    if (!corrupt && ((recovered && !ring_header_valid(h, self->capacity)) ||
+                     h->magic != kRingMagic))
+      corrupt = 1;
+    if (corrupt) {
+      ring_poison(h);
+      pthread_mutex_unlock(&h->mutex);
+    } else if (rc == 0) {
       uint64_t cap = h->capacity;
       uint64_t head = h->head;
       if (need > cap - head) {
@@ -288,11 +332,19 @@ static PyObject* ShmRing_push(ShmRing* self, PyObject* args, PyObject* kwds) {
       h->n_msgs += 1;
       ok = 1;
       pthread_cond_signal(&h->not_empty);
+      pthread_mutex_unlock(&h->mutex);
+    } else {
+      pthread_mutex_unlock(&h->mutex);
     }
-    pthread_mutex_unlock(&h->mutex);
   }
   Py_END_ALLOW_THREADS;
   PyBuffer_Release(&buf);
+  if (corrupt) {
+    PyErr_SetString(ShmRingError,
+                    "shm ring corrupted: a worker died mid-push and left an "
+                    "inconsistent header (ring poisoned; recreate it)");
+    return nullptr;
+  }
   if (!ok) Py_RETURN_FALSE;
   Py_RETURN_TRUE;
 }
@@ -307,14 +359,26 @@ static PyObject* ShmRing_pop(ShmRing* self, PyObject* args, PyObject* kwds) {
   std::string payload;  // copied out under the lock: space may be reused
                         // by a writer the moment `used` shrinks
   int ok = 0;
+  int corrupt = 0;
   Py_BEGIN_ALLOW_THREADS;
   struct timespec ts;
   timespec_in_ms(&ts, timeout_ms);
-  if (robust_timedlock(&h->mutex, &ts) == 0) {
+  int recovered = 0;
+  if (robust_timedlock(&h->mutex, &ts, &recovered) == 0) {
     int rc = 0;
-    while (h->n_msgs == 0 && rc == 0)
-      rc = robust_cond_timedwait(&h->not_empty, &h->mutex, &ts);
-    if (rc == 0) {
+    while (!corrupt && h->n_msgs == 0 && rc == 0) {
+      if ((recovered && !ring_header_valid(h, self->capacity)) ||
+          h->magic != kRingMagic) {
+        corrupt = 1;
+        break;
+      }
+      recovered = 0;
+      rc = robust_cond_timedwait(&h->not_empty, &h->mutex, &ts, &recovered);
+    }
+    if (!corrupt && ((recovered && !ring_header_valid(h, self->capacity)) ||
+                     h->magic != kRingMagic))
+      corrupt = 1;
+    if (!corrupt && rc == 0) {
       uint64_t cap = h->capacity;
       uint64_t tail = h->tail;
       if (cap - tail < 8) {
@@ -330,16 +394,32 @@ static PyObject* ShmRing_pop(ShmRing* self, PyObject* args, PyObject* kwds) {
       }
       uint64_t len;
       memcpy(&len, self->data + tail, 8);
-      payload.assign((const char*)(self->data + tail + 8), len);
-      h->tail = (tail + 8 + len) % cap;
-      h->used -= 8 + len;
-      h->n_msgs -= 1;
-      ok = 1;
-      pthread_cond_broadcast(&h->not_full);
+      // never trust the on-shm length blindly: bound it by the framing
+      // invariants or a mis-framed ring reads out of bounds. Compare in
+      // subtracted form — '8 + len' overflows uint64 for garbage lengths
+      // near 2^64 and would slip past an additive check.
+      if (h->used < 8 || len > h->used - 8 ||
+          cap - tail < 8 || len > cap - tail - 8) {
+        corrupt = 1;
+      } else {
+        payload.assign((const char*)(self->data + tail + 8), len);
+        h->tail = (tail + 8 + len) % cap;
+        h->used -= 8 + len;
+        h->n_msgs -= 1;
+        ok = 1;
+        pthread_cond_broadcast(&h->not_full);
+      }
     }
+    if (corrupt) ring_poison(h);
     pthread_mutex_unlock(&h->mutex);
   }
   Py_END_ALLOW_THREADS;
+  if (corrupt) {
+    PyErr_SetString(ShmRingError,
+                    "shm ring corrupted: a worker died mid-operation and "
+                    "left an inconsistent header (ring poisoned)");
+    return nullptr;
+  }
   if (!ok) Py_RETURN_NONE;
   return PyBytes_FromStringAndSize(payload.data(), (Py_ssize_t)payload.size());
 }

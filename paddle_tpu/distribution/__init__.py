@@ -95,13 +95,16 @@ class Normal(Distribution):
     def variance(self):
         return Tensor(jnp.broadcast_to(self.scale ** 2, self._batch_shape))
 
-    def sample(self, shape=()):
+    def rsample(self, shape=()):
         z = jax.random.normal(_key(), self._extend(shape), jnp.float32)
         loc, scale = self._params()
         return apply_op("normal_rsample",
                         lambda l, s: l + s * z, loc, scale)
 
-    rsample = sample
+    def sample(self, shape=()):
+        # non-reparameterized: detached from loc/scale (reference/torch
+        # convention — REINFORCE-style estimators rely on this)
+        return Tensor(self.rsample(shape)._data)
 
     def log_prob(self, value):
         def _f(v, l, s):
@@ -122,16 +125,22 @@ class Normal(Distribution):
 
 class Uniform(Distribution):
     def __init__(self, low, high, name=None):
+        self._low_p = low if isinstance(low, Tensor) else None
+        self._high_p = high if isinstance(high, Tensor) else None
         self.low = _arr(low)
         self.high = _arr(high)
         super().__init__(jnp.broadcast_shapes(self.low.shape,
                                               self.high.shape))
 
-    def sample(self, shape=()):
+    def rsample(self, shape=()):
         u = jax.random.uniform(_key(), self._extend(shape), jnp.float32)
-        return Tensor(self.low + (self.high - self.low) * u)
+        lo = self._low_p if self._low_p is not None else self.low
+        hi = self._high_p if self._high_p is not None else self.high
+        return apply_op("uniform_rsample",
+                        lambda lo_, hi_: lo_ + (hi_ - lo_) * u, lo, hi)
 
-    rsample = sample
+    def sample(self, shape=()):
+        return Tensor(self.rsample(shape)._data)
 
     def log_prob(self, value):
         def _f(v):
@@ -311,16 +320,21 @@ class Geometric(Distribution):
 
 class Gumbel(Distribution):
     def __init__(self, loc, scale, name=None):
+        self._loc_p = loc if isinstance(loc, Tensor) else None
+        self._scale_p = scale if isinstance(scale, Tensor) else None
         self.loc = _arr(loc)
         self.scale = _arr(scale)
         super().__init__(jnp.broadcast_shapes(self.loc.shape,
                                               self.scale.shape))
 
-    def sample(self, shape=()):
+    def rsample(self, shape=()):
         g = jax.random.gumbel(_key(), self._extend(shape))
-        return Tensor(self.loc + self.scale * g)
+        loc = self._loc_p if self._loc_p is not None else self.loc
+        sc = self._scale_p if self._scale_p is not None else self.scale
+        return apply_op("gumbel_rsample", lambda l, s: l + s * g, loc, sc)
 
-    rsample = sample
+    def sample(self, shape=()):
+        return Tensor(self.rsample(shape)._data)
 
     def log_prob(self, value):
         def _f(v):
@@ -331,16 +345,21 @@ class Gumbel(Distribution):
 
 class Laplace(Distribution):
     def __init__(self, loc, scale, name=None):
+        self._loc_p = loc if isinstance(loc, Tensor) else None
+        self._scale_p = scale if isinstance(scale, Tensor) else None
         self.loc = _arr(loc)
         self.scale = _arr(scale)
         super().__init__(jnp.broadcast_shapes(self.loc.shape,
                                               self.scale.shape))
 
-    def sample(self, shape=()):
+    def rsample(self, shape=()):
         l = jax.random.laplace(_key(), self._extend(shape))
-        return Tensor(self.loc + self.scale * l)
+        loc = self._loc_p if self._loc_p is not None else self.loc
+        sc = self._scale_p if self._scale_p is not None else self.scale
+        return apply_op("laplace_rsample", lambda lo, s: lo + s * l, loc, sc)
 
-    rsample = sample
+    def sample(self, shape=()):
+        return Tensor(self.rsample(shape)._data)
 
     def log_prob(self, value):
         def _f(v):
@@ -360,10 +379,11 @@ class LogNormal(Distribution):
         self._normal = Normal(loc, scale)
         super().__init__(self._normal._batch_shape)
 
-    def sample(self, shape=()):
-        return apply_op("exp", jnp.exp, self._normal.sample(shape))
+    def rsample(self, shape=()):
+        return apply_op("exp", jnp.exp, self._normal.rsample(shape))
 
-    rsample = sample
+    def sample(self, shape=()):
+        return Tensor(self.rsample(shape)._data)
 
     def log_prob(self, value):
         def _f(v):
@@ -456,3 +476,47 @@ def _kl_unif_unif(p, q):
     out = jnp.log((q.high - q.low) / (p.high - p.low))
     inside = (q.low <= p.low) & (p.high <= q.high)
     return Tensor(jnp.where(inside, out, jnp.inf))
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    from jax.scipy.special import betaln, digamma
+    a1, b1, a2, b2 = p.alpha, p.beta, q.alpha, q.beta
+    t = (betaln(a2, b2) - betaln(a1, b1)
+         + (a1 - a2) * digamma(a1) + (b1 - b2) * digamma(b1)
+         + (a2 - a1 + b2 - b1) * digamma(a1 + b1))
+    return Tensor(t)
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    from jax.scipy.special import digamma, gammaln
+    a1, a2 = p.concentration, q.concentration
+    s1 = jnp.sum(a1, axis=-1)
+    t = (gammaln(s1) - jnp.sum(gammaln(a1), axis=-1)
+         - gammaln(jnp.sum(a2, axis=-1)) + jnp.sum(gammaln(a2), axis=-1)
+         + jnp.sum((a1 - a2) * (digamma(a1) - digamma(s1)[..., None]),
+                   axis=-1))
+    return Tensor(t)
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    d = jnp.abs(p.loc - q.loc)
+    t = (jnp.log(q.scale / p.scale)
+         + (p.scale * jnp.exp(-d / p.scale) + d) / q.scale - 1.0)
+    return Tensor(t)
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric_geometric(p, q):
+    p1, p2 = p.probs_arr, q.probs_arr
+    t = (jnp.log(p1 / p2)
+         + (1.0 - p1) / p1 * jnp.log((1.0 - p1) / (1.0 - p2)))
+    return Tensor(t)
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential_exponential(p, q):
+    t = jnp.log(p.rate / q.rate) + q.rate / p.rate - 1.0
+    return Tensor(t)

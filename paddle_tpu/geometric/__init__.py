@@ -36,29 +36,37 @@ def _segment(name, reduce_op, data, ids, num_segments):
                 (-1,) + (1,) * (d.ndim - 1))
         out = _REDUCERS[reduce_op](d, i, n)
         if reduce_op in ("max", "min"):
-            # empty segments come back +-inf; reference returns 0
-            return jnp.where(jnp.isfinite(out), out, 0)
+            # empty segments come back +-inf; reference returns 0. Detect
+            # emptiness via the segment count — an isfinite() test would
+            # also clobber legitimate +-inf data values.
+            c = jax.ops.segment_sum(jnp.ones_like(i, jnp.int32), i, n)
+            empty = (c == 0).reshape((-1,) + (1,) * (d.ndim - 1))
+            return jnp.where(empty, jnp.zeros_like(out), out)
         return out
     return apply_op(name, _f, data, ids)
 
 
 def segment_sum(data, segment_ids, name=None, num_segments=None):
-    n = num_segments or int(jnp.max(segment_ids._data)) + 1
+    n = num_segments if num_segments is not None \
+        else int(jnp.max(segment_ids._data)) + 1
     return _segment("segment_sum", "sum", data, segment_ids, n)
 
 
 def segment_mean(data, segment_ids, name=None, num_segments=None):
-    n = num_segments or int(jnp.max(segment_ids._data)) + 1
+    n = num_segments if num_segments is not None \
+        else int(jnp.max(segment_ids._data)) + 1
     return _segment("segment_mean", "mean", data, segment_ids, n)
 
 
 def segment_max(data, segment_ids, name=None, num_segments=None):
-    n = num_segments or int(jnp.max(segment_ids._data)) + 1
+    n = num_segments if num_segments is not None \
+        else int(jnp.max(segment_ids._data)) + 1
     return _segment("segment_max", "max", data, segment_ids, n)
 
 
 def segment_min(data, segment_ids, name=None, num_segments=None):
-    n = num_segments or int(jnp.max(segment_ids._data)) + 1
+    n = num_segments if num_segments is not None \
+        else int(jnp.max(segment_ids._data)) + 1
     return _segment("segment_min", "min", data, segment_ids, n)
 
 

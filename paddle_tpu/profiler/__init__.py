@@ -224,6 +224,12 @@ class Profiler:
             self.on_trace_ready(self)
 
     def _start_tracing(self):
+        if _tracer.enabled:
+            # the module-global tracer supports ONE active profiler; a
+            # silent second start would clear the first profiler's spans
+            raise RuntimeError(
+                "another Profiler is already recording; stop it first "
+                "(only one active Profiler is supported)")
         _tracer.enabled = True
         _tracer.events = []
         if any(t in (ProfilerTarget.TPU, ProfilerTarget.GPU)

@@ -234,3 +234,23 @@ def test_dataloader_shm_iterable_replicas_shard():
     for yb in dl:
         seen.extend(np.asarray(yb._data).tolist())
     assert sorted(seen) == list(range(20))  # no duplication across replicas
+
+
+def test_shm_ring_poisoned_on_corrupt_header():
+    """A corrupted ring (e.g. a worker SIGKILLed mid-push) must raise a
+    clear ShmRingError instead of mis-framing or reading out of bounds
+    (ADVICE r1 medium)."""
+    name = "/pt_t_poison"
+    ring = _native.ShmRing(name, capacity=1 << 14, create=True)
+    try:
+        ring.push(b"ok")
+        # clobber the magic word — the simplest header inconsistency a
+        # half-applied writer can leave
+        with open(f"/dev/shm{name}", "r+b") as f:
+            f.write(b"\x00" * 8)
+        with pytest.raises(Exception, match="corrupt"):
+            ring.pop(timeout_ms=500)
+        with pytest.raises(Exception, match="corrupt"):
+            ring.push(b"more", timeout_ms=500)
+    finally:
+        ring.unlink()
