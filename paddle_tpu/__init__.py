@@ -52,6 +52,7 @@ from . import profiler  # noqa: F401,E402
 from . import distribution  # noqa: F401,E402
 from . import sparse  # noqa: F401,E402
 from . import geometric  # noqa: F401,E402
+from . import quantization  # noqa: F401,E402
 
 from .nn.layer.layers import Layer  # noqa: F401,E402
 from .hapi.model import Model  # noqa: F401,E402

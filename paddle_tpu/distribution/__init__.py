@@ -37,6 +37,12 @@ def _key():
 
 
 class Distribution:
+    @staticmethod
+    def _param(tensor_or_none, raw):
+        """Prefer the user's original Tensor (keeps the autograd edge for
+        reparameterized sampling) over the unwrapped array."""
+        return tensor_or_none if tensor_or_none is not None else raw
+
     """Base. Parity: paddle.distribution.Distribution."""
 
     def __init__(self, batch_shape=(), event_shape=()):
@@ -84,8 +90,8 @@ class Normal(Distribution):
                                               self.scale.shape))
 
     def _params(self):
-        return (self._loc_p if self._loc_p is not None else self.loc,
-                self._scale_p if self._scale_p is not None else self.scale)
+        return (self._param(self._loc_p, self.loc),
+                self._param(self._scale_p, self.scale))
 
     @property
     def mean(self):
@@ -134,8 +140,8 @@ class Uniform(Distribution):
 
     def rsample(self, shape=()):
         u = jax.random.uniform(_key(), self._extend(shape), jnp.float32)
-        lo = self._low_p if self._low_p is not None else self.low
-        hi = self._high_p if self._high_p is not None else self.high
+        lo = self._param(self._low_p, self.low)
+        hi = self._param(self._high_p, self.high)
         return apply_op("uniform_rsample",
                         lambda lo_, hi_: lo_ + (hi_ - lo_) * u, lo, hi)
 
@@ -329,8 +335,8 @@ class Gumbel(Distribution):
 
     def rsample(self, shape=()):
         g = jax.random.gumbel(_key(), self._extend(shape))
-        loc = self._loc_p if self._loc_p is not None else self.loc
-        sc = self._scale_p if self._scale_p is not None else self.scale
+        loc = self._param(self._loc_p, self.loc)
+        sc = self._param(self._scale_p, self.scale)
         return apply_op("gumbel_rsample", lambda l, s: l + s * g, loc, sc)
 
     def sample(self, shape=()):
@@ -354,8 +360,8 @@ class Laplace(Distribution):
 
     def rsample(self, shape=()):
         l = jax.random.laplace(_key(), self._extend(shape))
-        loc = self._loc_p if self._loc_p is not None else self.loc
-        sc = self._scale_p if self._scale_p is not None else self.scale
+        loc = self._param(self._loc_p, self.loc)
+        sc = self._param(self._scale_p, self.scale)
         return apply_op("laplace_rsample", lambda lo, s: lo + s * l, loc, sc)
 
     def sample(self, shape=()):
@@ -510,7 +516,9 @@ def _kl_laplace_laplace(p, q):
 
 @register_kl(Geometric, Geometric)
 def _kl_geometric_geometric(p, q):
-    p1, p2 = p.probs_arr, q.probs_arr
+    # clip away the p=0/1 boundaries (0*log(0) -> NaN), like _kl_bern_bern
+    p1 = jnp.clip(p.probs_arr, 1e-7, 1 - 1e-7)
+    p2 = jnp.clip(q.probs_arr, 1e-7, 1 - 1e-7)
     t = (jnp.log(p1 / p2)
          + (1.0 - p1) / p1 * jnp.log((1.0 - p1) / (1.0 - p2)))
     return Tensor(t)

@@ -85,7 +85,11 @@ def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
                 (-1,) + (1,) * (xa.ndim - 1))
         out = _REDUCERS[reduce_op](msgs, d, n)
         if reduce_op in ("max", "min"):
-            return jnp.where(jnp.isfinite(out), out, 0)
+            # empty destinations -> 0 (count-based; isfinite would clobber
+            # legitimate +-inf messages)
+            c = jax.ops.segment_sum(jnp.ones_like(d, jnp.int32), d, n)
+            empty = (c == 0).reshape((-1,) + (1,) * (out.ndim - 1))
+            return jnp.where(empty, jnp.zeros_like(out), out)
         return out
     return apply_op("send_u_recv", _f, x, src_index, dst_index)
 
@@ -107,7 +111,11 @@ def send_ue_recv(x, y, src_index, dst_index, message_op="add",
                 (-1,) + (1,) * (msgs.ndim - 1))
         out = _REDUCERS[reduce_op](msgs, d, n)
         if reduce_op in ("max", "min"):
-            return jnp.where(jnp.isfinite(out), out, 0)
+            # empty destinations -> 0 (count-based; isfinite would clobber
+            # legitimate +-inf messages)
+            c = jax.ops.segment_sum(jnp.ones_like(d, jnp.int32), d, n)
+            empty = (c == 0).reshape((-1,) + (1,) * (out.ndim - 1))
+            return jnp.where(empty, jnp.zeros_like(out), out)
         return out
     return apply_op("send_ue_recv", _f, x, y, src_index, dst_index)
 

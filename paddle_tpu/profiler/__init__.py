@@ -218,7 +218,13 @@ class Profiler:
     def _transition(self, prev, new):
         recording = (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
         if prev not in recording and new in recording:
-            self._start_tracing()
+            try:
+                self._start_tracing()
+            except Exception:
+                # roll back so this profiler's stop()/__exit__ cannot tear
+                # down the OTHER profiler's active recording
+                self.current_state = ProfilerState.CLOSED
+                raise
         elif prev in recording and new not in recording:
             self._stop_tracing()
             self.on_trace_ready(self)

@@ -1,0 +1,367 @@
+"""Quantization: PTQ observers + QAT fake-quant + config/factory.
+
+Parity: reference `python/paddle/quantization/` — QuantConfig
+(config.py: add_layer_config/add_type_config/add_name_config),
+QuanterFactory (factory.py), BaseObserver (base_observer.py:23),
+AbsmaxObserver (observers/abs_max.py), FakeQuanterWithAbsMaxObserver
+(quanters/abs_max.py), PTQ (ptq.py:29) and QAT (qat.py:27) flows with
+ObserveWrapper (wrapper.py) and quantize/convert (quantize.py).
+
+TPU-native: fake-quant uses the straight-through estimator expressed as
+``x + stop_grad(dq(q(x)) - x)`` — XLA folds it into the surrounding
+computation; converted inference layers hold int8 weights + scales and
+run through nn.quant.weight_only_linear (Pallas dequant-matmul).
+"""
+from __future__ import annotations
+
+import copy
+from typing import Dict, Optional, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..ops.dispatch import apply_op
+
+__all__ = ["BaseQuanter", "BaseObserver", "AbsmaxObserver",
+           "AbsMaxChannelWiseWeightObserver",
+           "FakeQuanterWithAbsMaxObserver", "QuanterFactory", "quanter",
+           "SingleLayerConfig", "QuantConfig", "PTQ", "QAT",
+           "ObserveWrapper", "QuantedLinear"]
+
+
+def _fake_quant(x, scale, qmax=127.0):
+    """Quantize-dequantize with straight-through gradients."""
+    s = jnp.maximum(scale, 1e-10)
+    dq = jnp.clip(jnp.round(x / s), -qmax, qmax) * s
+    return x + jax.lax.stop_gradient(dq - x)
+
+
+class BaseQuanter(Layer):
+    """Parity: base_quanter.py. Produces quant params after observation."""
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        return None
+
+    def bit_length(self):
+        return 8
+
+    def quant_axis(self):
+        return None
+
+
+class BaseObserver(BaseQuanter):
+    """Parity: base_observer.py:23 — records statistics in forward, yields
+    thresholds via cal_thresholds()."""
+
+    def cal_thresholds(self):
+        pass
+
+
+class AbsmaxObserver(BaseObserver):
+    """Per-tensor absmax activation observer (observers/abs_max.py)."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self._absmax = 0.0
+
+    def forward(self, x):
+        val = float(np.asarray(jnp.max(jnp.abs(x._data))))
+        self._absmax = max(self._absmax, val)
+        return x
+
+    def cal_thresholds(self):
+        self._scale = self._absmax / (2 ** (self._quant_bits - 1) - 1)
+
+    def scales(self):
+        self.cal_thresholds()
+        return self._scale
+
+    def bit_length(self):
+        return self._quant_bits
+
+
+class AbsMaxChannelWiseWeightObserver(BaseObserver):
+    """Per-output-channel weight observer (observers/ + groupwise.py)."""
+
+    def __init__(self, quant_bits=8, quant_axis=-1):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self._axis = quant_axis
+        self._absmax = None
+
+    def forward(self, x):
+        w = x._data
+        axes = tuple(i for i in range(w.ndim) if i != (self._axis % w.ndim))
+        cur = np.asarray(jnp.max(jnp.abs(w), axis=axes))
+        self._absmax = cur if self._absmax is None else \
+            np.maximum(self._absmax, cur)
+        return x
+
+    def scales(self):
+        return self._absmax / (2 ** (self._quant_bits - 1) - 1)
+
+    def quant_axis(self):
+        return self._axis
+
+    def bit_length(self):
+        return self._quant_bits
+
+
+class FakeQuanterWithAbsMaxObserver(BaseQuanter):
+    """QAT fake-quant with a moving-average absmax (quanters/abs_max.py)."""
+
+    def __init__(self, moving_rate=0.9, bit_length=8, dtype=None, name=None):
+        super().__init__()
+        self._rate = moving_rate
+        self._bits = bit_length
+        self._state = None
+
+    def forward(self, x):
+        cur = float(np.asarray(jnp.max(jnp.abs(jax.lax.stop_gradient(
+            x._data)))))
+        self._state = cur if self._state is None else \
+            self._rate * self._state + (1 - self._rate) * cur
+        scale = jnp.float32(self._state / (2 ** (self._bits - 1) - 1))
+        return apply_op("fake_quant",
+                        lambda a: _fake_quant(a, scale,
+                                              2 ** (self._bits - 1) - 1), x)
+
+    def scales(self):
+        return self._state / (2 ** (self._bits - 1) - 1)
+
+    def bit_length(self):
+        return self._bits
+
+
+class QuanterFactory:
+    """Partial-bound quanter constructor (factory.py)."""
+
+    def __init__(self, cls, *args, **kwargs):
+        self._cls = cls
+        self._args = args
+        self._kwargs = kwargs
+
+    def _instance(self, layer=None):
+        return self._cls(*self._args, **self._kwargs)
+
+    def __repr__(self):
+        return f"QuanterFactory({self._cls.__name__})"
+
+
+def quanter(name):
+    """Decorator registering a quanter class and returning a factory maker
+    (parity: factory.py quanter decorator)."""
+    def deco(cls):
+        def make(*args, **kwargs):
+            return QuanterFactory(cls, *args, **kwargs)
+        globals()[name] = make
+        return cls
+    return deco
+
+
+class SingleLayerConfig:
+    """Parity: config.py SingleLayerConfig."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+
+
+class QuantConfig:
+    """Parity: config.py QuantConfig — per-layer / per-type / per-name
+    quanter configuration."""
+
+    def __init__(self, activation=None, weight=None):
+        self._global = SingleLayerConfig(activation, weight)
+        self._layer_configs = []     # (layer_obj, cfg)
+        self._type_configs = []      # (layer_cls, cfg)
+        self._name_configs = []      # (name, cfg)
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._layer_configs.append(
+                (l, SingleLayerConfig(activation, weight)))
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) \
+            else [layer_type]
+        for t in types:
+            self._type_configs.append(
+                (t, SingleLayerConfig(activation, weight)))
+
+    def add_name_config(self, layer_name, activation=None, weight=None):
+        names = layer_name if isinstance(layer_name, (list, tuple)) \
+            else [layer_name]
+        for n in names:
+            self._name_configs.append(
+                (n, SingleLayerConfig(activation, weight)))
+
+    def _config_for(self, name, layer):
+        for l, cfg in self._layer_configs:
+            if l is layer:
+                return cfg
+        for n, cfg in self._name_configs:
+            if n == name:
+                return cfg
+        for t, cfg in self._type_configs:
+            if isinstance(layer, t):
+                return cfg
+        if self._global.activation is not None or \
+                self._global.weight is not None:
+            if isinstance(layer, _linear_types()):
+                return self._global
+        return None
+
+
+def _linear_types():
+    """Layer types the global default config applies to: plain Linear and
+    the TP mpu linears (so the ERNIE/Llama ladder models quantize)."""
+    from ..nn import Linear
+    from ..distributed.fleet.mpu import (ColumnParallelLinear,
+                                         RowParallelLinear)
+    return (Linear, ColumnParallelLinear, RowParallelLinear)
+
+
+class ObserveWrapper(Layer):
+    """Observed layer: activation observer on input, weight observer fed the
+    weight (parity: wrapper.py ObserveWrapper)."""
+
+    def __init__(self, observed, cfg: SingleLayerConfig):
+        super().__init__()
+        self._observed = observed
+        self._act = cfg.activation._instance() if cfg.activation else None
+        self._weight_ob = cfg.weight._instance() if cfg.weight else None
+
+    def forward(self, *args, **kwargs):
+        if self._act is not None and args:
+            args = (self._act(args[0]),) + args[1:]
+        if self._weight_ob is not None and hasattr(self._observed, "weight"):
+            self._weight_ob(self._observed.weight)
+        return self._observed(*args, **kwargs)
+
+
+class QuantedLinear(Layer):
+    """Converted inference layer: int8 weight + per-channel scale through
+    nn.quant.weight_only_linear (the Pallas dequant-matmul path)."""
+
+    def __init__(self, linear, weight_scales=None):
+        super().__init__()
+        from ..nn import quant as Q
+        w = linear.weight
+        qw, scale = Q.weight_quantize(w, algo="weight_only_int8")
+        self.qweight = qw
+        self.weight_scale = scale
+        self.bias = getattr(linear, "bias", None)
+
+    def forward(self, x):
+        from ..nn import quant as Q
+        return Q.weight_only_linear(x, self.qweight, self.bias,
+                                    self.weight_scale, "int8")
+
+
+class Quantization:
+    """Parity: quantize.py Quantization base."""
+
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def _wrap(self, model):
+        for name, child in list(model._sub_layers.items()):
+            cfg = self._config._config_for(name, child)
+            if cfg is not None:
+                model._sub_layers[name] = self._make_wrapper(child, cfg)
+            else:
+                self._wrap(child)
+        return model
+
+    def convert(self, model, inplace=False, remain_weight=False):
+        """Replace observed/fake-quant layers with quantized inference
+        layers (int8 weights + scales)."""
+        if not inplace:
+            model = copy.deepcopy(model)
+        self._convert(model)
+        return model
+
+    def _convert(self, model):
+        for name, child in list(model._sub_layers.items()):
+            target = getattr(child, "_observed", None)
+            if isinstance(child, ObserveWrapper) and \
+                    isinstance(target, _linear_types()):
+                model._sub_layers[name] = QuantedLinear(target)
+            elif isinstance(child, ObserveWrapper):
+                model._sub_layers[name] = target
+            else:
+                self._convert(child)
+
+
+class PTQ(Quantization):
+    """Post-training quantization flow (ptq.py:29): quantize() wraps
+    matching layers with observers; run calibration batches; convert()."""
+
+    def quantize(self, model, inplace=False):
+        if not inplace:
+            model = copy.deepcopy(model)
+        return self._wrap(model)
+
+    def _make_wrapper(self, layer, cfg):
+        return ObserveWrapper(layer, cfg)
+
+
+class _QATWrapper(Layer):
+    """Fake-quant on weight + activation in forward (STE grads) —
+    nn.quant.qat.QuantedLinear's role."""
+
+    def __init__(self, observed, cfg):
+        super().__init__()
+        self._observed = observed
+        self._act_q = cfg.activation._instance() if cfg.activation else None
+        self._weight_q = cfg.weight._instance() if cfg.weight else None
+
+    def forward(self, *args, **kwargs):
+        if self._act_q is not None and args:
+            args = (self._act_q(args[0]),) + args[1:]
+        if self._weight_q is not None and hasattr(self._observed, "weight"):
+            w = self._observed.weight
+            orig = w._data
+            fq = self._weight_q(w)
+            w._data = fq._data
+            try:
+                return self._observed(*args, **kwargs)
+            finally:
+                w._data = orig
+        return self._observed(*args, **kwargs)
+
+    @property
+    def _observed_target(self):
+        return self._observed
+
+
+class QAT(Quantization):
+    """Quantization-aware training flow (qat.py:27)."""
+
+    def quantize(self, model, inplace=False):
+        if not inplace:
+            model = copy.deepcopy(model)
+        return self._wrap(model)
+
+    def _make_wrapper(self, layer, cfg):
+        return _QATWrapper(layer, cfg)
+
+    def _convert(self, model):
+        for name, child in list(model._sub_layers.items()):
+            target = getattr(child, "_observed", None)
+            if isinstance(child, _QATWrapper) and \
+                    isinstance(target, _linear_types()):
+                model._sub_layers[name] = QuantedLinear(target)
+            elif isinstance(child, _QATWrapper):
+                model._sub_layers[name] = target
+            else:
+                self._convert(child)

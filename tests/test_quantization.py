@@ -1,0 +1,157 @@
+"""Quantization: PTQ/QAT flows + weight-only int8/int4 linear.
+
+Parity targets: reference `python/paddle/quantization/` (config/ptq/qat/
+observers) and `python/paddle/nn/quant/quantized_linear.py`. The Pallas
+int8 dequant-matmul runs in interpret mode on CPU.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.quant as Q
+from paddle_tpu.quantization import (AbsmaxObserver,
+                                     AbsMaxChannelWiseWeightObserver,
+                                     FakeQuanterWithAbsMaxObserver, PTQ, QAT,
+                                     QuantConfig, QuantedLinear,
+                                     QuanterFactory)
+
+rng = np.random.RandomState(0)
+
+
+# ------------------------------------------------------- weight-only linear
+def test_weight_quantize_dequantize_roundtrip():
+    w = paddle.to_tensor(rng.randn(64, 128).astype(np.float32))
+    qw, s = Q.weight_quantize(w)
+    assert str(qw._data.dtype) == "int8"
+    wd = Q.weight_dequantize(qw, s)
+    rel = np.abs(np.asarray(wd._data) - np.asarray(w._data)).max() / \
+        np.abs(np.asarray(w._data)).max()
+    assert rel < 0.01  # int8 per-channel: <1% of range
+
+
+def test_weight_only_linear_int8_matches_dequant():
+    w = paddle.to_tensor(rng.randn(64, 128).astype(np.float32))
+    x = paddle.to_tensor(rng.randn(8, 64).astype(np.float32))
+    b = paddle.to_tensor(rng.randn(128).astype(np.float32))
+    qw, s = Q.weight_quantize(w)
+    out = Q.weight_only_linear(x, qw, b, s)
+    ref = np.asarray(x._data) @ np.asarray(Q.weight_dequantize(qw, s)._data) \
+        + np.asarray(b._data)
+    np.testing.assert_allclose(np.asarray(out._data), ref, atol=1e-4)
+
+
+def test_weight_only_linear_int4():
+    w = paddle.to_tensor(rng.randn(64, 128).astype(np.float32))
+    x = paddle.to_tensor(rng.randn(8, 64).astype(np.float32))
+    qw, s = Q.weight_quantize(w, algo="weight_only_int4")
+    assert list(qw.shape) == [32, 128]  # packed two per byte
+    out = Q.weight_only_linear(x, qw, None, s, weight_dtype="int4")
+    ref = np.asarray(x._data) @ np.asarray(
+        Q.weight_dequantize(qw, s, algo="weight_only_int4")._data)
+    np.testing.assert_allclose(np.asarray(out._data), ref, atol=1e-4)
+    # int4 quantization error itself stays bounded
+    rel = np.abs(ref - np.asarray(x._data) @ np.asarray(w._data)).max() / \
+        np.abs(ref).max()
+    assert rel < 0.2
+
+
+def test_weight_only_linear_grad_flows_to_x():
+    w = paddle.to_tensor(rng.randn(64, 128).astype(np.float32))
+    x = paddle.to_tensor(rng.randn(8, 64).astype(np.float32),
+                         stop_gradient=False)
+    qw, s = Q.weight_quantize(w)
+    out = Q.weight_only_linear(x, qw, None, s)
+    out.sum().backward()
+    g = np.asarray(x.grad._data if hasattr(x.grad, "_data") else x.grad)
+    ref = np.asarray(Q.weight_dequantize(qw, s)._data).sum(axis=1)
+    np.testing.assert_allclose(g, np.broadcast_to(ref, (8, 64)), rtol=1e-4)
+
+
+def test_llm_int8_linear():
+    w = paddle.to_tensor(rng.randn(64, 128).astype(np.float32))
+    x = paddle.to_tensor(rng.randn(8, 64).astype(np.float32))
+    qw, s = Q.weight_quantize(w)
+    out = Q.llm_int8_linear(x, qw, None, s)
+    assert list(out.shape) == [8, 128]
+
+
+# ------------------------------------------------------------------ PTQ/QAT
+def _default_config():
+    return QuantConfig(
+        activation=QuanterFactory(AbsmaxObserver),
+        weight=QuanterFactory(AbsMaxChannelWiseWeightObserver))
+
+
+def test_ptq_quantizes_ernie_within_tolerance():
+    """VERDICT r1 #9 done-criterion: PTQ the ERNIE ladder model, match
+    fp32 within tolerance."""
+    from paddle_tpu.models.ernie import (ErnieForSequenceClassification,
+                                         ernie_tiny)
+    paddle.seed(0)
+    cfg = ernie_tiny()
+    m = ErnieForSequenceClassification(cfg, num_classes=4)
+    m.eval()
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (2, 16)))
+    ref = np.asarray(m(ids)._data)
+    ptq = PTQ(_default_config())
+    qm = ptq.quantize(m)
+    qm.eval()
+    for _ in range(3):
+        qm(ids)  # calibration passes feed the observers
+    conv = ptq.convert(qm)
+    conv.eval()
+    out = np.asarray(conv(ids)._data)
+    rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.05, rel
+    # converted layers actually hold int8 weights
+    kinds = [type(l).__name__ for l in conv.sublayers()]
+    assert "QuantedLinear" in kinds
+
+
+def test_ptq_original_model_untouched():
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 8))
+    ptq = PTQ(_default_config())
+    qm = ptq.quantize(net)  # inplace=False default: deep copy
+    assert type(net[0]).__name__ == "Linear"
+    assert type(qm[0]).__name__ == "ObserveWrapper"
+
+
+def test_qat_fake_quant_training():
+    """QAT: fake-quant forward keeps STE gradients; the model trains."""
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(16, 32), paddle.nn.ReLU(),
+                               paddle.nn.Linear(32, 16))
+    qat = QAT(QuantConfig(
+        activation=QuanterFactory(FakeQuanterWithAbsMaxObserver),
+        weight=QuanterFactory(FakeQuanterWithAbsMaxObserver)))
+    qnet = qat.quantize(net, inplace=True)
+    opt = paddle.optimizer.AdamW(1e-2, parameters=qnet.parameters())
+    x = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+    losses = []
+    for _ in range(10):
+        loss = paddle.nn.functional.mse_loss(qnet(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(np.asarray(loss._data)))
+    assert losses[-1] < losses[0]
+    conv = qat.convert(qnet)
+    out = conv(x)
+    assert list(out.shape) == [8, 16]
+
+
+def test_quant_config_precedence():
+    from paddle_tpu.quantization import SingleLayerConfig
+    lin1 = paddle.nn.Linear(4, 4)
+    lin2 = paddle.nn.Linear(4, 4)
+    cfg = QuantConfig(activation=QuanterFactory(AbsmaxObserver),
+                      weight=QuanterFactory(AbsMaxChannelWiseWeightObserver))
+    special = QuanterFactory(AbsmaxObserver, quant_bits=4)
+    cfg.add_layer_config(lin1, activation=special, weight=special)
+    got = cfg._config_for("x", lin1)
+    assert got.activation is special
+    got2 = cfg._config_for("x", lin2)
+    assert got2.activation is not special  # falls to global default
