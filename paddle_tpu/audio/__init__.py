@@ -1,0 +1,17 @@
+"""paddle.audio — spectrogram features.
+
+Parity: reference `python/paddle/audio/` — functional (window/mel/dct
+helpers, `audio/functional/functional.py`) and features (Spectrogram /
+MelSpectrogram / LogMelSpectrogram / MFCC layers, `audio/features/
+layers.py`).
+
+TPU-native: STFT framing is a strided window + rfft — one batched matmul
+and an XLA FFT, no conv tricks needed.
+"""
+from . import functional  # noqa: F401
+from . import features  # noqa: F401
+from .features import (LogMelSpectrogram, MelSpectrogram, MFCC,  # noqa: F401
+                       Spectrogram)
+
+__all__ = ["functional", "features", "Spectrogram", "MelSpectrogram",
+           "LogMelSpectrogram", "MFCC"]

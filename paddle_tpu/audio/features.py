@@ -1,0 +1,126 @@
+"""audio.features — Spectrogram / MelSpectrogram / LogMelSpectrogram / MFCC.
+
+Parity: reference `python/paddle/audio/features/layers.py`. STFT is
+implemented as strided framing + window + rfft (XLA FFT HLO); all layers
+are differentiable through the tape.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..ops.dispatch import apply_op
+from . import functional as AF
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+def _stft_power(x, n_fft, hop_length, win, center, power,
+                pad_mode="reflect"):
+    """x: (..., T) -> (..., n_freq, n_frames) |STFT|^power."""
+    if center:
+        pad = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        x = jnp.pad(x, pad, mode=pad_mode)
+    T = x.shape[-1]
+    n_frames = 1 + (T - n_fft) // hop_length
+    idx = (jnp.arange(n_frames)[:, None] * hop_length
+           + jnp.arange(n_fft)[None, :])                 # (frames, n_fft)
+    frames = x[..., idx]                                 # (..., frames, n_fft)
+    frames = frames * win[None, :]
+    spec = jnp.fft.rfft(frames, axis=-1)                 # (..., frames, freq)
+    mag = jnp.abs(spec) ** power
+    return jnp.swapaxes(mag, -1, -2)                     # (..., freq, frames)
+
+
+class Spectrogram(Layer):
+    """Parity: features/layers.py Spectrogram."""
+
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        w = AF.get_window(window, self.win_length)._data
+        if self.win_length < n_fft:  # center-pad window to n_fft
+            lpad = (n_fft - self.win_length) // 2
+            w = jnp.pad(w, (lpad, n_fft - self.win_length - lpad))
+        self.register_buffer("window", Tensor(w), persistable=False)
+
+    def forward(self, x):
+        return apply_op(
+            "spectrogram",
+            lambda a, w: _stft_power(a, self.n_fft, self.hop_length, w,
+                                     self.center, self.power,
+                                     self.pad_mode),
+            x, self.window)
+
+
+class MelSpectrogram(Layer):
+    """Parity: features/layers.py MelSpectrogram."""
+
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode)
+        fb = AF.compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max, htk,
+                                     norm)
+        self.register_buffer("fbank_matrix", fb, persistable=False)
+
+    def forward(self, x):
+        spec = self._spectrogram(x)
+        return apply_op("mel_spectrogram",
+                        lambda s, fb: jnp.einsum("...ft,mf->...mt", s, fb),
+                        spec, self.fbank_matrix)
+
+
+class LogMelSpectrogram(Layer):
+    """Parity: features/layers.py LogMelSpectrogram."""
+
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 ref_value=1.0, amin=1e-10, top_db=None, dtype="float32"):
+        super().__init__()
+        self._mel = MelSpectrogram(sr, n_fft, hop_length, win_length, window,
+                                   power, center, pad_mode, n_mels, f_min,
+                                   f_max, htk, norm)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return AF.power_to_db(self._mel(x), self.ref_value, self.amin,
+                              self.top_db)
+
+
+class MFCC(Layer):
+    """Parity: features/layers.py MFCC (log-mel + DCT)."""
+
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self._log_mel = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db)
+        dct = AF.create_dct(n_mfcc, n_mels)
+        self.register_buffer("dct_matrix", dct, persistable=False)
+
+    def forward(self, x):
+        lm = self._log_mel(x)
+        return apply_op("mfcc",
+                        lambda s, d: jnp.einsum("...mt,mk->...kt", s, d),
+                        lm, self.dct_matrix)

@@ -1,0 +1,138 @@
+"""audio.functional — windows, mel filterbanks, dct.
+
+Parity: reference `python/paddle/audio/functional/functional.py`
+(hz_to_mel/mel_to_hz/mel_frequencies/fft_frequencies/compute_fbank_matrix/
+power_to_db/create_dct) and `window.py` (get_window).
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct", "get_window"]
+
+
+def hz_to_mel(freq, htk=False):
+    """Hz -> mel. Slaney (default) or HTK formula."""
+    scalar = not hasattr(freq, "__len__") and not isinstance(freq, Tensor)
+    f = np.asarray(freq._data if isinstance(freq, Tensor) else freq,
+                   dtype=np.float64)
+    if htk:
+        mel = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mel = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mel = np.where(f >= min_log_hz,
+                       min_log_mel + np.log(np.maximum(f, 1e-10)
+                                            / min_log_hz) / logstep, mel)
+    return float(mel) if scalar else Tensor(jnp.asarray(mel, jnp.float32))
+
+
+def mel_to_hz(mel, htk=False):
+    scalar = not hasattr(mel, "__len__") and not isinstance(mel, Tensor)
+    m = np.asarray(mel._data if isinstance(mel, Tensor) else mel,
+                   dtype=np.float64)
+    if htk:
+        f = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        f = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        f = np.where(m >= min_log_mel,
+                     min_log_hz * np.exp(logstep * (m - min_log_mel)), f)
+    return float(f) if scalar else Tensor(jnp.asarray(f, jnp.float32))
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    lo = hz_to_mel(float(f_min), htk)
+    hi = hz_to_mel(float(f_max), htk)
+    mels = np.linspace(lo, hi, n_mels)
+    return Tensor(jnp.asarray(
+        np.asarray(mel_to_hz(mels, htk)._data), jnp.float32))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    return Tensor(jnp.linspace(0.0, sr / 2.0, 1 + n_fft // 2,
+                               dtype=jnp.float32))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """(n_mels, 1 + n_fft//2) triangular mel filterbank."""
+    f_max = f_max if f_max is not None else sr / 2.0
+    fft_f = np.asarray(fft_frequencies(sr, n_fft)._data)
+    mel_f = np.asarray(mel_frequencies(n_mels + 2, f_min, f_max, htk)._data)
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fft_f[None, :]
+    lower = -ramps[:-2] / np.maximum(fdiff[:-1, None], 1e-10)
+    upper = ramps[2:] / np.maximum(fdiff[1:, None], 1e-10)
+    fb = np.maximum(0.0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        fb *= enorm[:, None]
+    return Tensor(jnp.asarray(fb, jnp.float32))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0, name=None):
+    """10*log10(S/ref) with floor. Parity: functional.py power_to_db."""
+    from ..ops.dispatch import apply_op
+
+    def _f(s):
+        log_spec = 10.0 * jnp.log10(jnp.maximum(amin, s))
+        log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+        return log_spec
+    return apply_op("power_to_db", _f, spect)
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """(n_mels, n_mfcc) DCT-II matrix. Parity: functional.py create_dct."""
+    n = np.arange(n_mels, dtype=np.float64)
+    k = np.arange(n_mfcc, dtype=np.float64)[None, :]
+    dct = np.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2.0)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return Tensor(jnp.asarray(dct, jnp.float32))
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """hann/hamming/blackman/... periodic (fftbins) or symmetric windows."""
+    if isinstance(window, (tuple, list)):
+        name, *args = window
+    else:
+        name, args = window, []
+    n = win_length + (0 if fftbins else -1)
+    t = np.arange(win_length, dtype=np.float64)
+    denom = max(n, 1)
+    if name in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * math.pi * t / denom)
+    elif name == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * math.pi * t / denom)
+    elif name == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * math.pi * t / denom)
+             + 0.08 * np.cos(4 * math.pi * t / denom))
+    elif name in ("rect", "rectangular", "boxcar", "ones"):
+        w = np.ones(win_length)
+    elif name == "bartlett":
+        w = 1.0 - np.abs(2 * t / denom - 1.0)
+    elif name == "gaussian":
+        std = args[0] if args else 7.0
+        w = np.exp(-0.5 * ((t - (win_length - 1) / 2.0) / std) ** 2)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return Tensor(jnp.asarray(w, jnp.float32))

@@ -44,6 +44,8 @@ class Tensor:
         "placements",
         "_spec",
         "_lr_scale",
+        "_asp_mask",   # incubate.asp 2:4 sparsity mask (travels with the
+                       # parameter through deepcopy, unlike an id registry)
         "__weakref__",
     )
 

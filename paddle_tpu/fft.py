@@ -1,0 +1,103 @@
+"""paddle.fft — discrete Fourier transforms.
+
+Parity: reference `python/paddle/fft.py` (delegating to phi fft kernels /
+pocketfft). TPU-native: jnp.fft lowers to XLA's FFT HLO; every call goes
+through the dispatch funnel so transforms are differentiable on the tape.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .ops.dispatch import apply_op
+
+__all__ = [
+    "fft", "ifft", "fft2", "ifft2", "fftn", "ifftn",
+    "rfft", "irfft", "rfft2", "irfft2", "rfftn", "irfftn",
+    "hfft", "ihfft", "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _norm(norm):
+    return norm if norm in ("ortho", "forward") else "backward"
+
+
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    return apply_op("fft", lambda a: jnp.fft.fft(a, n, axis, _norm(norm)), x)
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    return apply_op("ifft", lambda a: jnp.fft.ifft(a, n, axis, _norm(norm)), x)
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply_op("fft2", lambda a: jnp.fft.fft2(a, s, axes, _norm(norm)), x)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply_op("ifft2",
+                    lambda a: jnp.fft.ifft2(a, s, axes, _norm(norm)), x)
+
+
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    return apply_op("fftn", lambda a: jnp.fft.fftn(a, s, axes, _norm(norm)), x)
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    return apply_op("ifftn",
+                    lambda a: jnp.fft.ifftn(a, s, axes, _norm(norm)), x)
+
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    return apply_op("rfft", lambda a: jnp.fft.rfft(a, n, axis, _norm(norm)), x)
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    return apply_op("irfft",
+                    lambda a: jnp.fft.irfft(a, n, axis, _norm(norm)), x)
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply_op("rfft2",
+                    lambda a: jnp.fft.rfft2(a, s, axes, _norm(norm)), x)
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply_op("irfft2",
+                    lambda a: jnp.fft.irfft2(a, s, axes, _norm(norm)), x)
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    return apply_op("rfftn",
+                    lambda a: jnp.fft.rfftn(a, s, axes, _norm(norm)), x)
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    return apply_op("irfftn",
+                    lambda a: jnp.fft.irfftn(a, s, axes, _norm(norm)), x)
+
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    return apply_op("hfft", lambda a: jnp.fft.hfft(a, n, axis, _norm(norm)), x)
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    return apply_op("ihfft",
+                    lambda a: jnp.fft.ihfft(a, n, axis, _norm(norm)), x)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.tensor import Tensor
+    return Tensor(jnp.fft.fftfreq(n, d))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.tensor import Tensor
+    return Tensor(jnp.fft.rfftfreq(n, d))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply_op("fftshift", lambda a: jnp.fft.fftshift(a, axes), x)
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply_op("ifftshift", lambda a: jnp.fft.ifftshift(a, axes), x)

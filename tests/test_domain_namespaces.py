@@ -1,0 +1,234 @@
+"""fft / audio / text / incubate namespace tests (VERDICT r1 missing #8)."""
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate as incubate
+
+rng = np.random.RandomState(0)
+
+
+# ------------------------------------------------------------------- fft
+def test_fft_roundtrips():
+    x = paddle.to_tensor(rng.randn(4, 32).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(paddle.fft.irfft(paddle.fft.rfft(x), n=32)._data),
+        np.asarray(x._data), atol=1e-5)
+    xc = paddle.fft.ifft(paddle.fft.fft(x))
+    np.testing.assert_allclose(np.asarray(xc._data).real,
+                               np.asarray(x._data), atol=1e-5)
+    x2 = paddle.to_tensor(rng.randn(4, 8, 8).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(paddle.fft.ifft2(paddle.fft.fft2(x2))._data).real,
+        np.asarray(x2._data), atol=1e-5)
+
+
+def test_fft_matches_numpy_and_grads():
+    x = paddle.to_tensor(rng.randn(16).astype(np.float32),
+                         stop_gradient=False)
+    X = paddle.fft.rfft(x)
+    np.testing.assert_allclose(np.asarray(X._data),
+                               np.fft.rfft(np.asarray(x._data)), atol=1e-4)
+    energy = (X.abs() ** 2).sum()
+    energy.backward()
+    assert x.grad is not None
+
+
+def test_fftshift_fftfreq():
+    f = paddle.fft.fftfreq(8, d=0.5)
+    np.testing.assert_allclose(np.asarray(f._data),
+                               np.fft.fftfreq(8, 0.5), atol=1e-7)
+    x = paddle.to_tensor(np.arange(8, dtype=np.float32))
+    np.testing.assert_allclose(np.asarray(paddle.fft.fftshift(x)._data),
+                               np.fft.fftshift(np.arange(8)), atol=1e-7)
+
+
+# ----------------------------------------------------------------- audio
+def test_mel_fbank_properties():
+    fb = np.asarray(paddle.audio.functional.compute_fbank_matrix(
+        16000, 512, n_mels=40)._data)
+    assert fb.shape == (40, 257)
+    assert (fb >= 0).all()
+    assert (fb.sum(axis=1) > 0).all()      # every filter covers some bins
+
+
+def test_hz_mel_roundtrip():
+    from paddle_tpu.audio.functional import hz_to_mel, mel_to_hz
+    for hz in (60.0, 440.0, 4000.0):
+        assert abs(mel_to_hz(hz_to_mel(hz)) - hz) < 1e-3
+
+
+def test_spectrogram_parseval():
+    """Rect-window, hop == n_fft spectrogram preserves frame energy."""
+    from paddle_tpu.audio.features import Spectrogram
+    n = 256
+    wav = paddle.to_tensor(rng.randn(1, 1024).astype(np.float32))
+    sp = Spectrogram(n_fft=n, hop_length=n, window="rect", power=2.0,
+                     center=False)
+    S = np.asarray(sp(wav)._data)          # (1, freq, frames)
+    frames = np.asarray(wav._data)[0][:1024].reshape(-1, n)
+    for t in range(S.shape[-1]):
+        spec_e = S[0, 0, t] + 2 * S[0, 1:-1, t].sum() + S[0, -1, t]
+        time_e = (frames[t] ** 2).sum() * n
+        np.testing.assert_allclose(spec_e, time_e, rtol=1e-4)
+
+
+def test_mfcc_shapes_and_grad():
+    from paddle_tpu.audio.features import MFCC
+    wav = paddle.to_tensor(rng.randn(2, 2000).astype(np.float32),
+                           stop_gradient=False)
+    out = MFCC(sr=16000, n_mfcc=13, n_fft=256, n_mels=32)(wav)
+    assert list(out.shape)[:2] == [2, 13]
+    out.sum().backward()
+    assert wav.grad is not None
+
+
+# ------------------------------------------------------------------ text
+def _brute_viterbi(emis, trans, length):
+    N = emis.shape[1]
+    best, arg = -np.inf, None
+    for path in itertools.product(range(N), repeat=length):
+        s = emis[0, path[0]]
+        for t in range(1, length):
+            s += trans[path[t - 1], path[t]] + emis[t, path[t]]
+        if s > best:
+            best, arg = s, path
+    return best, arg
+
+
+def test_viterbi_matches_bruteforce():
+    B, T, N = 2, 5, 4
+    emis = rng.randn(B, T, N).astype(np.float32)
+    trans = rng.randn(N, N).astype(np.float32)
+    lens = np.array([5, 3])
+    scores, paths = paddle.text.viterbi_decode(
+        paddle.to_tensor(emis), paddle.to_tensor(trans),
+        paddle.to_tensor(lens), include_bos_eos_tag=False)
+    for b in range(B):
+        ref_s, ref_p = _brute_viterbi(emis[b], trans, int(lens[b]))
+        np.testing.assert_allclose(float(np.asarray(scores._data)[b]),
+                                   ref_s, rtol=1e-5)
+        got = tuple(np.asarray(paths._data)[b][:lens[b]])
+        assert got == ref_p, (b, got, ref_p)
+
+
+def test_text_datasets_refuse_download():
+    with pytest.raises(RuntimeError, match="data_file"):
+        paddle.text.Imdb()
+
+
+# -------------------------------------------------------------- incubate
+def test_fused_transformer_encoder_trains():
+    paddle.seed(0)
+    layer = incubate.nn.FusedTransformerEncoderLayer(32, 4, 64)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=layer.parameters())
+    x = paddle.to_tensor(rng.randn(2, 8, 32).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(2, 8, 32).astype(np.float32))
+    losses = []
+    for _ in range(5):
+        loss = paddle.nn.functional.mse_loss(layer(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(np.asarray(loss._data)))
+    assert losses[-1] < losses[0]
+
+
+def test_fused_rms_norm_matches_composition():
+    from paddle_tpu.incubate.nn.functional import fused_rms_norm
+    x = paddle.to_tensor(rng.randn(2, 8, 16).astype(np.float32))
+    w = paddle.to_tensor(np.ones(16, np.float32))
+    res = paddle.to_tensor(rng.randn(2, 8, 16).astype(np.float32))
+    out, res_out = fused_rms_norm(x, w, residual=res)
+    a = np.asarray(x._data) + np.asarray(res._data)
+    ref = a / np.sqrt((a ** 2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(np.asarray(out._data), ref, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(res_out._data), a, atol=1e-6)
+
+
+def test_softmax_mask_fuse_upper_triangle():
+    from paddle_tpu.incubate import softmax_mask_fuse_upper_triangle
+    x = paddle.to_tensor(rng.randn(1, 2, 6, 6).astype(np.float32))
+    out = np.asarray(softmax_mask_fuse_upper_triangle(x)._data)
+    assert np.allclose(np.triu(out[0, 0], k=1), 0.0)
+    np.testing.assert_allclose(out.sum(-1), 1.0, atol=1e-5)
+
+
+def test_asp_2_4_pruning_and_training():
+    from paddle_tpu.incubate.asp import (calculate_density, check_mask_1d,
+                                         decorate, prune_model)
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(16, 32), paddle.nn.ReLU(),
+                               paddle.nn.Linear(32, 16))
+    prune_model(net)
+    assert abs(calculate_density(net[0].weight._data) - 0.5) < 1e-6
+    opt = decorate(paddle.optimizer.AdamW(1e-2, parameters=net.parameters()))
+    x = paddle.randn([4, 16])
+    y = paddle.randn([4, 16])
+    for _ in range(3):
+        loss = paddle.nn.functional.mse_loss(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    # masks survive optimizer updates (2:4 pattern intact)
+    assert check_mask_1d(np.asarray(net[0].weight._data))
+    assert abs(calculate_density(net[0].weight._data) - 0.5) < 1e-6
+
+
+def test_fused_rms_norm_applies_norm_bias():
+    from paddle_tpu.incubate.nn.functional import fused_rms_norm
+    x = paddle.to_tensor(rng.randn(2, 4, 8).astype(np.float32))
+    w = paddle.to_tensor(np.ones(8, np.float32))
+    nb = paddle.to_tensor(np.full(8, 0.5, np.float32))
+    out_nb = fused_rms_norm(x, w, norm_bias=nb)
+    out = fused_rms_norm(x, w)
+    np.testing.assert_allclose(np.asarray(out_nb._data),
+                               np.asarray(out._data) + 0.5, atol=1e-6)
+
+
+def test_fused_mha_pre_layer_norm_differs():
+    paddle.seed(0)
+    pre = incubate.nn.FusedMultiHeadAttention(32, 4, normalize_before=True)
+    x = paddle.to_tensor(rng.randn(2, 6, 32).astype(np.float32))
+    y_pre = pre(x)
+    pre.normalize_before = False
+    y_post = pre(x)
+    assert not np.allclose(np.asarray(y_pre._data),
+                           np.asarray(y_post._data))
+
+
+def test_viterbi_single_step():
+    emis = rng.randn(2, 1, 4).astype(np.float32)
+    trans = rng.randn(4, 4).astype(np.float32)
+    scores, paths = paddle.text.viterbi_decode(
+        paddle.to_tensor(emis), paddle.to_tensor(trans),
+        paddle.to_tensor(np.array([1, 1])), include_bos_eos_tag=False)
+    np.testing.assert_allclose(np.asarray(scores._data), emis.max(-1)[:, 0],
+                               rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(paths._data)[:, 0],
+                                  emis.argmax(-1)[:, 0])
+
+
+def test_spectrogram_pad_mode_respected():
+    from paddle_tpu.audio.features import Spectrogram
+    wav = paddle.to_tensor(rng.randn(1, 600).astype(np.float32))
+    a = np.asarray(Spectrogram(n_fft=256, pad_mode="reflect")(wav)._data)
+    b = np.asarray(Spectrogram(n_fft=256, pad_mode="constant")(wav)._data)
+    assert not np.allclose(a, b)
+
+
+def test_asp_mask_survives_deepcopy():
+    import copy
+    from paddle_tpu.incubate.asp import check_mask_1d, decorate, prune_model
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 8))
+    prune_model(net)
+    net2 = copy.deepcopy(net)
+    opt = decorate(paddle.optimizer.SGD(0.1, parameters=net2.parameters()))
+    loss = (net2(paddle.randn([2, 8])) ** 2).sum()
+    loss.backward()
+    opt.step()
+    assert check_mask_1d(np.asarray(net2[0].weight._data))
