@@ -42,4 +42,6 @@ from . import launch  # noqa: F401
 from . import context_parallel  # noqa: F401
 from .context_parallel import context_parallel_attention  # noqa: F401
 from . import rpc  # noqa: F401
+from . import auto_tuner  # noqa: F401
+from . import watchdog  # noqa: F401
 from . import utils as dist_utils  # noqa: F401

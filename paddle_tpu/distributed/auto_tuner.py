@@ -1,0 +1,234 @@
+"""Auto-tuner: black-box distributed-config search.
+
+Parity: reference `python/paddle/distributed/auto_tuner/` — AutoTuner
+(tuner.py:21, search_once/add_cfg/resume history), pruning rules
+(prune.py: prune_by_mp/pp/mbs/sharding/recompute), cost & memory models
+(cost_model.py, memory_cost_model.py).
+
+TPU-native: candidates are hybrid-mesh factorings (dp/mp/pp/sharding/
+micro-batch/recompute); the memory model budgets HBM per chip (params/
+grads/optimizer states divided by the sharding axes + activation
+estimate), the cost model ranks by modeled step time (FLOPs over
+MXU peak scaled by a parallelism-efficiency factor). The runner loop is
+the user's (launch a trial, report back via add_cfg), same as the
+reference's controller."""
+from __future__ import annotations
+
+import csv
+import itertools
+import os
+from typing import Dict, List, Optional
+
+__all__ = ["AutoTuner", "default_candidates", "prune_by_mp", "prune_by_pp",
+           "prune_by_mbs", "prune_by_sharding", "prune_by_recompute",
+           "memory_cost", "time_cost"]
+
+
+def default_candidates(tuner_cfg):
+    """Enumerate dp/mp/pp/sharding/mbs/recompute candidates for the world
+    size (parity: tuner.py default search space)."""
+    world = int(tuner_cfg.get("num_gpus", tuner_cfg.get("num_chips", 8)))
+    gbs = int(tuner_cfg.get("global_batch_size", 32))
+    cands = []
+    degrees = [d for d in (1, 2, 4, 8, 16, 32, 64) if d <= world]
+    for mp, pp, sharding in itertools.product(degrees, degrees, degrees):
+        if world % (mp * pp) != 0:
+            continue
+        dp = world // (mp * pp)
+        if sharding > dp:
+            continue
+        for mbs in (1, 2, 4, 8):
+            if gbs % (dp * mbs) != 0:
+                continue
+            for rc in (False, True):
+                cands.append({
+                    "dp_degree": dp, "mp_degree": mp, "pp_degree": pp,
+                    "sharding_degree": sharding, "sharding_stage": 1,
+                    "micro_batch_size": mbs, "use_recompute": rc,
+                })
+    return cands
+
+
+# --------------------------------------------------------- pruning rules
+def prune_by_mp(tuner_cfg, cur_cfg, history_cfgs=()):
+    """mp must divide heads and hidden size and stay intra-host-ish
+    (parity: prune.py:129)."""
+    mp = cur_cfg.get("mp_degree", 1)
+    heads = tuner_cfg.get("model_cfg", {}).get("num_attention_heads")
+    hidden = tuner_cfg.get("model_cfg", {}).get("hidden_size")
+    if heads and heads % mp != 0:
+        return True
+    if hidden and hidden % mp != 0:
+        return True
+    return False
+
+
+def prune_by_pp(tuner_cfg, cur_cfg, history_cfgs=()):
+    """pp must divide the layer count (parity: prune.py:173)."""
+    pp = cur_cfg.get("pp_degree", 1)
+    layers = tuner_cfg.get("model_cfg", {}).get("num_layers")
+    if layers and layers % pp != 0:
+        return True
+    return False
+
+
+def prune_by_mbs(tuner_cfg, cur_cfg, history_cfgs=()):
+    """micro batch must divide the local batch (parity: prune.py:307)."""
+    gbs = int(tuner_cfg.get("global_batch_size", 32))
+    dp = cur_cfg.get("dp_degree", 1)
+    mbs = cur_cfg.get("micro_batch_size", 1)
+    if gbs % dp != 0:
+        return True
+    local = gbs // dp
+    return local % mbs != 0
+
+
+def prune_by_sharding(tuner_cfg, cur_cfg, history_cfgs=()):
+    """sharding degree divides dp (parity: prune.py:395)."""
+    dp = cur_cfg.get("dp_degree", 1)
+    sh = cur_cfg.get("sharding_degree", 1)
+    return sh > 1 and dp % sh != 0
+
+
+def prune_by_recompute(tuner_cfg, cur_cfg, history_cfgs=()):
+    """If a no-recompute run already fit in memory, recompute=True can only
+    be slower (parity: prune.py:486)."""
+    if not cur_cfg.get("use_recompute", False):
+        return False
+    for h in history_cfgs:
+        if (not h.get("use_recompute", False)
+                and h.get("mp_degree") == cur_cfg.get("mp_degree")
+                and h.get("pp_degree") == cur_cfg.get("pp_degree")
+                and h.get("max_mem_usage") not in (None, "OOM")
+                and h.get("time", -1) > 0):
+            return True
+    return False
+
+
+_PRUNES = [prune_by_mp, prune_by_pp, prune_by_mbs, prune_by_sharding,
+           prune_by_recompute]
+
+
+# ------------------------------------------------------------ cost models
+def memory_cost(tuner_cfg, cfg):
+    """Modeled HBM bytes per chip (parity: memory_cost_model.py)."""
+    m = tuner_cfg.get("model_cfg", {})
+    L = m.get("num_layers", 32)
+    h = m.get("hidden_size", 4096)
+    inter = m.get("intermediate_size", 4 * h)
+    vocab = m.get("vocab_size", 32000)
+    seq = m.get("seq_length", 2048)
+    mp = cfg.get("mp_degree", 1)
+    pp = cfg.get("pp_degree", 1)
+    sh = max(cfg.get("sharding_degree", 1), 1)
+    mbs = cfg.get("micro_batch_size", 1)
+    params = (L * (4 * h * h + 3 * h * inter) / (mp * pp)
+              + vocab * h / mp)
+    # bf16 params + fp32 grads-and-adam-states sharded over `sh`
+    state_bytes = params * 2 + params * 12 / sh
+    act = mbs * seq * h * (L / pp) * (4 if cfg.get("use_recompute") else 24)
+    return state_bytes + act * 2
+
+
+def time_cost(tuner_cfg, cfg):
+    """Modeled step time (relative units; parity: cost_model.py)."""
+    m = tuner_cfg.get("model_cfg", {})
+    L = m.get("num_layers", 32)
+    h = m.get("hidden_size", 4096)
+    vocab = m.get("vocab_size", 32000)
+    seq = m.get("seq_length", 2048)
+    gbs = int(tuner_cfg.get("global_batch_size", 32))
+    world = int(tuner_cfg.get("num_gpus", tuner_cfg.get("num_chips", 8)))
+    flops = 6.0 * (12 * L * h * h + vocab * h) * gbs * seq
+    if cfg.get("use_recompute"):
+        flops *= 4.0 / 3.0
+    # parallelism efficiency: mp pays ICI collectives, pp pays bubble
+    mp = cfg.get("mp_degree", 1)
+    pp = cfg.get("pp_degree", 1)
+    mbs = cfg.get("micro_batch_size", 1)
+    dp = cfg.get("dp_degree", 1)
+    n_micro = max(gbs // (dp * mbs), 1)
+    eff = (1.0 - 0.05 * (mp > 1) - 0.02 * max(mp - 2, 0) / 2)
+    eff *= n_micro / (n_micro + pp - 1)          # pipeline bubble
+    return flops / (world * max(eff, 1e-3))
+
+
+class AutoTuner:
+    """Parity: tuner.py:21 AutoTuner. Usage:
+
+        tuner = AutoTuner(cfg)
+        while True:
+            trial = tuner.search_once()
+            if trial is None: break
+            metrics = run_trial(trial)        # user-side launch
+            trial.update(metrics)             # {'time': ..., 'max_mem_usage'}
+            tuner.add_cfg(trial)
+        best = tuner.best_cfg()
+    """
+
+    def __init__(self, tuner_cfg: Dict):
+        self.tuner_cfg = dict(tuner_cfg)
+        self.history_cfgs: List[Dict] = []
+        cands = tuner_cfg.get("candidates") or default_candidates(tuner_cfg)
+        mem_limit = tuner_cfg.get("max_mem_per_chip_gb")
+        pruned = []
+        for c in cands:
+            if any(p(self.tuner_cfg, c, self.history_cfgs) for p in _PRUNES):
+                continue
+            c = dict(c)
+            c["modeled_time"] = time_cost(self.tuner_cfg, c)
+            c["modeled_mem"] = memory_cost(self.tuner_cfg, c)
+            if mem_limit and c["modeled_mem"] > mem_limit * (1 << 30):
+                continue
+            pruned.append(c)
+        # best-modeled-first search order
+        self.candidates = sorted(pruned, key=lambda c: c["modeled_time"])
+        self.cur_task_id = 0
+
+    def search_once(self) -> Optional[Dict]:
+        while self.cur_task_id < len(self.candidates):
+            cfg = self.candidates[self.cur_task_id]
+            self.cur_task_id += 1
+            if any(p(self.tuner_cfg, cfg, self.history_cfgs)
+                   for p in _PRUNES):
+                continue
+            return dict(cfg)
+        return None
+
+    def add_cfg(self, cfg: Dict):
+        self.history_cfgs.append(dict(cfg))
+
+    def best_cfg(self) -> Optional[Dict]:
+        done = [c for c in self.history_cfgs
+                if c.get("time", -1) > 0 and c.get("max_mem_usage") != "OOM"]
+        return min(done, key=lambda c: c["time"]) if done else None
+
+    # ---- history persistence (parity: resume_form_history, tuner.py:75)
+    def save_history(self, path="./history.csv"):
+        if not self.history_cfgs:
+            return
+        keys = sorted({k for c in self.history_cfgs for k in c})
+        with open(path, "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=keys)
+            w.writeheader()
+            for c in self.history_cfgs:
+                w.writerow(c)
+
+    def resume_form_history(self, history_csv_path="./history.csv"):
+        if not os.path.exists(history_csv_path):
+            return False
+        with open(history_csv_path) as f:
+            for row in csv.DictReader(f):
+                parsed = {}
+                for k, v in row.items():
+                    try:
+                        parsed[k] = int(v)
+                    except (TypeError, ValueError):
+                        try:
+                            parsed[k] = float(v)
+                        except (TypeError, ValueError):
+                            parsed[k] = v
+                self.history_cfgs.append(parsed)
+        return True
+
+    resume_from_history = resume_form_history  # un-typo'd alias

@@ -1,0 +1,190 @@
+"""Elastic training: node heartbeat/watch + relaunch protocol.
+
+Parity: reference `python/paddle/distributed/fleet/elastic/manager.py` —
+ElasticManager (node registration with TTL lease :254, host watch
+callbacks :237,298, scale in/out triggering a rank-map rebuild, the
+ELASTIC_EXIT_CODE relaunch protocol) and LauncherInterface (child
+launch/watch/stop).
+
+TPU-native: the KV is the native TCPStore (the reference uses etcd) —
+each node heartbeats `nodes/<host>` with a timestamp lease; the watcher
+thread scans for dead (lease expired) or new hosts and flags a scale
+event; the supervisor relaunches the training process with
+ELASTIC_EXIT_CODE when membership changed, and the relaunched processes
+re-bootstrap through jax.distributed with the new world size.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, List, Optional
+
+__all__ = ["ELASTIC_EXIT_CODE", "ElasticStatus", "ElasticManager",
+           "LauncherInterface"]
+
+ELASTIC_EXIT_CODE = 101  # parity: manager.py ELASTIC_EXIT_CODE
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class LauncherInterface:
+    """Child-process supervision (parity: manager.py LauncherInterface)."""
+
+    def __init__(self, args: List[str], env=None):
+        self.args = list(args)
+        self.env = dict(env or os.environ)
+        self.proc: Optional[subprocess.Popen] = None
+
+    def launch(self):
+        self.proc = subprocess.Popen(self.args, env=self.env)
+        return self.proc
+
+    def watch(self):
+        """Non-blocking poll: None while running, else the exit code."""
+        return self.proc.poll() if self.proc else ELASTIC_EXIT_CODE
+
+    def stop(self, timeout=10):
+        if self.proc is None or self.proc.poll() is not None:
+            return
+        self.proc.send_signal(signal.SIGTERM)
+        try:
+            self.proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+
+
+class ElasticManager:
+    """Membership tracking over the TCPStore with TTL-lease heartbeats.
+
+    np spec "min:max" (or int) bounds the elastic world; `exit_code 101`
+    from the child requests a restart with the current membership.
+    """
+
+    def __init__(self, store=None, host=None, np="1", job_id=None,
+                 lease_ttl=6.0, heartbeat_interval=2.0):
+        from ..env import create_store
+        self.store = store if store is not None else create_store()
+        self.host = host or os.environ.get("POD_IP") \
+            or f"host-{os.environ.get('PADDLE_TRAINER_ID', '0')}"
+        self.job_id = job_id or os.environ.get("PADDLE_ELASTIC_JOB_ID",
+                                               "default")
+        self.min_np, self.max_np = self._parse_np(np)
+        self.lease_ttl = lease_ttl
+        self.heartbeat_interval = heartbeat_interval
+        self.elastic_level = int(os.environ.get(
+            "PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL", "1"))
+        self._stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._need_sync = False
+        self._known_hosts: List[str] = []
+
+    @staticmethod
+    def _parse_np(np_spec):
+        if isinstance(np_spec, int):
+            return np_spec, np_spec
+        if ":" in str(np_spec):
+            lo, hi = str(np_spec).split(":")
+            return int(lo), int(hi)
+        return int(np_spec), int(np_spec)
+
+    # ------------------------------------------------------------ leases
+    def _key(self, host):
+        return f"elastic/{self.job_id}/nodes/{host}"
+
+    def register(self):
+        """Heartbeat this host (parity: manager.py:254 TTL lease)."""
+        self._beat()
+        self._hb_thread = threading.Thread(target=self._hb_loop, daemon=True)
+        self._hb_thread.start()
+
+    def _beat(self):
+        self.store.set(self._key(self.host), repr(time.time()).encode())
+
+    def _hb_loop(self):
+        while not self._stop.wait(self.heartbeat_interval):
+            try:
+                self._beat()
+            except Exception:
+                return
+
+    def deregister(self):
+        self._stop.set()
+        try:
+            self.store.set(self._key(self.host), b"0")
+        except Exception:
+            pass
+
+    def hosts(self, candidates=None):
+        """Live hosts = lease not expired. The store has no native key
+        scan; candidate hosts come from env (PADDLE_TRAINER_ENDPOINTS) or
+        the caller."""
+        cands = candidates
+        if cands is None:
+            eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+            cands = [e for e in eps.split(",") if e] or [self.host]
+        alive = []
+        now = time.time()
+        for h in cands:
+            raw = self.store.get(self._key(h), wait=False)
+            if not raw:
+                continue
+            try:
+                ts = float(raw.decode())
+            except ValueError:
+                continue
+            if now - ts <= self.lease_ttl:
+                alive.append(h)
+        return alive
+
+    # ------------------------------------------------------------- watch
+    def watch_once(self, candidates=None):
+        """One membership scan -> ElasticStatus (parity: watch callbacks,
+        manager.py:237,298)."""
+        alive = self.hosts(candidates)
+        if self._known_hosts and set(alive) != set(self._known_hosts):
+            self._known_hosts = alive
+            if len(alive) < self.min_np:
+                return ElasticStatus.HOLD     # wait for scale-out
+            return ElasticStatus.RESTART      # membership changed: rebuild
+        self._known_hosts = alive
+        if len(alive) < self.min_np:
+            return ElasticStatus.HOLD
+        return ElasticStatus.COMPLETED
+
+    # --------------------------------------------------------- supervise
+    def run(self, launcher: LauncherInterface, candidates=None,
+            poll_interval=0.5, max_restarts=10):
+        """Supervise a training child: relaunch on ELASTIC_EXIT_CODE (the
+        child requests a restart after membership change), propagate other
+        exits. Returns the final exit code."""
+        self.register()
+        restarts = 0
+        try:
+            launcher.launch()
+            while True:
+                rc = launcher.watch()
+                if rc is None:
+                    time.sleep(poll_interval)
+                    continue
+                if rc == ELASTIC_EXIT_CODE and restarts < max_restarts:
+                    restarts += 1
+                    # wait until at least min_np members hold live leases
+                    deadline = time.time() + self.lease_ttl * 4
+                    while (len(self.hosts(candidates)) < self.min_np
+                           and time.time() < deadline):
+                        time.sleep(poll_interval)
+                    launcher.launch()
+                    continue
+                return rc
+        finally:
+            self.deregister()

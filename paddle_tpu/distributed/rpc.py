@@ -1,10 +1,180 @@
-"""paddle.distributed.rpc parity surface (not applicable on TPU SPMD; kept
-as explicit unsupported stubs, see SURVEY.md A.7)."""
-__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown"]
+"""paddle.distributed.rpc — tensor/object RPC between workers.
+
+Parity: reference `python/paddle/distributed/rpc/` over the brpc C++
+layer (`paddle/fluid/distributed/rpc/`): init_rpc / rpc_sync / rpc_async /
+get_worker_info / shutdown.
+
+TPU-native: the transport is the native TCPStore (the same rendezvous KV
+the launcher uses) — each worker runs a serve thread that blocks on its
+sequential mailbox keys, executes the pickled callable, and posts the
+pickled result. Functions are pickled by reference (must be importable on
+the callee), mirroring the reference's serialization contract. Arrays in
+args/results travel as numpy (host) buffers — RPC is a control-plane
+tool; bulk tensor movement belongs to the collectives.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import time
+from typing import Dict, Optional
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "get_worker_info",
+           "get_all_worker_infos", "shutdown", "WorkerInfo"]
+
+_state = {"name": None, "store": None, "serve": None, "stop": None,
+          "world_size": 1}
+_SHUTDOWN = b"__rpc_shutdown__"
+
+
+class WorkerInfo:
+    """Parity: rpc.get_worker_info result (name, rank, ip, port)."""
+
+    def __init__(self, name, rank, ip=None, port=None):
+        self.name = name
+        self.rank = rank
+        self.ip = ip
+        self.port = port
+
+    def __repr__(self):
+        return f"WorkerInfo(name={self.name}, rank={self.rank})"
+
+
+class _Future:
+    def __init__(self):
+        self._event = threading.Event()
+        self._value = None
+        self._exc = None
+
+    def _set(self, value=None, exc=None):
+        self._value, self._exc = value, exc
+        self._event.set()
+
+    def wait(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("rpc result timed out")
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+    def done(self):
+        return self._event.is_set()
+
+
+def _serve_loop(name, store, stop, start_seq):
+    # resume from the served counter: a re-init after shutdown (elastic
+    # restart) must not replay already-executed mailbox entries
+    seq = start_seq
+    while not stop.is_set():
+        key = f"rpc/q/{name}/{seq}"
+        raw = store.get(key, wait=False)
+        if raw is None:
+            time.sleep(0.005)
+            continue
+        seq += 1
+        store.add(f"rpc/served/{name}", 1)
+        if raw == _SHUTDOWN:
+            return
+        try:
+            fn, args, kwargs = pickle.loads(raw)
+            result = fn(*args, **kwargs)
+            payload = pickle.dumps(("ok", result))
+        except BaseException as e:  # marshalled back to the caller
+            try:
+                payload = pickle.dumps(("err", e))
+            except Exception:
+                payload = pickle.dumps(
+                    ("err", RuntimeError(f"unpicklable {type(e).__name__}: "
+                                         f"{e}")))
+        try:
+            store.set(key + "/ret", payload)
+        except Exception:
+            # unpicklable RESULT: report instead of killing the serve thread
+            store.set(key + "/ret", pickle.dumps(
+                ("err", RuntimeError("rpc result was not picklable"))))
 
 
 def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
-    raise NotImplementedError("rpc is out of the TPU north-star path")
+    """Start this worker's serve loop and register its name.
+    Parity: rpc/__init__.py init_rpc."""
+    from .env import create_store
+    if _state["serve"] is not None:
+        return
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None \
+        else rank
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
+        if world_size is None else world_size
+    store = create_store(master_endpoint, rank=rank)
+    store.set(f"rpc/worker/{name}", pickle.dumps(WorkerInfo(name, rank)))
+    store.add("rpc/nworkers", 1)
+    stop = threading.Event()
+    start_seq = store.add(f"rpc/served/{name}", 0)
+    t = threading.Thread(target=_serve_loop,
+                         args=(name, store, stop, start_seq),
+                         daemon=True)
+    t.start()
+    _state.update(name=name, store=store, serve=t, stop=stop,
+                  world_size=world_size)
 
 
-rpc_sync = rpc_async = shutdown = init_rpc
+def get_worker_info(name):
+    raw = _state["store"].get(f"rpc/worker/{name}", wait=True)
+    return pickle.loads(raw)
+
+
+def get_all_worker_infos():
+    # names are announced under rpc/worker/<name>; the store has no scan,
+    # so infos are collected lazily by name — callers usually know names
+    raise NotImplementedError(
+        "enumerate workers by name with get_worker_info(name)")
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=60.0):
+    """Post (fn, args) to `to`'s mailbox; returns a Future.
+    Parity: rpc/__init__.py rpc_async."""
+    store = _state["store"]
+    if store is None:
+        raise RuntimeError("call init_rpc first")
+    seq = store.add(f"rpc/ctr/{to}", 1) - 1
+    key = f"rpc/q/{to}/{seq}"
+    store.set(key, pickle.dumps((fn, tuple(args or ()), dict(kwargs or {}))))
+    fut = _Future()
+
+    def _poll():
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            raw = store.get(key + "/ret", wait=False)
+            if raw is not None:
+                status, value = pickle.loads(raw)
+                if status == "ok":
+                    fut._set(value=value)
+                else:
+                    fut._set(exc=value)
+                return
+            time.sleep(0.005)
+        fut._set(exc=TimeoutError(f"rpc to {to!r} timed out"))
+
+    threading.Thread(target=_poll, daemon=True).start()
+    return fut
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=60.0):
+    """Parity: rpc/__init__.py rpc_sync."""
+    return rpc_async(to, fn, args, kwargs, timeout).wait(timeout + 1.0)
+
+
+def shutdown():
+    """Stop the local serve loop (parity: rpc.shutdown). Posts a shutdown
+    marker into our own mailbox so the serve thread exits cleanly."""
+    name, store, stop = _state["name"], _state["store"], _state["stop"]
+    if store is None:
+        return
+    # the marker (not the stop flag) ends the loop, so the marker is always
+    # CONSUMED and counted — otherwise a re-init would read it first and
+    # exit immediately; stop is only the fallback if the join times out
+    seq = store.add(f"rpc/ctr/{name}", 1) - 1
+    store.set(f"rpc/q/{name}/{seq}", _SHUTDOWN)
+    _state["serve"].join(timeout=2)
+    stop.set()
+    _state.update(name=None, serve=None, stop=None)

@@ -1,0 +1,85 @@
+"""Comm watchdog: hang detection around blocking device/collective waits.
+
+Parity: reference `paddle/phi/core/distributed/comm_task_manager.h` /
+`nccl_comm_task.cc` — an async watchdog that flags NCCL collectives that
+neither complete nor error within a timeout and broadcasts the failure.
+
+TPU-native: collectives are in-graph, so the hang surface is the blocking
+HOST wait (`block_until_ready`, checkpoint barriers, store rendezvous).
+`watch()` wraps such a wait with a timer thread that fires a diagnostic
+callback (default: dump all Python stacks to stderr) when the deadline
+passes — turning a silent multi-host hang into an actionable report.
+"""
+from __future__ import annotations
+
+import faulthandler
+import sys
+import threading
+import time
+from typing import Callable, Optional
+
+__all__ = ["watch", "CommWatchdog", "wait_with_timeout"]
+
+
+class CommWatchdog:
+    """Context manager: run `on_timeout` if the block takes too long.
+
+    >>> with CommWatchdog(timeout=300, desc="allreduce barrier"):
+    ...     loss._data.block_until_ready()
+    """
+
+    def __init__(self, timeout: float = 600.0, desc: str = "",
+                 on_timeout: Optional[Callable] = None, repeat=False):
+        self.timeout = timeout
+        self.desc = desc
+        self.on_timeout = on_timeout or self._default_report
+        self.repeat = repeat
+        self._timer: Optional[threading.Timer] = None
+        self.fired = False
+
+    def _default_report(self):
+        sys.stderr.write(
+            f"[comm watchdog] {self.desc or 'blocking wait'} exceeded "
+            f"{self.timeout:.0f}s — dumping stacks (a peer is likely hung "
+            f"or dead; check membership/leases)\n")
+        try:
+            faulthandler.dump_traceback(file=sys.stderr)
+        except Exception:
+            pass
+
+    def _fire(self):
+        self.fired = True
+        try:
+            self.on_timeout()
+        finally:
+            if self.repeat:
+                self._arm()
+
+    def _arm(self):
+        self._timer = threading.Timer(self.timeout, self._fire)
+        self._timer.daemon = True
+        self._timer.start()
+
+    def __enter__(self):
+        self._arm()
+        return self
+
+    def __exit__(self, *exc):
+        if self._timer is not None:
+            self._timer.cancel()
+        return False
+
+
+def watch(timeout=600.0, desc="", on_timeout=None):
+    return CommWatchdog(timeout=timeout, desc=desc, on_timeout=on_timeout)
+
+
+def wait_with_timeout(array, timeout=600.0, desc="device wait"):
+    """block_until_ready with a watchdog; raises TimeoutError if the wait
+    exceeded the deadline (after firing the diagnostic)."""
+    wd = CommWatchdog(timeout=timeout, desc=desc)
+    with wd:
+        result = array.block_until_ready()
+    if wd.fired:
+        raise TimeoutError(f"{desc} exceeded {timeout}s")
+    return result

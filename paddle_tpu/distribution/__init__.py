@@ -37,13 +37,13 @@ def _key():
 
 
 class Distribution:
+    """Base. Parity: paddle.distribution.Distribution."""
+
     @staticmethod
     def _param(tensor_or_none, raw):
         """Prefer the user's original Tensor (keeps the autograd edge for
         reparameterized sampling) over the unwrapped array."""
         return tensor_or_none if tensor_or_none is not None else raw
-
-    """Base. Parity: paddle.distribution.Distribution."""
 
     def __init__(self, batch_shape=(), event_shape=()):
         self._batch_shape = tuple(batch_shape)

@@ -28,10 +28,6 @@ __all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
            "llm_int8_linear"]
 
 
-def _absmax_scale(w, axis):
-    return jnp.max(jnp.abs(w), axis=axis) / 127.0
-
-
 def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
     """(in, out) weight -> (quantized weight, per-out-channel scale).
 
@@ -39,22 +35,19 @@ def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
     4-bit values packed per int8 byte along the in dim.
     Parity: quantized_linear.py:56."""
     def _f(w):
-        scale = jnp.maximum(_absmax_scale(w, axis=0), 1e-10)   # (out,)
-        q = jnp.clip(jnp.round(w / scale[None, :]), -127, 127)
+        absmax = jnp.maximum(jnp.max(jnp.abs(w), axis=0), 1e-10)   # (out,)
         if algo == "weight_only_int8":
+            scale = absmax / 127.0
+            q = jnp.clip(jnp.round(w / scale[None, :]), -127, 127)
             return q.astype(jnp.int8), scale.astype(jnp.float32)
         if algo == "weight_only_int4":
-            qi = jnp.clip(jnp.round(w / (jnp.maximum(
-                jnp.max(jnp.abs(w), axis=0), 1e-10) / 7.0)[None, :]),
-                -7, 7).astype(jnp.int8)
-            k = qi.shape[0]
-            if k % 2:
+            s4 = (absmax / 7.0).astype(jnp.float32)
+            qi = jnp.clip(jnp.round(w / s4[None, :]), -7, 7).astype(jnp.int8)
+            if qi.shape[0] % 2:
                 raise ValueError("int4 packing needs even in-features")
             lo = qi[0::2] & 0x0F
             hi = (qi[1::2] & 0x0F) << 4
             packed = (lo | hi).astype(jnp.int8)
-            s4 = (jnp.maximum(jnp.max(jnp.abs(w), axis=0), 1e-10) /
-                  7.0).astype(jnp.float32)
             return packed, s4
         raise ValueError(f"unknown algo {algo!r}")
     return apply_op("weight_quantize", _f, x)
@@ -69,12 +62,15 @@ def _unpack_int4(packed):
     return out.at[0::2].set(lo).at[1::2].set(hi)
 
 
-def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype="float16"):
+def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype="float32"):
     """Inverse of weight_quantize. Parity: quantized_linear.py:123."""
+    from ...core.dtype import convert_dtype
+    dt = jnp.dtype(convert_dtype(out_dtype) or "float32")
+
     def _f(q, s):
         if algo == "weight_only_int4":
             q = _unpack_int4(q)
-        return q.astype(jnp.float32) * s[None, :]
+        return (q.astype(jnp.float32) * s[None, :]).astype(dt)
     return apply_op("weight_dequantize", _f, x, scale)
 
 
@@ -96,12 +92,6 @@ def _wint8_kernel(x_ref, w_ref, s_ref, o_ref, acc_ref, *, nk):
     @pl.when(ki == nk - 1)
     def _flush():
         o_ref[...] = (acc_ref[...] * s_ref[0][None, :]).astype(o_ref.dtype)
-
-
-def _pick(n, target):
-    if n <= target or n % target != 0:
-        return n if n % 8 == 0 or n <= 8 else n
-    return target
 
 
 def _wint8_matmul_pallas(x2d, qw, scale):
@@ -158,9 +148,16 @@ _wint8_mm.defvjp(_wint8_mm_fwd, _wint8_mm_bwd)
 
 
 def _wint8_supported(M, K, N):
-    if K % 8 != 0 or N % 128 != 0:
+    """Shapes whose block tiling stays VMEM-sized: every dim either fits
+    one bounded block or divides the target block exactly (a degenerate
+    whole-array block on a large unaligned dim would blow VMEM)."""
+    if K % 8 != 0 or N % 128 != 0 or M % 8 != 0:
         return False
-    if M % 8 != 0:
+    if M > 256 and M % 256 != 0:
+        return False
+    if K > 512 and K % 512 != 0:
+        return False
+    if N > 512 and N % 512 != 0:
         return False
     return True
 

@@ -190,6 +190,18 @@ class QuantConfig:
             self._layer_configs.append(
                 (l, SingleLayerConfig(activation, weight)))
 
+    def _remap_layers(self, old_root, new_root):
+        """Layer configs are identity-keyed; quantize() deepcopies the
+        model, so retarget each config onto the structurally corresponding
+        layer of the copy."""
+        mapping = {}
+        for (_n1, old), (_n2, new) in zip(
+                old_root.named_sublayers(include_self=True),
+                new_root.named_sublayers(include_self=True)):
+            mapping[id(old)] = new
+        self._layer_configs = [(mapping.get(id(l), l), cfg)
+                               for l, cfg in self._layer_configs]
+
     def add_type_config(self, layer_type, activation=None, weight=None):
         types = layer_type if isinstance(layer_type, (list, tuple)) \
             else [layer_type]
@@ -209,7 +221,8 @@ class QuantConfig:
             if l is layer:
                 return cfg
         for n, cfg in self._name_configs:
-            if n == name:
+            # `name` is the fully qualified path from the model root
+            if n == name or name.endswith("." + n):
                 return cfg
         for t, cfg in self._type_configs:
             if isinstance(layer, t):
@@ -250,15 +263,28 @@ class ObserveWrapper(Layer):
 
 class QuantedLinear(Layer):
     """Converted inference layer: int8 weight + per-channel scale through
-    nn.quant.weight_only_linear (the Pallas dequant-matmul path)."""
+    nn.quant.weight_only_linear (the Pallas dequant-matmul path).
 
-    def __init__(self, linear, weight_scales=None):
+    weight_scales: calibrated per-channel scales from the weight observer
+    (falls back to fresh absmax — identical for absmax observers, distinct
+    for moving-average/custom ones). act_scale is carried for serving-side
+    activation quantization."""
+
+    def __init__(self, linear, weight_scales=None, act_scale=None):
         super().__init__()
+        import jax.numpy as jnp
         from ..nn import quant as Q
         w = linear.weight
-        qw, scale = Q.weight_quantize(w, algo="weight_only_int8")
-        self.qweight = qw
-        self.weight_scale = scale
+        if weight_scales is not None:
+            s = jnp.maximum(jnp.asarray(weight_scales, jnp.float32), 1e-10)
+            q = jnp.clip(jnp.round(w._data / s[None, :]), -127, 127)
+            self.qweight = Tensor(q.astype(jnp.int8))
+            self.weight_scale = Tensor(s)
+        else:
+            qw, scale = Q.weight_quantize(w, algo="weight_only_int8")
+            self.qweight = qw
+            self.weight_scale = scale
+        self.act_scale = act_scale
         self.bias = getattr(linear, "bias", None)
 
     def forward(self, x):
@@ -273,13 +299,14 @@ class Quantization:
     def __init__(self, config: QuantConfig):
         self._config = config
 
-    def _wrap(self, model):
+    def _wrap(self, model, prefix=""):
         for name, child in list(model._sub_layers.items()):
-            cfg = self._config._config_for(name, child)
+            qualified = f"{prefix}.{name}" if prefix else name
+            cfg = self._config._config_for(qualified, child)
             if cfg is not None:
                 model._sub_layers[name] = self._make_wrapper(child, cfg)
             else:
-                self._wrap(child)
+                self._wrap(child, qualified)
         return model
 
     def convert(self, model, inplace=False, remain_weight=False):
@@ -295,7 +322,11 @@ class Quantization:
             target = getattr(child, "_observed", None)
             if isinstance(child, ObserveWrapper) and \
                     isinstance(target, _linear_types()):
-                model._sub_layers[name] = QuantedLinear(target)
+                wob = child._weight_ob
+                ws = wob.scales() if wob is not None else None
+                act = child._act.scales() if child._act is not None else None
+                model._sub_layers[name] = QuantedLinear(
+                    target, weight_scales=ws, act_scale=act)
             elif isinstance(child, ObserveWrapper):
                 model._sub_layers[name] = target
             else:
@@ -308,7 +339,9 @@ class PTQ(Quantization):
 
     def quantize(self, model, inplace=False):
         if not inplace:
-            model = copy.deepcopy(model)
+            new = copy.deepcopy(model)
+            self._config._remap_layers(model, new)
+            model = new
         return self._wrap(model)
 
     def _make_wrapper(self, layer, cfg):
@@ -349,7 +382,9 @@ class QAT(Quantization):
 
     def quantize(self, model, inplace=False):
         if not inplace:
-            model = copy.deepcopy(model)
+            new = copy.deepcopy(model)
+            self._config._remap_layers(model, new)
+            model = new
         return self._wrap(model)
 
     def _make_wrapper(self, layer, cfg):

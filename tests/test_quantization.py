@@ -155,3 +155,32 @@ def test_quant_config_precedence():
     assert got.activation is special
     got2 = cfg._config_for("x", lin2)
     assert got2.activation is not special  # falls to global default
+
+
+def test_layer_config_survives_deepcopy():
+    """add_layer_config targets must match after quantize()'s deepcopy
+    (code-review r2): configs are remapped onto the copied layers."""
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 8), paddle.nn.Linear(8, 8))
+    cfg = QuantConfig(activation=None, weight=None)
+    cfg.add_layer_config(net[0],
+                         activation=QuanterFactory(AbsmaxObserver),
+                         weight=QuanterFactory(
+                             AbsMaxChannelWiseWeightObserver))
+    ptq = PTQ(cfg)
+    qm = ptq.quantize(net)  # deepcopy path
+    assert type(qm[0]).__name__ == "ObserveWrapper"
+    assert type(qm[1]).__name__ == "Linear"  # no global default -> untouched
+
+
+def test_ptq_uses_observer_scales():
+    """convert() feeds the weight observer's calibrated scales into the
+    quantized layer instead of re-deriving fresh absmax."""
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 8))
+    ptq = PTQ(_default_config())
+    qm = ptq.quantize(net)
+    x = paddle.randn([4, 8])
+    qm(x)
+    wob = qm[0]._weight_ob
+    conv = ptq.convert(qm)
+    np.testing.assert_allclose(np.asarray(conv[0].weight_scale._data),
+                               wob.scales(), rtol=1e-6)
