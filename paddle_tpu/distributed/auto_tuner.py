@@ -221,6 +221,9 @@ class AutoTuner:
             for row in csv.DictReader(f):
                 parsed = {}
                 for k, v in row.items():
+                    if v in ("True", "False"):   # bools round-trip as text
+                        parsed[k] = v == "True"
+                        continue
                     try:
                         parsed[k] = int(v)
                     except (TypeError, ValueError):

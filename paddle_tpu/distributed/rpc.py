@@ -25,7 +25,7 @@ __all__ = ["init_rpc", "rpc_sync", "rpc_async", "get_worker_info",
 
 _state = {"name": None, "store": None, "serve": None, "stop": None,
           "world_size": 1}
-_SHUTDOWN = b"__rpc_shutdown__"
+
 
 
 class WorkerInfo:
@@ -62,11 +62,24 @@ class _Future:
         return self._event.is_set()
 
 
-def _serve_loop(name, store, stop, start_seq):
-    # resume from the served counter: a re-init after shutdown (elastic
-    # restart) must not replay already-executed mailbox entries
+def _gen_stopped(store, name, gen):
+    raw = store.get(f"rpc/stopgen/{name}", wait=False)
+    try:
+        return raw is not None and int(raw.decode()) >= gen
+    except ValueError:
+        return False
+
+
+def _serve_loop(name, store, stop, start_seq, gen):
+    # Resume from the served counter: a re-init after shutdown (elastic
+    # restart) must not replay already-executed mailbox entries. Shutdown
+    # is an out-of-band generation key, NOT an in-band marker — a marker
+    # left unconsumed by a busy dying loop would instantly kill the next
+    # generation's serve loop.
     seq = start_seq
     while not stop.is_set():
+        if _gen_stopped(store, name, gen):
+            return
         key = f"rpc/q/{name}/{seq}"
         raw = store.get(key, wait=False)
         if raw is None:
@@ -74,8 +87,6 @@ def _serve_loop(name, store, stop, start_seq):
             continue
         seq += 1
         store.add(f"rpc/served/{name}", 1)
-        if raw == _SHUTDOWN:
-            return
         try:
             fn, args, kwargs = pickle.loads(raw)
             result = fn(*args, **kwargs)
@@ -110,12 +121,13 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     store.add("rpc/nworkers", 1)
     stop = threading.Event()
     start_seq = store.add(f"rpc/served/{name}", 0)
+    gen = store.add(f"rpc/gen/{name}", 1)
     t = threading.Thread(target=_serve_loop,
-                         args=(name, store, stop, start_seq),
+                         args=(name, store, stop, start_seq, gen),
                          daemon=True)
     t.start()
     _state.update(name=name, store=store, serve=t, stop=stop,
-                  world_size=world_size)
+                  world_size=world_size, gen=gen)
 
 
 def get_worker_info(name):
@@ -165,16 +177,13 @@ def rpc_sync(to, fn, args=None, kwargs=None, timeout=60.0):
 
 
 def shutdown():
-    """Stop the local serve loop (parity: rpc.shutdown). Posts a shutdown
-    marker into our own mailbox so the serve thread exits cleanly."""
+    """Stop the local serve loop (parity: rpc.shutdown) via the
+    out-of-band generation key; a later init_rpc bumps the generation and
+    serves on, unaffected by prior shutdowns."""
     name, store, stop = _state["name"], _state["store"], _state["stop"]
     if store is None:
         return
-    # the marker (not the stop flag) ends the loop, so the marker is always
-    # CONSUMED and counted — otherwise a re-init would read it first and
-    # exit immediately; stop is only the fallback if the join times out
-    seq = store.add(f"rpc/ctr/{name}", 1) - 1
-    store.set(f"rpc/q/{name}/{seq}", _SHUTDOWN)
-    _state["serve"].join(timeout=2)
-    stop.set()
+    store.set(f"rpc/stopgen/{name}", str(_state["gen"]).encode())
+    _state["serve"].join(timeout=5)
+    stop.set()  # fallback if the loop is stuck inside a long RPC
     _state.update(name=None, serve=None, stop=None)

@@ -35,6 +35,8 @@ class CommWatchdog:
         self.on_timeout = on_timeout or self._default_report
         self.repeat = repeat
         self._timer: Optional[threading.Timer] = None
+        self._lock = threading.Lock()
+        self._closed = False
         self.fired = False
 
     def _default_report(self):
@@ -56,17 +58,24 @@ class CommWatchdog:
                 self._arm()
 
     def _arm(self):
-        self._timer = threading.Timer(self.timeout, self._fire)
-        self._timer.daemon = True
-        self._timer.start()
+        # never re-arm after __exit__ (a firing callback racing the exit
+        # would otherwise leak a recurring timer)
+        with self._lock:
+            if self._closed:
+                return
+            self._timer = threading.Timer(self.timeout, self._fire)
+            self._timer.daemon = True
+            self._timer.start()
 
     def __enter__(self):
         self._arm()
         return self
 
     def __exit__(self, *exc):
-        if self._timer is not None:
-            self._timer.cancel()
+        with self._lock:
+            self._closed = True
+            if self._timer is not None:
+                self._timer.cancel()
         return False
 
 

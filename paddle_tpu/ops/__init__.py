@@ -9,8 +9,10 @@ from .random import *  # noqa: F401,F403
 from .dispatch import apply_op, def_op  # noqa: F401
 
 from . import creation, math, manipulation, linalg, logic, search, random  # noqa: F401
+from . import extras  # noqa: F401
+from .extras import *  # noqa: F401,F403
 
 __all__ = (
     creation.__all__ + math.__all__ + manipulation.__all__ + linalg.__all__
-    + logic.__all__ + search.__all__ + random.__all__
+    + logic.__all__ + search.__all__ + random.__all__ + extras.__all__
 )

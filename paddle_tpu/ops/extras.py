@@ -1,0 +1,357 @@
+"""Op-coverage tail: the remaining reference tensor-API functions.
+
+Parity: assorted functions from `python/paddle/tensor/{math,manipulation,
+linalg,search,stat,attribute,random,creation}.py` not covered by the core
+op modules, plus the full in-place (`op_`) variant table (generated in
+methods.py against these and the existing ops)."""
+from __future__ import annotations
+
+import math as _math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dispatch import apply_op
+
+__all__ = [
+    "add_n", "cartesian_prod", "diagonal", "inverse", "isin", "isneginf",
+    "isposinf", "multiplex", "gammainc", "gammaincc",
+    "block_diag", "diagonal_scatter", "fill_diagonal_",
+    "fill_diagonal_tensor", "index_fill", "masked_scatter", "shard_index",
+    "slice_scatter", "tensor_split", "as_strided",
+    "cholesky_inverse", "histogram_bin_edges", "matrix_exp", "svd_lowrank",
+    "pca_lowrank",
+    "top_p_sampling", "quantile", "nanquantile", "numel",
+    "is_complex", "is_floating_point", "is_integer", "rank",
+    "gaussian", "fill_constant", "sigmoid", "reduce_as", "create_tensor",
+    "create_global_var",
+]
+
+
+# ------------------------------------------------------------------- math
+def add_n(inputs, name=None):
+    """Sum a list of tensors. Parity: math.add_n."""
+    if isinstance(inputs, Tensor):
+        return inputs
+    return apply_op("add_n", lambda xs: sum(xs[1:], xs[0]), list(inputs))
+
+
+def cartesian_prod(x, name=None):
+    """Cartesian product of 1-D tensors. Parity: math.cartesian_prod."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+
+    def _f(arrs):
+        grids = jnp.meshgrid(*arrs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+    return apply_op("cartesian_prod", _f, list(xs))
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op("diagonal",
+                    lambda a: jnp.diagonal(a, offset, axis1, axis2), x)
+
+
+def inverse(x, name=None):
+    return apply_op("inverse", jnp.linalg.inv, x)
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return apply_op("isin",
+                    lambda a, t: jnp.isin(a, t, invert=invert), x, test_x)
+
+
+def isneginf(x, name=None):
+    return apply_op("isneginf", jnp.isneginf, x)
+
+
+def isposinf(x, name=None):
+    return apply_op("isposinf", jnp.isposinf, x)
+
+
+def multiplex(inputs, index, name=None):
+    """Row-wise select between candidate tensors. Parity: math.multiplex."""
+    def _f(xs, idx):
+        stacked = jnp.stack(xs, axis=0)            # (n, B, ...)
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[idx.reshape(-1), rows]
+    return apply_op("multiplex", _f, list(inputs), index)
+
+
+def gammainc(x, y, name=None):
+    from jax.scipy.special import gammainc as gi
+    return apply_op("gammainc", gi, x, y)
+
+
+def gammaincc(x, y, name=None):
+    from jax.scipy.special import gammaincc as gic
+    return apply_op("gammaincc", gic, x, y)
+
+
+# ----------------------------------------------------------- manipulation
+def block_diag(inputs, name=None):
+    def _f(xs):
+        return jax.scipy.linalg.block_diag(*xs)
+    return apply_op("block_diag", _f, list(inputs))
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    def _f(a, b):
+        n = min(a.shape[axis1], a.shape[axis2])
+        i = jnp.arange(b.shape[-1] if b.ndim else n)
+        sel = [slice(None)] * a.ndim
+        sel[axis1] = i - min(offset, 0)
+        sel[axis2] = i + max(offset, 0)
+        return a.at[tuple(sel)].set(b)
+    return apply_op("diagonal_scatter", _f, x, y)
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    def _f(a):
+        n = min(a.shape[-2], a.shape[-1])
+        i = jnp.arange(n - abs(offset))
+        rows = i - min(offset, 0)
+        cols = i + max(offset, 0)
+        return a.at[..., rows, cols].set(value)
+    out = apply_op("fill_diagonal_", _f, x)
+    x._data = out._data
+    x._grad_node = out._grad_node
+    x._grad_out_idx = out._grad_out_idx
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    def _f(a, b):
+        n = min(a.shape[dim1], a.shape[dim2]) - abs(offset)
+        i = jnp.arange(n)
+        rows = i - min(offset, 0)
+        cols = i + max(offset, 0)
+        sel = [slice(None)] * a.ndim
+        sel[dim1] = rows
+        sel[dim2] = cols
+        return a.at[tuple(sel)].set(b)
+    return apply_op("fill_diagonal_tensor", _f, x, y)
+
+
+def index_fill(x, index, axis, value, name=None):
+    def _f(a, idx):
+        sel = [slice(None)] * a.ndim
+        sel[axis] = idx
+        return a.at[tuple(sel)].set(value)
+    return apply_op("index_fill", _f, x, index)
+
+
+def masked_scatter(x, mask, value, name=None):
+    """Fill masked positions with consecutive values (parity:
+    manipulation.masked_scatter)."""
+    def _f(a, m, v):
+        m = jnp.broadcast_to(m, a.shape)
+        flatv = v.reshape(-1)
+        # k-th True gets flatv[k]
+        order = jnp.cumsum(m.reshape(-1)) - 1
+        take = jnp.clip(order, 0, flatv.shape[0] - 1)
+        filled = jnp.where(m.reshape(-1), flatv[take], a.reshape(-1))
+        return filled.reshape(a.shape)
+    return apply_op("masked_scatter", _f, x, mask, value)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1,
+                name=None):
+    """Parity: manipulation.shard_index (vocab-shard relabeling)."""
+    def _f(a):
+        per = (index_num + nshards - 1) // nshards
+        lo = shard_id * per
+        inside = (a >= lo) & (a < lo + per)
+        return jnp.where(inside, a - lo, ignore_value)
+    return apply_op("shard_index", _f, input)
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    def _f(a, v):
+        sel = [slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            sel[ax] = slice(s, e, st)
+        return a.at[tuple(sel)].set(v)
+    return apply_op("slice_scatter", _f, x, value)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    def _f(a):
+        return tuple(jnp.array_split(a, num_or_indices, axis=axis))
+    return list(apply_op("tensor_split", _f, x))
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """View with explicit strides (materialized via gather — XLA has no
+    aliasing views). Parity: manipulation.as_strided."""
+    def _f(a):
+        flat = a.reshape(-1)
+        grids = jnp.meshgrid(*[jnp.arange(s) for s in shape], indexing="ij")
+        lin = sum((g * st for g, st in zip(grids, stride)),
+                  jnp.zeros((), jnp.int32)) + offset
+        return flat[lin.astype(jnp.int32)]
+    return apply_op("as_strided", _f, x)
+
+
+# ----------------------------------------------------------------- linalg
+def cholesky_inverse(x, upper=False, name=None):
+    def _f(a):
+        ident = jnp.eye(a.shape[-1], dtype=a.dtype)
+        inv_factor = jax.scipy.linalg.solve_triangular(a, ident, lower=not upper)
+        return inv_factor.T @ inv_factor if not upper else \
+            inv_factor @ inv_factor.T
+    return apply_op("cholesky_inverse", _f, x)
+
+
+def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
+    def _f(a):
+        lo, hi = (jnp.min(a), jnp.max(a)) if min == 0 and max == 0 \
+            else (min, max)
+        return jnp.linspace(lo, hi, bins + 1)
+    return apply_op("histogram_bin_edges", _f, input)
+
+
+def matrix_exp(x, name=None):
+    return apply_op("matrix_exp", jax.scipy.linalg.expm, x)
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD of (x - M) (parity: linalg.svd_lowrank)."""
+    from ..framework.random import rng_key
+    key = rng_key()
+
+    def _f(a, *rest):
+        if rest:
+            a = a - rest[0]
+        m, n = a.shape[-2:]
+        r = min(q, m, n)
+        omega = jax.random.normal(key, a.shape[:-2] + (n, r), a.dtype)
+        y = a @ omega
+        for _ in range(niter):
+            y = a @ (jnp.swapaxes(a, -1, -2) @ y)
+        Q, _ = jnp.linalg.qr(y)
+        b = jnp.swapaxes(Q, -1, -2) @ a
+        u, s, vh = jnp.linalg.svd(b, full_matrices=False)
+        return Q @ u, s, jnp.swapaxes(vh, -1, -2)
+    if M is not None:
+        return apply_op("svd_lowrank", _f, x, M)
+    return apply_op("svd_lowrank", _f, x)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    def _f(a):
+        k = q if q is not None else min(6, *a.shape[-2:])
+        if center:
+            a = a - jnp.mean(a, axis=-2, keepdims=True)
+        u, s, vh = jnp.linalg.svd(a, full_matrices=False)
+        return u[..., :k], s[..., :k], jnp.swapaxes(vh, -1, -2)[..., :k]
+    return apply_op("pca_lowrank", _f, x)
+
+
+# ----------------------------------------------------------------- search
+def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
+    """Nucleus sampling over the last axis (parity: phi top_p_sampling).
+    Returns (sampled values, sampled ids)."""
+    from ..framework.random import rng_key
+    key = rng_key() if seed is None else jax.random.key(seed)
+
+    def _f(logits, p):
+        probs = jax.nn.softmax(logits, axis=-1)
+        sort_idx = jnp.argsort(-probs, axis=-1)
+        sorted_probs = jnp.take_along_axis(probs, sort_idx, axis=-1)
+        cum = jnp.cumsum(sorted_probs, axis=-1)
+        keep = cum - sorted_probs <= p[..., None]
+        filt = jnp.where(keep, sorted_probs, 0.0)
+        filt = filt / jnp.maximum(filt.sum(-1, keepdims=True), 1e-9)
+        choice = jax.random.categorical(key, jnp.log(jnp.maximum(filt, 1e-30)))
+        ids = jnp.take_along_axis(sort_idx, choice[..., None], axis=-1)
+        vals = jnp.take_along_axis(probs, ids, axis=-1)
+        return vals, ids
+    return apply_op("top_p_sampling", _f, x, ps)
+
+
+# ------------------------------------------------------------------- stat
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear",
+             name=None):
+    return apply_op(
+        "quantile",
+        lambda a: jnp.quantile(a, jnp.asarray(q), axis=axis,
+                               keepdims=keepdim, method=interpolation), x)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear",
+                name=None):
+    return apply_op(
+        "nanquantile",
+        lambda a: jnp.nanquantile(a, jnp.asarray(q), axis=axis,
+                                  keepdims=keepdim, method=interpolation), x)
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(int(np.prod(x.shape)) if x.shape else 1))
+
+
+# -------------------------------------------------------------- attribute
+def is_complex(x):
+    return jnp.issubdtype(x._data.dtype, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(x._data.dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(x._data.dtype, jnp.integer)
+
+
+def rank(input):
+    return Tensor(jnp.asarray(input._data.ndim))
+
+
+# ----------------------------------------------------- random / creation
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype="float32", name=None):
+    from ..framework.random import rng_key
+    from ..core.dtype import convert_dtype
+    key = rng_key() if seed == 0 else jax.random.key(seed)
+    dt = jnp.dtype(convert_dtype(dtype) or "float32")
+    return Tensor(mean + std * jax.random.normal(key, tuple(shape), dt))
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
+    from ..core.dtype import convert_dtype
+    dt = jnp.dtype(convert_dtype(dtype) or "float32")
+    t = Tensor(jnp.full(tuple(int(s) for s in shape), value, dt))
+    if out is not None:
+        out._data = t._data
+        return out
+    return t
+
+
+def sigmoid(x, name=None):
+    return apply_op("sigmoid", jax.nn.sigmoid, x)
+
+
+def reduce_as(x, target, name=None):
+    """Sum x down to target's shape (parity: math.reduce_as)."""
+    def _f(a, t):
+        extra = a.ndim - t.ndim
+        axes = tuple(range(extra)) + tuple(
+            i + extra for i, (sa, st) in enumerate(
+                zip(a.shape[extra:], t.shape)) if st == 1 and sa != 1)
+        out = jnp.sum(a, axis=axes, keepdims=False)
+        return out.reshape(t.shape)
+    return apply_op("reduce_as", _f, x, target)
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    """Parity: creation.create_tensor (static-graph var shell)."""
+    from ..core.dtype import convert_dtype
+    return Tensor(jnp.zeros((), jnp.dtype(convert_dtype(dtype) or "float32")))
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """Parity: creation.create_global_var."""
+    return fill_constant(shape, dtype, value)

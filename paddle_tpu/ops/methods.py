@@ -11,7 +11,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
-from . import creation, linalg, logic, manipulation, math, search
+from . import creation, extras, linalg, logic, manipulation, math, search
 from .dispatch import apply_op
 
 
@@ -103,8 +103,12 @@ def _inplace(op):
 
 
 # Named methods lifted straight from the functional modules.
-_METHOD_SOURCES = [math, manipulation, linalg, logic, search]
-_SKIP = {"where"}  # `Tensor.where(cond...)` has different arg order; added below
+_METHOD_SOURCES = [math, manipulation, linalg, logic, search, extras]
+_SKIP = {"where",
+         # extras whose first arg is not a tensor (creation/list-first):
+         # attaching them as methods would misbind `self`
+         "gaussian", "fill_constant", "create_tensor", "create_global_var",
+         "block_diag", "cartesian_prod", "add_n", "multiplex"}
 
 
 def patch_tensor_methods():
@@ -138,6 +142,63 @@ def patch_tensor_methods():
     Tensor.where = lambda self, x, y=None: manipulation.where(self, x, y) \
         if jnp.issubdtype(self.dtype, jnp.bool_) else manipulation.where(self > 0, x, y)
     Tensor.tril_ = _inplace_unary(creation.tril)
+
+    # ---- generated in-place (`op_`) variants (reference tensor API tail):
+    # every base op gains an op_ that rebinds the tensor through the tape
+    # (the reference's inplace kernels; here a rebind after the pure op)
+    unary_inplace = [
+        "abs", "acos", "asin", "atan", "ceil", "cos", "cosh", "digamma",
+        "erf", "erfinv", "exp", "expm1", "floor", "frac", "lgamma", "log",
+        "log10", "log1p", "log2", "logit", "neg", "reciprocal", "round",
+        "rsqrt", "sigmoid", "sin", "sinh", "sqrt", "square", "tan", "tanh",
+        "trunc", "i0", "gammaln", "nan_to_num", "cast", "cumsum", "cumprod",
+        "polygamma", "multigammaln", "uniform", "normal", "bernoulli",
+        "bitwise_not", "logical_not", "sinc", "renorm", "t", "transpose",
+        "index_add", "index_fill", "index_put", "masked_fill",
+        "masked_scatter", "put_along_axis", "fill_diagonal_tensor", "addmm",
+    ]
+    binary_inplace = [
+        "divide", "floor_divide", "remainder", "pow", "copysign", "hypot",
+        "gcd", "lcm", "ldexp", "lerp", "bitwise_and", "bitwise_or",
+        "bitwise_xor", "bitwise_left_shift", "bitwise_right_shift",
+        "logical_and", "logical_or", "logical_xor", "equal", "not_equal",
+        "greater_equal", "greater_than", "less_equal", "less_than",
+        "maximum", "minimum", "fmax", "fmin", "gammainc", "gammaincc",
+    ]
+    for base in unary_inplace:
+        fn = getattr(Tensor, base, None)
+        if fn is not None and not hasattr(Tensor, base + "_"):
+            setattr(Tensor, base + "_", _inplace_unary(fn))
+    for base in binary_inplace:
+        fn = getattr(Tensor, base, None)
+        if fn is not None and not hasattr(Tensor, base + "_"):
+            setattr(Tensor, base + "_", _inplace(fn))
+
+    def _where_(self, x, y=None):
+        return _rebind(self, Tensor.where(_alias(self), x, y))
+
+    def _gaussian_(self, mean=0.0, std=1.0):
+        from .extras import gaussian
+        return _rebind(self, gaussian(self.shape, mean, std,
+                                      dtype=str(self.dtype)))
+
+    def _log_normal_(self, mean=1.0, std=2.0):
+        from .extras import gaussian
+        g = gaussian(self.shape, mean, std, dtype=str(self.dtype))
+        return _rebind(self, apply_op("exp", jnp.exp, g))
+
+    def _bernoulli_(self, p=0.5):
+        from ..framework.random import rng_key
+        key = rng_key()
+        return _rebind(self, apply_op(
+            "bernoulli_",
+            lambda a: jax.random.bernoulli(key, p, a.shape).astype(a.dtype),
+            _alias(self)))
+
+    Tensor.where_ = _where_
+    Tensor.gaussian_ = _gaussian_
+    Tensor.log_normal_ = _log_normal_
+    Tensor.bernoulli_ = _bernoulli_
     Tensor.triu_ = _inplace_unary(creation.triu)
     Tensor.zero_ = Tensor.zero_
     Tensor.unsqueeze_ = manipulation.unsqueeze_

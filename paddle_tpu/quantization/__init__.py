@@ -277,6 +277,8 @@ class QuantedLinear(Layer):
         w = linear.weight
         if weight_scales is not None:
             s = jnp.maximum(jnp.asarray(weight_scales, jnp.float32), 1e-10)
+            if s.ndim == 0:        # per-tensor observer -> broadcast
+                s = jnp.full((w.shape[-1],), s)
             q = jnp.clip(jnp.round(w._data / s[None, :]), -127, 127)
             self.qweight = Tensor(q.astype(jnp.int8))
             self.weight_scale = Tensor(s)
@@ -322,9 +324,16 @@ class Quantization:
             target = getattr(child, "_observed", None)
             if isinstance(child, ObserveWrapper) and \
                     isinstance(target, _linear_types()):
-                wob = child._weight_ob
-                ws = wob.scales() if wob is not None else None
-                act = child._act.scales() if child._act is not None else None
+                try:  # uncalibrated observers fall back to fresh absmax
+                    ws = child._weight_ob.scales() \
+                        if child._weight_ob is not None else None
+                except Exception:
+                    ws = None
+                try:
+                    act = child._act.scales() if child._act is not None \
+                        else None
+                except Exception:
+                    act = None
                 model._sub_layers[name] = QuantedLinear(
                     target, weight_scales=ws, act_scale=act)
             elif isinstance(child, ObserveWrapper):

@@ -169,3 +169,65 @@ def test_inplace_ops():
     np.testing.assert_allclose(x.numpy(), [2.0, 3.0])
     x.scale_(2.0)
     np.testing.assert_allclose(x.numpy(), [4.0, 6.0])
+
+
+# ----------------------------------------------------- op-coverage tail
+def test_extras_ops():
+    rng_ = np.random.RandomState(0)
+    # diagonal / inverse / isin
+    a = paddle.to_tensor(np.arange(9, dtype=np.float32).reshape(3, 3))
+    np.testing.assert_allclose(paddle.diagonal(a).numpy(), [0, 4, 8])
+    m = paddle.to_tensor(rng_.randn(4, 4).astype(np.float32)
+                         + 4 * np.eye(4, dtype=np.float32))
+    np.testing.assert_allclose(
+        (paddle.inverse(m).matmul(m)).numpy(), np.eye(4), atol=1e-5)
+    # add_n / multiplex / cartesian_prod
+    s = paddle.add_n([paddle.ones([2, 2]), paddle.ones([2, 2])])
+    np.testing.assert_allclose(s.numpy(), 2.0)
+    cp = paddle.cartesian_prod([paddle.to_tensor(np.array([1, 2])),
+                                paddle.to_tensor(np.array([3, 4]))])
+    assert list(cp.shape) == [4, 2]
+    # quantile / reduce_as / tensor_split
+    q = paddle.quantile(paddle.to_tensor(np.arange(11, dtype=np.float32)),
+                        0.5)
+    assert float(q) == 5.0
+    x = paddle.ones([2, 3, 4])
+    t = paddle.ones([1, 3, 1])
+    assert list(paddle.reduce_as(x, t).shape) == [1, 3, 1]
+    parts = paddle.tensor_split(paddle.to_tensor(np.arange(10)), 3)
+    assert [len(p) for p in parts] == [4, 3, 3]
+
+
+def test_inplace_variant_table():
+    x = paddle.to_tensor(np.array([1.0, 4.0, 9.0], np.float32))
+    x.sqrt_()
+    np.testing.assert_allclose(x.numpy(), [1, 2, 3])
+    x.add_(paddle.to_tensor(np.ones(3, np.float32)))
+    np.testing.assert_allclose(x.numpy(), [2, 3, 4])
+    x.divide_(paddle.to_tensor(np.full(3, 2.0, np.float32)))
+    np.testing.assert_allclose(x.numpy(), [1, 1.5, 2])
+    x.log_()
+    x.exp_()
+    np.testing.assert_allclose(x.numpy(), [1, 1.5, 2], rtol=1e-6)
+    b = paddle.to_tensor(np.array([1, 2, 3]))
+    b.bitwise_and_(paddle.to_tensor(np.array([1, 3, 1])))
+    np.testing.assert_array_equal(b.numpy(), [1, 2, 1])
+    # in-place on a leaf keeps autograd working through the alias
+    y = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    z = y * 3
+    z.sqrt_()
+    z.backward()
+    np.testing.assert_allclose(np.asarray(y.grad._data),
+                               [3 / (2 * np.sqrt(6.0))], rtol=1e-5)
+
+
+def test_masked_scatter_and_fill_diagonal():
+    x = paddle.zeros([2, 3])
+    mask = paddle.to_tensor(np.array([[True, False, True],
+                                      [False, True, False]]))
+    vals = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    out = paddle.ops.extras.masked_scatter(x, mask, vals)
+    np.testing.assert_allclose(out.numpy(), [[1, 0, 2], [0, 3, 0]])
+    d = paddle.zeros([3, 3])
+    paddle.ops.extras.fill_diagonal_(d, 7.0)
+    np.testing.assert_allclose(d.numpy(), np.eye(3) * 7)
