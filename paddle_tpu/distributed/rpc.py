@@ -78,11 +78,14 @@ def _serve_loop(name, store, stop, start_seq, gen):
     # generation's serve loop.
     seq = start_seq
     while not stop.is_set():
-        if _gen_stopped(store, name, gen):
-            return
         key = f"rpc/q/{name}/{seq}"
         raw = store.get(key, wait=False)
         if raw is None:
+            # shutdown honored only once the mailbox is drained (pending
+            # callers get answers, not 60s timeouts), and the gen key is
+            # polled on the idle path only (half the store traffic)
+            if _gen_stopped(store, name, gen):
+                return
             time.sleep(0.005)
             continue
         seq += 1
@@ -181,7 +184,7 @@ def shutdown():
     out-of-band generation key; a later init_rpc bumps the generation and
     serves on, unaffected by prior shutdowns."""
     name, store, stop = _state["name"], _state["store"], _state["stop"]
-    if store is None:
+    if store is None or _state["serve"] is None:
         return
     store.set(f"rpc/stopgen/{name}", str(_state["gen"]).encode())
     _state["serve"].join(timeout=5)

@@ -1,0 +1,186 @@
+"""paddle.inference — deployment predictor.
+
+Parity: reference inference API (`paddle/fluid/inference/api/
+paddle_inference_api.h:81` Predictor, python `paddle.inference.Config` /
+`create_predictor`, zero-copy handles) over the AnalysisPredictor engine.
+
+TPU-native collapse (SURVEY.md A.7): the offline-optimization pipeline
+(IR fusion passes, memory optimize, TRT subgraphs) IS XLA — jit.save
+exports a StableHLO module, and the Predictor deserializes and runs it
+through the same compiler the reference funnels through its analysis
+passes. The named-handle copy_from_cpu/run/copy_to_cpu protocol is kept
+verbatim so serving code ports unchanged.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Config", "Predictor", "create_predictor", "PredictorTensor",
+           "get_version"]
+
+
+def get_version():
+    from .. import __version__
+    return __version__
+
+
+class Config:
+    """Parity: paddle.inference.Config. Accepts the reference's tuning
+    toggles (recorded; XLA owns optimization on TPU)."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        if prog_file is not None and params_file is None and \
+                os.path.isdir(prog_file):
+            # Config(model_dir) form
+            base = os.path.join(prog_file, "model")
+            prog_file, params_file = base + ".pdmodel.mlir", \
+                base + ".pdiparams"
+        self.prog_file = prog_file
+        self.params_file = params_file
+        self._use_gpu = False
+        self._mem_optim = True
+        self._ir_optim = True
+        self._cpu_threads = 1
+
+    # ---- reference toggle surface (recorded, XLA decides) ----
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._use_gpu = True
+
+    def disable_gpu(self):
+        self._use_gpu = False
+
+    def enable_memory_optim(self, x=True):
+        self._mem_optim = x
+
+    def switch_ir_optim(self, x=True):
+        self._ir_optim = x
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._cpu_threads = n
+
+    def enable_mkldnn(self):
+        pass
+
+    def disable_glog_info(self):
+        pass
+
+    def model_dir(self):
+        return os.path.dirname(self.prog_file or "")
+
+
+class PredictorTensor:
+    """Named IO handle (parity: paddle_infer::Tensor zero-copy handle)."""
+
+    def __init__(self, name, spec=None):
+        self.name = name
+        self._spec = spec or {}
+        self._value = None
+
+    def copy_from_cpu(self, data):
+        self._value = jnp.asarray(np.asarray(data))
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+    def reshape(self, shape):
+        if self._value is not None:
+            self._value = self._value.reshape(shape)
+
+    def shape(self):
+        if self._value is not None:
+            return list(self._value.shape)
+        return list(self._spec.get("shape", []))
+
+    def type(self):
+        return self._spec.get("dtype", "float32")
+
+
+class Predictor:
+    """Parity: paddle_infer::Predictor (get_input_names/get_input_handle/
+    run/get_output_handle protocol)."""
+
+    def __init__(self, config: Config):
+        import jax.export
+        import pickle
+
+        self._config = config
+        base = config.prog_file
+        if base is None:
+            raise ValueError("Config needs a model file")
+        if base.endswith(".pdmodel.mlir"):
+            base = base[:-len(".pdmodel.mlir")]
+        with open(base + ".pdmodel.mlir", "rb") as f:
+            self._exported = jax.export.deserialize(f.read())
+        params_file = config.params_file or base + ".pdiparams"
+        with open(params_file, "rb") as f:
+            state = pickle.load(f)
+        self._state = {k: jnp.asarray(v) for k, v in state.items()}
+        meta_path = base + ".pdmodel.meta.json"
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                meta = json.load(f)
+            self._input_meta = meta.get("inputs", [])
+        else:
+            n_in = len(self._exported.in_avals[1]) \
+                if len(self._exported.in_avals) > 1 else 1
+            self._input_meta = [{"name": f"x{i}"} for i in range(n_in)]
+        self._inputs: Dict[str, PredictorTensor] = {
+            m["name"]: PredictorTensor(m["name"], m)
+            for m in self._input_meta}
+        self._outputs: List[PredictorTensor] = []
+
+    # ---- reference handle protocol ----
+    def get_input_names(self):
+        return [m["name"] for m in self._input_meta]
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def run(self, inputs=None):
+        """Zero-arg form runs from the named handles (reference protocol);
+        passing a list of numpy arrays returns outputs directly (the
+        reference's convenience overload)."""
+        if inputs is not None:
+            for m, a in zip(self._input_meta, inputs):
+                self._inputs[m["name"]].copy_from_cpu(a)
+        args = [self._inputs[m["name"]]._value for m in self._input_meta]
+        if any(a is None for a in args):
+            missing = [m["name"] for m, a in zip(self._input_meta, args)
+                       if a is None]
+            raise RuntimeError(f"inputs not set: {missing}")
+        out = self._exported.call(self._state, *args)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        self._outputs = []
+        for i, o in enumerate(outs):
+            h = PredictorTensor(f"out{i}")
+            h._value = o
+            self._outputs.append(h)
+        if inputs is not None:
+            return [np.asarray(o._value) for o in self._outputs]
+        return True
+
+    def get_output_names(self):
+        return [h.name for h in self._outputs] or ["out0"]
+
+    def get_output_handle(self, name):
+        for h in self._outputs:
+            if h.name == name:
+                return h
+        raise KeyError(name)
+
+    def try_shrink_memory(self):
+        pass
+
+    def clear_intermediate_tensor(self):
+        pass
+
+
+def create_predictor(config: Config) -> Predictor:
+    """Parity: paddle.inference.create_predictor."""
+    return Predictor(config)

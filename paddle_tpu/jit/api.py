@@ -72,7 +72,8 @@ class _StateBundle:
 class TracedFunction:
     """The compiled callable returned by to_static."""
 
-    def __init__(self, fn, state_objects=None, donate_state=True):
+    def __init__(self, fn, state_objects=None, donate_state=True,
+                 input_spec=None):
         from ..nn.layer.layers import Layer
         self._orig_fn = fn
         if isinstance(fn, Layer):
@@ -84,8 +85,51 @@ class TracedFunction:
         self._bundle = _StateBundle(state_objects)
         self._cache: Dict[Any, Any] = {}
         self._donate = donate_state
+        self._input_spec = list(input_spec) if input_spec else None
         self.__wrapped__ = fn
         functools.update_wrapper(self, self._callable)
+
+    def _check_spec(self, tensor_arrays):
+        """input_spec-driven guard (parity: the reference's
+        StaticFunction input_spec contract): every call's tensor args must
+        match the declared dtypes and static dims (-1/None = dynamic)."""
+        spec = self._input_spec
+        if len(tensor_arrays) < len(spec):
+            raise TypeError(
+                f"to_static(input_spec=...) declared {len(spec)} tensor "
+                f"inputs, call passed {len(tensor_arrays)}")
+        for i, (s, a) in enumerate(zip(spec, tensor_arrays)):
+            want = tuple(getattr(s, "shape", ()))
+            if len(want) != a.ndim:
+                raise TypeError(
+                    f"input {i} ({getattr(s, 'name', None) or i}): rank "
+                    f"{a.ndim} does not match input_spec rank {len(want)}")
+            for d, (w, g) in enumerate(zip(want, a.shape)):
+                if w not in (-1, None) and w != g:
+                    raise TypeError(
+                        f"input {i} dim {d}: got {g}, input_spec demands "
+                        f"{w}")
+            sd = getattr(s, "dtype", None)
+            if sd is not None and str(a.dtype) != str(sd):
+                raise TypeError(
+                    f"input {i}: dtype {a.dtype} != input_spec {sd}")
+
+    def warmup(self):
+        """Ahead-of-time compile from a fully static input_spec (the
+        reference's declarative-tracing mode: no example call needed)."""
+        import jax.numpy as jnp
+        if not self._input_spec:
+            raise ValueError("warmup() needs to_static(input_spec=[...])")
+        args = []
+        for s in self._input_spec:
+            shape = tuple(getattr(s, "shape", ()))
+            if any(d in (-1, None) for d in shape):
+                raise ValueError(
+                    "warmup() needs fully static input_spec shapes")
+            args.append(Tensor(jnp.zeros(shape,
+                                         jnp.dtype(s.dtype or "float32"))))
+        self(*args)
+        return self
 
     # -- internals ---------------------------------------------------------
     def _make_jitted(self, treedef, static_leaves, n_tensors):
@@ -139,6 +183,8 @@ class TracedFunction:
                 static_leaves.append(l)
                 sg_flags.append(True)
         self._sg_flags = sg_flags
+        if self._input_spec is not None:
+            self._check_spec(tensor_arrays)
         # sg_flags is read by the traced closure, so it MUST be part of the
         # guard key: two calls with identical shapes but different
         # stop_gradient patterns need distinct compiled programs.
@@ -195,7 +241,8 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     the compiled program — needed when the function mutates them."""
 
     def deco(fn):
-        return TracedFunction(fn, state_objects=state_objects)
+        return TracedFunction(fn, state_objects=state_objects,
+                              input_spec=input_spec)
 
     if function is not None:
         return deco(function)
@@ -257,6 +304,17 @@ def save(layer, path, input_spec=None, **configs):
             exported = jax.export.export(jax.jit(pure))(example_state, *shapes)
             with open(path + ".pdmodel.mlir", "wb") as f:
                 f.write(exported.serialize())
+            # sidecar metadata: named IO for the inference Predictor
+            import json
+            meta = {
+                "inputs": [{
+                    "name": getattr(s, "name", None) or f"x{i}",
+                    "shape": list(getattr(s, "shape", ())),
+                    "dtype": str(getattr(s, "dtype", "float32")),
+                } for i, s in enumerate(input_spec)],
+            }
+            with open(path + ".pdmodel.meta.json", "w") as f:
+                json.dump(meta, f)
     else:
         raise TypeError("jit.save expects a Layer or TracedFunction")
 

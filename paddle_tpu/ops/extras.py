@@ -107,10 +107,14 @@ def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
     return apply_op("diagonal_scatter", _f, x, y)
 
 
+def _diag_len(rows, cols, offset):
+    # length of the offset diagonal of a rows x cols matrix
+    return max(0, min(rows + min(offset, 0), cols - max(offset, 0)))
+
+
 def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
     def _f(a):
-        n = min(a.shape[-2], a.shape[-1])
-        i = jnp.arange(n - abs(offset))
+        i = jnp.arange(_diag_len(a.shape[-2], a.shape[-1], offset))
         rows = i - min(offset, 0)
         cols = i + max(offset, 0)
         return a.at[..., rows, cols].set(value)
@@ -124,8 +128,7 @@ def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
 
 def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
     def _f(a, b):
-        n = min(a.shape[dim1], a.shape[dim2]) - abs(offset)
-        i = jnp.arange(n)
+        i = jnp.arange(_diag_len(a.shape[dim1], a.shape[dim2], offset))
         rows = i - min(offset, 0)
         cols = i + max(offset, 0)
         sel = [slice(None)] * a.ndim
@@ -320,9 +323,8 @@ def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype="float32", name=None):
 
 
 def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
-    from ..core.dtype import convert_dtype
-    dt = jnp.dtype(convert_dtype(dtype) or "float32")
-    t = Tensor(jnp.full(tuple(int(s) for s in shape), value, dt))
+    from .creation import full
+    t = full(shape, value, dtype=dtype)
     if out is not None:
         out._data = t._data
         return out

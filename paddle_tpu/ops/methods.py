@@ -156,10 +156,11 @@ def patch_tensor_methods():
         "bitwise_not", "logical_not", "sinc", "renorm", "t", "transpose",
         "index_add", "index_fill", "index_put", "masked_fill",
         "masked_scatter", "put_along_axis", "fill_diagonal_tensor", "addmm",
+        "lerp",  # 3-arg: needs the *args wrapper
     ]
     binary_inplace = [
         "divide", "floor_divide", "remainder", "pow", "copysign", "hypot",
-        "gcd", "lcm", "ldexp", "lerp", "bitwise_and", "bitwise_or",
+        "gcd", "lcm", "ldexp", "bitwise_and", "bitwise_or",
         "bitwise_xor", "bitwise_left_shift", "bitwise_right_shift",
         "logical_and", "logical_or", "logical_xor", "equal", "not_equal",
         "greater_equal", "greater_than", "less_equal", "less_than",

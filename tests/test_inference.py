@@ -1,0 +1,96 @@
+"""Inference Predictor + input_spec tracing (layer 13 / layer 10 gaps)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.static import InputSpec
+
+rng = np.random.RandomState(0)
+
+
+def _saved_model(tmp_path):
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 16), paddle.nn.ReLU(),
+                               paddle.nn.Linear(16, 4))
+    net.eval()
+    base = os.path.join(str(tmp_path), "model")
+    paddle.jit.save(net, base,
+                    input_spec=[InputSpec([2, 8], "float32", name="input")])
+    return net, base
+
+
+def test_predictor_named_handle_protocol(tmp_path):
+    net, base = _saved_model(tmp_path)
+    x = rng.randn(2, 8).astype(np.float32)
+    ref = np.asarray(net(paddle.to_tensor(x))._data)
+
+    from paddle_tpu.inference import Config, create_predictor
+    cfg = Config(base + ".pdmodel.mlir", base + ".pdiparams")
+    cfg.enable_memory_optim()
+    cfg.switch_ir_optim(True)
+    pred = create_predictor(cfg)
+    assert pred.get_input_names() == ["input"]
+    h = pred.get_input_handle("input")
+    h.copy_from_cpu(x)
+    assert h.shape() == [2, 8]
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, ref, atol=1e-6)
+
+
+def test_predictor_convenience_run(tmp_path):
+    net, base = _saved_model(tmp_path)
+    x = rng.randn(2, 8).astype(np.float32)
+    ref = np.asarray(net(paddle.to_tensor(x))._data)
+    from paddle_tpu.inference import Config, create_predictor
+    pred = create_predictor(Config(base + ".pdmodel.mlir",
+                                   base + ".pdiparams"))
+    outs = pred.run([x])
+    np.testing.assert_allclose(outs[0], ref, atol=1e-6)
+
+
+def test_predictor_missing_input_raises(tmp_path):
+    _, base = _saved_model(tmp_path)
+    from paddle_tpu.inference import Config, create_predictor
+    pred = create_predictor(Config(base + ".pdmodel.mlir",
+                                   base + ".pdiparams"))
+    with pytest.raises(RuntimeError, match="inputs not set"):
+        pred.run()
+
+
+# -------------------------------------------------- input_spec tracing
+def test_to_static_input_spec_guard():
+    net = paddle.nn.Linear(8, 4)
+    f = paddle.jit.to_static(net,
+                             input_spec=[InputSpec([-1, 8], "float32", "x")])
+    out = f(paddle.to_tensor(np.zeros((3, 8), np.float32)))
+    assert list(out.shape) == [3, 4]
+    # dynamic batch dim: another size passes
+    f(paddle.to_tensor(np.zeros((5, 8), np.float32)))
+    with pytest.raises(TypeError, match="input_spec demands"):
+        f(paddle.to_tensor(np.zeros((3, 9), np.float32)))
+    with pytest.raises(TypeError, match="dtype"):
+        f(paddle.to_tensor(np.zeros((3, 8), np.float64)))
+    with pytest.raises(TypeError, match="rank"):
+        f(paddle.to_tensor(np.zeros((8,), np.float32)))
+
+
+def test_to_static_warmup_compiles_ahead_of_time():
+    net = paddle.nn.Linear(8, 4)
+    f = paddle.jit.to_static(net,
+                             input_spec=[InputSpec([2, 8], "float32", "x")])
+    f.warmup()
+    assert len(f._cache) == 1
+    # the warm entry is reused, not retraced
+    f(paddle.to_tensor(np.ones((2, 8), np.float32)))
+    assert len(f._cache) == 1
+
+
+def test_warmup_requires_static_shapes():
+    net = paddle.nn.Linear(8, 4)
+    f = paddle.jit.to_static(net,
+                             input_spec=[InputSpec([-1, 8], "float32", "x")])
+    with pytest.raises(ValueError, match="static"):
+        f.warmup()
