@@ -22,7 +22,7 @@ from .core.dtype import (  # noqa: F401,E402
     float64, complex64, complex128, float8_e4m3fn, float8_e5m2,
     set_default_dtype, get_default_dtype, finfo, iinfo, dtype_name,
 )
-from .core.tensor import Tensor, to_tensor, is_tensor  # noqa: F401,E402
+from .core.tensor import SelectedRows, Tensor, to_tensor, is_tensor  # noqa: F401,E402
 from .core import autograd as _autograd_core  # noqa: E402
 from .core.autograd import no_grad, enable_grad, set_grad_enabled, is_grad_enabled  # noqa: F401,E402
 from .core.autograd import grad  # noqa: F401,E402

@@ -285,3 +285,44 @@ def _tensor_unflatten(aux, children):
 
 
 jax.tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)
+
+
+class SelectedRows:
+    """Sparse row-slice tensor (parity: `phi::SelectedRows`,
+    `paddle/phi/core/selected_rows.h`): a (rows, value) pair representing a
+    tall tensor in which only `rows` hold data — the reference's embedding-
+    gradient format. On TPU dense scatter-add is the fast path, so this
+    type is an interchange/API surface: `to_dense()` materializes, and
+    embedding-style lookups can build one cheaply."""
+
+    def __init__(self, rows, value, height):
+        import jax.numpy as jnp
+        self.rows = jnp.asarray(rows._data if isinstance(rows, Tensor)
+                                else rows)
+        self.value = value if isinstance(value, Tensor) else Tensor(value)
+        self.height = int(height)
+
+    @property
+    def shape(self):
+        return [self.height] + list(self.value.shape[1:])
+
+    def to_dense(self):
+        import jax.numpy as jnp
+        v = self.value._data
+        out = jnp.zeros((self.height,) + tuple(v.shape[1:]), v.dtype)
+        return Tensor(out.at[self.rows].add(v))
+
+    def merge_rows(self):
+        """Coalesce duplicate rows (parity: scatter::MergeAdd)."""
+        import jax.numpy as jnp
+        uniq, inv = jnp.unique(self.rows, return_inverse=True,
+                               size=self.rows.shape[0], fill_value=-1)
+        v = self.value._data
+        merged = jnp.zeros((uniq.shape[0],) + tuple(v.shape[1:]), v.dtype)
+        merged = merged.at[inv].add(v)
+        keep = uniq >= 0
+        return SelectedRows(uniq[keep], Tensor(merged[keep]), self.height)
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, "
+                f"n_rows={self.rows.shape[0]})")

@@ -1,0 +1,114 @@
+"""fleet_executor: multi-program Plan/Job scheduling.
+
+Parity: reference `paddle/fluid/distributed/fleet_executor/` — the
+actor-style pipeline runtime executing a `Plan` of `Job`s (forward /
+backward / optimizer sub-programs per micro-batch, produced by the
+pipeline_scheduler passes, `new_executor/interpreter/plan.h`) with
+interceptors exchanging messages.
+
+TPU-native: a Job wraps a compiled callable (TracedFunction or plain fn)
+instead of a ProgramDesc; the FleetExecutor sequences jobs per the
+schedule (FThenB / 1F1B orderings from PipelineMicroScheduler). The
+*performance* pipeline path remains distributed.pipeline (one fused XLA
+program with ppermute edges); this executor exists for the multi-program
+orchestration capability — heterogeneous jobs, per-micro-batch callbacks,
+cross-program state carried host-side.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from .pipeline import PipelineMicroScheduler
+
+__all__ = ["Job", "Plan", "FleetExecutor", "build_pipeline_plan"]
+
+
+class Job:
+    """Parity: interpreter Plan's Job (type + micro_batch id)."""
+
+    def __init__(self, type: str, fn: Callable = None, micro_batch_id=-1):
+        self._type = type
+        self._fn = fn
+        self._micro_batch_id = micro_batch_id
+
+    def type(self):
+        return self._type
+
+    def micro_batch_id(self):
+        return self._micro_batch_id
+
+    def set_micro_batch_id(self, i):
+        self._micro_batch_id = i
+
+    def run(self, *args, **kwargs):
+        if self._fn is None:
+            return None
+        return self._fn(*args, **kwargs)
+
+    def __repr__(self):
+        return f"Job({self._type}, mb={self._micro_batch_id})"
+
+
+class Plan:
+    """Parity: interpreter/plan.h Plan — an ordered list of typed jobs."""
+
+    def __init__(self, job_list: List[Job],
+                 type_to_program: Optional[Dict[str, Callable]] = None):
+        self._jobs = list(job_list)
+        self._type_to_program = dict(type_to_program or {})
+        for j in self._jobs:
+            if j._fn is None and j.type() in self._type_to_program:
+                j._fn = self._type_to_program[j.type()]
+
+    def job_list(self):
+        return list(self._jobs)
+
+    def micro_batch_num(self):
+        return 1 + max((j.micro_batch_id() for j in self._jobs), default=0)
+
+
+class FleetExecutor:
+    """Sequences a Plan's jobs (parity: fleet_executor.h FleetExecutor +
+    Carrier; the message-bus actor machinery collapses to a host loop since
+    every job runs in this process against the XLA runtime)."""
+
+    def __init__(self, plan: Plan):
+        self._plan = plan
+        self._callbacks: List[Callable] = []
+
+    def register_micro_batch_callback(self, cb: Callable):
+        """Parity: micro-batch step callbacks
+        (pipeline_parallel.py:166)."""
+        self._callbacks.append(cb)
+
+    def run(self, feeds: Optional[Dict[int, Any]] = None):
+        """Run every job in order. `feeds` maps micro_batch_id -> job
+        input; returns {micro_batch_id: last output per micro batch}."""
+        feeds = feeds or {}
+        results: Dict[int, Any] = {}
+        for job in self._plan.job_list():
+            mb = job.micro_batch_id()
+            arg = feeds.get(mb)
+            out = job.run(arg) if arg is not None else job.run()
+            if out is not None:
+                results[mb] = out
+            for cb in self._callbacks:
+                cb(job.type(), mb)
+        return results
+
+
+def build_pipeline_plan(forward_fn, backward_fn, opt_fn, n_micro,
+                        n_stages=1, schedule="1F1B"):
+    """Build a Plan from the 1F1B / FThenB micro-batch orderings (parity:
+    pipeline_scheduler_pass building multi-Job plans,
+    passes/pipeline_scheduler_pass/pipeline_1f1b.py:39)."""
+    sched = PipelineMicroScheduler(n_stages=n_stages, n_micro=n_micro,
+                                   schedule=schedule)
+    jobs = []
+    for kind, mb in sched.steps():
+        if kind == "F":
+            jobs.append(Job("forward", forward_fn, mb))
+        else:
+            jobs.append(Job("backward", backward_fn, mb))
+    jobs.append(Job("optimizer", opt_fn))
+    return Plan(jobs)
