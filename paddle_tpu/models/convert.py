@@ -1,0 +1,103 @@
+"""Checkpoint converters: load PaddleNLP / HuggingFace Llama weights.
+
+Parity: the reference trains Llama through PaddleNLP recipes whose
+checkpoints use the `llama.*` key prefix with (in, out) Linear layout;
+HF transformers checkpoints use `model.*` keys with (out, in) torch
+layout. SURVEY.md §7 lists the name-mapping story as the checkpoint
+compat requirement for recipe parity — a reference user's weights must
+load into this framework unchanged.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["convert_llama_state_dict", "load_llama_checkpoint"]
+
+# our canonical key template (LlamaForCausalLM.state_dict)
+_LAYER_SUFFIXES = [
+    "self_attn.q_proj.weight", "self_attn.k_proj.weight",
+    "self_attn.v_proj.weight", "self_attn.o_proj.weight",
+    "mlp.gate_proj.weight", "mlp.up_proj.weight", "mlp.down_proj.weight",
+    "input_layernorm.weight", "post_attention_layernorm.weight",
+]
+
+
+def _detect_source(keys):
+    if any(k.startswith("llama.") for k in keys):
+        return "paddlenlp"
+    if any(k.startswith("model.layers.") or k == "model.embed_tokens.weight"
+           for k in keys):
+        return "hf"
+    return "native"
+
+
+def convert_llama_state_dict(state_dict: Dict, dtype=None) -> Dict:
+    """Map a PaddleNLP (`llama.*`, (in, out) layout) or HuggingFace
+    (`model.*`, (out, in) torch layout) Llama checkpoint onto this
+    framework's key space. Values may be numpy arrays or Tensors; returns
+    {our_key: np.ndarray}."""
+    raw = {k: (np.asarray(v._data) if isinstance(v, Tensor) else
+               np.asarray(v)) for k, v in state_dict.items()}
+    src = _detect_source(raw.keys())
+    if src == "native":
+        return raw
+
+    out: Dict[str, np.ndarray] = {}
+    prefix = "llama." if src == "paddlenlp" else "model."
+    transpose = src == "hf"  # torch Linear stores (out, in)
+
+    def put(our_key, src_key, is_linear=False):
+        if src_key not in raw:
+            return
+        w = raw[src_key]
+        if is_linear and transpose and w.ndim == 2:
+            w = w.T
+        out[our_key] = w
+
+    put("model.embed_tokens.weight", prefix + "embed_tokens.weight")
+    put("model.norm.weight", prefix + "norm.weight")
+    put("lm_head.weight", "lm_head.weight", is_linear=True)
+    # PaddleNLP lm_head is (hidden, vocab) already — matches ours
+    i = 0
+    while f"{prefix}layers.{i}.input_layernorm.weight" in raw:
+        for suf in _LAYER_SUFFIXES:
+            put(f"model.layers.{i}.{suf}", f"{prefix}layers.{i}.{suf}",
+                is_linear=suf.endswith("proj.weight"))
+            bias_suf = suf.replace(".weight", ".bias")
+            if f"{prefix}layers.{i}.{bias_suf}" in raw:
+                put(f"model.layers.{i}.{bias_suf}",
+                    f"{prefix}layers.{i}.{bias_suf}")
+        i += 1
+    if dtype is not None:
+        out = {k: v.astype(dtype) for k, v in out.items()}
+    return out
+
+
+def load_llama_checkpoint(model, state_dict: Dict, strict: bool = False):
+    """Convert + load into a LlamaForCausalLM (or Pipe) instance.
+    Returns (missing_keys, unexpected_keys)."""
+    converted = convert_llama_state_dict(state_dict)
+    own = model.state_dict()
+    missing, loaded = [], set()
+    for k, t in own.items():
+        if k.startswith("model.rope_"):
+            continue  # recomputed buffers
+        if k in converted:
+            arr = jnp.asarray(converted[k])
+            if tuple(arr.shape) != tuple(t._data.shape):
+                raise ValueError(
+                    f"{k}: checkpoint shape {arr.shape} != model "
+                    f"{tuple(t._data.shape)}")
+            t._data = arr.astype(t._data.dtype)
+            loaded.add(k)
+        else:
+            missing.append(k)
+    unexpected = [k for k in converted if k not in own]
+    if strict and (missing or unexpected):
+        raise KeyError(f"missing={missing} unexpected={unexpected}")
+    return missing, unexpected
