@@ -49,6 +49,8 @@ from . import metric  # noqa: F401,E402
 from . import static  # noqa: F401,E402
 from . import utils  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
+from .hapi.summary import flops, summary  # noqa: F401,E402
+from .utils.flags import get_flags, set_flags  # noqa: F401,E402
 from . import distribution  # noqa: F401,E402
 from . import sparse  # noqa: F401,E402
 from . import geometric  # noqa: F401,E402

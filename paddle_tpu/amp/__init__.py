@@ -13,3 +13,5 @@ from .grad_scaler import GradScaler, AmpScaler, OptimizerState  # noqa: F401
 from . import amp_lists  # noqa: F401
 
 __all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler", "AmpScaler"]
+
+from . import debugging  # noqa: F401

@@ -1,0 +1,111 @@
+"""amp.debugging — per-op dtype statistics for mixed-precision debugging.
+
+Parity: reference `python/paddle/amp/debugging.py` —
+enable/disable_operator_stats_collection, collect_operator_stats context
+(prints the op calls grouped by dtype so low-precision leakage is visible),
+and the TensorCheckerConfig/enable_tensor_checker nan/inf scan (here the
+framework-wide FLAGS_check_nan_inf path already wired into the dispatch
+funnel).
+
+TPU-native: the dispatch funnel is the single choke point every op passes
+through, so stats collection is one hook there — no per-kernel
+instrumentation.
+"""
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Dict
+
+__all__ = ["enable_operator_stats_collection",
+           "disable_operator_stats_collection", "collect_operator_stats",
+           "TensorCheckerConfig", "enable_tensor_checker",
+           "disable_tensor_checker"]
+
+_stats_lock = threading.Lock()
+_collecting = [False]
+# op name -> dtype -> call count
+_op_stats: Dict[str, Dict[str, int]] = defaultdict(lambda: defaultdict(int))
+
+
+def _record(name, out_leaves):
+    """Called from the dispatch funnel when collection is on."""
+    with _stats_lock:
+        for o in out_leaves:
+            dt = str(getattr(o, "dtype", "other"))
+            _op_stats[name][dt] += 1
+
+
+def _is_collecting():
+    return _collecting[0]
+
+
+def enable_operator_stats_collection():
+    """Parity: amp/debugging.py enable_operator_stats_collection."""
+    with _stats_lock:
+        _op_stats.clear()
+    _collecting[0] = True
+
+
+def disable_operator_stats_collection():
+    """Stop collecting and print the dtype table (reference behavior)."""
+    _collecting[0] = False
+    _print_table()
+
+
+def _print_table():
+    dtypes = ["float32", "float16", "bfloat16", "other"]
+    width = 40 + 12 * len(dtypes)
+    print("-" * width)
+    print(f"{'op':<40}" + "".join(f"{d:>12}" for d in dtypes))
+    print("=" * width)
+    with _stats_lock:
+        for name in sorted(_op_stats):
+            counts = _op_stats[name]
+            row = {d: 0 for d in dtypes}
+            for dt, n in counts.items():
+                row[dt if dt in row else "other"] += n
+            print(f"{name[:39]:<40}" +
+                  "".join(f"{row[d]:>12}" for d in dtypes))
+    print("-" * width)
+
+
+class collect_operator_stats:
+    """Context form (parity: amp/debugging.py collect_operator_stats)."""
+
+    def __enter__(self):
+        enable_operator_stats_collection()
+        return self
+
+    def __exit__(self, *exc):
+        disable_operator_stats_collection()
+        return False
+
+
+def operator_stats():
+    """Programmatic access to the collected table (copy)."""
+    with _stats_lock:
+        return {k: dict(v) for k, v in _op_stats.items()}
+
+
+class TensorCheckerConfig:
+    """Parity: amp/debugging.py TensorCheckerConfig — configures the
+    nan/inf scan (enable_check_nan_inf path in the dispatch funnel)."""
+
+    def __init__(self, enable=True, debug_mode=None, output_dir=None,
+                 checked_op_list=None, skipped_op_list=None, **kw):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = checked_op_list
+        self.skipped_op_list = skipped_op_list
+
+
+def enable_tensor_checker(config: TensorCheckerConfig):
+    from ..utils.flags import set_flags
+    set_flags({"FLAGS_check_nan_inf": bool(config.enable)})
+
+
+def disable_tensor_checker():
+    from ..utils.flags import set_flags
+    set_flags({"FLAGS_check_nan_inf": False})

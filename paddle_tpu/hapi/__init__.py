@@ -1,2 +1,4 @@
 from .model import Model  # noqa: F401
 from . import callbacks  # noqa: F401
+
+from .summary import flops, summary  # noqa: F401

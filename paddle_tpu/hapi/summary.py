@@ -1,0 +1,136 @@
+"""paddle.summary / paddle.flops — model inspection.
+
+Parity: reference `python/paddle/hapi/model_summary.py` (summary) and
+`python/paddle/hapi/dynamic_flops.py` (flops): per-layer shape/param
+table from a hooked forward pass, and a FLOPs estimate for the common
+layer types.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["summary", "flops"]
+
+
+def _zeros_input(input_size, dtypes=None):
+    import jax.numpy as jnp
+    if isinstance(input_size, (list,)) and input_size and \
+            isinstance(input_size[0], (list, tuple)):
+        shapes = input_size
+    else:
+        shapes = [tuple(input_size)]
+    dt = dtypes or ["float32"] * len(shapes)
+    return [Tensor(jnp.zeros(tuple(int(d) for d in s), jnp.dtype(t)))
+            for s, t in zip(shapes, dt)]
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Per-layer output-shape/param table (parity: hapi.summary)."""
+    rows: List[dict] = []
+    hooks = []
+
+    def make_hook(name, layer):
+        def hook(l, inputs, outputs):
+            out = outputs[0] if isinstance(outputs, (list, tuple)) \
+                else outputs
+            shape = list(out.shape) if isinstance(out, Tensor) else []
+            n_params = sum(int(np.prod(p.shape))
+                           for p in l._parameters.values()
+                           if p is not None)
+            rows.append({"name": name or type(l).__name__,
+                         "type": type(l).__name__,
+                         "output_shape": shape, "params": n_params})
+        return hook
+
+    for name, layer in net.named_sublayers(include_self=False):
+        if not layer._sub_layers:  # leaves only
+            hooks.append(layer.register_forward_post_hook(
+                make_hook(name, layer)))
+    try:
+        args = [input] if input is not None else _zeros_input(input_size,
+                                                              dtypes)
+        net(*args)
+    finally:
+        for h in hooks:
+            h.remove()
+
+    total = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
+                    if not p.stop_gradient)
+    width = 72
+    print("-" * width)
+    print(f"{'Layer (type)':<32}{'Output Shape':<24}{'Param #':>14}")
+    print("=" * width)
+    for r in rows:
+        print(f"{(r['name'] + ' (' + r['type'] + ')')[:31]:<32}"
+              f"{str(r['output_shape']):<24}{r['params']:>14,}")
+    print("=" * width)
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    print("-" * width)
+    return {"total_params": total, "trainable_params": trainable}
+
+
+def _layer_flops(layer, inputs, outputs):
+    """FLOPs for the common layer types (parity: dynamic_flops.py
+    count_* registry)."""
+    from ..nn import Conv2D, Linear
+    out = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
+    if not isinstance(out, Tensor):
+        return 0
+    out_elems = int(np.prod(out.shape))
+    name = type(layer).__name__
+    if name in ("Linear", "ColumnParallelLinear", "RowParallelLinear"):
+        in_f = layer.weight.shape[0]
+        return 2 * out_elems * int(in_f)
+    if name in ("Conv2D", "Conv1D", "Conv3D"):
+        w = layer.weight
+        kernel_elems = int(np.prod(w.shape[1:]))  # cin/groups * k...
+        return 2 * out_elems * kernel_elems
+    if "Norm" in name:
+        return 2 * out_elems
+    if name.lower() in ("relu", "gelu", "sigmoid", "tanh", "softmax",
+                        "silu", "swish", "leakyrelu", "elu", "hardswish"):
+        return out_elems
+    if "Pool" in name:
+        return out_elems
+    return 0
+
+
+def flops(net, input_size, custom_ops: Optional[Dict] = None,
+          print_detail=False):
+    """Total forward FLOPs estimate (parity: paddle.flops)."""
+    total = [0]
+    detail = []
+    hooks = []
+    custom_ops = custom_ops or {}
+
+    def make_hook(name, layer):
+        def hook(l, inputs, outputs):
+            fn = custom_ops.get(type(l))
+            n = fn(l, inputs, outputs) if fn else _layer_flops(l, inputs,
+                                                              outputs)
+            total[0] += n
+            detail.append((name or type(l).__name__, n))
+        return hook
+
+    for name, layer in net.named_sublayers(include_self=False):
+        if not layer._sub_layers:
+            hooks.append(layer.register_forward_post_hook(
+                make_hook(name, layer)))
+    try:
+        net(*_zeros_input(input_size))
+    finally:
+        for h in hooks:
+            h.remove()
+    if print_detail:
+        for name, n in detail:
+            print(f"{name:<40}{n:>16,}")
+    print(f"Total Flops: {total[0]:,}     "
+          f"Total Params: {sum(int(np.prod(p.shape)) for p in net.parameters()):,}")
+    return total[0]

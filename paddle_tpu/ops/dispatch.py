@@ -94,6 +94,10 @@ def _apply_op(name: str, fn: Callable, *args, **kwargs):
     if check_nan_inf_enabled():
         maybe_check(name, out_leaves)
 
+    from ..amp import debugging as _amp_dbg
+    if _amp_dbg._is_collecting():
+        _amp_dbg._record(name, out_leaves)
+
     out_tensors = []
     node = None
     if need_grad:

@@ -277,3 +277,28 @@ def test_bf16_cast():
     assert lin.weight.dtype == paddle.bfloat16
     x = paddle.to_tensor(rng.randn(2, 4).astype(np.float32)).astype("bfloat16")
     assert lin(x).dtype == paddle.bfloat16
+
+
+# --------------------------------------------- summary / flops / amp debug
+def test_paddle_summary_and_flops():
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 16), paddle.nn.ReLU(),
+                               paddle.nn.Linear(16, 4))
+    info = paddle.summary(net, (2, 8))
+    assert info["total_params"] == 8 * 16 + 16 + 16 * 4 + 4
+    n = paddle.flops(net, (2, 8))
+    assert n == 2 * 2 * 16 * 8 + 2 * 16 + 2 * 2 * 4 * 16
+
+
+def test_amp_operator_stats_collection():
+    from paddle_tpu.amp.debugging import (collect_operator_stats,
+                                          operator_stats)
+    net = paddle.nn.Linear(8, 8)
+    x = paddle.to_tensor(np.zeros((2, 8), np.float32))
+    with paddle.amp.auto_cast(dtype="bfloat16"):
+        with collect_operator_stats():
+            net(x)
+    stats = operator_stats()
+    assert any("bfloat16" in d for v in stats.values() for d in v)
+    # collection is off outside the context
+    net(x)
+    assert operator_stats() == stats
