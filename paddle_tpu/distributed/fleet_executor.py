@@ -18,7 +18,7 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict, List, Optional
 
-from .pipeline import PipelineMicroScheduler
+from .pipeline import PipelineMicroScheduler, ZB_SCHEDULES
 
 __all__ = ["Job", "Plan", "FleetExecutor", "build_pipeline_plan"]
 
@@ -98,17 +98,29 @@ class FleetExecutor:
 
 
 def build_pipeline_plan(forward_fn, backward_fn, opt_fn, n_micro,
-                        n_stages=1, schedule="1F1B"):
-    """Build a Plan from the 1F1B / FThenB micro-batch orderings (parity:
-    pipeline_scheduler_pass building multi-Job plans,
-    passes/pipeline_scheduler_pass/pipeline_1f1b.py:39)."""
+                        n_stages=1, schedule="1F1B", weight_grad_fn=None):
+    """Build a Plan from the 1F1B / FThenB / ZB-H1 micro-batch orderings
+    (parity: pipeline_scheduler_pass building multi-Job plans,
+    passes/pipeline_scheduler_pass/pipeline_1f1b.py:39,
+    pipeline_zero_bubble.py:62 — ZB-H1 splits backward into input-grad
+    'backward_b' and deferred weight-grad 'backward_w' jobs)."""
     sched = PipelineMicroScheduler(n_stages=n_stages, n_micro=n_micro,
                                    schedule=schedule)
+    zb = schedule in ZB_SCHEDULES
+    if zb and weight_grad_fn is None:
+        raise ValueError(
+            "zero-bubble schedules defer weight grads into backward_w "
+            "jobs: pass weight_grad_fn (a silent no-op would train "
+            "without weight gradients)")
     jobs = []
-    for kind, mb in sched.steps():
+    for ev in sched.steps():
+        kind, mb = ev
         if kind == "F":
             jobs.append(Job("forward", forward_fn, mb))
+        elif kind == "W":
+            jobs.append(Job("backward_w", weight_grad_fn, mb))
         else:
-            jobs.append(Job("backward", backward_fn, mb))
+            jobs.append(Job("backward_b" if zb else "backward",
+                            backward_fn, mb))
     jobs.append(Job("optimizer", opt_fn))
     return Plan(jobs)

@@ -211,6 +211,9 @@ def _pipeline_circular(stage_params, micro_inputs, stage_fn, mesh, axis,
     return jax.jit(mapped)(stage_params, micro_inputs, *extras)
 
 
+ZB_SCHEDULES = ("ZB-H1", "ZB", "zero_bubble")
+
+
 class PipelineMicroScheduler:
     """Host-level micro-batch scheduler used by fleet.PipelineParallel for
     the eager path (schedule bookkeeping parity: FThenB / 1F1B orderings).
@@ -222,15 +225,21 @@ class PipelineMicroScheduler:
         self.schedule = schedule
 
     def steps(self):
-        """Yields ('F', i) / ('B', i) events in schedule order for rank-0
-        semantics (single-process SPMD runs the whole graph)."""
+        """Yields ('F', i) / ('B', i) — plus ('W', i) for ZB-H1 — events in
+        schedule order for rank-0 semantics (single-process SPMD runs the
+        whole graph)."""
         if self.schedule == "FThenB":
             for i in range(self.n_micro):
                 yield ("F", i)
             for i in range(self.n_micro):
                 yield ("B", i)
             return
-        warmup = min(self.n_stages - 1, self.n_micro)
+        if self.schedule in ZB_SCHEDULES:
+            yield from self._zb_h1_steps()
+            return
+        # n_stages=1 has no pipeline overlap: warmup must still cover
+        # F(0) or the steady loop would emit B(0) before its forward
+        warmup = min(max(self.n_stages - 1, 1), self.n_micro)
         for i in range(warmup):
             yield ("F", i)
         fwd = warmup
@@ -244,3 +253,31 @@ class PipelineMicroScheduler:
             else:
                 yield ("B", bwd)
                 bwd += 1
+
+    def _zb_h1_steps(self):
+        """ZB-H1 zero-bubble ordering (parity: reference
+        passes/pipeline_scheduler_pass/pipeline_zero_bubble.py:62): the
+        backward splits into B (input grads — on the critical path, sent
+        upstream immediately) and W (weight grads — free to slide into
+        bubbles). Warmup forwards as 1F1B; steady state interleaves F/B;
+        W fills the cooldown slots that 1F1B leaves idle, deferring all
+        remaining W to the tail."""
+        warmup = min(max(self.n_stages - 1, 1), self.n_micro)
+        for i in range(warmup):
+            yield ("F", i)
+        fwd = warmup
+        bwd = 0
+        w_done = 0
+        while bwd < self.n_micro:
+            yield ("B", bwd)
+            bwd += 1
+            if fwd < self.n_micro:
+                yield ("F", fwd)
+                fwd += 1
+            elif w_done < bwd - 1:
+                # cooldown bubble: retire a deferred weight grad
+                yield ("W", w_done)
+                w_done += 1
+        while w_done < self.n_micro:
+            yield ("W", w_done)
+            w_done += 1

@@ -18,7 +18,7 @@ __all__ = ["summary", "flops"]
 
 def _zeros_input(input_size, dtypes=None):
     import jax.numpy as jnp
-    if isinstance(input_size, (list,)) and input_size and \
+    if isinstance(input_size, (list, tuple)) and input_size and \
             isinstance(input_size[0], (list, tuple)):
         shapes = input_size
     else:
@@ -46,8 +46,8 @@ def summary(net, input_size=None, dtypes=None, input=None):
                          "output_shape": shape, "params": n_params})
         return hook
 
-    for name, layer in net.named_sublayers(include_self=False):
-        if not layer._sub_layers:  # leaves only
+    for name, layer in net.named_sublayers(include_self=True):
+        if not layer._sub_layers:  # leaves only (incl. a leaf root layer)
             hooks.append(layer.register_forward_post_hook(
                 make_hook(name, layer)))
     try:
@@ -119,7 +119,7 @@ def flops(net, input_size, custom_ops: Optional[Dict] = None,
             detail.append((name or type(l).__name__, n))
         return hook
 
-    for name, layer in net.named_sublayers(include_self=False):
+    for name, layer in net.named_sublayers(include_self=True):
         if not layer._sub_layers:
             hooks.append(layer.register_forward_post_hook(
                 make_hook(name, layer)))

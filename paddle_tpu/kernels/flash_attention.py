@@ -662,7 +662,25 @@ def flash_attention_bshd(q, k, v, causal=False, sm_scale=None):
         sm_scale = 1.0 / math.sqrt(D)
     block_q = _pick_block_q(Sq)
     block_k = _pick_block_k(Sk)
-    out = _flash_core(_to_bhsd(q), _to_bhsd(k), _to_bhsd(v), float(sm_scale),
+    qf, kf, vf = _to_bhsd(q), _to_bhsd(k), _to_bhsd(v)
+    from .autotune import autotune_enabled
+    if autotune_enabled() and not _interpret_mode() \
+            and not isinstance(q, jax.core.Tracer):
+        # eager concrete inputs on real TPU: search the legal block grid
+        # once per (shape, device) and reuse the cached winner
+        from .autotune import attention_block_candidates, autotune
+
+        def run(cfg):
+            bq, bk = int(cfg["block_q"]), int(cfg["block_k"])
+            f = jax.jit(lambda a, b, c: _flash_core(
+                a, b, c, float(sm_scale), bool(causal), bq, bk))
+            return lambda: f(qf, kf, vf)
+
+        best = autotune(
+            "flash_fwd", (B * H, Sq, Sk, D, str(q.dtype), bool(causal)),
+            attention_block_candidates(Sq, Sk), run)
+        block_q, block_k = best["block_q"], best["block_k"]
+    out = _flash_core(qf, kf, vf, float(sm_scale),
                       bool(causal), int(block_q), int(block_k))
     return _from_bhsd(out, B, H, Sq, D)
 

@@ -28,7 +28,14 @@ def _env_cast(raw: str, default):
 
 def define_flag(name: str, default, help_str: str = ""):
     env = os.environ.get("FLAGS_" + name)
-    value = _env_cast(env, default) if env is not None else default
+    if env is not None:
+        value = _env_cast(env, default)
+    elif name in _REGISTRY:
+        # a set_flags() issued before the defining module was lazily
+        # imported must not be clobbered by the definition's default
+        return _REGISTRY[name]
+    else:
+        value = default
     _REGISTRY[name] = value
     return value
 

@@ -200,3 +200,38 @@ class TestLlamaPipe:
                   for _ in range(3)]
         assert losses[-1] < losses[0]
         assert all(np.isfinite(l) for l in losses)
+
+
+def test_zb_h1_schedule_properties():
+    """ZB-H1 (zero-bubble) ordering: backward split into B (critical path)
+    and W (deferred weight grads filling cooldown bubbles); every
+    micro-batch gets exactly one F, one B, one W, with W_i after B_i."""
+    sch = PipelineMicroScheduler(n_stages=4, n_micro=8, schedule="ZB-H1")
+    events = list(sch.steps())
+    for kind in ("F", "B", "W"):
+        ids = [i for k, i in events if k == kind]
+        assert sorted(ids) == list(range(8)), (kind, ids)
+    pos = {(k, i): p for p, (k, i) in enumerate(events)}
+    for i in range(8):
+        assert pos[("F", i)] < pos[("B", i)] < pos[("W", i)]
+    # warmup is forward-only (1F1B warmup depth)
+    assert [k for k, _ in events[:3]] == ["F", "F", "F"]
+    # some W work lands before the final B (bubble filling, not all-at-tail)
+    last_b = max(p for (k, i), p in pos.items() if k == "B")
+    assert any(p < last_b for (k, i), p in pos.items() if k == "W")
+
+
+def test_zb_plan_builder():
+    from paddle_tpu.distributed.fleet_executor import (FleetExecutor,
+                                                       build_pipeline_plan)
+    log = []
+    plan = build_pipeline_plan(
+        forward_fn=lambda: log.append("F"),
+        backward_fn=lambda: log.append("B"),
+        opt_fn=lambda: log.append("O"),
+        weight_grad_fn=lambda: log.append("W"),
+        n_micro=4, n_stages=2, schedule="ZB-H1")
+    kinds = {j.type() for j in plan.job_list()}
+    assert kinds == {"forward", "backward_b", "backward_w", "optimizer"}
+    FleetExecutor(plan).run()
+    assert log.count("F") == 4 and log.count("B") == 4 and log.count("W") == 4
