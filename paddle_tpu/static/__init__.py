@@ -1,22 +1,58 @@
-"""paddle_tpu.static — compatibility shims.
+"""paddle_tpu.static — static-graph mode over the eager tape.
 
-The reference's static-graph mode (Program/Executor,
-`python/paddle/static/`) is replaced wholesale by jax.jit tracing
-(paddle_tpu.jit.to_static); see SURVEY.md §7 design stance. This module
-keeps the commonly-scripted entry points as thin adapters so reference
-scripts import cleanly.
+Parity: reference `python/paddle/static/` — `paddle.static.data`
+placeholders, `Program`/`program_guard`, `Executor.run(feed, fetch_list)`
+(`base/executor.py:1234` -> StandaloneExecutor). The heavyweight machinery
+(ProgramDesc, PIR lowering, interpreter) is replaced by XLA per SURVEY.md
+§7; what this module KEEPS working is the scripting pattern:
+
+    x = paddle.static.data("x", [None, 8])
+    y = net(x)                       # ops record on the tape as usual
+    exe = paddle.static.Executor()
+    out, = exe.run(feed={"x": batch}, fetch_list=[y])
+
+TPU-native: every taped GradNode carries its array-level forward closure,
+so the recorded graph IS a re-runnable program — `Executor.run` walks the
+producer DAG of the fetches in forward-topological order, substituting
+feed values at the `data` placeholders. The replay is jitted and cached
+per (fetch set, feed shapes), playing the StandaloneExecutor role.
 """
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..core.tensor import Tensor
 from ..jit.api import InputSpec  # noqa: F401
 
 __all__ = ["InputSpec", "Program", "program_guard", "default_main_program",
-           "default_startup_program"]
+           "default_startup_program", "data", "Executor", "enable_static",
+           "disable_static", "in_static_mode"]
+
+_static_mode = [False]
+
+
+def enable_static():
+    _static_mode[0] = True
+
+
+def disable_static():
+    _static_mode[0] = False
+
+
+def in_static_mode():
+    return _static_mode[0]
 
 
 class Program:
-    """Inert placeholder; compiled programs are XLA executables."""
+    """Records the data placeholders created under it; the op graph itself
+    lives on the tape (GradNode DAG)."""
 
     def __init__(self):
         self._is_start_up = False
+        self.placeholders: List[Tensor] = []
 
     def global_block(self):
         return self
@@ -27,6 +63,7 @@ class Program:
 
 _main = Program()
 _startup = Program()
+_current = [_main]
 
 
 def default_main_program():
@@ -39,10 +76,115 @@ def default_startup_program():
 
 class program_guard:
     def __init__(self, main_program=None, startup_program=None):
-        pass
+        self._prog = main_program or Program()
 
     def __enter__(self):
-        return self
+        _current.append(self._prog)
+        return self._prog
 
     def __exit__(self, *a):
+        _current.pop()
         return False
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Placeholder variable (parity: paddle.static.data). Returns a Tensor
+    of zeros with dynamic (None/-1) dims materialized as 1 — the value is
+    a tracing stand-in; Executor.run substitutes the feed."""
+    import jax.numpy as jnp
+    from ..core.dtype import convert_dtype
+    shp = tuple(1 if (s is None or s == -1) else int(s) for s in shape)
+    t = Tensor(jnp.zeros(shp, jnp.dtype(convert_dtype(dtype) or "float32")),
+               stop_gradient=False, name=name)
+    t._spec = None
+    _current[-1].placeholders.append(t)
+    return t
+
+
+def _forward_topo(fetch_tensors):
+    """Forward-topological order of GradNodes producing the fetches."""
+    order, visited = [], set()
+    stack = []
+    for t in fetch_tensors:
+        n = t._grad_node
+        if n is not None:
+            stack.append((n, False))
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        stack.append((node, True))
+        for t in node.inputs:
+            parent = t._grad_node
+            if parent is not None and id(parent) not in visited:
+                stack.append((parent, False))
+    return order  # leaves-first
+
+
+class Executor:
+    """Parity: paddle.static.Executor — replays the fetches' producer DAG
+    with feeds substituted, compiled per (fetches, feed shapes)."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def run(self, program=None, feed: Optional[Dict] = None,
+            fetch_list: Optional[List] = None, return_numpy=True):
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        prog = program if isinstance(program, Program) else _current[-1]
+        # resolve feed names onto placeholder tensors
+        by_name = {p.name: p for p in prog.placeholders}
+        feed_ts, feed_vals = [], []
+        for k, v in feed.items():
+            t = k if isinstance(k, Tensor) else by_name.get(k)
+            if t is None:
+                raise KeyError(f"feed {k!r} is not a static.data placeholder "
+                               f"of this program")
+            feed_ts.append(t)
+            feed_vals.append(np.asarray(v))
+
+        nodes = _forward_topo(fetch_list)
+        for n in nodes:
+            if n.fwd_closed is None:
+                raise RuntimeError(
+                    f"node {n.name} was released (backward already ran "
+                    "without retain_graph); rebuild the program")
+
+        key = (tuple(id(t) for t in fetch_list),
+               tuple((v.shape, str(v.dtype)) for v in feed_vals),
+               tuple(id(t) for t in feed_ts))
+        fn = self._cache.get(key)
+        if fn is None:
+            feed_ids = [id(t) for t in feed_ts]
+
+            def replay(vals):
+                produced = {}
+
+                def value(t):
+                    if id(t) in feed_ids:
+                        return vals[feed_ids.index(id(t))]
+                    node = t._grad_node
+                    if node is not None and (id(node), t._grad_out_idx) \
+                            in produced:
+                        return produced[(id(node), t._grad_out_idx)]
+                    return t._data
+
+                for node in nodes:
+                    outs = node.fwd_closed(*[value(t) for t in node.inputs])
+                    leaves = jax.tree_util.tree_leaves(outs)
+                    for i, o in enumerate(leaves):
+                        produced[(id(node), i)] = o
+                return [value(t) for t in fetch_list]
+
+            fn = jax.jit(replay)
+            self._cache[key] = fn
+        outs = fn(feed_vals)
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]

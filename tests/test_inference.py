@@ -94,3 +94,43 @@ def test_warmup_requires_static_shapes():
                              input_spec=[InputSpec([-1, 8], "float32", "x")])
     with pytest.raises(ValueError, match="static"):
         f.warmup()
+
+
+# -------------------------------------------------- static Executor replay
+def test_static_executor_replays_tape():
+    """paddle.static.data + Executor.run: the taped producer DAG replays
+    with feeds substituted (the StandaloneExecutor role over XLA)."""
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 16), paddle.nn.ReLU(),
+                               paddle.nn.Linear(16, 4))
+    x = paddle.static.data("x", [None, 8])
+    y = net(x)
+    z = (y * 2).sum(axis=-1)
+    exe = paddle.static.Executor()
+    batch = rng.randn(3, 8).astype(np.float32)
+    out_y, out_z = exe.run(feed={"x": batch}, fetch_list=[y, z])
+    ref = np.asarray(net(paddle.to_tensor(batch))._data)
+    np.testing.assert_allclose(out_y, ref, atol=1e-6)
+    np.testing.assert_allclose(out_z, (ref * 2).sum(-1), atol=1e-5)
+    # dynamic batch dim: a different size recompiles and runs
+    out5, = exe.run(feed={"x": np.zeros((5, 8), np.float32)},
+                    fetch_list=[y])
+    assert out5.shape == (5, 4)
+
+
+def test_static_executor_unknown_feed_raises():
+    x = paddle.static.data("inp", [2, 4])
+    y = x * 3
+    exe = paddle.static.Executor()
+    with pytest.raises(KeyError):
+        exe.run(feed={"nope": np.zeros((2, 4), np.float32)},
+                fetch_list=[y])
+
+
+def test_program_guard_scopes_placeholders():
+    from paddle_tpu.static import Program, program_guard
+    with program_guard(Program()) as prog:
+        a = paddle.static.data("a", [2, 2])
+    assert any(a is p for p in prog.placeholders)
+    from paddle_tpu.static import default_main_program
+    assert all(a is not p for p in default_main_program().placeholders)
