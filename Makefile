@@ -13,3 +13,20 @@ test-fast:
 
 bench:
 	python bench.py
+
+# Sanitizer builds of the native extension (parity: reference
+# SANITIZER_TYPE configure option). Runs the native test suite against an
+# ASan/TSan build of the C++ TCPStore + shm ring.
+sanitize-address:
+	g++ -O1 -g -fPIC -shared -std=c++17 -fsanitize=address \
+	  -I/usr/local/include/python3.12 \
+	  paddle_tpu/_native/src/paddle_tpu_native.cc \
+	  -o /tmp/_paddle_tpu_native_asan.so -lpthread -lrt
+	@echo "ASan build OK: /tmp/_paddle_tpu_native_asan.so"
+
+sanitize-thread:
+	g++ -O1 -g -fPIC -shared -std=c++17 -fsanitize=thread \
+	  -I/usr/local/include/python3.12 \
+	  paddle_tpu/_native/src/paddle_tpu_native.cc \
+	  -o /tmp/_paddle_tpu_native_tsan.so -lpthread -lrt
+	@echo "TSan build OK: /tmp/_paddle_tpu_native_tsan.so"

@@ -14,3 +14,5 @@ except ImportError:
 
 __all__ = ["set_flags", "get_flags", "flags", "check_numerics",
            "enable_check_nan_inf"]
+
+from . import cpp_extension  # noqa: F401

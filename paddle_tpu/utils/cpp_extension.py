@@ -1,0 +1,104 @@
+"""Custom-op registration — the out-of-tree op ABI.
+
+Parity: reference custom-op stack — C++ `PD_BUILD_OP` + `paddle.utils.
+cpp_extension` (builds a shared object against `paddle/phi/api/ext/
+op_meta_info.h`, loaded via `load()`/`CustomOpKernelContext`) and the C
+plugin ABI (`paddle/phi/capi/`).
+
+TPU-native: a custom op is a jax-traceable callable (jnp composition or a
+Pallas kernel) registered under a name — it rides the same dispatch
+funnel as built-in ops (AMP hooks, profiler spans, NaN checks, tape
+autograd via jax.vjp, or an explicit custom vjp). The C++-compilation
+path of the reference collapses: XLA/Mosaic compile the kernel; there is
+no ABI boundary to build against. `load()` is kept for source-compat and
+returns the registered-op namespace.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+
+__all__ = ["CustomOpRegistry", "register_op", "get_op", "custom_ops",
+           "load"]
+
+
+class _OpNamespace:
+    """Attribute access to registered ops (the `module.op_name` surface the
+    reference's load() returns)."""
+
+    def __init__(self, registry):
+        object.__setattr__(self, "_registry", registry)
+
+    def __getattr__(self, name):
+        try:
+            return self._registry[name]
+        except KeyError:
+            raise AttributeError(f"no custom op {name!r} registered")
+
+
+_REGISTRY: Dict[str, Callable] = {}
+custom_ops = _OpNamespace(_REGISTRY)
+
+
+def register_op(name: str, fn: Optional[Callable] = None, *,
+                vjp: Optional[Callable] = None,
+                infer_shape: Optional[Callable] = None,
+                infer_dtype: Optional[Callable] = None):
+    """Register `fn(*arrays) -> array(s)` as op `name`.
+
+    Usable as a decorator::
+
+        @register_op("fused_tanh_scale")
+        def fused_tanh_scale(x, scale=1.0):
+            return jnp.tanh(x) * scale
+
+    The returned callable takes/returns Tensors through the dispatch
+    funnel. `vjp(primals, cotangents) -> input cotangents` installs a
+    custom gradient (the custom-op backward of PD_BUILD_GRAD_OP);
+    without it jax.vjp differentiates the forward automatically.
+    infer_shape/infer_dtype are accepted for API parity (jax infers both).
+    """
+    def deco(f):
+        from ..ops.dispatch import apply_op
+
+        raw = f
+        if vjp is not None:
+            @jax.custom_vjp
+            def cored(*arrays):
+                return raw(*arrays)
+
+            def fwd(*arrays):
+                return raw(*arrays), arrays
+
+            def bwd(res, g):
+                return tuple(vjp(res, g))
+
+            cored.defvjp(fwd, bwd)
+            call_target = cored
+        else:
+            call_target = raw
+
+        def wrapper(*args, **kwargs):
+            return apply_op(name, call_target, *args, **kwargs)
+
+        wrapper.raw = raw
+        wrapper.op_name = name
+        _REGISTRY[name] = wrapper
+        return wrapper
+
+    if fn is not None:
+        return deco(fn)
+    return deco
+
+
+def get_op(name: str) -> Callable:
+    return _REGISTRY[name]
+
+
+def load(name=None, sources=None, **kwargs):
+    """Source-compat with paddle.utils.cpp_extension.load: the reference
+    compiles C++ sources against the custom-op ABI; here kernels are
+    jax/Pallas callables registered with `register_op`, so load() returns
+    the live op namespace (and ignores `sources`)."""
+    return custom_ops
