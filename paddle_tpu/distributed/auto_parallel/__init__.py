@@ -4,3 +4,5 @@ from .api import (  # noqa: F401
     shard_tensor, reshard, dtensor_from_local, dtensor_to_local, shard_layer,
     shard_optimizer, to_static, unshard_dtensor, DistAttr,
 )
+
+from . import spmd_rules  # noqa: F401

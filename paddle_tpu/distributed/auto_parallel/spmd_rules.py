@@ -1,0 +1,235 @@
+"""Per-op SPMD sharding-propagation rules.
+
+Parity: reference `paddle/phi/infermeta/spmd_rules/` (111 files, registry
+`rules.h`): each op declares how input shardings propagate to outputs
+(`MatmulInferSpmd`, elementwise, embedding, reduction, softmax, ...),
+consumed by the generated dist branch (InferSpmd -> reshard -> local
+kernel, `phi/api/generator/dist_api_gen.py:49-110`).
+
+TPU-native: GSPMD performs whole-program propagation inside XLA, so these
+rules are not on the execution path of every op. They exist as the
+queryable registry the reference exposes — used by shard_layer-style
+planners to choose placements ahead of compilation, by tests documenting
+expected propagation, and as explicit constraints (`apply_rule`) when
+GSPMD's choice should be pinned. Specs are `jax.sharding.PartitionSpec`s;
+`None` entries mean replicated along that dim; the reference's `Partial`
+state maps to GSPMD's implicit pending-reduction the rules mark in
+`partial_axes`.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["register_spmd_rule", "get_spmd_rule", "infer_spmd",
+           "SpmdResult"]
+
+_RULES: Dict[str, Callable] = {}
+
+
+class SpmdResult:
+    """(input specs as the rule demands them, output specs, axes whose
+    reduction is pending — the reference's Partial placements)."""
+
+    def __init__(self, in_specs, out_specs, partial_axes=()):
+        self.in_specs = list(in_specs)
+        self.out_specs = out_specs if isinstance(out_specs, list) \
+            else [out_specs]
+        self.partial_axes = tuple(partial_axes)
+
+    def __repr__(self):
+        return (f"SpmdResult(in={self.in_specs}, out={self.out_specs}, "
+                f"partial={self.partial_axes})")
+
+
+def register_spmd_rule(name):
+    def deco(fn):
+        for n in ([name] if isinstance(name, str) else name):
+            _RULES[n] = fn
+        return fn
+    return deco
+
+
+def get_spmd_rule(name: str) -> Callable:
+    """Parity: SpmdRuleFactory lookup (spmd_rules/rules.h); falls back to
+    the replicated rule like VariadicReplicatedInferSpmdDynamic."""
+    return _RULES.get(name, _replicated_rule)
+
+
+def infer_spmd(name: str, *in_specs, **attrs) -> SpmdResult:
+    return get_spmd_rule(name)(*in_specs, **attrs)
+
+
+def _ent(spec, i):
+    entries = tuple(spec) if spec is not None else ()
+    return entries[i] if i < len(entries) else None
+
+
+def _pad(spec, ndim):
+    entries = list(tuple(spec) if spec is not None else ())
+    entries += [None] * (ndim - len(entries))
+    return entries
+
+
+# ------------------------------------------------------------------ rules
+def _replicated_rule(*in_specs, **attrs):
+    """Fallback: everything replicated (spmd_rules replicated.cc)."""
+    return SpmdResult([P() for _ in in_specs], P())
+
+
+@register_spmd_rule(["add", "subtract", "multiply", "divide", "maximum",
+                     "minimum", "pow", "elementwise"])
+def elementwise_rule(*in_specs, **attrs):
+    """Broadcast elementwise: merge shardings dim-by-dim from the right;
+    conflicting meshes axes fall back to replicated on that dim
+    (spmd_rules elementwise.cc)."""
+    ndim = max((len(tuple(s) or ()) for s in in_specs), default=0)
+    out = []
+    for i in range(ndim):
+        picks = {e for s in in_specs
+                 for e in [_ent(s, len(tuple(s) or ()) - ndim + i)]
+                 if e is not None}
+        out.append(picks.pop() if len(picks) == 1 else None)
+    spec = P(*out)
+    return SpmdResult(list(in_specs), spec)
+
+
+@register_spmd_rule(["matmul", "mm", "bmm"])
+def matmul_rule(x_spec, y_spec, trans_x=False, trans_y=False, **attrs):
+    """MatmulInferSpmd (spmd_rules/matmul.h:25): batch dims merge, the
+    contracted dim's sharding induces a Partial output, row/col shardings
+    pass through."""
+    xs, ys = tuple(x_spec or ()), tuple(y_spec or ())
+    xm = xs[-2] if len(xs) >= 2 and not trans_x else \
+        (xs[-1] if trans_x and len(xs) >= 1 else None)
+    xk = xs[-1] if len(xs) >= 1 and not trans_x else \
+        (xs[-2] if trans_x and len(xs) >= 2 else None)
+    yk = ys[-2] if len(ys) >= 2 and not trans_y else \
+        (ys[-1] if trans_y and len(ys) >= 1 else None)
+    yn = ys[-1] if len(ys) >= 1 and not trans_y else \
+        (ys[-2] if trans_y and len(ys) >= 2 else None)
+    batch = list(xs[:-2]) if len(xs) > 2 else []
+    contracted = xk if xk is not None else yk
+    partial = (contracted,) if (xk is not None and xk == yk) else ()
+    out = P(*(batch + [xm, yn]))
+    return SpmdResult([x_spec, y_spec], out, partial_axes=partial)
+
+
+@register_spmd_rule(["embedding", "c_embedding"])
+def embedding_rule(ids_spec, weight_spec, **attrs):
+    """spmd_rules/embedding.cc: vocab-dim sharding yields a Partial output
+    (the vocab-parallel allreduce); ids sharding passes through."""
+    vocab_axis = _ent(weight_spec, 0)
+    emb_axis = _ent(weight_spec, 1)
+    out = P(*(list(tuple(ids_spec or ())) + [emb_axis]))
+    partial = (vocab_axis,) if vocab_axis is not None else ()
+    return SpmdResult([ids_spec, weight_spec], out, partial_axes=partial)
+
+
+@register_spmd_rule(["softmax", "log_softmax"])
+def softmax_rule(x_spec, axis=-1, **attrs):
+    """spmd_rules/softmax.cc: the softmax dim must be unsharded; all other
+    dims pass through."""
+    xs = list(tuple(x_spec or ()))
+    if xs:
+        xs[axis if axis >= 0 else len(xs) + axis] = None
+    spec = P(*xs)
+    return SpmdResult([spec], spec)
+
+
+@register_spmd_rule(["cross_entropy_with_softmax", "parallel_cross_entropy"])
+def cross_entropy_rule(logits_spec, label_spec, **attrs):
+    """spmd_rules/cross_entropy_with_softmax.cc: class-dim sharding is the
+    vocab-parallel case — loss output is Partial over that axis."""
+    cls_axis = _ent(logits_spec, len(tuple(logits_spec or ())) - 1)
+    out = P(*tuple(logits_spec or ())[:-1])
+    partial = (cls_axis,) if cls_axis is not None else ()
+    return SpmdResult([logits_spec, label_spec], out, partial_axes=partial)
+
+
+@register_spmd_rule(["layer_norm", "rms_norm"])
+def norm_rule(x_spec, *param_specs, **attrs):
+    """spmd_rules/layer_norm.cc: normalized (last) dim must be replicated;
+    leading dims pass through; params replicated."""
+    xs = _pad(x_spec, len(tuple(x_spec or ())))
+    if xs:
+        xs[-1] = None
+    spec = P(*xs)
+    return SpmdResult([spec] + [P() for _ in param_specs], spec)
+
+
+@register_spmd_rule(["reduction", "sum", "mean", "max", "min"])
+def reduction_rule(x_spec, axis=None, keepdim=False, **attrs):
+    """spmd_rules reduction: reducing a sharded dim yields Partial over
+    its axis; kept dims pass through."""
+    xs = list(tuple(x_spec or ()))
+    if axis is None:
+        axes = list(range(len(xs)))
+    else:
+        axes = [a if a >= 0 else len(xs) + a
+                for a in (axis if isinstance(axis, (list, tuple)) else [axis])]
+    partial = tuple(xs[a] for a in axes if a < len(xs) and xs[a] is not None)
+    out = []
+    for i, e in enumerate(xs):
+        if i in axes:
+            if keepdim:
+                out.append(None)
+        else:
+            out.append(e)
+    return SpmdResult([x_spec], P(*out), partial_axes=partial)
+
+
+@register_spmd_rule(["transpose", "t"])
+def transpose_rule(x_spec, perm=None, **attrs):
+    xs = list(tuple(x_spec or ()))
+    if perm is None:
+        perm = list(reversed(range(len(xs))))
+    out = [xs[p] if p < len(xs) else None for p in perm]
+    return SpmdResult([x_spec], P(*out))
+
+
+@register_spmd_rule(["concat", "stack"])
+def concat_rule(*in_specs, axis=0, **attrs):
+    """spmd_rules/concat.cc: the concat dim must be replicated; others
+    merge like elementwise."""
+    merged = elementwise_rule(*in_specs).out_specs[0]
+    out = list(tuple(merged or ()))
+    if out and axis < len(out):
+        out[axis] = None
+    spec = P(*out)
+    return SpmdResult(list(in_specs), spec)
+
+
+@register_spmd_rule(["split", "unbind"])
+def split_rule(x_spec, axis=0, **attrs):
+    xs = list(tuple(x_spec or ()))
+    if xs and axis < len(xs):
+        xs[axis] = None
+    spec = P(*xs)
+    return SpmdResult([spec], spec)
+
+
+@register_spmd_rule(["flash_attention", "sdpa"])
+def flash_attention_rule(q_spec, k_spec, v_spec, **attrs):
+    """spmd_rules/flash_attention.cc: batch and head dims propagate; the
+    sequence dim may stay sharded (context parallel); head_dim replicated."""
+    qs = _pad(q_spec, 4)
+    out = P(qs[0], qs[1], qs[2], None)
+    return SpmdResult([q_spec, k_spec, v_spec], out)
+
+
+@register_spmd_rule(["reshape", "flatten"])
+def reshape_rule(x_spec, **attrs):
+    """spmd_rules/reshape.cc via dim_trans: without the shape pair the
+    only always-safe propagation keeps the leading dim's sharding."""
+    lead = _ent(x_spec, 0)
+    return SpmdResult([x_spec], P(lead))
+
+
+@register_spmd_rule("default_data_parallel")
+def default_data_parallel_rule(*in_specs, mesh_axis="data", **attrs):
+    """spmd_rules/default_data_parallel.cc: batch dim sharded over the
+    data axis for every input/output."""
+    outs = [P(mesh_axis) for _ in in_specs]
+    return SpmdResult(outs, P(mesh_axis))
