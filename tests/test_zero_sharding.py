@@ -145,3 +145,34 @@ def test_shard_spec_for_no_double_placement():
     assert shard_spec_for((64, 64), 8, P("sharding", None)) is None
     assert shard_spec_for((6, 64), 8) == P(None, "sharding")
     assert shard_spec_for((6, 7), 8) is None
+
+
+def test_pp_tp_zero_composition():
+    """The hybrid axes compose: pipelined Llama (pp=2, interleave) + TP
+    (mp=2) + ZeRO-2 accumulator sharding, one training run converging on
+    the 8-device mesh."""
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.models.llama import LlamaForCausalLMPipe, llama_tiny
+    st = DistributedStrategy()
+    st.hybrid_configs = {"dp_degree": 1, "mp_degree": 2, "pp_degree": 2,
+                         "sharding_degree": 2, "sep_degree": 1}
+    fleet.init(is_collective=True, strategy=st)
+    paddle.seed(0)
+    cfg = llama_tiny(num_hidden_layers=4)
+    pipe = LlamaForCausalLMPipe(cfg, num_stages=2, num_microbatches=2,
+                                n_virtual=2)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=pipe.parameters())
+    model, sopt, _ = dist.sharding.group_sharded_parallel(pipe, opt, "os_g")
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (4, 16)))
+    losses = []
+    for _ in range(3):
+        loss = model(ids, labels=ids)
+        loss.backward()
+        sopt.step()
+        sopt.clear_grad()
+        losses.append(float(np.asarray(loss._data)))
+    assert losses[-1] < losses[0]
+    m1 = sopt._inner._accumulators["moment1"][0]
+    assert "sharding" in str(m1.sharding.spec)
+    fleet._hcg = None
