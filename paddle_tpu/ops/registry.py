@@ -1,0 +1,82 @@
+"""Enumerable op registry with dtype capability tables.
+
+Parity: reference op YAML registry (`paddle/phi/ops/yaml/ops.yaml`, 465
+ops + dtype tables per PD_REGISTER_KERNEL) — the single enumerable source
+the reference generates everything from. Here ops are plain functions in
+the `ops` modules; this registry enumerates them with category + dtype
+metadata so tooling (coverage audits, doc generation, dispatch
+inspection) has the same queryable surface.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple, Optional
+
+__all__ = ["OpInfo", "registry", "get_op_list", "lookup"]
+
+# default dtype capability sets (XLA lowers all of these on TPU; f64
+# executes but is emulated/slow — kept for numeric parity tests)
+_FLOAT = ("float32", "bfloat16", "float16", "float64")
+_ALL = _FLOAT + ("int32", "int64", "bool")
+_INT = ("int32", "int64")
+
+_CATEGORY_DTYPES = {
+    "math": _ALL,
+    "creation": _ALL,
+    "manipulation": _ALL,
+    "linalg": _FLOAT,
+    "logic": _ALL,
+    "search": _ALL,
+    "random": _FLOAT,
+    "extras": _ALL,
+}
+
+
+class OpInfo(NamedTuple):
+    name: str
+    category: str
+    fn: object
+    dtypes: tuple
+
+
+_cache: Optional[Dict[str, OpInfo]] = None
+
+
+def registry(refresh: bool = False) -> Dict[str, OpInfo]:
+    """name -> OpInfo for every exported op function."""
+    global _cache
+    if _cache is not None and not refresh:
+        return _cache
+    from . import creation, extras, linalg, logic, manipulation, math
+    from . import random as random_mod
+    from . import search
+    table: Dict[str, OpInfo] = {}
+    mods = [("math", math), ("creation", creation),
+            ("manipulation", manipulation), ("linalg", linalg),
+            ("logic", logic), ("search", search), ("random", random_mod),
+            ("extras", extras)]
+    for cat, mod in mods:
+        for name in getattr(mod, "__all__", []):
+            fn = getattr(mod, name, None)
+            if callable(fn):
+                table[name] = OpInfo(name, cat, fn, _CATEGORY_DTYPES[cat])
+    # custom ops registered at runtime join the table
+    try:
+        from ..utils.cpp_extension import _REGISTRY as custom
+        for name, fn in custom.items():
+            table.setdefault(name, OpInfo(name, "custom", fn, _ALL))
+    except Exception:
+        pass
+    _cache = table
+    return table
+
+
+def get_op_list(category: Optional[str] = None) -> List[str]:
+    """Sorted op names (optionally one category) — the ops.yaml
+    enumeration role."""
+    table = registry()
+    return sorted(n for n, info in table.items()
+                  if category is None or info.category == category)
+
+
+def lookup(name: str) -> Optional[OpInfo]:
+    return registry().get(name)

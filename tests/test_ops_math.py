@@ -262,3 +262,15 @@ def test_custom_op_registration():
     custom_ops.t_twice(x2).sum().backward()
     np.testing.assert_allclose(np.asarray(x2.grad._data), 3.0)
     assert load().t_twice is custom_ops.t_twice
+
+
+def test_op_registry_enumerable():
+    """Enumerable op registry with dtype tables (the ops.yaml role)."""
+    from paddle_tpu.ops.registry import get_op_list, lookup, registry
+    table = registry(refresh=True)
+    assert len(table) > 300, len(table)
+    assert "matmul" in table and "concat" in table and "topk" in table
+    info = lookup("matmul")
+    assert info.category == "linalg" and "bfloat16" in info.dtypes
+    assert "add" in get_op_list("math")
+    assert get_op_list() == sorted(get_op_list())
