@@ -15,6 +15,7 @@ from .meta_parallel import (  # noqa: F401
 from .hybrid_parallel_optimizer import HybridParallelOptimizer  # noqa: F401
 from . import mpu  # noqa: F401
 from . import elastic  # noqa: F401
+from . import sequence_parallel_utils  # noqa: F401
 from .mpu import (  # noqa: F401
     VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
     ParallelCrossEntropy, get_rng_state_tracker,

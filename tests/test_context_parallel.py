@@ -192,3 +192,49 @@ def test_context_parallel_attention_wrapper(mode):
     assert out.sharding.spec == P(None, "sep")
     ref = _ref_attention(q, k, v, True)
     np.testing.assert_allclose(np.asarray(out), ref, atol=2e-3, rtol=2e-3)
+
+
+# ----------------------------------------------------- Megatron-SP layers
+def test_megatron_sp_linears_match_plain_math():
+    """ColumnSequenceParallelLinear / RowSequenceParallelLinear (parity:
+    sequence_parallel_utils.py:427,562): sequence-sharded activations in
+    and out of the TP pair reproduce the unsharded math, with the output
+    actually sharded over ('data','sep')."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet import fleet, DistributedStrategy
+    from paddle_tpu.distributed.fleet.sequence_parallel_utils import (
+        ColumnSequenceParallelLinear, RowSequenceParallelLinear, all_gather,
+        scatter)
+
+    st = DistributedStrategy()
+    st.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 1,
+                         "sharding_degree": 1, "sep_degree": 2}
+    fleet.init(is_collective=True, strategy=st)
+    paddle.seed(0)
+
+    class Block(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.c = ColumnSequenceParallelLinear(16, 32, has_bias=True,
+                                                  gather_output=False)
+            self.r = RowSequenceParallelLinear(32, 16, has_bias=True,
+                                               input_is_parallel=True)
+
+        def forward(self, x):
+            return self.r(paddle.nn.functional.relu(self.c(x)))
+
+    blk = Block()
+    rng_ = np.random.RandomState(0)
+    x = paddle.to_tensor(rng_.randn(4, 8, 16).astype(np.float32),
+                         stop_gradient=False)
+    out = blk(scatter(x))
+    ref = np.maximum(np.asarray(x._data) @ np.asarray(blk.c.weight._data)
+                     + np.asarray(blk.c.bias._data), 0) \
+        @ np.asarray(blk.r.weight._data) + np.asarray(blk.r.bias._data)
+    np.testing.assert_allclose(np.asarray(out._data), ref, atol=1e-5)
+    assert "sep" in str(out._data.sharding.spec)
+    out.sum().backward()
+    assert blk.c.weight.grad is not None
+    np.testing.assert_allclose(np.asarray(all_gather(out)._data), ref,
+                               atol=1e-5)
+    fleet._hcg = None
