@@ -60,6 +60,9 @@ from . import audio  # noqa: F401,E402
 from . import text  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
+from . import regularizer  # noqa: F401,E402
+from .ops import linalg  # noqa: F401,E402
+from .hapi import callbacks  # noqa: F401,E402
 
 from .nn.layer.layers import Layer  # noqa: F401,E402
 from .hapi.model import Model  # noqa: F401,E402

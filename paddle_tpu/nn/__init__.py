@@ -2,6 +2,7 @@
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
 from . import quant  # noqa: F401
+from . import utils  # noqa: F401
 from .layer.layers import Layer  # noqa: F401
 from .layer.container import *  # noqa: F401,F403
 from .layer.common import *  # noqa: F401,F403

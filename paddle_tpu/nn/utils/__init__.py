@@ -1,0 +1,164 @@
+"""paddle.nn.utils — gradient clipping, weight/spectral norm, param vecs.
+
+Parity: reference `python/paddle/nn/utils/` — clip_grad_norm_ /
+clip_grad_value_ (clip_grad.py), weight_norm / remove_weight_norm
+(weight_norm_hook.py: reparameterize weight = g * v/||v||), spectral_norm
+(spectral_norm_hook.py: power-iteration largest singular value),
+parameters_to_vector / vector_to_parameters (transform_parameters.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = ["clip_grad_norm_", "clip_grad_value_", "parameters_to_vector",
+           "vector_to_parameters", "weight_norm", "remove_weight_norm",
+           "spectral_norm"]
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """Scale grads in place so the global norm <= max_norm; returns the
+    pre-clip total norm (parity: clip_grad.py clip_grad_norm_)."""
+    params = [parameters] if isinstance(parameters, Tensor) else \
+        [p for p in parameters]
+    grads = [p._grad_buffer for p in params if p._grad_buffer is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g.astype(jnp.float32)) ** norm_type)
+             for g in grads])) ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError("non-finite total gradient norm")
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in params:
+        if p._grad_buffer is not None:
+            p._grad_buffer = (p._grad_buffer.astype(jnp.float32)
+                              * scale).astype(p._grad_buffer.dtype)
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    params = [parameters] if isinstance(parameters, Tensor) else \
+        [p for p in parameters]
+    v = float(clip_value)
+    for p in params:
+        if p._grad_buffer is not None:
+            p._grad_buffer = jnp.clip(p._grad_buffer, -v, v)
+
+
+def parameters_to_vector(parameters, name=None):
+    arrs = [p._data.reshape(-1) for p in parameters]
+    return Tensor(jnp.concatenate(arrs))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    data = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    off = 0
+    for p in parameters:
+        n = int(np.prod(p.shape))
+        p._data = data[off:off + n].reshape(p._data.shape).astype(p.dtype)
+        off += n
+
+
+def _norm_except(v, dim):
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(v), axis=axes, keepdims=True))
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize `name` as g * v/||v|| recomputed every forward
+    (parity: weight_norm_hook.py). Registers `{name}_g` / `{name}_v`."""
+    from ...ops.dispatch import apply_op
+
+    w = getattr(layer, name)
+    dim = dim if dim is not None else 0
+    v0 = w._data
+    g0 = _norm_except(v0, dim)
+    layer.add_parameter(name + "_v", Tensor(v0, stop_gradient=False))
+    layer.add_parameter(name + "_g", Tensor(g0, stop_gradient=False))
+
+    def recompute(l, inputs):
+        gv = l._parameters[name + "_g"]
+        vv = l._parameters[name + "_v"]
+        w_new = apply_op(
+            "weight_norm",
+            lambda g, v: g * v / jnp.maximum(_norm_except(v, dim), 1e-12),
+            gv, vv)
+        cur = l._parameters.get(name)
+        if cur is not None:
+            cur._data = w_new._data
+            cur._grad_node = w_new._grad_node
+            cur._grad_out_idx = w_new._grad_out_idx
+            cur.stop_gradient = w_new.stop_gradient
+        return None
+
+    handle = layer.register_forward_pre_hook(recompute)
+    layer._weight_norm_handle = handle
+    layer._weight_norm_name = name
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Bake the current g*v/||v|| back into `name` and drop the hooks."""
+    gv = layer._parameters.pop(name + "_g")
+    vv = layer._parameters.pop(name + "_v")
+    dim_norm = _norm_except(vv._data, 0)
+    w = gv._data * vv._data / jnp.maximum(dim_norm, 1e-12)
+    layer._parameters[name] = Tensor(w, stop_gradient=False)
+    handle = getattr(layer, "_weight_norm_handle", None)
+    if handle is not None:
+        handle.remove()
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=0):
+    """Divide `name` by its largest singular value, estimated by power
+    iteration each forward (parity: spectral_norm_hook.py)."""
+    from ...ops.dispatch import apply_op
+
+    w = getattr(layer, name)
+    w2d = np.asarray(w._data).reshape(w.shape[dim], -1) if dim == 0 else \
+        np.moveaxis(np.asarray(w._data), dim, 0).reshape(w.shape[dim], -1)
+    rng = np.random.RandomState(0)
+    u = rng.randn(w2d.shape[0]).astype(np.float32)
+    layer.register_buffer(name + "_u",
+                          Tensor(jnp.asarray(u / np.linalg.norm(u))),
+                          persistable=False)
+    layer.add_parameter(name + "_orig", Tensor(w._data, stop_gradient=False))
+
+    def recompute(l, inputs):
+        orig = l._parameters[name + "_orig"]
+        u_t = l._buffers[name + "_u"]
+
+        def _sn(wa, ua):
+            mat = jnp.moveaxis(wa, dim, 0).reshape(wa.shape[dim], -1)
+            u_ = ua
+            for _ in range(n_power_iterations):
+                v_ = mat.T @ u_
+                v_ = v_ / jnp.maximum(jnp.linalg.norm(v_), eps)
+                u_ = mat @ v_
+                u_ = u_ / jnp.maximum(jnp.linalg.norm(u_), eps)
+            sigma = u_ @ (mat @ v_)
+            return wa / jnp.maximum(sigma, eps), jax.lax.stop_gradient(u_)
+
+        w_new = apply_op("spectral_norm",
+                         lambda wa: _sn(wa, u_t._data)[0], orig)
+        u_t._data = _sn(jax.lax.stop_gradient(orig._data), u_t._data)[1]
+        cur = l._parameters.get(name)
+        if cur is not None:
+            cur._data = w_new._data
+            cur._grad_node = w_new._grad_node
+            cur._grad_out_idx = w_new._grad_out_idx
+            cur.stop_gradient = w_new.stop_gradient
+        return None
+
+    layer.register_forward_pre_hook(recompute)
+    return layer

@@ -119,9 +119,13 @@ class Optimizer:
         raise NotImplementedError
 
     def _decayed_grad(self, p, g):
-        """L2-regularizer-style decay (coupled; AdamW overrides w/ decoupled)."""
-        if isinstance(self._weight_decay, float) and self._weight_decay != 0.0:
-            return g + self._weight_decay * p._data.astype(g.dtype)
+        """L2-regularizer-style decay (coupled; AdamW overrides w/ decoupled).
+        Accepts paddle.regularizer objects (L1Decay adds coeff*sign(w))."""
+        wd = self._weight_decay
+        if isinstance(wd, float) and wd != 0.0:
+            return g + wd * p._data.astype(g.dtype)
+        if wd is not None and hasattr(wd, "apply"):
+            return wd.apply(p._data, g)
         return g
 
     def clear_grad(self, set_to_zero=True):

@@ -1,0 +1,41 @@
+"""paddle.regularizer — L1Decay / L2Decay.
+
+Parity: reference `python/paddle/regularizer.py`: regularizer objects
+passed as `weight_decay=` to optimizers (or per-param via ParamAttr);
+L2Decay folds into the gradient (coupled decay), L1Decay adds
+coeff * sign(w).
+"""
+from __future__ import annotations
+
+__all__ = ["L1Decay", "L2Decay", "WeightDecayRegularizer"]
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+    def __float__(self):
+        return self._coeff
+
+    def apply(self, param_array, grad_array):
+        raise NotImplementedError
+
+
+class L1Decay(WeightDecayRegularizer):
+    """grad += coeff * sign(w) (parity: regularizer.py L1Decay)."""
+
+    def apply(self, param_array, grad_array):
+        import jax.numpy as jnp
+        return grad_array + self._coeff * jnp.sign(
+            param_array.astype(grad_array.dtype))
+
+
+class L2Decay(WeightDecayRegularizer):
+    """grad += coeff * w (parity: regularizer.py L2Decay)."""
+
+    def apply(self, param_array, grad_array):
+        return grad_array + self._coeff * param_array.astype(grad_array.dtype)

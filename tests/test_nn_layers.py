@@ -302,3 +302,81 @@ def test_amp_operator_stats_collection():
     # collection is off outside the context
     net(x)
     assert operator_stats() == stats
+
+
+# ------------------------------------------- nn.utils / regularizer / linalg
+def test_namespaces_linalg_callbacks_regularizer():
+    assert hasattr(paddle.linalg, "norm") and hasattr(paddle.linalg, "svd")
+    assert hasattr(paddle, "callbacks")
+    from paddle_tpu.regularizer import L1Decay, L2Decay
+    assert float(L2Decay(0.1)) == 0.1
+    # L2Decay(c) == numeric weight_decay=c for SGD
+    ref_w = None
+    for wd in (0.1, L2Decay(0.1)):
+        paddle.seed(0)
+        net = paddle.nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(0.1, parameters=net.parameters(),
+                                   weight_decay=wd)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        (net(x) ** 2).sum().backward()
+        opt.step()
+        w = np.asarray(net.weight._data)
+        if ref_w is None:
+            ref_w = w
+        else:
+            np.testing.assert_allclose(w, ref_w, atol=1e-7)
+
+
+def test_clip_grad_norm_and_value():
+    import jax.numpy as jnp
+    from paddle_tpu.nn.utils import clip_grad_norm_, clip_grad_value_
+    net = paddle.nn.Linear(8, 8)
+    (net(paddle.randn([4, 8])) ** 2).sum().backward()
+    total = clip_grad_norm_(net.parameters(), max_norm=0.5)
+    assert float(total) > 0.5  # pre-clip norm was larger
+    gn = float(jnp.sqrt(sum(jnp.sum(p._grad_buffer ** 2)
+                            for p in net.parameters()
+                            if p._grad_buffer is not None)))
+    assert gn <= 0.51
+    clip_grad_value_(net.parameters(), 0.001)
+    for p in net.parameters():
+        if p._grad_buffer is not None:
+            assert float(jnp.max(jnp.abs(p._grad_buffer))) <= 0.001 + 1e-8
+
+
+def test_parameters_to_vector_roundtrip():
+    from paddle_tpu.nn.utils import (parameters_to_vector,
+                                     vector_to_parameters)
+    net = paddle.nn.Linear(4, 3)
+    vec = parameters_to_vector(net.parameters())
+    assert vec.shape == [4 * 3 + 3]
+    before = [np.asarray(p._data).copy() for p in net.parameters()]
+    vector_to_parameters(vec * 2, net.parameters())
+    for b, p in zip(before, net.parameters()):
+        np.testing.assert_allclose(np.asarray(p._data), b * 2, rtol=1e-6)
+
+
+def test_weight_norm_reparam():
+    from paddle_tpu.nn.utils import remove_weight_norm, weight_norm
+    paddle.seed(0)
+    lin = paddle.nn.Linear(6, 3)
+    x = paddle.to_tensor(np.ones((2, 6), np.float32))
+    ref = np.asarray(lin(x)._data)
+    weight_norm(lin)
+    np.testing.assert_allclose(np.asarray(lin(x)._data), ref, atol=1e-5)
+    lin(paddle.randn([2, 6])).sum().backward()
+    assert lin._parameters["weight_g"].grad is not None
+    assert lin._parameters["weight_v"].grad is not None
+    remove_weight_norm(lin)
+    np.testing.assert_allclose(np.asarray(lin(x)._data), ref, atol=1e-5)
+
+
+def test_spectral_norm_bounds_sigma():
+    from paddle_tpu.nn.utils import spectral_norm
+    paddle.seed(0)
+    lin = paddle.nn.Linear(8, 8)
+    spectral_norm(lin, n_power_iterations=5)
+    for _ in range(3):
+        lin(paddle.randn([2, 8]))
+    sv = np.linalg.svd(np.asarray(lin.weight._data), compute_uv=False)[0]
+    assert sv < 1.1
