@@ -5,6 +5,11 @@ clip_grad_value_ (clip_grad.py), weight_norm / remove_weight_norm
 (weight_norm_hook.py: reparameterize weight = g * v/||v||), spectral_norm
 (spectral_norm_hook.py: power-iteration largest singular value),
 parameters_to_vector / vector_to_parameters (transform_parameters.py).
+
+Like the reference hooks, the reparameterized `weight` is REMOVED from the
+parameter list (it becomes a non-persistable buffer recomputed by a
+forward pre-hook), so optimizers and state_dicts see only weight_g /
+weight_v (resp. weight_orig).
 """
 from __future__ import annotations
 
@@ -13,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...core.tensor import Tensor
+from ...ops.dispatch import apply_op
 
 __all__ = ["clip_grad_norm_", "clip_grad_value_", "parameters_to_vector",
            "vector_to_parameters", "weight_norm", "remove_weight_norm",
@@ -54,8 +60,12 @@ def clip_grad_value_(parameters, clip_value):
 
 
 def parameters_to_vector(parameters, name=None):
-    arrs = [p._data.reshape(-1) for p in parameters]
-    return Tensor(jnp.concatenate(arrs))
+    """Concatenate flattened params — on the tape, so gradients flow back
+    to the source parameters."""
+    params = list(parameters)
+    return apply_op(
+        "parameters_to_vector",
+        lambda xs: jnp.concatenate([x.reshape(-1) for x in xs]), params)
 
 
 def vector_to_parameters(vec, parameters, name=None):
@@ -68,21 +78,31 @@ def vector_to_parameters(vec, parameters, name=None):
 
 
 def _norm_except(v, dim):
+    if dim is None:
+        return jnp.sqrt(jnp.sum(jnp.square(v)))  # scalar g (whole tensor)
     axes = tuple(i for i in range(v.ndim) if i != dim)
     return jnp.sqrt(jnp.sum(jnp.square(v), axis=axes, keepdims=True))
 
 
+def _demote_to_buffer(layer, name, value):
+    """Drop `name` from the parameter list and keep it as a recomputed
+    non-persistable buffer (the reference hooks delete the attribute)."""
+    layer._parameters.pop(name, None)
+    layer.register_buffer(name, value, persistable=False)
+    return layer._buffers[name]
+
+
 def weight_norm(layer, name="weight", dim=0):
     """Reparameterize `name` as g * v/||v|| recomputed every forward
-    (parity: weight_norm_hook.py). Registers `{name}_g` / `{name}_v`."""
-    from ...ops.dispatch import apply_op
-
+    (parity: weight_norm_hook.py). Registers `{name}_g` / `{name}_v` and
+    removes `name` from the parameter list. dim=None yields one scalar g
+    over the whole tensor (reference semantics)."""
     w = getattr(layer, name)
-    dim = dim if dim is not None else 0
     v0 = w._data
     g0 = _norm_except(v0, dim)
     layer.add_parameter(name + "_v", Tensor(v0, stop_gradient=False))
     layer.add_parameter(name + "_g", Tensor(g0, stop_gradient=False))
+    buf = _demote_to_buffer(layer, name, Tensor(v0))
 
     def recompute(l, inputs):
         gv = l._parameters[name + "_g"]
@@ -91,27 +111,28 @@ def weight_norm(layer, name="weight", dim=0):
             "weight_norm",
             lambda g, v: g * v / jnp.maximum(_norm_except(v, dim), 1e-12),
             gv, vv)
-        cur = l._parameters.get(name)
-        if cur is not None:
-            cur._data = w_new._data
-            cur._grad_node = w_new._grad_node
-            cur._grad_out_idx = w_new._grad_out_idx
-            cur.stop_gradient = w_new.stop_gradient
+        cur = l._buffers[name]
+        cur._data = w_new._data
+        cur._grad_node = w_new._grad_node
+        cur._grad_out_idx = w_new._grad_out_idx
+        cur.stop_gradient = w_new.stop_gradient
         return None
 
     handle = layer.register_forward_pre_hook(recompute)
     layer._weight_norm_handle = handle
-    layer._weight_norm_name = name
+    layer._weight_norm_dim = dim
     return layer
 
 
 def remove_weight_norm(layer, name="weight"):
-    """Bake the current g*v/||v|| back into `name` and drop the hooks."""
+    """Bake the current g*v/||v|| back into `name` (as a parameter again)
+    and drop the hook. Uses the dim weight_norm was created with."""
+    dim = getattr(layer, "_weight_norm_dim", 0)
     gv = layer._parameters.pop(name + "_g")
     vv = layer._parameters.pop(name + "_v")
-    dim_norm = _norm_except(vv._data, 0)
-    w = gv._data * vv._data / jnp.maximum(dim_norm, 1e-12)
-    layer._parameters[name] = Tensor(w, stop_gradient=False)
+    w = gv._data * vv._data / jnp.maximum(_norm_except(vv._data, dim), 1e-12)
+    layer._buffers.pop(name, None)
+    layer.add_parameter(name, Tensor(w, stop_gradient=False))
     handle = getattr(layer, "_weight_norm_handle", None)
     if handle is not None:
         handle.remove()
@@ -121,43 +142,46 @@ def remove_weight_norm(layer, name="weight"):
 def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
                   dim=0):
     """Divide `name` by its largest singular value, estimated by power
-    iteration each forward (parity: spectral_norm_hook.py)."""
-    from ...ops.dispatch import apply_op
-
+    iteration each forward (parity: spectral_norm_hook.py). `name` leaves
+    the parameter list; `{name}_orig` is the trainable parameter."""
     w = getattr(layer, name)
-    w2d = np.asarray(w._data).reshape(w.shape[dim], -1) if dim == 0 else \
-        np.moveaxis(np.asarray(w._data), dim, 0).reshape(w.shape[dim], -1)
+    rows = w.shape[dim]
     rng = np.random.RandomState(0)
-    u = rng.randn(w2d.shape[0]).astype(np.float32)
+    u0 = rng.randn(rows).astype(np.float32)
     layer.register_buffer(name + "_u",
-                          Tensor(jnp.asarray(u / np.linalg.norm(u))),
+                          Tensor(jnp.asarray(u0 / np.linalg.norm(u0))),
                           persistable=False)
     layer.add_parameter(name + "_orig", Tensor(w._data, stop_gradient=False))
+    _demote_to_buffer(layer, name, Tensor(w._data))
+    iters = max(int(n_power_iterations), 1)  # 0 iterations: still one
+    # matvec pair so v is defined (the buffers carry u across forwards)
 
     def recompute(l, inputs):
         orig = l._parameters[name + "_orig"]
         u_t = l._buffers[name + "_u"]
+        # ONE power-iteration evaluation per forward: update u eagerly
+        # (stop-gradient), then the taped op only normalizes by sigma
+        mat = jnp.moveaxis(jax.lax.stop_gradient(orig._data),
+                           dim, 0).reshape(rows, -1)
+        u_ = u_t._data
+        for _ in range(iters):
+            v_ = mat.T @ u_
+            v_ = v_ / jnp.maximum(jnp.linalg.norm(v_), eps)
+            u_ = mat @ v_
+            u_ = u_ / jnp.maximum(jnp.linalg.norm(u_), eps)
+        u_t._data = u_
 
-        def _sn(wa, ua):
-            mat = jnp.moveaxis(wa, dim, 0).reshape(wa.shape[dim], -1)
-            u_ = ua
-            for _ in range(n_power_iterations):
-                v_ = mat.T @ u_
-                v_ = v_ / jnp.maximum(jnp.linalg.norm(v_), eps)
-                u_ = mat @ v_
-                u_ = u_ / jnp.maximum(jnp.linalg.norm(u_), eps)
-            sigma = u_ @ (mat @ v_)
-            return wa / jnp.maximum(sigma, eps), jax.lax.stop_gradient(u_)
+        def _normalize(wa):
+            m = jnp.moveaxis(wa, dim, 0).reshape(rows, -1)
+            sigma = u_ @ (m @ v_)
+            return wa / jnp.maximum(sigma, eps)
 
-        w_new = apply_op("spectral_norm",
-                         lambda wa: _sn(wa, u_t._data)[0], orig)
-        u_t._data = _sn(jax.lax.stop_gradient(orig._data), u_t._data)[1]
-        cur = l._parameters.get(name)
-        if cur is not None:
-            cur._data = w_new._data
-            cur._grad_node = w_new._grad_node
-            cur._grad_out_idx = w_new._grad_out_idx
-            cur.stop_gradient = w_new.stop_gradient
+        w_new = apply_op("spectral_norm", _normalize, orig)
+        cur = l._buffers[name]
+        cur._data = w_new._data
+        cur._grad_node = w_new._grad_node
+        cur._grad_out_idx = w_new._grad_out_idx
+        cur.stop_gradient = w_new.stop_gradient
         return None
 
     layer.register_forward_pre_hook(recompute)

@@ -262,6 +262,15 @@ class AdamW(Adam):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          None, grad_clip, lazy_mode, multi_precision,
                          name=name, amsgrad=amsgrad)
+        from ..regularizer import L1Decay, L2Decay
+        if isinstance(weight_decay, L1Decay):
+            # parity: reference AdamW rejects regularizer objects — a
+            # silent float() would turn L1 into decoupled L2 decay
+            raise TypeError(
+                "AdamW applies decoupled L2 decay; L1Decay is not "
+                "supported (use Adam with weight_decay=L1Decay(...))")
+        if isinstance(weight_decay, L2Decay):
+            weight_decay = weight_decay.coeff
         self._wd = float(weight_decay) if weight_decay else 0.0
         self._apply_decay_fn = apply_decay_param_fun
         self._lr_ratio = lr_ratio

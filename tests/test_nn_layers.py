@@ -363,12 +363,41 @@ def test_weight_norm_reparam():
     x = paddle.to_tensor(np.ones((2, 6), np.float32))
     ref = np.asarray(lin(x)._data)
     weight_norm(lin)
+    # reference hook semantics: weight leaves the parameter list
+    names = [n for n, _ in lin.named_parameters()]
+    assert "weight" not in names
+    assert "weight_g" in names and "weight_v" in names
     np.testing.assert_allclose(np.asarray(lin(x)._data), ref, atol=1e-5)
     lin(paddle.randn([2, 6])).sum().backward()
     assert lin._parameters["weight_g"].grad is not None
     assert lin._parameters["weight_v"].grad is not None
     remove_weight_norm(lin)
+    assert "weight" in [n for n, _ in lin.named_parameters()]
     np.testing.assert_allclose(np.asarray(lin(x)._data), ref, atol=1e-5)
+
+
+def test_weight_norm_dim_none_scalar_g():
+    from paddle_tpu.nn.utils import remove_weight_norm, weight_norm
+    paddle.seed(0)
+    lin = paddle.nn.Linear(6, 3)
+    x = paddle.to_tensor(np.ones((2, 6), np.float32))
+    ref = np.asarray(lin(x)._data)
+    weight_norm(lin, dim=None)
+    assert lin._parameters["weight_g"].shape == []  # one scalar g
+    np.testing.assert_allclose(np.asarray(lin(x)._data), ref, atol=1e-5)
+    remove_weight_norm(lin)
+    np.testing.assert_allclose(np.asarray(lin(x)._data), ref, atol=1e-5)
+
+
+def test_weight_norm_dim1_removal_consistent():
+    from paddle_tpu.nn.utils import remove_weight_norm, weight_norm
+    paddle.seed(0)
+    lin = paddle.nn.Linear(6, 3)
+    x = paddle.to_tensor(np.ones((2, 6), np.float32))
+    weight_norm(lin, dim=1)
+    mid = np.asarray(lin(x)._data)
+    remove_weight_norm(lin)   # must bake with the SAME dim
+    np.testing.assert_allclose(np.asarray(lin(x)._data), mid, atol=1e-5)
 
 
 def test_spectral_norm_bounds_sigma():
@@ -376,7 +405,32 @@ def test_spectral_norm_bounds_sigma():
     paddle.seed(0)
     lin = paddle.nn.Linear(8, 8)
     spectral_norm(lin, n_power_iterations=5)
+    names = [n for n, _ in lin.named_parameters()]
+    assert "weight" not in names and "weight_orig" in names
     for _ in range(3):
         lin(paddle.randn([2, 8]))
     sv = np.linalg.svd(np.asarray(lin.weight._data), compute_uv=False)[0]
     assert sv < 1.1
+    # n_power_iterations=0 must not crash (buffers carry u)
+    lin0 = paddle.nn.Linear(4, 4)
+    spectral_norm(lin0, n_power_iterations=0)
+    lin0(paddle.randn([2, 4]))
+
+
+def test_parameters_to_vector_differentiable():
+    from paddle_tpu.nn.utils import parameters_to_vector
+    lin = paddle.nn.Linear(3, 2)
+    vec = parameters_to_vector(lin.parameters())
+    (vec ** 2).sum().backward()
+    assert lin.weight.grad is not None and lin.bias.grad is not None
+
+
+def test_adamw_rejects_l1decay():
+    from paddle_tpu.regularizer import L1Decay, L2Decay
+    net = paddle.nn.Linear(4, 4)
+    with pytest.raises(TypeError, match="L1Decay"):
+        paddle.optimizer.AdamW(1e-3, parameters=net.parameters(),
+                               weight_decay=L1Decay(0.01))
+    opt = paddle.optimizer.AdamW(1e-3, parameters=net.parameters(),
+                                 weight_decay=L2Decay(0.01))
+    assert opt._wd == 0.01
