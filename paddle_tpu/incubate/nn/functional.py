@@ -25,7 +25,8 @@ __all__ = ["fused_multi_head_attention", "fused_feedforward",
            "fused_rms_norm", "fused_layer_norm",
            "fused_rotary_position_embedding", "fused_dropout_add", "swiglu",
            "fused_bias_act", "fused_linear", "fused_linear_activation",
-           "softmax_mask_fuse_upper_triangle"]
+           "softmax_mask_fuse_upper_triangle",
+           "masked_multihead_attention", "block_multihead_attention"]
 
 
 def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
@@ -291,3 +292,150 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
         if t is not None:
             args.append(t)
     return apply_op("fused_feedforward", _f, *args)
+
+
+def _apply_decode_rope(t, cos, sin, neox):
+    """Rotary embedding for one decode step. neox=True rotates the two
+    head-dim halves (rotate-half); neox=False rotates adjacent (even, odd)
+    pairs — the reference kernel branches the same way on
+    `neox_rotary_style` (masked_multihead_attention_kernel.cu:247) and
+    models/llama.py uses the pair convention."""
+    if neox:
+        h1, h2 = jnp.split(t, 2, axis=-1)
+        rot = jnp.concatenate([-h2, h1], axis=-1)
+    else:
+        even = t[..., 0::2]
+        odd = t[..., 1::2]
+        rot = jnp.stack([-odd, even], axis=-1).reshape(t.shape)
+    return t * cos + rot * sin
+
+
+def masked_multihead_attention(x, cache_kv, src_mask=None,
+                               sequence_lengths=None, rotary_tensor=None,
+                               beam_cache_offset=None, qkv_out_scale=None,
+                               out_shift=None, out_smooth=None, seq_len=1,
+                               rotary_emb_dims=0, use_neox_rotary_style=False,
+                               compute_dtype="default", out_scale=-1.0,
+                               quant_round_type=1, quant_max_bound=127.0,
+                               quant_min_bound=-127.0, name=None):
+    """Single-token decode attention over a contiguous KV cache.
+
+    Parity: reference `masked_multihead_attention`
+    (`phi/kernels/fusion/gpu/masked_multihead_attention_kernel.cu`, python
+    `incubate/nn/functional/masked_multihead_attention.py`). Supported
+    subset: fused qkv input x (B, (H + 2*KVH) * D), cache_kv
+    (2, B, KVH, max_seq, D), sequence_lengths (B,) = number of cached
+    tokens (the current token is appended at that position). Quant
+    shift/smooth args are accepted for API parity but must be None.
+
+    TPU-native: the cache append is one dynamic_update_slice and the
+    attention a masked einsum — decode is HBM-bandwidth-bound and XLA
+    already emits a single fused pass over the live cache; the paged
+    Pallas kernel (block_multihead_attention) is the scalable path.
+    Returns (out (B, H*D), updated cache_kv).
+    """
+    if any(a is not None for a in (qkv_out_scale, out_shift, out_smooth,
+                                   beam_cache_offset)) \
+            or out_scale > 0 or compute_dtype != "default":
+        raise NotImplementedError(
+            "quant/beam args of masked_multihead_attention not supported")
+
+    def _f(xv, cache, *rest):
+        rest = list(rest)
+        lens = rest.pop(0) if sequence_lengths is not None else None
+        rot = rest.pop(0) if rotary_tensor is not None else None
+        mask = rest.pop(0) if src_mask is not None else None
+        _, B, KVH, S, D = cache.shape
+        H = xv.shape[1] // D - 2 * KVH
+        q, knew, vnew = jnp.split(
+            xv.reshape(B, H + 2 * KVH, D), [H, H + KVH], axis=1)
+        if lens is None:
+            lens = jnp.zeros((B,), jnp.int32)
+        lens = lens.astype(jnp.int32).reshape(B)
+        if rot is not None and rotary_emb_dims:
+            # rot: (2, B, 1, S, D) cos/sin at absolute positions
+            cos = jnp.take_along_axis(
+                rot[0].reshape(B, S, D), lens[:, None, None], axis=1)
+            sin = jnp.take_along_axis(
+                rot[1].reshape(B, S, D), lens[:, None, None], axis=1)
+
+            q = _apply_decode_rope(q, cos, sin, use_neox_rotary_style)
+            knew = _apply_decode_rope(knew, cos, sin, use_neox_rotary_style)
+        # append this step's K/V at position lens (per sequence)
+        bidx = jnp.arange(B)
+        kc = cache[0].at[bidx, :, lens].set(knew.astype(cache.dtype))
+        vc = cache[1].at[bidx, :, lens].set(vnew.astype(cache.dtype))
+        G = H // KVH
+        qg = q.reshape(B, KVH, G, D).astype(jnp.float32)
+        s = jnp.einsum("bhgd,bhsd->bhgs", qg, kc.astype(jnp.float32))
+        s = s / math.sqrt(D)
+        pos = jnp.arange(S)[None, None, None, :]
+        s = jnp.where(pos <= lens[:, None, None, None], s, -1e30)
+        if mask is not None:
+            s = s + mask.reshape(B, 1, 1, -1)[..., :S]
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhgs,bhsd->bhgd", p, vc.astype(jnp.float32))
+        out = o.reshape(B, H * D).astype(xv.dtype)
+        return out, jnp.stack([kc, vc], axis=0)
+
+    args = [x, cache_kv]
+    if sequence_lengths is not None:
+        args.append(sequence_lengths)
+    if rotary_tensor is not None:
+        args.append(rotary_tensor)
+    if src_mask is not None:
+        args.append(src_mask)
+    return apply_op("masked_multihead_attention", _f, *args)
+
+
+def block_multihead_attention(qkv, k_cache, v_cache, seq_lens, block_tables,
+                              num_heads=None, num_kv_heads=None,
+                              rope_cos=None, rope_sin=None,
+                              use_neox_rotary_style=False, name=None):
+    """One decode step of attention over a PAGED KV cache.
+
+    Parity: reference `block_multi_head_attention`
+    (`phi/kernels/fusion/gpu/block_multi_head_attention.cu`, python
+    `incubate/nn/functional/block_multihead_attention.py`) — the paged
+    serving path. Supported subset: decode steps (one new token per
+    sequence); prefill goes through `nn.functional.flash_attention` and
+    `paged_cache_write` per position.
+
+    qkv: (B, (H + 2*KVH) * D); k/v_cache: (num_pages, KVH, page_size, D)
+    in the Pallas kernel's page-major layout
+    (`kernels/paged_attention.py`); seq_lens (B,) = cached tokens before
+    this step; block_tables (B, max_pages) int32.
+    Returns (out (B, H*D), k_cache, v_cache).
+    """
+    from ...kernels.paged_attention import (paged_attention_decode,
+                                            paged_cache_write)
+
+    def _f(xv, kc, vc, lens, bt, *rest):
+        rest = list(rest)
+        cos = rest.pop(0) if rope_cos is not None else None
+        sin = rest.pop(0) if rope_sin is not None else None
+        _, KVH, _, D = kc.shape
+        B = xv.shape[0]
+        if num_kv_heads is not None and KVH != num_kv_heads:
+            raise ValueError(
+                f"cache has {KVH} kv heads, got num_kv_heads={num_kv_heads}")
+        H = xv.shape[1] // D - 2 * KVH
+        if num_heads is not None and H != num_heads:
+            raise ValueError(f"qkv width implies {H} heads, got {num_heads}")
+        q, knew, vnew = jnp.split(
+            xv.reshape(B, H + 2 * KVH, D), [H, H + KVH], axis=1)
+        if cos is not None:
+            c = cos.reshape(B, 1, D)
+            sn = sin.reshape(B, 1, D)
+            q = _apply_decode_rope(q, c, sn, use_neox_rotary_style)
+            knew = _apply_decode_rope(knew, c, sn, use_neox_rotary_style)
+        lens = lens.astype(jnp.int32).reshape(B)
+        kc, vc = paged_cache_write(kc, vc, knew, vnew, bt, lens)
+        out = paged_attention_decode(q.reshape(B, H, D), kc, vc, bt,
+                                     lens + 1)
+        return out.reshape(B, H * D), kc, vc
+
+    args = [qkv, k_cache, v_cache, seq_lens, block_tables]
+    if rope_cos is not None:
+        args += [rope_cos, rope_sin]
+    return apply_op("block_multihead_attention", _f, *args)

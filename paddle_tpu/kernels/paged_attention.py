@@ -1,0 +1,207 @@
+"""Pallas paged-KV-cache decode attention (TPU).
+
+Capability parity: the reference serving kernel pack —
+`block_multi_head_attention` (paged KV cache,
+`paddle/phi/kernels/fusion/gpu/block_multi_head_attention.cu` via
+`python/paddle/incubate/nn/functional/block_multihead_attention.py`) and
+`masked_multihead_attention` (decode MHA,
+`paddle/phi/kernels/fusion/gpu/masked_multihead_attention_kernel.cu`).
+Rebuilt as a native Pallas TPU kernel over a TPU-friendly page layout
+rather than a CUDA translation.
+
+Design:
+  * the KV cache lives in HBM as (num_pages, KVH, page_size, D) — page
+    major, so one page (all kv heads' slices for page_size tokens) is a
+    single contiguous DMA; pages are assigned to sequences through an
+    int32 block table;
+  * decode query (B, H, D) is viewed as (B, KVH, G, D) with G = H//KVH
+    grouped-query heads sharing one KV head;
+  * grid (B, max_pages) with the page dimension innermost: the block
+    table and per-sequence lengths ride scalar prefetch, the page index
+    map gathers `block_tables[b, i]` so Pallas streams exactly the
+    pages this sequence owns (double-buffered HBM->VMEM), one whole
+    page (all kv heads) per step;
+  * online softmax over pages with (G, 128) lane-broadcast running
+    stats; pages past ceil(len/page_size) skip all compute via pl.when;
+  * positions >= seq_len inside the last page are masked in-block.
+
+The kernel is bandwidth-bound (one pass over the live KV), which is the
+same regime the reference's CUDA kernel targets; MXU utilisation is
+irrelevant at decode G sizes.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import _interpret_mode
+
+__all__ = ["paged_attention_decode", "paged_cache_write", "alloc_paged_cache"]
+
+NEG_INF = np.float32(-1e30)
+_STATS_LANES = 128
+_I0 = np.int32(0)
+
+
+def _decode_kernel(bt_ref, sl_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_ref, m_ref, l_ref, *, sm_scale, page_size, npages,
+                   kvh):
+    """Grid (B, max_pages); one step streams the page for ALL kv heads
+    (kvh * page * D * 2 bytes per DMA — large enough that per-step grid
+    overhead amortizes; with one head per step the kernel measured
+    74 GB/s on v5e, folded it saturates HBM)."""
+    sm_scale = np.float32(sm_scale)
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+    sl = sl_ref[b]
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(i * page_size < sl)
+    def _step():
+        for h in range(kvh):                           # static unroll
+            q = q_ref[0, h].astype(jnp.float32)        # (G, D)
+            k = k_ref[0, h].astype(jnp.float32)        # (page, D)
+            v = v_ref[0, h].astype(jnp.float32)
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            s = s * sm_scale                           # (G, page)
+            G, P = s.shape
+            pos = i * page_size + jax.lax.broadcasted_iota(
+                jnp.int32, (G, P), 1)
+            s = jnp.where(pos < sl, s, NEG_INF)
+            m_prev = m_ref[h, :, :1]
+            l_prev = l_ref[h, :, :1]
+            m_cur = jnp.max(s, axis=1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - m_new))
+            alpha = jnp.where(m_prev <= NEG_INF / 2, 0.0,
+                              jnp.exp(m_prev - m_new))
+            l_ref[h] = jnp.broadcast_to(
+                l_prev * alpha + jnp.sum(p, axis=1, keepdims=True),
+                l_ref.shape[1:])
+            m_ref[h] = jnp.broadcast_to(m_new, m_ref.shape[1:])
+            acc_ref[h] = acc_ref[h] * alpha + jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+    @pl.when(i == npages - 1)
+    def _finalize():
+        for h in range(kvh):
+            l = jnp.maximum(l_ref[h, :, :1], np.float32(1e-30))
+            o_ref[0, h] = (acc_ref[h] / l).astype(o_ref.dtype)
+
+
+def paged_attention_decode(q, k_cache, v_cache, block_tables, seq_lens,
+                           sm_scale=None):
+    """One decode step of attention over a paged KV cache.
+
+    q:            (B, H, D) — current-step queries.
+    k/v_cache:    (num_pages, KVH, page_size, D).
+    block_tables: (B, max_pages) int32 — page ids per sequence, position
+                  j holds the page covering tokens [j*page_size,
+                  (j+1)*page_size); unused slots must hold a valid page
+                  id (0 is fine — masked out by seq_lens).
+    seq_lens:     (B,) int32 — live tokens per sequence (including the
+                  token being decoded).
+    Returns (B, H, D).
+    """
+    B, H, D = q.shape
+    num_pages, KVH, page_size, _ = k_cache.shape
+    max_pages = block_tables.shape[1]
+    if H % KVH != 0:
+        raise ValueError(f"H={H} not a multiple of KVH={KVH}")
+    G = H // KVH
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(D)
+    qg = q.reshape(B, KVH, G, D)
+    bt = block_tables.astype(jnp.int32)
+    sl = seq_lens.astype(jnp.int32)
+
+    kernel = functools.partial(_decode_kernel, sm_scale=float(sm_scale),
+                               page_size=page_size, npages=max_pages,
+                               kvh=KVH)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, KVH, G, D), lambda b, i, *_: (b, _I0, _I0, _I0)),
+            pl.BlockSpec((1, KVH, page_size, D),
+                         lambda b, i, bt, sl: (bt[b, i], _I0, _I0, _I0)),
+            pl.BlockSpec((1, KVH, page_size, D),
+                         lambda b, i, bt, sl: (bt[b, i], _I0, _I0, _I0)),
+        ],
+        out_specs=pl.BlockSpec((1, KVH, G, D),
+                               lambda b, i, *_: (b, _I0, _I0, _I0)),
+        scratch_shapes=[
+            pltpu.VMEM((KVH, G, D), jnp.float32),
+            pltpu.VMEM((KVH, G, _STATS_LANES), jnp.float32),
+            pltpu.VMEM((KVH, G, _STATS_LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KVH, G, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=_interpret_mode(),
+    )(bt, sl, qg, k_cache, v_cache)
+    return out.reshape(B, H, D)
+
+
+def alloc_paged_cache(num_kv_heads, num_pages, page_size, head_dim,
+                      dtype=jnp.bfloat16):
+    """Allocate an empty paged KV cache pair in the kernel's layout."""
+    shape = (num_pages, num_kv_heads, page_size, head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def paged_cache_write(k_cache, v_cache, k_new, v_new, block_tables,
+                      write_pos):
+    """Scatter one step's K/V into the paged cache.
+
+    k_new/v_new: (B, KVH, D) — the current token's key/value per head.
+    write_pos:   (B,) int32 — token index being written (seq_len - 1).
+    Returns the updated (k_cache, v_cache).
+
+    The scatter is a pure-XLA dynamic update (one token per sequence per
+    step — not a bandwidth problem); the read path is the Pallas kernel.
+    """
+    num_pages, KVH, page_size, D = k_cache.shape
+    B = k_new.shape[0]
+    pos = write_pos.astype(jnp.int32)
+    page_idx = jax.lax.div(pos, jnp.int32(page_size))
+    page_off = jax.lax.rem(pos, jnp.int32(page_size))
+    pages = jnp.take_along_axis(block_tables.astype(jnp.int32),
+                                page_idx[:, None], axis=1)[:, 0]   # (B,)
+    heads = jnp.arange(KVH, dtype=jnp.int32)
+    # scatter indices (B, KVH, 3) over cache dims (page, head, slot)
+    idx = jnp.stack([
+        jnp.broadcast_to(pages[:, None], (B, KVH)),
+        jnp.broadcast_to(heads[None, :], (B, KVH)),
+        jnp.broadcast_to(page_off[:, None], (B, KVH)),
+    ], axis=-1)
+    dnums = jax.lax.ScatterDimensionNumbers(
+        update_window_dims=(1,),
+        inserted_window_dims=(0, 1, 2),
+        scatter_dims_to_operand_dims=(0, 1, 2))
+    k_cache = jax.lax.scatter(
+        k_cache, idx.reshape(B * KVH, 3),
+        k_new.reshape(B * KVH, D).astype(k_cache.dtype), dnums,
+        indices_are_sorted=False, unique_indices=True)
+    v_cache = jax.lax.scatter(
+        v_cache, idx.reshape(B * KVH, 3),
+        v_new.reshape(B * KVH, D).astype(v_cache.dtype), dnums,
+        indices_are_sorted=False, unique_indices=True)
+    return k_cache, v_cache

@@ -16,7 +16,7 @@ from .dispatch import apply_op, def_op
 __all__ = [
     "argmax", "argmin", "argsort", "sort", "topk", "searchsorted", "nonzero",
     "kthvalue", "mode", "unique", "unique_consecutive", "index_sample",
-    "bucketize",
+    "bucketize", "top_p_sampling",
 ]
 
 
@@ -173,3 +173,62 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
 def index_sample(x, index):
     rows = jnp.arange(x.shape[0])[:, None]
     return x[rows, index]
+
+
+def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1, k=0,
+                   mode="truncated", return_top=False, name=None):
+    """Nucleus (top-p) sampling over per-row probability vectors.
+
+    Parity: `python/paddle/tensor/search.py:1363` (`phi` kernel
+    `top_p_sampling`). x: (B, V) probabilities; ps: (B,) per-row top-p.
+    Returns (values (B, 1), ids (B, 1) int64); with return_top also the
+    top-k (values, ids). TPU-native: a full descending sort + cumsum +
+    categorical draw — one fused XLA program, no host sync.
+    """
+    from ..framework.random import rng_key
+
+    probs = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    p_row = (ps._data if isinstance(ps, Tensor) else jnp.asarray(ps))
+    p_row = p_row.reshape(-1, 1).astype(jnp.float32)
+    B, V = probs.shape
+    pf = probs.astype(jnp.float32)
+    sorted_p, sorted_idx = jax.lax.top_k(pf, V)
+    cum = jnp.cumsum(sorted_p, axis=-1)
+    # keep the minimal prefix whose mass reaches ps (mass *before* the
+    # token < ps keeps the boundary token; top-1 always survives)
+    keep = (cum - sorted_p) < p_row
+    if threshold is not None:
+        th = (threshold._data if isinstance(threshold, Tensor)
+              else jnp.asarray(threshold)).reshape(-1, 1)
+        keep = jnp.logical_and(keep, sorted_p >= th.astype(jnp.float32))
+    keep = keep.at[:, 0].set(True)
+    # both modes sample within the (nucleus AND threshold) candidate set —
+    # the reference's non-truncated kernel also keeps that restriction and
+    # only changes the within-prefix sampling rule, which after
+    # normalization coincides with the truncated rule here
+    masked = jnp.where(keep, sorted_p, 0.0)
+    logits = jnp.log(jnp.maximum(masked, 1e-30))
+    logits = jnp.where(masked > 0, logits, -jnp.inf)
+    if seed is not None and int(seed) >= 0:
+        key = jax.random.PRNGKey(int(seed))
+    else:
+        key = rng_key()
+    if topp_seed is not None:
+        rows = (topp_seed._data if isinstance(topp_seed, Tensor)
+                else jnp.asarray(topp_seed)).reshape(-1)
+        keys = jax.vmap(lambda s: jax.random.fold_in(key, s))(
+            rows.astype(jnp.uint32))
+        pos = jax.vmap(lambda kk, lg: jax.random.categorical(kk, lg))(
+            keys, logits)
+    else:
+        pos = jax.random.categorical(key, logits, axis=-1)
+    pos = pos[:, None]
+    ids = jnp.take_along_axis(sorted_idx, pos, axis=1).astype(jnp.int64)
+    vals = jnp.take_along_axis(sorted_p, pos, axis=1).astype(probs.dtype)
+    out = (Tensor(vals), Tensor(ids))
+    if return_top:
+        kk = max(int(k), 1)
+        tv, ti = jax.lax.top_k(pf, kk)
+        return out + (Tensor(tv.astype(probs.dtype)),
+                      Tensor(ti.astype(jnp.int64)))
+    return out

@@ -1,0 +1,8 @@
+"""`paddle.tensor` namespace (reference `python/paddle/tensor/`): the
+functional tensor API as a module, aliasing the ops layer. Functions are
+also monkey-patched onto Tensor (ops/methods.py), matching the reference's
+dual module/method surface."""
+from .ops import *  # noqa: F401,F403
+from .ops import (creation, linalg, logic, manipulation, math,  # noqa: F401
+                  random, search)
+from .ops.search import top_p_sampling  # noqa: F401
