@@ -410,6 +410,9 @@ def block_multihead_attention(qkv, k_cache, v_cache, seq_lens, block_tables,
     from ...kernels.paged_attention import (paged_attention_decode,
                                             paged_cache_write)
 
+    if (rope_cos is None) != (rope_sin is None):
+        raise ValueError("rope_cos and rope_sin must be passed together")
+
     def _f(xv, kc, vc, lens, bt, *rest):
         rest = list(rest)
         cos = rest.pop(0) if rope_cos is not None else None

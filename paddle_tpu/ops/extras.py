@@ -254,25 +254,15 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
 
 
 # ----------------------------------------------------------------- search
-def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
-    """Nucleus sampling over the last axis (parity: phi top_p_sampling).
-    Returns (sampled values, sampled ids)."""
-    from ..framework.random import rng_key
-    key = rng_key() if seed is None else jax.random.key(seed)
-
-    def _f(logits, p):
-        probs = jax.nn.softmax(logits, axis=-1)
-        sort_idx = jnp.argsort(-probs, axis=-1)
-        sorted_probs = jnp.take_along_axis(probs, sort_idx, axis=-1)
-        cum = jnp.cumsum(sorted_probs, axis=-1)
-        keep = cum - sorted_probs <= p[..., None]
-        filt = jnp.where(keep, sorted_probs, 0.0)
-        filt = filt / jnp.maximum(filt.sum(-1, keepdims=True), 1e-9)
-        choice = jax.random.categorical(key, jnp.log(jnp.maximum(filt, 1e-30)))
-        ids = jnp.take_along_axis(sort_idx, choice[..., None], axis=-1)
-        vals = jnp.take_along_axis(probs, ids, axis=-1)
-        return vals, ids
-    return apply_op("top_p_sampling", _f, x, ps)
+def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1, k=0,
+                   mode="truncated", return_top=False, name=None):
+    """Nucleus sampling (parity: phi top_p_sampling). One implementation
+    lives in ops/search.py; this alias keeps the historical extras export
+    pointing at the same function so paddle.top_p_sampling ==
+    paddle.tensor.top_p_sampling."""
+    from .search import top_p_sampling as _impl
+    return _impl(x, ps, threshold=threshold, topp_seed=topp_seed, seed=seed,
+                 k=k, mode=mode, return_top=return_top, name=name)
 
 
 # ------------------------------------------------------------------- stat

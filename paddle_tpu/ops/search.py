@@ -182,53 +182,61 @@ def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1, k=0,
     Parity: `python/paddle/tensor/search.py:1363` (`phi` kernel
     `top_p_sampling`). x: (B, V) probabilities; ps: (B,) per-row top-p.
     Returns (values (B, 1), ids (B, 1) int64); with return_top also the
-    top-k (values, ids). TPU-native: a full descending sort + cumsum +
-    categorical draw — one fused XLA program, no host sync.
+    top-k (values, ids). Both modes sample within the (nucleus AND
+    threshold) candidate set — the reference's non-truncated kernel keeps
+    that restriction too and only changes the within-prefix sampling
+    rule, which after normalization coincides with the truncated rule.
+    TPU-native: a full descending sort + cumsum + categorical draw — one
+    fused XLA program, no host sync; dispatched through apply_op so the
+    profiler/NaN-check hooks see it.
     """
     from ..framework.random import rng_key
 
-    probs = x._data if isinstance(x, Tensor) else jnp.asarray(x)
-    p_row = (ps._data if isinstance(ps, Tensor) else jnp.asarray(ps))
-    p_row = p_row.reshape(-1, 1).astype(jnp.float32)
-    B, V = probs.shape
-    pf = probs.astype(jnp.float32)
-    sorted_p, sorted_idx = jax.lax.top_k(pf, V)
-    cum = jnp.cumsum(sorted_p, axis=-1)
-    # keep the minimal prefix whose mass reaches ps (mass *before* the
-    # token < ps keeps the boundary token; top-1 always survives)
-    keep = (cum - sorted_p) < p_row
-    if threshold is not None:
-        th = (threshold._data if isinstance(threshold, Tensor)
-              else jnp.asarray(threshold)).reshape(-1, 1)
-        keep = jnp.logical_and(keep, sorted_p >= th.astype(jnp.float32))
-    keep = keep.at[:, 0].set(True)
-    # both modes sample within the (nucleus AND threshold) candidate set —
-    # the reference's non-truncated kernel also keeps that restriction and
-    # only changes the within-prefix sampling rule, which after
-    # normalization coincides with the truncated rule here
-    masked = jnp.where(keep, sorted_p, 0.0)
-    logits = jnp.log(jnp.maximum(masked, 1e-30))
-    logits = jnp.where(masked > 0, logits, -jnp.inf)
     if seed is not None and int(seed) >= 0:
         key = jax.random.PRNGKey(int(seed))
     else:
         key = rng_key()
+    kk = max(int(k), 1)
+
+    def _f(probs, p_row, *rest):
+        rest = list(rest)
+        th = rest.pop(0) if threshold is not None else None
+        rows = rest.pop(0) if topp_seed is not None else None
+        B, V = probs.shape
+        pf = probs.astype(jnp.float32)
+        p_row = p_row.reshape(-1, 1).astype(jnp.float32)
+        sorted_p, sorted_idx = jax.lax.top_k(pf, V)
+        cum = jnp.cumsum(sorted_p, axis=-1)
+        # keep the minimal prefix whose mass reaches ps (mass *before*
+        # the token < ps keeps the boundary token; top-1 always survives)
+        keep = (cum - sorted_p) < p_row
+        if th is not None:
+            keep = jnp.logical_and(
+                keep, sorted_p >= th.reshape(-1, 1).astype(jnp.float32))
+        keep = keep.at[:, 0].set(True)
+        masked = jnp.where(keep, sorted_p, 0.0)
+        logits = jnp.log(jnp.maximum(masked, 1e-30))
+        logits = jnp.where(masked > 0, logits, -jnp.inf)
+        if rows is not None:
+            keys = jax.vmap(lambda s: jax.random.fold_in(key, s))(
+                rows.reshape(-1).astype(jnp.uint32))
+            pos = jax.vmap(lambda kr, lg: jax.random.categorical(kr, lg))(
+                keys, logits)
+        else:
+            pos = jax.random.categorical(key, logits, axis=-1)
+        pos = pos[:, None]
+        ids = jnp.take_along_axis(sorted_idx, pos, axis=1).astype(jnp.int64)
+        vals = jnp.take_along_axis(sorted_p, pos, axis=1).astype(probs.dtype)
+        if return_top:
+            # the full sort is already here — slice it instead of a
+            # second top_k pass
+            return (vals, ids, sorted_p[:, :kk].astype(probs.dtype),
+                    sorted_idx[:, :kk].astype(jnp.int64))
+        return vals, ids
+
+    args = [x, ps]
+    if threshold is not None:
+        args.append(threshold)
     if topp_seed is not None:
-        rows = (topp_seed._data if isinstance(topp_seed, Tensor)
-                else jnp.asarray(topp_seed)).reshape(-1)
-        keys = jax.vmap(lambda s: jax.random.fold_in(key, s))(
-            rows.astype(jnp.uint32))
-        pos = jax.vmap(lambda kk, lg: jax.random.categorical(kk, lg))(
-            keys, logits)
-    else:
-        pos = jax.random.categorical(key, logits, axis=-1)
-    pos = pos[:, None]
-    ids = jnp.take_along_axis(sorted_idx, pos, axis=1).astype(jnp.int64)
-    vals = jnp.take_along_axis(sorted_p, pos, axis=1).astype(probs.dtype)
-    out = (Tensor(vals), Tensor(ids))
-    if return_top:
-        kk = max(int(k), 1)
-        tv, ti = jax.lax.top_k(pf, kk)
-        return out + (Tensor(tv.astype(probs.dtype)),
-                      Tensor(ti.astype(jnp.int64)))
-    return out
+        args.append(topp_seed)
+    return apply_op("top_p_sampling", _f, *args)

@@ -1,27 +1,40 @@
-"""Sparse tensors (COO/CSR).
+"""Sparse tensors (COO/CSR) + the sparse op set.
 
 Parity: reference sparse stack — `phi::SparseCooTensor`/`SparseCsrTensor`
 (`paddle/phi/core/sparse_coo_tensor.h`), kernels in `paddle/phi/kernels/
-sparse/` (~60 interfaces), python API `python/paddle/sparse/`.
+sparse/` (~60 interfaces), python API `python/paddle/sparse/` (creation,
+unary.py, binary.py, multiary.py, nn/).
 
 TPU-native: backed by jax.experimental.sparse BCOO/BCSR — XLA lowers
 sparse matmul to gather+MXU matmul; elementwise unary ops run on the
 values buffer only (same trick the reference's sparse kernels use).
 Autograd: value buffers participate through apply_op like any dense op.
+Ops whose output sparsity pattern is data-dependent (conv, pooling,
+reshape/slice re-sparsification) are eager-only, the same restriction the
+reference's sparse kernels have under static shape inference.
 """
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import sparse as jsparse
 
 from ..core.tensor import Tensor
 from ..ops.dispatch import apply_op
 
-__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
-           "SparseCsrTensor", "is_same_shape", "matmul", "masked_matmul",
-           "add", "multiply", "relu", "sin", "tanh", "sqrt", "abs",
-           "to_dense", "to_sparse_coo", "nn"]
+__all__ = [
+    "sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+    "SparseCsrTensor", "is_same_shape", "matmul", "masked_matmul", "mv",
+    "addmm", "add", "subtract", "multiply", "divide", "mask_as",
+    "relu", "relu6", "leaky_relu", "sin", "tan", "asin", "atan", "sinh",
+    "asinh", "atanh", "tanh", "square", "sqrt", "log1p", "abs", "pow",
+    "neg", "expm1", "rad2deg", "deg2rad", "isnan", "cast", "coalesce",
+    "transpose", "reshape", "sum", "slice", "to_dense", "to_sparse_coo",
+    "nn",
+]
 
 
 class SparseCooTensor:
@@ -49,7 +62,11 @@ class SparseCooTensor:
         return Tensor(self._bcoo.indices.T)  # paddle layout (ndim, nnz)
 
     def values(self):
-        return Tensor(self._bcoo.data)
+        # the tape-connected values Tensor when this sparse tensor was
+        # produced by a differentiable op (autograd flows through values
+        # buffers, like the reference's sparse grad kernels)
+        vt = getattr(self, "_vals_t", None)
+        return vt if vt is not None else Tensor(self._bcoo.data)
 
     def to_dense(self):
         return Tensor(self._bcoo.todense())
@@ -91,7 +108,8 @@ class SparseCsrTensor:
         return Tensor(self._bcsr.indices)
 
     def values(self):
-        return Tensor(self._bcsr.data)
+        vt = getattr(self, "_vals_t", None)
+        return vt if vt is not None else Tensor(self._bcsr.data)
 
     def to_dense(self):
         return Tensor(self._bcsr.todense())
@@ -122,7 +140,10 @@ def sparse_coo_tensor(indices, values, shape=None, dtype=None,
     if shape is None:
         shape = tuple(int(i) + 1 for i in idx.max(axis=0))
     bcoo = jsparse.BCOO((val, idx), shape=tuple(shape))
-    return SparseCooTensor(bcoo)
+    out = SparseCooTensor(bcoo)
+    if isinstance(values, Tensor) and val is values._data:
+        out._vals_t = values                       # keep the tape link
+    return out
 
 
 def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
@@ -132,9 +153,17 @@ def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
     if dtype is not None:
         from ..core.dtype import convert_dtype
         val = val.astype(convert_dtype(dtype))
-    bcsr = jsparse.BCSR(
-        (val, _data(cols).astype(jnp.int32),
-         _data(crows).astype(jnp.int32)), shape=tuple(shape))
+    cr = _data(crows).astype(jnp.int32)
+    cl = _data(cols).astype(jnp.int32)
+    if len(shape) == 3 and cr.ndim == 1:
+        # batched CSR with reference-style flat buffers: crows is
+        # batch-concatenated (B*(rows+1),) — reshape to BCSR's batch form
+        B = int(shape[0])
+        cr = cr.reshape(B, int(shape[1]) + 1)
+        cl = cl.reshape(B, -1)
+        val = val.reshape(B, -1, *val.shape[1:]) if val.ndim == 1 \
+            else val.reshape(B, -1, *val.shape[2:])
+    bcsr = jsparse.BCSR((val, cl, cr), shape=tuple(shape))
     return SparseCsrTensor(bcsr)
 
 
@@ -153,33 +182,193 @@ def to_sparse_coo(x, sparse_dim=None):
     return SparseCooTensor(jsparse.BCOO.fromdense(d))
 
 
-# -- ops --------------------------------------------------------------------
+def _rebuild_coo(x: SparseCooTensor, new_vals, shape=None):
+    """new_vals: a Tensor (tape-connected) or raw array."""
+    vt = new_vals if isinstance(new_vals, Tensor) else None
+    arr = new_vals._data if vt is not None else new_vals
+    out = SparseCooTensor(jsparse.BCOO((arr, x._bcoo.indices),
+                                       shape=shape or x._bcoo.shape))
+    out._vals_t = vt
+    return out
+
+
+def _rebuild_csr(x: SparseCsrTensor, new_vals, shape=None):
+    vt = new_vals if isinstance(new_vals, Tensor) else None
+    arr = new_vals._data if vt is not None else new_vals
+    out = SparseCsrTensor(jsparse.BCSR(
+        (arr, x._bcsr.indices, x._bcsr.indptr),
+        shape=shape or x._bcsr.shape))
+    out._vals_t = vt
+    return out
+
+
+# -- unary ops (values-buffer only; parity: sparse/unary.py) ----------------
 
 def _unary_on_values(name, fn):
     """Elementwise op applied to the values buffer (zero-preserving ops
     only — the reference's sparse unary kernels share this contract)."""
     def op(x, name_arg=None):
         if isinstance(x, SparseCooTensor):
-            new_vals = apply_op(name, fn, Tensor(x._bcoo.data))
-            return SparseCooTensor(
-                jsparse.BCOO((new_vals._data, x._bcoo.indices),
-                             shape=x._bcoo.shape))
+            new_vals = apply_op(name, fn, x.values())
+            return _rebuild_coo(x, new_vals)
         if isinstance(x, SparseCsrTensor):
-            new_vals = apply_op(name, fn, Tensor(x._bcsr.data))
-            return SparseCsrTensor(
-                jsparse.BCSR((new_vals._data, x._bcsr.indices,
-                              x._bcsr.indptr), shape=x._bcsr.shape))
+            new_vals = apply_op(name, fn, x.values())
+            return _rebuild_csr(x, new_vals)
         return apply_op(name, fn, x)
     op.__name__ = name
     return op
 
 
 relu = _unary_on_values("sparse_relu", lambda v: jnp.maximum(v, 0))
+relu6 = _unary_on_values("sparse_relu6", lambda v: jnp.clip(v, 0, 6))
 sin = _unary_on_values("sparse_sin", jnp.sin)
+tan = _unary_on_values("sparse_tan", jnp.tan)
+asin = _unary_on_values("sparse_asin", jnp.arcsin)
+atan = _unary_on_values("sparse_atan", jnp.arctan)
+sinh = _unary_on_values("sparse_sinh", jnp.sinh)
+asinh = _unary_on_values("sparse_asinh", jnp.arcsinh)
+atanh = _unary_on_values("sparse_atanh", jnp.arctanh)
 tanh = _unary_on_values("sparse_tanh", jnp.tanh)
+square = _unary_on_values("sparse_square", jnp.square)
 sqrt = _unary_on_values("sparse_sqrt", jnp.sqrt)
+log1p = _unary_on_values("sparse_log1p", jnp.log1p)
 abs = _unary_on_values("sparse_abs", jnp.abs)
+neg = _unary_on_values("sparse_neg", jnp.negative)
+expm1 = _unary_on_values("sparse_expm1", jnp.expm1)
+rad2deg = _unary_on_values("sparse_rad2deg",
+                           lambda v: v * np.float32(180.0 / math.pi))
+deg2rad = _unary_on_values("sparse_deg2rad",
+                           lambda v: v * np.float32(math.pi / 180.0))
+isnan = _unary_on_values("sparse_isnan", jnp.isnan)
 
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _unary_on_values(
+        "sparse_leaky_relu",
+        lambda v: jnp.where(v >= 0, v, v * negative_slope))(x)
+
+
+def pow(x, factor, name=None):
+    return _unary_on_values("sparse_pow", lambda v: jnp.power(v, factor))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    from ..core.dtype import convert_dtype
+    vd = convert_dtype(value_dtype) if value_dtype is not None else None
+    idd = convert_dtype(index_dtype) if index_dtype is not None else None
+    if isinstance(x, SparseCooTensor):
+        vals_t = apply_op("sparse_cast",
+                          lambda v: v.astype(vd) if vd else v, x.values())
+        idx = x._bcoo.indices.astype(idd) if idd else x._bcoo.indices
+        out = SparseCooTensor(jsparse.BCOO((vals_t._data, idx),
+                                           shape=x._bcoo.shape))
+        out._vals_t = vals_t
+        return out
+    vals_t = apply_op("sparse_cast",
+                      lambda v: v.astype(vd) if vd else v, x.values())
+    cols = x._bcsr.indices.astype(idd) if idd else x._bcsr.indices
+    crows = x._bcsr.indptr.astype(idd) if idd else x._bcsr.indptr
+    out = SparseCsrTensor(jsparse.BCSR((vals_t._data, cols, crows),
+                                       shape=x._bcsr.shape))
+    out._vals_t = vals_t
+    return out
+
+
+def coalesce(x, name=None):
+    return x.coalesce()
+
+
+def transpose(x, perm, name=None):
+    """Permute sparse dims by reordering COO index columns (no data copy
+    of a dense tensor — parity: sparse transpose_kernel)."""
+    coo = x.to_sparse_coo() if isinstance(x, SparseCsrTensor) else x
+    idx = coo._bcoo.indices[:, jnp.asarray(perm, jnp.int32)]
+    shape = tuple(coo._bcoo.shape[p] for p in perm)
+    out = SparseCooTensor(jsparse.BCOO((coo._bcoo.data, idx), shape=shape))
+    out._vals_t = getattr(coo, "_vals_t", None)   # values unchanged
+    return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) else out
+
+
+def reshape(x, shape, name=None):
+    """Re-linearize COO coordinates into the new shape (index arithmetic
+    only). -1 wildcard supported."""
+    coo = x.to_sparse_coo() if isinstance(x, SparseCsrTensor) else x
+    old_shape = coo._bcoo.shape
+    size = int(np.prod(old_shape))
+    shape = list(shape)
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape[shape.index(-1)] = size // known
+    lin = jnp.zeros(coo._bcoo.indices.shape[0], jnp.int64)
+    for d, s in enumerate(old_shape):
+        lin = lin * s + coo._bcoo.indices[:, d].astype(jnp.int64)
+    new_idx = []
+    for s in reversed(shape):
+        new_idx.append(lin % s)
+        lin = lin // s
+    idx = jnp.stack(list(reversed(new_idx)), axis=1).astype(jnp.int32)
+    out = SparseCooTensor(jsparse.BCOO((coo._bcoo.data, idx),
+                                       shape=tuple(shape)))
+    out._vals_t = getattr(coo, "_vals_t", None)   # values unchanged
+    return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) else out
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    """Sparse reduction. axis=None returns a dense 0-d Tensor; an int axis
+    returns a sparse tensor (computed by re-bucketing coordinates)."""
+    coo = x.to_sparse_coo() if isinstance(x, SparseCsrTensor) else x
+    if axis is None:
+        out = apply_op("sparse_sum", jnp.sum, Tensor(coo._bcoo.data))
+        return out
+    nd = len(coo._bcoo.shape)
+    axis = axis % nd
+    keep = [d for d in range(nd) if d != axis]
+    idx_np = np.asarray(coo._bcoo.indices)[:, keep]
+    shape = tuple(coo._bcoo.shape[d] for d in keep)
+    uidx, inv = np.unique(idx_np, axis=0, return_inverse=True)
+    inv = jnp.asarray(inv.reshape(-1))
+    n_out = uidx.shape[0]
+    vals = apply_op("sparse_sum",
+                    lambda v: jax.ops.segment_sum(v, inv, n_out),
+                    coo.values())
+    if keepdim:
+        uidx = np.insert(uidx, axis, 0, axis=1)
+        shape = tuple(coo._bcoo.shape[d] if d != axis else 1
+                      for d in range(nd))
+    out = SparseCooTensor(jsparse.BCOO(
+        (vals._data, jnp.asarray(uidx.astype(np.int32))), shape=shape))
+    out._vals_t = vals
+    return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) else out
+
+
+def slice(x, axes, starts, ends, name=None):
+    """Slice by filtering coordinates (eager-only: output nnz is
+    data-dependent, as in the reference's sparse slice kernel)."""
+    coo = x.to_sparse_coo() if isinstance(x, SparseCsrTensor) else x
+    idx = np.asarray(coo._bcoo.indices)
+    shape = list(coo._bcoo.shape)
+    mask = np.ones(idx.shape[0], bool)
+    for ax, st, en in zip(axes, starts, ends):
+        ax = ax % len(shape)
+        st = st + shape[ax] if st < 0 else st
+        en = min(en + shape[ax] if en < 0 else en, shape[ax])
+        mask &= (idx[:, ax] >= st) & (idx[:, ax] < en)
+        shape[ax] = en - st
+    sel = np.where(mask)[0]
+    new_idx = idx[sel].copy()
+    for ax, st, _ in zip(axes, starts, ends):
+        ax = ax % len(coo._bcoo.shape)
+        st = st + coo._bcoo.shape[ax] if st < 0 else st
+        new_idx[:, ax] -= st
+    sel_j = jnp.asarray(sel)
+    vals_t = apply_op("sparse_slice", lambda v: v[sel_j], coo.values())
+    out = SparseCooTensor(jsparse.BCOO(
+        (vals_t._data, jnp.asarray(new_idx)), shape=tuple(shape)))
+    out._vals_t = vals_t
+    return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) else out
+
+
+# -- binary / multiary (parity: sparse/binary.py, multiary.py) --------------
 
 def matmul(x, y, name=None):
     """sparse @ dense -> dense. Parity: paddle.sparse.matmul."""
@@ -192,6 +381,22 @@ def matmul(x, y, name=None):
             dimension_numbers=(([x._bcoo.ndim - 1], [0]), ([], [])))
         return Tensor(out)
     return apply_op("matmul", jnp.matmul, x, y)
+
+
+def mv(x, vec, name=None):
+    """sparse (M, N) @ dense vector (N,) -> dense (M,).
+    Parity: paddle.sparse.mv."""
+    return matmul(x, vec, name=name)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta * input + alpha * (x @ y). Parity: paddle.sparse.addmm
+    (multiary.py)."""
+    prod = matmul(x, y)
+    inp = input.to_dense() if isinstance(
+        input, (SparseCooTensor, SparseCsrTensor)) else input
+    from ..ops import math as _m
+    return _m.add(_m.scale(inp, beta), _m.scale(prod, alpha))
 
 
 def masked_matmul(x, y, mask, name=None):
@@ -212,52 +417,74 @@ def _sddmm(xd, yd, mask: SparseCooTensor):
     return SparseCooTensor(jsparse.BCOO((vals, idx), shape=mask._bcoo.shape))
 
 
+def mask_as(x, mask, name=None):
+    """Gather the dense tensor's entries at the mask's sparsity pattern.
+    Parity: paddle.sparse.mask_as (sparse_mask kernel)."""
+    coo = mask.to_sparse_coo() if isinstance(mask, SparseCsrTensor) else mask
+    idx = coo._bcoo.indices
+    vals_t = apply_op(
+        "sparse_mask_as",
+        lambda d: d[tuple(idx[:, i] for i in range(idx.shape[1]))],
+        x if isinstance(x, Tensor) else Tensor(jnp.asarray(_data(x))))
+    out = SparseCooTensor(jsparse.BCOO((vals_t._data, idx),
+                                       shape=coo._bcoo.shape))
+    out._vals_t = vals_t
+    return out.to_sparse_csr() if isinstance(mask, SparseCsrTensor) else out
+
+
+def _coerce_coo_pair(x, y, opname):
+    was_csr = isinstance(x, SparseCsrTensor)
+    xc = x.to_sparse_coo() if was_csr else x
+    yc = y.to_sparse_coo() if isinstance(y, SparseCsrTensor) else y
+    if not (isinstance(xc, SparseCooTensor) and
+            isinstance(yc, SparseCooTensor)):
+        raise TypeError(f"sparse.{opname} expects two sparse tensors")
+    return xc, yc, was_csr
+
+
 def add(x, y, name=None):
-    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
-        idx = jnp.concatenate([x._bcoo.indices, y._bcoo.indices], axis=0)
-        val = jnp.concatenate([x._bcoo.data, y._bcoo.data], axis=0)
-        out = jsparse.BCOO((val, idx), shape=x._bcoo.shape).sum_duplicates()
-        return SparseCooTensor(out)
-    raise TypeError("sparse.add expects two SparseCooTensors")
+    xc, yc, was_csr = _coerce_coo_pair(x, y, "add")
+    idx = jnp.concatenate([xc._bcoo.indices, yc._bcoo.indices], axis=0)
+    val = jnp.concatenate([xc._bcoo.data, yc._bcoo.data], axis=0)
+    out = SparseCooTensor(
+        jsparse.BCOO((val, idx), shape=xc._bcoo.shape).sum_duplicates())
+    return out.to_sparse_csr() if was_csr else out
+
+
+def subtract(x, y, name=None):
+    return add(x, neg(y), name=name)
 
 
 def multiply(x, y, name=None):
-    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
-        # elementwise product via dense path (reference kernels do a merge;
-        # nnz here is test-scale)
-        out = x._bcoo.todense() * y._bcoo.todense()
-        return SparseCooTensor(jsparse.BCOO.fromdense(out))
-    raise TypeError("sparse.multiply expects two SparseCooTensors")
+    xc, yc, was_csr = _coerce_coo_pair(x, y, "multiply")
+    # elementwise product via dense path (reference kernels do a merge;
+    # nnz here is test-scale)
+    out = xc._bcoo.todense() * yc._bcoo.todense()
+    res = SparseCooTensor(jsparse.BCOO.fromdense(out))
+    return res.to_sparse_csr() if was_csr else res
 
 
-# -- sparse.nn --------------------------------------------------------------
-
-class _SparseNN:
-    """paddle.sparse.nn namespace (ReLU layer parity)."""
-
-    class ReLU:
-        def __call__(self, x):
-            return relu(x)
-
-    class Softmax:
-        """Row-wise softmax over CSR values. Parity:
-        paddle.sparse.nn.Softmax (csr softmax kernel)."""
-
-        def __init__(self, axis=-1):
-            self.axis = axis
-
-        def __call__(self, x: SparseCsrTensor):
-            indptr = x._bcsr.indptr
-            vals = x._bcsr.data
-            n_rows = x.shape[0]
-            row_id = jnp.searchsorted(indptr, jnp.arange(vals.shape[0]),
-                                      side="right") - 1
-            row_max = jax.ops.segment_max(vals, row_id, n_rows)
-            ex = jnp.exp(vals - row_max[row_id])
-            row_sum = jax.ops.segment_sum(ex, row_id, n_rows)
-            out = ex / row_sum[row_id]
-            return SparseCsrTensor(jsparse.BCSR(
-                (out, x._bcsr.indices, x._bcsr.indptr), shape=x._bcsr.shape))
+def divide(x, y, name=None):
+    """Elementwise divide at the UNION of the two sparsity patterns;
+    entries where the divisor is (structurally) zero keep their inf/nan
+    result, matching the reference (`python/paddle/sparse/binary.py`
+    divide example: -1/0 -> -inf). Scalar divisor divides the values
+    buffer (sparse_divide_scalar kernel)."""
+    if jnp.isscalar(y) or isinstance(y, (int, float)):
+        return _unary_on_values("sparse_divide_scalar",
+                                lambda v: v / y)(x)
+    xc, yc, was_csr = _coerce_coo_pair(x, y, "divide")
+    union = jsparse.BCOO((jnp.concatenate([
+        jnp.ones(xc._bcoo.nse, jnp.float32),
+        jnp.ones(yc._bcoo.nse, jnp.float32)]),
+        jnp.concatenate([xc._bcoo.indices, yc._bcoo.indices], axis=0)),
+        shape=xc._bcoo.shape).sum_duplicates()
+    idx = union.indices
+    pos = tuple(idx[:, d] for d in range(idx.shape[1]))
+    xd, yd = xc._bcoo.todense(), yc._bcoo.todense()
+    vals = xd[pos] / yd[pos]
+    res = SparseCooTensor(jsparse.BCOO((vals, idx), shape=xc._bcoo.shape))
+    return res.to_sparse_csr() if was_csr else res
 
 
-nn = _SparseNN()
+from . import nn  # noqa: E402,F401  (layers/functional subpackage)

@@ -1,0 +1,182 @@
+"""sparse.nn — layer classes over sparse.nn.functional.
+
+Parity: reference `python/paddle/sparse/nn/layer/` (activation.py,
+conv.py Conv3D/SubmConv3D/Conv2D/SubmConv2D, norm.py BatchNorm/
+SyncBatchNorm, pooling.py MaxPool3D)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ...core.tensor import Tensor
+from ...nn.layer.layers import Layer
+from ...ops.dispatch import apply_op
+from .. import SparseCooTensor
+from . import functional
+from . import functional as F
+
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "Conv2D", "Conv3D",
+           "SubmConv2D", "SubmConv3D", "BatchNorm", "SyncBatchNorm",
+           "MaxPool3D", "functional"]
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return F.relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return F.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01, name=None):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        return F.leaky_relu(x, self._slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, self._axis)
+
+
+class _ConvBase(Layer):
+    def __init__(self, nd, subm, in_channels, out_channels, kernel_size,
+                 stride=1, padding=0, dilation=1, groups=1,
+                 padding_mode="zeros", weight_attr=None, bias_attr=None,
+                 data_format=None):
+        super().__init__()
+        ks = (kernel_size,) * nd if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._nd, self._subm = nd, subm
+        self._stride, self._padding = stride, padding
+        self._dilation, self._groups = dilation, groups
+        fan_in = int(np.prod(ks)) * in_channels // groups
+        bound = 1.0 / np.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            ks + (in_channels // groups, out_channels), attr=weight_attr)
+        if weight_attr is None or getattr(weight_attr, "initializer",
+                                          None) is None:
+            from ...framework.random import rng_key
+            import jax
+            self.weight._data = jax.random.uniform(
+                rng_key(), tuple(self.weight.shape), self.weight.dtype,
+                minval=-bound, maxval=bound)
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter((out_channels,),
+                                              attr=bias_attr, is_bias=True)
+        if self.bias is not None:
+            self.add_parameter("bias", self.bias)
+        self.add_parameter("weight", self.weight)
+
+    def forward(self, x):
+        fn = {(2, False): F.conv2d, (3, False): F.conv3d,
+              (2, True): F.subm_conv2d, (3, True): F.subm_conv3d}[
+            (self._nd, self._subm)]
+        return fn(x, self.weight, self.bias, stride=self._stride,
+                  padding=self._padding, dilation=self._dilation,
+                  groups=self._groups)
+
+
+class Conv3D(_ConvBase):
+    """Parity: paddle.sparse.nn.Conv3D (sparse conv3d kernel)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, **kw):
+        super().__init__(3, False, in_channels, out_channels, kernel_size,
+                         **kw)
+
+
+class Conv2D(_ConvBase):
+    def __init__(self, in_channels, out_channels, kernel_size, **kw):
+        super().__init__(2, False, in_channels, out_channels, kernel_size,
+                         **kw)
+
+
+class SubmConv3D(_ConvBase):
+    """Parity: paddle.sparse.nn.SubmConv3D (submanifold rulebook conv)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, **kw):
+        super().__init__(3, True, in_channels, out_channels, kernel_size,
+                         **kw)
+
+
+class SubmConv2D(_ConvBase):
+    def __init__(self, in_channels, out_channels, kernel_size, **kw):
+        super().__init__(2, True, in_channels, out_channels, kernel_size,
+                         **kw)
+
+
+class BatchNorm(Layer):
+    """Batch norm over ACTIVE values per channel (inactive sites do not
+    contribute to the statistics — reference sparse batch_norm kernel
+    semantics, `phi/kernels/sparse/batch_norm_kernel.h`)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self._momentum, self._eps = momentum, epsilon
+        self._use_global_stats = use_global_stats
+        from ...nn.initializer import Constant
+        self.weight = self.create_parameter((num_features,),
+                                            default_initializer=Constant(1.0))
+        self.bias = self.create_parameter((num_features,), is_bias=True)
+        self.add_parameter("weight", self.weight)
+        self.add_parameter("bias", self.bias)
+        self._mean = Tensor(jnp.zeros((num_features,)), stop_gradient=True)
+        self._variance = Tensor(jnp.ones((num_features,)),
+                                stop_gradient=True)
+        self.register_buffer("_mean", self._mean)
+        self.register_buffer("_variance", self._variance)
+
+    def forward(self, x: SparseCooTensor):
+        vals = x._bcoo.data                            # (nnz, C)
+        use_global = self._use_global_stats
+        if use_global is None:
+            use_global = not self.training
+        if use_global:
+            mean, var = self._mean._data, self._variance._data
+        else:
+            mean = jnp.mean(vals, axis=0)
+            var = jnp.var(vals, axis=0)
+            m = self._momentum
+            self._mean._data = m * self._mean._data + (1 - m) * mean
+            self._variance._data = (m * self._variance._data
+                                    + (1 - m) * var)
+
+        def _f(v, w, b):
+            return (v - mean) / jnp.sqrt(var + self._eps) * w + b
+
+        out = apply_op("sparse_batch_norm", _f, x.values(), self.weight,
+                       self.bias)
+        from .. import _rebuild_coo
+        return _rebuild_coo(x, out)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-replica batch norm. Under SPMD the values buffer is already
+    globally visible to the compiler (stats become collective reductions
+    when sharded); eager single-process behavior equals BatchNorm —
+    matching the reference's world_size==1 fast path
+    (`python/paddle/sparse/nn/layer/norm.py` SyncBatchNorm)."""
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 return_mask=False, data_format="NDHWC", name=None):
+        super().__init__()
+        self._ks, self._st, self._pd = kernel_size, stride, padding
+
+    def forward(self, x):
+        return F.max_pool3d(x, self._ks, self._st, self._pd)

@@ -1,0 +1,290 @@
+"""sparse.nn.functional — sparse conv / pooling / activations / attention.
+
+Parity: reference `python/paddle/sparse/nn/functional/` (conv.py
+conv3d/subm_conv3d/conv2d/subm_conv2d, pooling.py max_pool3d,
+activation.py, transformer.py attention) over the phi sparse kernels
+(`paddle/phi/kernels/sparse/gpu/conv_kernel.cu`, `pool_kernel.cu`,
+`fused_attention_kernel.cu`).
+
+TPU-native designs:
+  * submanifold conv = gather-GEMM: active sites keep their coordinates;
+    for each kernel offset a host-built neighbor table gathers partner
+    values and one (nnz, Cin) x (Cin, Cout) MXU matmul accumulates — the
+    same rulebook formulation the reference builds on device, done once
+    on host (eager-only, like every data-dependent-sparsity op here).
+  * full conv / pooling densify into a window reduction (XLA
+    conv_general_dilated / reduce_window) and re-sparsify — correct at
+    any test scale; the submanifold path is the performance-critical one
+    in point-cloud workloads.
+  * sparse attention = SDDMM + segment softmax + SpMM, vmapped over
+    (batch, head) with the CSR pattern riding along.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ...core.tensor import Tensor
+from ...ops.dispatch import apply_op
+from .. import (SparseCooTensor, SparseCsrTensor, _data, leaky_relu, relu,
+                relu6)
+
+__all__ = ["conv2d", "conv3d", "subm_conv2d", "subm_conv3d", "max_pool3d",
+           "relu", "relu6", "leaky_relu", "softmax", "attention"]
+
+
+def softmax(x, axis=-1, name=None):
+    """Row-wise softmax over the last sparse dim (CSR rows / COO rows).
+    Parity: sparse softmax kernel (csr)."""
+    if axis not in (-1, len(x.shape) - 1):
+        raise NotImplementedError("sparse softmax supports the last axis")
+    csr = x.to_sparse_csr() if isinstance(x, SparseCooTensor) else x
+    indptr = csr._bcsr.indptr
+    vals = csr._bcsr.data
+    n_rows = csr.shape[0]
+    row_id = jnp.searchsorted(indptr, jnp.arange(vals.shape[0]),
+                              side="right") - 1
+    row_max = jax.ops.segment_max(vals, row_id, n_rows)
+    ex = jnp.exp(vals - row_max[row_id])
+    row_sum = jax.ops.segment_sum(ex, row_id, n_rows)
+    out_vals = ex / row_sum[row_id]
+    out = SparseCsrTensor(jsparse.BCSR(
+        (out_vals, csr._bcsr.indices, csr._bcsr.indptr), shape=csr.shape))
+    return out.to_sparse_coo() if isinstance(x, SparseCooTensor) else out
+
+
+def _resparsify(out_dense):
+    """Dense Tensor -> COO, keeping the tape link by gathering the dense
+    output at the discovered nonzero coordinates (eager-only)."""
+    bcoo = jsparse.BCOO.fromdense(out_dense._data)
+    idx = bcoo.indices
+
+    def _g(d):
+        return d[tuple(idx[:, i] for i in range(idx.shape[1]))]
+
+    vals = apply_op("sparse_values_gather", _g, out_dense)
+    res = SparseCooTensor(jsparse.BCOO((vals._data, idx),
+                                       shape=out_dense._data.shape))
+    res._vals_t = vals
+    return res
+
+
+def _normalize(v, nd, name):
+    if isinstance(v, int):
+        return (v,) * nd
+    v = tuple(int(s) for s in v)
+    if len(v) != nd:
+        raise ValueError(f"{name} must have {nd} entries, got {v}")
+    return v
+
+
+def _subm_neighbor_tables(idx_np, kernel_sizes, dilation):
+    """Host-side rulebook: for every kernel offset, neighbor_row[i] = row
+    of the input active site that the offset reaches from output site i,
+    or -1. Output sites == input sites (submanifold contract)."""
+    table = {tuple(c): i for i, c in enumerate(idx_np)}
+    nnz = idx_np.shape[0]
+    # idx columns: (batch, *spatial) — values carry the channel dim
+    offsets = np.stack(np.meshgrid(
+        *[np.arange(k) - k // 2 for k in kernel_sizes],
+        indexing="ij"), axis=-1).reshape(-1, len(kernel_sizes))
+    gathers = []
+    for off in offsets:
+        g = np.full(nnz, -1, np.int64)
+        shifted = idx_np.copy()
+        shifted[:, 1:] = idx_np[:, 1:] + off * np.asarray(dilation)
+        for i, c in enumerate(shifted):
+            g[i] = table.get(tuple(c), -1)
+        gathers.append(g)
+    return np.stack(gathers)                           # (K, nnz)
+
+
+def _subm_conv(x: SparseCooTensor, weight, bias, dilation, name):
+    """Gather-GEMM submanifold conv (stride 1, 'same' active set)."""
+    idx_np = np.asarray(x._bcoo.indices)               # (nnz, 1+spatial)
+    wd = _data(weight)
+    ks = wd.shape[:-2]
+    nd = len(ks)
+    if idx_np.shape[1] != nd + 1:
+        raise ValueError(
+            f"subm_conv{nd}d input must have indices (batch, {nd} spatial)")
+    gathers = jnp.asarray(
+        _subm_neighbor_tables(idx_np, ks, _normalize(dilation, nd,
+                                                     "dilation")))
+
+    def _f(vals, w, *maybe_b):
+        wf = w.reshape(-1, w.shape[-2], w.shape[-1])   # (K, Cin, Cout)
+        out = jnp.zeros((vals.shape[0], w.shape[-1]), vals.dtype)
+
+        def body(k, acc):
+            g = gathers[k]
+            nb = jnp.where(g[:, None] >= 0, vals[jnp.maximum(g, 0)], 0.0)
+            return acc + nb @ wf[k]
+        out = jax.lax.fori_loop(0, wf.shape[0], body, out)
+        if maybe_b:
+            out = out + maybe_b[0]
+        return out
+
+    args = [x.values(), weight]
+    if bias is not None:
+        args.append(bias)
+    new_vals = apply_op(name, _f, *args)
+    from .. import _rebuild_coo
+    shape = tuple(list(x.shape[:-1]) + [int(wd.shape[-1])])
+    return _rebuild_coo(x, new_vals, shape=shape)
+
+
+def _dense_conv(x: SparseCooTensor, weight, bias, stride, padding, dilation,
+                groups, name):
+    """Full sparse conv: densify -> XLA conv -> re-sparsify (eager)."""
+    wd = _data(weight)
+    nd = len(wd.shape) - 2
+    stride = _normalize(stride, nd, "stride")
+    padding = _normalize(padding, nd, "padding")
+    dilation = _normalize(dilation, nd, "dilation")
+
+    def _f(dense, w, *maybe_b):
+        dn = jax.lax.conv_dimension_numbers(
+            dense.shape, w.shape,
+            ("NDHWC", "DHWIO", "NDHWC") if nd == 3 else
+            ("NHWC", "HWIO", "NHWC"))
+        out = jax.lax.conv_general_dilated(
+            dense, w, window_strides=stride,
+            padding=[(p, p) for p in padding], rhs_dilation=dilation,
+            dimension_numbers=dn, feature_group_count=groups)
+        if maybe_b:
+            out = out + maybe_b[0]
+        return out
+
+    args = [Tensor(x._bcoo.todense()), weight]
+    if bias is not None:
+        args.append(bias)
+    out = apply_op(name, _f, *args)
+    return _resparsify(out)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC", key=None, name=None):
+    """x: COO (N, D, H, W, C); weight: (kD, kH, kW, Cin/groups, Cout).
+    Parity: paddle.sparse.nn.functional.conv3d."""
+    if data_format != "NDHWC":
+        raise NotImplementedError("sparse conv3d supports NDHWC only")
+    return _dense_conv(x, weight, bias, stride, padding, dilation, groups,
+                       "sparse_conv3d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NHWC", key=None, name=None):
+    if data_format != "NHWC":
+        raise NotImplementedError("sparse conv2d supports NHWC only")
+    return _dense_conv(x, weight, bias, stride, padding, dilation, groups,
+                       "sparse_conv2d")
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    """Submanifold conv: output active set == input active set.
+    Parity: paddle.sparse.nn.functional.subm_conv3d (rulebook + gemm)."""
+    if data_format != "NDHWC":
+        raise NotImplementedError("subm_conv3d supports NDHWC only")
+    if _normalize(stride, 3, "stride") != (1, 1, 1) or groups != 1:
+        raise NotImplementedError("subm conv requires stride=1, groups=1")
+    return _subm_conv(x, weight, bias, dilation, "sparse_subm_conv3d")
+
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NHWC", key=None, name=None):
+    if data_format != "NHWC":
+        raise NotImplementedError("subm_conv2d supports NHWC only")
+    if _normalize(stride, 2, "stride") != (1, 1) or groups != 1:
+        raise NotImplementedError("subm conv requires stride=1, groups=1")
+    return _subm_conv(x, weight, bias, dilation, "sparse_subm_conv2d")
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NDHWC", name=None):
+    """Max over each window's ACTIVE sites (inactive background is -inf,
+    not 0 — matches the reference sparse pool kernel). Eager-only."""
+    if data_format != "NDHWC":
+        raise NotImplementedError("sparse max_pool3d supports NDHWC only")
+    ks = _normalize(kernel_size, 3, "kernel_size")
+    st = _normalize(stride if stride is not None else kernel_size, 3,
+                    "stride")
+    pd = _normalize(padding, 3, "padding")
+    neg = jnp.asarray(-jnp.inf, x.dtype)
+    dense = jnp.full(tuple(x.shape), neg)
+    idx = x._bcoo.indices
+    dense = dense.at[tuple(idx[:, d] for d in range(idx.shape[1]))].set(
+        x._bcoo.data)
+
+    def _f(d):
+        out = jax.lax.reduce_window(
+            d, neg, jax.lax.max,
+            window_dimensions=(1,) + ks + (1,),
+            window_strides=(1,) + st + (1,),
+            padding=((0, 0),) + tuple((p, p) for p in pd) + ((0, 0),))
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    out = apply_op("sparse_max_pool3d", _f, Tensor(dense))
+    return _resparsify(out)
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse-pattern attention: scores only at the CSR mask's nonzeros.
+
+    Parity: paddle.sparse.nn.functional.attention
+    (`phi/kernels/sparse/gpu/fused_attention_kernel.cu`): q,k,v
+    (B, H, S, D) dense, sparse_mask a (B*H, S, S) CSR pattern batch.
+    TPU-native: SDDMM + segment softmax + SpMM vmapped over B*H — one
+    fused XLA program, nnz-proportional work.
+    """
+    qd, kd, vd = _data(query), _data(key), _data(value)
+    B, H, S, D = qd.shape
+    csr = sparse_mask
+    crows = _data(csr.crows()).reshape(B * H, S + 1)
+    cols = _data(csr.cols()).reshape(B * H, -1)
+    scale = 1.0 / float(np.sqrt(D))
+
+    def _f(q, k, v, *masks):
+        kpm = masks[0] if key_padding_mask is not None else None
+        am = (masks[1] if key_padding_mask is not None else masks[0]) \
+            if attn_mask is not None else None
+
+        def one(qh, kh, vh, crow, col, extra):
+            nnz = col.shape[0]
+            row = jnp.searchsorted(crow, jnp.arange(nnz), side="right") - 1
+            s = jnp.einsum("nd,nd->n", qh[row], kh[col]) * scale + extra
+            mx = jax.ops.segment_max(s, row, S)
+            ex = jnp.exp(s - mx[row])
+            den = jax.ops.segment_sum(ex, row, S)
+            p = ex / jnp.maximum(den[row], 1e-30)
+            return jax.ops.segment_sum(p[:, None] * vh[col], row, S)
+
+        qf = q.reshape(B * H, S, D)
+        kf = k.reshape(B * H, S, D)
+        vf = v.reshape(B * H, S, D)
+        nnz = cols.shape[1]
+        extra = jnp.zeros((B * H, nnz), qf.dtype)
+        if kpm is not None:
+            # (B, S) additive mask on keys
+            kpm_bh = jnp.repeat(kpm, H, axis=0)
+            extra = extra + jnp.take_along_axis(kpm_bh, cols, axis=1)
+        if am is not None:
+            am_bh = jnp.repeat(am.reshape(B, S, S), H, axis=0) \
+                if am.ndim == 3 else jnp.broadcast_to(am, (B * H, S, S))
+            row = jax.vmap(lambda cr: jnp.searchsorted(
+                cr, jnp.arange(nnz), side="right") - 1)(crows)
+            gat = jax.vmap(lambda a, r, c: a[r, c])(am_bh, row, cols)
+            extra = extra + gat
+        out = jax.vmap(one)(qf, kf, vf, crows, cols, extra)
+        return out.reshape(B, H, S, D)
+
+    args = [query, key, value]
+    if key_padding_mask is not None:
+        args.append(key_padding_mask)
+    if attn_mask is not None:
+        args.append(attn_mask)
+    return apply_op("sparse_attention", _f, *args)
