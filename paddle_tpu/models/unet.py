@@ -1,0 +1,212 @@
+"""Time-conditioned UNet denoiser — the Stable-Diffusion-style conv rung
+of the model ladder (BASELINE.md configs: "SD/DiT mixed conv+attn").
+
+Capability parity: the reference trains SD/LDM-class UNets through
+PaddleMIX on the same core ops (conv + attention + group norm); this is a
+native implementation of that architecture class: ResBlocks with
+scale-shift time conditioning, down/up paths with skip concat, and
+self-attention at the low-resolution levels. Shares `GaussianDiffusion`
+(models/dit.py) for DDPM training and DDIM sampling.
+
+TPU notes: NCHW layout at the API (paddle convention) with XLA choosing
+the device layout; attention runs through the framework's
+scaled_dot_product_attention so the Pallas flash path engages when shapes
+are eligible; everything is static-shaped and jit/to_static friendly.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..ops.dispatch import apply_op
+from ..ops.manipulation import concat
+from .dit import GaussianDiffusion, TimestepEmbedder  # noqa: F401
+
+__all__ = ["UNetConfig", "UNet2DModel", "unet_tiny", "GaussianDiffusion"]
+
+
+@dataclass
+class UNetConfig:
+    in_channels: int = 3
+    out_channels: int = 3
+    base_channels: int = 64
+    channel_mults: tuple = (1, 2, 4)
+    num_res_blocks: int = 2
+    attn_levels: tuple = (2,)        # indices into channel_mults
+    num_heads: int = 4
+    groups: int = 8
+    dropout: float = 0.0
+    learn_sigma: bool = False        # GaussianDiffusion splits eps if True
+
+
+class _ResBlock(nn.Layer):
+    """GroupNorm -> SiLU -> conv, with scale-shift time conditioning
+    (the SD UNet block shape)."""
+
+    def __init__(self, inp, out, t_dim, groups, dropout):
+        super().__init__()
+        self.norm1 = nn.GroupNorm(groups, inp)
+        self.conv1 = nn.Conv2D(inp, out, 3, padding=1)
+        self.t_proj = nn.Linear(t_dim, out * 2)
+        self.norm2 = nn.GroupNorm(groups, out)
+        self.drop = nn.Dropout(dropout)
+        self.conv2 = nn.Conv2D(out, out, 3, padding=1)
+        self.act = nn.Silu()
+        self.skip = nn.Conv2D(inp, out, 1) if inp != out else None
+
+    def forward(self, x, temb):
+        h = self.conv1(self.act(self.norm1(x)))
+        ss = self.t_proj(self.act(temb))
+
+        def _cond(hh, s):
+            scale, shift = jnp.split(s[:, :, None, None], 2, axis=1)
+            return hh * (1 + scale) + shift
+
+        h = apply_op("unet_scale_shift", _cond, self.norm2(h), ss)
+        h = self.conv2(self.drop(self.act(h)))
+        base = self.skip(x) if self.skip is not None else x
+        return base + h
+
+
+class _SelfAttention2D(nn.Layer):
+    """Spatial self-attention over HxW tokens (flash-eligible)."""
+
+    def __init__(self, channels, num_heads, groups):
+        super().__init__()
+        self.norm = nn.GroupNorm(groups, channels)
+        self.qkv = nn.Linear(channels, channels * 3)
+        self.proj = nn.Linear(channels, channels)
+        self.heads = num_heads
+
+    def forward(self, x):
+        B, C, H, W = x.shape
+        h = self.norm(x)
+
+        def _to_tokens(a):
+            return jnp.transpose(a.reshape(a.shape[0], a.shape[1], -1),
+                                 (0, 2, 1))
+        tok = apply_op("unet_to_tokens", _to_tokens, h)     # (B, HW, C)
+        qkv = self.qkv(tok)
+        from ..nn.functional import scaled_dot_product_attention
+
+        def _split_heads(a):
+            b, s, _ = a.shape
+            return a.reshape(b, s, 3, self.heads,
+                             a.shape[-1] // (3 * self.heads))
+        qkv = apply_op("unet_split_heads", _split_heads, qkv)
+        q, k, v = (apply_op("unet_pick", lambda a, i=i: a[:, :, i], qkv)
+                   for i in range(3))
+        att = scaled_dot_product_attention(q, k, v)
+        att = apply_op("unet_merge_heads",
+                       lambda a: a.reshape(a.shape[0], a.shape[1], -1), att)
+        out = self.proj(att)
+
+        def _to_map(a):
+            return jnp.transpose(a, (0, 2, 1)).reshape(B, C, H, W)
+        return x + apply_op("unet_to_map", _to_map, out)
+
+
+class _Down(nn.Layer):
+    def __init__(self, ch):
+        super().__init__()
+        self.op = nn.Conv2D(ch, ch, 3, stride=2, padding=1)
+
+    def forward(self, x):
+        return self.op(x)
+
+
+class _Up(nn.Layer):
+    def __init__(self, ch):
+        super().__init__()
+        self.up = nn.Upsample(scale_factor=2, mode="nearest")
+        self.op = nn.Conv2D(ch, ch, 3, padding=1)
+
+    def forward(self, x):
+        return self.op(self.up(x))
+
+
+class UNet2DModel(nn.Layer):
+    """epsilon-prediction UNet: forward(x_t (B,C,H,W), t (B,), y=None)."""
+
+    def __init__(self, cfg: UNetConfig = None, **kw):
+        super().__init__()
+        self.cfg = cfg or UNetConfig(**kw)
+        c = self.cfg
+        t_dim = c.base_channels * 4
+        self.t_embed = TimestepEmbedder(t_dim)
+        self.conv_in = nn.Conv2D(c.in_channels, c.base_channels, 3,
+                                 padding=1)
+
+        downs, ch, skips = [], c.base_channels, [c.base_channels]
+        for lvl, mult in enumerate(c.channel_mults):
+            out = c.base_channels * mult
+            for _ in range(c.num_res_blocks):
+                blk = [_ResBlock(ch, out, t_dim, c.groups, c.dropout)]
+                if lvl in c.attn_levels:
+                    blk.append(_SelfAttention2D(out, c.num_heads, c.groups))
+                downs.append(nn.LayerList(blk))
+                ch = out
+                skips.append(ch)
+            if lvl != len(c.channel_mults) - 1:
+                downs.append(nn.LayerList([_Down(ch)]))
+                skips.append(ch)
+        self.downs = nn.LayerList(downs)
+        self._skip_chs = skips
+
+        self.mid1 = _ResBlock(ch, ch, t_dim, c.groups, c.dropout)
+        self.mid_attn = _SelfAttention2D(ch, c.num_heads, c.groups)
+        self.mid2 = _ResBlock(ch, ch, t_dim, c.groups, c.dropout)
+
+        ups = []
+        skip_stack = list(skips)
+        for lvl in reversed(range(len(c.channel_mults))):
+            out = c.base_channels * c.channel_mults[lvl]
+            for _ in range(c.num_res_blocks + 1):
+                sk = skip_stack.pop()
+                blk = [_ResBlock(ch + sk, out, t_dim, c.groups, c.dropout)]
+                if lvl in c.attn_levels:
+                    blk.append(_SelfAttention2D(out, c.num_heads, c.groups))
+                ups.append(nn.LayerList(blk))
+                ch = out
+            if lvl != 0:
+                ups.append(nn.LayerList([_Up(ch)]))
+        self.ups = nn.LayerList(ups)
+
+        self.norm_out = nn.GroupNorm(c.groups, ch)
+        self.act = nn.Silu()
+        out_ch = c.out_channels * (2 if c.learn_sigma else 1)
+        self.conv_out = nn.Conv2D(ch, out_ch, 3, padding=1)
+
+    def forward(self, x, t, y=None):
+        temb = self.t_embed(t)
+        h = self.conv_in(x)
+        hs = [h]
+        for blk in self.downs:
+            mods = list(blk)
+            if isinstance(mods[0], _Down):
+                h = mods[0](h)
+            else:
+                h = mods[0](h, temb)
+                if len(mods) > 1:
+                    h = mods[1](h)
+            hs.append(h)
+        h = self.mid2(self.mid_attn(self.mid1(h, temb)), temb)
+        for blk in self.ups:
+            mods = list(blk)
+            if isinstance(mods[0], _Up):
+                h = mods[0](h)
+            else:
+                h = mods[0](concat([h, hs.pop()], axis=1), temb)
+                if len(mods) > 1:
+                    h = mods[1](h)
+        return self.conv_out(self.act(self.norm_out(h)))
+
+
+def unet_tiny(**kw):
+    kw.setdefault("base_channels", 32)
+    kw.setdefault("channel_mults", (1, 2))
+    kw.setdefault("num_res_blocks", 1)
+    kw.setdefault("attn_levels", (1,))
+    return UNet2DModel(UNetConfig(**kw))

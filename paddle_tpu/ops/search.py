@@ -234,9 +234,12 @@ def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1, k=0,
                     sorted_idx[:, :kk].astype(jnp.int64))
         return vals, ids
 
-    args = [x, ps]
+    def _t(v):
+        return v if isinstance(v, Tensor) else Tensor(jnp.asarray(v))
+
+    args = [_t(x), _t(ps)]
     if threshold is not None:
-        args.append(threshold)
+        args.append(_t(threshold))
     if topp_seed is not None:
-        args.append(topp_seed)
+        args.append(_t(topp_seed))
     return apply_op("top_p_sampling", _f, *args)

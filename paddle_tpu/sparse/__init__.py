@@ -338,7 +338,10 @@ def sum(x, axis=None, dtype=None, keepdim=False, name=None):
     out = SparseCooTensor(jsparse.BCOO(
         (vals._data, jnp.asarray(uidx.astype(np.int32))), shape=shape))
     out._vals_t = vals
-    return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) else out
+    # CSR needs rank >= 2; a rank-1 reduction result stays COO
+    if isinstance(x, SparseCsrTensor) and len(shape) >= 2:
+        return out.to_sparse_csr()
+    return out
 
 
 def slice(x, axes, starts, ends, name=None):

@@ -141,25 +141,30 @@ class BatchNorm(Layer):
         self.register_buffer("_variance", self._variance)
 
     def forward(self, x: SparseCooTensor):
-        vals = x._bcoo.data                            # (nnz, C)
         use_global = self._use_global_stats
         if use_global is None:
             use_global = not self.training
-        if use_global:
-            mean, var = self._mean._data, self._variance._data
-        else:
-            mean = jnp.mean(vals, axis=0)
-            var = jnp.var(vals, axis=0)
-            m = self._momentum
-            self._mean._data = m * self._mean._data + (1 - m) * mean
-            self._variance._data = (m * self._variance._data
-                                    + (1 - m) * var)
+        run_mean = self._mean._data
+        run_var = self._variance._data
 
         def _f(v, w, b):
-            return (v - mean) / jnp.sqrt(var + self._eps) * w + b
+            # stats computed INSIDE the taped closure so backward carries
+            # the d(mean)/dv and d(var)/dv terms (dense F.batch_norm does
+            # the same; reference sparse batch_norm grad kernel parity)
+            if use_global:
+                mean, var = run_mean, run_var
+            else:
+                mean = jnp.mean(v, axis=0)
+                var = jnp.var(v, axis=0)
+            out = (v - mean) / jnp.sqrt(var + self._eps) * w + b
+            return out, mean, var
 
-        out = apply_op("sparse_batch_norm", _f, x.values(), self.weight,
-                       self.bias)
+        out, mean_t, var_t = apply_op("sparse_batch_norm", _f, x.values(),
+                                      self.weight, self.bias)
+        if not use_global:
+            m = self._momentum
+            self._mean._data = m * run_mean + (1 - m) * mean_t._data
+            self._variance._data = m * run_var + (1 - m) * var_t._data
         from .. import _rebuild_coo
         return _rebuild_coo(x, out)
 

@@ -80,25 +80,44 @@ def _normalize(v, nd, name):
     return v
 
 
-def _subm_neighbor_tables(idx_np, kernel_sizes, dilation):
+_RULEBOOK_CACHE = {}
+
+
+def _subm_neighbor_tables(idx_np, kernel_sizes, dilation, dims):
     """Host-side rulebook: for every kernel offset, neighbor_row[i] = row
     of the input active site that the offset reaches from output site i,
-    or -1. Output sites == input sites (submanifold contract)."""
-    table = {tuple(c): i for i, c in enumerate(idx_np)}
+    or -1. Output sites == input sites (submanifold contract). Built once
+    per (geometry, kernel) — cached, since active sites are static across
+    training steps — and fully vectorized via sorted linear coordinates."""
+    key = (idx_np.tobytes(), tuple(kernel_sizes), tuple(dilation),
+           tuple(dims))
+    hit = _RULEBOOK_CACHE.get(key)
+    if hit is not None:
+        return hit
     nnz = idx_np.shape[0]
-    # idx columns: (batch, *spatial) — values carry the channel dim
+    dims = np.asarray(dims)
+    lin = np.ravel_multi_index(idx_np.T, dims)
+    order = np.argsort(lin)
+    lin_sorted = lin[order]
     offsets = np.stack(np.meshgrid(
         *[np.arange(k) - k // 2 for k in kernel_sizes],
         indexing="ij"), axis=-1).reshape(-1, len(kernel_sizes))
     gathers = []
     for off in offsets:
-        g = np.full(nnz, -1, np.int64)
         shifted = idx_np.copy()
         shifted[:, 1:] = idx_np[:, 1:] + off * np.asarray(dilation)
-        for i, c in enumerate(shifted):
-            g[i] = table.get(tuple(c), -1)
-        gathers.append(g)
-    return np.stack(gathers)                           # (K, nnz)
+        inb = np.all((shifted >= 0) & (shifted < dims), axis=1)
+        lin_s = np.where(
+            inb, np.ravel_multi_index(shifted.T % dims[:, None], dims), 0)
+        pos = np.searchsorted(lin_sorted, lin_s)
+        pos_c = np.minimum(pos, nnz - 1)
+        found = inb & (lin_sorted[pos_c] == lin_s)
+        gathers.append(np.where(found, order[pos_c], -1))
+    out = np.stack(gathers)                            # (K, nnz)
+    if len(_RULEBOOK_CACHE) > 64:
+        _RULEBOOK_CACHE.clear()
+    _RULEBOOK_CACHE[key] = out
+    return out
 
 
 def _subm_conv(x: SparseCooTensor, weight, bias, dilation, name):
@@ -111,8 +130,9 @@ def _subm_conv(x: SparseCooTensor, weight, bias, dilation, name):
         raise ValueError(
             f"subm_conv{nd}d input must have indices (batch, {nd} spatial)")
     gathers = jnp.asarray(
-        _subm_neighbor_tables(idx_np, ks, _normalize(dilation, nd,
-                                                     "dilation")))
+        _subm_neighbor_tables(idx_np, ks,
+                              _normalize(dilation, nd, "dilation"),
+                              tuple(x.shape[:-1])))
 
     def _f(vals, w, *maybe_b):
         wf = w.reshape(-1, w.shape[-2], w.shape[-1])   # (K, Cin, Cout)
@@ -158,7 +178,15 @@ def _dense_conv(x: SparseCooTensor, weight, bias, stride, padding, dilation,
             out = out + maybe_b[0]
         return out
 
-    args = [Tensor(x._bcoo.todense()), weight]
+    idx = x._bcoo.indices
+    pos = tuple(idx[:, i] for i in range(idx.shape[1]))
+    dense_shape = tuple(x.shape)
+
+    def _densify(v):
+        return jnp.zeros(dense_shape, v.dtype).at[pos].set(v)
+
+    dense_t = apply_op("sparse_to_dense", _densify, x.values())
+    args = [dense_t, weight]
     if bias is not None:
         args.append(bias)
     out = apply_op(name, _f, *args)
@@ -214,12 +242,12 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                     "stride")
     pd = _normalize(padding, 3, "padding")
     neg = jnp.asarray(-jnp.inf, x.dtype)
-    dense = jnp.full(tuple(x.shape), neg)
     idx = x._bcoo.indices
-    dense = dense.at[tuple(idx[:, d] for d in range(idx.shape[1]))].set(
-        x._bcoo.data)
+    pos = tuple(idx[:, d] for d in range(idx.shape[1]))
+    dense_shape = tuple(x.shape)
 
-    def _f(d):
+    def _f(v):
+        d = jnp.full(dense_shape, neg, v.dtype).at[pos].set(v)
         out = jax.lax.reduce_window(
             d, neg, jax.lax.max,
             window_dimensions=(1,) + ks + (1,),
@@ -227,7 +255,7 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
             padding=((0, 0),) + tuple((p, p) for p in pd) + ((0, 0),))
         return jnp.where(jnp.isfinite(out), out, 0.0)
 
-    out = apply_op("sparse_max_pool3d", _f, Tensor(dense))
+    out = apply_op("sparse_max_pool3d", _f, x.values())
     return _resparsify(out)
 
 

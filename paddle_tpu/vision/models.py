@@ -1,14 +1,21 @@
-"""Vision models: ResNet family, VGG, MobileNetV2, LeNet.
+"""Vision model zoo.
 
-Parity: reference `python/paddle/vision/models/` (resnet.py, vgg.py,
-mobilenetv2.py, lenet.py).
+Parity: reference `python/paddle/vision/models/` — resnet.py (+wide/
+resnext variants), vgg.py, alexnet.py, mobilenetv1/v2/v3.py,
+squeezenet.py, shufflenetv2.py, densenet.py, googlenet.py, lenet.py.
 """
 from __future__ import annotations
 
 from .. import nn
 
 __all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
-           "resnet152", "LeNet", "VGG", "vgg16", "MobileNetV2", "mobilenet_v2"]
+           "resnet152", "wide_resnet50_2", "wide_resnet101_2",
+           "resnext50_32x4d", "resnext101_64x4d", "LeNet", "VGG", "vgg11",
+           "vgg13", "vgg16", "vgg19", "AlexNet", "alexnet", "MobileNetV1",
+           "mobilenet_v1", "MobileNetV2", "mobilenet_v2", "MobileNetV3",
+           "mobilenet_v3_small", "mobilenet_v3_large", "SqueezeNet",
+           "squeezenet1_1", "ShuffleNetV2", "shufflenet_v2_x1_0",
+           "DenseNet", "densenet121", "GoogLeNet", "googlenet"]
 
 
 class BasicBlock(nn.Layer):
@@ -268,3 +275,465 @@ class MobileNetV2(nn.Layer):
 
 def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
     return MobileNetV2(scale=scale, **kwargs)
+
+
+class AlexNet(nn.Layer):
+    """Parity: python/paddle/vision/models/alexnet.py."""
+
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(3, 64, 11, stride=4, padding=2), nn.ReLU(),
+            nn.MaxPool2D(3, 2),
+            nn.Conv2D(64, 192, 5, padding=2), nn.ReLU(),
+            nn.MaxPool2D(3, 2),
+            nn.Conv2D(192, 384, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(384, 256, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(256, 256, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(3, 2))
+        self.avgpool = nn.AdaptiveAvgPool2D((6, 6))
+        self.classifier = nn.Sequential(
+            nn.Dropout(), nn.Linear(256 * 36, 4096), nn.ReLU(),
+            nn.Dropout(), nn.Linear(4096, 4096), nn.ReLU(),
+            nn.Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.avgpool(self.features(x))
+        from ..ops.manipulation import flatten
+        return self.classifier(flatten(x, 1))
+
+
+def alexnet(pretrained=False, **kwargs):
+    return AlexNet(**kwargs)
+
+
+def vgg11(pretrained=False, batch_norm=False, **kwargs):
+    cfg = [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"]
+    return VGG(_vgg_layers(cfg, batch_norm), **kwargs)
+
+
+def vgg13(pretrained=False, batch_norm=False, **kwargs):
+    cfg = [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+           512, 512, "M"]
+    return VGG(_vgg_layers(cfg, batch_norm), **kwargs)
+
+
+def vgg19(pretrained=False, batch_norm=False, **kwargs):
+    cfg = [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+           512, 512, 512, 512, "M", 512, 512, 512, 512, "M"]
+    return VGG(_vgg_layers(cfg, batch_norm), **kwargs)
+
+
+def wide_resnet50_2(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 50, width=128, **kwargs)
+
+
+def wide_resnet101_2(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 101, width=128, **kwargs)
+
+
+def resnext50_32x4d(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 50, width=4, groups=32, **kwargs)
+
+
+def resnext101_64x4d(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 101, width=4, groups=64, **kwargs)
+
+
+class MobileNetV1(nn.Layer):
+    """Depthwise-separable stack. Parity: vision/models/mobilenetv1.py."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        def c(ch):
+            return max(int(ch * scale), 8)
+
+        def dw_sep(inp, oup, stride):
+            return nn.Sequential(
+                nn.Conv2D(inp, inp, 3, stride=stride, padding=1, groups=inp,
+                          bias_attr=False),
+                nn.BatchNorm2D(inp), nn.ReLU(),
+                nn.Conv2D(inp, oup, 1, bias_attr=False),
+                nn.BatchNorm2D(oup), nn.ReLU())
+
+        cfg = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+               (256, 256, 1), (256, 512, 2)] + [(512, 512, 1)] * 5 + \
+              [(512, 1024, 2), (1024, 1024, 1)]
+        feats = [nn.Conv2D(3, c(32), 3, stride=2, padding=1, bias_attr=False),
+                 nn.BatchNorm2D(c(32)), nn.ReLU()]
+        for inp, oup, s in cfg:
+            feats.append(dw_sep(c(inp), c(oup), s))
+        self.features = nn.Sequential(*feats)
+        self.with_pool, self.num_classes = with_pool, num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ..ops.manipulation import flatten
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+class _SqueezeExcite(nn.Layer):
+    def __init__(self, ch, squeeze_ch):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        self.fc1 = nn.Conv2D(ch, squeeze_ch, 1)
+        self.fc2 = nn.Conv2D(squeeze_ch, ch, 1)
+        self.relu = nn.ReLU()
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _MBV3Block(nn.Layer):
+    def __init__(self, inp, hidden, oup, k, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and inp == oup
+        Act = nn.Hardswish if act == "hardswish" else nn.ReLU
+        layers = []
+        if hidden != inp:
+            layers += [nn.Conv2D(inp, hidden, 1, bias_attr=False),
+                       nn.BatchNorm2D(hidden), Act()]
+        layers += [nn.Conv2D(hidden, hidden, k, stride=stride,
+                             padding=k // 2, groups=hidden, bias_attr=False),
+                   nn.BatchNorm2D(hidden), Act()]
+        if use_se:
+            layers.append(_SqueezeExcite(hidden, max(hidden // 4, 8)))
+        layers += [nn.Conv2D(hidden, oup, 1, bias_attr=False),
+                   nn.BatchNorm2D(oup)]
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV3(nn.Layer):
+    """Parity: vision/models/mobilenetv3.py (small/large configs)."""
+
+    CFG_LARGE = [
+        (16, 16, 16, 3, 1, False, "relu"),
+        (16, 64, 24, 3, 2, False, "relu"),
+        (24, 72, 24, 3, 1, False, "relu"),
+        (24, 72, 40, 5, 2, True, "relu"),
+        (40, 120, 40, 5, 1, True, "relu"),
+        (40, 120, 40, 5, 1, True, "relu"),
+        (40, 240, 80, 3, 2, False, "hardswish"),
+        (80, 200, 80, 3, 1, False, "hardswish"),
+        (80, 184, 80, 3, 1, False, "hardswish"),
+        (80, 184, 80, 3, 1, False, "hardswish"),
+        (80, 480, 112, 3, 1, True, "hardswish"),
+        (112, 672, 112, 3, 1, True, "hardswish"),
+        (112, 672, 160, 5, 2, True, "hardswish"),
+        (160, 960, 160, 5, 1, True, "hardswish"),
+        (160, 960, 160, 5, 1, True, "hardswish"),
+    ]
+    CFG_SMALL = [
+        (16, 16, 16, 3, 2, True, "relu"),
+        (16, 72, 24, 3, 2, False, "relu"),
+        (24, 88, 24, 3, 1, False, "relu"),
+        (24, 96, 40, 5, 2, True, "hardswish"),
+        (40, 240, 40, 5, 1, True, "hardswish"),
+        (40, 240, 40, 5, 1, True, "hardswish"),
+        (40, 120, 48, 5, 1, True, "hardswish"),
+        (48, 144, 48, 5, 1, True, "hardswish"),
+        (48, 288, 96, 5, 2, True, "hardswish"),
+        (96, 576, 96, 5, 1, True, "hardswish"),
+        (96, 576, 96, 5, 1, True, "hardswish"),
+    ]
+
+    def __init__(self, config="large", scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        cfg = self.CFG_LARGE if config == "large" else self.CFG_SMALL
+        last_exp = 960 if config == "large" else 576
+        def c(ch):
+            return max(int(ch * scale), 8)
+        feats = [nn.Conv2D(3, c(16), 3, stride=2, padding=1, bias_attr=False),
+                 nn.BatchNorm2D(c(16)), nn.Hardswish()]
+        for inp, hid, oup, k, s, se, act in cfg:
+            feats.append(_MBV3Block(c(inp), c(hid), c(oup), k, s, se, act))
+        feats += [nn.Conv2D(c(cfg[-1][2]), c(last_exp), 1, bias_attr=False),
+                  nn.BatchNorm2D(c(last_exp)), nn.Hardswish()]
+        self.features = nn.Sequential(*feats)
+        self.with_pool, self.num_classes = with_pool, num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(c(last_exp), 1280), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(1280, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ..ops.manipulation import flatten
+            x = self.classifier(flatten(x, 1))
+        return x
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3("large", scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3("small", scale=scale, **kwargs)
+
+
+class SqueezeNet(nn.Layer):
+    """Parity: vision/models/squeezenet.py (v1.1)."""
+
+    class Fire(nn.Layer):
+        def __init__(self, inp, squeeze, e1, e3):
+            super().__init__()
+            self.squeeze = nn.Sequential(nn.Conv2D(inp, squeeze, 1),
+                                         nn.ReLU())
+            self.e1 = nn.Sequential(nn.Conv2D(squeeze, e1, 1), nn.ReLU())
+            self.e3 = nn.Sequential(nn.Conv2D(squeeze, e3, 3, padding=1),
+                                    nn.ReLU())
+
+        def forward(self, x):
+            from ..ops.manipulation import concat
+            s = self.squeeze(x)
+            return concat([self.e1(s), self.e3(s)], axis=1)
+
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        F = SqueezeNet.Fire
+        self.features = nn.Sequential(
+            nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(),
+            nn.MaxPool2D(3, 2),
+            F(64, 16, 64, 64), F(128, 16, 64, 64),
+            nn.MaxPool2D(3, 2),
+            F(128, 32, 128, 128), F(256, 32, 128, 128),
+            nn.MaxPool2D(3, 2),
+            F(256, 48, 192, 192), F(384, 48, 192, 192),
+            F(384, 64, 256, 256), F(512, 64, 256, 256))
+        self.classifier = nn.Sequential(
+            nn.Dropout(), nn.Conv2D(512, num_classes, 1), nn.ReLU(),
+            nn.AdaptiveAvgPool2D((1, 1)))
+
+    def forward(self, x):
+        x = self.classifier(self.features(x))
+        from ..ops.manipulation import flatten
+        return flatten(x, 1)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    return SqueezeNet(**kwargs)
+
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, inp, oup, stride):
+        super().__init__()
+        self.stride = stride
+        branch = oup // 2
+        if stride == 2:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(inp, inp, 3, stride=2, padding=1, groups=inp,
+                          bias_attr=False),
+                nn.BatchNorm2D(inp),
+                nn.Conv2D(inp, branch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch), nn.ReLU())
+            in2 = inp
+        else:
+            self.branch1 = None
+            in2 = inp // 2
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(in2, branch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch), nn.ReLU(),
+            nn.Conv2D(branch, branch, 3, stride=stride, padding=1,
+                      groups=branch, bias_attr=False),
+            nn.BatchNorm2D(branch),
+            nn.Conv2D(branch, branch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch), nn.ReLU())
+        self.shuffle = nn.ChannelShuffle(2)
+
+    def forward(self, x):
+        from ..ops.manipulation import concat, split
+        if self.stride == 2:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        else:
+            x1, x2 = split(x, 2, axis=1)
+            out = concat([x1, self.branch2(x2)], axis=1)
+        return self.shuffle(out)
+
+
+class ShuffleNetV2(nn.Layer):
+    """Parity: vision/models/shufflenetv2.py (x1.0)."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        stage_out = {0.5: [48, 96, 192, 1024], 1.0: [116, 232, 464, 1024],
+                     1.5: [176, 352, 704, 1024],
+                     2.0: [244, 488, 976, 2048]}[scale]
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, 24, 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(24), nn.ReLU())
+        self.maxpool = nn.MaxPool2D(3, 2, padding=1)
+        inp = 24
+        stages = []
+        for i, reps in enumerate([4, 8, 4]):
+            oup = stage_out[i]
+            units = [_ShuffleUnit(inp, oup, 2)]
+            for _ in range(reps - 1):
+                units.append(_ShuffleUnit(oup, oup, 1))
+            stages.append(nn.Sequential(*units))
+            inp = oup
+        self.stages = nn.Sequential(*stages)
+        self.conv_last = nn.Sequential(
+            nn.Conv2D(inp, stage_out[3], 1, bias_attr=False),
+            nn.BatchNorm2D(stage_out[3]), nn.ReLU())
+        self.with_pool, self.num_classes = with_pool, num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(stage_out[3], num_classes)
+
+    def forward(self, x):
+        x = self.conv_last(self.stages(self.maxpool(self.conv1(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ..ops.manipulation import flatten
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, **kwargs)
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, inp, growth, bn_size):
+        super().__init__()
+        self.fn = nn.Sequential(
+            nn.BatchNorm2D(inp), nn.ReLU(),
+            nn.Conv2D(inp, bn_size * growth, 1, bias_attr=False),
+            nn.BatchNorm2D(bn_size * growth), nn.ReLU(),
+            nn.Conv2D(bn_size * growth, growth, 3, padding=1,
+                      bias_attr=False))
+
+    def forward(self, x):
+        from ..ops.manipulation import concat
+        return concat([x, self.fn(x)], axis=1)
+
+
+class DenseNet(nn.Layer):
+    """Parity: vision/models/densenet.py (121 config by default)."""
+
+    def __init__(self, layers=121, growth_rate=32, bn_size=4,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        block_cfg = {121: [6, 12, 24, 16], 161: [6, 12, 36, 24],
+                     169: [6, 12, 32, 32], 201: [6, 12, 48, 32],
+                     264: [6, 12, 64, 48]}[layers]
+        ch = 2 * growth_rate
+        feats = [nn.Conv2D(3, ch, 7, stride=2, padding=3, bias_attr=False),
+                 nn.BatchNorm2D(ch), nn.ReLU(), nn.MaxPool2D(3, 2, padding=1)]
+        for i, reps in enumerate(block_cfg):
+            for _ in range(reps):
+                feats.append(_DenseLayer(ch, growth_rate, bn_size))
+                ch += growth_rate
+            if i != len(block_cfg) - 1:
+                feats += [nn.BatchNorm2D(ch), nn.ReLU(),
+                          nn.Conv2D(ch, ch // 2, 1, bias_attr=False),
+                          nn.AvgPool2D(2, 2)]
+                ch //= 2
+        feats += [nn.BatchNorm2D(ch), nn.ReLU()]
+        self.features = nn.Sequential(*feats)
+        self.with_pool, self.num_classes = with_pool, num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ..ops.manipulation import flatten
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+def densenet121(pretrained=False, **kwargs):
+    return DenseNet(121, **kwargs)
+
+
+class _Inception(nn.Layer):
+    def __init__(self, inp, c1, c3r, c3, c5r, c5, pp):
+        super().__init__()
+        self.b1 = nn.Sequential(nn.Conv2D(inp, c1, 1), nn.ReLU())
+        self.b2 = nn.Sequential(nn.Conv2D(inp, c3r, 1), nn.ReLU(),
+                                nn.Conv2D(c3r, c3, 3, padding=1), nn.ReLU())
+        self.b3 = nn.Sequential(nn.Conv2D(inp, c5r, 1), nn.ReLU(),
+                                nn.Conv2D(c5r, c5, 5, padding=2), nn.ReLU())
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, 1, padding=1),
+                                nn.Conv2D(inp, pp, 1), nn.ReLU())
+
+    def forward(self, x):
+        from ..ops.manipulation import concat
+        return concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)],
+                      axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    """Parity: vision/models/googlenet.py (aux heads omitted in eval
+    parity; the reference also drops them at inference)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, 64, 7, stride=2, padding=3), nn.ReLU(),
+            nn.MaxPool2D(3, 2, padding=1),
+            nn.Conv2D(64, 64, 1), nn.ReLU(),
+            nn.Conv2D(64, 192, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(3, 2, padding=1))
+        self.blocks = nn.Sequential(
+            _Inception(192, 64, 96, 128, 16, 32, 32),
+            _Inception(256, 128, 128, 192, 32, 96, 64),
+            nn.MaxPool2D(3, 2, padding=1),
+            _Inception(480, 192, 96, 208, 16, 48, 64),
+            _Inception(512, 160, 112, 224, 24, 64, 64),
+            _Inception(512, 128, 128, 256, 24, 64, 64),
+            _Inception(512, 112, 144, 288, 32, 64, 64),
+            _Inception(528, 256, 160, 320, 32, 128, 128),
+            nn.MaxPool2D(3, 2, padding=1),
+            _Inception(832, 256, 160, 320, 32, 128, 128),
+            _Inception(832, 384, 192, 384, 48, 128, 128))
+        self.with_pool, self.num_classes = with_pool, num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.head = nn.Sequential(nn.Dropout(0.2),
+                                      nn.Linear(1024, num_classes))
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ..ops.manipulation import flatten
+            x = self.head(flatten(x, 1))
+        return x
+
+
+def googlenet(pretrained=False, **kwargs):
+    return GoogLeNet(**kwargs)

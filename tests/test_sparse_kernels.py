@@ -149,10 +149,7 @@ def test_batch_norm_active_only():
 
 def test_max_pool3d_active_only():
     N, D, H, W, C = 1, 4, 4, 4, 2
-    idx = np.array([[0, 0, 0], [0, 1, 1], [0, 3, 3]]).T  # (3 coords)
-    idx = np.vstack([np.zeros((1, 3), np.int64), idx,
-                     np.zeros((1, 3), np.int64)])  # n, d, h, w, -> add c? no
-    # build explicit: sites (n,d,h,w)
+    # active sites as (n, d, h, w) coordinate columns
     sites = np.array([[0, 0, 0, 0], [0, 0, 1, 1], [0, 3, 3, 3]]).T
     feats = np.array([[-5.0, 1.0], [-7.0, 2.0], [3.0, -1.0]], np.float32)
     xs = sparse.sparse_coo_tensor(sites, feats, (N, D, H, W, C))
@@ -172,7 +169,6 @@ def test_sparse_attention_matches_masked_dense():
     # random mask with at least one nonzero per row, same nnz per (b,h):
     # use a banded causal-ish pattern
     mask = np.tril(np.ones((S, S), np.float32))
-    crows = np.arange(S + 1).cumsum()  # row i has i+1 entries
     crows = np.concatenate([[0], np.cumsum(np.arange(1, S + 1))])
     cols = np.concatenate([np.arange(i + 1) for i in range(S)])
     crows_b = np.tile(crows, (B * H, 1)).reshape(-1)
@@ -203,3 +199,38 @@ def test_csr_roundtrips_through_new_ops():
     row_sums = np.asarray(sm.to_dense()._data).sum(1)
     active_rows = (d1 != 0).any(1)
     np.testing.assert_allclose(row_sums[active_rows], 1.0, rtol=1e-5)
+
+
+def test_dense_conv_and_pool_input_grads_flow():
+    """Dense-fallback conv / pooling must keep the values tape link."""
+    from jax.experimental import sparse as jsparse
+    N, H, W, C = 1, 4, 4, 2
+    _, x = _rand_coo((N, H, W), density=0.5, seed=8)
+    feats = paddle.to_tensor(rng.randn(x.nnz, C).astype(np.float32))
+    feats.stop_gradient = False
+    xs = sparse.SparseCooTensor.__new__(sparse.SparseCooTensor)
+    xs._bcoo = jsparse.BCOO((feats._data, x._bcoo.indices),
+                            shape=(N, H, W, C))
+    xs._vals_t = feats
+    w = paddle.to_tensor(rng.randn(3, 3, C, 2).astype(np.float32) * 0.1)
+    w.stop_gradient = False
+    out = sparse.nn.functional.conv2d(xs, w, padding=1)
+    out.values().sum().backward()
+    assert feats.grad is not None
+    assert np.isfinite(np.asarray(feats.grad._data)).all()
+
+
+def test_csr_sum_axis_returns_coo_for_rank1():
+    d1, x = _rand_coo((5, 6), seed=9)
+    csr = x.to_sparse_csr()
+    s = sparse.sum(csr, axis=1)
+    assert isinstance(s, sparse.SparseCooTensor)  # rank-1 cannot be CSR
+    np.testing.assert_allclose(np.asarray(s.to_dense()._data), d1.sum(1),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_top_p_sampling_scalar_ps():
+    probs = np.full((2, 10), 0.1, np.float32)
+    vals, ids = paddle.tensor.top_p_sampling(paddle.to_tensor(probs), 0.9,
+                                             seed=1)
+    assert list(ids.shape) == [2, 1]

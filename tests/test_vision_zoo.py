@@ -1,0 +1,103 @@
+"""Vision model zoo + diffusion UNet. Parity targets:
+`python/paddle/vision/models/` (alexnet/vgg/mobilenet v1-v3/squeezenet/
+shufflenetv2/densenet/googlenet/resnext) and the SD-style UNet rung of
+the BASELINE ladder."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models as M
+
+
+@pytest.fixture(scope="module")
+def img():
+    return paddle.to_tensor(
+        np.random.RandomState(0).randn(1, 3, 64, 64).astype(np.float32))
+
+
+@pytest.mark.parametrize("ctor,params_M", [
+    (lambda: M.alexnet(num_classes=5), 57.0),
+    (lambda: M.vgg11(num_classes=5), 128.8),
+    (lambda: M.mobilenet_v1(scale=0.25, num_classes=5), 0.21),
+    (lambda: M.mobilenet_v3_small(scale=0.5, num_classes=5), 0.63),
+    (lambda: M.mobilenet_v3_large(scale=0.35, num_classes=5), 0.83),
+    (lambda: M.squeezenet1_1(num_classes=5), 0.73),
+    (lambda: M.shufflenet_v2_x1_0(num_classes=5), 1.26),
+    (lambda: M.densenet121(num_classes=5), 6.96),
+    (lambda: M.googlenet(num_classes=5), 5.98),
+    (lambda: M.resnext50_32x4d(num_classes=5), 23.0),
+    (lambda: M.wide_resnet50_2(num_classes=5), 66.8),
+])
+def test_model_forward_and_params(ctor, params_M, img):
+    m = ctor()
+    m.eval()
+    out = m(img)
+    assert list(out.shape) == [1, 5]
+    n = sum(int(np.prod(p.shape)) for p in m.parameters()) / 1e6
+    assert abs(n - params_M) / params_M < 0.25, f"param count {n}M"
+
+
+def test_mobilenet_trains():
+    paddle.seed(0)
+    m = M.mobilenet_v1(scale=0.25, num_classes=3)
+    opt = paddle.optimizer.SGD(0.05, parameters=m.parameters())
+    x = paddle.to_tensor(
+        np.random.RandomState(1).randn(4, 3, 32, 32).astype(np.float32))
+    y = paddle.to_tensor(np.array([0, 1, 2, 1], np.int64))
+    ce = paddle.nn.CrossEntropyLoss()
+    losses = []
+    for _ in range(6):
+        loss = ce(m(x), y)
+        loss.backward()
+        opt.step(); opt.clear_grad()
+        losses.append(float(np.asarray(loss._data)))
+    assert losses[-1] < losses[0]
+
+
+def test_unet_train_and_ddim_sample():
+    from paddle_tpu.models.unet import unet_tiny, GaussianDiffusion
+    paddle.seed(0)
+    m = unet_tiny(in_channels=3, out_channels=3)
+    x = paddle.to_tensor(
+        np.random.RandomState(2).randn(2, 3, 16, 16).astype(np.float32))
+    t = paddle.to_tensor(np.array([3, 7], np.int32))
+    out = m(x, t)
+    assert list(out.shape) == [2, 3, 16, 16]
+    diff = GaussianDiffusion(num_timesteps=20)
+    opt = paddle.optimizer.AdamW(2e-3, parameters=m.parameters())
+    first = last = None
+    for step in range(6):
+        loss = diff.train_loss(m, x)
+        loss.backward()
+        opt.step(); opt.clear_grad()
+        v = float(np.asarray(loss._data))
+        first = v if first is None else first
+        last = v
+    assert np.isfinite(last)
+    img = diff.ddim_sample_loop(m, (1, 3, 16, 16), steps=4)
+    assert list(img.shape) == [1, 3, 16, 16]
+    assert np.isfinite(np.asarray(img._data)).all()
+
+
+def test_unet_to_static_compiles():
+    from paddle_tpu.models.unet import unet_tiny
+    paddle.seed(0)
+    m = unet_tiny(in_channels=1, out_channels=1, base_channels=16)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+
+    def step(x, t, target):
+        eps = m(x, t)
+        loss = ((eps - target) ** 2).mean()
+        loss.backward()
+        opt.step(); opt.clear_grad()
+        return loss
+
+    jstep = paddle.jit.to_static(step, state_objects=[m, opt])
+    x = paddle.to_tensor(
+        np.random.RandomState(3).randn(2, 1, 16, 16).astype(np.float32))
+    t = paddle.to_tensor(np.array([1, 2], np.int32))
+    tgt = paddle.to_tensor(
+        np.random.RandomState(4).randn(2, 1, 16, 16).astype(np.float32))
+    l1 = float(np.asarray(jstep(x, t, tgt)._data))
+    l2 = float(np.asarray(jstep(x, t, tgt)._data))
+    assert np.isfinite(l1) and l2 < l1
