@@ -6,6 +6,8 @@ saved-tensor hooks, no_grad).
 from ..core.autograd import backward, grad, no_grad, enable_grad, is_grad_enabled, set_grad_enabled  # noqa: F401
 from .py_layer import PyLayer, PyLayerContext  # noqa: F401
 from .saved_tensors_hooks import saved_tensors_hooks  # noqa: F401
+from .functional import jacobian, hessian, jvp, vjp, Jacobian, Hessian  # noqa: F401
 
-__all__ = ["backward", "grad", "no_grad", "enable_grad", "PyLayer",
+__all__ = ["jacobian", "hessian", "jvp", "vjp",
+           "backward", "grad", "no_grad", "enable_grad", "PyLayer",
            "PyLayerContext", "saved_tensors_hooks"]

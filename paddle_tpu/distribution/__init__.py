@@ -528,3 +528,24 @@ def _kl_geometric_geometric(p, q):
 def _kl_exponential_exponential(p, q):
     t = jnp.log(p.rate / q.rate) + q.rate / p.rate - 1.0
     return Tensor(t)
+
+
+# distribution tail (transforms, heavy-tailed/count, MVN) — extra.py
+from .extra import (  # noqa: E402,F401
+    Poisson, Cauchy, Chi2, StudentT, Binomial, ContinuousBernoulli,
+    MultivariateNormal, ExponentialFamily, Independent,
+    TransformedDistribution, Transform, AbsTransform, AffineTransform,
+    ChainTransform, ExpTransform, IndependentTransform, PowerTransform,
+    ReshapeTransform, SigmoidTransform, SoftmaxTransform, StackTransform,
+    StickBreakingTransform, TanhTransform,
+)
+
+__all__ += [
+    "Poisson", "Cauchy", "Chi2", "StudentT", "Binomial",
+    "ContinuousBernoulli", "MultivariateNormal", "ExponentialFamily",
+    "Independent", "TransformedDistribution", "Transform", "AbsTransform",
+    "AffineTransform", "ChainTransform", "ExpTransform",
+    "IndependentTransform", "PowerTransform", "ReshapeTransform",
+    "SigmoidTransform", "SoftmaxTransform", "StackTransform",
+    "StickBreakingTransform", "TanhTransform",
+]

@@ -38,6 +38,7 @@ class UNetConfig:
     num_heads: int = 4
     groups: int = 8
     dropout: float = 0.0
+    num_classes: int = 0             # >0 enables class conditioning via y
     learn_sigma: bool = False        # GaussianDiffusion splits eps if True
 
 
@@ -136,6 +137,8 @@ class UNet2DModel(nn.Layer):
         c = self.cfg
         t_dim = c.base_channels * 4
         self.t_embed = TimestepEmbedder(t_dim)
+        self.y_embed = (nn.Embedding(c.num_classes, t_dim)
+                        if c.num_classes > 0 else None)
         self.conv_in = nn.Conv2D(c.in_channels, c.base_channels, 3,
                                  padding=1)
 
@@ -181,6 +184,12 @@ class UNet2DModel(nn.Layer):
 
     def forward(self, x, t, y=None):
         temb = self.t_embed(t)
+        if y is not None:
+            if self.y_embed is None:
+                raise ValueError(
+                    "labels passed but UNetConfig.num_classes == 0 — this "
+                    "UNet is unconditional")
+            temb = temb + self.y_embed(y)
         h = self.conv_in(x)
         hs = [h]
         for blk in self.downs:

@@ -1,0 +1,151 @@
+"""paddle.signal — frame / overlap_add / stft / istft.
+
+Parity: reference `python/paddle/signal.py` (stft:272, istft:449, built
+on frame/overlap_add ops `paddle/phi/kernels/frame_kernel.h`,
+`overlap_add_kernel.h`).
+
+TPU-native: framing is a strided gather and the FFT goes through XLA's
+native FFT lowering; everything is static-shaped, differentiable, and
+jit-friendly. The audio feature stack (audio.Spectrogram etc.) layers on
+the same primitives.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.tensor import Tensor
+from .ops.dispatch import apply_op
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice x into overlapping frames along `axis`.
+
+    axis=-1: (..., seq) -> (..., frame_length, num_frames);
+    axis=0:  (seq, ...) -> (num_frames, frame_length, ...).
+    """
+    def _f(a):
+        if axis in (-1, a.ndim - 1):
+            n = a.shape[-1]
+            num = 1 + (n - frame_length) // hop_length
+            idx = (jnp.arange(frame_length)[:, None]
+                   + hop_length * jnp.arange(num)[None, :])
+            return a[..., idx]
+        if axis == 0:
+            n = a.shape[0]
+            num = 1 + (n - frame_length) // hop_length
+            idx = (hop_length * jnp.arange(num)[:, None]
+                   + jnp.arange(frame_length)[None, :])
+            return a[idx]
+        raise ValueError("frame supports axis 0 or -1")
+    return apply_op("frame", _f, x)
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame: sum overlapping frames.
+
+    axis=-1: (..., frame_length, num_frames) -> (..., seq)."""
+    def _f(a):
+        if axis in (-1, a.ndim - 1):
+            fl, num = a.shape[-2], a.shape[-1]
+            out_len = (num - 1) * hop_length + fl
+            seg = jnp.zeros(a.shape[:-2] + (out_len,), a.dtype)
+            pos = (hop_length * jnp.arange(num)[None, :]
+                   + jnp.arange(fl)[:, None])       # (fl, num)
+            return seg.at[..., pos].add(a)
+        if axis == 0:
+            num, fl = a.shape[0], a.shape[1]
+            out_len = (num - 1) * hop_length + fl
+            seg = jnp.zeros((out_len,) + a.shape[2:], a.dtype)
+            pos = (hop_length * jnp.arange(num)[:, None]
+                   + jnp.arange(fl)[None, :])       # (num, fl)
+            return seg.at[pos].add(a)
+        raise ValueError("overlap_add supports axis 0 or -1")
+    return apply_op("overlap_add", _f, x)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Short-time Fourier transform.
+
+    x: (N, T) or (T,) real (or complex with onesided=False).
+    Returns (N, n_fft//2+1 or n_fft, num_frames) complex.
+    """
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    win = _arr(window) if window is not None else jnp.ones(win_length)
+    if win_length < n_fft:  # center-pad the window to n_fft
+        lpad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+
+    def _f(a, w):
+        is_complex = jnp.iscomplexobj(a)
+        if center:
+            a = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(n_fft // 2,) * 2],
+                        mode=pad_mode)
+        n = a.shape[-1]
+        num = 1 + (n - n_fft) // hop_length
+        idx = (jnp.arange(n_fft)[:, None]
+               + hop_length * jnp.arange(num)[None, :])
+        frames = a[..., idx] * w[:, None]           # (..., n_fft, num)
+        if onesided and not is_complex:
+            spec = jnp.fft.rfft(frames, n=n_fft, axis=-2)
+        else:
+            spec = jnp.fft.fft(frames, n=n_fft, axis=-2)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return spec
+
+    return apply_op("stft", _f, x, Tensor(win))
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT with overlap-add and window-envelope normalization.
+
+    x: (N, freq, num_frames) complex. Round-trips stft for windows
+    satisfying the NOLA constraint.
+    """
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    win = _arr(window) if window is not None else jnp.ones(win_length)
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+
+    def _f(spec, w):
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-2)
+        else:
+            frames = jnp.fft.ifft(spec, n=n_fft, axis=-2)
+            if not return_complex:
+                frames = frames.real
+        frames = frames * w[:, None]
+        num = frames.shape[-1]
+        out_len = (num - 1) * hop_length + n_fft
+        pos = (hop_length * jnp.arange(num)[None, :]
+               + jnp.arange(n_fft)[:, None])
+        sig = jnp.zeros(frames.shape[:-2] + (out_len,),
+                        frames.dtype).at[..., pos].add(frames)
+        env = jnp.zeros(out_len).at[pos.reshape(-1)].add(
+            jnp.tile((w ** 2)[:, None], (1, num)).reshape(-1))
+        sig = sig / jnp.maximum(env, 1e-11)
+        if center:
+            sig = sig[..., n_fft // 2:out_len - n_fft // 2]
+        if length is not None:
+            if sig.shape[-1] < length:  # frames don't cover the tail
+                sig = jnp.pad(sig, [(0, 0)] * (sig.ndim - 1)
+                              + [(0, length - sig.shape[-1])])
+            sig = sig[..., :length]
+        return sig
+
+    return apply_op("istft", _f, x, Tensor(win))

@@ -183,7 +183,9 @@ def _dense_conv(x: SparseCooTensor, weight, bias, stride, padding, dilation,
     dense_shape = tuple(x.shape)
 
     def _densify(v):
-        return jnp.zeros(dense_shape, v.dtype).at[pos].set(v)
+        # .add (not .set): un-coalesced COO duplicates must sum, matching
+        # todense() semantics
+        return jnp.zeros(dense_shape, v.dtype).at[pos].add(v)
 
     dense_t = apply_op("sparse_to_dense", _densify, x.values())
     args = [dense_t, weight]

@@ -11,8 +11,9 @@ import numpy as np
 
 from ..core.tensor import Tensor
 from ..ops.dispatch import apply_op
+from ..nn.layer.layers import Layer as _Layer
 
-__all__ = ["nms", "roi_align", "box_area", "box_iou", "psroi_pool", "roi_pool"]
+__all__ = ["nms", "roi_align", "box_area", "box_iou", "psroi_pool", "roi_pool", "deform_conv2d", "DeformConv2D"]
 
 
 def box_area(boxes):
@@ -138,3 +139,105 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
 
 def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
     raise NotImplementedError("psroi_pool planned (position-sensitive variant)")
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1/v2 (mask=None -> v1).
+
+    Parity: `python/paddle/vision/ops.py` deform_conv2d over
+    `phi/kernels/deformable_conv_kernel.h`. x (B, Cin, H, W); offset
+    (B, 2*dg*kh*kw, Ho, Wo) in (dy, dx) pairs; mask (B, dg*kh*kw, Ho, Wo).
+
+    TPU-native: bilinear sampling as four gathers + weighted sum (vs the
+    reference's per-thread CUDA im2col), then one grouped einsum on the
+    MXU. Fully differentiable and jit-friendly (static shapes).
+    """
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    d = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+
+    def _f(xa, off, w, *rest):
+        rest = list(rest)
+        mk = rest.pop(0) if mask is not None else None
+        b_ = rest.pop(0) if bias is not None else None
+        B, Cin, H, W = xa.shape
+        Cout, Cin_g, kh, kw = w.shape
+        K = kh * kw
+        dg = deformable_groups
+        Ho = (H + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
+        Wo = (W + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
+        off = off.reshape(B, dg, K, 2, Ho, Wo)
+        # base sampling grid per kernel tap
+        ky, kx = jnp.meshgrid(jnp.arange(kh), jnp.arange(kw), indexing="ij")
+        base_y = (s[0] * jnp.arange(Ho)[None, :, None] - p[0]
+                  + d[0] * ky.reshape(K, 1, 1))          # (K, Ho, 1)
+        base_x = (s[1] * jnp.arange(Wo)[None, None, :] - p[1]
+                  + d[1] * kx.reshape(K, 1, 1))          # (K, 1, Wo)
+        ys = base_y + off[:, :, :, 0]                    # (B, dg, K, Ho, Wo)
+        xs = base_x + off[:, :, :, 1]
+
+        y0 = jnp.floor(ys); x0 = jnp.floor(xs)
+        wy = ys - y0; wx = xs - x0
+
+        def gather(yy, xx):
+            inb = ((yy >= 0) & (yy <= H - 1) & (xx >= 0) & (xx <= W - 1))
+            yc = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+            xc = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+            # channels split across deformable groups
+            xg = xa.reshape(B, dg, Cin // dg, H, W)
+            flat = xg.reshape(B, dg, Cin // dg, H * W)
+            lin = (yc * W + xc).reshape(B, dg, -1)       # (B, dg, K*Ho*Wo)
+            got = jnp.take_along_axis(flat, lin[:, :, None, :], axis=3)
+            got = got.reshape(B, dg, Cin // dg, K, Ho, Wo)
+            return got * inb[:, :, None].astype(xa.dtype)
+
+        v = (gather(y0, x0) * ((1 - wy) * (1 - wx))[:, :, None]
+             + gather(y0, x0 + 1) * ((1 - wy) * wx)[:, :, None]
+             + gather(y0 + 1, x0) * (wy * (1 - wx))[:, :, None]
+             + gather(y0 + 1, x0 + 1) * (wy * wx)[:, :, None])
+        if mk is not None:
+            v = v * mk.reshape(B, dg, 1, K, Ho, Wo).astype(xa.dtype)
+        v = v.reshape(B, Cin, K, Ho, Wo)
+        # grouped contraction: (B, g, Cin/g, K, Ho, Wo) x (g, Cout/g, Cin/g, K)
+        vg = v.reshape(B, groups, Cin // groups, K, Ho, Wo)
+        wg = w.reshape(groups, Cout // groups, Cin_g, kh * kw)
+        out = jnp.einsum("bgckhw,gock->bgohw", vg, wg)
+        out = out.reshape(B, Cout, Ho, Wo)
+        if b_ is not None:
+            out = out + b_.reshape(1, Cout, 1, 1)
+        return out
+
+    args = [x, offset, weight]
+    if mask is not None:
+        args.append(mask)
+    if bias is not None:
+        args.append(bias)
+    return apply_op("deform_conv2d", _f, *args)
+
+
+class DeformConv2D(_Layer):
+    """Layer over deform_conv2d (parity: paddle.vision.ops.DeformConv2D) —
+    a real nn.Layer so parent models see its parameters."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        kh, kw = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._cfg = dict(stride=stride, padding=padding, dilation=dilation,
+                         deformable_groups=deformable_groups, groups=groups)
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups, kh, kw), attr=weight_attr)
+        self.add_parameter("weight", self.weight)
+        self.bias = None if bias_attr is False else \
+            self.create_parameter((out_channels,), attr=bias_attr,
+                                  is_bias=True)
+        if self.bias is not None:
+            self.add_parameter("bias", self.bias)
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             mask=mask, **self._cfg)

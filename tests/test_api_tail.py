@@ -1,0 +1,228 @@
+"""API tail: paddle.signal (stft/istft), autograd functional
+(jacobian/hessian/jvp/vjp), distribution tail (heavy-tailed, MVN,
+transforms), deform_conv2d. Parity targets: `python/paddle/signal.py`,
+`python/paddle/autograd/autograd.py`, `python/paddle/distribution/`,
+`python/paddle/vision/ops.py` deform_conv2d."""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+rng = np.random.RandomState(0)
+
+
+# ------------------------------------------------------------------ signal
+def test_stft_istft_roundtrip():
+    x = rng.randn(2, 2048).astype(np.float32)
+    win = np.hanning(256).astype(np.float32)
+    X = paddle.signal.stft(paddle.to_tensor(x), n_fft=256, hop_length=64,
+                           window=paddle.to_tensor(win))
+    assert list(X.shape) == [2, 129, 1 + (2048 // 64)]
+    y = paddle.signal.istft(X, n_fft=256, hop_length=64,
+                            window=paddle.to_tensor(win), length=2048)
+    np.testing.assert_allclose(np.asarray(y._data), x, atol=1e-4)
+
+
+def test_stft_matches_scipy_magnitude():
+    import scipy.signal as ss
+    x = rng.randn(1000).astype(np.float32)
+    win = np.hanning(128).astype(np.float32)
+    X = paddle.signal.stft(paddle.to_tensor(x), n_fft=128, hop_length=32,
+                           window=paddle.to_tensor(win))
+    _, _, Z = ss.stft(x, nperseg=128, noverlap=96, window=win,
+                      boundary="even", padded=False)
+    # scipy normalizes by win.sum(); compare normalized magnitudes
+    a = np.abs(np.asarray(X._data))
+    b = np.abs(Z) * win.sum()
+    np.testing.assert_allclose(a[:, 1:-1], b[:, 1:-1], atol=2e-3)
+
+
+def test_frame_overlap_add_roundtrip():
+    x = rng.randn(3, 640).astype(np.float32)
+    fr = paddle.signal.frame(paddle.to_tensor(x), 128, 128)  # no overlap
+    rec = paddle.signal.overlap_add(fr, 128)
+    np.testing.assert_allclose(np.asarray(rec._data), x[:, :640], atol=1e-6)
+
+
+def test_stft_gradients():
+    x = paddle.to_tensor(rng.randn(512).astype(np.float32))
+    x.stop_gradient = False
+    X = paddle.signal.stft(x, n_fft=128, hop_length=64)
+    (X.abs() ** 2).sum().backward()
+    assert x.grad is not None and np.isfinite(np.asarray(x.grad._data)).all()
+
+
+# ----------------------------------------------------- autograd functional
+def test_jacobian_single():
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    x.stop_gradient = False
+    y = x ** 2
+    J = paddle.autograd.jacobian(y, x)
+    np.testing.assert_allclose(np.asarray(J._data),
+                               np.diag([2.0, 4.0, 6.0]), rtol=1e-5)
+
+
+def test_jacobian_batched():
+    xb = paddle.to_tensor(rng.randn(4, 3).astype(np.float32))
+    xb.stop_gradient = False
+    J = paddle.autograd.jacobian(xb ** 3, xb, batch_axis=0)
+    ref = np.stack([np.diag(3 * np.asarray(xb._data)[b] ** 2)
+                    for b in range(4)])
+    np.testing.assert_allclose(np.asarray(J._data), ref, rtol=1e-4)
+
+
+def test_hessian():
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    x.stop_gradient = False
+    y = (x ** 3).sum()
+    H = paddle.autograd.hessian(y, x)
+    np.testing.assert_allclose(np.asarray(H._data),
+                               np.diag([6.0, 12.0]), rtol=1e-5)
+
+
+def test_jvp_vjp():
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+
+    def f(a):
+        return (a ** 2).sum()
+
+    ys, g = paddle.autograd.vjp(f, x)
+    np.testing.assert_allclose(np.asarray(g._data), [2.0, 4.0], rtol=1e-5)
+    x2 = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    ys, jv = paddle.autograd.jvp(
+        lambda a: a * a, x2,
+        paddle.to_tensor(np.array([1.0, 1.0], np.float32)))
+    np.testing.assert_allclose(np.asarray(jv._data), [2.0, 4.0], rtol=1e-5)
+
+
+# ----------------------------------------------------------- distributions
+def test_cauchy_and_kl():
+    c = D.Cauchy(0.0, 2.0)
+    lp = float(np.asarray(c.log_prob(paddle.to_tensor(1.0))._data))
+    assert abs(lp - st.cauchy.logpdf(1.0, 0.0, 2.0)) < 1e-5
+    kl = float(np.asarray(
+        D.kl_divergence(D.Cauchy(0.0, 1.0), D.Cauchy(1.0, 2.0))._data))
+    ref = math.log(((1 + 2) ** 2 + 1) / (4 * 1 * 2))
+    assert abs(kl - ref) < 1e-5
+
+
+def test_student_t_chi2_poisson_binomial():
+    t = D.StudentT(4.0, 1.0, 2.0)
+    lp = float(np.asarray(t.log_prob(paddle.to_tensor(0.5))._data))
+    assert abs(lp - st.t.logpdf(0.5, 4.0, 1.0, 2.0)) < 1e-5
+    chi = D.Chi2(6.0)
+    lp = float(np.asarray(chi.log_prob(paddle.to_tensor(3.0))._data))
+    assert abs(lp - st.chi2.logpdf(3.0, 6.0)) < 1e-5
+    po = D.Poisson(2.5)
+    lp = float(np.asarray(po.log_prob(paddle.to_tensor(3.0))._data))
+    assert abs(lp - st.poisson.logpmf(3, 2.5)) < 1e-5
+    bi = D.Binomial(10.0, 0.3)
+    lp = float(np.asarray(bi.log_prob(paddle.to_tensor(4.0))._data))
+    assert abs(lp - st.binom.logpmf(4, 10, 0.3)) < 1e-5
+    ent = float(np.asarray(bi.entropy()._data))
+    assert abs(ent - st.binom.entropy(10, 0.3)) < 1e-4
+
+
+def test_mvn_logprob_entropy_kl():
+    cov = np.array([[2.0, 0.5], [0.5, 1.0]], np.float32)
+    mvn = D.MultivariateNormal(np.zeros(2, np.float32),
+                               covariance_matrix=cov)
+    v = np.array([0.3, -0.4], np.float32)
+    lp = float(np.asarray(mvn.log_prob(paddle.to_tensor(v))._data))
+    assert abs(lp - st.multivariate_normal.logpdf(v, np.zeros(2), cov)) < 1e-5
+    ent = float(np.asarray(mvn.entropy()._data))
+    assert abs(ent - st.multivariate_normal.entropy(np.zeros(2), cov)) < 1e-5
+    q = D.MultivariateNormal(np.ones(2, np.float32),
+                             covariance_matrix=np.eye(2, dtype=np.float32))
+    kl = float(np.asarray(D.kl_divergence(mvn, q)._data))
+    # closed form for gaussians
+    ref = 0.5 * (np.trace(cov) + 2  # maha with identity q cov
+                 - 2 - np.log(np.linalg.det(cov)))
+    assert abs(kl - ref) < 1e-5
+
+
+def test_transformed_distribution_matches_lognormal():
+    td = D.TransformedDistribution(D.Normal(0.3, 0.8), [D.ExpTransform()])
+    ln = D.LogNormal(0.3, 0.8)
+    for v in (0.5, 1.0, 2.5):
+        a = float(np.asarray(td.log_prob(paddle.to_tensor(v))._data))
+        b = float(np.asarray(ln.log_prob(paddle.to_tensor(v))._data))
+        assert abs(a - b) < 1e-5
+
+
+def test_transforms_roundtrip_and_ldj():
+    for tr in (D.ExpTransform(), D.SigmoidTransform(), D.TanhTransform(),
+               D.AffineTransform(1.0, 2.5), D.PowerTransform(3.0)):
+        x = paddle.to_tensor(np.array([0.3, 0.7], np.float32))
+        y = tr.forward(x)
+        back = tr.inverse(y)
+        np.testing.assert_allclose(np.asarray(back._data),
+                                   np.asarray(x._data), rtol=1e-4)
+        # ldj vs numeric dy/dx
+        ldj = np.asarray(tr.forward_log_det_jacobian(x)._data)
+        eps = 1e-4
+        y1 = np.asarray(tr.forward(
+            paddle.to_tensor(np.array([0.3 + eps, 0.7 + eps],
+                                      np.float32)))._data)
+        num = np.log(np.abs((y1 - np.asarray(y._data)) / eps))
+        np.testing.assert_allclose(ldj, num, atol=1e-2)
+
+
+def test_stickbreaking_simplex():
+    tr = D.StickBreakingTransform()
+    x = paddle.to_tensor(rng.randn(5, 3).astype(np.float32))
+    y = np.asarray(tr.forward(x)._data)
+    assert y.shape == (5, 4)
+    np.testing.assert_allclose(y.sum(-1), 1.0, rtol=1e-5)
+    assert (y > 0).all()
+    back = np.asarray(tr.inverse(paddle.to_tensor(y))._data)
+    np.testing.assert_allclose(back, np.asarray(x._data), atol=1e-4)
+
+
+def test_independent_sums_event_dims():
+    base = D.Normal(np.zeros((3, 4), np.float32),
+                    np.ones((3, 4), np.float32))
+    ind = D.Independent(base, 1)
+    v = paddle.to_tensor(rng.randn(3, 4).astype(np.float32))
+    lp = np.asarray(ind.log_prob(v)._data)
+    ref = np.asarray(base.log_prob(v)._data).sum(-1)
+    np.testing.assert_allclose(lp, ref, rtol=1e-5)
+
+
+# ------------------------------------------------------------ deform conv
+def test_deform_conv2d_zero_offset_is_conv():
+    from paddle_tpu.vision.ops import deform_conv2d
+    B, Cin, H, W, Cout, k = 1, 3, 6, 6, 4, 3
+    x = rng.randn(B, Cin, H, W).astype(np.float32)
+    w = rng.randn(Cout, Cin, k, k).astype(np.float32) * 0.2
+    off = np.zeros((B, 2 * k * k, H, W), np.float32)
+    out = deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                        paddle.to_tensor(w), padding=1)
+    ref = jax.lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w), (1, 1), [(1, 1)] * 2,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    np.testing.assert_allclose(np.asarray(out._data), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_deform_conv2d_mask_and_grads():
+    from paddle_tpu.vision.ops import deform_conv2d
+    B, Cin, H, W, Cout, k = 1, 2, 5, 5, 3, 3
+    x = paddle.to_tensor(rng.randn(B, Cin, H, W).astype(np.float32))
+    off = paddle.to_tensor(
+        (rng.rand(B, 2 * k * k, H, W).astype(np.float32) - 0.5))
+    mask = paddle.to_tensor(rng.rand(B, k * k, H, W).astype(np.float32))
+    w = paddle.to_tensor(rng.randn(Cout, Cin, k, k).astype(np.float32))
+    for t in (x, off, mask, w):
+        t.stop_gradient = False
+    out = deform_conv2d(x, off, w, padding=1, mask=mask)
+    out.sum().backward()
+    for t in (x, off, mask, w):
+        assert t.grad is not None
+        assert np.isfinite(np.asarray(t.grad._data)).all()
