@@ -102,7 +102,9 @@ def hessian(ys, xs, batch_axis=None):
     xs_l = _tensors(xs)
     if batch_axis not in (None, 0):
         raise ValueError("batch_axis must be None or 0")
-    first = _grad([ys], xs_l, create_graph=True, allow_unused=False)
+    seed = Tensor(jnp.ones(tuple(ys.shape), ys._data.dtype))
+    first = _grad([ys], xs_l, grad_outputs=[seed], create_graph=True,
+                  allow_unused=False)
     out = []
     for j, g in enumerate(first):
         out.append(jacobian(g, xs_l[j], batch_axis=batch_axis))
@@ -123,7 +125,9 @@ def vjp(func, xs, v=None):
     ys = func(*xs_l)
     ys_l = _tensors(ys)
     if v is None:
-        v_l = None
+        # reference contract: v=None means all-ones cotangents
+        v_l = [Tensor(jnp.ones(tuple(y.shape), y._data.dtype))
+               for y in ys_l]
     else:
         v_l = _tensors(v)
     gs = _grad(ys_l, xs_l, grad_outputs=v_l, retain_graph=True,

@@ -65,10 +65,15 @@ class Poisson(ExponentialFamily):
                         self._param(self._rate_p, self.rate), value)
 
     def entropy(self):
-        # series approximation matching the reference's formulation:
-        # rate*(1-log(rate)) + exp(-rate)*sum_{k} rate^k log(k!)/k!
+        # series: rate*(1-log rate) + exp(-rate) * sum_k rate^k log(k!)/k!
+        # with a RATE-DEPENDENT support bound (the summand peaks near
+        # k ~ rate; reference poisson.py enumerates bounded support too)
+        import numpy as _np
+        rmax = float(_np.max(_np.asarray(self.rate)))
+        kmax = int(max(30, _np.ceil(rmax + 12 * _np.sqrt(rmax) + 10)))
+
         def _f(r):
-            ks = jnp.arange(1.0, 31.0)
+            ks = jnp.arange(1.0, kmax + 1.0)
             lgk = jax.scipy.special.gammaln(ks + 1)
             terms = jnp.exp(ks[(None,) * r.ndim + (slice(None),)]
                             * jnp.log(r)[..., None]
@@ -262,8 +267,9 @@ class ContinuousBernoulli(Distribution):
         lo, hi = self._lims
         safe = jnp.where((p > lo) & (p < hi), 0.25, p)
         c = (2 * jnp.arctanh(1 - 2 * safe)) / (1 - 2 * safe)
-        # 2nd-order Taylor around 0.5: C = 2 + (4/3)(p-1/2)^2 ...
-        taylor = 2.0 + (16.0 / 3.0) * (p - 0.5) ** 2
+        # 2nd-order Taylor of 2*atanh(1-2p)/(1-2p) around p=1/2:
+        # with t = 1-2p, = 2 + 2t^2/3 = 2 + (8/3)(p-1/2)^2
+        taylor = 2.0 + (8.0 / 3.0) * (p - 0.5) ** 2
         return jnp.log(jnp.where((p > lo) & (p < hi), taylor, c))
 
     def sample(self, shape=()):
@@ -593,10 +599,14 @@ class ChainTransform(Transform):
         return y
 
     def _fldj(self, x):
+        # reduce every per-transform ldj to the chain's batch frame before
+        # summing (mixed event dims would otherwise broadcast wrongly)
+        batch_ndim = x.ndim - self._domain_event_dim
         total = None
         for t in self.transforms:
             ld = t._fldj(x)
-            # reduce per-transform event dims to the chain's event frame
+            if ld.ndim > batch_ndim:
+                ld = ld.sum(axis=tuple(range(batch_ndim, ld.ndim)))
             total = ld if total is None else total + ld
             x = t._forward(x)
         return total
@@ -686,12 +696,13 @@ class TransformedDistribution(Distribution):
         super().__init__(base.batch_shape, base.event_shape)
 
     def sample(self, shape=()):
-        t = self.rsample(shape) if hasattr(self.base, "rsample") else None
-        if t is None:
-            x = self.base.sample(shape)
+        try:
+            t = self.rsample(shape)
+        except NotImplementedError:
+            # non-reparameterizable base: detached sample + forward
+            t = self.base.sample(shape)
             for tr in self.transforms:
-                x = tr.forward(x)
-            t = x
+                t = tr.forward(t)
         t.stop_gradient = True
         return Tensor(t._data)
 
@@ -705,13 +716,19 @@ class TransformedDistribution(Distribution):
         y = value
 
         def _chain(v):
-            ldj = jnp.zeros(())
-            event_dim = 0
+            lds = []
             for tr in reversed(self.transforms):
                 x = tr._inverse(v)
-                ld = tr._fldj(x)
-                ldj = ldj + ld
+                lds.append(tr._fldj(x))
                 v = x
+            # v is now in the base frame: reduce every ldj to the base
+            # batch shape before summing
+            batch_ndim = v.ndim - len(self.base.event_shape)
+            ldj = jnp.zeros(())
+            for ld in lds:
+                if ld.ndim > batch_ndim:
+                    ld = ld.sum(axis=tuple(range(batch_ndim, ld.ndim)))
+                ldj = ldj + ld
             return v, ldj
 
         def _f(v):

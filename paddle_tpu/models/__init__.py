@@ -10,5 +10,6 @@ from .ernie import (ErnieConfig, ErnieModel, ErnieForMaskedLM,  # noqa: F401
 from .dit import (DiTConfig, DiT, GaussianDiffusion, dit_tiny,  # noqa: F401
                   dit_s_2, dit_xl_2)
 from .unet import UNetConfig, UNet2DModel, unet_tiny  # noqa: F401
+from .generation import jit_generate  # noqa: F401
 from .qwen2_moe import (Qwen2MoeConfig, Qwen2MoeForCausalLM,  # noqa: F401
                         qwen2_moe_tiny, qwen2_moe_a14b)

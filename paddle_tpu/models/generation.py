@@ -1,0 +1,140 @@
+"""Compiled autoregressive generation: one jit program for the whole
+decode loop.
+
+Capability parity: the reference serves generation through PaddleNLP's
+`generate` + the fused serving kernels (`block_multi_head_attention`,
+`masked_multihead_attention`, `top_p_sampling` — SURVEY.md A.2); this is
+the framework-native equivalent.
+
+TPU-first design: the KV cache is a FIXED-size buffer written at a
+position (no per-step reallocation/recompile); prefill + every decode
+step + sampling live inside ONE `jax.jit` whose decode loop is a
+`lax.while_loop` with early exit when every sequence hit EOS. Sampling
+supports temperature / top-k / top-p (nucleus) entirely on device — no
+host sync until the final buffer readback. Compiled programs are cached
+per (model, B, S0, N, sampling config).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["jit_generate"]
+
+_PROGRAM_CACHE = {}
+
+
+def _sample_arr(logits, key, temperature, top_k, top_p):
+    """(B, V) logits -> (B,) int32 token ids, pure-array."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    lg = logits.astype(jnp.float32) / temperature
+    V = lg.shape[-1]
+    if top_k and top_k < V:
+        kth = jax.lax.top_k(lg, top_k)[0][..., -1:]
+        lg = jnp.where(lg < kth, -jnp.inf, lg)
+    if top_p < 1.0:
+        sorted_lg = jnp.sort(lg, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_lg, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # smallest logit still inside the nucleus
+        keep = (cum - probs) < top_p
+        kth = jnp.max(jnp.where(keep, sorted_lg, -jnp.inf), axis=-1,
+                      keepdims=True)
+        lg = jnp.where(lg < kth, -jnp.inf, lg)
+    return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
+
+
+def _build_program(model, B, S0, N, temperature, top_k, top_p, eos):
+    from ..jit.api import functional_call
+
+    L = model.cfg.num_hidden_layers
+    KV = model.cfg.num_key_value_heads
+    D = model.cfg.hidden_size // model.cfg.num_attention_heads
+    MAX = S0 + N
+    param_dtype = next(iter(model.state_dict().values()))._data.dtype
+
+    def run_model(state_a, ids, caches_a, pos):
+        st = {k: Tensor(v) for k, v in state_a.items()}
+        caches_t = [(Tensor(kc), Tensor(vc)) for kc, vc in caches_a]
+        logits, new_caches = functional_call(
+            model, st, Tensor(ids), caches=caches_t, cache_pos=pos)
+        return (logits._data,
+                [(c[0]._data, c[1]._data) for c in new_caches])
+
+    def program(state_a, ids, key):
+        caches = [(jnp.zeros((B, MAX, KV, D), param_dtype),
+                   jnp.zeros((B, MAX, KV, D), param_dtype))
+                  for _ in range(L)]
+        logits, caches = run_model(state_a, ids, caches, jnp.int32(0))
+        key, k0 = jax.random.split(key)
+        tok = _sample_arr(logits[:, -1], k0, temperature, top_k, top_p)
+        # pre-fill the generated region with eos (or 0) so an early
+        # all-done exit leaves correct padding without extra writes
+        fill = eos if eos is not None else 0
+        ids_buf = jnp.concatenate(
+            [ids, jnp.full((B, N), fill, ids.dtype)], axis=1)
+        ids_buf = jax.lax.dynamic_update_slice(
+            ids_buf, tok[:, None].astype(ids.dtype),
+            (jnp.int32(0), jnp.int32(S0)))
+        done = (tok == eos) if eos is not None else jnp.zeros((B,), bool)
+
+        def cond(carry):
+            _, _, _, t, _, done = carry
+            return jnp.logical_and(t < N - 1,
+                                   jnp.logical_not(jnp.all(done)))
+
+        def body(carry):
+            ids_buf, caches, tok, t, key, done = carry
+            logits, caches = run_model(
+                state_a, tok[:, None].astype(ids.dtype), caches,
+                (S0 + t).astype(jnp.int32))
+            key, kn = jax.random.split(key)
+            nxt = _sample_arr(logits[:, 0], kn, temperature, top_k, top_p)
+            if eos is not None:
+                nxt = jnp.where(done, jnp.int32(eos), nxt)
+                done = jnp.logical_or(done, nxt == eos)
+            ids_buf = jax.lax.dynamic_update_slice(
+                ids_buf, nxt[:, None].astype(ids.dtype),
+                (jnp.int32(0), (S0 + t + 1).astype(jnp.int32)))
+            return ids_buf, caches, nxt, t + 1, key, done
+
+        ids_buf, _, _, _, _, _ = jax.lax.while_loop(
+            cond, body, (ids_buf, caches, tok, jnp.int32(0), key, done))
+        return ids_buf
+
+    return jax.jit(program)
+
+
+def jit_generate(model, input_ids, max_new_tokens=32, temperature=0.0,
+                 top_k=0, top_p=1.0, eos_token_id=None, seed=None):
+    """Generate with the whole decode loop compiled into one XLA program.
+
+    model: a causal LM whose forward supports (input_ids, caches=...,
+    cache_pos=...) fixed-buffer decoding (models/llama.py). Returns
+    (B, S0 + max_new_tokens) ids; sequences that hit eos are padded with
+    eos.
+    """
+    from ..core.autograd import no_grad
+    from ..framework.random import rng_key
+
+    ids = input_ids._data if isinstance(input_ids, Tensor) else \
+        jnp.asarray(input_ids)
+    B, S0 = ids.shape
+    cache_key = (id(model), B, S0, int(max_new_tokens), float(temperature),
+                 int(top_k), float(top_p), eos_token_id)
+    prog = _PROGRAM_CACHE.get(cache_key)
+    if prog is None:
+        prog = _build_program(model, B, S0, int(max_new_tokens),
+                              float(temperature), int(top_k), float(top_p),
+                              eos_token_id)
+        if len(_PROGRAM_CACHE) >= 16:   # bounded: evict oldest program
+            _PROGRAM_CACHE.pop(next(iter(_PROGRAM_CACHE)))
+        _PROGRAM_CACHE[cache_key] = prog
+    with no_grad():
+        state_a = {k: t._data for k, t in model.state_dict().items()}
+        key = (jax.random.PRNGKey(seed) if seed is not None else rng_key())
+        out = prog(state_a, ids, key)
+    return Tensor(out)

@@ -110,26 +110,56 @@ class LlamaAttention(nn.Layer):
         self.o_proj = RowParallelLinear(h, h, has_bias=config.use_bias,
                                         input_is_parallel=True)
 
-    def forward(self, x, cos, sin, cache=None):
+    def forward(self, x, cos, sin, cache=None, cache_pos=None):
         b, s, _ = x.shape
         q = M.reshape(self.q_proj(x), [b, s, self.n_heads, self.head_dim])
         k = M.reshape(self.k_proj(x), [b, s, self.n_kv, self.head_dim])
         v = M.reshape(self.v_proj(x), [b, s, self.n_kv, self.head_dim])
         q = apply_op("rope", apply_rotary, q, cos, sin)
         k = apply_op("rope", apply_rotary, k, cos, sin)
-        if cache is not None:
+        if cache is not None and cache_pos is not None:
+            # fixed-size cache buffers + write position: the jit-compiled
+            # decode path (generate) — buffer shape never changes, so one
+            # compiled program serves every step (lax.while_loop-able)
+            pk, pv = cache
+            pos = jnp.asarray(cache_pos, jnp.int32)
+
+            def _write(buf, new):
+                return jax.lax.dynamic_update_slice(
+                    buf, new.astype(buf.dtype),
+                    (jnp.int32(0), pos, jnp.int32(0), jnp.int32(0)))
+
+            k = apply_op("cache_write", _write, pk, k)
+            v = apply_op("cache_write", _write, pv, v)
+            cache = (k, v)
+            max_len = int(pk.shape[1])
+
+            def _mask(_q):
+                qpos = pos + jnp.arange(s, dtype=jnp.int32)
+                kpos = jnp.arange(max_len, dtype=jnp.int32)
+                return (kpos[None, :] <= qpos[:, None])[None, None]
+
+            mask = apply_op("cache_mask", _mask, q)
+        elif cache is not None:
             pk, pv = cache
             k = M.concat([pk, k], axis=1)
             v = M.concat([pv, v], axis=1)
             cache = (k, v)
+            mask = None
+        else:
+            mask = None
         if self.n_kv != self.n_heads:
             rep = self.n_heads // self.n_kv
             k = apply_op("repeat_kv", lambda a: jnp.repeat(a, rep, axis=2), k)
             v = apply_op("repeat_kv", lambda a: jnp.repeat(a, rep, axis=2), v)
         # causal whenever we score more than one query position (prefill with
         # a cache included); single-token decode needs no mask. The sdpa
-        # causal mask is key-offset-aware (tril with k=sk-sq).
-        out = F.scaled_dot_product_attention(q, k, v, is_causal=(s > 1))
+        # causal mask is key-offset-aware (tril with k=sk-sq). The fixed-
+        # buffer path encodes causality + validity in its own bool mask.
+        if mask is not None:
+            out = F.scaled_dot_product_attention(q, k, v, attn_mask=mask)
+        else:
+            out = F.scaled_dot_product_attention(q, k, v, is_causal=(s > 1))
         out = M.reshape(out, [b, s, self.n_heads * self.head_dim])
         out = self.o_proj(out)
         return (out, cache) if cache is not None else out
@@ -160,10 +190,10 @@ class LlamaDecoderLayer(nn.Layer):
                                                    epsilon=config.rms_norm_eps)
         self.mlp = LlamaMLP(config)
 
-    def forward(self, x, cos, sin, cache=None):
+    def forward(self, x, cos, sin, cache=None, cache_pos=None):
         h = self.input_layernorm(x)
         if cache is not None:
-            attn, cache = self.self_attn(h, cos, sin, cache)
+            attn, cache = self.self_attn(h, cos, sin, cache, cache_pos)
         else:
             attn = self.self_attn(h, cos, sin)
         x = x + attn
@@ -186,9 +216,12 @@ class LlamaModel(nn.Layer):
         self.register_buffer("rope_cos", Tensor(cos), persistable=False)
         self.register_buffer("rope_sin", Tensor(sin), persistable=False)
 
-    def forward(self, input_ids, caches=None):
+    def forward(self, input_ids, caches=None, cache_pos=None):
         s = input_ids.shape[1]
-        past = caches[0][0].shape[1] if caches is not None else 0
+        if cache_pos is not None:
+            past = jnp.asarray(cache_pos, jnp.int32)
+        else:
+            past = caches[0][0].shape[1] if caches is not None else 0
         cos = apply_op("rope_slice",
                        lambda c: jax.lax.dynamic_slice_in_dim(c, past, s, 0),
                        self.rope_cos)
@@ -202,7 +235,7 @@ class LlamaModel(nn.Layer):
         new_caches = []
         for i, layer in enumerate(self.layers):
             if caches is not None:
-                x, c = layer(x, cos, sin, caches[i])
+                x, c = layer(x, cos, sin, caches[i], cache_pos)
                 new_caches.append(c)
             elif self.cfg.recompute:
                 x = _recompute_layer(layer, x, cos, sin)
@@ -270,9 +303,9 @@ class LlamaForCausalLM(nn.Layer):
                 config.hidden_size, config.vocab_size, has_bias=False,
                 gather_output=False)
 
-    def forward(self, input_ids, labels=None, caches=None):
+    def forward(self, input_ids, labels=None, caches=None, cache_pos=None):
         if caches is not None:
-            h, caches = self.model(input_ids, caches)
+            h, caches = self.model(input_ids, caches, cache_pos)
         else:
             h = self.model(input_ids)
         tied = self.model.embed_tokens.weight if self.lm_head is None else None
@@ -283,8 +316,21 @@ class LlamaForCausalLM(nn.Layer):
 
     # -------------------------------------------------------- generation
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
-                 top_k=0, eos_token_id=None):
-        """Greedy/sampled decode with KV cache (eager loop)."""
+                 top_k=0, top_p=1.0, eos_token_id=None, use_jit=False,
+                 seed=None):
+        """Greedy/sampled decode with KV cache.
+
+        use_jit=True compiles prefill + the full decode loop + sampling
+        into ONE XLA program over a fixed-size cache
+        (models/generation.py jit_generate — the TPU-native serving
+        path); the default eager loop re-dispatches per step."""
+        if use_jit:
+            from .generation import jit_generate
+            return jit_generate(self, input_ids,
+                                max_new_tokens=max_new_tokens,
+                                temperature=temperature, top_k=top_k,
+                                top_p=top_p, eos_token_id=eos_token_id,
+                                seed=seed)
         from ..core.autograd import no_grad
         from ..framework.random import rng_key
         with no_grad():

@@ -174,3 +174,41 @@ def test_load_paddlenlp_and_hf_checkpoints():
         assert not unexpected, unexpected
         np.testing.assert_allclose(np.asarray(dst(ids)._data), ref,
                                    atol=1e-5)
+
+
+def test_jit_generate_matches_eager_greedy():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+    paddle.seed(0)
+    m = LlamaForCausalLM(llama_tiny())
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, 256, (2, 9)).astype(np.int64))
+    a = np.asarray(m.generate(ids, max_new_tokens=6)._data)
+    b = np.asarray(m.generate(ids, max_new_tokens=6, use_jit=True)._data)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_jit_generate_eos_padding_and_sampling():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+    paddle.seed(1)
+    m = LlamaForCausalLM(llama_tiny())
+    ids = paddle.to_tensor(
+        np.random.RandomState(1).randint(0, 256, (2, 5)).astype(np.int64))
+    out = m.generate(ids, max_new_tokens=8, use_jit=True, eos_token_id=3)
+    o = np.asarray(out._data)
+    assert o.shape == (2, 13)
+    # after the first eos in the generated region, everything is eos
+    for row in o:
+        gen = row[5:]
+        hits = np.where(gen == 3)[0]
+        if hits.size:
+            assert (gen[hits[0]:] == 3).all()
+    s1 = m.generate(ids, max_new_tokens=8, use_jit=True, temperature=0.7,
+                    top_k=10, top_p=0.9, seed=11)
+    s2 = m.generate(ids, max_new_tokens=8, use_jit=True, temperature=0.7,
+                    top_k=10, top_p=0.9, seed=11)
+    np.testing.assert_array_equal(np.asarray(s1._data),
+                                  np.asarray(s2._data))
