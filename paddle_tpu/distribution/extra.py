@@ -75,10 +75,12 @@ class Poisson(ExponentialFamily):
         def _f(r):
             ks = jnp.arange(1.0, kmax + 1.0)
             lgk = jax.scipy.special.gammaln(ks + 1)
+            # keep -r inside the exponent: the summand alone overflows
+            # f32 near k ~ r for r >~ 90
             terms = jnp.exp(ks[(None,) * r.ndim + (slice(None),)]
-                            * jnp.log(r)[..., None]
+                            * jnp.log(r)[..., None] - r[..., None]
                             - lgk) * lgk
-            return r * (1 - jnp.log(r)) + jnp.exp(-r) * terms.sum(-1)
+            return r * (1 - jnp.log(r)) + terms.sum(-1)
         return apply_op("poisson_entropy", _f,
                         self._param(self._rate_p, self.rate))
 

@@ -23,8 +23,6 @@ from ..core.tensor import Tensor
 
 __all__ = ["jit_generate"]
 
-_PROGRAM_CACHE = {}
-
 
 def _sample_arr(logits, key, temperature, top_k, top_p):
     """(B, V) logits -> (B,) int32 token ids, pure-array."""
@@ -123,16 +121,24 @@ def jit_generate(model, input_ids, max_new_tokens=32, temperature=0.0,
     ids = input_ids._data if isinstance(input_ids, Tensor) else \
         jnp.asarray(input_ids)
     B, S0 = ids.shape
-    cache_key = (id(model), B, S0, int(max_new_tokens), float(temperature),
+    if int(max_new_tokens) <= 0:
+        return Tensor(ids)
+    # cache lives ON the model: programs (whose closures hold the model)
+    # form an ordinary self-cycle that the gc collects with the model
+    per_model = model.__dict__.get("_generate_programs")
+    if per_model is None:
+        per_model = {}
+        model.__dict__["_generate_programs"] = per_model
+    cache_key = (B, S0, int(max_new_tokens), float(temperature),
                  int(top_k), float(top_p), eos_token_id)
-    prog = _PROGRAM_CACHE.get(cache_key)
+    prog = per_model.get(cache_key)
     if prog is None:
         prog = _build_program(model, B, S0, int(max_new_tokens),
                               float(temperature), int(top_k), float(top_p),
                               eos_token_id)
-        if len(_PROGRAM_CACHE) >= 16:   # bounded: evict oldest program
-            _PROGRAM_CACHE.pop(next(iter(_PROGRAM_CACHE)))
-        _PROGRAM_CACHE[cache_key] = prog
+        if len(per_model) >= 8:        # bounded per model
+            per_model.pop(next(iter(per_model)))
+        per_model[cache_key] = prog
     with no_grad():
         state_a = {k: t._data for k, t in model.state_dict().items()}
         key = (jax.random.PRNGKey(seed) if seed is not None else rng_key())

@@ -333,8 +333,11 @@ class LlamaForCausalLM(nn.Layer):
                                 seed=seed)
         from ..core.autograd import no_grad
         from ..framework.random import rng_key
+        from .generation import _sample_arr
         with no_grad():
             b, s = input_ids.shape
+            key = (jax.random.PRNGKey(seed) if seed is not None
+                   else rng_key())
             caches = [(Tensor(jnp.zeros((b, 0, l.self_attn.n_kv,
                                          l.self_attn.head_dim), jnp.float32)),
                        Tensor(jnp.zeros((b, 0, l.self_attn.n_kv,
@@ -342,14 +345,28 @@ class LlamaForCausalLM(nn.Layer):
                       for l in self.model.layers]
             logits, caches = self.forward(input_ids, caches=caches)
             out_ids = [input_ids]
+            import numpy as _np
+            done = _np.zeros((b,), bool)
             for _ in range(max_new_tokens):
                 last = logits._data[:, -1, :]  # stays on device
-                if temperature > 0:
-                    nxt = Tensor(jax.random.categorical(
-                        rng_key(), last / temperature)[:, None])
-                else:
-                    nxt = Tensor(jnp.argmax(last, axis=-1)[:, None])
+                key, kn = jax.random.split(key)
+                nxt_arr = _sample_arr(last, kn, float(temperature),
+                                      int(top_k), float(top_p))
+                if eos_token_id is not None:
+                    nxt_arr = jnp.where(jnp.asarray(done),
+                                        jnp.int32(eos_token_id), nxt_arr)
+                    done = _np.asarray(
+                        jnp.logical_or(jnp.asarray(done),
+                                       nxt_arr == eos_token_id))
+                nxt = Tensor(nxt_arr.astype(input_ids._data.dtype)[:, None])
                 out_ids.append(nxt)
+                if eos_token_id is not None and done.all():
+                    pad = Tensor(jnp.full(
+                        (b, max_new_tokens - len(out_ids) + 1),
+                        eos_token_id, input_ids._data.dtype))
+                    if pad.shape[1] > 0:
+                        out_ids.append(pad)
+                    break
                 logits, caches = self.forward(nxt, caches=caches)
             return M.concat(out_ids, axis=1)
 

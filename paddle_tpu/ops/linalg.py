@@ -19,7 +19,7 @@ __all__ = [
     "matrix_power", "pinv", "solve", "triangular_solve", "lstsq", "lu",
     "lu_unpack", "matrix_rank", "cond", "histogram", "histogramdd",
     "bincount", "einsum", "multi_dot", "corrcoef", "cov", "householder_product",
-    "matrix_transpose", "pdist", "cdist",
+    "matrix_transpose", "pdist", "cdist", "svd_lowrank", "pca_lowrank",
 ]
 
 
@@ -327,3 +327,43 @@ def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=
     if p == 2.0:
         return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-30)
     return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD (parity: paddle.linalg.svd_lowrank,
+    `python/paddle/tensor/linalg.py`). Returns (U (m, q), S (q,),
+    V (n, q)). Power iteration sharpens the spectrum; everything is
+    MXU matmuls + one small exact SVD."""
+    from ..framework.random import rng_key
+    import jax
+
+    def _f(a, *rest):
+        m = rest[0] if M is not None else None
+        if m is not None:
+            a = a - m
+        key = rng_key()
+        n = a.shape[-1]
+        omega = jax.random.normal(key, a.shape[:-2] + (n, q), a.dtype)
+        y = a @ omega
+        for _ in range(niter):
+            y = a @ (jnp.swapaxes(a, -1, -2) @ y)
+        Q, _ = jnp.linalg.qr(y)
+        b = jnp.swapaxes(Q, -1, -2) @ a          # (q, n)
+        u_b, s, vt = jnp.linalg.svd(b, full_matrices=False)
+        u = Q @ u_b
+        return u, s, jnp.swapaxes(vt, -1, -2)
+
+    args = [x] + ([M] if M is not None else [])
+    return apply_op("svd_lowrank", _f, *args)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized PCA (parity: paddle.linalg.pca_lowrank)."""
+    qq = q if q is not None else min(6, *[int(s) for s in x.shape[-2:]])
+
+    def _f(a):
+        if center:
+            a = a - jnp.mean(a, axis=-2, keepdims=True)
+        return a
+    centered = apply_op("pca_center", _f, x)
+    return svd_lowrank(centered, q=qq, niter=niter)

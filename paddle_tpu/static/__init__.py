@@ -132,6 +132,15 @@ class Executor:
     def __init__(self, place=None):
         self.place = place
         self._cache = {}
+        # run statistics (parity: new_executor/executor_statistics.cc —
+        # per-op instruction counts + run timings, dumpable as JSON)
+        self._stats = {"runs": 0, "compiles": 0, "op_counts": {},
+                       "total_run_time_s": 0.0, "last_run_time_s": 0.0}
+
+    def statistics(self):
+        """Executor run statistics: runs, compiles, per-op replay counts,
+        wall times (the reference's executor-statistics dump)."""
+        return dict(self._stats, op_counts=dict(self._stats["op_counts"]))
 
     def run(self, program=None, feed: Optional[Dict] = None,
             fetch_list: Optional[List] = None, return_numpy=True):
@@ -149,6 +158,8 @@ class Executor:
             feed_ts.append(t)
             feed_vals.append(np.asarray(v))
 
+        import time as _time
+        _t0 = _time.perf_counter()
         nodes = _forward_topo(fetch_list)
         for n in nodes:
             if n.fwd_closed is None:
@@ -184,7 +195,26 @@ class Executor:
 
             fn = jax.jit(replay)
             self._cache[key] = fn
+            self._stats["compiles"] += 1
         outs = fn(feed_vals)
+        self._stats["runs"] += 1
+        for n in nodes:
+            oc = self._stats["op_counts"]
+            oc[n.name] = oc.get(n.name, 0) + 1
+        dt = _time.perf_counter() - _t0
+        self._stats["last_run_time_s"] = dt
+        self._stats["total_run_time_s"] += dt
         if return_numpy:
             return [np.asarray(o) for o in outs]
         return [Tensor(o) for o in outs]
+
+
+def executor_statistics(executor, path=None):
+    """Dump an Executor's run statistics, optionally to a JSON file
+    (parity: `new_executor/executor_statistics.cc` dump)."""
+    import json
+    stats = executor.statistics()
+    if path is not None:
+        with open(path, "w") as f:
+            json.dump(stats, f, indent=2)
+    return stats

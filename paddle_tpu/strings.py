@@ -1,0 +1,73 @@
+"""String tensor ops — the `phi/kernels/strings/` analog.
+
+Parity: reference StringTensor (`paddle/phi/core/string_tensor.h`) with
+its kernel set `strings_empty/strings_lower/strings_upper`
+(`paddle/phi/kernels/strings/strings_lower_upper_kernel.h`, unicode-aware
+case conversion in `strings/unicode.h`). The reference exposes these to
+serving preprocessing (faster_tokenizer); here the same surface is a
+host-side object array — string data never belongs on the TPU, and the
+reference's CPU kernels are host-side too.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["StringTensor", "empty", "lower", "upper"]
+
+
+class StringTensor:
+    """A dense tensor of variable-length unicode strings."""
+
+    def __init__(self, data, name=None):
+        if isinstance(data, StringTensor):
+            data = data._data
+        self._data = np.asarray(data, dtype=object)
+        self.name = name
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    def numpy(self):
+        return self._data
+
+    def tolist(self):
+        return self._data.tolist()
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        return out if isinstance(out, str) else StringTensor(out)
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, data={self._data!r})"
+
+
+def empty(shape, name=None):
+    """strings_empty kernel: a StringTensor of empty strings."""
+    arr = np.full(tuple(shape), "", dtype=object)
+    return StringTensor(arr)
+
+
+def _case_map(x, fn, use_utf8_encoding):
+    arr = x._data if isinstance(x, StringTensor) else \
+        np.asarray(x, dtype=object)
+    if use_utf8_encoding:
+        # ASCII-only conversion (the reference's utf8 byte fast path):
+        # only code points < 128 change case, multibyte chars pass through
+        delta = -32 if fn == "upper" else 32
+        lo, hi = ("a", "z") if fn == "upper" else ("A", "Z")
+        table = {c: c + delta for c in range(ord(lo), ord(hi) + 1)}
+        out = np.frompyfunc(lambda s: s.translate(table), 1, 1)(arr)
+    else:
+        out = np.frompyfunc(lambda s: getattr(s, fn)(), 1, 1)(arr)
+    return StringTensor(out)
+
+
+def lower(x, use_utf8_encoding=False, name=None):
+    """strings_lower kernel (unicode-aware by default)."""
+    return _case_map(x, "lower", use_utf8_encoding)
+
+
+def upper(x, use_utf8_encoding=False, name=None):
+    """strings_upper kernel."""
+    return _case_map(x, "upper", use_utf8_encoding)

@@ -13,8 +13,12 @@ import numpy as np
 __all__ = ["Compose", "ToTensor", "Normalize", "Resize", "RandomCrop",
            "CenterCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
            "Transpose", "Pad", "RandomResizedCrop", "BrightnessTransform",
-           "ContrastTransform", "to_tensor", "normalize", "resize", "hflip",
-           "vflip", "crop", "center_crop", "pad"]
+           "ContrastTransform", "SaturationTransform", "HueTransform",
+           "ColorJitter", "Grayscale", "RandomRotation", "RandomAffine",
+           "RandomPerspective", "RandomErasing",
+           "to_tensor", "normalize", "resize", "hflip",
+           "vflip", "crop", "center_crop", "pad", "adjust_brightness",
+           "adjust_contrast", "adjust_saturation", "adjust_hue"]
 
 
 class Compose:
@@ -259,7 +263,252 @@ class ContrastTransform:
         if self.value == 0:
             return np.asarray(img)
         factor = random.uniform(max(0, 1 - self.value), 1 + self.value)
-        a = np.asarray(img).astype(np.float32)
-        mean = a.mean()
-        out = (a - mean) * factor + mean
-        return np.clip(out, 0, 255).astype(np.asarray(img).dtype)
+        return adjust_contrast(img, factor)
+
+
+def adjust_brightness(img, factor):
+    a = np.asarray(img, np.float32)
+    hi = 255.0 if a.max() > 1.5 else 1.0
+    return np.clip(a * factor, 0, hi).astype(np.asarray(img).dtype)
+
+
+def adjust_contrast(img, factor):
+    a = np.asarray(img, np.float32)
+    hi = 255.0 if a.max() > 1.5 else 1.0
+    mean = a.mean()
+    return np.clip((a - mean) * factor + mean, 0, hi).astype(
+        np.asarray(img).dtype)
+
+
+def adjust_saturation(img, factor):
+    a = _chw(np.asarray(img, np.float32))
+    gray = a @ np.array([0.299, 0.587, 0.114], np.float32) \
+        if a.shape[-1] == 3 else a[..., 0]
+    hi = 255.0 if a.max() > 1.5 else 1.0
+    out = a * factor + gray[..., None] * (1 - factor)
+    return np.clip(out, 0, hi).astype(np.asarray(img).dtype)
+
+
+def adjust_hue(img, factor):
+    """factor in [-0.5, 0.5]: rotate the hue channel in HSV space."""
+    a = _chw(np.asarray(img, np.float32))
+    scale = 255.0 if a.max() > 1.5 else 1.0
+    x = a / scale
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    mx, mn = x.max(-1), x.min(-1)
+    diff = mx - mn + 1e-12
+    h = np.zeros_like(mx)
+    m = mx == r
+    h[m] = ((g - b) / diff % 6)[m]
+    m = mx == g
+    h[m] = ((b - r) / diff + 2)[m]
+    m = mx == b
+    h[m] = ((r - g) / diff + 4)[m]
+    h = (h / 6 + factor) % 1.0
+    s = np.where(mx > 0, diff / (mx + 1e-12), 0)
+    v = mx
+    i = np.floor(h * 6)
+    f = h * 6 - i
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    i = i.astype(np.int32) % 6
+    out = np.stack([
+        np.choose(i, [v, q, p, p, t, v]),
+        np.choose(i, [t, v, v, q, p, p]),
+        np.choose(i, [p, p, t, v, v, q]),
+    ], axis=-1)
+    return (out * scale).astype(np.asarray(img).dtype)
+
+
+class SaturationTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_saturation(img, f)
+
+
+class HueTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        return adjust_hue(img, random.uniform(-self.value, self.value))
+
+
+class ColorJitter:
+    """Parity: transforms.ColorJitter — random brightness/contrast/
+    saturation/hue in random order."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self.b, self.c, self.s, self.h = brightness, contrast, saturation, hue
+
+    def __call__(self, img):
+        ops = []
+        if self.b:
+            f = random.uniform(max(0, 1 - self.b), 1 + self.b)
+            ops.append(lambda im: adjust_brightness(im, f))
+        if self.c:
+            g = random.uniform(max(0, 1 - self.c), 1 + self.c)
+            ops.append(lambda im: adjust_contrast(im, g))
+        if self.s:
+            h = random.uniform(max(0, 1 - self.s), 1 + self.s)
+            ops.append(lambda im: adjust_saturation(im, h))
+        if self.h:
+            k = random.uniform(-self.h, self.h)
+            ops.append(lambda im: adjust_hue(im, k))
+        random.shuffle(ops)
+        for op in ops:
+            img = op(img)
+        return img
+
+
+class Grayscale:
+    def __init__(self, num_output_channels=1):
+        self.n = num_output_channels
+
+    def __call__(self, img):
+        a = _chw(np.asarray(img, np.float32))
+        g = a @ np.array([0.299, 0.587, 0.114], np.float32) \
+            if a.shape[-1] == 3 else a[..., 0]
+        out = np.repeat(g[..., None], self.n, axis=-1)
+        return out.astype(np.asarray(img).dtype)
+
+
+def _grid_sample_nearest(a, sx, sy, fill=0):
+    """Nearest-neighbor gather at float source coordinates; out-of-range
+    positions take `fill`."""
+    h, w = a.shape[:2]
+    sxi = np.round(sx).astype(np.int64)
+    syi = np.round(sy).astype(np.int64)
+    valid = (sxi >= 0) & (sxi < w) & (syi >= 0) & (syi < h)
+    out = np.full_like(a, fill)
+    out[valid] = a[syi[valid], sxi[valid]]
+    return out
+
+
+def _affine_grid_sample(a, mat, fill=0):
+    """Inverse-warp HWC image by 2x3 affine matrix (nearest sampling)."""
+    h, w = a.shape[:2]
+    yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+    xs = xx - cx
+    ys = yy - cy
+    sx = mat[0, 0] * xs + mat[0, 1] * ys + mat[0, 2] + cx
+    sy = mat[1, 0] * xs + mat[1, 1] * ys + mat[1, 2] + cy
+    return _grid_sample_nearest(a, sx, sy, fill)
+
+
+class RandomRotation:
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        if expand or center is not None:
+            raise NotImplementedError(
+                "RandomRotation expand/center not supported")
+        self.degrees, self.fill = degrees, fill
+
+    def __call__(self, img):
+        a = _chw(np.asarray(img))
+        ang = np.deg2rad(random.uniform(*self.degrees))
+        c, s = np.cos(ang), np.sin(ang)
+        mat = np.array([[c, -s, 0.0], [s, c, 0.0]], np.float32)
+        return _affine_grid_sample(a, mat, self.fill)
+
+
+class RandomAffine:
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        if center is not None:
+            raise NotImplementedError("RandomAffine center not supported")
+        if isinstance(shear, numbers.Number):
+            shear = (-shear, shear)
+        self.degrees, self.translate = degrees, translate
+        self.scale, self.shear, self.fill = scale, shear, fill
+
+    def __call__(self, img):
+        a = _chw(np.asarray(img))
+        h, w = a.shape[:2]
+        ang = np.deg2rad(random.uniform(*self.degrees))
+        sc = random.uniform(*self.scale) if self.scale else 1.0
+        tx = ty = 0.0
+        if self.translate:
+            tx = random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = random.uniform(-self.translate[1], self.translate[1]) * h
+        shx = np.deg2rad(random.uniform(*self.shear)) if self.shear else 0.0
+        c, s = np.cos(ang), np.sin(ang)
+        rot = np.array([[c, -s], [s, c]], np.float32)
+        sh = np.array([[1.0, np.tan(shx)], [0.0, 1.0]], np.float32)
+        lin = (rot @ sh) / sc
+        mat = np.array([[lin[0, 0], lin[0, 1], -tx],
+                        [lin[1, 0], lin[1, 1], -ty]], np.float32)
+        return _affine_grid_sample(a, mat, self.fill)
+
+
+class RandomPerspective:
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0):
+        self.prob, self.d, self.fill = prob, distortion_scale, fill
+
+    def __call__(self, img):
+        if random.random() >= self.prob:
+            return img
+        a = _chw(np.asarray(img))
+        h, w = a.shape[:2]
+        d = self.d
+        # jitter the 4 corners and fit the projective map (8 dof)
+        src = np.array([[0, 0], [w - 1, 0], [w - 1, h - 1], [0, h - 1]],
+                       np.float32)
+        jit = np.array([[random.uniform(0, d * w / 2),
+                         random.uniform(0, d * h / 2)] for _ in range(4)],
+                       np.float32)
+        sign = np.array([[1, 1], [-1, 1], [-1, -1], [1, -1]], np.float32)
+        dst = src + jit * sign
+        A = []
+        for (x, y), (u, v) in zip(dst, src):
+            A.append([x, y, 1, 0, 0, 0, -u * x, -u * y])
+            A.append([0, 0, 0, x, y, 1, -v * x, -v * y])
+        bvec = src.reshape(-1)
+        coef = np.linalg.lstsq(np.array(A, np.float32), bvec, rcond=None)[0]
+        M = np.append(coef, 1.0).reshape(3, 3)
+        yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+        den = M[2, 0] * xx + M[2, 1] * yy + M[2, 2]
+        sx = (M[0, 0] * xx + M[0, 1] * yy + M[0, 2]) / den
+        sy = (M[1, 0] * xx + M[1, 1] * yy + M[1, 2]) / den
+        return _grid_sample_nearest(a, sx, sy, self.fill)
+
+
+class RandomErasing:
+    """Parity: transforms.RandomErasing (CHW tensors or HWC arrays)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False):
+        self.prob, self.scale, self.ratio, self.value = \
+            prob, scale, ratio, value
+
+    def __call__(self, img):
+        a = np.array(img, copy=True)
+        if random.random() >= self.prob:
+            return a
+        chw = a.ndim == 3 and a.shape[0] in (1, 3)
+        h, w = (a.shape[1], a.shape[2]) if chw else (a.shape[0], a.shape[1])
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ratio = random.uniform(*self.ratio)
+            eh = int(round(np.sqrt(target * ratio)))
+            ew = int(round(np.sqrt(target / ratio)))
+            if eh < h and ew < w:
+                y = random.randint(0, h - eh)
+                x = random.randint(0, w - ew)
+                if chw:
+                    a[:, y:y + eh, x:x + ew] = self.value
+                else:
+                    a[y:y + eh, x:x + ew] = self.value
+                break
+        return a
