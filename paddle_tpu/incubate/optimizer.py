@@ -1,0 +1,140 @@
+"""incubate.optimizer — LookAhead and ModelAverage wrappers.
+
+Parity: reference `python/paddle/incubate/optimizer/lookahead.py`
+(LookAhead:24 — slow/fast weights, slow = slow + alpha*(fast - slow)
+every k steps) and `modelaverage.py` (ModelAverage — running parameter
+average applied for eval via apply()/restore()).
+
+TPU-native: the slow/average buffers are device arrays updated by the
+same jnp expressions the inner optimizer uses; everything stays on device
+(no host copies in the step path)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """k-step lookahead over an inner optimizer."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_num = 0
+        self._slow = None
+
+    @property
+    def _params(self):
+        return self.inner_optimizer._parameter_list
+
+    def step(self):
+        if self._slow is None:
+            self._slow = [p._data for p in self._params]
+        self.inner_optimizer.step()
+        self._step_num += 1
+        if self._step_num % self.k == 0:
+            masters = getattr(self.inner_optimizer, "_master_weights", {})
+            for i, p in enumerate(self._params):
+                slow = (self._slow[i].astype(jnp.float32)
+                        + self.alpha * (p._data.astype(jnp.float32)
+                                        - self._slow[i].astype(jnp.float32)))
+                self._slow[i] = slow.astype(p._data.dtype)
+                p._data = self._slow[i]
+                if i in masters:
+                    # keep the inner optimizer's fp32 master in sync or the
+                    # next step would overwrite the pullback
+                    masters[i] = slow
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    def state_dict(self):
+        return {"inner": getattr(self.inner_optimizer, "state_dict",
+                                 dict)(),
+                "slow": self._slow, "step_num": self._step_num}
+
+    def set_state_dict(self, state):
+        inner_sd = state.get("inner")
+        if inner_sd and hasattr(self.inner_optimizer, "set_state_dict"):
+            self.inner_optimizer.set_state_dict(inner_sd)
+        self._slow = state.get("slow")
+        self._step_num = int(state.get("step_num", 0))
+
+
+class ModelAverage:
+    """Running average of parameters, swapped in for evaluation.
+
+    average_window_rate bounds the window like the reference; apply()
+    swaps averaged weights in (optionally inside a `with`), restore()
+    swaps the trained weights back."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        if parameters is None:
+            raise ValueError("ModelAverage requires the parameter list")
+        self._params = list(parameters)
+        self.rate = float(average_window_rate)
+        self.min_w = int(min_average_window)
+        self.max_w = int(max_average_window)
+        self._sum = [jnp.zeros(tuple(p.shape), jnp.float32)
+                     for p in self._params]
+        self._count = 0
+        self._backup = None
+
+    def step(self):
+        """Accumulate the current parameters into the running average
+        (call after optimizer.step())."""
+        window = max(self.min_w,
+                     min(self.max_w, int(self.rate * (self._count + 1))))
+        if self._count >= window:
+            decay = 1.0 - 1.0 / window
+            self._sum = [s * decay for s in self._sum]
+            self._count = int(self._count * decay)
+        self._sum = [s + p._data.astype(jnp.float32)
+                     for s, p in zip(self._sum, self._params)]
+        self._count += 1
+
+    def apply(self, executor=None, need_restore=True):
+        """Swap the averaged parameters in (context-manager friendly)."""
+        if self._count == 0:
+            return self
+        if self._backup is not None:
+            return self   # already applied: a second swap would back up
+                          # the averaged weights and lose the trained ones
+        self._backup = [p._data for p in self._params]
+        for p, s in zip(self._params, self._sum):
+            p._data = (s / self._count).astype(p._data.dtype)
+        self._need_restore = need_restore
+        return self
+
+    def restore(self, executor=None):
+        if self._backup is not None:
+            for p, b in zip(self._params, self._backup):
+                p._data = b
+            self._backup = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if getattr(self, "_need_restore", True):
+            self.restore()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        raise RuntimeError(
+            "ModelAverage tracks another optimizer's parameters; call "
+            "step() after the training optimizer's step()")

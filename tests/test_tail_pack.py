@@ -90,3 +90,32 @@ def test_executor_statistics():
         path = os.path.join(d, "stats.json")
         paddle.static.executor_statistics(ex, path)
         assert json.load(open(path))["runs"] == 2
+
+
+def test_lookahead_and_model_average():
+    """incubate.LookAhead / ModelAverage (reference incubate/optimizer/)."""
+    paddle.seed(0)
+    net = paddle.nn.Linear(4, 2)
+    inner = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    la = paddle.incubate.LookAhead(inner, alpha=0.5, k=2)
+    ma = paddle.incubate.ModelAverage(0.2, parameters=net.parameters(),
+                                      min_average_window=2)
+    x = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(8, 2).astype(np.float32))
+    losses = []
+    for _ in range(8):
+        loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        la.step(); la.clear_grad(); ma.step()
+        losses.append(float(np.asarray(loss._data)))
+    assert losses[-1] < losses[0]
+    w_train = np.asarray(net.weight._data).copy()
+    with ma.apply():
+        assert not np.allclose(np.asarray(net.weight._data), w_train)
+    np.testing.assert_allclose(np.asarray(net.weight._data), w_train)
+    # double apply guarded; state roundtrip
+    ma.apply(); ma.apply(); ma.restore()
+    np.testing.assert_allclose(np.asarray(net.weight._data), w_train)
+    sd = la.state_dict()
+    la.set_state_dict(sd)
+    assert la.minimize(((net(x) - y) ** 2).mean()) == (None, None)
