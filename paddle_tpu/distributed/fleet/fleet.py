@@ -72,10 +72,14 @@ def distributed_model(model):
 
 
 def distributed_optimizer(optimizer, strategy=None):
-    """Parity: fleet.distributed_optimizer -> HybridParallelOptimizer."""
+    """Parity: fleet.distributed_optimizer -> HybridParallelOptimizer.
+    An explicit strategy argument overrides the fleet.init one (the
+    reference accepts either call pattern)."""
     _ensure_init()
     from .hybrid_parallel_optimizer import HybridParallelOptimizer
-    return HybridParallelOptimizer(optimizer, _hcg, _strategy)
+    return HybridParallelOptimizer(optimizer, _hcg,
+                                   strategy if strategy is not None
+                                   else _strategy)
 
 
 def collective_perf(comm_type="allreduce", round=5, size_and_time=None):

@@ -34,11 +34,42 @@ class HybridParallelOptimizer:
         if optimizer._grad_clip is not None and hcg is not None:
             optimizer._grad_clip = HybridParallelClipGrad(
                 optimizer._grad_clip, hcg)
+        # gradient merge (parity: fleet meta-optimizer gradient_merge /
+        # GradientMergeOptimizer): accumulate k_steps of grads, apply the
+        # (averaged) update every k-th step
+        gm = bool(strategy is not None
+                  and getattr(strategy, "gradient_merge", False))
+        cfg = (getattr(strategy, "gradient_merge_configs", {})
+               if gm else {})
+        self._gm_k = int(cfg.get("k_steps", 1)) if gm else 1
+        self._gm_avg = bool(cfg.get("avg", True))
+        self._gm_step = 0
+        self._gm_acc = None
 
     def __getattr__(self, item):
         return getattr(self._inner_opt, item)
 
     def step(self):
+        if self._gm_k <= 1:
+            self._inner_opt.step()
+            return
+        params = self._inner_opt._parameter_list
+        if self._gm_acc is None:
+            self._gm_acc = [None] * len(params)
+        for i, p in enumerate(params):
+            if p.grad is not None:
+                g = p.grad._data.astype(jnp.float32)
+                self._gm_acc[i] = g if self._gm_acc[i] is None \
+                    else self._gm_acc[i] + g
+        self._gm_step += 1
+        if self._gm_step % self._gm_k != 0:
+            self._inner_opt.clear_grad()     # grads are banked; skip apply
+            return
+        scale = 1.0 / self._gm_k if self._gm_avg else 1.0
+        for p, acc in zip(params, self._gm_acc):
+            if acc is not None:
+                p.grad = Tensor((acc * scale).astype(p._data.dtype))
+        self._gm_acc = None
         self._inner_opt.step()
 
     def clear_grad(self, *a, **k):
@@ -47,10 +78,23 @@ class HybridParallelOptimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, *a, **k):
-        return self._inner_opt.minimize(loss, *a, **k)
+        # route through OUR step() so gradient-merge banking applies
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
 
     def state_dict(self):
-        return self._inner_opt.state_dict()
+        sd = self._inner_opt.state_dict()
+        if self._gm_k > 1:
+            sd = dict(sd)
+            sd["_gm_step"] = self._gm_step
+            sd["_gm_acc"] = self._gm_acc
+        return sd
 
     def set_state_dict(self, sd):
+        if self._gm_k > 1 and "_gm_step" in sd:
+            sd = dict(sd)
+            self._gm_step = int(sd.pop("_gm_step"))
+            self._gm_acc = sd.pop("_gm_acc")
         return self._inner_opt.set_state_dict(sd)

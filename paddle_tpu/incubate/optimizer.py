@@ -112,8 +112,11 @@ class ModelAverage:
         if self._count == 0:
             return self
         if self._backup is not None:
-            return self   # already applied: a second swap would back up
-                          # the averaged weights and lose the trained ones
+            # already applied: refuse the second swap (it would back up
+            # the averaged weights and lose the trained ones) but honor
+            # the caller's restore intent for `with` usage
+            self._need_restore = need_restore
+            return self
         self._backup = [p._data for p in self._params]
         for p, s in zip(self._params, self._sum):
             p._data = (s / self._count).astype(p._data.dtype)
