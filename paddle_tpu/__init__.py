@@ -64,6 +64,8 @@ from . import regularizer  # noqa: F401,E402
 from . import tensor  # noqa: F401,E402
 from . import signal  # noqa: F401,E402
 from . import strings  # noqa: F401,E402
+from . import hub  # noqa: F401,E402
+from . import version  # noqa: F401,E402
 from .ops import linalg  # noqa: F401,E402
 from .hapi import callbacks  # noqa: F401,E402
 

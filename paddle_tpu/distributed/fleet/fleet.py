@@ -77,6 +77,15 @@ def distributed_optimizer(optimizer, strategy=None):
     reference accepts either call pattern)."""
     _ensure_init()
     from .hybrid_parallel_optimizer import HybridParallelOptimizer
+    if strategy is not None and _strategy is not None:
+        a = getattr(strategy, "hybrid_configs", None)
+        b = getattr(_strategy, "hybrid_configs", None)
+        if a and b and dict(a) != dict(b):
+            raise ValueError(
+                "distributed_optimizer strategy.hybrid_configs "
+                f"{a} differ from the fleet.init topology {b}; the comm "
+                "groups were built at init — re-run fleet.init with the "
+                "new topology instead")
     return HybridParallelOptimizer(optimizer, _hcg,
                                    strategy if strategy is not None
                                    else _strategy)

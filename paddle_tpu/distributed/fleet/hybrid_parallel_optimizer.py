@@ -89,12 +89,19 @@ class HybridParallelOptimizer:
         if self._gm_k > 1:
             sd = dict(sd)
             sd["_gm_step"] = self._gm_step
-            sd["_gm_acc"] = self._gm_acc
+            # copy: the live accumulator list mutates as training goes on
+            sd["_gm_acc"] = None if self._gm_acc is None \
+                else list(self._gm_acc)
         return sd
 
     def set_state_dict(self, sd):
-        if self._gm_k > 1 and "_gm_step" in sd:
+        if "_gm_step" in sd or "_gm_acc" in sd:
+            # strip gm keys unconditionally — a gm-disabled loader must
+            # not leak them into the inner optimizer's key parser
             sd = dict(sd)
-            self._gm_step = int(sd.pop("_gm_step"))
-            self._gm_acc = sd.pop("_gm_acc")
+            step = sd.pop("_gm_step", 0)
+            acc = sd.pop("_gm_acc", None)
+            if self._gm_k > 1:
+                self._gm_step = int(step)
+                self._gm_acc = None if acc is None else list(acc)
         return self._inner_opt.set_state_dict(sd)

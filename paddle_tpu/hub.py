@@ -1,0 +1,74 @@
+"""paddle.hub — load models from a hubconf.py.
+
+Parity: reference `python/paddle/hub.py` (list/help/load over github /
+gitee / local sources). This build supports the `local` source (a
+directory containing `hubconf.py`); remote sources raise — the sandbox
+has no egress, and the reference's entrypoint protocol (callables in
+hubconf, `dependencies` list) is fully honored for local dirs.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF_CACHE = {}
+
+
+def _load_hubconf(repo_dir, force_reload=False):
+    path = os.path.join(repo_dir, "hubconf.py")
+    key = os.path.abspath(path)
+    if not force_reload and key in _HUBCONF_CACHE:
+        return _HUBCONF_CACHE[key]
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no hubconf.py under {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    deps = getattr(mod, "dependencies", None)
+    if deps:
+        import importlib as _il
+        for d in deps:
+            try:
+                _il.import_module(d)
+            except ImportError as e:
+                raise RuntimeError(f"hub dependency {d!r} missing") from e
+    _HUBCONF_CACHE[key] = mod
+    return mod
+
+
+def _check_source(source):
+    if source != "local":
+        raise NotImplementedError(
+            "paddle_tpu.hub supports source='local' only (no network "
+            "egress); point repo_dir at a directory with hubconf.py")
+
+
+def list(repo_dir, source="local", force_reload=False):
+    """Entrypoint names exported by the repo's hubconf.py."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir, force_reload)
+    return [k for k, v in vars(mod).items()
+            if callable(v) and not k.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):
+    """Docstring of one entrypoint."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir, force_reload)
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise ValueError(f"hubconf has no entrypoint {model!r}")
+    return fn.__doc__
+
+
+def load(repo_dir, model, *args, source="local", force_reload=False,
+         **kwargs):
+    """Instantiate one entrypoint."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir, force_reload)
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise ValueError(f"hubconf has no entrypoint {model!r}")
+    return fn(*args, **kwargs)

@@ -1,0 +1,73 @@
+"""paddle.version — build metadata.
+
+Parity: reference generated `python/paddle/version/__init__.py`
+(full_version/major/minor/patch/rc + cuda()/cudnn()/nccl()/xpu() probes).
+This build targets TPU through XLA: the CUDA-family probes report False/
+None and tpu()/xla() report the live backend.
+"""
+from __future__ import annotations
+
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+istaged = True
+commit = "tpu-native"
+with_pip_cuda_libraries = "OFF"
+
+__all__ = ["full_version", "major", "minor", "patch", "rc", "cuda",
+           "cudnn", "nccl", "xpu", "xpu_xccl", "xpu_xhpc", "cinn",
+           "tpu", "xla", "show"]
+
+
+def cuda():
+    """False: this build has no CUDA dependency (TPU-native)."""
+    return False
+
+
+def cudnn():
+    return False
+
+
+def nccl():
+    return 0
+
+
+def xpu():
+    return False
+
+
+def xpu_xccl():
+    return 0
+
+
+def xpu_xhpc():
+    return ""
+
+
+def cinn():
+    """The fusion-compiler role is played by XLA in this build."""
+    return False
+
+
+def tpu():
+    """The libtpu/PJRT backend version when a TPU is attached."""
+    try:
+        import jax
+        d = jax.devices()[0]
+        return getattr(d, "device_kind", d.platform)
+    except Exception:
+        return None
+
+
+def xla():
+    import jax
+    return jax.__version__
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"commit: {commit}")
+    print(f"xla (jax): {xla()}")
+    print(f"cuda: {cuda()}  cudnn: {cudnn()}  (TPU-native build)")

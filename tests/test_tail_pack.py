@@ -119,3 +119,26 @@ def test_lookahead_and_model_average():
     sd = la.state_dict()
     la.set_state_dict(sd)
     assert la.minimize(((net(x) - y) ** 2).mean()) == (None, None)
+
+
+def test_hub_local_and_version():
+    """paddle.hub local-source protocol + version metadata
+    (reference python/paddle/hub.py, generated version module)."""
+    import os
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        with open(os.path.join(d, "hubconf.py"), "w") as f:
+            f.write("dependencies = ['numpy']\n\n"
+                    "def entry(n=4):\n"
+                    "    '''entry doc.'''\n"
+                    "    import paddle_tpu as paddle\n"
+                    "    return paddle.nn.Linear(n, 2)\n")
+        assert paddle.hub.list(d) == ["entry"]
+        assert "entry doc" in paddle.hub.help(d, "entry")
+        m = paddle.hub.load(d, "entry", n=6)
+        assert list(m.weight.shape) == [6, 2]
+    import pytest
+    with pytest.raises(NotImplementedError):
+        paddle.hub.list("repo", source="github")
+    assert paddle.version.cuda() is False
+    assert paddle.version.full_version == paddle.__version__
