@@ -1,5 +1,5 @@
 """paddle_tpu.nn.functional — parity with python/paddle/nn/functional/."""
-from . import activation, common, conv, pooling, norm, loss  # noqa: F401
+from . import activation, common, conv, pooling, norm, loss, extra  # noqa: F401
 from . import flash_attention as _fa_mod
 
 from .activation import *  # noqa: F401,F403
@@ -9,7 +9,8 @@ from .pooling import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .flash_attention import *  # noqa: F401,F403
+from .extra import *  # noqa: F401,F403
 
 __all__ = (activation.__all__ + common.__all__ + conv.__all__ +
            pooling.__all__ + norm.__all__ + loss.__all__ +
-           _fa_mod.__all__)
+           _fa_mod.__all__ + extra.__all__)

@@ -1,0 +1,402 @@
+"""nn.functional tail: spatial sampling (grid_sample/affine_grid),
+sequence losses (ctc_loss/rnnt_loss), unpooling, and small utilities.
+
+Parity: reference `python/paddle/nn/functional/vision.py`
+(grid_sample:270, affine_grid:26, temporal_shift), `loss.py` ctc_loss /
+rnnt_loss (warpctc/warprnnt bindings in the reference), `pooling.py`
+max_unpool1d/2d/3d, `common.py` embedding_bag-style gathers.
+
+TPU-native: grid_sample is four gathers + bilinear weights (one fused
+XLA program, differentiable); CTC and RNN-T are log-domain dynamic
+programs over `lax.scan` — the reference dynloads warpctc/warprnnt CUDA,
+here the same recurrences compile through XLA.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.dispatch import apply_op
+
+__all__ = ["grid_sample", "affine_grid", "sequence_mask", "max_unpool1d",
+           "max_unpool2d", "max_unpool3d", "pairwise_distance",
+           "temporal_shift", "feature_alpha_dropout", "embedding_bag",
+           "ctc_loss", "rnnt_loss"]
+
+NEG = -1e30
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """x (N, C, H, W); grid (N, Ho, Wo, 2) in [-1, 1] (x, y) order.
+    Parity: nn/functional/vision.py grid_sample."""
+
+    def _f(a, g):
+        N, C, H, W = a.shape
+        gx, gy = g[..., 0], g[..., 1]
+
+        def unnorm(v, size):
+            if align_corners:
+                return (v + 1) * (size - 1) / 2
+            return ((v + 1) * size - 1) / 2
+        fx = unnorm(gx, W)
+        fy = unnorm(gy, H)
+        if padding_mode == "reflection":
+            def reflect(v, size):
+                if align_corners:
+                    span = 2 * (size - 1)
+                    v = jnp.abs(v) % span
+                    return jnp.where(v > size - 1, span - v, v)
+                span = 2 * size
+                v = (v + 0.5) % span
+                v = jnp.where(v > size, span - v, v)
+                return jnp.clip(v - 0.5, 0, size - 1)
+            fx = reflect(fx, W)
+            fy = reflect(fy, H)
+
+        def sample(ix, iy):
+            inb = (ix >= 0) & (ix < W) & (iy >= 0) & (iy < H)
+            ixc = jnp.clip(ix, 0, W - 1)
+            iyc = jnp.clip(iy, 0, H - 1)
+            lin = (iyc * W + ixc).reshape(N, -1)        # (N, Ho*Wo)
+            flat = a.reshape(N, C, H * W)
+            got = jnp.take_along_axis(flat, lin[:, None, :], axis=2)
+            got = got.reshape(N, C, *ix.shape[1:])
+            if padding_mode == "zeros":
+                got = got * inb[:, None].astype(a.dtype)
+            return got
+
+        if mode == "nearest":
+            return sample(jnp.round(fx).astype(jnp.int32),
+                          jnp.round(fy).astype(jnp.int32))
+        x0 = jnp.floor(fx)
+        y0 = jnp.floor(fy)
+        wx = (fx - x0).astype(a.dtype)[:, None]
+        wy = (fy - y0).astype(a.dtype)[:, None]
+        x0i = x0.astype(jnp.int32)
+        y0i = y0.astype(jnp.int32)
+        return (sample(x0i, y0i) * (1 - wx) * (1 - wy)
+                + sample(x0i + 1, y0i) * wx * (1 - wy)
+                + sample(x0i, y0i + 1) * (1 - wx) * wy
+                + sample(x0i + 1, y0i + 1) * wx * wy)
+
+    return apply_op("grid_sample", _f, x, grid)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """theta (N, 2, 3) -> sampling grid (N, H, W, 2) for grid_sample.
+    Parity: nn/functional/vision.py affine_grid."""
+    if hasattr(out_shape, "_data"):
+        out_shape = [int(v) for v in np.asarray(out_shape._data)]
+    N, C, H, W = [int(v) for v in out_shape]
+
+    def _f(th):
+        if align_corners:
+            xs = jnp.linspace(-1, 1, W)
+            ys = jnp.linspace(-1, 1, H)
+        else:
+            xs = (jnp.arange(W) * 2 + 1) / W - 1
+            ys = (jnp.arange(H) * 2 + 1) / H - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # (H, W, 3)
+        return jnp.einsum("hwk,njk->nhwj", base, th)
+
+    return apply_op("affine_grid", _f, theta)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """lengths (…,) -> mask (…, maxlen). Parity: paddle sequence_mask
+    (extension.py:59, dtype defaults to int64)."""
+    from ...core.dtype import convert_dtype
+
+    if maxlen is None:
+        data = x._data if hasattr(x, "_data") else x
+        try:
+            maxlen = int(jnp.max(data))
+        except jax.errors.ConcretizationTypeError:
+            raise ValueError(
+                "sequence_mask under jit/to_static needs an explicit "
+                "maxlen (the output shape cannot depend on data)") from None
+
+    def _f(lens):
+        pos = jnp.arange(maxlen)
+        out = pos[None, :] < lens.reshape(-1, 1)
+        out = out.reshape(tuple(lens.shape) + (maxlen,))
+        return out.astype(convert_dtype(dtype))
+
+    return apply_op("sequence_mask", _f, x)
+
+
+def _max_unpool(x, indices, nd, kernel_size, stride, padding, output_size):
+    ks = (kernel_size,) * nd if isinstance(kernel_size, int) \
+        else tuple(kernel_size)
+    st = tuple((stride,) * nd if isinstance(stride, int)
+               else stride) if stride is not None else ks
+    pd = (padding,) * nd if isinstance(padding, int) else tuple(padding)
+
+    def _f(a, idx):
+        spatial_in = a.shape[2:]
+        if output_size is not None:
+            spatial = tuple(int(s) for s in output_size[-nd:])
+        else:
+            spatial = tuple((si - 1) * s + k - 2 * p
+                            for si, s, k, p in zip(spatial_in, st, ks, pd))
+        N, C = a.shape[:2]
+        size = int(np.prod(spatial))
+        flat_idx = idx.reshape(N, C, -1).astype(jnp.int32)
+        flat_val = a.reshape(N, C, -1)
+        out = jnp.zeros((N, C, size), a.dtype)
+        out = jax.vmap(jax.vmap(
+            lambda o, i, v: o.at[i].set(v)))(out, flat_idx, flat_val)
+        return out.reshape((N, C) + spatial)
+
+    return apply_op("max_unpool", _f, x, indices)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    """Parity: pooling.py max_unpool1d (indices from max_pool(…,
+    return_mask=True))."""
+    return _max_unpool(x, indices, 1, kernel_size, stride, padding,
+                       output_size)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 2, kernel_size, stride, padding,
+                       output_size)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 3, kernel_size, stride, padding,
+                       output_size)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def _f(a, b):
+        d = a - b + epsilon
+        return jnp.linalg.norm(d, ord=p, axis=-1, keepdims=keepdim)
+    return apply_op("pairwise_distance", _f, x, y)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """TSM shift (parity: vision.py temporal_shift): shift a channel
+    fraction one step along the segment (time) axis."""
+
+    def _f(a):
+        if data_format == "NHWC":
+            a = jnp.transpose(a, (0, 3, 1, 2))
+        NT, C, H, W = a.shape
+        N = NT // seg_num
+        v = a.reshape(N, seg_num, C, H, W)
+        c1 = int(C * shift_ratio)
+        c2 = int(C * 2 * shift_ratio)
+        fwd = jnp.concatenate(
+            [v[:, 1:, :c1], jnp.zeros_like(v[:, :1, :c1])], axis=1)
+        bwd = jnp.concatenate(
+            [jnp.zeros_like(v[:, :1, c1:c2]), v[:, :-1, c1:c2]], axis=1)
+        out = jnp.concatenate([fwd, bwd, v[:, :, c2:]], axis=2)
+        out = out.reshape(NT, C, H, W)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return apply_op("temporal_shift", _f, x)
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """Alpha dropout over whole channels (parity: common.py
+    feature_alpha_dropout)."""
+    if not training or p == 0.0:
+        return x
+    from ...framework.random import rng_key
+    key = rng_key()
+    alpha_p = -1.7580993408473766
+
+    def _f(a):
+        shape = (a.shape[0], a.shape[1]) + (1,) * (a.ndim - 2)
+        keep = jax.random.bernoulli(key, 1 - p, shape)
+        q = 1 - p
+        scale_a = (q + alpha_p ** 2 * q * (1 - q)) ** -0.5
+        scale_b = -scale_a * alpha_p * (1 - q)
+        return (jnp.where(keep, a, alpha_p) * scale_a + scale_b).astype(
+            a.dtype)
+
+    return apply_op("feature_alpha_dropout", _f, x)
+
+
+def embedding_bag(input, weight, offsets=None, mode="mean", name=None):
+    """Bagged embedding lookup: gather rows then reduce per bag.
+
+    input (B, L) with per-row bags (offsets=None), or flat indices +
+    offsets (B,) marking bag starts (reference embedding_bag contract)."""
+
+    def _f(ids, w, *rest):
+        reduce = {"mean": jnp.mean, "sum": jnp.sum, "max": jnp.max}[mode]
+        if offsets is None:
+            got = w[ids]                               # (B, L, D)
+            return reduce(got, axis=1)
+        offs = rest[0]
+        flat = w[ids]                                  # (Ltot, D)
+        B = offs.shape[0]
+        Ltot = ids.shape[0]
+        bag_id = jnp.searchsorted(offs, jnp.arange(Ltot),
+                                  side="right") - 1
+        if mode == "sum":
+            return jax.ops.segment_sum(flat, bag_id, B)
+        if mode == "mean":
+            s = jax.ops.segment_sum(flat, bag_id, B)
+            n = jax.ops.segment_sum(jnp.ones((Ltot, 1)), bag_id, B)
+            return s / jnp.maximum(n, 1)
+        return jax.ops.segment_max(flat, bag_id, B)
+
+    args = [input, weight] + ([offsets] if offsets is not None else [])
+    return apply_op("embedding_bag", _f, *args)
+
+
+def _logaddexp(a, b):
+    return jnp.logaddexp(a, b)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False, name=None):
+    """CTC loss as a log-domain forward DP compiled by XLA.
+
+    Parity: nn/functional/loss.py ctc_loss (the reference dynloads
+    warpctc). log_probs (T, B, V) log-softmaxed (raw logits accepted —
+    log_softmax is applied), labels (B, S) int, lengths (B,).
+    """
+    def _f(lp, lab, in_len, lab_len):
+        lp = jax.nn.log_softmax(lp, axis=-1)
+        T, B, V = lp.shape
+        S = lab.shape[1]
+        # extended label sequence: blank y1 blank y2 ... yS blank (2S+1)
+        ext = jnp.full((B, 2 * S + 1), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        L = 2 * S + 1
+        # allow transition from l-2 when ext[l] != blank and != ext[l-2]
+        ext_prev2 = jnp.concatenate(
+            [jnp.full((B, 2), -1, jnp.int32), ext[:, :-2]], axis=1)
+        can_skip = (ext != blank) & (ext != ext_prev2)
+        alpha0 = jnp.full((B, L), NEG)
+        alpha0 = alpha0.at[:, 0].set(lp[0, jnp.arange(B), ext[:, 0]])
+        has1 = (L > 1)
+        if has1:
+            alpha0 = alpha0.at[:, 1].set(
+                jnp.where(lab_len > 0,
+                          lp[0, jnp.arange(B), ext[:, 1]], NEG))
+
+        def step(alpha, lp_t):
+            prev1 = jnp.concatenate(
+                [jnp.full((B, 1), NEG), alpha[:, :-1]], axis=1)
+            prev2 = jnp.concatenate(
+                [jnp.full((B, 2), NEG), alpha[:, :-2]], axis=1)
+            prev2 = jnp.where(can_skip, prev2, NEG)
+            merged = _logaddexp(_logaddexp(alpha, prev1), prev2)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return merged + emit, None
+
+        def scan_body(carry, t):
+            alpha = carry
+            new_alpha, _ = step(alpha, lp[t])
+            # freeze rows past their input length
+            new_alpha = jnp.where((t < in_len)[:, None], new_alpha, alpha)
+            return new_alpha, None
+
+        alpha, _ = jax.lax.scan(scan_body, alpha0, jnp.arange(1, T))
+        # final: logsumexp of positions 2*lab_len and 2*lab_len - 1
+        idx_last = (2 * lab_len).astype(jnp.int32)
+        a_last = jnp.take_along_axis(alpha, idx_last[:, None], axis=1)[:, 0]
+        idx_pen = jnp.maximum(idx_last - 1, 0)
+        a_pen = jnp.where(lab_len > 0,
+                          jnp.take_along_axis(alpha, idx_pen[:, None],
+                                              axis=1)[:, 0], NEG)
+        nll = -_logaddexp(a_last, a_pen)
+        if norm_by_times:
+            nll = nll / jnp.maximum(in_len.astype(nll.dtype), 1)
+        if reduction == "mean":
+            # reference warpctc mean: also divides each loss by label len
+            return jnp.mean(nll
+                            / jnp.maximum(lab_len.astype(nll.dtype), 1))
+        if reduction == "sum":
+            return jnp.sum(nll)
+        return nll
+
+    return apply_op("ctc_loss", _f, log_probs, labels, input_lengths,
+                    label_lengths)
+
+
+def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-T (transducer) loss as a log-domain lattice DP.
+
+    Parity: nn/functional/loss.py rnnt_loss:2061 (reference dynloads
+    warprnnt; fastemit_lambda defaults 0.001 there too). logits
+    (B, T, U+1, V) raw; labels (B, U) int; lengths (B,). FastEmit is the
+    gradient-scaling formulation: emit-arc gradients scale by
+    (1 + lambda) while the reported loss value is the plain RNN-T NLL —
+    exactly warprnnt's behavior.
+    """
+    def _f(lg, lab, t_len, u_len):
+        lp = jax.nn.log_softmax(lg, axis=-1)
+        B, T, U1, V = lp.shape
+        U = U1 - 1
+        blank_lp = lp[..., blank]                      # (B, T, U+1)
+        lab_i = lab.astype(jnp.int32)
+        emit_lp = jnp.take_along_axis(
+            lp[:, :, :U, :], lab_i[:, None, :, None], axis=3)[..., 0]
+        if fastemit_lambda:
+            # value unchanged, emit-arc gradient scaled by (1 + lambda)
+            emit_lp = (emit_lp + fastemit_lambda
+                       * (emit_lp - jax.lax.stop_gradient(emit_lp)))
+        # emit padded to U+1 so u-scans can index u-1 in [0, U]
+        emit_pad = jnp.concatenate(
+            [emit_lp, jnp.full((B, T, 1), NEG)], axis=2)  # (B, T, U+1)
+        valid_u = jnp.arange(U1)[None, :] <= u_len[:, None]
+
+        def climb(base, t):
+            """alpha(t, u) = logsumexp(base(u), alpha(t, u-1) + emit(t, u-1))
+            — the vertical (label-emitting) closure within frame t."""
+            def u_scan(carry, u):
+                em = jnp.take_along_axis(
+                    emit_pad[:, t, :],
+                    jnp.maximum(u - 1, 0).repeat(B)[:, None], axis=1)[:, 0]
+                val = jnp.where(u == 0, base[:, 0],
+                                _logaddexp(
+                                    jnp.take_along_axis(
+                                        base, u.repeat(B)[:, None],
+                                        axis=1)[:, 0],
+                                    carry + em))
+                return val, val
+            _, cols = jax.lax.scan(u_scan, jnp.full((B,), NEG),
+                                   jnp.arange(U1))
+            return jnp.swapaxes(cols, 0, 1)
+
+        # t = 0: only vertical emits from alpha(0,0)=0
+        base0 = jnp.full((B, U1), NEG).at[:, 0].set(0.0)
+        alpha = jnp.where(valid_u, climb(base0, 0), NEG)
+
+        def t_body(alpha, t):
+            base = alpha + blank_lp[:, t - 1, :]       # horizontal (blank)
+            new_alpha = jnp.where(valid_u, climb(base, t), NEG)
+            new_alpha = jnp.where((t < t_len)[:, None], new_alpha, alpha)
+            return new_alpha, None
+
+        alpha, _ = jax.lax.scan(t_body, alpha, jnp.arange(1, T))
+        a_fin = jnp.take_along_axis(alpha, u_len.astype(jnp.int32)[:, None],
+                                    axis=1)[:, 0]
+        bidx = jnp.arange(B)
+        final_blank = blank_lp[bidx, jnp.maximum(t_len - 1, 0),
+                               u_len.astype(jnp.int32)]
+        nll = -(a_fin + final_blank)
+        if reduction == "mean":
+            return jnp.mean(nll)
+        if reduction == "sum":
+            return jnp.sum(nll)
+        return nll
+
+    return apply_op("rnnt_loss", _f, logits, labels, logit_lengths,
+                    label_lengths)

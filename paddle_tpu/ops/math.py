@@ -33,6 +33,7 @@ __all__ = [
     "log_normalize", "renorm", "trapezoid", "cumulative_trapezoid",
     "vander", "i0", "i0e", "i1", "i1e", "polygamma", "combinations",
     "signbit", "copysign", "nextafter", "frexp", "sinc", "take",
+    "igamma", "igammac",
 ]
 
 # ----------------------------------------------------------------- binary
@@ -436,3 +437,18 @@ def combinations(x, r=2, with_replacement=False, name=None):
 @def_op("take")
 def take(x, index, mode="raise", name=None):
     return jnp.take(x.reshape(-1), index.reshape(-1), mode="clip" if mode != "wrap" else "wrap").reshape(index.shape)
+
+
+def igamma(x, y, name=None):
+    """Regularized UPPER incomplete gamma Q(x, y) — Paddle's igamma is
+    igamc (phi IgammaFunctor, impl/gammaincc_kernel_impl.h:112), i.e. the
+    complement of scipy's gammainc. Alias of gammaincc (ops/extras.py)."""
+    from .extras import gammaincc
+    return gammaincc(x, y)
+
+
+def igammac(x, y, name=None):
+    """Regularized LOWER incomplete gamma P(x, y) (Paddle igammac ==
+    gammainc). Alias of gammainc (ops/extras.py)."""
+    from .extras import gammainc
+    return gammainc(x, y)

@@ -1,0 +1,152 @@
+"""nn.functional tail: grid_sample/affine_grid (vs torch), CTC (vs torch),
+RNN-T (vs brute-force lattice enumeration), unpooling, sequence utils."""
+import itertools
+import math
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+
+import paddle_tpu as paddle
+
+F = paddle.nn.functional
+rng = np.random.RandomState(0)
+
+
+@pytest.mark.parametrize("align", [True, False])
+@pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+def test_grid_sample_matches_torch(align, mode):
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    g = (rng.rand(2, 5, 6, 2).astype(np.float32) * 2.4 - 1.2)  # some OOB
+    ours = np.asarray(F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(g),
+                                    mode=mode, align_corners=align)._data)
+    ref = TF.grid_sample(torch.tensor(x), torch.tensor(g), mode=mode,
+                         align_corners=align).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_grid_sample_gradients():
+    x = paddle.to_tensor(rng.randn(1, 2, 6, 6).astype(np.float32))
+    g = paddle.to_tensor((rng.rand(1, 4, 4, 2).astype(np.float32) - 0.5))
+    x.stop_gradient = False
+    g.stop_gradient = False
+    F.grid_sample(x, g).sum().backward()
+    assert x.grad is not None and g.grad is not None
+
+
+def test_affine_grid_matches_torch():
+    th = rng.randn(2, 2, 3).astype(np.float32)
+    for align in (True, False):
+        ours = np.asarray(F.affine_grid(paddle.to_tensor(th), [2, 3, 7, 5],
+                                        align_corners=align)._data)
+        ref = TF.affine_grid(torch.tensor(th), [2, 3, 7, 5],
+                             align_corners=align).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_ctc_loss_matches_torch():
+    T_, B, V, S = 12, 3, 6, 4
+    logits = rng.randn(T_, B, V).astype(np.float32)
+    labels = rng.randint(1, V, (B, S)).astype(np.int64)
+    in_len = np.array([12, 10, 8], np.int64)
+    lab_len = np.array([4, 3, 2], np.int64)
+    ours = np.asarray(F.ctc_loss(
+        paddle.to_tensor(logits), paddle.to_tensor(labels),
+        paddle.to_tensor(in_len), paddle.to_tensor(lab_len),
+        reduction="none")._data)
+    ref = TF.ctc_loss(torch.log_softmax(torch.tensor(logits), -1),
+                      torch.tensor(labels), torch.tensor(in_len),
+                      torch.tensor(lab_len), blank=0,
+                      reduction="none").numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_ctc_loss_gradients_flow():
+    logits = paddle.to_tensor(rng.randn(6, 2, 5).astype(np.float32))
+    logits.stop_gradient = False
+    loss = F.ctc_loss(logits,
+                      paddle.to_tensor(np.array([[1, 2], [3, 4]], np.int64)),
+                      paddle.to_tensor(np.array([6, 5], np.int64)),
+                      paddle.to_tensor(np.array([2, 2], np.int64)))
+    loss.backward()
+    assert np.isfinite(np.asarray(logits.grad._data)).all()
+
+
+def test_rnnt_loss_brute_force():
+    B, T, U, V = 1, 3, 2, 4
+    lg = np.random.RandomState(3).randn(B, T, U + 1, V).astype(np.float32)
+    lb = np.array([[1, 2]], np.int32)
+    ours = float(np.asarray(F.rnnt_loss(
+        paddle.to_tensor(lg), paddle.to_tensor(lb),
+        paddle.to_tensor(np.array([3], np.int32)),
+        paddle.to_tensor(np.array([2], np.int32)),
+        reduction="none")._data)[0])
+    lp = lg[0] - np.log(np.exp(lg[0]).sum(-1, keepdims=True))
+
+    def lse(a, b):
+        m = max(a, b)
+        return m + math.log(math.exp(a - m) + math.exp(b - m))
+
+    total = -np.inf
+    for moves in set(itertools.permutations(["b"] * T + ["y"] * U)):
+        if moves[-1] != "b":
+            continue
+        t = u = 0
+        s = 0.0
+        for mv in moves:
+            if mv == "b":
+                s += lp[t, u, 0]
+                t += 1
+            else:
+                s += lp[t, u, lb[0, u]]
+                u += 1
+        total = lse(total, s)
+    assert abs(ours + total) < 1e-4
+
+
+def test_max_unpool2d_roundtrip():
+    x = rng.randn(1, 2, 4, 4).astype(np.float32)
+    tx = torch.tensor(x)
+    pooled, idx = TF.max_pool2d(tx, 2, return_indices=True)
+    ours = np.asarray(F.max_unpool2d(
+        paddle.to_tensor(pooled.numpy()), paddle.to_tensor(idx.numpy()),
+        kernel_size=2)._data)
+    ref = TF.max_unpool2d(pooled, idx, 2).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-6)
+    # padding shrinks the inferred output ((si-1)*s + k - 2p)
+    x6 = rng.randn(1, 1, 6, 6).astype(np.float32)
+    p6, i6 = TF.max_pool2d(torch.tensor(x6), 2, stride=2, padding=1,
+                           return_indices=True)
+    ours6 = np.asarray(F.max_unpool2d(
+        paddle.to_tensor(p6.numpy()), paddle.to_tensor(i6.numpy()),
+        kernel_size=2, stride=2, padding=1)._data)
+    np.testing.assert_allclose(
+        ours6, TF.max_unpool2d(p6, i6, 2, stride=2, padding=1).numpy())
+
+
+def test_sequence_mask_embedding_bag_temporal_shift():
+    m_t = F.sequence_mask(
+        paddle.to_tensor(np.array([2, 4], np.int64)), maxlen=5)
+    assert str(m_t._data.dtype) == "int64"  # reference default dtype
+    m = np.asarray(m_t._data)
+    np.testing.assert_array_equal(m, [[1, 1, 0, 0, 0], [1, 1, 1, 1, 0]])
+    w = rng.randn(10, 4).astype(np.float32)
+    ids = np.array([[1, 2, 3], [4, 5, 6]], np.int64)
+    bag = np.asarray(F.embedding_bag(paddle.to_tensor(ids),
+                                     paddle.to_tensor(w),
+                                     mode="mean")._data)
+    np.testing.assert_allclose(bag, w[ids].mean(1), rtol=1e-6)
+    flat = np.array([1, 2, 3, 4], np.int64)
+    offs = np.array([0, 3], np.int64)
+    bag2 = np.asarray(F.embedding_bag(paddle.to_tensor(flat),
+                                      paddle.to_tensor(w),
+                                      paddle.to_tensor(offs),
+                                      mode="sum")._data)
+    np.testing.assert_allclose(bag2, [w[[1, 2, 3]].sum(0), w[[4]].sum(0)],
+                               rtol=1e-6)
+    x = rng.randn(4, 8, 3, 3).astype(np.float32)  # (N*T, C, H, W), T=2
+    ts = np.asarray(F.temporal_shift(paddle.to_tensor(x), seg_num=2)._data)
+    v = x.reshape(2, 2, 8, 3, 3)
+    np.testing.assert_allclose(ts.reshape(2, 2, 8, 3, 3)[:, 0, :2],
+                               v[:, 1, :2], rtol=1e-6)  # fwd-shifted block
