@@ -21,3 +21,11 @@ from .layer import transformer, rnn  # noqa: F401
 
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
 from .utils_ import ParamAttr  # noqa: F401
+
+
+from .layer.extra_layers import (  # noqa: E402,F401
+    ParameterDict, ZeroPad1D, ZeroPad3D, HSigmoidLoss,
+    AdaptiveLogSoftmaxWithLoss, FractionalMaxPool2D, FractionalMaxPool3D,
+    BeamSearchDecoder, dynamic_decode, CTCLoss, RNNTLoss, MaxUnPool1D,
+    MaxUnPool2D, MaxUnPool3D, FeatureAlphaDropout)
+from .layer.rnn import RNNCellBase  # noqa: E402,F401

@@ -194,11 +194,13 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
         v = a.reshape(N, seg_num, C, H, W)
         c1 = int(C * shift_ratio)
         c2 = int(C * 2 * shift_ratio)
-        fwd = jnp.concatenate(
-            [v[:, 1:, :c1], jnp.zeros_like(v[:, :1, :c1])], axis=1)
-        bwd = jnp.concatenate(
-            [jnp.zeros_like(v[:, :1, c1:c2]), v[:, :-1, c1:c2]], axis=1)
-        out = jnp.concatenate([fwd, bwd, v[:, :, c2:]], axis=2)
+        # phi temporal_shift_kernel.cc: channels [0, c1) read frame t-1,
+        # channels [c1, c2) read frame t+1
+        from_prev = jnp.concatenate(
+            [jnp.zeros_like(v[:, :1, :c1]), v[:, :-1, :c1]], axis=1)
+        from_next = jnp.concatenate(
+            [v[:, 1:, c1:c2], jnp.zeros_like(v[:, :1, c1:c2])], axis=1)
+        out = jnp.concatenate([from_prev, from_next, v[:, :, c2:]], axis=2)
         out = out.reshape(NT, C, H, W)
         if data_format == "NHWC":
             out = jnp.transpose(out, (0, 2, 3, 1))
@@ -214,7 +216,8 @@ def feature_alpha_dropout(x, p=0.5, training=True, name=None):
         return x
     from ...framework.random import rng_key
     key = rng_key()
-    alpha_p = -1.7580993408473766
+    selu_alpha, selu_scale = 1.6732632423543772, 1.0507009873554805
+    alpha_p = -selu_alpha * selu_scale   # same derivation as alpha_dropout
 
     def _f(a):
         shape = (a.shape[0], a.shape[1]) + (1,) * (a.ndim - 2)

@@ -1,0 +1,519 @@
+"""nn layer tail: ParameterDict, ZeroPad1D/3D, HSigmoidLoss,
+AdaptiveLogSoftmaxWithLoss, FractionalMaxPool2D/3D, BeamSearchDecoder +
+dynamic_decode.
+
+Parity: reference `python/paddle/nn/` — container.py ParameterDict,
+padding ZeroPad1D/3D, loss.py HSigmoidLoss (complete-binary-tree
+hierarchical sigmoid, `phi/kernels/hsigmoid_loss_kernel.h`),
+AdaptiveLogSoftmaxWithLoss (cluster-partitioned vocabulary softmax),
+pooling.py FractionalMaxPool2D/3D (pseudo-random pooling regions,
+`phi/kernels/fractional_max_pool2d_kernel.h`), decode.py
+BeamSearchDecoder/dynamic_decode.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...ops.dispatch import apply_op
+from .layers import Layer
+
+_builtins_list = list
+
+from ..functional.extra import (ctc_loss, feature_alpha_dropout,
+                                max_unpool1d, max_unpool2d, max_unpool3d,
+                                rnnt_loss)
+
+__all__ = ["ParameterDict", "ZeroPad1D", "ZeroPad3D", "HSigmoidLoss",
+           "AdaptiveLogSoftmaxWithLoss", "FractionalMaxPool2D",
+           "FractionalMaxPool3D", "BeamSearchDecoder", "dynamic_decode",
+           "CTCLoss", "RNNTLoss", "MaxUnPool1D", "MaxUnPool2D",
+           "MaxUnPool3D", "FeatureAlphaDropout"]
+
+
+class CTCLoss(Layer):
+    """Parity: paddle.nn.CTCLoss over F.ctc_loss."""
+
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank, self.reduction = blank, reduction
+
+    def forward(self, logits, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return ctc_loss(logits, labels, input_lengths, label_lengths,
+                        blank=self.blank, reduction=self.reduction,
+                        norm_by_times=norm_by_times)
+
+
+class RNNTLoss(Layer):
+    """Parity: paddle.nn.RNNTLoss over F.rnnt_loss."""
+
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, logits, labels, logit_lengths, label_lengths):
+        return rnnt_loss(logits, labels, logit_lengths, label_lengths,
+                         blank=self.blank,
+                         fastemit_lambda=self.fastemit_lambda,
+                         reduction=self.reduction)
+
+
+class _UnpoolBase(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format=None, output_size=None, name=None):
+        super().__init__()
+        self._cfg = (kernel_size, stride, padding, output_size)
+
+
+class MaxUnPool1D(_UnpoolBase):
+    def forward(self, x, indices):
+        k, s, p, o = self._cfg
+        return max_unpool1d(x, indices, k, s, p, output_size=o)
+
+
+class MaxUnPool2D(_UnpoolBase):
+    def forward(self, x, indices):
+        k, s, p, o = self._cfg
+        return max_unpool2d(x, indices, k, s, p, output_size=o)
+
+
+class MaxUnPool3D(_UnpoolBase):
+    def forward(self, x, indices):
+        k, s, p, o = self._cfg
+        return max_unpool3d(x, indices, k, s, p, output_size=o)
+
+
+class FeatureAlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return feature_alpha_dropout(x, self.p, self.training)
+
+
+class ParameterDict(Layer):
+    """Keyed parameter container (parity: nn.ParameterDict)."""
+
+    def __init__(self, parameters=None):
+        super().__init__()
+        if parameters:
+            items = parameters.items() if hasattr(parameters, "items") \
+                else parameters
+            for k, v in items:
+                self.add_parameter(str(k), v)
+
+    def __getitem__(self, key):
+        return self._parameters[key]
+
+    def __setitem__(self, key, param):
+        self.add_parameter(str(key), param)
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters)
+
+    def keys(self):
+        return self._parameters.keys()
+
+    def values(self):
+        return self._parameters.values()
+
+    def items(self):
+        return self._parameters.items()
+
+    def update(self, parameters):
+        for k, v in (parameters.items() if hasattr(parameters, "items")
+                     else parameters):
+            self.add_parameter(str(k), v)
+
+
+class _ZeroPadNd(Layer):
+    _nd = 1
+
+    def __init__(self, padding, data_format=None, name=None):
+        super().__init__()
+        nd = self._nd
+        if isinstance(padding, int):
+            padding = [padding] * (2 * nd)
+        self._padding = [int(p) for p in padding]
+        self._channels_last = bool(data_format) and data_format.endswith("C")
+
+    def forward(self, x):
+        pads = self._padding
+        nd = self._nd
+        channels_last = self._channels_last
+
+        def _f(a):
+            dims = [(pads[2 * d], pads[2 * d + 1]) for d in range(nd)]
+            if channels_last:
+                # NLC / NDHWC: spatial axes are 1..nd
+                cfg = ([(0, 0)] + _builtins_list(reversed(dims))
+                       + [(0, 0)] * (a.ndim - nd - 1))
+            else:
+                cfg = ([(0, 0)] * (a.ndim - nd)
+                       + _builtins_list(reversed(dims)))
+            return jnp.pad(a, cfg)
+
+        return apply_op("zero_pad", _f, x)
+
+
+class ZeroPad1D(_ZeroPadNd):
+    _nd = 1
+
+
+class ZeroPad3D(_ZeroPadNd):
+    _nd = 3
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid over a complete binary tree (the reference's
+    default, non-custom-tree mode): each class's probability is a product
+    of sigmoid decisions along its path; loss = -log p(label)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if is_custom:
+            raise NotImplementedError("custom trees not supported")
+        self.num_classes = num_classes
+        self.depth = max(1, math.ceil(math.log2(max(num_classes, 2))))
+        n_nodes = num_classes - 1  # internal nodes of the complete tree
+        self.weight = self.create_parameter((max(n_nodes, 1), feature_size))
+        self.add_parameter("weight", self.weight)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (max(n_nodes, 1),), is_bias=True)
+        if self.bias is not None:
+            self.add_parameter("bias", self.bias)
+        # precompute (node index, direction) paths per class: the classes
+        # are the leaves of a complete binary tree rooted at node 1
+        # (heap layout); internal node i has children 2i, 2i+1
+        codes = np.zeros((num_classes, self.depth), np.int32)
+        signs = np.zeros((num_classes, self.depth), np.float32)
+        mask = np.zeros((num_classes, self.depth), np.float32)
+        for c in range(num_classes):
+            node = c + num_classes  # leaves occupy [num_classes, 2N)
+            d = 0
+            path = []
+            while node > 1:
+                parent = node // 2
+                path.append((parent - 1, 1.0 if node % 2 == 0 else -1.0))
+                node = parent
+            for d, (idx, sgn) in enumerate(reversed(path)):
+                if d < self.depth and idx < max(n_nodes, 1):
+                    codes[c, d] = idx
+                    signs[c, d] = sgn
+                    mask[c, d] = 1.0
+        self._codes = jnp.asarray(codes)
+        self._signs = jnp.asarray(signs)
+        self._mask = jnp.asarray(mask)
+
+    def forward(self, input, label):
+        def _f(x, lab, w, *maybe_b):
+            b = maybe_b[0] if maybe_b else None
+            lab = lab.reshape(-1).astype(jnp.int32)
+            nodes = self._codes[lab]                  # (B, depth)
+            sgn = self._signs[lab]
+            msk = self._mask[lab]
+            wv = w[nodes]                             # (B, depth, F)
+            logits = jnp.einsum("bdf,bf->bd", wv, x)
+            if b is not None:
+                logits = logits + b[nodes]
+            # sign convention: +1 -> left (sigmoid), -1 -> right
+            logp = jax.nn.log_sigmoid(sgn * logits) * msk
+            return -(logp.sum(axis=1, keepdims=True))
+
+        args = [input, label, self.weight]
+        if self.bias is not None:
+            args.append(self.bias)
+        return apply_op("hsigmoid_loss", _f, *args)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """Cluster-partitioned softmax (parity: nn.AdaptiveLogSoftmaxWithLoss):
+    a head over [shortlist + one token per tail cluster], each tail
+    cluster projected down by div_value^i and scored lazily."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        cutoffs = list(cutoffs)
+        if not cutoffs or cutoffs != sorted(set(cutoffs)) \
+                or cutoffs[-1] > n_classes - 1:
+            raise ValueError(f"bad cutoffs {cutoffs}")
+        self.cutoffs = cutoffs + [n_classes]
+        self.n_clusters = len(self.cutoffs) - 1
+        self.head_size = self.cutoffs[0] + self.n_clusters
+        self.head_weight = self.create_parameter(
+            (in_features, self.head_size))
+        self.add_parameter("head_weight", self.head_weight)
+        self.head_bias = self.create_parameter(
+            (self.head_size,), is_bias=True) if head_bias else None
+        if self.head_bias is not None:
+            self.add_parameter("head_bias", self.head_bias)
+        self._tails = []
+        for i in range(self.n_clusters):
+            hsz = max(1, int(in_features // (div_value ** (i + 1))))
+            osz = self.cutoffs[i + 1] - self.cutoffs[i]
+            p1 = self.create_parameter((in_features, hsz))
+            p2 = self.create_parameter((hsz, osz))
+            self.add_parameter(f"tail_{i}_proj", p1)
+            self.add_parameter(f"tail_{i}_out", p2)
+            self._tails.append((p1, p2))
+
+    def _head_logprob(self, x_arr, params):
+        hw, hb = params[0], params[1]
+        logits = x_arr @ hw
+        if hb is not None:
+            logits = logits + hb
+        return jax.nn.log_softmax(logits, axis=-1)
+
+    def forward(self, input, label):
+        def _f(x, lab, *ps):
+            hb = ps[1] if self.head_bias is not None else None
+            tails = ps[2:] if self.head_bias is not None else ps[1:]
+            head_lp = self._head_logprob(x, (ps[0], hb))
+            lab = lab.reshape(-1).astype(jnp.int32)
+            out = jnp.zeros(lab.shape, x.dtype)
+            short = lab < self.cutoffs[0]
+            gathered = jnp.take_along_axis(
+                head_lp, jnp.clip(lab, 0, self.cutoffs[0] - 1)[:, None],
+                axis=1)[:, 0]
+            out = jnp.where(short, gathered, out)
+            for i in range(self.n_clusters):
+                lo, hi = self.cutoffs[i], self.cutoffs[i + 1]
+                in_c = (lab >= lo) & (lab < hi)
+                p1, p2 = tails[2 * i], tails[2 * i + 1]
+                tail_lp = jax.nn.log_softmax((x @ p1) @ p2, axis=-1)
+                rel = jnp.clip(lab - lo, 0, hi - lo - 1)
+                t = jnp.take_along_axis(tail_lp, rel[:, None], axis=1)[:, 0]
+                cluster_lp = head_lp[:, self.cutoffs[0] + i]
+                out = jnp.where(in_c, cluster_lp + t, out)
+            return out, -jnp.mean(out)
+
+        args = [input, label, self.head_weight]
+        if self.head_bias is not None:
+            args.append(self.head_bias)
+        for p1, p2 in self._tails:
+            args += [p1, p2]
+        return apply_op("adaptive_log_softmax", _f, *args)
+
+    def log_prob(self, input):
+        def _f(x, *ps):
+            hb = ps[1] if self.head_bias is not None else None
+            tails = ps[2:] if self.head_bias is not None else ps[1:]
+            head_lp = self._head_logprob(x, (ps[0], hb))
+            parts = [head_lp[:, :self.cutoffs[0]]]
+            for i in range(self.n_clusters):
+                p1, p2 = tails[2 * i], tails[2 * i + 1]
+                tail_lp = jax.nn.log_softmax((x @ p1) @ p2, axis=-1)
+                parts.append(head_lp[:, self.cutoffs[0] + i][:, None]
+                             + tail_lp)
+            return jnp.concatenate(parts, axis=1)
+
+        args = [input, self.head_weight]
+        if self.head_bias is not None:
+            args.append(self.head_bias)
+        for p1, p2 in self._tails:
+            args += [p1, p2]
+        return apply_op("adaptive_log_softmax_logprob", _f, *args)
+
+    def predict(self, input):
+        lp = self.log_prob(input)
+        from ...ops.search import argmax
+        return argmax(lp, axis=-1)
+
+
+class _FractionalMaxPoolNd(Layer):
+    _nd = 2
+
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        nd = self._nd
+        self._out = (output_size,) * nd if isinstance(output_size, int) \
+            else tuple(output_size)
+        self._return_mask = return_mask
+        self._k = None if kernel_size is None else (
+            (kernel_size,) * nd if isinstance(kernel_size, int)
+            else tuple(kernel_size))
+        self._u = random_u
+
+    def forward(self, x):
+        nd = self._nd
+        outs = self._out
+        ksz = self._k
+        want_mask = self._return_mask
+
+        def _f(a):
+            spatial = a.shape[-nd:]
+            from ...framework.random import rng_key
+            # pseudo-random region boundaries (fractional pooling,
+            # Graham 2014): alpha = in/out, row i starts at
+            # ceil(alpha*(i+u)) - ceil(alpha*u); disjoint regions end at
+            # the next start, overlapping mode uses kernel_size windows
+            if self._u is not None:
+                us = [float(self._u)] * nd
+            else:
+                key = rng_key()
+                us = [float(v) for v in np.asarray(
+                    jax.random.uniform(key, (nd,), minval=0.0,
+                                       maxval=1.0))]
+            bounds_per_dim = []
+            for d, (size, out, u) in enumerate(zip(spatial, outs, us)):
+                alpha = size / out
+                starts = [int(np.ceil(alpha * (i + u))) - int(
+                    np.ceil(alpha * u)) for i in range(out + 1)]
+                starts[-1] = size
+                spans = []
+                for i in range(out):
+                    s0 = min(starts[i], size - 1)
+                    if ksz is not None:
+                        e0 = min(s0 + ksz[d], size)
+                    else:
+                        e0 = max(starts[i + 1], s0 + 1)
+                    spans.append((s0, min(max(e0, s0 + 1), size)))
+                bounds_per_dim.append(spans)
+
+            def region(idx):
+                sl = [slice(None)] * (a.ndim - nd)
+                off = []
+                for d, i in enumerate(idx):
+                    s0, e0 = bounds_per_dim[d][i]
+                    sl.append(slice(s0, e0))
+                    off.append(s0)
+                reg = a[tuple(sl)]
+                red_axes = tuple(range(a.ndim - nd, a.ndim))
+                mx = reg.max(axis=red_axes)
+                if not want_mask:
+                    return mx, None
+                flat = reg.reshape(reg.shape[:a.ndim - nd] + (-1,))
+                am = jnp.argmax(flat, axis=-1)
+                # unravel within the region, shift by offsets, linearize
+                # into the full spatial frame (paddle mask convention)
+                rshape = reg.shape[a.ndim - nd:]
+                lin = jnp.zeros_like(am)
+                rem = am
+                for d in range(nd):
+                    stride = int(np.prod(rshape[d + 1:])) or 1
+                    coord = rem // stride + off[d]
+                    rem = rem % stride
+                    lin = lin * spatial[d] + coord
+                return mx, lin
+
+            import itertools
+            cells = [region(idx) for idx in
+                     itertools.product(*[range(o) for o in outs])]
+            out_arr = jnp.stack([c[0] for c in cells], axis=-1)
+            out_arr = out_arr.reshape(a.shape[:-nd] + outs)
+            if want_mask:
+                mask = jnp.stack([c[1] for c in cells], axis=-1)
+                mask = mask.reshape(a.shape[:-nd] + outs)
+                return out_arr, mask
+            return out_arr
+
+        return apply_op("fractional_max_pool", _f, x)
+
+
+class FractionalMaxPool2D(_FractionalMaxPoolNd):
+    _nd = 2
+
+
+class FractionalMaxPool3D(_FractionalMaxPoolNd):
+    _nd = 3
+
+
+class BeamSearchDecoder:
+    """Beam search over an RNN cell (parity: nn/decode.py
+    BeamSearchDecoder — the eager seq2seq decoding API)."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def _logits(self, tok, states):
+        emb = self.embedding_fn(tok) if self.embedding_fn else tok
+        out, new_states = self.cell(emb, states)
+        if self.output_fn is not None:
+            out = self.output_fn(out)
+        return out, new_states
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=32, batch_size=1,
+                   **kwargs):
+    """Run beam search until every beam emits end_token or max_step_num.
+
+    Returns (token ids (B, beam, T), final scores (B, beam)) for
+    batch_size independent decodes (eager host loop — parity:
+    nn/decode.py dynamic_decode; the compiled serving path is
+    models/generation.jit_generate)."""
+    import numpy as np
+
+    beam = decoder.beam_size
+    all_ids, all_scores = [], []
+    for _b in range(batch_size):
+        states = inits[_b] if isinstance(inits, (list, tuple)) else inits
+        first = Tensor(jnp.asarray([[decoder.start_token]], jnp.int64))
+        logits, states = decoder._logits(first, states)
+        lp = jax.nn.log_softmax(
+            logits._data[0, -1] if logits._data.ndim == 3
+            else logits._data[0], axis=-1)
+        top_lp, top_id = jax.lax.top_k(lp, beam)
+        seqs = [[int(t)] for t in np.asarray(top_id)]
+        scores = np.asarray(top_lp, np.float64).copy()
+        beam_states = [states] * beam
+        done = [s[-1] == decoder.end_token for s in seqs]
+        for _ in range(max_step_num - 1):
+            if all(done):
+                break
+            cand = []
+            for b in range(beam):
+                if done[b]:
+                    cand.append((scores[b], b, decoder.end_token,
+                                 beam_states[b]))
+                    continue
+                tok = Tensor(jnp.asarray([[seqs[b][-1]]], jnp.int64))
+                logits, st = decoder._logits(tok, beam_states[b])
+                lp = jax.nn.log_softmax(
+                    logits._data[0, -1] if logits._data.ndim == 3
+                    else logits._data[0], axis=-1)
+                t_lp, t_id = jax.lax.top_k(lp, beam)
+                for l, i in zip(np.asarray(t_lp), np.asarray(t_id)):
+                    cand.append((scores[b] + float(l), b, int(i), st))
+            cand.sort(key=lambda c: -c[0])
+            new_seqs, new_scores, new_states, new_done = [], [], [], []
+            for sc, b, tok, st in cand[:beam]:
+                new_seqs.append(seqs[b] + ([tok] if not done[b] else []))
+                new_scores.append(sc)
+                new_states.append(st)
+                new_done.append(done[b] or tok == decoder.end_token)
+            seqs, beam_states, done = new_seqs, new_states, new_done
+            scores = np.asarray(new_scores)
+        T = max(len(s) for s in seqs)
+        ids = np.full((beam, T), decoder.end_token, np.int64)
+        for b, s in enumerate(seqs):
+            ids[b, :len(s)] = s
+        all_ids.append(ids)
+        all_scores.append(scores)
+    T = max(a.shape[1] for a in all_ids)
+    out = np.full((batch_size, beam, T), decoder.end_token, np.int64)
+    for i, a in enumerate(all_ids):
+        out[i, :, :a.shape[1]] = a
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(
+        np.stack(all_scores)))

@@ -148,5 +148,87 @@ def test_sequence_mask_embedding_bag_temporal_shift():
     x = rng.randn(4, 8, 3, 3).astype(np.float32)  # (N*T, C, H, W), T=2
     ts = np.asarray(F.temporal_shift(paddle.to_tensor(x), seg_num=2)._data)
     v = x.reshape(2, 2, 8, 3, 3)
-    np.testing.assert_allclose(ts.reshape(2, 2, 8, 3, 3)[:, 0, :2],
-                               v[:, 1, :2], rtol=1e-6)  # fwd-shifted block
+    # phi convention: channels [0, c1) at frame t read frame t-1
+    np.testing.assert_allclose(ts.reshape(2, 2, 8, 3, 3)[:, 1, :2],
+                               v[:, 0, :2], rtol=1e-6)
+    assert (ts.reshape(2, 2, 8, 3, 3)[:, 0, :2] == 0).all()  # t=0 pads
+    # channels [c1, c2) read frame t+1
+    np.testing.assert_allclose(ts.reshape(2, 2, 8, 3, 3)[:, 0, 2:4],
+                               v[:, 1, 2:4], rtol=1e-6)
+
+
+def test_nn_layer_tail_exports_and_behavior():
+    """ParameterDict / ZeroPad / HSigmoid / AdaptiveLogSoftmax /
+    FractionalMaxPool / BeamSearchDecoder (reference nn.__all__ parity)."""
+    for n in ["RNNCellBase", "dynamic_decode", "BeamSearchDecoder",
+              "ParameterDict", "HSigmoidLoss", "AdaptiveLogSoftmaxWithLoss",
+              "FractionalMaxPool2D", "FractionalMaxPool3D", "ZeroPad1D",
+              "ZeroPad3D", "CTCLoss", "RNNTLoss", "MaxUnPool2D"]:
+        assert hasattr(paddle.nn, n), n
+    pd = paddle.nn.ParameterDict()
+    w = paddle.nn.Linear(2, 2).weight
+    pd["w"] = w
+    assert len(pd.parameters()) == 1 and "w" in pd.keys()
+    zp = paddle.nn.ZeroPad1D([1, 2])
+    out = zp(paddle.to_tensor(np.ones((1, 2, 3), np.float32)))
+    assert list(out.shape) == [1, 2, 6]
+    np.testing.assert_allclose(np.asarray(out._data)[0, 0],
+                               [0, 1, 1, 1, 0, 0])
+
+
+def test_hsigmoid_learns_to_separate():
+    paddle.seed(0)
+    hs = paddle.nn.HSigmoidLoss(8, 4)
+    opt = paddle.optimizer.Adam(5e-2, parameters=hs.parameters())
+    x = paddle.to_tensor(rng.randn(16, 8).astype(np.float32))
+    lab = paddle.to_tensor((np.arange(16) % 4).astype(np.int64))
+    first = last = None
+    for _ in range(25):
+        loss = hs(x, lab).mean()
+        loss.backward(); opt.step(); opt.clear_grad()
+        v = float(np.asarray(loss._data))
+        first = first or v
+        last = v
+    assert last < first * 0.6
+
+
+def test_adaptive_log_softmax_normalizes():
+    paddle.seed(1)
+    als = paddle.nn.AdaptiveLogSoftmaxWithLoss(12, 30, [5, 15],
+                                               head_bias=True)
+    x = paddle.to_tensor(rng.randn(6, 12).astype(np.float32))
+    lp = np.asarray(als.log_prob(x)._data)
+    assert lp.shape == (6, 30)
+    np.testing.assert_allclose(np.exp(lp).sum(1), 1.0, rtol=1e-4)
+    labels = np.array([0, 4, 6, 14, 16, 29], np.int64)
+    out, loss = als(x, paddle.to_tensor(labels))
+    np.testing.assert_allclose(np.asarray(out._data),
+                               lp[np.arange(6), labels], rtol=1e-4)
+    pred = als.predict(x)
+    assert np.asarray(pred._data).shape == (6,)
+
+
+def test_fractional_max_pool_and_beam_search():
+    import jax.numpy as jnp
+    fp = paddle.nn.FractionalMaxPool2D(output_size=4, random_u=0.7)
+    x = rng.randn(2, 3, 9, 9).astype(np.float32)
+    out = np.asarray(fp(paddle.to_tensor(x))._data)
+    assert out.shape == (2, 3, 4, 4)
+    # every output is the max of SOME input region => must exist in input
+    for n in range(2):
+        for c in range(3):
+            assert np.isin(out[n, c], x[n, c]).all()
+    W = rng.randn(4, 9).astype(np.float32)
+
+    class ToyCell:
+        def __call__(self, emb, state):
+            return paddle.to_tensor(emb._data @ jnp.asarray(W)), state
+
+    dec = paddle.nn.BeamSearchDecoder(
+        ToyCell(), start_token=1, end_token=8, beam_size=3,
+        embedding_fn=lambda t: paddle.to_tensor(
+            jnp.eye(9, 4)[t._data[..., 0]]))
+    ids, scores = paddle.nn.dynamic_decode(dec, max_step_num=5)
+    assert np.asarray(ids._data).shape[1] == 3
+    s = np.asarray(scores._data)[0]
+    assert (np.diff(s) <= 1e-6).all()  # beams sorted by score
