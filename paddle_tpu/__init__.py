@@ -66,6 +66,34 @@ from . import signal  # noqa: F401,E402
 from . import strings  # noqa: F401,E402
 from . import hub  # noqa: F401,E402
 from . import version  # noqa: F401,E402
+from .compat import (  # noqa: F401,E402
+    CPUPlace, CUDAPlace, CUDAPinnedPlace, XPUPlace, CustomPlace, shape,
+    tolist, reverse, batch, set_printoptions, disable_signal_handler,
+    check_shape, set_cuda_rng_state, get_cuda_rng_state)
+from .compat import _export_inplace as _exp_inp  # noqa: E402
+_exp_inp(globals())
+del _exp_inp
+
+# remaining reference top-level aliases
+from .nn.utils_ import ParamAttr  # noqa: F401,E402
+bool = bool_  # noqa: F401,E402  (paddle.bool dtype alias, like reference)
+import numpy as _np  # noqa: E402
+dtype = _np.dtype  # Tensor.dtype values are numpy dtype instances, so
+# isinstance(x.dtype, paddle.dtype) holds — the reference idiom
+floor_mod = mod  # noqa: F811,E402
+floor_mod_ = globals().get("mod_", None) or floor_mod
+
+
+class LazyGuard:
+    """Parity: paddle.LazyGuard — defers parameter initialization in the
+    reference; initialization here is already lazy-cheap (jax arrays
+    materialize on first use), so the guard is a no-op context."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
 from .ops import linalg  # noqa: F401,E402
 from .hapi import callbacks  # noqa: F401,E402
 

@@ -1,0 +1,160 @@
+"""Top-level namespace tail: module-level in-place ops, Place classes,
+and small utilities.
+
+Parity: reference `python/paddle/__init__.py` exports — the `op_`
+in-place variants are already Tensor methods (ops/methods.py); this
+module lifts them to module functions the way the reference does.
+Place classes collapse onto jax devices (`paddle/phi/common/place.h`):
+on a TPU build CUDAPlace is absent hardware, so it maps to the default
+accelerator slot for API compatibility.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .core.tensor import Tensor
+
+__all__ = ["CPUPlace", "CUDAPlace", "CUDAPinnedPlace", "XPUPlace",
+           "CustomPlace", "shape", "tolist", "reverse", "batch",
+           "set_printoptions", "disable_signal_handler", "check_shape",
+           "set_cuda_rng_state", "get_cuda_rng_state"]
+
+
+class _Place:
+    _kind = "undefined"
+
+    def __init__(self, device_id=0):
+        self._id = int(device_id)
+
+    def __repr__(self):
+        return f"Place({self._kind}:{self._id})" if self._kind != "cpu" \
+            else "Place(cpu)"
+
+    def __eq__(self, other):
+        return (type(self) is type(other)
+                and self._id == getattr(other, "_id", None))
+
+
+class CPUPlace(_Place):
+    _kind = "cpu"
+
+    def __init__(self):
+        super().__init__(0)
+
+
+class CUDAPlace(_Place):
+    """Accelerator slot i — on this build the attached TPU/XLA device
+    (kept for API compatibility with reference code that constructs
+    CUDAPlace)."""
+    _kind = "accelerator"
+
+
+class CUDAPinnedPlace(_Place):
+    _kind = "pinned"
+
+    def __init__(self):
+        super().__init__(0)
+
+
+class XPUPlace(_Place):
+    _kind = "xpu"
+
+
+class CustomPlace(_Place):
+    _kind = "custom"
+
+    def __init__(self, dev_type, device_id=0):
+        super().__init__(device_id)
+        self._kind = str(dev_type)
+
+
+def shape(input, name=None):
+    """Runtime shape as an int32 tensor (reference paddle.shape)."""
+    import jax.numpy as jnp
+    arr = input._data if isinstance(input, Tensor) else input
+    return Tensor(jnp.asarray(arr.shape, jnp.int32))
+
+
+def tolist(x):
+    return np.asarray(x._data if isinstance(x, Tensor) else x).tolist()
+
+
+def reverse(x, axis, name=None):
+    """Alias of flip (the reference keeps both names)."""
+    from .ops.manipulation import flip
+    return flip(x, axis)
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Legacy reader decorator (reference paddle.batch)."""
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Forward to numpy's print options (tensors repr through numpy)."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def disable_signal_handler():
+    """No-op: the reference installs C++ signal handlers that this build
+    never registers."""
+
+
+def check_shape(x):
+    """Static-graph shape check hook — shapes are always concrete here."""
+    return shape(x)
+
+
+def set_cuda_rng_state(state):
+    """Maps onto the single framework RNG stream (no separate CUDA
+    generator on a TPU build)."""
+    from .framework.random import set_rng_state
+    set_rng_state(state)
+
+
+def get_cuda_rng_state():
+    from .framework.random import get_rng_state
+    return get_rng_state()
+
+
+def _export_inplace(ns):
+    """Lift every Tensor `op_` in-place method to a module function
+    (reference exports them at top level)."""
+    made = []
+    for name in dir(Tensor):
+        if not name.endswith("_") or name.startswith("_"):
+            continue
+        if name in ns:
+            continue
+        meth = getattr(Tensor, name)
+        if not callable(meth):
+            continue
+
+        def fn(x, *args, _m=name, **kw):
+            return getattr(x, _m)(*args, **kw)
+        fn.__name__ = name
+        fn.__doc__ = f"In-place variant (Tensor.{name}); returns x."
+        ns[name] = fn
+        made.append(name)
+    return made

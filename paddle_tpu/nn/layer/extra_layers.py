@@ -22,8 +22,6 @@ from ...core.tensor import Tensor
 from ...ops.dispatch import apply_op
 from .layers import Layer
 
-_builtins_list = list
-
 from ..functional.extra import (ctc_loss, feature_alpha_dropout,
                                 max_unpool1d, max_unpool2d, max_unpool3d,
                                 rnnt_loss)
@@ -138,42 +136,19 @@ class ParameterDict(Layer):
             self.add_parameter(str(k), v)
 
 
-class _ZeroPadNd(Layer):
-    _nd = 1
-
-    def __init__(self, padding, data_format=None, name=None):
-        super().__init__()
-        nd = self._nd
-        if isinstance(padding, int):
-            padding = [padding] * (2 * nd)
-        self._padding = [int(p) for p in padding]
-        self._channels_last = bool(data_format) and data_format.endswith("C")
-
-    def forward(self, x):
-        pads = self._padding
-        nd = self._nd
-        channels_last = self._channels_last
-
-        def _f(a):
-            dims = [(pads[2 * d], pads[2 * d + 1]) for d in range(nd)]
-            if channels_last:
-                # NLC / NDHWC: spatial axes are 1..nd
-                cfg = ([(0, 0)] + _builtins_list(reversed(dims))
-                       + [(0, 0)] * (a.ndim - nd - 1))
-            else:
-                cfg = ([(0, 0)] * (a.ndim - nd)
-                       + _builtins_list(reversed(dims)))
-            return jnp.pad(a, cfg)
-
-        return apply_op("zero_pad", _f, x)
+from .common import _PadNd
 
 
-class ZeroPad1D(_ZeroPadNd):
-    _nd = 1
+class ZeroPad1D(_PadNd):
+    """2-line subclass over F.pad, like the existing ZeroPad2D."""
+
+    def __init__(self, padding, data_format="NCL", name=None):
+        super().__init__(padding, "constant", 0.0, data_format)
 
 
-class ZeroPad3D(_ZeroPadNd):
-    _nd = 3
+class ZeroPad3D(_PadNd):
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__(padding, "constant", 0.0, data_format)
 
 
 class HSigmoidLoss(Layer):
@@ -190,10 +165,11 @@ class HSigmoidLoss(Layer):
         self.num_classes = num_classes
         self.depth = max(1, math.ceil(math.log2(max(num_classes, 2))))
         n_nodes = num_classes - 1  # internal nodes of the complete tree
-        self.weight = self.create_parameter((max(n_nodes, 1), feature_size))
+        self.weight = self.create_parameter(
+            (max(n_nodes, 1), feature_size), attr=weight_attr)
         self.add_parameter("weight", self.weight)
         self.bias = None if bias_attr is False else self.create_parameter(
-            (max(n_nodes, 1),), is_bias=True)
+            (max(n_nodes, 1),), attr=bias_attr, is_bias=True)
         if self.bias is not None:
             self.add_parameter("bias", self.bias)
         # precompute (node index, direction) paths per class: the classes
@@ -291,9 +267,12 @@ class AdaptiveLogSoftmaxWithLoss(Layer):
                 head_lp, jnp.clip(lab, 0, self.cutoffs[0] - 1)[:, None],
                 axis=1)[:, 0]
             out = jnp.where(short, gathered, out)
+            concrete = not isinstance(lab, jax.core.Tracer)
             for i in range(self.n_clusters):
                 lo, hi = self.cutoffs[i], self.cutoffs[i + 1]
                 in_c = (lab >= lo) & (lab < hi)
+                if concrete and not bool(jnp.any(in_c)):
+                    continue   # lazy: skip untouched clusters in eager
                 p1, p2 = tails[2 * i], tails[2 * i + 1]
                 tail_lp = jax.nn.log_softmax((x @ p1) @ p2, axis=-1)
                 rel = jnp.clip(lab - lo, 0, hi - lo - 1)
@@ -367,9 +346,15 @@ class _FractionalMaxPoolNd(Layer):
                 us = [float(self._u)] * nd
             else:
                 key = rng_key()
-                us = [float(v) for v in np.asarray(
-                    jax.random.uniform(key, (nd,), minval=0.0,
-                                       maxval=1.0))]
+                try:
+                    us = [float(v) for v in np.asarray(
+                        jax.random.uniform(key, (nd,), minval=0.0,
+                                           maxval=1.0))]
+                except jax.errors.TracerArrayConversionError:
+                    raise ValueError(
+                        "FractionalMaxPool under jit/to_static needs an "
+                        "explicit random_u (region boundaries are host-"
+                        "computed)") from None
             bounds_per_dim = []
             for d, (size, out, u) in enumerate(zip(spatial, outs, us)):
                 alpha = size / out
@@ -468,7 +453,10 @@ def dynamic_decode(decoder, inits=None, max_step_num=32, batch_size=1,
     beam = decoder.beam_size
     all_ids, all_scores = [], []
     for _b in range(batch_size):
-        states = inits[_b] if isinstance(inits, (list, tuple)) else inits
+        # inits is the cell's initial state, passed verbatim (tuple states
+        # like LSTM (h, c) included); per-batch variation belongs in the
+        # cell's own state handling
+        states = inits
         first = Tensor(jnp.asarray([[decoder.start_token]], jnp.int64))
         logits, states = decoder._logits(first, states)
         lp = jax.nn.log_softmax(

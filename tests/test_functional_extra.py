@@ -187,7 +187,8 @@ def test_hsigmoid_learns_to_separate():
         loss = hs(x, lab).mean()
         loss.backward(); opt.step(); opt.clear_grad()
         v = float(np.asarray(loss._data))
-        first = first or v
+        if first is None:
+            first = v
         last = v
     assert last < first * 0.6
 
