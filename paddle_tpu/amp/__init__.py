@@ -15,3 +15,15 @@ from . import amp_lists  # noqa: F401
 __all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler", "AmpScaler"]
 
 from . import debugging  # noqa: F401
+
+
+def is_float16_supported(device=None):
+    """float16 compute support probe (parity: paddle.amp). TPUs compute
+    in bfloat16; fp16 works via XLA but without MXU benefit."""
+    import jax
+    return jax.default_backend() != "tpu"
+
+
+def is_bfloat16_supported(device=None):
+    """bfloat16 is the native TPU matmul dtype; CPU supports it too."""
+    return True

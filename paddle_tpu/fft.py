@@ -101,3 +101,53 @@ def fftshift(x, axes=None, name=None):
 
 def ifftshift(x, axes=None, name=None):
     return apply_op("ifftshift", lambda a: jnp.fft.ifftshift(a, axes), x)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """Hermitian-input 2-D FFT (parity: paddle.fft.hfft2)."""
+    return hfftn(x, s, axes, norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ihfftn(x, s, axes, norm)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    """N-d Hermitian FFT: ifftn over the leading axes + hfft on the last
+    (matches numpy/reference semantics)."""
+    def _f(a):
+        if axes is not None:
+            ax = tuple(axes)
+        elif s is not None:
+            ax = tuple(range(-len(s), 0))   # last len(s) axes
+        else:
+            ax = tuple(range(-a.ndim, 0))
+        last = ax[-1]
+        lead = ax[:-1]
+        n_last = None if s is None else s[-1]
+        if lead:
+            lead_s = None if s is None else s[:-1]
+            a = jnp.fft.ifftn(a, s=lead_s, axes=lead,
+                              norm=_norm(norm))
+        return jnp.fft.hfft(a, n=n_last, axis=last, norm=_norm(norm))
+    return apply_op("hfftn", _f, x)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    def _f(a):
+        if axes is not None:
+            ax = tuple(axes)
+        elif s is not None:
+            ax = tuple(range(-len(s), 0))   # last len(s) axes
+        else:
+            ax = tuple(range(-a.ndim, 0))
+        last = ax[-1]
+        lead = ax[:-1]
+        n_last = None if s is None else s[-1]
+        out = jnp.fft.ihfft(a, n=n_last, axis=last, norm=_norm(norm))
+        if lead:
+            lead_s = None if s is None else s[:-1]
+            out = jnp.fft.fftn(out, s=lead_s, axes=lead,
+                               norm=_norm(norm))
+        return out
+    return apply_op("ihfftn", _f, x)

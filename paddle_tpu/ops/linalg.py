@@ -19,7 +19,7 @@ __all__ = [
     "matrix_power", "pinv", "solve", "triangular_solve", "lstsq", "lu",
     "lu_unpack", "matrix_rank", "cond", "histogram", "histogramdd",
     "bincount", "einsum", "multi_dot", "corrcoef", "cov", "householder_product",
-    "matrix_transpose", "pdist", "cdist", "svd_lowrank", "pca_lowrank",
+    "matrix_transpose", "pdist", "cdist", "svd_lowrank", "pca_lowrank", "cholesky_inverse", "matrix_exp", "ormqr", "fp8_fp8_half_gemm_fused",
 ]
 
 
@@ -367,3 +367,72 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
         return a
     centered = apply_op("pca_center", _f, x)
     return svd_lowrank(centered, q=qq, niter=niter)
+
+
+@def_op("cholesky_inverse")
+def cholesky_inverse(x, upper=False, name=None):
+    """Inverse of A from its Cholesky factor (parity:
+    paddle.linalg.cholesky_inverse)."""
+    L = jnp.swapaxes(x, -1, -2) if upper else x
+    eye = jnp.eye(x.shape[-1], dtype=x.dtype)
+    inv_l = jax.scipy.linalg.solve_triangular(L, eye, lower=True)
+    return jnp.swapaxes(inv_l, -1, -2) @ inv_l
+
+
+@def_op("matrix_exp")
+def matrix_exp(x, name=None):
+    """Matrix exponential (parity: paddle.linalg.matrix_exp; scaling-and-
+    squaring via jax.scipy.linalg.expm)."""
+    return jax.scipy.linalg.expm(x)
+
+
+@def_op("ormqr")
+def ormqr(x, tau, y, left=True, transpose=False, name=None):
+    """Multiply y by the FULL Q of a geqrf-style (householder)
+    factorization (parity: paddle.linalg.ormqr). The m x n factor is
+    zero-padded square so householder_product materializes all of Q —
+    one extra MXU matmul vs LAPACK's implicit application."""
+    m = x.shape[-2]
+    k = tau.shape[-1]
+    pad_cols = m - x.shape[-1]
+    if pad_cols > 0:
+        x = jnp.concatenate(
+            [x, jnp.zeros(x.shape[:-1] + (pad_cols,), x.dtype)], axis=-1)
+    if m - k > 0:
+        tau = jnp.concatenate(
+            [tau, jnp.zeros(tau.shape[:-1] + (m - k,), tau.dtype)],
+            axis=-1)
+    q = jax.lax.linalg.householder_product(x, tau)
+    qm = jnp.swapaxes(q, -1, -2) if transpose else q
+    return qm @ y if left else y @ qm
+
+
+def fp8_fp8_half_gemm_fused(x, y, bias=None, transpose_x=False,
+                            transpose_y=False, output_dtype="float16",
+                            scale=1.0, act="identity", name=None):
+    """fp8 x fp8 -> half GEMM (parity: paddle.linalg.fp8_fp8_half_gemm_fused,
+    `phi/kernels/fusion/gpu/fp8_gemm`): on TPU the fp8 operands are
+    MXU-multiplied with a half-precision accumulate-and-store — XLA fuses
+    the scale/bias/activation epilogue like cublasLt does."""
+    from ..core.dtype import convert_dtype
+    out_dt = convert_dtype(output_dtype)
+
+    def _f(a, b, *mb):
+        bb = mb[0] if bias is not None else None
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        out = jax.lax.dot_general(
+            a, b, (((a.ndim - 1,), (b.ndim - 2,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if bb is not None:
+            out = out + bb.astype(out.dtype)
+        if act == "gelu":
+            out = jax.nn.gelu(out)
+        elif act == "relu":
+            out = jax.nn.relu(out)
+        return out.astype(out_dt)
+
+    args = [x, y] + ([bias] if bias is not None else [])
+    return apply_op("fp8_fp8_half_gemm_fused", _f, *args)

@@ -33,6 +33,7 @@ __all__ = [
     "asinh", "atanh", "tanh", "square", "sqrt", "log1p", "abs", "pow",
     "neg", "expm1", "rad2deg", "deg2rad", "isnan", "cast", "coalesce",
     "transpose", "reshape", "sum", "slice", "to_dense", "to_sparse_coo",
+    "pca_lowrank",
     "nn",
 ]
 
@@ -488,6 +489,15 @@ def divide(x, y, name=None):
     vals = xd[pos] / yd[pos]
     res = SparseCooTensor(jsparse.BCOO((vals, idx), shape=xc._bcoo.shape))
     return res.to_sparse_csr() if was_csr else res
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized PCA of a sparse matrix (parity: paddle.sparse exports
+    pca_lowrank too; computed through the dense path at test scale)."""
+    from ..ops.linalg import pca_lowrank as _dense
+    dense = x.to_dense() if isinstance(x, (SparseCooTensor,
+                                           SparseCsrTensor)) else x
+    return _dense(dense, q=q, center=center, niter=niter)
 
 
 from . import nn  # noqa: E402,F401  (layers/functional subpackage)

@@ -6,3 +6,34 @@ from . import transforms  # noqa: F401
 from . import datasets  # noqa: F401
 from . import models  # noqa: F401
 from . import ops  # noqa: F401
+
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend):
+    """Parity: paddle.vision.set_image_backend ('pil' or 'cv2'; this
+    build ships PIL)."""
+    global _image_backend
+    if backend not in ("pil", "cv2"):
+        raise ValueError(f"unknown image backend {backend!r}")
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image file (parity: paddle.vision.image_load): the 'pil'
+    backend returns a PIL Image, 'cv2' an HWC BGR ndarray (decoded via
+    PIL here — OpenCV isn't shipped, but the return-type contract
+    holds)."""
+    import numpy as np
+    from PIL import Image
+    b = backend or _image_backend
+    img = Image.open(path)
+    if b == "cv2":
+        arr = np.asarray(img.convert("RGB"))
+        return arr[:, :, ::-1].copy()   # BGR like cv2.imread
+    return img

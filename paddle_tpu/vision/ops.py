@@ -13,7 +13,11 @@ from ..core.tensor import Tensor
 from ..ops.dispatch import apply_op
 from ..nn.layer.layers import Layer as _Layer
 
-__all__ = ["nms", "roi_align", "box_area", "box_iou", "psroi_pool", "roi_pool", "deform_conv2d", "DeformConv2D"]
+__all__ = ["nms", "roi_align", "box_area", "box_iou", "psroi_pool",
+           "roi_pool", "deform_conv2d", "DeformConv2D", "box_coder",
+           "prior_box", "yolo_box", "yolo_loss", "yolov3_loss",
+           "matrix_nms", "distribute_fpn_proposals", "generate_proposals",
+           "RoIPool", "RoIAlign", "PSRoIPool", "read_file", "decode_jpeg"]
 
 
 def box_area(boxes):
@@ -241,3 +245,506 @@ class DeformConv2D(_Layer):
     def forward(self, x, offset, mask=None):
         return deform_conv2d(x, offset, self.weight, self.bias,
                              mask=mask, **self._cfg)
+
+
+# ------------------------------------------------------------- detection
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """Encode/decode boxes against priors (parity: vision/ops.py box_coder
+    over phi box_coder kernel). Boxes are (x1, y1, x2, y2)."""
+    def _f(pb, tb, *maybe_var):
+        var = maybe_var[0] if maybe_var else None
+        off = 0.0 if box_normalized else 1.0
+        pw = pb[:, 2] - pb[:, 0] + off
+        ph = pb[:, 3] - pb[:, 1] + off
+        pcx = pb[:, 0] + pw * 0.5
+        pcy = pb[:, 1] + ph * 0.5
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + off
+            th = tb[:, 3] - tb[:, 1] + off
+            tcx = tb[:, 0] + tw * 0.5
+            tcy = tb[:, 1] + th * 0.5
+            out = jnp.stack([(tcx[:, None] - pcx[None, :]) / pw[None, :],
+                             (tcy[:, None] - pcy[None, :]) / ph[None, :],
+                             jnp.log(tw[:, None] / pw[None, :]),
+                             jnp.log(th[:, None] / ph[None, :])], axis=-1)
+            if var is not None:
+                out = out / var[None, :, :]
+            return out
+        # decode_center_size: tb (N, M, 4) deltas against the priors
+        d = tb
+        if var is not None:
+            if var.ndim == 2:
+                # broadcast along the prior axis (phi box_coder_kernel.cc
+                # prior_var_offset switches on axis)
+                d = d * (var[None, :, :] if axis == 0 else var[:, None, :])
+            else:
+                d = d * var
+        if axis == 0:
+            pcx_, pcy_, pw_, ph_ = (v[None, :] for v in (pcx, pcy, pw, ph))
+        else:
+            pcx_, pcy_, pw_, ph_ = (v[:, None] for v in (pcx, pcy, pw, ph))
+        cx = d[..., 0] * pw_ + pcx_
+        cy = d[..., 1] * ph_ + pcy_
+        w = jnp.exp(d[..., 2]) * pw_
+        h = jnp.exp(d[..., 3]) * ph_
+        return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                          cx + w * 0.5 - off, cy + h * 0.5 - off], axis=-1)
+
+    args = [prior_box, target_box]
+    if prior_box_var is not None and not isinstance(prior_box_var,
+                                                    (list, tuple)):
+        args.append(prior_box_var)
+    elif isinstance(prior_box_var, (list, tuple)):
+        args.append(Tensor(jnp.broadcast_to(
+            jnp.asarray(prior_box_var, jnp.float32),
+            (prior_box.shape[0], 4))))
+    return apply_op("box_coder", _f, *args)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior (anchor) boxes per feature-map cell (parity:
+    vision/ops.py prior_box)."""
+    fh, fw = int(input.shape[2]), int(input.shape[3])
+    ih, iw = int(image.shape[2]), int(image.shape[3])
+    step_h = steps[1] or ih / fh
+    step_w = steps[0] or iw / fw
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - a) > 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    whs = []
+    for ms in min_sizes:
+        ratio_boxes = [(ms * np.sqrt(ar), ms / np.sqrt(ar))
+                       for ar in ars if abs(ar - 1.0) > 1e-6]
+        max_box = None
+        if max_sizes:
+            mx = max_sizes[min_sizes.index(ms)]
+            max_box = (np.sqrt(ms * mx), np.sqrt(ms * mx))
+        if min_max_aspect_ratios_order:
+            # min, max, then ratio boxes (phi prior_box_kernel.cc:107)
+            whs.append((ms, ms))
+            if max_box:
+                whs.append(max_box)
+            whs += ratio_boxes
+        else:
+            # default: min, ratio boxes, max LAST
+            whs.append((ms, ms))
+            whs += ratio_boxes
+            if max_box:
+                whs.append(max_box)
+    whs = np.asarray(whs, np.float32)
+    cx = (np.arange(fw) + offset) * step_w
+    cy = (np.arange(fh) + offset) * step_h
+    gx, gy = np.meshgrid(cx, cy)
+    centers = np.stack([gx, gy], -1).reshape(fh, fw, 1, 2)
+    half = whs[None, None] / 2
+    boxes = np.concatenate([
+        (centers - half) / np.array([iw, ih], np.float32),
+        (centers + half) / np.array([iw, ih], np.float32)], axis=-1)
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    boxes = boxes.astype(np.float32)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          boxes.shape).copy()
+    return Tensor(jnp.asarray(boxes)), Tensor(jnp.asarray(var))
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """Decode YOLOv3 head predictions into boxes + scores (parity:
+    vision/ops.py yolo_box over phi yolo_box kernel)."""
+    def _f(a, imgs):
+        B, C, H, W = a.shape
+        na = len(anchors) // 2
+        an = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+        iou_pred = None
+        if iou_aware:
+            # layout: na IoU channels first, then the na*(5+cls) head
+            iou_pred = jax.nn.sigmoid(a[:, :na].reshape(B, na, H, W))
+            a = a[:, na:]
+        a = a.reshape(B, na, -1, H, W)
+        sxy = float(scale_x_y)
+        bias = -0.5 * (sxy - 1.0)
+        gx = jnp.arange(W, dtype=jnp.float32)
+        gy = jnp.arange(H, dtype=jnp.float32)
+        cx = (jax.nn.sigmoid(a[:, :, 0]) * sxy + bias
+              + gx[None, None, None, :]) / W
+        cy = (jax.nn.sigmoid(a[:, :, 1]) * sxy + bias
+              + gy[None, None, :, None]) / H
+        tw = jnp.exp(jnp.clip(a[:, :, 2], -10, 10)) \
+            * an[None, :, 0, None, None]
+        th = jnp.exp(jnp.clip(a[:, :, 3], -10, 10)) \
+            * an[None, :, 1, None, None]
+        w = tw / (W * downsample_ratio)
+        h = th / (H * downsample_ratio)
+        obj = jax.nn.sigmoid(a[:, :, 4])
+        if iou_pred is not None:
+            f = float(iou_aware_factor)
+            obj = obj ** (1.0 - f) * iou_pred ** f
+        cls = jax.nn.sigmoid(a[:, :, 5:5 + class_num])
+        imgh = imgs[:, 0].astype(jnp.float32)[:, None]
+        imgw = imgs[:, 1].astype(jnp.float32)[:, None]
+        x1 = (cx - w / 2).reshape(B, -1) * imgw
+        y1 = (cy - h / 2).reshape(B, -1) * imgh
+        x2 = (cx + w / 2).reshape(B, -1) * imgw
+        y2 = (cy + h / 2).reshape(B, -1) * imgh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imgw - 1)
+            y1 = jnp.clip(y1, 0, imgh - 1)
+            x2 = jnp.clip(x2, 0, imgw - 1)
+            y2 = jnp.clip(y2, 0, imgh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], -1)
+        scores = (obj[..., None] * jnp.moveaxis(cls, 2, -1)) \
+            .reshape(B, -1, class_num)
+        mask = (obj.reshape(B, -1) >= conf_thresh).astype(boxes.dtype)
+        return boxes * mask[..., None], scores * mask[..., None]
+
+    return apply_op("yolo_box", _f, x, img_size)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, scale_x_y=1.0, name=None):
+    """YOLOv3 training loss (parity: vision/ops.py yolo_loss): anchor
+    assignment by max-IoU at the gt center cell, BCE on xy/obj/cls and
+    L1-ish on wh, objectness ignore above IoU threshold."""
+    def _f(a, gtb, gtl, *maybe_s):
+        B, C, H, W = a.shape
+        na = len(anchor_mask)
+        an_all = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
+        an = an_all[jnp.asarray(anchor_mask)]
+        a = a.reshape(B, na, 5 + class_num, H, W)
+        stride = downsample_ratio
+        # gt in [0,1] xywh-center form (paddle convention)
+        gx, gy = gtb[..., 0], gtb[..., 1]
+        gw, gh = jnp.maximum(gtb[..., 2], 1e-9), jnp.maximum(
+            gtb[..., 3], 1e-9)
+        valid = (gw > 1e-8)
+        ci = jnp.clip((gx * W).astype(jnp.int32), 0, W - 1)
+        cj = jnp.clip((gy * H).astype(jnp.int32), 0, H - 1)
+        # best anchor per gt by wh IoU (anchor units: pixels)
+        gwp = gw * W * stride
+        ghp = gh * H * stride
+        inter = (jnp.minimum(gwp[..., None], an_all[None, None, :, 0])
+                 * jnp.minimum(ghp[..., None], an_all[None, None, :, 1]))
+        union = (gwp * ghp)[..., None] + an_all[None, None, :, 0] \
+            * an_all[None, None, :, 1] - inter
+        best = jnp.argmax(inter / union, axis=-1)     # (B, G) global idx
+        mask_ids = jnp.asarray(anchor_mask)
+        loss = jnp.zeros((B,), jnp.float32)
+        eps = 1e-7
+        lab_pos = 1.0 - 1.0 / class_num if use_label_smooth else 1.0
+        lab_neg = 1.0 / class_num if use_label_smooth else 0.0
+        gts = maybe_s[0] if maybe_s else jnp.ones(gtb.shape[:2])
+        obj_target = jnp.zeros((B, na, H, W))
+        # objectness ignore mask: decoded predictions whose best IoU with
+        # any gt exceeds ignore_thresh drop out of the negative loss
+        gx_grid = (jax.nn.sigmoid(a[:, :, 0])
+                   + jnp.arange(W, dtype=jnp.float32)[None, None, None, :]) / W
+        gy_grid = (jax.nn.sigmoid(a[:, :, 1])
+                   + jnp.arange(H, dtype=jnp.float32)[None, None, :, None]) / H
+        pw_grid = jnp.exp(jnp.clip(a[:, :, 2], -10, 10)) \
+            * an[None, :, 0, None, None] / (W * stride)
+        ph_grid = jnp.exp(jnp.clip(a[:, :, 3], -10, 10)) \
+            * an[None, :, 1, None, None] / (H * stride)
+        px1 = gx_grid - pw_grid / 2
+        py1 = gy_grid - ph_grid / 2
+        px2 = gx_grid + pw_grid / 2
+        py2 = gy_grid + ph_grid / 2
+        best_iou = jnp.zeros((B, na, H, W))
+        for g in range(gtb.shape[1]):
+            bx1 = (gx[:, g] - gw[:, g] / 2)[:, None, None, None]
+            by1 = (gy[:, g] - gh[:, g] / 2)[:, None, None, None]
+            bx2 = (gx[:, g] + gw[:, g] / 2)[:, None, None, None]
+            by2 = (gy[:, g] + gh[:, g] / 2)[:, None, None, None]
+            iw_ = jnp.maximum(jnp.minimum(px2, bx2)
+                              - jnp.maximum(px1, bx1), 0)
+            ih_ = jnp.maximum(jnp.minimum(py2, by2)
+                              - jnp.maximum(py1, by1), 0)
+            inter_ = iw_ * ih_
+            uni = (pw_grid * ph_grid
+                   + (gw[:, g] * gh[:, g])[:, None, None, None] - inter_)
+            iou_g = jnp.where(valid[:, g][:, None, None, None],
+                              inter_ / jnp.maximum(uni, 1e-9), 0.0)
+            best_iou = jnp.maximum(best_iou, iou_g)
+        ignore = best_iou > ignore_thresh
+        for g in range(gtb.shape[1]):
+            for local_a in range(na):
+                sel = valid[:, g] & (best[:, g] == mask_ids[local_a])
+                px = jax.nn.sigmoid(
+                    a[jnp.arange(B), local_a, 0, cj[:, g], ci[:, g]])
+                py = jax.nn.sigmoid(
+                    a[jnp.arange(B), local_a, 1, cj[:, g], ci[:, g]])
+                pw = a[jnp.arange(B), local_a, 2, cj[:, g], ci[:, g]]
+                ph = a[jnp.arange(B), local_a, 3, cj[:, g], ci[:, g]]
+                tx = gx[:, g] * W - ci[:, g]
+                ty = gy[:, g] * H - cj[:, g]
+                tw = jnp.log(gwp[:, g] / an[local_a, 0])
+                th = jnp.log(ghp[:, g] / an[local_a, 1])
+                scale = 2.0 - gw[:, g] * gh[:, g]
+                l_xy = (-(tx * jnp.log(px + eps)
+                          + (1 - tx) * jnp.log(1 - px + eps))
+                        - (ty * jnp.log(py + eps)
+                           + (1 - ty) * jnp.log(1 - py + eps))) * scale
+                l_wh = (jnp.abs(pw - tw) + jnp.abs(ph - th)) * scale
+                pc = jax.nn.sigmoid(
+                    a[jnp.arange(B), local_a, 5:, cj[:, g], ci[:, g]])
+                onehot = jax.nn.one_hot(gtl[:, g], class_num)
+                tcls = onehot * lab_pos + (1 - onehot) * lab_neg
+                l_cls = -(tcls * jnp.log(pc + eps)
+                          + (1 - tcls) * jnp.log(1 - pc + eps)).sum(-1)
+                loss = loss + jnp.where(sel, (l_xy + l_wh + l_cls)
+                                        * gts[:, g], 0.0)
+                obj_target = obj_target.at[
+                    jnp.arange(B), local_a, cj[:, g], ci[:, g]].max(
+                    jnp.where(sel, 1.0, 0.0))
+        pobj = jax.nn.sigmoid(a[:, :, 4])
+        neg_w = jnp.where(ignore & (obj_target == 0), 0.0, 1.0)
+        l_obj = -(obj_target * jnp.log(pobj + eps)
+                  + (1 - obj_target) * neg_w * jnp.log(1 - pobj + eps))
+        loss = loss + l_obj.sum((1, 2, 3))
+        return loss
+
+    args = [x, gt_box, gt_label]
+    if gt_score is not None:
+        args.append(gt_score)
+    return apply_op("yolo_loss", _f, *args)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (SOLOv2; parity: vision/ops.py matrix_nms): decay each
+    box's score by its max-IoU overlap with higher-scored boxes of the
+    same class — no hard suppression loop. Eager-only (data-dependent
+    output count)."""
+    bb = np.asarray(bboxes._data if isinstance(bboxes, Tensor) else bboxes)
+    sc = np.asarray(scores._data if isinstance(scores, Tensor) else scores)
+    B, C, M = sc.shape
+    off = 0.0 if normalized else 1.0
+    outs, idxs, nums = [], [], []
+    for b in range(B):
+        dets = []
+        for c in range(C):
+            if c == background_label:
+                continue
+            keep = np.where(sc[b, c] > score_threshold)[0]
+            if keep.size == 0:
+                continue
+            order = keep[np.argsort(-sc[b, c, keep])][:nms_top_k]
+            boxes = bb[b, order]
+            s = sc[b, c, order]
+            x1, y1, x2, y2 = boxes.T
+            area = (x2 - x1 + off) * (y2 - y1 + off)
+            ix1 = np.maximum(x1[:, None], x1[None, :])
+            iy1 = np.maximum(y1[:, None], y1[None, :])
+            ix2 = np.minimum(x2[:, None], x2[None, :])
+            iy2 = np.minimum(y2[:, None], y2[None, :])
+            iw = np.maximum(ix2 - ix1 + off, 0)
+            ih = np.maximum(iy2 - iy1 + off, 0)
+            iou = iw * ih / (area[:, None] + area[None, :] - iw * ih)
+            iou = np.triu(iou, 1)                     # j > i: i higher
+            # comp_i = max IoU of box i with boxes scored higher than i
+            comp = iou.max(0)
+            if use_gaussian:
+                decay = np.exp(-(iou ** 2 - comp[:, None] ** 2)
+                               / gaussian_sigma).min(0)
+            else:
+                decay = ((1 - iou) / np.maximum(1 - comp[:, None],
+                                                1e-9)).min(0)
+            new_s = s * decay
+            for i, o in enumerate(order):
+                if new_s[i] > post_threshold:
+                    dets.append((float(new_s[i]), c, b, o))
+        dets.sort(key=lambda d: -d[0])
+        dets = dets[:keep_top_k]
+        out = np.array([[c, s2, *bb[b, o]] for s2, c, _, o in dets],
+                       np.float32).reshape(-1, 6)
+        outs.append(out)
+        idxs.extend(b * M + o for _, _, _, o in dets)  # flattened index
+        nums.append(len(dets))
+    out = Tensor(jnp.asarray(np.concatenate(outs, 0)
+                             if outs else np.zeros((0, 6), np.float32)))
+    res = [out]
+    if return_index:
+        res.append(Tensor(jnp.asarray(np.asarray(idxs, np.int64))))
+    if return_rois_num:
+        res.append(Tensor(jnp.asarray(np.asarray(nums, np.int32))))
+    return tuple(res) if len(res) > 1 else out
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Assign RoIs to FPN levels by scale (parity: vision/ops.py
+    distribute_fpn_proposals). Eager-only."""
+    rois = np.asarray(fpn_rois._data if isinstance(fpn_rois, Tensor)
+                      else fpn_rois)
+    off = 1.0 if pixel_offset else 0.0
+    w = rois[:, 2] - rois[:, 0] + off
+    h = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.maximum(w * h, 1e-12))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    if rois_num is not None:
+        rn = np.asarray(rois_num._data if isinstance(rois_num, Tensor)
+                        else rois_num).astype(np.int64)
+        img_of = np.repeat(np.arange(rn.shape[0]), rn)
+    else:
+        rn = np.asarray([rois.shape[0]], np.int64)
+        img_of = np.zeros(rois.shape[0], np.int64)
+    outs, index, nums = [], [], []
+    for l in range(min_level, max_level + 1):
+        sel = np.where(lvl == l)[0]
+        outs.append(Tensor(jnp.asarray(rois[sel])))
+        index.extend(sel.tolist())
+        # per-image roi count at this level (reference rois_num_per_level)
+        per_img = np.asarray([(img_of[sel] == b).sum()
+                              for b in range(rn.shape[0])], np.int32)
+        nums.append(Tensor(jnp.asarray(per_img)))
+    restore = np.empty(len(index), np.int32)
+    restore[np.asarray(index, np.int64)] = np.arange(len(index))
+    return outs, Tensor(jnp.asarray(restore.reshape(-1, 1))), nums
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """RPN proposal generation: decode deltas vs anchors, top-k, clip,
+    filter small, NMS (parity: vision/ops.py generate_proposals).
+    Eager-only."""
+    sc = np.asarray(scores._data if isinstance(scores, Tensor) else scores)
+    bd = np.asarray(bbox_deltas._data if isinstance(bbox_deltas, Tensor)
+                    else bbox_deltas)
+    an = np.asarray(anchors._data if isinstance(anchors, Tensor)
+                    else anchors).reshape(-1, 4)
+    var = np.asarray(variances._data if isinstance(variances, Tensor)
+                     else variances).reshape(-1, 4)
+    ims = np.asarray(img_size._data if isinstance(img_size, Tensor)
+                     else img_size)
+    B = sc.shape[0]
+    off = 1.0 if pixel_offset else 0.0
+    all_rois, all_probs, nums = [], [], []
+    for b in range(B):
+        s = sc[b].transpose(1, 2, 0).reshape(-1)
+        d = bd[b].transpose(1, 2, 0).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, a_, v_ = s[order], d[order], an[order % an.shape[0]] \
+            if an.shape[0] != s.shape[0] else an[order], var[order % var.shape[0]] \
+            if var.shape[0] != s.shape[0] else var[order]
+        aw = a_[:, 2] - a_[:, 0] + off
+        ah = a_[:, 3] - a_[:, 1] + off
+        acx = a_[:, 0] + aw / 2
+        acy = a_[:, 1] + ah / 2
+        cx = v_[:, 0] * d[:, 0] * aw + acx
+        cy = v_[:, 1] * d[:, 1] * ah + acy
+        w = np.exp(np.clip(v_[:, 2] * d[:, 2], -10, 10)) * aw
+        h = np.exp(np.clip(v_[:, 3] * d[:, 3], -10, 10)) * ah
+        boxes = np.stack([cx - w / 2, cy - h / 2,
+                          cx + w / 2 - off, cy + h / 2 - off], -1)
+        ih, iw = ims[b, 0], ims[b, 1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, iw - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih - off)
+        keep = np.where((boxes[:, 2] - boxes[:, 0] + off >= min_size)
+                        & (boxes[:, 3] - boxes[:, 1] + off >= min_size))[0]
+        boxes, s = boxes[keep], s[keep]
+        # plain NMS
+        sel = []
+        idx = np.argsort(-s)
+        while idx.size and len(sel) < post_nms_top_n:
+            i = idx[0]
+            sel.append(i)
+            if idx.size == 1:
+                break
+            xx1 = np.maximum(boxes[i, 0], boxes[idx[1:], 0])
+            yy1 = np.maximum(boxes[i, 1], boxes[idx[1:], 1])
+            xx2 = np.minimum(boxes[i, 2], boxes[idx[1:], 2])
+            yy2 = np.minimum(boxes[i, 3], boxes[idx[1:], 3])
+            iw_ = np.maximum(xx2 - xx1 + off, 0)
+            ih_ = np.maximum(yy2 - yy1 + off, 0)
+            ai = (boxes[i, 2] - boxes[i, 0] + off) \
+                * (boxes[i, 3] - boxes[i, 1] + off)
+            aj = (boxes[idx[1:], 2] - boxes[idx[1:], 0] + off) \
+                * (boxes[idx[1:], 3] - boxes[idx[1:], 1] + off)
+            iou = iw_ * ih_ / (ai + aj - iw_ * ih_)
+            idx = idx[1:][iou <= nms_thresh]
+        all_rois.append(boxes[sel])
+        all_probs.append(s[sel])
+        nums.append(len(sel))
+    rois = Tensor(jnp.asarray(np.concatenate(all_rois, 0).astype(
+        np.float32)))
+    probs = Tensor(jnp.asarray(np.concatenate(all_probs, 0).astype(
+        np.float32)[:, None]))
+    if return_rois_num:
+        return rois, probs, Tensor(jnp.asarray(np.asarray(nums, np.int32)))
+    return rois, probs
+
+
+yolov3_loss = yolo_loss
+
+
+class RoIPool(_Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._cfg = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._cfg[0], self._cfg[1])
+
+
+class RoIAlign(_Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._cfg = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self._cfg[0], self._cfg[1],
+                         aligned=aligned)
+
+
+class PSRoIPool(_Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._cfg = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._cfg[0], self._cfg[1])
+
+
+def read_file(path, name=None):
+    """Raw file bytes as a uint8 tensor (parity: vision/ops.py
+    read_file)."""
+    with open(path, "rb") as f:
+        data = np.frombuffer(f.read(), np.uint8)
+    return Tensor(jnp.asarray(data))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to (C, H, W) uint8 (parity:
+    vision/ops.py decode_jpeg; host-side via PIL)."""
+    import io
+    from PIL import Image
+    data = np.asarray(x._data if isinstance(x, Tensor) else x,
+                      np.uint8).tobytes()
+    img = Image.open(io.BytesIO(data))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode in ("rgb", "RGB"):
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))

@@ -54,3 +54,78 @@ def test_places_shape_misc():
     assert [len(b) for b in reader()] == [2, 2, 1]
     with paddle.LazyGuard():
         paddle.nn.Linear(2, 2)
+
+
+def _ref_all_bounded(path):
+    """Names inside the __all__ list literal only (no docstring noise)."""
+    src = open(path).read()
+    idx = src.index("__all__")
+    end = src.index("]", idx)
+    return re.findall(r"'([A-Za-z0-9_]+)'", src[idx:end])
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference not mounted")
+@pytest.mark.parametrize("rel,attr", [
+    ("optimizer/__init__.py", "optimizer"),
+    ("linalg.py", "linalg"),
+    ("vision/__init__.py", "vision"),
+    ("vision/ops.py", "vision.ops"),
+    ("distributed/__init__.py", "distributed"),
+    ("amp/__init__.py", "amp"),
+    ("io/__init__.py", "io"),
+    ("metric/__init__.py", "metric"),
+    ("sparse/__init__.py", "sparse"),
+])
+def test_subnamespace_exports_complete(rel, attr):
+    names = _ref_all_bounded(os.path.join(REF, rel))
+    mod = paddle
+    for part in attr.split("."):
+        mod = getattr(mod, part)
+    missing = [n for n in dict.fromkeys(names) if not hasattr(mod, n)]
+    assert not missing, f"{attr} missing: {missing}"
+
+
+def test_detection_ops_behave():
+    from paddle_tpu.vision import ops as V
+    rng2 = np.random.RandomState(1)
+    priors = np.array([[0, 0, 10, 10], [5, 5, 15, 15]], np.float32)
+    targets = np.array([[1, 1, 9, 9], [6, 4, 14, 16]], np.float32)
+    var = np.ones((2, 4), np.float32)
+    enc = V.box_coder(paddle.to_tensor(priors), paddle.to_tensor(var),
+                      paddle.to_tensor(targets))
+    dec = V.box_coder(paddle.to_tensor(priors), paddle.to_tensor(var),
+                      enc, code_type="decode_center_size")
+    np.testing.assert_allclose(
+        np.asarray(dec._data)[np.arange(2), np.arange(2)], targets,
+        rtol=1e-4, atol=1e-4)
+    x = paddle.to_tensor(rng2.randn(1, 21, 4, 4).astype(np.float32))
+    bx, sc = V.yolo_box(x, paddle.to_tensor(np.array([[64, 64]], np.int32)),
+                        anchors=[10, 13, 16, 30, 33, 23], class_num=2,
+                        conf_thresh=0.0, downsample_ratio=16)
+    assert list(bx.shape) == [1, 48, 4] and list(sc.shape) == [1, 48, 2]
+    # decoded boxes stay inside the clipped image frame
+    b = np.asarray(bx._data)
+    assert b.min() >= 0 and b.max() <= 63
+    rois = np.array([[0, 0, 16, 16], [0, 0, 500, 500]], np.float32)
+    outs, restore, nums = V.distribute_fpn_proposals(
+        paddle.to_tensor(rois), 2, 5, 4, 224)
+    sizes = [int(o.shape[0]) for o in outs]
+    # scale 16 -> level 2 (clipped), scale 500 -> floor(log2(500/224))+4 = 5
+    assert sum(sizes) == 2 and sizes[0] == 1 and sizes[-1] == 1
+
+
+def test_matrix_nms_suppresses_overlaps():
+    from paddle_tpu.vision import ops as V
+    bb = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                    [50, 50, 60, 60]]], np.float32)
+    sc = np.zeros((1, 2, 3), np.float32)
+    sc[0, 1] = [0.9, 0.85, 0.8]
+    out, nums = V.matrix_nms(paddle.to_tensor(bb), paddle.to_tensor(sc),
+                             score_threshold=0.1, post_threshold=0.0,
+                             nms_top_k=10, keep_top_k=10,
+                             background_label=0)
+    o = np.asarray(out._data)
+    assert int(np.asarray(nums._data)[0]) == 3
+    # the heavily-overlapping box's score decays far below its raw 0.85
+    decayed = sorted(o[:, 1])[0]
+    assert decayed < 0.2
