@@ -537,7 +537,7 @@ from .extra import (  # noqa: E402,F401
     TransformedDistribution, Transform, AbsTransform, AffineTransform,
     ChainTransform, ExpTransform, IndependentTransform, PowerTransform,
     ReshapeTransform, SigmoidTransform, SoftmaxTransform, StackTransform,
-    StickBreakingTransform, TanhTransform,
+    StickBreakingTransform, TanhTransform, LKJCholesky,
 )
 
 __all__ += [
@@ -547,5 +547,5 @@ __all__ += [
     "AffineTransform", "ChainTransform", "ExpTransform",
     "IndependentTransform", "PowerTransform", "ReshapeTransform",
     "SigmoidTransform", "SoftmaxTransform", "StackTransform",
-    "StickBreakingTransform", "TanhTransform",
+    "StickBreakingTransform", "TanhTransform", "LKJCholesky",
 ]

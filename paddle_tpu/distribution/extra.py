@@ -29,7 +29,7 @@ __all__ = [
     "AffineTransform", "ChainTransform", "ExpTransform",
     "IndependentTransform", "PowerTransform", "ReshapeTransform",
     "SigmoidTransform", "SoftmaxTransform", "StackTransform",
-    "StickBreakingTransform", "TanhTransform",
+    "StickBreakingTransform", "TanhTransform", "LKJCholesky",
 ]
 
 
@@ -744,3 +744,66 @@ class TransformedDistribution(Distribution):
         def _sub(a, b):
             return a - b
         return apply_op("td_log_prob", _sub, base_lp, ldj_t)
+
+
+class LKJCholesky(Distribution):
+    """Cholesky factors of LKJ-distributed correlation matrices (parity:
+    reference distribution/lkj_cholesky.py, onion construction)."""
+
+    def __init__(self, dim=2, concentration=1.0, sample_method="onion",
+                 name=None):
+        if dim < 2:
+            raise ValueError("dim must be >= 2")
+        self._conc_p = concentration if isinstance(concentration, Tensor) \
+            else None
+        self.dim = int(dim)
+        self.concentration = _arr(concentration)
+        self.sample_method = sample_method
+        super().__init__(tuple(self.concentration.shape),
+                         (self.dim, self.dim))
+
+    def sample(self, shape=()):
+        d = self.dim
+        conc = self.concentration
+        batch = tuple(shape) + tuple(conc.shape)
+        key = _key()
+        import jax as _jax
+        ks = _jax.random.split(key, 2 * d)   # distinct key per draw
+        # onion: row i (1-indexed) is a scaled point on the sphere
+        rows = [jnp.ones(batch + (1,))]
+        for i in range(1, d):
+            beta_conc1 = i / 2.0
+            beta_conc0 = conc + (d - 1 - i) / 2.0
+            y = _jax.random.beta(ks[2 * i], beta_conc1, beta_conc0, batch)
+            u = _jax.random.normal(ks[2 * i + 1], batch + (i,))
+            u = u / jnp.linalg.norm(u, axis=-1, keepdims=True)
+            w = jnp.sqrt(y)[..., None] * u
+            diag = jnp.sqrt(jnp.clip(1.0 - y, 1e-12, None))[..., None]
+            rows.append(jnp.concatenate([w, diag], axis=-1))
+        L = jnp.zeros(batch + (d, d))
+        for i, r in enumerate(rows):
+            L = L.at[..., i, :i + 1].set(r)
+        return Tensor(L)
+
+    def log_prob(self, value):
+        def _f(conc, L):
+            d = self.dim
+            diag = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]
+            orders = jnp.arange(2, d + 1, dtype=jnp.float32)
+            unnorm = ((2.0 * (conc[..., None] - 1.0) + d - orders)
+                      * jnp.log(diag)).sum(-1)
+            # normalization constant (Stan's lkj_corr_cholesky_log):
+            # sum_k [ k/2 log(pi) + log B(conc + (d-1-k)/2, ...) terms ]
+            lg = jax.scipy.special.gammaln
+            lognorm = jnp.zeros(conc.shape)
+            for k in range(1, d):
+                lognorm = lognorm + (
+                    0.5 * k * jnp.log(jnp.pi)
+                    + lg(conc + (d - 1 - k) / 2.0)
+                    - lg(conc + (d - 1) / 2.0))
+            return unnorm - lognorm
+        val = value._data if isinstance(value, Tensor) else _arr(value)
+        return apply_op("lkj_log_prob", _f,
+                        self._param(self._conc_p, self.concentration),
+                        Tensor(val) if not isinstance(value, Tensor)
+                        else value)

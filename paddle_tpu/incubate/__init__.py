@@ -12,3 +12,132 @@ from .nn.functional import softmax_mask_fuse_upper_triangle  # noqa: F401
 
 __all__ = ["nn", "asp", "optimizer", "LookAhead", "ModelAverage",
            "softmax_mask_fuse_upper_triangle"]
+
+
+# graph/segment surface (parity: incubate exports; the implementations
+# live in paddle.geometric, as in the reference where incubate re-exports)
+from ..geometric import (  # noqa: F401
+    segment_sum, segment_mean, segment_max, segment_min)
+from ..geometric import send_u_recv as graph_send_recv  # noqa: F401
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) in one taped op (parity: incubate
+    softmax_mask_fuse over fused_softmax_mask kernel)."""
+    from ..ops.dispatch import apply_op
+    import jax
+    return apply_op("softmax_mask_fuse",
+                    lambda a, m: jax.nn.softmax(a + m, axis=-1), x, mask)
+
+
+def identity_loss(x, reduction="none", name=None):
+    """Mark a tensor as a loss output (parity: incubate identity_loss —
+    an IPU-era marker; semantics here are the chosen reduction)."""
+    if reduction in ("none", 2):
+        return x
+    if reduction in ("sum", 0):
+        return x.sum()
+    return x.mean()
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """K-hop neighbor sampling over a CSC graph (parity: incubate
+    graph_khop_sampler). Host-side (data-dependent output sizes)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    r = np.asarray(row._data if hasattr(row, "_data") else row)
+    cp = np.asarray(colptr._data if hasattr(colptr, "_data") else colptr)
+    frontier = np.asarray(input_nodes._data if hasattr(input_nodes, "_data")
+                          else input_nodes).reshape(-1)
+    from ..framework.random import rng_key
+    import jax as _jax
+    rng = np.random.RandomState(
+        int(_jax.random.randint(rng_key(), (), 0, 2**31 - 1)))
+    edge_src, edge_dst = [], []
+    nodes = list(frontier.tolist())
+    seen = set(nodes)
+    for k in sample_sizes:
+        nxt = []
+        for v in frontier:
+            neigh = r[cp[v]:cp[v + 1]]
+            if k >= 0 and neigh.size > k:
+                neigh = rng.choice(neigh, size=k, replace=False)
+            for u in neigh:
+                edge_src.append(int(u))
+                edge_dst.append(int(v))
+                if int(u) not in seen:
+                    seen.add(int(u))
+                    nodes.append(int(u))
+                    nxt.append(int(u))
+        frontier = np.asarray(nxt, np.int64)
+    remap = {n: i for i, n in enumerate(nodes)}
+    es = np.asarray([remap[s] for s in edge_src], np.int64)
+    ed = np.asarray([remap[d] for d in edge_dst], np.int64)
+    # reindex_x: positions of input_nodes in the sampled-node list — the
+    # frontier seeds the list, so these are the first len(input) slots
+    n_in = np.asarray(input_nodes._data if hasattr(input_nodes, "_data")
+                      else input_nodes).reshape(-1).shape[0]
+    outs = (Tensor(jnp.asarray(es)), Tensor(jnp.asarray(ed)),
+            Tensor(jnp.asarray(np.asarray(nodes, np.int64))),
+            Tensor(jnp.asarray(np.arange(n_in, dtype=np.int64))))
+    if return_eids:
+        outs = outs + (Tensor(jnp.asarray(
+            np.arange(es.shape[0], dtype=np.int64))),)
+    return outs
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    """One-hop neighbor sampling (parity: incubate
+    graph_sample_neighbors). Host-side."""
+    import numpy as np
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    r = np.asarray(row._data if hasattr(row, "_data") else row)
+    cp = np.asarray(colptr._data if hasattr(colptr, "_data") else colptr)
+    nodes = np.asarray(input_nodes._data if hasattr(input_nodes, "_data")
+                       else input_nodes).reshape(-1)
+    from ..framework.random import rng_key
+    import jax as _jax
+    rng = np.random.RandomState(
+        int(_jax.random.randint(rng_key(), (), 0, 2**31 - 1)))
+    out, counts = [], []
+    for v in nodes:
+        neigh = r[cp[v]:cp[v + 1]]
+        if sample_size >= 0 and neigh.size > sample_size:
+            neigh = rng.choice(neigh, size=sample_size, replace=False)
+        out.extend(int(u) for u in neigh)
+        counts.append(len(neigh))
+    return (Tensor(jnp.asarray(np.asarray(out, np.int64))),
+            Tensor(jnp.asarray(np.asarray(counts, np.int32))))
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    """Reindex a sampled subgraph to local ids (parity: incubate
+    graph_reindex). Host-side."""
+    import numpy as np
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    xs = np.asarray(x._data if hasattr(x, "_data") else x).reshape(-1)
+    nb = np.asarray(neighbors._data if hasattr(neighbors, "_data")
+                    else neighbors).reshape(-1)
+    ct = np.asarray(count._data if hasattr(count, "_data")
+                    else count).reshape(-1)
+    remap = {int(n): i for i, n in enumerate(xs)}
+    order = list(xs.tolist())
+    for u in nb:
+        if int(u) not in remap:
+            remap[int(u)] = len(order)
+            order.append(int(u))
+    re_nb = np.asarray([remap[int(u)] for u in nb], np.int64)
+    re_src = np.repeat(np.arange(ct.shape[0]), ct).astype(np.int64)
+    return (Tensor(jnp.asarray(re_nb)), Tensor(jnp.asarray(re_src)),
+            Tensor(jnp.asarray(np.asarray(order, np.int64))))
+
+
+from . import inference  # noqa: F401,E402

@@ -1,0 +1,16 @@
+"""incubate.inference — decorator marking a predictor function (parity:
+reference incubate/inference: TensorRT-conversion decorator). On TPU the
+conversion target is jit.to_static + StableHLO export; the decorator
+compiles the wrapped callable on first use."""
+from __future__ import annotations
+
+__all__ = ["enable_inference_mode"]
+
+
+def enable_inference_mode(func=None, **kwargs):
+    def deco(f):
+        from ..jit.api import to_static
+        return to_static(f)
+    if func is not None:
+        return deco(func)
+    return deco

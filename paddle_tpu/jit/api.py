@@ -169,6 +169,8 @@ class TracedFunction:
         return jitted, out_treedef_box
 
     def __call__(self, *args, **kwargs):
+        if not _to_static_enabled:
+            return self._orig_fn(*args, **kwargs)   # jit globally disabled
         leaves, treedef = jax.tree_util.tree_flatten((args, kwargs),
                                                      is_leaf=_is_tensor)
         tensor_arrays = []
@@ -350,3 +352,36 @@ class InputSpec:
         self.shape = tuple(-1 if s is None else int(s) for s in shape)
         self.dtype = convert_dtype(dtype)
         self.name = name
+
+
+
+class TranslatedLayer:
+    """Marker/result type of jit.load (parity: jit/translated_layer.py).
+    jit.load in this build returns a runnable program wrapper; this alias
+    keeps isinstance checks from reference code importable."""
+
+
+_code_level = 0
+_verbosity = 0
+_to_static_enabled = True
+
+
+def set_code_level(level=100, also_to_stderr=False):
+    """Parity: paddle.jit.set_code_level (SOT transformed-code logging).
+    Stored for introspection; this build has no bytecode transformer to
+    print."""
+    global _code_level
+    _code_level = level
+
+
+def set_verbosity(level=0, also_to_stderr=False):
+    global _verbosity
+    _verbosity = level
+
+
+def enable_to_static(enable=True):
+    """Globally toggle to_static compilation (parity:
+    jit.enable_to_static): when off, TracedFunction calls fall through to
+    eager execution."""
+    global _to_static_enabled
+    _to_static_enabled = bool(enable)

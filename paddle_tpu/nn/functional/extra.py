@@ -22,7 +22,12 @@ from ...ops.dispatch import apply_op
 __all__ = ["grid_sample", "affine_grid", "sequence_mask", "max_unpool1d",
            "max_unpool2d", "max_unpool3d", "pairwise_distance",
            "temporal_shift", "feature_alpha_dropout", "embedding_bag",
-           "ctc_loss", "rnnt_loss"]
+           "ctc_loss", "rnnt_loss", "hardtanh_", "leaky_relu_",
+           "thresholded_relu_", "fractional_max_pool2d",
+           "fractional_max_pool3d", "hsigmoid_loss",
+           "adaptive_log_softmax_with_loss", "gather_tree",
+           "sparse_attention", "flash_attn_qkvpacked",
+           "flash_attn_varlen_qkvpacked", "margin_cross_entropy"]
 
 NEG = -1e30
 
@@ -403,3 +408,197 @@ def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
 
     return apply_op("rnnt_loss", _f, logits, labels, logit_lengths,
                     label_lengths)
+
+
+def _inplace_of(x, out):
+    """Taped in-place: mutate x to out BUT first snapshot x's old tape
+    identity and rebind the new node's input to the snapshot — otherwise
+    the node's input would be the mutated x itself (a self-cycle that
+    silently drops the op's gradient)."""
+    from ...core.tensor import Tensor as _T
+    node = out._grad_node
+    if node is not None:
+        old = _T(x._data, stop_gradient=x.stop_gradient)
+        old._grad_node = x._grad_node
+        old._grad_out_idx = x._grad_out_idx
+        node.inputs = [old if t is x else t for t in node.inputs]
+    x._data = out._data
+    x._grad_node = node
+    x._grad_out_idx = out._grad_out_idx
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def hardtanh_(x, min=-1.0, max=1.0, name=None):
+    """In-place hardtanh (parity: functional hardtanh_)."""
+    from .activation import hardtanh
+    return _inplace_of(x, hardtanh(x, min, max))
+
+
+def leaky_relu_(x, negative_slope=0.01, name=None):
+    from .activation import leaky_relu
+    return _inplace_of(x, leaky_relu(x, negative_slope))
+
+
+def thresholded_relu_(x, threshold=1.0, value=0.0, name=None):
+    from .activation import thresholded_relu
+    return _inplace_of(x, thresholded_relu(x, threshold, value))
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """Functional over the FractionalMaxPool2D layer logic."""
+    from ..layer.extra_layers import FractionalMaxPool2D
+    return FractionalMaxPool2D(output_size, kernel_size, random_u,
+                               return_mask)(x)
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    from ..layer.extra_layers import FractionalMaxPool3D
+    return FractionalMaxPool3D(output_size, kernel_size, random_u,
+                               return_mask)(x)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Functional hierarchical sigmoid over a complete binary tree with
+    CALLER-OWNED weight/bias (parity: functional hsigmoid_loss; custom
+    path tables unsupported, like the layer)."""
+    if path_table is not None or path_code is not None:
+        raise NotImplementedError("custom path tables not supported")
+    from ..layer.extra_layers import HSigmoidLoss
+    tmp = HSigmoidLoss.__new__(HSigmoidLoss)
+    # borrow the layer's path precomputation without registering params
+    from ..layer.layers import Layer
+    Layer.__init__(tmp)
+    import math as _m
+    tmp.num_classes = num_classes
+    tmp.depth = max(1, _m.ceil(_m.log2(max(num_classes, 2))))
+    codes, signs, msk = HSigmoidLoss._build_paths(num_classes, tmp.depth)
+    tmp._codes, tmp._signs, tmp._mask = codes, signs, msk
+    tmp.weight, tmp.bias = weight, bias
+    return tmp.forward(input, label)
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, head_bias,
+                                   cutoffs, tail_weights, name=None):
+    """Functional adaptive softmax with caller-owned projections (parity:
+    functional adaptive_log_softmax_with_loss)."""
+    from ..layer.extra_layers import AdaptiveLogSoftmaxWithLoss
+    als = AdaptiveLogSoftmaxWithLoss.__new__(AdaptiveLogSoftmaxWithLoss)
+    from ..layer.layers import Layer
+    Layer.__init__(als)
+    als.cutoffs = [int(c) for c in cutoffs]
+    als.n_clusters = len(als.cutoffs) - 1
+    als.head_size = als.cutoffs[0] + als.n_clusters
+    als.head_weight, als.head_bias = head_weight, head_bias
+    als._tails = [tuple(t) for t in tail_weights]
+    return als.forward(input, label)
+
+
+def gather_tree(ids, parents, name=None):
+    """Trace beam-search ancestry back from the last step (parity:
+    functional gather_tree over phi gather_tree kernel).
+    ids/parents: (T, B, beam)."""
+    def _f(i, p):
+        T = i.shape[0]
+
+        def step(carry, t):
+            beams = carry                            # (B, beam) int
+            out_t = jnp.take_along_axis(i[t], beams, axis=1)
+            prev = jnp.take_along_axis(p[t], beams, axis=1)
+            return prev, out_t
+
+        init = jnp.broadcast_to(jnp.arange(i.shape[2], dtype=i.dtype),
+                                i.shape[1:])
+        _, outs = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+        return outs[::-1]
+
+    return apply_op("gather_tree", _f, ids, parents)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Block-sparse attention with an explicit CSR pattern (parity:
+    functional sparse_attention over phi sparse_attention kernel);
+    delegates to the sparse.nn implementation."""
+    from ...sparse import sparse_csr_tensor
+    from ...sparse.nn.functional import attention as _sp_attn
+    off = sparse_csr_offset._data if hasattr(sparse_csr_offset, "_data") \
+        else jnp.asarray(sparse_csr_offset)
+    col = sparse_csr_columns._data if hasattr(sparse_csr_columns, "_data") \
+        else jnp.asarray(sparse_csr_columns)
+    B, H, S, _ = query.shape
+    csr = sparse_csr_tensor(
+        off.reshape(-1), col.reshape(-1),
+        jnp.ones((int(np.prod(col.shape)),), jnp.float32),
+        (B * H, S, S))
+    return _sp_attn(query, key, value, csr,
+                    key_padding_mask=key_padding_mask, attn_mask=attn_mask)
+
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False,
+                         return_softmax=False, training=True, name=None):
+    """Packed-QKV flash attention: qkv (B, S, 3, H, D) (parity:
+    nn/functional/flash_attention.py flash_attn_qkvpacked)."""
+    from .flash_attention import scaled_dot_product_attention
+
+    def _pick(i):
+        return apply_op("qkv_unpack", lambda a, j=i: a[:, :, j], qkv)
+    q, k, v = _pick(0), _pick(1), _pick(2)
+    out = scaled_dot_product_attention(q, k, v, None, dropout, causal,
+                                       training)
+    return out, None
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
+                                max_seqlen_q, max_seqlen_k, scale,
+                                dropout=0.0, causal=False,
+                                return_softmax=False, training=True,
+                                name=None):
+    """Packed varlen flash attention (parity: flash_attn_varlen_qkvpacked):
+    unpacks and routes to flash_attn_unpadded."""
+    from .flash_attention import flash_attn_unpadded
+
+    # varlen packed layout is (total_tokens, 3, H, D) — axis 1 holds qkv
+    def _pick(i):
+        return apply_op("qkv_unpack", lambda a, j=i: a[:, j], qkv)
+    q, k, v = _pick(0), _pick(1), _pick(2)
+    return flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                               max_seqlen_q, max_seqlen_k, scale, dropout,
+                               causal, return_softmax, training=training)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean",
+                         name=None):
+    """ArcFace-family margin softmax CE (parity: functional
+    margin_cross_entropy): cos(m1*theta + m2) - m3 applied to the target
+    logit before the scaled softmax."""
+    def _f(lg, lab):
+        lab = lab.reshape(-1).astype(jnp.int32)
+        cos = jnp.clip(lg, -1.0, 1.0)
+        theta = jnp.arccos(cos)
+        tgt = jnp.cos(margin1 * theta + margin2) - margin3
+        onehot = jax.nn.one_hot(lab, lg.shape[-1], dtype=lg.dtype)
+        adj = jnp.where(onehot > 0, tgt, cos) * scale
+        logp = jax.nn.log_softmax(adj, axis=-1)
+        nll = -jnp.take_along_axis(logp, lab[:, None], axis=1)[:, 0]
+        sm = jnp.exp(logp)
+        if reduction == "mean":
+            out = jnp.mean(nll)
+        elif reduction == "sum":
+            out = jnp.sum(nll)
+        else:
+            out = nll
+        return (out, sm) if return_softmax else out
+
+    if group is not None and getattr(group, "nranks", 1) > 1:
+        raise NotImplementedError(
+            "model-parallel margin_cross_entropy: use "
+            "fleet.mpu.ParallelCrossEntropy for the sharded-vocab path")
+    return apply_op("margin_cross_entropy", _f, logits, label)

