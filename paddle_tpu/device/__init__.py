@@ -253,3 +253,44 @@ def reset_max_memory_reserved(device=None):
 __all__ += ["memory_stats", "memory_allocated", "max_memory_allocated",
             "memory_reserved", "max_memory_reserved",
             "reset_max_memory_allocated", "reset_max_memory_reserved"]
+
+
+def get_cudnn_version():
+    """None: no cuDNN in the TPU build (parity probe)."""
+    return None
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_cinn():
+    """The fusion-compiler capability is XLA in this build."""
+    return False
+
+
+def is_compiled_with_distribute():
+    """Distributed support is always compiled in (XLA collectives)."""
+    return True
+
+
+def get_all_device_type():
+    import jax
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_available_custom_device():
+    return []
+
+
+def set_stream(stream=None):
+    """Streams are XLA-managed; kept for API parity."""
+    return stream
+
+
+from ..compat import XPUPlace  # noqa: E402,F401  (shared _Place base)
+
+
+class IPUPlace:
+    def __init__(self):
+        raise NotImplementedError("IPU backends are not part of this build")

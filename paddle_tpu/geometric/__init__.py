@@ -15,7 +15,9 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor
 from ..ops.dispatch import apply_op
 
-__all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
+__all__ = ["reindex_graph", "reindex_heter_graph", "sample_neighbors",
+    "weighted_sample_neighbors",
+    "send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
            "segment_mean", "segment_max", "segment_min"]
 
 _REDUCERS = {
@@ -128,3 +130,54 @@ def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
     def _f(xa, ya, s, d):
         return combine(xa[s], ya[d])
     return apply_op("send_uv", _f, x, y, src_index, dst_index)
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Parity: paddle.geometric.reindex_graph — same contract as the
+    incubate implementation."""
+    from ..incubate import graph_reindex
+    return graph_reindex(x, neighbors, count, value_buffer, index_buffer)
+
+
+def reindex_heter_graph(x, neighbors_list, count_list, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous reindex: one shared node table across edge types,
+    CONCATENATED outputs (reference geometric/reindex.py:153 returns flat
+    reindex_src / reindex_dst / out_nodes tensors)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    from ..incubate import graph_reindex
+    all_nb, all_ct = [], []
+    for neighbors, count in zip(neighbors_list, count_list):
+        all_nb.append(np.asarray(
+            neighbors._data if hasattr(neighbors, "_data")
+            else neighbors).reshape(-1))
+        all_ct.append(np.asarray(
+            count._data if hasattr(count, "_data") else count).reshape(-1))
+    nb = Tensor(jnp.asarray(np.concatenate(all_nb).astype(np.int64)))
+    ct = Tensor(jnp.asarray(np.concatenate(all_ct).astype(np.int64)))
+    return graph_reindex(x, nb, ct)
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Parity: paddle.geometric.sample_neighbors."""
+    from ..incubate import graph_sample_neighbors
+    return graph_sample_neighbors(row, colptr, input_nodes, eids=eids,
+                                  sample_size=sample_size,
+                                  return_eids=return_eids)
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weight-proportional neighbor sampling (parity:
+    geometric.weighted_sample_neighbors) — delegates to the incubate
+    sampler with edge_weight set."""
+    from ..incubate import graph_sample_neighbors
+    return graph_sample_neighbors(row, colptr, input_nodes, eids=eids,
+                                  sample_size=sample_size,
+                                  return_eids=return_eids,
+                                  edge_weight=edge_weight)

@@ -91,9 +91,10 @@ def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
 def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
                            perm_buffer=None, sample_size=-1,
                            return_eids=False, flag_perm_buffer=False,
-                           name=None):
-    """One-hop neighbor sampling (parity: incubate
-    graph_sample_neighbors). Host-side."""
+                           edge_weight=None, name=None):
+    """One-hop neighbor sampling, uniform or weight-proportional (parity:
+    incubate graph_sample_neighbors; geometric.weighted_sample_neighbors
+    delegates here with edge_weight). Host-side."""
     import numpy as np
     import jax.numpy as jnp
     from ..core.tensor import Tensor
@@ -101,19 +102,43 @@ def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
     cp = np.asarray(colptr._data if hasattr(colptr, "_data") else colptr)
     nodes = np.asarray(input_nodes._data if hasattr(input_nodes, "_data")
                        else input_nodes).reshape(-1)
+    w = None if edge_weight is None else np.asarray(
+        edge_weight._data if hasattr(edge_weight, "_data")
+        else edge_weight).reshape(-1)
+    ei = None if eids is None else np.asarray(
+        eids._data if hasattr(eids, "_data") else eids).reshape(-1)
     from ..framework.random import rng_key
     import jax as _jax
     rng = np.random.RandomState(
         int(_jax.random.randint(rng_key(), (), 0, 2**31 - 1)))
-    out, counts = [], []
+    out, counts, out_eids = [], [], []
     for v in nodes:
-        neigh = r[cp[v]:cp[v + 1]]
-        if sample_size >= 0 and neigh.size > sample_size:
-            neigh = rng.choice(neigh, size=sample_size, replace=False)
-        out.extend(int(u) for u in neigh)
-        counts.append(len(neigh))
-    return (Tensor(jnp.asarray(np.asarray(out, np.int64))),
-            Tensor(jnp.asarray(np.asarray(counts, np.int32))))
+        lo, hi = int(cp[v]), int(cp[v + 1])
+        idx = np.arange(lo, hi)
+        if sample_size >= 0 and idx.size > sample_size:
+            if w is not None:
+                ws = w[idx]
+                tot = ws.sum()
+                if tot <= 0:          # degenerate weights: fall back to
+                    p = None          # uniform rather than NaN probs
+                else:
+                    p = ws / tot
+                    nz = int((p > 0).sum())
+                    if nz < sample_size:
+                        p = None
+                idx = rng.choice(idx, size=sample_size, replace=False, p=p)
+            else:
+                idx = rng.choice(idx, size=sample_size, replace=False)
+        out.extend(int(u) for u in r[idx])
+        counts.append(idx.size)
+        if return_eids:
+            src_e = ei if ei is not None else np.arange(r.shape[0])
+            out_eids.extend(int(e) for e in src_e[idx])
+    res = (Tensor(jnp.asarray(np.asarray(out, np.int64))),
+           Tensor(jnp.asarray(np.asarray(counts, np.int32))))
+    if return_eids:
+        res = res + (Tensor(jnp.asarray(np.asarray(out_eids, np.int64))),)
+    return res
 
 
 def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,

@@ -390,3 +390,43 @@ _benchmark = _Benchmark()
 def benchmark() -> _Benchmark:
     """Parity: paddle.profiler.utils.benchmark() global step timer."""
     return _benchmark
+
+
+import enum as _enum
+
+
+class SortedKeys(_enum.Enum):
+    """Summary sort keys (parity: profiler.SortedKeys)."""
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView(_enum.Enum):
+    """Summary table views (parity: profiler.SummaryView)."""
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def export_protobuf(profiler_result, path):
+    """Serialize a profiler result (parity: profiler.export_protobuf —
+    the reference dumps its own proto; this build writes the same JSON
+    span list load_profiler_result reads back)."""
+    import json
+    data = profiler_result.events if hasattr(profiler_result, "events") \
+        else profiler_result
+    with open(path, "w") as f:
+        json.dump(data, f)
+    return path

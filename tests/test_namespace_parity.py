@@ -81,6 +81,12 @@ def _ref_all_bounded(path):
     ("static/__init__.py", "static"),
     ("incubate/__init__.py", "incubate"),
     ("signal.py", "signal"),
+    ("geometric/__init__.py", "geometric"),
+    ("device/__init__.py", "device"),
+    ("profiler/__init__.py", "profiler"),
+    ("audio/__init__.py", "audio"),
+    ("text/__init__.py", "text"),
+    ("autograd/__init__.py", "autograd"),
 ])
 def test_subnamespace_exports_complete(rel, attr):
     names = _ref_all_bounded(os.path.join(REF, rel))
