@@ -66,6 +66,7 @@ from . import signal  # noqa: F401,E402
 from . import strings  # noqa: F401,E402
 from . import hub  # noqa: F401,E402
 from . import version  # noqa: F401,E402
+from . import onnx  # noqa: F401,E402
 from .compat import (  # noqa: F401,E402
     CPUPlace, CUDAPlace, CUDAPinnedPlace, XPUPlace, CustomPlace, shape,
     tolist, reverse, batch, set_printoptions, disable_signal_handler,

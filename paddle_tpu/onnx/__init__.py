@@ -1,0 +1,36 @@
+"""paddle.onnx — export facade.
+
+Parity: reference `python/paddle/onnx/export.py` (delegates to
+paddle2onnx). Per SURVEY.md A.7 the TPU build's deployment artifact is
+the StableHLO module written by jit.save: `onnx.export` keeps the
+reference call shape and produces that artifact (ONNX protobuf emission
+would need the paddle2onnx package, which is not shipped).
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Export a Layer for deployment (reference onnx.export signature).
+
+    Writes `{path}.pdiparams` + `{path}.pdmodel.mlir` (StableHLO) via
+    jit.save — the portable compiled-program format of this build.
+    input_spec is REQUIRED (the program artifact is traced from it), and
+    every dimension must be concrete — XLA programs are static-shaped,
+    so export one program per deployment batch size."""
+    from ..jit import save as jit_save
+    if input_spec is None:
+        raise ValueError(
+            "onnx.export needs input_spec: the compiled-program artifact "
+            "is traced from it (e.g. input_spec=[InputSpec([8, 4], "
+            "'float32')])")
+    for spec in input_spec:
+        shape = getattr(spec, "shape", None) or []
+        if any(s is None or (isinstance(s, int) and s < 0) for s in shape):
+            raise NotImplementedError(
+                f"dynamic dim in {list(shape)}: StableHLO export is "
+                "static-shaped — pass concrete sizes (one artifact per "
+                "deployment shape)")
+    jit_save(layer, path, input_spec=input_spec, **configs)
+    return path
