@@ -226,7 +226,31 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False,
     elif not isinstance(grad_tensors, (list, tuple)):
         grad_tensors = [grad_tensors]
 
+    hooked_slots = {}      # (id(node), out_idx) -> hooks: applied once on
+    hooked_leaves = {}     # id(t) -> (t, partial sum): the ACCUMULATED
+                           # cotangent (paddle hook semantics), not per edge
+
+    def _run_hooks(hooks, g):
+        for h in list(hooks):
+            out = h(g if isinstance(g, Tensor)
+                    else Tensor(g, stop_gradient=True))
+            if out is not None:
+                g = out if (create_graph and isinstance(out, Tensor)) else (
+                    out._data if isinstance(out, Tensor)
+                    else jnp.asarray(out))
+        return g
+
     def _scatter(t, g):
+        hooks = getattr(t, "_grad_hooks", None)
+        if hooks:
+            if t._grad_node is not None:
+                hooked_slots[(id(t._grad_node), t._grad_out_idx)] = hooks
+            else:
+                tid = id(t)
+                prev = hooked_leaves.get(tid)
+                acc = g if prev is None else prev[1] + g
+                hooked_leaves[tid] = (t, acc)
+                return          # deposited (transformed) after the walk
         if _capture is not None and id(t) in _capture:
             prev = _capture[id(t)]
             _capture[id(t)] = g if prev is None else prev + g
@@ -276,6 +300,10 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False,
             c if c is not None else _zero_cotangent(av)
             for c, av in zip(node.out_cots, node.out_avals)
         ]
+        for i in range(len(cots)):
+            hk = hooked_slots.pop((id(node), i), None)
+            if hk is not None:
+                cots[i] = _run_hooks(hk, cots[i])
         if create_graph and node.fwd_closed is not None:
             in_grads = _vjp_on_tape(node, cots)
         elif node.out_treedef is not None:
@@ -293,6 +321,16 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False,
             node.release()
         else:
             node.out_cots = [None] * node.n_outputs
+
+    for t, total in hooked_leaves.values():
+        g = _run_hooks(t._grad_hooks, total)
+        if isinstance(g, Tensor):
+            g = g.data
+        if _capture is not None and id(t) in _capture:
+            prev = _capture[id(t)]
+            _capture[id(t)] = g if prev is None else prev + g
+        if not t.stop_gradient and _capture is None:
+            t._accumulate_grad(g)
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,

@@ -46,6 +46,7 @@ class Tensor:
         "_lr_scale",
         "_asp_mask",   # incubate.asp 2:4 sparsity mask (travels with the
                        # parameter through deepcopy, unlike an id registry)
+        "_grad_hooks",  # register_hook callbacks run on the cotangent
         "__weakref__",
     )
 
@@ -242,8 +243,71 @@ class Tensor:
     def cpu(self):
         return Tensor(jax.device_get(self._data), self.stop_gradient, self.name)
 
+    def cuda(self, device_id=None, blocking=True):
+        """API parity: moves to the accelerator — jax already placed the
+        array on the default device, so this is the identity."""
+        return self
+
     def pin_memory(self):
         return self
+
+    # ------------------------------------------------------ hooks/compat
+    def register_hook(self, hook):
+        """Run `hook(grad)` when this tensor's gradient is produced during
+        backward; a non-None return replaces the gradient (parity:
+        Tensor.register_hook / egr GradNode hooks)."""
+        if self.stop_gradient:
+            raise ValueError(
+                "cannot register_hook on a tensor with stop_gradient=True "
+                "(no gradient will ever be produced for it)")
+        hooks = getattr(self, "_grad_hooks", None)
+        if hooks is None:
+            hooks = []
+            self._grad_hooks = hooks
+        hooks.append(hook)
+
+        class _Handle:
+            def remove(_self):
+                if hook in hooks:
+                    hooks.remove(hook)
+        return _Handle()
+
+    def ndimension(self):
+        return len(self._data.shape)
+
+    def element_size(self):
+        return int(np.dtype(self._data.dtype).itemsize)
+
+    def get_tensor(self):
+        """Legacy LoDTensor accessor — the Tensor IS its storage here."""
+        return self
+
+    def value(self):
+        return self
+
+    @property
+    def persistable(self):
+        return bool(getattr(self, "_is_param", False))
+
+    @persistable.setter
+    def persistable(self, v):
+        self._is_param = bool(v)
+
+    @property
+    def type(self):
+        return "lod_tensor"
+
+    @property
+    def strides(self):
+        sh = self._data.shape
+        st, acc = [], 1
+        for s in reversed(sh):
+            st.append(acc)
+            acc *= int(s)
+        return list(reversed(st))
+
+    def data_ptr(self):
+        return id(self._data)
 
     def contiguous(self):
         return self

@@ -112,6 +112,7 @@ _SKIP = {"where",
 
 
 def patch_tensor_methods():
+    _bind_inplace_random()
     for name, fn in _BINARY_DUNDERS.items():
         setattr(Tensor, name, fn)
     Tensor.__neg__ = _neg
@@ -212,3 +213,11 @@ def _inplace_unary(op):
     def fn(self, *args, **kwargs):
         return _rebind(self, op(_alias(self), *args, **kwargs))
     return fn
+
+
+def _bind_inplace_random():
+    from ..core.tensor import Tensor
+    from . import random as _r
+    Tensor.uniform_ = _r.uniform_
+    Tensor.normal_ = _r.normal_
+    Tensor.exponential_ = _r.exponential_

@@ -54,15 +54,23 @@ def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
                                      minval=min, maxval=max))
 
 
+def _reset_history(x):
+    """In-place randomization severs the op history: the new values do
+    not depend on whatever produced the old ones."""
+    x._grad_node = None
+    x._grad_out_idx = None
+    return x
+
+
 def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
     x._data = jax.random.uniform(rng_key(), tuple(x._data.shape), x.dtype,
                                  minval=min, maxval=max)
-    return x
+    return _reset_history(x)
 
 
 def normal_(x, mean=0.0, std=1.0, name=None):
     x._data = mean + std * jax.random.normal(rng_key(), tuple(x._data.shape), x.dtype)
-    return x
+    return _reset_history(x)
 
 
 def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
@@ -117,7 +125,7 @@ def exponential_(x, lam=1.0, name=None):
     u = jax.random.uniform(rng_key(), tuple(x._data.shape), x.dtype,
                            minval=jnp.finfo(x.dtype).tiny, maxval=1.0)
     x._data = -jnp.log(u) / lam
-    return x
+    return _reset_history(x)
 
 
 def standard_gamma(x, name=None):

@@ -224,3 +224,24 @@ def test_static_executor_params_are_runtime_args():
     r2 = ex.run(feed=feed, fetch_list=[loss])
     assert abs((r2[0] - r1[0]) - 10.0) < 1e-4
     assert ex.statistics()["compiles"] == 1
+
+
+def test_tensor_surface_and_grad_hooks():
+    t = paddle.to_tensor(np.ones((2, 3), np.float32))
+    assert t.strides == [3, 1]
+    assert t.element_size() == 4
+    assert t.ndimension() == 2
+    assert t.cuda() is t and t.get_tensor() is t
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    x.stop_gradient = False
+    handle = x.register_hook(lambda g: g * 2)
+    (x * 3.0).sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad._data), [6.0, 6.0])
+    handle.remove()
+    x.clear_grad()
+    (x * 3.0).sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad._data), [3.0, 3.0])
+    paddle.seed(0)
+    t.uniform_(0.0, 1.0)
+    a = np.asarray(t._data)
+    assert a.min() >= 0 and a.max() <= 1
