@@ -78,11 +78,21 @@ class HybridParallelOptimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, *a, **k):
-        # route through OUR step() so gradient-merge banking applies
+        """ADVICE r2: only route through the wrapper's step() when
+        gradient-merge banking is active; otherwise delegate to the inner
+        optimizer's minimize. Never clears gradients (reference
+        hybrid_parallel_optimizer.py:266 contract — callers inspect
+        p.grad after minimize) and returns (optimize_ops, params_grads).
+        Note: with banking active, the k-1 banked steps DO clear the
+        per-step grads inside step() — that is the banking contract, the
+        accumulated gradient lives in the wrapper."""
+        if self._gm_k <= 1:
+            return self._inner_opt.minimize(loss, *a, **k)
         loss.backward()
+        params = self._inner_opt._parameter_list
+        params_grads = [(p, p.grad) for p in params if p.grad is not None]
         self.step()
-        self.clear_grad()
-        return None, None
+        return [], params_grads
 
     def state_dict(self):
         sd = self._inner_opt.state_dict()

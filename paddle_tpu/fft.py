@@ -113,8 +113,11 @@ def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
 
 
 def hfftn(x, s=None, axes=None, norm="backward", name=None):
-    """N-d Hermitian FFT: ifftn over the leading axes + hfft on the last
-    (matches numpy/reference semantics)."""
+    """N-d Hermitian-input FFT (c2r): a FORWARD transform throughout —
+    forward fftn over the leading axes + hfft on the last. Parity:
+    paddle.fft.hfftn -> fftn_c2r (reference python/paddle/fft.py:883);
+    ground truth for real y = hfftn(x): ihfftn(y) == x, and
+    hfftn == real(fftn(hermitian-expanded x))."""
     def _f(a):
         if axes is not None:
             ax = tuple(axes)
@@ -127,13 +130,16 @@ def hfftn(x, s=None, axes=None, norm="backward", name=None):
         n_last = None if s is None else s[-1]
         if lead:
             lead_s = None if s is None else s[:-1]
-            a = jnp.fft.ifftn(a, s=lead_s, axes=lead,
-                              norm=_norm(norm))
+            a = jnp.fft.fftn(a, s=lead_s, axes=lead,
+                             norm=_norm(norm))
         return jnp.fft.hfft(a, n=n_last, axis=last, norm=_norm(norm))
     return apply_op("hfftn", _f, x)
 
 
 def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    """N-d inverse Hermitian FFT (r2c): an INVERSE transform throughout —
+    ihfft on the last axis + ifftn over the leading axes. For real x this
+    equals np.fft.ifftn(x)[..., :n//2+1] (the advisor's ground truth)."""
     def _f(a):
         if axes is not None:
             ax = tuple(axes)
@@ -147,7 +153,7 @@ def ihfftn(x, s=None, axes=None, norm="backward", name=None):
         out = jnp.fft.ihfft(a, n=n_last, axis=last, norm=_norm(norm))
         if lead:
             lead_s = None if s is None else s[:-1]
-            out = jnp.fft.fftn(out, s=lead_s, axes=lead,
-                               norm=_norm(norm))
+            out = jnp.fft.ifftn(out, s=lead_s, axes=lead,
+                                norm=_norm(norm))
         return out
     return apply_op("ihfftn", _f, x)

@@ -55,10 +55,12 @@ class LookAhead:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        # reference contract: no clear_grad, return (ops, params_grads)
         loss.backward()
+        params = self.inner_optimizer._parameter_list
+        params_grads = [(p, p.grad) for p in params if p.grad is not None]
         self.step()
-        self.clear_grad()
-        return None, None
+        return [], params_grads
 
     def state_dict(self):
         return {"inner": getattr(self.inner_optimizer, "state_dict",

@@ -135,10 +135,16 @@ class Optimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        """backward + apply. Matches the reference contract
+        (python/paddle/optimizer/optimizer.py Optimizer.minimize): does
+        NOT clear gradients — p.grad stays inspectable afterwards, the
+        caller owns clear_grad() — and returns (optimize_ops,
+        params_grads); optimize_ops is [] in dygraph."""
         loss.backward()
+        params_grads = [(p, p.grad) for p in self._parameter_list
+                        if p.grad is not None]
         self.step()
-        self.clear_grad()
-        return None, None
+        return [], params_grads
 
     # --------------------------------------------------------------- state IO
     def state_dict(self):

@@ -446,26 +446,57 @@ def _coerce_coo_pair(x, y, opname):
     return xc, yc, was_csr
 
 
+def _union_indices(xc: SparseCooTensor, yc: SparseCooTensor):
+    """Structural union of the two sparsity patterns (sorted, deduped).
+    Non-differentiable by construction: only index buffers are touched."""
+    union = jsparse.BCOO((jnp.concatenate([
+        jnp.ones(xc._bcoo.nse, jnp.float32),
+        jnp.ones(yc._bcoo.nse, jnp.float32)]),
+        jnp.concatenate([xc._bcoo.indices, yc._bcoo.indices], axis=0)),
+        shape=xc._bcoo.shape).sum_duplicates()
+    return union.indices
+
+
+def _binary_at_pattern(opname, fn, x, y, out_indices=None):
+    """Elementwise binary op at a fixed output pattern, with the VALUES
+    computed through apply_op over x.values()/y.values() so autograd flows
+    into both values buffers (ADVICE r2: the earlier raw-array path
+    silently dropped these gradients). The dense reconstruct + gather is
+    all jnp inside the closure, hence differentiable; nnz is test-scale
+    (same stance as the reference's merge kernels note above). Note the
+    CSR path coalesces through a COO conversion, which drops an incoming
+    `_vals_t` tape link — gradients are guaranteed for COO operands."""
+    xc, yc, was_csr = _coerce_coo_pair(x, y, opname)
+    idx = _union_indices(xc, yc) if out_indices is None else out_indices
+    pos = tuple(idx[:, d] for d in range(idx.shape[1]))
+    xi, yi, shp = xc._bcoo.indices, yc._bcoo.indices, xc._bcoo.shape
+
+    def _f(vx, vy):
+        dx = jsparse.BCOO((vx, xi), shape=shp).todense()
+        dy = jsparse.BCOO((vy, yi), shape=shp).todense()
+        return fn(dx, dy)[pos]
+
+    vals_t = apply_op(opname, _f, xc.values(), yc.values())
+    res = SparseCooTensor(jsparse.BCOO((vals_t._data, idx), shape=shp))
+    res._vals_t = vals_t
+    return res.to_sparse_csr() if was_csr else res
+
+
 def add(x, y, name=None):
-    xc, yc, was_csr = _coerce_coo_pair(x, y, "add")
-    idx = jnp.concatenate([xc._bcoo.indices, yc._bcoo.indices], axis=0)
-    val = jnp.concatenate([xc._bcoo.data, yc._bcoo.data], axis=0)
-    out = SparseCooTensor(
-        jsparse.BCOO((val, idx), shape=xc._bcoo.shape).sum_duplicates())
-    return out.to_sparse_csr() if was_csr else out
+    return _binary_at_pattern("sparse_add", lambda a, b: a + b, x, y)
 
 
 def subtract(x, y, name=None):
-    return add(x, neg(y), name=name)
+    return _binary_at_pattern("sparse_subtract", lambda a, b: a - b, x, y)
 
 
 def multiply(x, y, name=None):
-    xc, yc, was_csr = _coerce_coo_pair(x, y, "multiply")
-    # elementwise product via dense path (reference kernels do a merge;
-    # nnz here is test-scale)
-    out = xc._bcoo.todense() * yc._bcoo.todense()
-    res = SparseCooTensor(jsparse.BCOO.fromdense(out))
-    return res.to_sparse_csr() if was_csr else res
+    xc, yc, _ = _coerce_coo_pair(x, y, "multiply")
+    # keep the historical output pattern: exact nonzeros of the product
+    # (intersection minus cancellations), computed structurally first
+    pattern = jsparse.BCOO.fromdense(xc._bcoo.todense() * yc._bcoo.todense())
+    return _binary_at_pattern("sparse_multiply", lambda a, b: a * b, x, y,
+                              out_indices=pattern.indices)
 
 
 def divide(x, y, name=None):
@@ -477,18 +508,7 @@ def divide(x, y, name=None):
     if jnp.isscalar(y) or isinstance(y, (int, float)):
         return _unary_on_values("sparse_divide_scalar",
                                 lambda v: v / y)(x)
-    xc, yc, was_csr = _coerce_coo_pair(x, y, "divide")
-    union = jsparse.BCOO((jnp.concatenate([
-        jnp.ones(xc._bcoo.nse, jnp.float32),
-        jnp.ones(yc._bcoo.nse, jnp.float32)]),
-        jnp.concatenate([xc._bcoo.indices, yc._bcoo.indices], axis=0)),
-        shape=xc._bcoo.shape).sum_duplicates()
-    idx = union.indices
-    pos = tuple(idx[:, d] for d in range(idx.shape[1]))
-    xd, yd = xc._bcoo.todense(), yc._bcoo.todense()
-    vals = xd[pos] / yd[pos]
-    res = SparseCooTensor(jsparse.BCOO((vals, idx), shape=xc._bcoo.shape))
-    return res.to_sparse_csr() if was_csr else res
+    return _binary_at_pattern("sparse_divide", lambda a, b: a / b, x, y)
 
 
 def pca_lowrank(x, q=None, center=True, niter=2, name=None):

@@ -38,6 +38,33 @@ def test_fft_matches_numpy_and_grads():
     assert x.grad is not None
 
 
+def test_ihfftn_matches_truncated_ifftn():
+    """ADVICE r2 (medium): ihfftn must be ifftn on leading axes (not
+    forward fftn). Ground truth for real x: ifft2(x)[..., :n//2+1]."""
+    x = rng.randn(4, 6, 10).astype(np.float32)
+    got = np.asarray(paddle.fft.ihfftn(paddle.to_tensor(x),
+                                       axes=(-2, -1))._data)
+    want = np.fft.ifft2(x)[..., : 10 // 2 + 1]
+    np.testing.assert_allclose(got, want, atol=1e-5)
+    got2 = np.asarray(paddle.fft.ihfft2(paddle.to_tensor(x))._data)
+    np.testing.assert_allclose(got2, want, atol=1e-5)
+
+
+def test_hfftn_matches_full_forward_fftn():
+    """hfftn(x) == real(fftn(expand(x))) where expand restores the full
+    Hermitian spectrum on the last axis; also hfftn(ihfftn(x)) == x."""
+    x = rng.randn(4, 6, 10).astype(np.float32)
+    half = np.fft.ihfft(x, axis=-1)          # r2c half-spectrum, last axis
+    half = np.fft.ifft(half, axis=-2)        # manual leading-axis inverse
+    got = np.asarray(paddle.fft.hfftn(paddle.to_tensor(half),
+                                      s=(6, 10), axes=(-2, -1))._data)
+    np.testing.assert_allclose(got, x, atol=1e-4)
+    # roundtrip through our own pair as well
+    rt = paddle.fft.hfftn(paddle.fft.ihfftn(paddle.to_tensor(x)),
+                          s=x.shape)
+    np.testing.assert_allclose(np.asarray(rt._data), x, atol=1e-4)
+
+
 def test_fftshift_fftfreq():
     f = paddle.fft.fftfreq(8, d=0.5)
     np.testing.assert_allclose(np.asarray(f._data),

@@ -75,6 +75,42 @@ def test_binary_and_multiary():
                                rtol=1e-6)
 
 
+def test_binary_ops_grads_flow_through_values():
+    """ADVICE r2: sparse.add/subtract/multiply/divide must route through
+    apply_op so d(out.values)/d(in.values) exists for BOTH operands."""
+    d1, _ = _rand_coo((5, 7), seed=1)
+    d2, _ = _rand_coo((5, 7), seed=2)
+
+    def _leaf_coo(d):
+        idx = np.stack(np.nonzero(d))
+        vals = paddle.to_tensor(d[tuple(idx)], stop_gradient=False)
+        return sparse.sparse_coo_tensor(idx, vals, d.shape)
+
+    x, y = _leaf_coo(d1), _leaf_coo(d2)
+    # forward parity on the union pattern
+    np.testing.assert_allclose(
+        np.asarray(sparse.add(x, y).to_dense()._data), d1 + d2, rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(sparse.multiply(x, y).to_dense()._data), d1 * d2,
+        rtol=1e-5, atol=1e-6)
+    out = sparse.add(x, y)
+    loss = (out.values() * out.values()).sum()
+    loss.backward()
+    # d/dvx sum((vx_at_union + vy_at_union)^2) = 2*(x+y) gathered at x's
+    # own nonzero positions
+    xi = np.stack(np.nonzero(d1))
+    want = 2.0 * (d1 + d2)[tuple(xi)]
+    np.testing.assert_allclose(np.asarray(x._vals_t.grad._data), want,
+                               rtol=1e-5, atol=1e-6)
+    assert y._vals_t.grad is not None
+    # multiply: product rule pulls the OTHER operand's values in
+    x2 = sparse.sparse_coo_tensor(xi, paddle.to_tensor(
+        d1[tuple(xi)], stop_gradient=False))
+    prod = sparse.multiply(x2, y)
+    prod.values().sum().backward()
+    assert x2._vals_t.grad is not None
+
+
 def test_subm_conv3d_matches_dense_conv_at_active_sites():
     N, D, H, W, C, Cout = 1, 5, 6, 5, 4, 3
     dense, x = _rand_coo((N, D, H, W), density=0.25, seed=3)

@@ -118,7 +118,12 @@ def test_lookahead_and_model_average():
     np.testing.assert_allclose(np.asarray(net.weight._data), w_train)
     sd = la.state_dict()
     la.set_state_dict(sd)
-    assert la.minimize(((net(x) - y) ** 2).mean()) == (None, None)
+    ops, params_grads = la.minimize(((net(x) - y) ** 2).mean())
+    assert ops == [] and len(params_grads) > 0
+    # reference contract: minimize does NOT clear grads
+    assert all(g is not None for _, g in params_grads)
+    assert net.weight.grad is not None
+    la.clear_grad()
 
 
 def test_hub_local_and_version():
