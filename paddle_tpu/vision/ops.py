@@ -1,7 +1,8 @@
 """Vision ops: nms, roi_align, box utils.
 
-Parity: reference `python/paddle/vision/ops.py` (subset: nms, roi_align,
-box_coder-adjacent utilities, deform_conv2d is a planned kernel).
+Parity: reference `python/paddle/vision/ops.py`: nms, roi_align,
+box_coder-adjacent utilities, deform_conv2d (gather-based bilinear
+sampling), distribute_fpn_proposals, generate_proposals, matrix_nms.
 """
 from __future__ import annotations
 

@@ -288,7 +288,7 @@ def test_sharded_checkpoint_roundtrip(tmp_path):
 
 # -------------------------------------------------------- collectives in-trace
 def test_collectives_inside_shard_map():
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import Mesh
     devs = np.asarray(jax.devices()[:4])
     mesh = Mesh(devs, ("data",))
