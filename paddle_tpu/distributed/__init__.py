@@ -19,7 +19,7 @@ from .collective import (  # noqa: F401
     Group, new_group, all_reduce, all_gather, all_gather_object, all_to_all,
     all_to_all_single, broadcast, reduce, scatter, reduce_scatter, send, recv,
     barrier, ReduceOp, is_available, get_backend, destroy_process_group,
-    stream, get_group, broadcast_object_list,
+    stream, get_group, broadcast_object_list, Task,
 )
 from .parallel import DataParallel  # noqa: F401
 

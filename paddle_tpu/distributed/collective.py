@@ -22,7 +22,53 @@ __all__ = ["Group", "new_group", "get_group", "all_reduce", "all_gather",
            "all_gather_object", "all_to_all", "all_to_all_single", "broadcast",
            "reduce", "scatter", "reduce_scatter", "send", "recv", "barrier",
            "ReduceOp", "is_available", "get_backend", "destroy_process_group",
-           "stream"]
+           "stream", "Task"]
+
+
+class Task:
+    """Async-collective handle (parity: the `task` object returned by every
+    reference collective — e.g. communication/stream/all_reduce.py:104 —
+    with .wait()/.is_completed()). On TPU the collective is an in-graph op
+    scheduled asynchronously by XLA/PJRT, so the `sync_op=False` contract
+    is honored truthfully: the returned buffers are async futures already,
+    wait() blocks until they are materialized. Inside a trace wait() is a
+    no-op (tracers have no buffers; ordering is the compiler's job)."""
+
+    def __init__(self, *tensors):
+        self._tensors = [t for t in tensors if t is not None]
+        self._waited = False
+
+    def _buffers(self):
+        for t in self._tensors:
+            d = getattr(t, "_data", t)
+            if isinstance(d, jax.core.Tracer):
+                continue
+            if hasattr(d, "block_until_ready"):
+                yield d
+
+    def wait(self, timeout=None):
+        for d in self._buffers():
+            d.block_until_ready()
+        self._waited = True
+        return True
+
+    def is_completed(self):
+        if self._waited:
+            return True
+        try:
+            return all(d.is_ready() for d in self._buffers())
+        except AttributeError:
+            return self._waited
+
+    def is_sync(self):
+        return self._waited
+
+
+def _task(sync_op, *tensors) -> Task:
+    t = Task(*tensors)
+    if sync_op:
+        t.wait()
+    return t
 
 
 class ReduceOp:
@@ -148,10 +194,10 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         tensor._grad_node = out._grad_node
         tensor._grad_out_idx = out._grad_out_idx
         tensor.stop_gradient = out.stop_gradient
-        return tensor
+        return _task(sync_op, tensor)
     _require_trace_or_world1("all_reduce", group)
     # single-rank group: identity
-    return tensor
+    return _task(sync_op, tensor)
 
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
@@ -164,11 +210,11 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
         parts = unbind(out, 0)
         tensor_list.clear()
         tensor_list.extend(parts)
-        return tensor_list
+        return _task(sync_op, *tensor_list)
     _require_trace_or_world1("all_gather", group)
     tensor_list.clear()
     tensor_list.append(tensor)
-    return tensor_list
+    return _task(sync_op, *tensor_list)
 
 
 def all_gather_object(object_list, obj, group=None):
@@ -189,11 +235,11 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
         parts = unbind(out, 0)
         out_tensor_list.clear()
         out_tensor_list.extend(parts)
-        return out_tensor_list
+        return _task(sync_op, *out_tensor_list)
     _require_trace_or_world1("all_to_all", group)
     out_tensor_list.clear()
     out_tensor_list.extend(in_tensor_list)
-    return out_tensor_list
+    return _task(sync_op, *out_tensor_list)
 
 
 def all_to_all_single(out_tensor, in_tensor, in_split_sizes=None,
@@ -207,16 +253,16 @@ def all_to_all_single(out_tensor, in_tensor, in_split_sizes=None,
                 x.reshape((n, x.shape[0] // n) + x.shape[1:]), ax,
                 split_axis=0, concat_axis=0, tiled=True), in_tensor)
         out_tensor._data = out._data.reshape(out_tensor._data.shape)
-        return out_tensor
+        return _task(sync_op, out_tensor)
     _require_trace_or_world1("all_to_all_single", group)
     out_tensor._data = in_tensor._data
-    return out_tensor
+    return _task(sync_op, out_tensor)
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
     # In-trace SPMD: all ranks compute identically; broadcast is a no-op on
     # replicated values. Cross-process eager: handled by checkpoint/init sync.
-    return tensor
+    return _task(sync_op, tensor)
 
 
 def broadcast_object_list(object_list, src=0, group=None):
@@ -235,11 +281,11 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
         idx = jax.lax.axis_index(ax)
         out = apply_op("scatter", lambda x: x[idx], stacked)
         tensor._data = out._data
-        return tensor
+        return _task(sync_op, tensor)
     _require_trace_or_world1("scatter", group)
     if tensor_list:
         tensor._data = tensor_list[src]._data
-    return tensor
+    return _task(sync_op, tensor)
 
 
 def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
@@ -252,14 +298,14 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
                        lambda x: jax.lax.psum_scatter(x, ax, scatter_dimension=0,
                                                       tiled=False), stacked)
         tensor._data = out._data
-        return tensor
+        return _task(sync_op, tensor)
     _require_trace_or_world1("reduce_scatter", group)
     if tensor_list:
         acc = tensor_list[0]._data
         for t in tensor_list[1:]:
             acc = acc + t._data
         tensor._data = acc
-    return tensor
+    return _task(sync_op, tensor)
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
@@ -278,17 +324,29 @@ def barrier(group=None):
     jnp.zeros(()).block_until_ready()
 
 
+def _stream_variant(fn):
+    """reference communication/stream/*.py signature: adds
+    use_calc_stream (calc-stream vs comm-stream is a CUDA scheduling
+    distinction; XLA owns scheduling here, so it only gates the eager
+    wait) and returns the Task."""
+    def wrapper(*args, sync_op=True, use_calc_stream=False, **kwargs):
+        return fn(*args, sync_op=sync_op or use_calc_stream, **kwargs)
+    wrapper.__name__ = fn.__name__
+    wrapper.__doc__ = fn.__doc__
+    return wrapper
+
+
 class _StreamNamespace:
-    """paddle.distributed.stream.* async variants — on TPU all collectives
-    are in-graph and asynchronously scheduled by XLA, so these alias the
-    sync API (sync_op is accepted and ignored)."""
-    all_reduce = staticmethod(all_reduce)
-    all_gather = staticmethod(all_gather)
-    all_to_all = staticmethod(all_to_all)
-    broadcast = staticmethod(broadcast)
-    reduce = staticmethod(reduce)
-    scatter = staticmethod(scatter)
-    reduce_scatter = staticmethod(reduce_scatter)
+    """paddle.distributed.stream.* variants — on TPU all collectives are
+    in-graph and asynchronously scheduled by XLA; these return the same
+    Task handles with the stream-API signature."""
+    all_reduce = staticmethod(_stream_variant(all_reduce))
+    all_gather = staticmethod(_stream_variant(all_gather))
+    all_to_all = staticmethod(_stream_variant(all_to_all))
+    broadcast = staticmethod(_stream_variant(broadcast))
+    reduce = staticmethod(_stream_variant(reduce))
+    scatter = staticmethod(_stream_variant(scatter))
+    reduce_scatter = staticmethod(_stream_variant(reduce_scatter))
 
 
 stream = _StreamNamespace()

@@ -52,8 +52,14 @@ _DEFAULTS = {
         "enable_offload": False,
     },
     "gradient_merge_configs": {"k_steps": 1, "avg": True},
-    "lars_configs": {}, "lamb_configs": {}, "dgc_configs": {},
-    "localsgd_configs": {}, "a_sync_configs": {},
+    # lars/localsgd are CONSUMED by HybridParallelOptimizer (lars swaps a
+    # Momentum inner optimizer for LarsMomentum; localsgd syncs params
+    # every k_steps); dgc raises NotImplementedError there.
+    "lars_configs": {"lars_coeff": 0.001, "lars_weight_decay": 0.0005,
+                     "epsilon": 0.0, "exclude_from_weight_decay": []},
+    "lamb_configs": {}, "dgc_configs": {},
+    "localsgd_configs": {"k_steps": 1, "begin_step": 1},
+    "a_sync_configs": {},
 }
 
 _FLAGS = {

@@ -7,11 +7,11 @@ Parity: reference `python/paddle/incubate/` — nn fused transformer layers
 from . import nn  # noqa: F401
 from . import asp  # noqa: F401
 from . import optimizer  # noqa: F401
-from .optimizer import LookAhead, ModelAverage  # noqa: F401
+from .optimizer import LookAhead, ModelAverage, LarsMomentum  # noqa: F401
 from .nn.functional import softmax_mask_fuse_upper_triangle  # noqa: F401
 
 __all__ = ["nn", "asp", "optimizer", "LookAhead", "ModelAverage",
-           "softmax_mask_fuse_upper_triangle"]
+           "LarsMomentum", "softmax_mask_fuse_upper_triangle"]
 
 
 # graph/segment surface (parity: incubate exports; the implementations

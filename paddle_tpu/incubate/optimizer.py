@@ -1,9 +1,11 @@
-"""incubate.optimizer — LookAhead and ModelAverage wrappers.
+"""incubate.optimizer — LookAhead, ModelAverage, LarsMomentum.
 
 Parity: reference `python/paddle/incubate/optimizer/lookahead.py`
 (LookAhead:24 — slow/fast weights, slow = slow + alpha*(fast - slow)
-every k steps) and `modelaverage.py` (ModelAverage — running parameter
-average applied for eval via apply()/restore()).
+every k steps), `modelaverage.py` (ModelAverage — running parameter
+average applied for eval via apply()/restore()), and
+`lars_momentum.py` + `phi/kernels/cpu/lars_momentum_kernel.cc:66-73`
+(LARS trust-ratio local learning rate).
 
 TPU-native: the slow/average buffers are device arrays updated by the
 same jnp expressions the inner optimizer uses; everything stays on device
@@ -12,7 +14,69 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-__all__ = ["LookAhead", "ModelAverage"]
+from ..optimizer.optimizer import Optimizer
+
+__all__ = ["LookAhead", "ModelAverage", "LarsMomentum",
+           "LarsMomentumOptimizer"]
+
+
+class LarsMomentum(Optimizer):
+    """Momentum with LARS layer-wise trust-ratio learning rates.
+
+    Update (parity: reference lars_momentum_kernel.cc:66-73):
+        local_lr = lr                                  # default
+        if lars_weight_decay > 0 and |p| > 0 and |g| > 0:
+            local_lr = lr * lars_coeff * |p|
+                       / (|g| + lars_weight_decay * |p| + epsilon)
+        v = mu * v + local_lr * (g + lars_weight_decay * p)
+        p = p - v
+
+    `exclude_from_weight_decay` is a list of name substrings whose
+    parameters use lars_weight_decay = 0 (and hence plain momentum),
+    matching LarsMomentumOptimizer (incubate/optimizer/lars_momentum.py).
+    """
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 lars_coeff=0.001, lars_weight_decay=0.0005, epsilon=0.0,
+                 exclude_from_weight_decay=None, grad_clip=None,
+                 multi_precision=False, rescale_grad=1.0, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         name, multi_precision)
+        self._momentum = float(momentum)
+        self._lars_coeff = float(lars_coeff)
+        self._lars_weight_decay = float(lars_weight_decay)
+        self._eps = float(epsilon)
+        self._rescale_grad = float(rescale_grad)
+        self._exclude = list(exclude_from_weight_decay or [])
+
+    def _wd_for(self, p):
+        name = getattr(p, "name", "") or ""
+        if any(token in name for token in self._exclude):
+            return 0.0
+        return self._lars_weight_decay
+
+    def _apply_one(self, idx, p, g, lr):
+        m = self._master(idx, p)
+        g = g.astype(m.dtype) * self._rescale_grad
+        wd = self._wd_for(p)
+        p_norm = jnp.sqrt(jnp.sum(m.astype(jnp.float32) ** 2))
+        g_norm = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
+        if wd > 0:
+            trust = jnp.where(
+                (p_norm > 0) & (g_norm > 0),
+                self._lars_coeff * p_norm
+                / (g_norm + wd * p_norm + self._eps),
+                1.0).astype(m.dtype)
+        else:
+            trust = 1.0
+        vel = self._acc("velocity", idx, m)
+        vel = self._momentum * vel + (lr * trust) * (g + wd * m)
+        self._set_acc("velocity", idx, vel)
+        self._writeback(idx, p, m - vel)
+
+
+# reference class name (python/paddle/incubate/optimizer/lars_momentum.py)
+LarsMomentumOptimizer = LarsMomentum
 
 
 class LookAhead:

@@ -286,6 +286,36 @@ def test_sharded_checkpoint_roundtrip(tmp_path):
     np.testing.assert_allclose(np.asarray(sd2["b"]._data), [0, 1, 2, 3])
 
 
+def test_async_collective_task_contract():
+    """VERDICT r2 #8: sync_op=False returns a Task with wait()/
+    is_completed(); stream.* variants accept use_calc_stream."""
+    t = paddle.to_tensor([1.0, 2.0])
+    task = dist.all_reduce(t, sync_op=False)
+    assert isinstance(task, dist.Task)
+    assert task.wait() is True and task.is_completed()
+    out = []
+    task = dist.all_gather(out, t, sync_op=False)
+    assert len(out) == 1
+    task.wait()
+    task = dist.stream.all_reduce(t, sync_op=False, use_calc_stream=True)
+    assert task.is_completed()          # use_calc_stream forces the wait
+    # in-trace: collectives still return Task, wait() is a no-op on tracers
+    from jax import shard_map
+    from jax.sharding import Mesh
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("data",))
+    g = dist.new_group(list(range(4)), axis_name="data")
+
+    def fn(x):
+        tt = paddle.Tensor(x)
+        tk = dist.all_reduce(tt, group=g, sync_op=False)
+        tk.wait()
+        return tt._data
+
+    mapped = shard_map(fn, mesh=mesh, in_specs=P("data"), out_specs=P("data"))
+    np.testing.assert_allclose(np.asarray(mapped(jnp.arange(4.0))),
+                               np.full(4, 6.0))
+
+
 # -------------------------------------------------------- collectives in-trace
 def test_collectives_inside_shard_map():
     from jax import shard_map
@@ -496,6 +526,96 @@ def test_gradient_merge_strategy():
     loss2.backward(); opt2.step()
     np.testing.assert_allclose(np.asarray(net.weight._data),
                                np.asarray(net2.weight._data), rtol=1e-5)
+
+
+def test_lars_strategy_changes_update_rule():
+    """VERDICT r2 #7: strategy.lars=True must CHANGE the update —
+    verified against a hand-computed LARS trust ratio."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    paddle.seed(0)
+    strategy = fleet.DistributedStrategy()
+    strategy.lars = True
+    strategy.lars_configs = {"lars_coeff": 0.01, "lars_weight_decay": 0.05,
+                             "epsilon": 0.0}
+    fleet.init(is_collective=True, strategy=strategy)
+    net = paddle.nn.Linear(4, 2)
+    w0 = np.asarray(net.weight._data).copy().astype(np.float64)
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.Momentum(0.1, momentum=0.9,
+                                  parameters=net.parameters()), strategy)
+    from paddle_tpu.incubate.optimizer import LarsMomentum
+    assert isinstance(opt._inner_opt, LarsMomentum)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(8, 4).astype(np.float32))
+    y = paddle.to_tensor(np.random.RandomState(1).randn(8, 2).astype(np.float32))
+    loss = ((net(x) - y) ** 2).mean()
+    loss.backward()
+    g = np.asarray(net.weight.grad._data).astype(np.float64)
+    opt.step()
+    # hand-computed first step: v=0 ->
+    # local_lr = lr * coeff * |w| / (|g| + wd*|w|); v = local_lr*(g+wd*w)
+    pn, gn = np.linalg.norm(w0), np.linalg.norm(g)
+    local_lr = 0.1 * 0.01 * pn / (gn + 0.05 * pn)
+    want = w0 - local_lr * (g + 0.05 * w0)
+    np.testing.assert_allclose(np.asarray(net.weight._data), want,
+                               rtol=1e-5, atol=1e-6)
+    # and it differs from what plain Momentum would have done
+    assert not np.allclose(want, w0 - 0.1 * g)
+
+
+def test_dgc_strategy_raises():
+    """dgc=True must hard-error, not silently no-op (VERDICT r2 #7)."""
+    import pytest as _pytest
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    strategy = fleet.DistributedStrategy()
+    strategy.dgc = True
+    fleet.init(is_collective=True, strategy=strategy)
+    net = paddle.nn.Linear(2, 2)
+    with _pytest.raises(NotImplementedError, match="dgc"):
+        fleet.distributed_optimizer(
+            paddle.optimizer.Momentum(0.1, parameters=net.parameters()),
+            strategy)
+
+
+def test_lars_requires_momentum_inner():
+    import pytest as _pytest
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    strategy = fleet.DistributedStrategy()
+    strategy.lars = True
+    fleet.init(is_collective=True, strategy=strategy)
+    net = paddle.nn.Linear(2, 2)
+    with _pytest.raises(TypeError, match="Momentum"):
+        fleet.distributed_optimizer(
+            paddle.optimizer.Adam(0.1, parameters=net.parameters()),
+            strategy)
+
+
+def test_localsgd_sync_schedule():
+    """localsgd: param sync fires every k_steps after begin_step; on a
+    1-rank data group the sync is the identity (values unchanged)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    paddle.seed(0)
+    strategy = fleet.DistributedStrategy()
+    strategy.localsgd = True
+    strategy.localsgd_configs = {"k_steps": 2, "begin_step": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    net = paddle.nn.Linear(4, 2)
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.SGD(0.05, parameters=net.parameters()), strategy)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(8, 4).astype(np.float32))
+    y = paddle.to_tensor(np.random.RandomState(1).randn(8, 2).astype(np.float32))
+    losses = []
+    for i in range(4):
+        loss = ((net(x) - y) ** 2).mean()
+        loss.backward(); opt.step(); opt.clear_grad()
+        losses.append(float(np.asarray(loss._data)))
+    assert opt._ls_synced == 2          # steps 2 and 4
+    assert losses[-1] < losses[0]       # training still converges
 
 
 def test_dp_sharded_batched_generation():
