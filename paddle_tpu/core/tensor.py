@@ -43,6 +43,8 @@ class Tensor:
         "process_mesh",
         "placements",
         "_spec",
+        "_spmd_spec",  # placement inferred by the SPMD rule registry
+                       # (auto_parallel/propagation.py)
         "_lr_scale",
         "_asp_mask",   # incubate.asp 2:4 sparsity mask (travels with the
                        # parameter through deepcopy, unlike an id registry)

@@ -6,3 +6,4 @@ from .api import (  # noqa: F401
 )
 
 from . import spmd_rules  # noqa: F401
+from .propagation import spmd_propagation, propagation_mesh  # noqa: F401

@@ -28,6 +28,7 @@ def _is_tensor(x):
 
 
 _profiler_mod = None
+_spmd_prop = None
 
 
 def apply_op(name: str, fn: Callable, *args, **kwargs):
@@ -111,6 +112,15 @@ def _apply_op(name: str, fn: Callable, *args, **kwargs):
             t._grad_node = node
             t._grad_out_idx = idx
         out_tensors.append(t)
+    # SPMD rule propagation hook (parity: InferSpmd step of the generated
+    # dist branch, dist_api_gen.py:49-110) — active only inside a
+    # spmd_propagation(mesh) scope; one dict lookup otherwise.
+    global _spmd_prop
+    if _spmd_prop is None:
+        from ..distributed.auto_parallel import propagation as _sp
+        _spmd_prop = _sp
+    if _spmd_prop._STATE["mesh"] is not None:
+        _spmd_prop.maybe_constrain(name, tensors, out_tensors, kwargs)
     return jax.tree_util.tree_unflatten(out_treedef, out_tensors)
 
 
