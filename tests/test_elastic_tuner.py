@@ -62,6 +62,67 @@ def test_tuner_search_and_best(tmp_path):
     assert len(t2.history_cfgs) == 3
 
 
+def test_tuner_measure_loop_picks_measured_fastest():
+    """VERDICT r2 #9: the tuner must pick a config because it MEASURED it
+    fastest — fake measurements invert the model's ranking and the
+    winner follows the measurements, not the model."""
+    tuner = AutoTuner(dict(TUNER_CFG, candidates=[
+        {"dp_degree": 8, "mp_degree": 1, "pp_degree": 1,
+         "sharding_degree": 1, "micro_batch_size": 1},
+        {"dp_degree": 4, "mp_degree": 2, "pp_degree": 1,
+         "sharding_degree": 1, "micro_batch_size": 1},
+        {"dp_degree": 2, "mp_degree": 4, "pp_degree": 1,
+         "sharding_degree": 1, "micro_batch_size": 1},
+    ]))
+    # model ranks dp8 first (no mp penalty) — fake measurements disagree
+    ran = []
+
+    def fake_trial(tuner_cfg, cfg):
+        ran.append(cfg["mp_degree"])
+        t = {1: 9.0, 2: 3.0, 4: 6.0}[cfg["mp_degree"]]
+        return {"time": t, "max_mem_usage": 1 << 20, "measured": True}
+
+    best = tuner.tune(trial_fn=fake_trial)
+    assert len(ran) == 3                      # the measurement path ran
+    assert best["mp_degree"] == 2             # measured winner, not modeled
+    assert tuner.candidates[0]["mp_degree"] == 1  # model preferred dp8
+    assert all(h.get("measured") for h in tuner.history_cfgs)
+
+
+def test_tuner_measures_on_live_mesh():
+    """The default trial runner really times a sharded step on the
+    8-device CPU mesh and reads the memory-stats API."""
+    tuner = AutoTuner(dict(TUNER_CFG, candidates=[
+        {"dp_degree": 8, "mp_degree": 1, "pp_degree": 1,
+         "sharding_degree": 1, "micro_batch_size": 1},
+        {"dp_degree": 2, "mp_degree": 2, "pp_degree": 2,
+         "sharding_degree": 1, "micro_batch_size": 1},
+    ]))
+    best = tuner.tune(max_trials=2)
+    assert best is not None and best["time"] > 0
+    measured = [h for h in tuner.history_cfgs if h.get("measured")]
+    assert len(measured) == 2
+    assert all(isinstance(h["max_mem_usage"], int) for h in measured)
+
+
+def test_tuner_predicts_oom_from_memory_budget():
+    """Candidates whose modeled memory exceeds the per-chip budget are
+    recorded as predicted OOM without launching."""
+    big_model = dict(TUNER_CFG, max_mem_per_chip_gb=0.0001, candidates=[
+        {"dp_degree": 8, "mp_degree": 1, "pp_degree": 1,
+         "sharding_degree": 1, "micro_batch_size": 1}])
+    # constructor prunes over-budget candidates already; bypass it to
+    # exercise the tune()-time prediction path
+    tuner = AutoTuner(dict(big_model, max_mem_per_chip_gb=None))
+    tuner.tuner_cfg["max_mem_per_chip_gb"] = 0.0001
+    launched = []
+    best = tuner.tune(trial_fn=lambda tc, c: launched.append(c) or
+                      {"time": 1.0, "max_mem_usage": 1})
+    assert launched == []                     # never launched
+    assert best is None
+    assert all(h.get("oom_predicted") for h in tuner.history_cfgs)
+
+
 def test_memory_model_monotone_in_sharding():
     base = {"dp_degree": 8, "mp_degree": 1, "pp_degree": 1,
             "micro_batch_size": 1, "sharding_degree": 1}
