@@ -1,0 +1,331 @@
+"""Op-level microbenchmarks: prove (or disprove) the XLA-fusion story.
+
+VERDICT r2 #2: the A.2 fused-kernel backlog (fused_rope, rms_norm,
+swiglu, fused_dropout_add, gemm epilogue — reference
+`paddle/phi/kernels/fusion/gpu/`) was covered by "XLA will fuse it" with
+zero measurements. This harness measures, on the live chip:
+
+  - Pallas flash attention vs an XLA-composed SDPA (fwd and fwd+bwd)
+  - the elementwise/fusion pack (rms_norm[+residual], rope, swiglu,
+    fused_dropout_add, bias+gelu epilogue) as achieved HBM bandwidth vs
+    the device roofline — a memory-bound op whose XLA composition runs
+    near the roofline needs no hand-written kernel (>10% gap = Pallas
+    candidate, per the round-3 plan)
+  - paged-KV decode attention GB/s vs HBM peak
+  - int8 weight-only dequant matmul vs bf16 matmul in the decode regime
+
+Usage: python bench_ops.py [--write-md] [--quick]
+Prints one JSON line per benchmark; --write-md also rewrites
+BENCH_OPS.md. Never exits non-zero; a watchdog prints partial results if
+the transport wedges (same rationale as bench.py).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+RESULTS = []
+DEADLINE_S = int(os.environ.get("BENCH_OPS_DEADLINE_S", "600"))
+
+# per-chip rooflines (bf16 FLOP/s, HBM bytes/s)
+PEAKS = {
+    "v5e": (197e12, 819e9), "v5 lite": (197e12, 819e9),
+    "v5p": (459e12, 2765e9),
+    "v4": (275e12, 1228e9),
+    "v6e": (918e12, 1640e9), "trillium": (918e12, 1640e9),
+    "cpu": (1e12, 100e9),
+}
+
+
+def _peaks(device_kind):
+    kind = device_kind.lower()
+    for k, v in PEAKS.items():
+        if k in kind:
+            return v
+    return PEAKS["v5e"]
+
+
+def _watchdog():
+    time.sleep(DEADLINE_S)
+    _emit_all(error="bench_ops watchdog fired (transport wedged?)")
+    os._exit(0)
+
+
+def _emit_all(error=None):
+    for r in RESULTS:
+        print(json.dumps(r), flush=True)
+    if error:
+        print(json.dumps({"bench": "__status__", "error": error}), flush=True)
+
+
+def _time_it(fn, *args, iters=10):
+    import jax
+    out = fn(*args)
+    jax.block_until_ready(out)
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def _record(name, variant, shape, dt, flops=None, bytes_moved=None,
+            device_kind="?"):
+    fpeak, bpeak = _peaks(device_kind)
+    rec = {"bench": name, "variant": variant, "shape": shape,
+           "ms": round(dt * 1e3, 4), "device": device_kind}
+    if flops:
+        rec["tflops"] = round(flops / dt / 1e12, 2)
+        rec["mfu"] = round(flops / dt / fpeak, 4)
+    if bytes_moved:
+        rec["gbps"] = round(bytes_moved / dt / 1e9, 1)
+        rec["hbm_frac"] = round(bytes_moved / dt / bpeak, 4)
+    RESULTS.append(rec)
+    return rec
+
+
+# ---------------------------------------------------------------- benches
+def bench_flash_vs_sdpa(dev, quick):
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.kernels.flash_attention import flash_attention_bshd
+
+    def xla_sdpa(q, k, v):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, v.dtype.type(1) * k) \
+            * (1.0 / np.sqrt(q.shape[-1]))
+        mask = jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool))
+        s = jnp.where(mask, s, -1e9)
+        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+    if dev == "cpu":          # interpret-mode Pallas: harness check only
+        shapes = [(1, 256, 2, 64)]
+    elif quick:
+        shapes = [(4, 2048, 16, 64)]
+    else:
+        shapes = [(4, 2048, 16, 64), (1, 8192, 16, 64)]
+    for B, S, H, D in shapes:
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.randn(B, S, H, D), jnp.bfloat16)
+        k = jnp.asarray(rng.randn(B, S, H, D), jnp.bfloat16)
+        v = jnp.asarray(rng.randn(B, S, H, D), jnp.bfloat16)
+        flops_fwd = 4.0 * B * H * S * S * D * 0.5  # causal halves the work
+        flash = jax.jit(lambda q, k, v: flash_attention_bshd(
+            q, k, v, causal=True))
+        sdpa = jax.jit(xla_sdpa)
+        for variant, fn in [("pallas_flash", flash), ("xla_sdpa", sdpa)]:
+            dt = _time_it(fn, q, k, v)
+            _record("attention_fwd", variant, f"b{B}s{S}h{H}d{D}", dt,
+                    flops=flops_fwd, device_kind=dev)
+        # fwd+bwd
+        for variant, fn in [("pallas_flash", flash), ("xla_sdpa", sdpa)]:
+            g = jax.jit(jax.grad(lambda q, k, v: fn(q, k, v).astype(
+                jnp.float32).sum(), argnums=(0, 1, 2)))
+            dt = _time_it(g, q, k, v)
+            _record("attention_fwdbwd", variant, f"b{B}s{S}h{H}d{D}", dt,
+                    flops=flops_fwd * 3.5, device_kind=dev)
+
+
+def bench_fusion_pack(dev, quick):
+    """The A.2 backlog as roofline fractions: each op is memory-bound;
+    bytes = reads + writes of the major arrays (bf16)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.incubate.nn.functional import (
+        fused_rms_norm, fused_rotary_position_embedding, swiglu,
+        fused_dropout_add)
+
+    if dev == "cpu":
+        B, S, Hd = (1, 256, 512)
+    else:
+        B, S, Hd = (4, 2048, 4096) if quick else (8, 4096, 4096)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(B, S, Hd), jnp.bfloat16)
+    res = jnp.asarray(rng.randn(B, S, Hd), jnp.bfloat16)
+    w = jnp.asarray(rng.randn(Hd), jnp.bfloat16)
+    nbytes = x.size * 2
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+
+    def t(a):
+        return Tensor(a)
+
+    rms = jax.jit(lambda a: fused_rms_norm(t(a), t(w))[0]._data)
+    _record("rms_norm", "xla_fused", f"{B}x{S}x{Hd}",
+            _time_it(rms, x), bytes_moved=2 * nbytes, device_kind=dev)
+
+    rms_res = jax.jit(
+        lambda a, r: fused_rms_norm(t(a), t(w), residual=t(r))[0]._data)
+    _record("rms_norm_residual", "xla_fused", f"{B}x{S}x{Hd}",
+            _time_it(rms_res, x, res), bytes_moved=3 * nbytes,
+            device_kind=dev)
+
+    # rope on (B, S, H, D)
+    H, D = (4, 64) if dev == "cpu" else (32, 128)
+    qk = jnp.asarray(rng.randn(B, S, H, D), jnp.bfloat16)
+    pos = jnp.arange(S)
+    inv = 1.0 / (10000 ** (jnp.arange(0, D, 2) / D))
+    ang = pos[:, None] * inv[None, :]
+    sin = jnp.sin(ang).astype(jnp.bfloat16)[None, :, None, :]
+    cos = jnp.cos(ang).astype(jnp.bfloat16)[None, :, None, :]
+    def _rope_call(a):
+        out = fused_rotary_position_embedding(t(a), sin=t(sin), cos=t(cos))
+        return (out[0] if isinstance(out, (tuple, list)) else out)._data
+
+    rope = jax.jit(_rope_call)
+    _record("rope", "xla_fused", f"{B}x{S}x{H}x{D}",
+            _time_it(rope, qk), bytes_moved=2 * qk.size * 2,
+            device_kind=dev)
+
+    inter = 512 if dev == "cpu" else (11008 if not quick else 4096)
+    g1 = jnp.asarray(rng.randn(B * S // 4, inter), jnp.bfloat16)
+    g2 = jnp.asarray(rng.randn(B * S // 4, inter), jnp.bfloat16)
+    sw = jax.jit(lambda a, b: swiglu(t(a), t(b))._data)
+    _record("swiglu", "xla_fused", f"{B * S // 4}x{inter}",
+            _time_it(sw, g1, g2), bytes_moved=3 * g1.size * 2,
+            device_kind=dev)
+
+    da = jax.jit(lambda a, b: fused_dropout_add(t(a), t(b), p=0.0,
+                                                training=False)._data)
+    _record("dropout_add", "xla_fused", f"{B}x{S}x{Hd}",
+            _time_it(da, x, res), bytes_moved=3 * nbytes, device_kind=dev)
+
+    # gemm epilogue: matmul + bias + gelu fused by XLA — compute-bound
+    if dev == "cpu":
+        M, K, N = (256, 256, 256)
+    else:
+        M, K, N = (4096, 4096, 4096) if not quick else (2048, 2048, 2048)
+    a = jnp.asarray(rng.randn(M, K), jnp.bfloat16)
+    wt = jnp.asarray(rng.randn(K, N), jnp.bfloat16)
+    bias = jnp.asarray(rng.randn(N), jnp.bfloat16)
+    ep = jax.jit(lambda a, w_, b_: jax.nn.gelu(a @ w_ + b_))
+    plain = jax.jit(lambda a, w_: a @ w_)
+    dt_ep = _time_it(ep, a, wt, bias)
+    dt_pl = _time_it(plain, a, wt)
+    _record("gemm_epilogue", "matmul_bias_gelu", f"{M}x{K}x{N}", dt_ep,
+            flops=2.0 * M * K * N, device_kind=dev)
+    _record("gemm_epilogue", "matmul_only", f"{M}x{K}x{N}", dt_pl,
+            flops=2.0 * M * K * N, device_kind=dev)
+    RESULTS.append({"bench": "gemm_epilogue", "variant": "overhead_pct",
+                    "value": round(100 * (dt_ep - dt_pl) / dt_pl, 2),
+                    "device": dev})
+
+
+def bench_paged_decode(dev, quick):
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.kernels.paged_attention import (
+        alloc_paged_cache, paged_attention_decode)
+
+    if dev == "cpu":
+        B, KVH, H, D = 2, 2, 4, 64
+        page, S = 16, 64
+    else:
+        B, KVH, H, D = 16, 8, 32, 128
+        page, S = 16, 1024 if quick else 2048
+    pages_per_seq = S // page
+    num_pages = B * pages_per_seq
+    k_cache, v_cache = alloc_paged_cache(KVH, num_pages, page, D,
+                                         dtype=jnp.bfloat16)
+    rng = np.random.RandomState(0)
+    k_cache = jnp.asarray(rng.randn(*k_cache.shape), jnp.bfloat16)
+    v_cache = jnp.asarray(rng.randn(*v_cache.shape), jnp.bfloat16)
+    bt = jnp.arange(num_pages, dtype=jnp.int32).reshape(B, pages_per_seq)
+    sl = jnp.full((B,), S, jnp.int32)
+    q = jnp.asarray(rng.randn(B, H, D), jnp.bfloat16)
+    fn = jax.jit(lambda q, kc, vc: paged_attention_decode(
+        q, kc, vc, bt, sl))
+    dt = _time_it(fn, q, k_cache, v_cache)
+    kv_bytes = 2 * B * S * KVH * D * 2  # K and V, bf16
+    _record("paged_decode", "pallas", f"b{B}s{S}kvh{KVH}h{H}d{D}", dt,
+            bytes_moved=kv_bytes, device_kind=dev)
+
+
+def bench_int8_matmul(dev, quick):
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.nn.quant import weight_quantize, weight_only_linear
+    import paddle_tpu as paddle
+
+    K, N, M = (256, 256, 8) if dev == "cpu" else (4096, 4096, 32)
+    rng = np.random.RandomState(0)
+    w = paddle.to_tensor(rng.randn(K, N).astype(np.float32) * 0.02)
+    qw, scale = weight_quantize(w, algo="weight_only_int8")
+    x = paddle.to_tensor(rng.randn(M, K).astype(np.float32))
+    x_bf = x._data.astype(jnp.bfloat16)
+    w_bf = w._data.astype(jnp.bfloat16)
+
+    int8 = jax.jit(lambda xa: weight_only_linear(
+        paddle.Tensor(xa), qw, weight_scale=scale,
+        weight_dtype="int8")._data)
+    bf16 = jax.jit(lambda xa: xa @ w_bf)
+    dt_i8 = _time_it(int8, x_bf)
+    dt_bf = _time_it(bf16, x_bf)
+    _record("weight_only_matmul", "int8", f"{M}x{K}x{N}", dt_i8,
+            bytes_moved=K * N, device_kind=dev)
+    _record("weight_only_matmul", "bf16", f"{M}x{K}x{N}", dt_bf,
+            bytes_moved=K * N * 2, device_kind=dev)
+
+
+BENCHES = [bench_flash_vs_sdpa, bench_fusion_pack, bench_paged_decode,
+           bench_int8_matmul]
+
+
+def write_md(path="BENCH_OPS.md"):
+    dev = next((r.get("device") for r in RESULTS if r.get("device")), "?")
+    lines = [
+        "# Op microbenchmarks (bench_ops.py)", "",
+        f"Device: **{dev}**. Roofline fractions use bf16 peak FLOP/s and "
+        "HBM peak bytes/s for the chip; `hbm_frac` near 1.0 means the "
+        "XLA-fused composition saturates memory bandwidth and needs no "
+        "hand-written kernel (>10% gap = Pallas candidate).", "",
+        "| bench | variant | shape | ms | TFLOP/s | MFU | GB/s | HBM frac |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for r in RESULTS:
+        if r.get("bench") == "__status__" or "ms" not in r:
+            continue
+        lines.append(
+            f"| {r['bench']} | {r['variant']} | {r.get('shape','')} "
+            f"| {r['ms']} | {r.get('tflops','')} | {r.get('mfu','')} "
+            f"| {r.get('gbps','')} | {r.get('hbm_frac','')} |")
+    extra = [r for r in RESULTS if "value" in r]
+    if extra:
+        lines.append("")
+        for r in extra:
+            lines.append(f"- {r['bench']}/{r['variant']}: {r['value']}")
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+def main():
+    threading.Thread(target=_watchdog, daemon=True).start()
+    quick = "--quick" in sys.argv
+    try:
+        import jax
+        dev = getattr(jax.devices()[0], "device_kind",
+                      jax.devices()[0].platform)
+    except Exception as e:
+        _emit_all(error=f"device init failed: {e!r}")
+        return
+    for bench in BENCHES:
+        try:
+            bench(dev, quick)
+        except Exception as e:
+            RESULTS.append({"bench": bench.__name__,
+                            "error": repr(e)[:300]})
+    _emit_all()
+    if "--write-md" in sys.argv:
+        write_md()
+
+
+if __name__ == "__main__":
+    main()
