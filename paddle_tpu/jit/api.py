@@ -208,6 +208,12 @@ class TracedFunction:
     def __call__(self, *args, **kwargs):
         if not _to_static_enabled:
             return self._orig_fn(*args, **kwargs)   # jit globally disabled
+        if getattr(self._callable, "_not_to_static", False) or \
+                getattr(self._orig_fn, "_not_to_static", False):
+            # @not_to_static: the function opted out of capture — run it
+            # eagerly (the whole-function subset of the reference's
+            # call-site graph break, jit/api.py not_to_static)
+            return self._callable(*args, **kwargs)
         leaves, treedef = jax.tree_util.tree_flatten((args, kwargs),
                                                      is_leaf=_is_tensor)
         tensor_arrays = []

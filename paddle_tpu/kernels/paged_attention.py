@@ -42,7 +42,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .flash_attention import _interpret_mode
 
-__all__ = ["paged_attention_decode", "paged_cache_write", "alloc_paged_cache"]
+__all__ = ["paged_attention_decode", "paged_cache_write", "alloc_paged_cache",
+           "check_supported_paged", "paged_blockspecs"]
 
 NEG_INF = np.float32(-1e30)
 _STATS_LANES = 128
@@ -102,6 +103,44 @@ def _decode_kernel(bt_ref, sl_ref, q_ref, k_ref, v_ref, o_ref,
             o_ref[0, h] = (acc_ref[h] / l).astype(o_ref.dtype)
 
 
+def check_supported_paged(q_shape, cache_shape, dtype):
+    """Static shape validation mirroring what Mosaic will accept — raise
+    here (with a clear message) instead of deep inside lowering. Same
+    role as flash_attention.check_supported; the legality test suite
+    (tests/test_paged_blockspec_legality.py) sweeps this + the exact
+    BlockSpecs below, because interpret=True on CPU hides all Mosaic
+    tiling violations (round-1 lesson)."""
+    B, H, D = q_shape
+    num_pages, KVH, page_size, Dc = cache_shape
+    if D != Dc:
+        raise ValueError(f"q head_dim {D} != cache head_dim {Dc}")
+    if H % KVH != 0:
+        raise ValueError(f"H={H} not a multiple of KVH={KVH}")
+    if D % 64 != 0 or D > 256:
+        raise ValueError(f"head_dim {D} unsupported (need multiple of 64, "
+                         "<= 256)")
+    if page_size % 8 != 0:
+        raise ValueError(f"page_size {page_size} must be a multiple of 8 "
+                         "(sublane tiling)")
+    if str(dtype) not in ("bfloat16", "float32"):
+        raise ValueError(f"unsupported dtype {dtype}")
+
+
+def paged_blockspecs(B, H, KVH, D, page_size, num_pages, max_pages):
+    """The exact (block_shape, array_shape) pairs the pallas_call below
+    constructs, plus the VMEM scratch shapes — enumerable for the static
+    legality test without running the kernel."""
+    G = H // KVH
+    specs = [
+        ((1, KVH, G, D), (B, KVH, G, D)),                 # q block
+        ((1, KVH, page_size, D), (num_pages, KVH, page_size, D)),  # k
+        ((1, KVH, page_size, D), (num_pages, KVH, page_size, D)),  # v
+        ((1, KVH, G, D), (B, KVH, G, D)),                 # out block
+    ]
+    scratch = [(KVH, G, D), (KVH, G, _STATS_LANES), (KVH, G, _STATS_LANES)]
+    return specs, scratch
+
+
 def paged_attention_decode(q, k_cache, v_cache, block_tables, seq_lens,
                            sm_scale=None):
     """One decode step of attention over a paged KV cache.
@@ -119,8 +158,7 @@ def paged_attention_decode(q, k_cache, v_cache, block_tables, seq_lens,
     B, H, D = q.shape
     num_pages, KVH, page_size, _ = k_cache.shape
     max_pages = block_tables.shape[1]
-    if H % KVH != 0:
-        raise ValueError(f"H={H} not a multiple of KVH={KVH}")
+    check_supported_paged(q.shape, k_cache.shape, q.dtype)
     G = H // KVH
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(D)

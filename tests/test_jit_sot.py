@@ -119,6 +119,28 @@ def test_full_graph_true_raises_clear_error():
         step(x, y)
 
 
+def test_not_to_static_runs_eagerly():
+    """@not_to_static opts a function out of capture entirely — even a
+    data-dependent if works with no warning and no compile."""
+    calls = []
+
+    @paddle.jit.not_to_static
+    def fn(x):
+        calls.append(1)
+        if float(x.sum()._data) > 0:     # would break under tracing
+            return x * 2
+        return x
+
+    traced = paddle.jit.to_static(fn)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        out = traced(paddle.to_tensor(np.ones(4, np.float32)))
+    assert not any("graph break" in str(w.message) for w in caught)
+    assert len(traced._cache) == 0       # never attempted a trace
+    np.testing.assert_allclose(np.asarray(out._data), 2 * np.ones(4))
+    assert calls == [1]
+
+
 def test_shape_dependent_break_also_falls_back():
     """int(tensor) used as a shape — TracerIntegerConversionError path."""
     paddle.seed(0)
