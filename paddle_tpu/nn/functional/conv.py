@@ -105,8 +105,8 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding,
         if groups == 1:
             out = jax.lax.conv_general_dilated(
                 a, w, window_strides=(1,) * n, padding=pads,
-                lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn,
-                transpose_kernel=False)
+                lhs_dilation=strides, rhs_dilation=dil,
+                dimension_numbers=dn)
         else:
             # grouped transpose conv: split along channel axis
             ch_ax = a.ndim - 1 if channel_last else 1

@@ -622,3 +622,9 @@ class IpuCompiledProgram:
 
 def set_ipu_shard(call_func, index=-1, stage=-1):
     raise NotImplementedError("IPU backends are not part of the TPU build")
+
+
+# placed last: static.nn's module body only needs core/ops; its uses of
+# global_scope/create_parameter are lazy (inside the layer builders)
+from . import nn  # noqa: F401,E402
+__all__.append("nn")

@@ -1,0 +1,587 @@
+"""paddle.static.nn — control flow + static-style layer builders.
+
+Parity: reference `python/paddle/static/nn/__init__.py` (__all__ of 31
+names: control_flow.py cond/case/switch_case/while_loop/static_pylayer,
+common.py fc/embedding/conv*/norms/nce/row_conv/sequence_lod.py ops).
+
+TPU-native semantics:
+
+* Control flow is the real payload — these are the primitives dy2static
+  rewrites python `if`/`while` into (reference
+  dy2static/convert_operators.py). With a CONCRETE predicate they run
+  the chosen branch eagerly (reference dygraph behavior). With a traced
+  predicate (inside to_static) `cond`/`case`/`switch_case` execute every
+  branch and select elementwise — gradients flow through the tape to
+  both branches, and XLA dead-codes the unselected side where it can;
+  `while_loop` lowers to `lax.while_loop` (forward-only under trace,
+  like the reference's grad-restricted static While).
+* Layer builders create their parameters inline (the static-graph
+  convention); a `name=` reuses the parameter across rebuilds via the
+  global scope, unnamed calls create fresh parameters.
+* Sequence ops operate on padded (B, T, ...) tensors with an optional
+  `seq_lens` in place of LoD.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops.dispatch import apply_op
+
+__all__ = [
+    "fc", "batch_norm", "bilinear_tensor_product", "embedding", "case",
+    "cond", "static_pylayer", "conv2d", "conv2d_transpose", "conv3d",
+    "conv3d_transpose", "data_norm", "deform_conv2d", "group_norm",
+    "instance_norm", "layer_norm", "nce", "prelu", "py_func", "row_conv",
+    "spectral_norm", "switch_case", "while_loop", "sparse_embedding",
+    "sequence_conv", "sequence_softmax", "sequence_pool",
+    "sequence_first_step", "sequence_last_step", "sequence_expand",
+]
+
+
+def _is_tracer(x):
+    d = getattr(x, "_data", x)
+    return isinstance(d, jax.core.Tracer)
+
+
+def _as_bool(pred):
+    d = getattr(pred, "_data", pred)
+    return bool(np.asarray(d).reshape(()))
+
+
+def _select_trees(pred, taken, other):
+    """Elementwise select between two same-structure outputs; gradients
+    flow into both (the untaken side's cotangent is zeroed by where)."""
+    t_leaves, treedef = jax.tree_util.tree_flatten(
+        taken, is_leaf=lambda x: isinstance(x, Tensor))
+    o_leaves, treedef2 = jax.tree_util.tree_flatten(
+        other, is_leaf=lambda x: isinstance(x, Tensor))
+    if treedef != treedef2:
+        raise ValueError(
+            f"cond branches returned different structures: {treedef} vs "
+            f"{treedef2} (reference requires matching nest structures)")
+    out = []
+    for t, o in zip(t_leaves, o_leaves):
+        out.append(apply_op(
+            "cond_select",
+            lambda p, a, b: jnp.where(p.astype(bool), a, b), pred, t, o))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """Parity: paddle.static.nn.cond (control_flow.py). Both fns take no
+    arguments and close over the enclosing scope."""
+    if not _is_tracer(pred):
+        fn = true_fn if _as_bool(pred) else false_fn
+        return fn() if fn is not None else None
+    taken = true_fn() if true_fn is not None else None
+    other = false_fn() if false_fn is not None else None
+    if taken is None or other is None:
+        raise ValueError(
+            "cond with a traced predicate needs BOTH branches (a one-armed "
+            "if has no value to select on the untaken side)")
+    return _select_trees(pred, taken, other)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """Parity: static.nn.case — first true predicate wins."""
+    if not pred_fn_pairs:
+        return default() if default else None
+    (pred, fn), rest = pred_fn_pairs[0], pred_fn_pairs[1:]
+    if not _is_tracer(pred):
+        if _as_bool(pred):
+            return fn()
+        return case(rest, default, name)
+    return cond(pred, fn, lambda: case(rest, default, name))
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Parity: static.nn.switch_case — dispatch on an integer index."""
+    if isinstance(branch_fns, dict):
+        pairs = sorted(branch_fns.items())
+    elif branch_fns and isinstance(branch_fns[0], (tuple, list)):
+        pairs = sorted((int(i), f) for i, f in branch_fns)
+    else:
+        pairs = list(enumerate(branch_fns))
+    if not _is_tracer(branch_index):
+        idx = int(np.asarray(getattr(branch_index, "_data",
+                                     branch_index)).reshape(()))
+        for i, f in pairs:
+            if i == idx:
+                return f()
+        return default() if default else None
+    preds = [(apply_op("eq_index",
+                       lambda b, i=i: (b == i).reshape(()), branch_index), f)
+             for i, f in pairs]
+    return case(preds, default, name)
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """Parity: static.nn.while_loop. Concrete condition: a taped python
+    loop (fully differentiable — the unrolled reverse is the reference's
+    While grad). Traced condition: lax.while_loop over the array leaves;
+    forward-only (outputs carry stop_gradient=True), matching the
+    reference static While's heavily restricted backward."""
+    loop_vars = list(loop_vars)
+    first = cond_fn(*loop_vars)
+    if not _is_tracer(first) and not any(map(_is_tracer, loop_vars)):
+        keep = _as_bool(first)
+        while keep:
+            out = body_fn(*loop_vars)
+            loop_vars = list(out) if isinstance(out, (list, tuple)) else [out]
+            keep = _as_bool(cond_fn(*loop_vars))
+        return loop_vars
+
+    from ..core import autograd
+
+    leaves, treedef = jax.tree_util.tree_flatten(
+        loop_vars, is_leaf=lambda x: isinstance(x, Tensor))
+    arrs = [l._data if isinstance(l, Tensor) else jnp.asarray(l)
+            for l in leaves]
+
+    def wrap(arrays):
+        ts = [Tensor(a) for a in arrays]
+        return jax.tree_util.tree_unflatten(treedef, ts)
+
+    def c(arrays):
+        with autograd.no_grad():
+            r = cond_fn(*wrap(list(arrays)))
+        return getattr(r, "_data", r).reshape(()).astype(bool)
+
+    def b(arrays):
+        with autograd.no_grad():
+            out = body_fn(*wrap(list(arrays)))
+        out = list(out) if isinstance(out, (list, tuple)) else [out]
+        out_leaves, _ = jax.tree_util.tree_flatten(
+            out, is_leaf=lambda x: isinstance(x, Tensor))
+        return [getattr(o, "_data", o) for o in out_leaves]
+
+    final = jax.lax.while_loop(c, b, arrs)
+    return jax.tree_util.tree_unflatten(
+        treedef, [Tensor(a) for a in final])
+
+
+def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
+    """Parity: static.nn.static_pylayer — custom forward with an optional
+    custom backward, over the autograd PyLayer machinery."""
+    if backward_fn is None:
+        from ..core import autograd
+        with autograd.no_grad():
+            return forward_fn(*inputs)
+    from ..autograd import PyLayer
+
+    class _StaticPy(PyLayer):
+        @staticmethod
+        def forward(ctx, *xs):
+            return forward_fn(*xs)
+
+        @staticmethod
+        def backward(ctx, *grads):
+            return backward_fn(*grads)
+
+    return _StaticPy.apply(*inputs)
+
+
+def py_func(func, x, out=None, backward_func=None, skip_vars_in_backward_input=None):
+    """Parity: static.nn.py_func — run host python inside the program.
+    Eager: call directly on numpy views. Traced: jax.pure_callback with
+    `out` as the shape/dtype template (required under tracing)."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    arrs = [getattr(t, "_data", t) for t in xs]
+    if not any(isinstance(a, jax.core.Tracer) for a in arrs):
+        res = func(*[np.asarray(a) for a in arrs])
+        if res is None:
+            return out
+        res_list = res if isinstance(res, (list, tuple)) else [res]
+        wrapped = [Tensor(jnp.asarray(np.asarray(r))) for r in res_list]
+        return wrapped if len(wrapped) > 1 else wrapped[0]
+    if out is None:
+        raise ValueError("py_func under tracing needs `out` (a template "
+                         "Tensor) for the result shape/dtype")
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    shapes = [jax.ShapeDtypeStruct(tuple(t.shape), t.dtype) for t in outs]
+    res = jax.pure_callback(
+        lambda *a: func(*[np.asarray(x) for x in a]),
+        shapes if len(shapes) > 1 else shapes[0], *arrs)
+    res_list = res if isinstance(res, (list, tuple)) else [res]
+    wrapped = [Tensor(r) for r in res_list]
+    return wrapped if len(wrapped) > 1 else wrapped[0]
+
+
+# ------------------------------------------------------- layer builders
+def _param(name, shape, dtype="float32", is_bias=False, initializer=None):
+    """Create (or reuse, when named) a parameter in the global scope —
+    the static-graph convention of building weights at layer-call time."""
+    from . import global_scope, create_parameter
+    from ..nn.initializer import Constant
+    scope = global_scope()
+    if name is not None and name in scope.vars:
+        return scope.vars[name]
+    if initializer == "ones":
+        initializer = Constant(1.0)
+    elif initializer == "zeros":
+        initializer = Constant(0.0)
+    elif isinstance(initializer, (int, float)):
+        initializer = Constant(float(initializer))
+    p = create_parameter(shape, dtype, is_bias=is_bias,
+                         default_initializer=initializer)
+    if name is not None:
+        scope.vars[name] = p
+        p.name = name
+    return p
+
+
+def _maybe_act(out, act):
+    if act is None:
+        return out
+    from ..nn import functional as F
+    return getattr(F, act)(out)
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """Parity: static.nn.fc — flatten trailing dims, create W/b inline."""
+    from ..nn import functional as F
+    shape = list(x.shape)
+    nfd = num_flatten_dims if num_flatten_dims > 0 else len(shape) - 1
+    in_dim = int(np.prod(shape[nfd:]))
+    x2 = x.reshape(shape[:nfd] + [in_dim])
+    w = _param(f"{name}.w_0" if name else None, (in_dim, size))
+    b = None if bias_attr is False else _param(
+        f"{name}.b_0" if name else None, (size,), is_bias=True)
+    return _maybe_act(F.linear(x2, w, b), activation)
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    from ..nn import functional as F
+    name = getattr(param_attr, "name", None)
+    w = _param(name, tuple(size), dtype)
+    return F.embedding(input, w, padding_idx=padding_idx, sparse=is_sparse)
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32", slot=None):
+    """Parity: static.nn.sparse_embedding (PS large-scale table) — on TPU
+    the table is a dense sharded parameter; lookup is identical."""
+    return embedding(input, size, is_sparse=True, padding_idx=padding_idx,
+                     param_attr=param_attr, dtype=dtype)
+
+
+def _conv(ndim, transpose, input, num_filters, filter_size, stride=1,
+          padding=0, dilation=1, groups=1, param_attr=None, bias_attr=None,
+          act=None, data_format=None, name=None, output_size=None):
+    from ..nn import functional as F
+    data_format = data_format or ("NCHW" if ndim == 2 else "NCDHW")
+    c_ax = 1 if data_format[1] == "C" else -1
+    cin = int(input.shape[c_ax])
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else [filter_size] * ndim
+    if transpose:
+        wshape = (cin, num_filters // groups, *ks)
+    else:
+        wshape = (num_filters, cin // groups, *ks)
+    w = _param(f"{name}.w_0" if name else None, wshape)
+    b = None if bias_attr is False else _param(
+        f"{name}.b_0" if name else None, (num_filters,), is_bias=True)
+    fn = {(2, False): F.conv2d, (2, True): F.conv2d_transpose,
+          (3, False): F.conv3d, (3, True): F.conv3d_transpose}[
+              (ndim, transpose)]
+    kw = dict(stride=stride, padding=padding, dilation=dilation,
+              groups=groups, data_format=data_format)
+    if transpose and output_size is not None:
+        kw["output_size"] = output_size
+    return _maybe_act(fn(input, w, b, **kw), act)
+
+
+conv2d = functools.partial(_conv, 2, False)
+conv2d_transpose = functools.partial(_conv, 2, True)
+conv3d = functools.partial(_conv, 3, False)
+conv3d_transpose = functools.partial(_conv, 3, True)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=True,
+               use_global_stats=False):
+    from ..nn import functional as F
+    c_ax = 1 if data_layout[1] == "C" else -1
+    c = int(input.shape[c_ax])
+    scale = _param(f"{name}.w_0" if name else None, (c,),
+                   initializer="ones")
+    bias = _param(f"{name}.b_0" if name else None, (c,), is_bias=True)
+    mean = _param(moving_mean_name, (c,), initializer="zeros")
+    var = _param(moving_variance_name, (c,), initializer="ones")
+    mean.stop_gradient = var.stop_gradient = True
+    out = F.batch_norm(input, mean, var, scale, bias,
+                       training=not is_test, momentum=momentum,
+                       epsilon=epsilon, data_format=data_layout,
+                       use_global_stats=use_global_stats or None)
+    return _maybe_act(out, act)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    from ..nn import functional as F
+    norm_shape = [int(s) for s in input.shape[begin_norm_axis:]]
+    w = _param(f"{name}.w_0" if name else None, norm_shape,
+               initializer="ones") if scale else None
+    b = _param(f"{name}.b_0" if name else None, norm_shape,
+               is_bias=True) if shift else None
+    return _maybe_act(
+        F.layer_norm(input, norm_shape, weight=w, bias=b, epsilon=epsilon),
+        act)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    from ..nn import functional as F
+    c_ax = 1 if data_layout[1] == "C" else -1
+    c = int(input.shape[c_ax])
+    w = _param(f"{name}.w_0" if name else None, (c,), initializer="ones")
+    b = _param(f"{name}.b_0" if name else None, (c,), is_bias=True)
+    return _maybe_act(F.group_norm(input, groups, epsilon=epsilon,
+                                   weight=w, bias=b,
+                                   data_format=data_layout), act)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    from ..nn import functional as F
+    c = int(input.shape[1])
+    w = _param(f"{name}.w_0" if name else None, (c,), initializer="ones")
+    b = _param(f"{name}.b_0" if name else None, (c,), is_bias=True)
+    return F.instance_norm(input, weight=w, bias=b, eps=epsilon)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              summary_decay_rate=0.9999999, sync_stats=False,
+              enable_scale_and_shift=False):
+    """Parity: static.nn.data_norm — normalization by ACCUMULATED batch
+    statistics (batch_size/batch_sum/batch_square_sum), the CTR-model
+    normalizer. Accumulators update eagerly during training calls."""
+    c = int(input.shape[-1] if data_layout[-1] == "C" else input.shape[1])
+    bsize = _param(f"{name}.batch_size" if name else None, (c,),
+                   initializer="ones")
+    bsum = _param(f"{name}.batch_sum" if name else None, (c,),
+                  initializer="zeros")
+    bsq = _param(f"{name}.batch_square_sum" if name else None, (c,),
+                 initializer="ones")
+    for t in (bsize, bsum, bsq):
+        t.stop_gradient = True
+    mean = bsum / bsize
+    scale = (bsize / (bsq - (bsum * bsum) / bsize + epsilon)).sqrt()
+    out = (input - mean) * scale
+    if not _is_tracer(input):
+        n = float(input.shape[0])
+        x = input.detach()
+        red = tuple(range(x._data.ndim - 1)) if data_layout[-1] == "C" \
+            else (0,) + tuple(range(2, x._data.ndim))
+        r = summary_decay_rate
+        bsize._data = bsize._data * r + n
+        bsum._data = bsum._data * r + jnp.sum(x._data, axis=red)
+        bsq._data = bsq._data * r + jnp.sum(x._data ** 2, axis=red)
+    return _maybe_act(out, act)
+
+
+def deform_conv2d(input, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None,
+                  modulated=True, name=None):
+    from ..vision.ops import deform_conv2d as _dc
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else [filter_size] * 2
+    cin = int(input.shape[1])
+    w = _param(f"{name}.w_0" if name else None,
+               (num_filters, cin // groups, *ks))
+    b = None if bias_attr is False else _param(
+        f"{name}.b_0" if name else None, (num_filters,), is_bias=True)
+    return _dc(input, offset, w, bias=b, stride=stride, padding=padding,
+               dilation=dilation, deformable_groups=deformable_groups,
+               groups=groups, mask=mask if modulated else None)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    from ..nn import functional as F
+    w = _param(f"{name}.w_0" if name else None,
+               (size, int(x.shape[-1]), int(y.shape[-1])))
+    b = None if bias_attr is False else _param(
+        f"{name}.b_0" if name else None, (size,), is_bias=True)
+    return _maybe_act(F.bilinear(x, y, w, b), act)
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    from ..nn import functional as F
+    if mode == "all":
+        shape = (1,)
+    elif mode == "channel":
+        shape = (int(x.shape[1 if data_format[1] == "C" else -1]),)
+    else:                     # element
+        shape = tuple(int(s) for s in x.shape[1:])
+    w = _param(f"{name}.w_0" if name else None, shape,
+               initializer=0.25)
+    return F.prelu(x, w, data_format=data_format)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    from ..nn import functional as F
+    return F.spectral_norm(weight, dim=dim, power_iters=power_iters,
+                           eps=eps)
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=10, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """Parity: static.nn.nce — noise-contrastive estimation loss with
+    sampled negatives (phi nce kernel). Uniform/log-uniform samplers;
+    returns per-example loss (B, 1)."""
+    from ..framework import random as _random
+    d = int(input.shape[-1])
+    w = _param(f"{name}.w_0" if name else None, (num_total_classes, d))
+    b = _param(f"{name}.b_0" if name else None, (num_total_classes,),
+               is_bias=True)
+    B = int(input.shape[0])
+    key = _random.default_rng().next_key()
+    if sampler == "log_uniform":
+        u = jax.random.uniform(key, (num_neg_samples,))
+        neg = (jnp.exp(u * jnp.log(float(num_total_classes + 1))) - 1)
+        neg = jnp.clip(neg.astype(jnp.int32), 0, num_total_classes - 1)
+    elif sampler == "custom_dist" and custom_dist is not None:
+        p = jnp.asarray(custom_dist, jnp.float32)
+        neg = jax.random.choice(key, num_total_classes, (num_neg_samples,),
+                                p=p / p.sum())
+    else:
+        neg = jax.random.randint(key, (num_neg_samples,), 0,
+                                 num_total_classes)
+    neg_t = Tensor(neg)
+
+    def _f(x, lw, lb, lab, negs):
+        lab = lab.reshape(B).astype(jnp.int32)
+        pos_logit = jnp.einsum("bd,bd->b", x, lw[lab]) + lb[lab]
+        neg_logit = x @ lw[negs].T + lb[negs]          # (B, num_neg)
+        pos_loss = jax.nn.softplus(-pos_logit)         # -log sigmoid(s+)
+        neg_loss = jax.nn.softplus(neg_logit).sum(-1)  # -log sigmoid(-s-)
+        return (pos_loss + neg_loss).reshape(B, 1)
+
+    return apply_op("nce", _f, input, w, b, label, neg_t)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Parity: static.nn.row_conv — lookahead row convolution over
+    (B, T, D): out[t] = sum_{i=0..k} x[t+i] * w[i] (phi row_conv)."""
+    d = int(input.shape[-1])
+    k = int(future_context_size)
+    w = _param(getattr(param_attr, "name", None), (k + 1, d))
+
+    def _f(x, ww):
+        pad = jnp.pad(x, ((0, 0), (0, k), (0, 0)))
+        out = sum(pad[:, i:i + x.shape[1]] * ww[i] for i in range(k + 1))
+        return out
+
+    return _maybe_act(apply_op("row_conv", _f, input, w), act)
+
+
+# ------------------------------------------------- sequence ops (padded)
+def _time_mask(x, seq_lens):
+    if seq_lens is None:
+        return None
+    ln = getattr(seq_lens, "_data", jnp.asarray(seq_lens))
+    return jnp.arange(x.shape[1])[None, :] < ln[:, None]
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None):
+    """Time-axis conv over padded (B, T, D) (sequence_lod.py analog)."""
+    from ..nn import functional as F
+    d = int(input.shape[-1])
+    w = _param(f"{name}.w_0" if name else None,
+               (num_filters, d, int(filter_size)))
+    b = None if bias_attr is False else _param(
+        f"{name}.b_0" if name else None, (num_filters,), is_bias=True)
+    x = input.transpose([0, 2, 1])                 # (B, D, T)
+    start = -((filter_size - 1) // 2) if padding_start is None \
+        else padding_start
+    pad_left = max(-start, 0)
+    pad_right = max(filter_size - 1 - pad_left, 0)
+
+    def _f(xa, wa, ba):
+        xa = jnp.pad(xa, ((0, 0), (0, 0), (pad_left, pad_right)))
+        out = jax.lax.conv_general_dilated(
+            xa, wa, (filter_stride,), "VALID",
+            dimension_numbers=("NCH", "OIH", "NCH"))
+        if ba is not None:
+            out = out + ba[None, :, None]
+        return out
+
+    out = apply_op("sequence_conv", _f, x, w, b).transpose([0, 2, 1])
+    return _maybe_act(out, act)
+
+
+def sequence_softmax(input, use_cudnn=False, name=None, seq_lens=None):
+    def _f(x):
+        m = _time_mask(input, seq_lens)
+        if m is not None:
+            x = jnp.where(m[..., None] if x.ndim == 3 else m, x, -1e9)
+        return jax.nn.softmax(x, axis=1)
+    return apply_op("sequence_softmax", _f, input)
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0,
+                  seq_lens=None):
+    def _f(x):
+        m = _time_mask(input, seq_lens)
+        mask = None if m is None else m[..., None].astype(x.dtype)
+        if pool_type.lower() == "sum":
+            return (x if mask is None else x * mask).sum(axis=1)
+        if pool_type.lower() in ("average", "mean"):
+            if mask is None:
+                return x.mean(axis=1)
+            return (x * mask).sum(axis=1) / jnp.maximum(mask.sum(axis=1), 1)
+        if pool_type.lower() == "sqrt":
+            n = x.shape[1] if mask is None else mask.sum(axis=1)
+            return (x if mask is None else x * mask).sum(axis=1) \
+                / jnp.sqrt(jnp.maximum(n, 1))
+        if pool_type.lower() == "max":
+            if mask is None:
+                return x.max(axis=1)
+            return jnp.where(mask.astype(bool), x, -jnp.inf).max(axis=1)
+        if pool_type.lower() == "first":
+            return x[:, 0]
+        if pool_type.lower() == "last":
+            if seq_lens is None:
+                return x[:, -1]
+            ln = getattr(seq_lens, "_data", jnp.asarray(seq_lens))
+            return jnp.take_along_axis(
+                x, (ln - 1)[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+        raise ValueError(f"unknown pool_type {pool_type}")
+    return apply_op("sequence_pool", _f, input)
+
+
+def sequence_first_step(input, seq_lens=None):
+    return sequence_pool(input, "first", seq_lens=seq_lens)
+
+
+def sequence_last_step(input, seq_lens=None):
+    return sequence_pool(input, "last", seq_lens=seq_lens)
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    """Padded analog of LoD sequence_expand: tile x's rows to match y's
+    time dimension (each x row broadcast along y's T)."""
+    def _f(xa, ya):
+        t = ya.shape[1]
+        return jnp.repeat(xa[:, None], t, axis=1).reshape(
+            (xa.shape[0] * t,) + xa.shape[1:])
+    return apply_op("sequence_expand", _f, x, y)
