@@ -95,13 +95,15 @@ def _graph_break_errors():
     (data-dependent if/while, int()/bool()/np.asarray() on a tracer,
     tensor-dependent shapes)."""
     import jax.errors as je
+    from .dy2static import DygraphToStaticBreak
     # note: in this jax only TracerBoolConversionError subclasses
     # ConcretizationTypeError; the int/array variants are siblings
     return (je.ConcretizationTypeError,
             je.TracerIntegerConversionError,
             je.TracerArrayConversionError,
             je.NonConcreteBooleanIndexError,
-            je.UnexpectedTracerError)     # side-effect leaks out of the trace
+            je.UnexpectedTracerError,     # side-effect leaks out of the trace
+            DygraphToStaticBreak)         # rewritten construct won't lower
 
 
 class TracedFunction:
@@ -273,13 +275,32 @@ class TracedFunction:
                         p._grad_buffer = None
 
     def _graph_break(self, key, concrete_state, err, args, kwargs):
-        """SOT-lite fallback: restore the concrete state the aborted trace
-        clobbered (bundle.load ran with tracers), guard this call
-        signature to eager, and run the python directly. Python-side
-        scalar mutations made before the break (e.g. a step counter) are
-        not rolled back — same caveat as SOT's partial-frame replay."""
+        """SOT-lite fallback with an AST rescue first: restore the
+        concrete state the aborted trace clobbered (bundle.load ran with
+        tracers), then try the dy2static AST conversion ONCE — python
+        if/while over tensor predicates rewritten to static.nn
+        cond/while_loop often compiles outright (the reference's AST
+        mode). Only if the converted function also breaks does this call
+        signature get guarded to eager. Python-side scalar mutations made
+        before the break (e.g. a step counter) are not rolled back — same
+        caveat as SOT's partial-frame replay."""
         self._bundle.load(concrete_state)
         self._clear_tracer_grads()
+        if not getattr(self, "_ast_tried", False):
+            self._ast_tried = True
+            from .dy2static import try_convert
+            converted = try_convert(self._callable)
+            if converted is not None:
+                self._eager_callable = self._callable  # for later breaks
+                self._callable = converted
+                self._cache.pop(key, None)
+                warnings.warn(
+                    "to_static: AST-converted "
+                    f"{getattr(converted, '_dy2static_converted', '?')} "
+                    "control-flow statement(s) to compiled cond/while "
+                    "(dy2static); retracing.", RuntimeWarning,
+                    stacklevel=3)
+                return self.__call__(*args, **kwargs)
         self._cache[key] = _EAGER_FALLBACK
         self._fallback_count += 1
         name = getattr(self._callable, "__qualname__",

@@ -1,0 +1,362 @@
+"""AST dy2static-lite: rewrite python control flow into compiled ops.
+
+Parity: the reference's AST transform pipeline
+(`python/paddle/jit/dy2static/program_translator.py:377`,
+`convert_operators.py` convert_ifelse/convert_while_loop — ~35k LoC with
+a bytecode VM on top). This is the load-bearing subset: `if` statements
+and `while` loops whose predicates turn out to be traced tensors are
+rewritten into `paddle.static.nn.cond` / `while_loop` calls, so the
+model COMPILES instead of graph-breaking to eager.
+
+Pipeline position (jit/api.py): trace fails with a concretization error
+-> try_convert() rewrites the function's AST -> retrace; only if the
+converted function still breaks does the SOT-lite eager fallback engage.
+
+Restrictions (each skips the rewrite for that statement, keeping plain
+python semantics — the fallback still works):
+  * branches/loop bodies containing return/break/continue/yield
+  * nested function definitions are not descended into
+  * closure variables are bound by VALUE at conversion time (the
+    reference snapshots cells the same way when synthesizing code)
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import types
+from typing import List, Optional, Set, Tuple
+
+import numpy as np
+
+__all__ = ["try_convert", "DygraphToStaticBreak"]
+
+
+class DygraphToStaticBreak(Exception):
+    """Raised by the runtime helpers when a rewritten construct cannot be
+    represented under tracing (e.g. branches with mismatched structures);
+    jit/api.py treats it exactly like a jax concretization error."""
+
+
+class _Undefined:
+    __slots__ = ("name",)
+
+    def __init__(self, name):
+        self.name = name
+
+    def __repr__(self):
+        return f"<undefined {self.name}>"
+
+
+def _is_tracer_tensor(p):
+    import jax
+    from ..core.tensor import Tensor
+    return isinstance(p, Tensor) and isinstance(p._data, jax.core.Tracer)
+
+
+def _to_bool(p):
+    from ..core.tensor import Tensor
+    if isinstance(p, Tensor):
+        return bool(np.asarray(p._data).reshape(()))
+    return bool(p)
+
+
+def _run_if(pred, true_fn, false_fn):
+    """Runtime helper for rewritten `if`: concrete predicates keep exact
+    python semantics; traced predicates lower to static.nn.cond."""
+    if _is_tracer_tensor(pred):
+        from ..static import nn as snn
+        try:
+            return snn.cond(pred, true_fn, false_fn)
+        except Exception as e:  # structure mismatch, undefined var, ...
+            raise DygraphToStaticBreak(
+                f"converted `if` could not lower to cond: {e}") from e
+    return true_fn() if _to_bool(pred) else false_fn()
+
+
+def _run_while(cond_fn, body_fn, loop_vars):
+    """Runtime helper for rewritten `while`."""
+    import jax
+    first = cond_fn(*loop_vars)
+    tracers = _is_tracer_tensor(first) or any(
+        isinstance(getattr(v, "_data", v), jax.core.Tracer)
+        for v in loop_vars)
+    if not tracers:
+        while _to_bool(cond_fn(*loop_vars)):
+            out = body_fn(*loop_vars)
+            loop_vars = tuple(out) if isinstance(out, (list, tuple)) \
+                else (out,)
+        return tuple(loop_vars)
+    from ..static import nn as snn
+    try:
+        return tuple(snn.while_loop(cond_fn, body_fn, list(loop_vars)))
+    except Exception as e:
+        raise DygraphToStaticBreak(
+            f"converted `while` could not lower to while_loop: {e}") from e
+
+
+# --------------------------------------------------------- AST analysis
+class _AssignCollector(ast.NodeVisitor):
+    """Names bound by a statement (stores, aug-assigns, for-targets,
+    with-as); does not descend into nested function/class definitions."""
+
+    def __init__(self):
+        self.names: Set[str] = set()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self.names.add(node.id)
+
+    def visit_FunctionDef(self, node):
+        self.names.add(node.name)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        self.names.add(node.name)
+
+    def visit_Lambda(self, node):
+        pass
+
+
+def _assigned_names(stmts) -> Set[str]:
+    c = _AssignCollector()
+    for s in stmts:
+        c.visit(s)
+    return c.names
+
+
+class _ReadCollector(ast.NodeVisitor):
+    """Names read (Load ctx) by a statement list, excluding nested
+    function/class bodies."""
+
+    def __init__(self):
+        self.names: Set[str] = set()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Load):
+            self.names.add(node.id)
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_ClassDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+
+def _read_names(stmts) -> Set[str]:
+    c = _ReadCollector()
+    for s in stmts:
+        c.visit(s)
+    return c.names
+
+
+class _CtrlScanner(ast.NodeVisitor):
+    """Detects constructs that make a body non-extractable."""
+
+    def __init__(self):
+        self.blocked = False
+
+    def visit_Return(self, node):
+        self.blocked = True
+
+    def visit_Break(self, node):
+        self.blocked = True
+
+    def visit_Continue(self, node):
+        self.blocked = True
+
+    def visit_Yield(self, node):
+        self.blocked = True
+
+    visit_YieldFrom = visit_Yield
+
+    def visit_FunctionDef(self, node):
+        pass  # nested defs keep their own control flow
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+
+def _blocked(stmts) -> bool:
+    s = _CtrlScanner()
+    for st in stmts:
+        s.visit(st)
+    return s.blocked
+
+
+def _name(id_, ctx):
+    return ast.Name(id=id_, ctx=ctx)
+
+
+def _tuple_of(names: List[str], ctx):
+    return ast.Tuple(elts=[_name(n, ctx) for n in names], ctx=ctx)
+
+
+class _Rewriter:
+    """Statement-list rewriter tracking which names are bound so far (to
+    know when a branch-assigned name needs an undefined-sentinel init)."""
+
+    def __init__(self):
+        self.count = 0
+        self.uid = 0
+
+    def rewrite_body(self, stmts, bound: Set[str]) -> List[ast.stmt]:
+        out: List[ast.stmt] = []
+        for st in stmts:
+            if isinstance(st, ast.If) and not _blocked(st.body + st.orelse):
+                out.extend(self._rewrite_if(st, bound))
+            elif isinstance(st, ast.While) and not st.orelse \
+                    and not _blocked(st.body):
+                out.extend(self._rewrite_while(st, bound))
+            else:
+                # recurse into compound statements' bodies in place
+                for field in ("body", "orelse", "finalbody"):
+                    sub = getattr(st, field, None)
+                    if sub and not isinstance(
+                            st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                        setattr(st, field, self.rewrite_body(sub, bound))
+                out.append(st)
+            bound |= _assigned_names([st])
+        return out
+
+    def _fn_def(self, fname, params, body, result_names,
+                default_params=()):
+        """`params` are plain positional args (while carried vars);
+        `default_params` become keyword args whose defaults capture the
+        CURRENT outer value at definition time — this is how an extracted
+        branch can read a name it also assigns (a bare closure read would
+        be an UnboundLocalError once the name becomes function-local)."""
+        body = list(body)
+        body.append(ast.Return(value=_tuple_of(result_names, ast.Load())))
+        args = ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=p) for p in params]
+            + [ast.arg(arg=p) for p in default_params],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[_name(p, ast.Load()) for p in default_params])
+        return ast.FunctionDef(name=fname, args=args, body=body,
+                               decorator_list=[], returns=None)
+
+    def _rewrite_if(self, node: ast.If, bound: Set[str]) -> List[ast.stmt]:
+        self.uid += 1
+        k = self.uid
+        body = self.rewrite_body(node.body, set(bound))
+        orelse = self.rewrite_body(node.orelse, set(bound)) if node.orelse \
+            else [ast.Pass()]
+        targets = sorted(_assigned_names(node.body)
+                         | _assigned_names(node.orelse))
+        pre: List[ast.stmt] = []
+        for t in targets:
+            if t not in bound:
+                pre.append(ast.Assign(
+                    targets=[_name(t, ast.Store())],
+                    value=ast.Call(
+                        func=_name("__pt_undef", ast.Load()),
+                        args=[ast.Constant(value=t)], keywords=[])))
+        # names a branch reads AND a branch assigns: must enter as
+        # captured default params (see _fn_def); the sentinel inits above
+        # guarantee the default expression is evaluable
+        reads = _read_names(node.body) | _read_names(node.orelse)
+        captured = sorted(reads & set(targets))
+        tf = self._fn_def(f"__pt_true_{k}", [], body, targets,
+                          default_params=captured)
+        ff = self._fn_def(f"__pt_false_{k}", [], orelse, targets,
+                          default_params=captured)
+        call = ast.Call(func=_name("__pt_run_if", ast.Load()),
+                        args=[node.test,
+                              _name(tf.name, ast.Load()),
+                              _name(ff.name, ast.Load())], keywords=[])
+        if targets:
+            assign: ast.stmt = ast.Assign(
+                targets=[_tuple_of(targets, ast.Store())], value=call)
+        else:
+            assign = ast.Expr(value=call)
+        self.count += 1
+        return pre + [tf, ff, assign]
+
+    def _rewrite_while(self, node: ast.While,
+                       bound: Set[str]) -> List[ast.stmt]:
+        self.uid += 1
+        k = self.uid
+        body = self.rewrite_body(node.body, set(bound))
+        carried = sorted(_assigned_names(node.body))
+        if not carried:
+            return [node]  # nothing loop-carried: leave as plain python
+        pre: List[ast.stmt] = []
+        for t in carried:
+            if t not in bound:
+                pre.append(ast.Assign(
+                    targets=[_name(t, ast.Store())],
+                    value=ast.Call(
+                        func=_name("__pt_undef", ast.Load()),
+                        args=[ast.Constant(value=t)], keywords=[])))
+        cf = self._fn_def(f"__pt_cond_{k}", carried,
+                          [], [])  # placeholder, replaced below
+        cf.body = [ast.Return(value=node.test)]
+        bf = self._fn_def(f"__pt_body_{k}", carried, body, carried)
+        call = ast.Call(
+            func=_name("__pt_run_while", ast.Load()),
+            args=[_name(cf.name, ast.Load()), _name(bf.name, ast.Load()),
+                  _tuple_of(carried, ast.Load())], keywords=[])
+        assign = ast.Assign(targets=[_tuple_of(carried, ast.Store())],
+                            value=call)
+        self.count += 1
+        return pre + [cf, bf, assign]
+
+
+def try_convert(fn) -> Optional[types.FunctionType]:
+    """AST-convert `fn`'s data-dependent control flow. Returns the
+    converted callable, or None when nothing was (or could be)
+    converted. Never raises."""
+    try:
+        return _convert(fn)
+    except Exception:
+        return None
+
+
+def _convert(fn):
+    bound_self = getattr(fn, "__self__", None)
+    func = fn.__func__ if bound_self is not None else fn
+    if not isinstance(func, types.FunctionType):
+        return None
+    src = textwrap.dedent(inspect.getsource(func))
+    tree = ast.parse(src)
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    fdef.decorator_list = []
+    rw = _Rewriter()
+    arg_names = {a.arg for a in (fdef.args.posonlyargs + fdef.args.args
+                                 + fdef.args.kwonlyargs)}
+    if fdef.args.vararg:
+        arg_names.add(fdef.args.vararg.arg)
+    if fdef.args.kwarg:
+        arg_names.add(fdef.args.kwarg.arg)
+    fdef.body = rw.rewrite_body(fdef.body, set(arg_names))
+    if rw.count == 0:
+        return None
+    ast.fix_missing_locations(tree)
+    code = compile(tree, filename=f"<dy2static {func.__name__}>",
+                   mode="exec")
+    namespace = dict(func.__globals__)
+    # closure cells bound by value (documented restriction)
+    for name, cell in zip(func.__code__.co_freevars,
+                          func.__closure__ or ()):
+        try:
+            namespace[name] = cell.cell_contents
+        except ValueError:
+            return None  # empty cell: cannot snapshot
+    namespace["__pt_run_if"] = _run_if
+    namespace["__pt_run_while"] = _run_while
+    namespace["__pt_undef"] = _Undefined
+    exec(code, namespace)
+    new_fn = namespace[fdef.name]
+    functools.update_wrapper(new_fn, func)
+    new_fn._dy2static_converted = rw.count
+    if bound_self is not None:
+        return types.MethodType(new_fn, bound_self)
+    return new_fn
