@@ -48,6 +48,7 @@ from . import vision  # noqa: F401,E402
 from . import metric  # noqa: F401,E402
 from . import static  # noqa: F401,E402
 from . import utils  # noqa: F401,E402
+from . import sysconfig  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from .hapi.summary import flops, summary  # noqa: F401,E402
 from .utils.flags import get_flags, set_flags  # noqa: F401,E402

@@ -28,6 +28,23 @@ from . import auto_parallel  # noqa: F401
 from .auto_parallel.api import (  # noqa: F401
     shard_tensor, reshard, dtensor_from_local, dtensor_to_local, shard_layer,
     shard_optimizer, to_static as dist_to_static, unshard_dtensor,
+    to_static, DistModel, DistAttr,
+)
+from . import communication  # noqa: F401
+from . import extras as _extras  # noqa: F401
+from .extras import (  # noqa: F401
+    gather, wait, isend, irecv, scatter_object_list, alltoall,
+    alltoall_single, gloo_init_parallel_env, gloo_barrier, gloo_release,
+    split, spawn, ParallelMode, ReduceType, dtensor_from_fn,
+    shard_dataloader, ShardDataloader, shard_scaler, Strategy,
+    QueueDataset, InMemoryDataset, CountFilterEntry, ShowClickEntry,
+    ProbabilityEntry,
+)
+from . import io  # noqa: F401
+from . import passes  # noqa: F401
+from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
+from .sharding import (  # noqa: F401
+    ShardingStage1, ShardingStage2, ShardingStage3,
 )
 from .auto_parallel.process_mesh import ProcessMesh  # noqa: F401
 from .auto_parallel.placement_type import (  # noqa: F401

@@ -262,59 +262,71 @@ def shard_optimizer(optimizer, shard_fn=None):
     return _ShardOptimizer(optimizer, shard_fn)
 
 
+class DistModel:
+    """Parity: dist.DistModel (auto_parallel/api.py) — the compiled
+    distributed train/eval callable dist.to_static returns. The step is
+    jit-compiled over the already-sharded parameters and runs under
+    spmd_propagation when a mesh is discoverable (layer._spmd_mesh from
+    shard_layer, or the first parameter's process_mesh) so the SPMD rule
+    registry pins intermediate placements inside the program."""
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None,
+                 strategy=None, metrics=None):
+        import contextlib
+        from ...jit import to_static as jit_to_static
+        from .propagation import spmd_propagation
+
+        self.network = layer
+        self._loss = loss
+        self._opt = optimizer
+        self._strategy = strategy
+        self._mode = "train"
+
+        mesh = getattr(layer, "_spmd_mesh", None)
+        if mesh is None:
+            for p in layer.parameters():
+                m = getattr(p, "process_mesh", None)
+                if m is not None:
+                    mesh = m
+                    break
+
+        # `mode` rides as a leading STATIC argument so train vs eval get
+        # distinct guard-cache entries (a closure read would freeze the
+        # trace-time mode into the compiled program)
+        def step_fn(mode, *batch):
+            ctx = (spmd_propagation(mesh) if mesh is not None
+                   else contextlib.nullcontext())
+            with ctx:
+                out = layer(*batch[:-1])
+                l = loss(out, batch[-1]) if loss is not None else out
+                if optimizer is not None and mode == "train":
+                    l.backward()
+                    optimizer.step()
+                    optimizer.clear_grad()
+            return l
+
+        self._step = jit_to_static(
+            step_fn, state_objects=[layer] +
+            ([optimizer] if optimizer else []))
+
+    def train(self):
+        self._mode = "train"
+        self.network.train()
+
+    def eval(self):
+        self._mode = "eval"
+        self.network.eval()
+
+    def __call__(self, *batch):
+        return self._step(self._mode, *batch)
+
+    def state_dict(self, mode="all"):
+        return self.network.state_dict()
+
+    def dist_main_program(self, mode=None):
+        return self._step
+
+
 def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
-    """Parity: dist.to_static -> DistModel. Compiles the dist training step
-    with paddle_tpu.jit.to_static over the already-sharded parameters.
-    The step runs under spmd_propagation when a mesh is discoverable
-    (layer._spmd_mesh from shard_layer, or the first parameter's
-    process_mesh) so the SPMD rule registry pins intermediate placements
-    inside the compiled program."""
-    from ...jit import to_static as jit_to_static
-    from .propagation import spmd_propagation
-    import contextlib
-
-    mesh = getattr(layer, "_spmd_mesh", None)
-    if mesh is None:
-        for p in layer.parameters():
-            m = getattr(p, "process_mesh", None)
-            if m is not None:
-                mesh = m
-                break
-
-    class DistModel:
-        def __init__(self):
-            self.network = layer
-            self._loss = loss
-            self._opt = optimizer
-            self._mode = "train"
-
-            def step_fn(*batch):
-                ctx = (spmd_propagation(mesh) if mesh is not None
-                       else contextlib.nullcontext())
-                with ctx:
-                    out = layer(*batch[:-1])
-                    l = loss(out, batch[-1]) if loss is not None else out
-                    if optimizer is not None:
-                        l.backward()
-                        optimizer.step()
-                        optimizer.clear_grad()
-                return l
-            self._step = jit_to_static(step_fn,
-                                       state_objects=[layer] +
-                                       ([optimizer] if optimizer else []))
-
-        def train(self):
-            self._mode = "train"
-            layer.train()
-
-        def eval(self):
-            self._mode = "eval"
-            layer.eval()
-
-        def __call__(self, *batch):
-            return self._step(*batch)
-
-        def state_dict(self):
-            return layer.state_dict()
-
-    return DistModel()
+    """Parity: dist.to_static -> DistModel."""
+    return DistModel(layer, loader, loss, optimizer, strategy)

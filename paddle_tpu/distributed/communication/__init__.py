@@ -1,0 +1,17 @@
+"""paddle.distributed.communication — module-path parity.
+
+Parity: reference `python/paddle/distributed/communication/` (the new
+comm library: one module per collective + the stream variants). The
+implementations live in ..collective (XLA collectives over mesh axes);
+this package provides the importable module structure.
+"""
+from ..collective import (  # noqa: F401
+    all_reduce, all_gather, all_gather_object, all_to_all,
+    all_to_all_single, broadcast, broadcast_object_list, reduce, scatter,
+    reduce_scatter, send, recv, barrier, ReduceOp, Group, Task,
+)
+from . import stream  # noqa: F401
+
+__all__ = ["stream", "all_reduce", "all_gather", "all_to_all",
+           "broadcast", "reduce", "scatter", "reduce_scatter", "send",
+           "recv", "barrier", "ReduceOp", "Group", "Task"]

@@ -1,0 +1,18 @@
+"""paddle.distributed.communication.stream — stream-variant collectives.
+
+Parity: reference `python/paddle/distributed/communication/stream/*.py`
+(each collective with `use_calc_stream`). On TPU, XLA owns scheduling;
+the flag only gates the eager wait (see ..collective._stream_variant).
+"""
+from ..collective import stream as _ns
+
+all_reduce = _ns.all_reduce
+all_gather = _ns.all_gather
+all_to_all = _ns.all_to_all
+broadcast = _ns.broadcast
+reduce = _ns.reduce
+scatter = _ns.scatter
+reduce_scatter = _ns.reduce_scatter
+
+__all__ = ["all_reduce", "all_gather", "all_to_all", "broadcast",
+           "reduce", "scatter", "reduce_scatter"]

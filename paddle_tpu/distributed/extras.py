@@ -1,0 +1,354 @@
+"""Remaining paddle.distributed top-level surface.
+
+Parity targets (reference python/paddle/distributed/__init__.py names
+that had no home yet): communication conveniences (gather, wait,
+isend/irecv, scatter_object_list, alltoall aliases, gloo_*), the
+megatron `split` op, spawn, ParallelMode/ReduceType enums, auto-parallel
+conveniences (dtensor_from_fn, shard_dataloader, shard_scaler,
+Strategy), and the PS-era dataset/entry configs (config objects are
+real; server-touching methods raise — this build excludes the parameter
+server per SURVEY A.7, and a silent no-op would be worse than an error).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .collective import (ReduceOp, Task, _axis_in_trace, _default_group,
+                         _resolve_axis, all_gather, all_to_all,
+                         all_to_all_single, barrier, recv, send)
+
+__all__ = [
+    "gather", "wait", "isend", "irecv", "scatter_object_list", "alltoall",
+    "alltoall_single", "gloo_init_parallel_env", "gloo_barrier",
+    "gloo_release", "split", "spawn", "ParallelMode", "ReduceType",
+    "dtensor_from_fn", "shard_dataloader", "ShardDataloader",
+    "shard_scaler", "Strategy", "QueueDataset", "InMemoryDataset",
+    "CountFilterEntry", "ShowClickEntry", "ProbabilityEntry",
+]
+
+alltoall = all_to_all
+alltoall_single = all_to_all_single
+
+
+class ParallelMode:
+    """Parity: paddle.distributed.ParallelMode (parallel.py)."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+class ReduceType:
+    """Parity: dist.ReduceType (dtensor partial reduce kinds)."""
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """Parity: dist.wait — block until the tensor's producing work is
+    visible. On TPU every array is an async future: block_until_ready."""
+    d = getattr(tensor, "_data", tensor)
+    if hasattr(d, "block_until_ready"):
+        try:
+            d.block_until_ready()
+        except Exception:
+            pass
+    return None
+
+
+def isend(tensor, dst=0, group=None):
+    """Async point-to-point (parity: dist.isend). Same contract as send:
+    out-of-schedule p2p is not supported on the TPU build."""
+    return send(tensor, dst, group, sync_op=False)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src, group, sync_op=False)
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """Parity: dist.gather — collect shards to `dst`. SPMD note: inside a
+    mesh trace every rank computes the full gather (XLA all_gather); the
+    dst-only visibility of the reference is a host-side convention."""
+    if gather_list is None:
+        gather_list = []
+    return all_gather(gather_list, tensor, group=group, sync_op=sync_op)
+
+
+def scatter_object_list(out_object_list, in_object_list, src=0, group=None):
+    """Parity: dist.scatter_object_list (single-process world: rank 0's
+    slot)."""
+    g = group or _default_group()
+    rank = max(g.rank, 0)
+    out_object_list.clear()
+    if in_object_list:
+        out_object_list.append(in_object_list[rank % len(in_object_list)])
+    return out_object_list
+
+
+def gloo_init_parallel_env(rank_id=0, rank_num=1, server_endpoint=None):
+    """Parity: dist.gloo_init_parallel_env — CPU-side barrier bootstrap;
+    maps onto the standard store-based init."""
+    from .env import init_parallel_env
+    init_parallel_env()
+
+
+def gloo_barrier():
+    barrier()
+
+
+def gloo_release():
+    return None
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Parity: dist.split (the megatron helper creating a column/row
+    parallel linear or a vocab-parallel embedding in one call,
+    reference collective.py split). Uses the mpu layers; the created
+    parameters live on the returned layer (`split.last_layer`) for
+    callers that train through them."""
+    from .fleet import mpu
+    if operation == "linear":
+        in_f, out_f = size
+        if axis == 1:
+            layer = mpu.ColumnParallelLinear(
+                in_f, out_f, weight_attr=weight_attr,
+                has_bias=bias_attr is not False, gather_output=gather_out)
+        elif axis == 0:
+            layer = mpu.RowParallelLinear(
+                in_f, out_f, weight_attr=weight_attr,
+                has_bias=bias_attr is not False, input_is_parallel=False)
+        else:
+            raise ValueError("split(linear) axis must be 0 or 1")
+    elif operation == "embedding":
+        n, d = size
+        layer = mpu.VocabParallelEmbedding(n, d, weight_attr=weight_attr)
+    else:
+        raise ValueError(f"split: unknown operation {operation!r}")
+    split.last_layer = layer
+    return layer(x)
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Parity: dist.spawn — launch `func` in nprocs processes with the
+    trainer env prepared (PADDLE_MASTER / TRAINER_ID / TRAINERS_NUM), the
+    same env contract as distributed.launch. Returns the context (with
+    .processes) when join=False."""
+    import multiprocessing as mp
+    import os
+    import socket
+
+    if nprocs <= 0:
+        nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    master = f"127.0.0.1:{s.getsockname()[1]}"
+    s.close()
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        env = {"PADDLE_MASTER": master, "PADDLE_TRAINERS_NUM": str(nprocs),
+               "PADDLE_TRAINER_ID": str(rank)}
+        p = ctx.Process(target=_spawn_entry,
+                        args=(func, args, env), daemon=daemon)
+        p.start()
+        procs.append(p)
+
+    class _Ctx:
+        processes = procs
+
+        def join(self, timeout=None):
+            for p in procs:
+                p.join(timeout)
+            bad = [p.exitcode for p in procs if p.exitcode]
+            if bad:
+                raise RuntimeError(f"spawn: child exit codes {bad}")
+
+    c = _Ctx()
+    if join:
+        c.join()
+    return c
+
+
+def _spawn_entry(func, args, env):
+    import os
+    os.environ.update(env)
+    func(*args)
+
+
+# ------------------------------------------------- auto-parallel extras
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    """Parity: dist.dtensor_from_fn (api.py) — build then place."""
+    from .auto_parallel.api import shard_tensor
+    t = fn(*args, **kwargs)
+    return shard_tensor(t, mesh, placements)
+
+
+class ShardDataloader:
+    """Iterates a DataLoader placing each batch on the mesh (batch dim
+    sharded over the mesh's first axis, or `shard_dims`). Parity:
+    dist.shard_dataloader / ShardDataloader (auto_parallel/api.py)."""
+
+    def __init__(self, dataloader, meshes, shard_dims=None,
+                 is_dataset_splitted=False):
+        self._dl = dataloader
+        self._mesh = meshes[0] if isinstance(meshes, (list, tuple)) \
+            else meshes
+        self._dims = shard_dims
+
+    def __len__(self):
+        return len(self._dl)
+
+    def _place(self, item):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        jmesh = getattr(self._mesh, "jax_mesh", self._mesh)
+        dim = self._dims or list(jmesh.shape.keys())[0]
+        def put(t):
+            if isinstance(t, Tensor):
+                return Tensor(jax.device_put(
+                    t._data, NamedSharding(jmesh, P(dim))),
+                    stop_gradient=t.stop_gradient)
+            return t
+        if isinstance(item, (list, tuple)):
+            return type(item)(put(t) for t in item)
+        return put(item)
+
+    def __iter__(self):
+        for item in self._dl:
+            yield self._place(item)
+
+
+def shard_dataloader(dataloader, meshes, shard_dims=None,
+                     is_dataset_splitted=False):
+    return ShardDataloader(dataloader, meshes, shard_dims,
+                           is_dataset_splitted)
+
+
+def shard_scaler(scaler):
+    """Parity: dist.shard_scaler — the GradScaler already operates on
+    sharded jax arrays (its jnp reductions run the mesh collectives), so
+    the wrap is the identity; kept for API compatibility."""
+    return scaler
+
+
+class _Flags:
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+class Strategy:
+    """Parity: dist.Strategy (auto_parallel/strategy.py) — the config
+    object dist.to_static accepts: sharding/amp/pipeline/fused_passes
+    sub-configs."""
+
+    def __init__(self, config=None):
+        cfg = config or {}
+        self.sharding = _Flags(enable=False, stage=1, degree=8,
+                               **cfg.get("sharding", {}))
+        self.amp = _Flags(enable=False, dtype="float16", level="O1",
+                          **cfg.get("amp", {}))
+        self.pipeline = _Flags(enable=False, schedule_mode="1F1B",
+                               micro_batch_size=1, accumulate_steps=1,
+                               **cfg.get("pipeline", {}))
+        self.fused_passes = _Flags(enable=False, fused_passes_list=[],
+                                   **cfg.get("fused_passes", {}))
+        self.gradient_merge = _Flags(enable=False, k_steps=1, avg=True,
+                                     **cfg.get("gradient_merge", {}))
+
+
+# --------------------------------------------------- PS-era data configs
+class _EntryBase:
+    def __init__(self, *a):
+        self._args = a
+
+    def _to_attr(self):
+        return f"{type(self).__name__.lower()}:{':'.join(map(str, self._args))}"
+
+
+class CountFilterEntry(_EntryBase):
+    """Parity: dist.CountFilterEntry — sparse-table admission by count."""
+
+    def __init__(self, count_filter):
+        if count_filter < 0:
+            raise ValueError("count_filter must be >= 0")
+        super().__init__(count_filter)
+
+
+class ShowClickEntry(_EntryBase):
+    """Parity: dist.ShowClickEntry — show/click slot names."""
+
+    def __init__(self, show_name, click_name):
+        super().__init__(show_name, click_name)
+
+
+class ProbabilityEntry(_EntryBase):
+    """Parity: dist.ProbabilityEntry — probabilistic admission."""
+
+    def __init__(self, probability):
+        if not 0 <= probability <= 1:
+            raise ValueError("probability must be in [0, 1]")
+        super().__init__(probability)
+
+
+class _PSDataset:
+    """Config surface of the PS dataset pipeline. The parameter-server
+    runtime is excluded from the TPU build (SURVEY A.7): configuration
+    calls work, pipeline execution raises instead of silently no-opping."""
+
+    def __init__(self):
+        self._filelist: List[str] = []
+        self._pipe_command = "cat"
+        self._batch_size = 1
+        self._thread_num = 1
+        self._use_var = []
+
+    def init(self, **kwargs):
+        self._batch_size = kwargs.get("batch_size", self._batch_size)
+        self._thread_num = kwargs.get("thread_num", self._thread_num)
+        self._pipe_command = kwargs.get("pipe_command", self._pipe_command)
+        self._use_var = kwargs.get("use_var", self._use_var)
+
+    update_settings = init
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def _raise(self, what):
+        raise NotImplementedError(
+            f"{type(self).__name__}.{what}: the parameter-server data "
+            "pipeline is not part of the TPU build (SURVEY A.7); use "
+            "paddle.io.DataLoader, which feeds the same training APIs")
+
+    def load_into_memory(self):
+        self._raise("load_into_memory")
+
+    def preload_into_memory(self, thread_num=None):
+        self._raise("preload_into_memory")
+
+    def release_memory(self):
+        return None
+
+
+class QueueDataset(_PSDataset):
+    """Parity: dist.QueueDataset (streaming PS dataset)."""
+
+
+class InMemoryDataset(_PSDataset):
+    """Parity: dist.InMemoryDataset (shuffleable PS dataset)."""
+
+    def local_shuffle(self):
+        self._raise("local_shuffle")
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        self._raise("global_shuffle")

@@ -6,8 +6,16 @@ from .distributed_strategy import DistributedStrategy  # noqa: F401
 from .topology import CommunicateTopology, HybridCommunicateGroup, build_mesh  # noqa: F401
 from .fleet import (  # noqa: F401
     init, is_initialized, distributed_model, distributed_optimizer,
-    get_hybrid_communicate_group, collective_perf,
+    get_hybrid_communicate_group, collective_perf, UtilBase, Fleet, util,
 )
+from .role_maker import (  # noqa: F401
+    Role, UserDefinedRoleMaker, PaddleCloudRoleMaker,
+)
+from .data_generator import (  # noqa: F401
+    MultiSlotDataGenerator, MultiSlotStringDataGenerator,
+)
+from . import base  # noqa: F401
+from . import utils  # noqa: F401
 from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer  # noqa: F401
 from .meta_parallel import (  # noqa: F401
     TensorParallel, ShardingParallel, SegmentParallel, PipelineParallel,

@@ -1,0 +1,2 @@
+"""fleet.base — module-path parity (reference fleet/base/)."""
+from . import topology  # noqa: F401

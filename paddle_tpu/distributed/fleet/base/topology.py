@@ -1,0 +1,8 @@
+"""fleet.base.topology — module-path parity: the implementations live in
+paddle_tpu.distributed.fleet.topology (reference
+fleet/base/topology.py CommunicateTopology/HybridCommunicateGroup)."""
+from ..topology import (  # noqa: F401
+    CommunicateTopology, HybridCommunicateGroup, build_mesh,
+)
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup", "build_mesh"]

@@ -1,0 +1,77 @@
+"""fleet data generators (parity: reference
+fleet/data_generator/data_generator.py — the text-protocol generators
+feeding slot-based data pipelines). Pure python in the reference too;
+implemented fully: user subclasses override generate_sample and the
+generator renders the multi-slot line protocol."""
+from __future__ import annotations
+
+import sys
+
+__all__ = ["MultiSlotDataGenerator", "MultiSlotStringDataGenerator"]
+
+
+class DataGenerator:
+    def __init__(self):
+        self._batch = 1
+        self._proto_info = None
+
+    def set_batch(self, batch_size):
+        self._batch = int(batch_size)
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "subclass DataGenerator and implement generate_sample")
+
+    def generate_batch(self, samples):
+        def local_iter():
+            for s in samples:
+                yield s
+        return local_iter
+
+    def _gen_str(self, userdef):
+        raise NotImplementedError
+
+    def run_from_stdin(self):
+        for line in sys.stdin:
+            it = self.generate_sample(line)
+            if it is None:
+                continue
+            for user in it():
+                sys.stdout.write(self._gen_str(user))
+
+    def run_from_memory(self):
+        out = []
+        it = self.generate_sample(None)
+        for user in it():
+            out.append(self._gen_str(user))
+        return out
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    """Line protocol: `<n> <v1> ... <vn>` per (name, values) slot, values
+    kept as strings."""
+
+    def _gen_str(self, userdef):
+        parts = []
+        for _, values in userdef:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Same protocol with type checking: all values of a slot must be
+    int or float (the reference validates identically)."""
+
+    def _gen_str(self, userdef):
+        parts = []
+        for name, values in userdef:
+            if not values:
+                raise ValueError(f"slot {name}: empty value list")
+            if not all(isinstance(v, (int, float)) for v in values):
+                raise ValueError(
+                    f"slot {name}: values must be int/float, got "
+                    f"{[type(v).__name__ for v in values]}")
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts) + "\n"

@@ -128,6 +128,53 @@ def collective_perf(comm_type="allreduce", round=5, size_and_time=None):
     return results
 
 
+class UtilBase:
+    """Parity: fleet.UtilBase (base/util_factory.py) — cross-worker
+    utility helpers riding the collective layer + local FS."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np
+        import jax.numpy as jnp
+        from ..collective import ReduceOp, all_reduce as _ar
+        from ...core.tensor import Tensor
+        t = input if isinstance(input, Tensor) else Tensor(jnp.asarray(
+            np.asarray(input)))
+        op = {"sum": ReduceOp.SUM, "max": ReduceOp.MAX,
+              "min": ReduceOp.MIN}[mode]
+        _ar(t, op=op)
+        return np.asarray(t._data)
+
+    def barrier(self, comm_world="worker"):
+        from ..collective import barrier as _b
+        _b()
+
+    def all_gather(self, input, comm_world="worker"):
+        out = []
+        import numpy as np
+        import jax.numpy as jnp
+        from ..collective import all_gather as _ag
+        from ...core.tensor import Tensor
+        _ag(out, Tensor(jnp.asarray(np.asarray(input))))
+        return [np.asarray(t._data) for t in out]
+
+    def get_file_shard(self, files):
+        """Split a file list evenly over workers (reference contract:
+        earlier workers take the remainder)."""
+        from ..env import get_rank, get_world_size
+        n, rank = max(get_world_size(), 1), get_rank()
+        per, rem = divmod(len(files), n)
+        start = rank * per + min(rank, rem)
+        return files[start:start + per + (1 if rank < rem else 0)]
+
+    def print_on_rank(self, message, rank_id=0):
+        from ..env import get_rank
+        if get_rank() == rank_id:
+            print(message)
+
+
+util = UtilBase()
+
+
 class _FleetNamespace:
     """`fleet` object surface (so `from paddle_tpu.distributed import fleet`
     followed by fleet.init(...) works like the reference)."""
@@ -147,5 +194,12 @@ class _FleetNamespace:
     def worker_index(self):
         return get_rank()
 
+    @property
+    def util(self):
+        return util
+
+
+# reference exports the class as fleet.Fleet (fleet.py:218)
+Fleet = _FleetNamespace
 
 fleet = _FleetNamespace()

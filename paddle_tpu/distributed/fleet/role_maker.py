@@ -1,0 +1,96 @@
+"""fleet role makers (parity: reference fleet/base/role_maker.py).
+
+On the TPU build every process is a collective worker; the role makers
+are env-derived config objects (the PS server/heter roles are excluded
+per SURVEY A.7 — asking for a server role raises)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["Role", "UserDefinedRoleMaker", "PaddleCloudRoleMaker"]
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class RoleMakerBase:
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = is_collective
+        self._role = kwargs.get("role", Role.WORKER)
+        if self._role == Role.SERVER:
+            raise NotImplementedError(
+                "parameter-server roles are not part of the TPU build "
+                "(SURVEY A.7); every process is a collective WORKER")
+
+    def _is_worker(self):
+        return True
+
+    is_worker = _is_worker
+
+    def _is_server(self):
+        return False
+
+    is_server = _is_server
+
+    def _worker_num(self):
+        from ..env import get_world_size
+        return max(get_world_size(), 1)
+
+    worker_num = _worker_num
+
+    def _worker_index(self):
+        from ..env import get_rank
+        return get_rank()
+
+    worker_index = _worker_index
+
+    def _role_id(self):
+        return self._worker_index()
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    """Parity: explicit ranks/endpoints config."""
+
+    def __init__(self, is_collective=True, current_id=0, role=Role.WORKER,
+                 worker_num=1, worker_endpoints=None, **kwargs):
+        super().__init__(is_collective, role=role, **kwargs)
+        self._current_id = int(current_id)
+        self._n = int(worker_num)
+        self._endpoints = list(worker_endpoints or [])
+
+    def _worker_num(self):
+        return self._n
+
+    worker_num = _worker_num
+
+    def _worker_index(self):
+        return self._current_id
+
+    worker_index = _worker_index
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """Parity: env-driven role maker (PADDLE_TRAINER_ID /
+    PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ENDPOINTS)."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        super().__init__(is_collective, **kwargs)
+        self._current_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self._n = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self._endpoints = [e for e in os.environ.get(
+            "PADDLE_TRAINER_ENDPOINTS", "").split(",") if e]
+
+    def _worker_num(self):
+        return self._n
+
+    worker_num = _worker_num
+
+    def _worker_index(self):
+        return self._current_id
+
+    worker_index = _worker_index

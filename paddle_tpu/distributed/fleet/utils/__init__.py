@@ -1,0 +1,96 @@
+"""fleet.utils (parity: reference fleet/utils/__init__.py __all__ =
+[LocalFS, recompute, DistributedInfer, HDFSClient])."""
+from __future__ import annotations
+
+import os
+import shutil
+
+__all__ = ["LocalFS", "recompute", "DistributedInfer", "HDFSClient"]
+
+
+def recompute(function, *args, **kwargs):
+    """Parity: fleet.utils.recompute (reference fleet/recompute/
+    recompute.py — drop activations in forward, recompute in backward).
+    TPU-native: jax.checkpoint over the Tensor-level function; the tape
+    records ONE op whose vjp re-runs the rematerialized forward."""
+    import jax
+    from ....core.tensor import Tensor
+    from ....ops.dispatch import apply_op
+
+    kwargs.pop("use_reentrant", None)   # accepted, meaningless here
+    kwargs.pop("preserve_rng_state", None)
+    tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+
+    def _f(*arrays):
+        full = list(args)
+        for i, a in zip(tensor_idx, arrays):
+            full[i] = Tensor(a)
+        out = function(*full, **kwargs)
+        return jax.tree_util.tree_map(
+            lambda t: t._data if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda t: isinstance(t, Tensor))
+
+    return apply_op("recompute", jax.checkpoint(_f),
+                    *[args[i] for i in tensor_idx])
+
+
+class LocalFS:
+    """Parity: fleet/utils/fs.py LocalFS — local-filesystem client."""
+
+    def ls_dir(self, path):
+        dirs, files = [], []
+        for e in os.listdir(path):
+            (dirs if os.path.isdir(os.path.join(path, e)) else files).append(e)
+        return dirs, files
+
+    def mkdirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def is_dir(self, path):
+        return os.path.isdir(path)
+
+    def is_file(self, path):
+        return os.path.isfile(path)
+
+    def is_exist(self, path):
+        return os.path.exists(path)
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def rename(self, src, dst):
+        os.rename(src, dst)
+
+    mv = rename
+
+    def upload(self, local, remote):
+        shutil.copy(local, remote)
+
+    def download(self, remote, local):
+        shutil.copy(remote, local)
+
+    def touch(self, path, exist_ok=True):
+        if os.path.exists(path) and not exist_ok:
+            raise FileExistsError(path)
+        open(path, "a").close()
+
+
+class HDFSClient:
+    """HDFS client surface; no hadoop runtime in the TPU image."""
+
+    def __init__(self, hadoop_home=None, configs=None, *a, **k):
+        raise NotImplementedError(
+            "HDFS is not available in the TPU build; use LocalFS or mount "
+            "the data through the host filesystem")
+
+
+class DistributedInfer:
+    """PS-era distributed inference helper; excluded per SURVEY A.7."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        raise NotImplementedError(
+            "DistributedInfer targets the parameter-server runtime "
+            "(SURVEY A.7); use paddle_tpu.inference.Predictor")

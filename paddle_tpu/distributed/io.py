@@ -1,0 +1,61 @@
+"""paddle.distributed.io — persistable save/load for static programs.
+
+Parity: reference `python/paddle/distributed/io.py`
+(save_persistables / load_persistables / is_persistable over a static
+Program + Executor). Here persistables are the parameters and
+global-scope vars of the traced static Program; artifacts are one
+pickled numpy dict per directory (the distributed sharded path is
+distributed.checkpoint).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+__all__ = ["save_persistables", "load_persistables", "is_persistable"]
+
+
+def is_persistable(var) -> bool:
+    """Parameters and named global-scope vars persist; temporaries don't."""
+    from ..core.tensor import Tensor
+    if not isinstance(var, Tensor):
+        return False
+    return bool(getattr(var, "_is_param", False)) or bool(var.name)
+
+
+def _collect(program=None):
+    from ..static import default_main_program, global_scope
+    prog = program or default_main_program()
+    out = {}
+    for name, var in global_scope().vars.items():
+        if is_persistable(var):
+            out[name] = np.asarray(var._data)
+    for p in getattr(prog, "parameters", lambda: [])():
+        if p.name:
+            out[p.name] = np.asarray(p._data)
+    return out
+
+
+def save_persistables(executor=None, dirname="./", main_program=None,
+                      filename=None):
+    os.makedirs(dirname, exist_ok=True)
+    path = os.path.join(dirname, filename or "__persistables__")
+    with open(path, "wb") as f:
+        pickle.dump(_collect(main_program), f)
+    return path
+
+
+def load_persistables(executor=None, dirname="./", main_program=None,
+                      filename=None):
+    import jax.numpy as jnp
+    from ..static import global_scope
+    path = os.path.join(dirname, filename or "__persistables__")
+    with open(path, "rb") as f:
+        state = pickle.load(f)
+    scope = global_scope()
+    for name, arr in state.items():
+        if name in scope.vars:
+            scope.vars[name]._data = jnp.asarray(arr)
+    return state

@@ -1,0 +1,78 @@
+"""paddle.distributed.passes — pass registry (module-path parity).
+
+Parity: reference `python/paddle/distributed/passes/__init__.py`
+(new_pass + PassManager over ~40 program passes). On the TPU build the
+program transformations those passes perform are owned by XLA/GSPMD or
+by the schedule builders; new_pass returns a descriptor that maps a
+known pass name onto the owning subsystem, and raises (rather than
+silently no-ops) for passes with no TPU analog.
+"""
+from __future__ import annotations
+
+__all__ = ["new_pass", "PassManager", "PassContext"]
+
+# pass name -> (owner, how the capability is reached in this build)
+_KNOWN = {
+    "pipeline_scheduler_FThenB": (
+        "distributed.pipeline",
+        "DistributedStrategy.pipeline_configs['schedule_mode']='FThenB'"),
+    "pipeline_scheduler_1F1B": (
+        "distributed.pipeline", "schedule_mode='1F1B'"),
+    "pipeline_scheduler_VPP": (
+        "distributed.pipeline", "interleaved schedule: n_virtual>1"),
+    "pipeline_scheduler_ZBH1": (
+        "distributed.fleet_executor",
+        "ZeroBubbleRunner / schedule_mode='ZBH1'"),
+    "auto_parallel_amp": ("amp", "paddle.amp.auto_cast / strategy.amp"),
+    "auto_parallel_fp16": ("amp", "auto_cast(level='O2')"),
+    "auto_parallel_recompute": (
+        "fleet.utils.recompute", "jax.checkpoint per stage"),
+    "auto_parallel_sharding": (
+        "distributed.sharding", "ZeRO placement policies"),
+    "auto_parallel_gradient_merge_pass": (
+        "fleet.HybridParallelOptimizer", "strategy.gradient_merge"),
+    "fuse_gemm_epilogue": ("XLA", "fused automatically by XLA"),
+    "fused_attention": ("kernels.flash_attention", "Pallas flash"),
+    "fuse_optimizer": ("XLA", "optimizer update fuses under to_static"),
+}
+
+
+class PassContext:
+    def __init__(self):
+        self.attrs = {}
+
+
+class _Pass:
+    def __init__(self, name, attrs=None):
+        self.name = name
+        self.attrs = dict(attrs or {})
+        self._info = _KNOWN.get(name)
+
+    def apply(self, main_programs=None, startup_programs=None,
+              context=None):
+        if self._info is None:
+            raise NotImplementedError(
+                f"pass {self.name!r} has no TPU analog in this build")
+        owner, how = self._info
+        raise NotImplementedError(
+            f"pass {self.name!r} is not applied as a program rewrite on "
+            f"the TPU build — the capability is owned by {owner} ({how})")
+
+    def __repr__(self):
+        return f"Pass({self.name})"
+
+
+def new_pass(name, pass_attrs=None):
+    return _Pass(name, pass_attrs)
+
+
+class PassManager:
+    def __init__(self, passes=None):
+        self.passes = list(passes or [])
+
+    def append(self, p):
+        self.passes.append(p)
+
+    def apply(self, main_programs=None, startup_programs=None):
+        for p in self.passes:
+            p.apply(main_programs, startup_programs, PassContext())

@@ -21,6 +21,7 @@ import time
 from typing import Dict, Optional
 
 __all__ = ["init_rpc", "rpc_sync", "rpc_async", "get_worker_info",
+           "get_current_worker_info",
            "get_all_worker_infos", "shutdown", "WorkerInfo"]
 
 _state = {"name": None, "store": None, "serve": None, "stop": None,
@@ -136,6 +137,13 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
 def get_worker_info(name):
     raw = _state["store"].get(f"rpc/worker/{name}", wait=True)
     return pickle.loads(raw)
+
+
+def get_current_worker_info():
+    """Parity: rpc.get_current_worker_info — this process's WorkerInfo."""
+    if _state["name"] is None:
+        raise RuntimeError("call init_rpc first")
+    return get_worker_info(_state["name"])
 
 
 def get_all_worker_infos():

@@ -1,0 +1,21 @@
+"""paddle.sysconfig — install-tree introspection.
+
+Parity: reference `python/paddle/sysconfig.py` (get_include/get_lib).
+Here the headers/libs of interest are the native extension's
+(_native/), plus jaxlib's for XLA-adjacent builds.
+"""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    """Directory of the C headers shipped with the native runtime."""
+    return os.path.join(_ROOT, "_native", "include")
+
+
+def get_lib() -> str:
+    """Directory holding the built native shared objects."""
+    return os.path.join(_ROOT, "_native", "lib")

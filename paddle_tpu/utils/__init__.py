@@ -6,13 +6,88 @@ flags; settable via FLAGS_* env or paddle.set_flags) and nan/inf checking
 """
 from .flags import set_flags, get_flags, flags  # noqa: F401
 from .nan_inf import check_numerics, enable_check_nan_inf  # noqa: F401
-
-try:  # optional alias paddle.utils.unique_name
-    from . import unique_name  # noqa: F401
-except ImportError:
-    pass
+from . import unique_name  # noqa: F401
+from . import download  # noqa: F401
+from . import dlpack  # noqa: F401
 
 __all__ = ["set_flags", "get_flags", "flags", "check_numerics",
-           "enable_check_nan_inf"]
+           "enable_check_nan_inf", "deprecated", "run_check",
+           "require_version", "try_import", "unique_name", "download",
+           "dlpack"]
 
 from . import cpp_extension  # noqa: F401
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Parity: paddle.utils.deprecated — warn-once decorator."""
+    import functools
+    import warnings
+
+    def deco(fn):
+        warned = []
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not warned:
+                warned.append(1)
+                msg = f"API {fn.__module__}.{fn.__qualname__} is deprecated"
+                if since:
+                    msg += f" since {since}"
+                if update_to:
+                    msg += f", use {update_to} instead"
+                if reason:
+                    msg += f" ({reason})"
+                warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        wrapper.__deprecated__ = True
+        return wrapper
+    return deco
+
+
+def run_check():
+    """Parity: paddle.utils.run_check — smoke-test the install: one
+    matmul on the available device(s), a multi-device mesh if present."""
+    import jax
+    import jax.numpy as jnp
+    devs = jax.devices()
+    x = jnp.ones((128, 128), jnp.float32)
+    (x @ x).block_until_ready()
+    print(f"PaddlePaddle-TPU works on {devs[0].platform} "
+          f"({len(devs)} device(s)).")
+    if len(devs) > 1:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        mesh = Mesh(__import__("numpy").asarray(devs), ("x",))
+        xs = jax.device_put(x, NamedSharding(mesh, P("x")))
+        jnp.sum(xs).block_until_ready()
+        print(f"PaddlePaddle-TPU works on {len(devs)} devices.")
+    print("PaddlePaddle-TPU is installed successfully!")
+
+
+def require_version(min_version, max_version=None):
+    """Parity: paddle.utils.require_version — check the installed
+    version lies in [min_version, max_version]."""
+    from .. import __version__
+
+    def parse(v):
+        return tuple(int(p) for p in str(v).split(".")[:3] if p.isdigit())
+
+    cur = parse(__version__)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {__version__} < required {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {__version__} > allowed {max_version}")
+    return True
+
+
+def try_import(module_name, err_msg=None):
+    """Parity: paddle.utils.try_import — import or raise a helpful
+    ImportError."""
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            err_msg or f"{module_name} is required but not installed; "
+            f"pip install {module_name}") from e

@@ -102,3 +102,57 @@ def load(name=None, sources=None, **kwargs):
     jax/Pallas callables registered with `register_op`, so load() returns
     the live op namespace (and ignores `sources`)."""
     return custom_ops
+
+
+class CppExtension:
+    """Parity: cpp_extension.CppExtension — a setuptools Extension spec
+    for a custom-op shared library. In this build the native toolchain
+    compiles plain C extensions (see _native/); kwargs are carried for
+    the setup() below."""
+
+    def __init__(self, sources=None, *args, **kwargs):
+        self.sources = list(sources or [])
+        self.kwargs = kwargs
+        self.name = kwargs.get("name")
+
+
+class CUDAExtension(CppExtension):
+    """Accepted for source compatibility; CUDA sources cannot build in
+    the TPU image — setup() raises if any .cu file is listed."""
+
+
+def get_build_directory(verbose=False):
+    import os
+    d = os.environ.get("PADDLE_EXTENSION_DIR",
+                       os.path.expanduser("~/.cache/paddle_tpu/extensions"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def setup(name=None, ext_modules=None, **kwargs):
+    """Parity: cpp_extension.setup — build custom-op extensions with
+    setuptools. C++ sources build as plain C extensions (the custom-op
+    ABI here is the python register_op registry + ctypes, no pybind11);
+    .cu sources are rejected with a clear error."""
+    exts = ext_modules if isinstance(ext_modules, (list, tuple)) else \
+        ([ext_modules] if ext_modules else [])
+    for e in exts:
+        srcs = getattr(e, "sources", [])
+        if any(str(s).endswith((".cu", ".cuh")) for s in srcs):
+            raise RuntimeError(
+                "CUDA sources cannot be built in the TPU image; implement "
+                "the kernel in Pallas (jax.experimental.pallas) and attach "
+                "it with register_op instead")
+    import setuptools
+    from setuptools import Extension
+    st_exts = [Extension(getattr(e, "name", None) or name,
+                         sources=getattr(e, "sources", []))
+               for e in exts]
+    return setuptools.setup(name=name, ext_modules=st_exts,
+                            script_args=kwargs.pop("script_args",
+                                                   ["build_ext", "--inplace"]),
+                            **kwargs)
+
+
+__all__ += ["CppExtension", "CUDAExtension", "setup",
+            "get_build_directory"]

@@ -1,0 +1,27 @@
+"""paddle.utils.dlpack — zero-copy tensor exchange.
+
+Parity: reference `python/paddle/utils/dlpack.py` (to_dlpack /
+from_dlpack over the DLPack protocol). TPU-native: jax arrays implement
+`__dlpack__`; host-side interop (numpy/torch-cpu) goes through the
+standard capsule protocol.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Tensor -> DLPack capsule (via the array's __dlpack__)."""
+    d = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return d.__dlpack__()
+
+
+def from_dlpack(capsule_or_array):
+    """DLPack capsule / any __dlpack__-bearing object -> Tensor."""
+    arr = jax.numpy.from_dlpack(capsule_or_array)
+    return Tensor(arr)

@@ -1,0 +1,53 @@
+"""paddle.utils.download — cached artifact fetcher.
+
+Parity: reference `python/paddle/utils/download.py` (get_weights_path_
+from_url / get_path_from_url with md5 check). This build runs in
+zero-egress environments: a file:// URL or an existing local path is
+served from/copied into the cache; a remote URL raises a clear error
+unless the artifact is already cached.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+
+__all__ = ["get_weights_path_from_url", "get_path_from_url"]
+
+WEIGHTS_HOME = os.path.expanduser("~/.cache/paddle_tpu/weights")
+
+
+def _md5check(path, md5sum=None):
+    if md5sum is None:
+        return True
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest() == md5sum
+
+
+def get_path_from_url(url, root_dir=None, md5sum=None, check_exist=True):
+    root_dir = root_dir or WEIGHTS_HOME
+    os.makedirs(root_dir, exist_ok=True)
+    fname = os.path.basename(url.rstrip("/")) or "artifact"
+    cached = os.path.join(root_dir, fname)
+    if check_exist and os.path.exists(cached) and _md5check(cached, md5sum):
+        return cached
+    if url.startswith("file://"):
+        src = url[len("file://"):]
+    elif os.path.exists(url):
+        src = url
+    else:
+        raise RuntimeError(
+            f"cannot fetch {url!r}: this build has no network egress and "
+            f"the artifact is not cached at {cached}. Place the file there "
+            "or pass a local/file:// path.")
+    shutil.copyfile(src, cached)
+    if not _md5check(cached, md5sum):
+        raise RuntimeError(f"md5 mismatch for {cached}")
+    return cached
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)

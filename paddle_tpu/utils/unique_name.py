@@ -1,0 +1,47 @@
+"""paddle.utils.unique_name — process-wide unique name generator.
+
+Parity: reference `python/paddle/utils/unique_name.py` (generate/guard/
+switch over a prefix-counter UniqueNameGenerator).
+"""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["generate", "switch", "guard"]
+
+
+class UniqueNameGenerator:
+    def __init__(self, prefix=""):
+        self.prefix = prefix
+        self.ids = {}
+
+    def __call__(self, key):
+        n = self.ids.get(key, 0)
+        self.ids[key] = n + 1
+        return "_".join(filter(None, [self.prefix, key, str(n)]))
+
+
+generator = UniqueNameGenerator()
+
+
+def generate(key: str) -> str:
+    return generator(key)
+
+
+def switch(new_generator=None):
+    """Swap the active generator; returns the previous one."""
+    global generator
+    old = generator
+    generator = new_generator or UniqueNameGenerator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    if isinstance(new_generator, str):
+        new_generator = UniqueNameGenerator(new_generator)
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)
