@@ -10,8 +10,12 @@ and an XLA FFT, no conv tricks needed.
 """
 from . import functional  # noqa: F401
 from . import features  # noqa: F401
+from . import backends  # noqa: F401
+from . import datasets  # noqa: F401
+from .backends import load, save, info  # noqa: F401
 from .features import (LogMelSpectrogram, MelSpectrogram, MFCC,  # noqa: F401
                        Spectrogram)
 
-__all__ = ["functional", "features", "Spectrogram", "MelSpectrogram",
+__all__ = ["functional", "features", "backends", "datasets", "load",
+           "save", "info", "Spectrogram", "MelSpectrogram",
            "LogMelSpectrogram", "MFCC"]

@@ -1,0 +1,87 @@
+"""paddle.audio.backends — wav IO (parity: audio/backends/wave_backend.py:
+load/save/info over the stdlib wave module, get/set/list_audio_backends).
+"""
+from __future__ import annotations
+
+import wave as _wave
+
+import numpy as np
+
+__all__ = ["info", "load", "save", "get_current_audio_backend",
+           "list_available_backends", "set_backend", "AudioInfo"]
+
+
+class AudioInfo:
+    """Parity: backends.backend.AudioInfo."""
+
+    def __init__(self, sample_rate, num_samples, num_channels,
+                 bits_per_sample, encoding):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+
+def info(filepath):
+    with _wave.open(filepath, "rb") as f:
+        return AudioInfo(f.getframerate(), f.getnframes(), f.getnchannels(),
+                         f.getsampwidth() * 8, "PCM_S")
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """wav -> (Tensor (C, L) or (L, C), sample_rate). normalize=True
+    scales int PCM to [-1, 1] float32 (reference contract)."""
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+    with _wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        n = f.getnframes()
+        ch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(min(frame_offset, n))
+        take = n - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(take)
+    dtype = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
+    data = np.frombuffer(raw, dtype).reshape(-1, ch)
+    if normalize:
+        if width == 1:
+            data = (data.astype(np.float32) - 128.0) / 128.0
+        else:
+            data = data.astype(np.float32) / float(2 ** (width * 8 - 1))
+    arr = data.T if channels_first else data
+    return Tensor(jnp.asarray(arr)), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         encoding="PCM_16", bits_per_sample=16):
+    """Tensor/array -> 16-bit PCM wav."""
+    a = np.asarray(getattr(src, "_data", src))
+    if channels_first:
+        a = a.T
+    if a.ndim == 1:
+        a = a[:, None]
+    if a.dtype.kind == "f":
+        a = np.clip(a, -1.0, 1.0)
+        a = (a * 32767.0).astype(np.int16)
+    with _wave.open(filepath, "wb") as f:
+        f.setnchannels(a.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(a.astype("<i2").tobytes())
+
+
+def get_current_audio_backend():
+    return "wave_backend"
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def set_backend(backend_name):
+    if backend_name != "wave_backend":
+        raise NotImplementedError(
+            f"backend {backend_name!r} unavailable; only the stdlib wave "
+            "backend ships in the TPU build (no soundfile/sox)")

@@ -1,0 +1,121 @@
+"""paddle.audio.datasets — ESC50 / TESS audio classification datasets.
+
+Parity: reference `python/paddle/audio/datasets/` (ESC50, TESS over
+AudioClassificationDataset: wav files -> (feature, label)). Zero-egress
+build: reads the standard local extraction; synthetic fallback otherwise
+(same stance as vision.datasets.MNIST).
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["ESC50", "TESS"]
+
+_DATA_HOME = os.path.expanduser(os.environ.get("PADDLE_TPU_DATA_HOME",
+                                               "~/.cache/paddle_tpu/datasets"))
+
+
+class AudioClassificationDataset(Dataset):
+    """(waveform, label) pairs with an optional feature transform."""
+
+    def __init__(self, files, labels, sample_rate, feat_type="raw",
+                 archive=None, **kwargs):
+        self.files = files
+        self.labels = labels
+        self.sample_rate = sample_rate
+        self.feat_type = feat_type
+        self.feat_config = kwargs
+
+    def _feature(self, wav):
+        import jax.numpy as jnp
+        from ..core.tensor import Tensor
+        if self.feat_type == "raw":
+            return Tensor(jnp.asarray(wav, jnp.float32))
+        from .features import MelSpectrogram
+        if self.feat_type == "mel_spectrogram":
+            m = MelSpectrogram(sr=self.sample_rate, **self.feat_config)
+            return m(Tensor(jnp.asarray(wav, jnp.float32)[None]))
+        raise ValueError(f"unknown feat_type {self.feat_type}")
+
+    def __getitem__(self, idx):
+        f = self.files[idx]
+        if isinstance(f, np.ndarray):
+            wav = f
+        else:
+            from .backends import load
+            t, _ = load(f, channels_first=False)
+            wav = np.asarray(t._data)[:, 0]
+        return self._feature(wav), np.asarray(self.labels[idx], np.int64)
+
+    def __len__(self):
+        return len(self.files)
+
+
+def _synthetic(n, sr, n_classes, seconds=1):
+    rng = np.random.RandomState(0)
+    waves = [rng.randn(sr * seconds).astype(np.float32) * 0.1
+             for _ in range(n)]
+    labels = rng.randint(0, n_classes, n)
+    return waves, labels
+
+
+class ESC50(AudioClassificationDataset):
+    """Parity: audio.datasets.ESC50 (2000 clips, 50 classes, 5 folds)."""
+
+    sample_rate = 44100
+
+    def __init__(self, mode="train", split=1, feat_type="raw",
+                 archive=None, **kwargs):
+        base = os.path.join(_DATA_HOME, "esc50", "ESC-50-master")
+        meta = os.path.join(base, "meta", "esc50.csv")
+        if os.path.exists(meta):
+            import csv
+            files, labels = [], []
+            with open(meta) as f:
+                for row in csv.DictReader(f):
+                    in_fold = int(row["fold"]) == int(split)
+                    if (mode == "train") != in_fold:
+                        files.append(os.path.join(base, "audio",
+                                                  row["filename"]))
+                        labels.append(int(row["target"]))
+        else:
+            n = 160 if mode == "train" else 40
+            files, labels = _synthetic(n, 4410, 50)
+        super().__init__(files, labels, self.sample_rate, feat_type,
+                         **kwargs)
+
+
+class TESS(AudioClassificationDataset):
+    """Parity: audio.datasets.TESS (2800 clips, 7 emotions)."""
+
+    sample_rate = 24414
+    emotions = ["angry", "disgust", "fear", "happy", "neutral",
+                "ps", "sad"]
+
+    def __init__(self, mode="train", n_folds=5, split=1, feat_type="raw",
+                 archive=None, **kwargs):
+        base = os.path.join(_DATA_HOME, "tess",
+                            "TESS_Toronto_emotional_speech_set_data")
+        if os.path.isdir(base):
+            files, labels = [], []
+            wavs = []
+            for dirpath, _, fs in sorted(os.walk(base)):
+                wavs += [os.path.join(dirpath, f) for f in sorted(fs)
+                         if f.lower().endswith(".wav")]
+            for i, w in enumerate(wavs):
+                emo = os.path.basename(w).split("_")[-1][:-4].lower()
+                label = self.emotions.index(emo) if emo in self.emotions \
+                    else 0
+                in_fold = (i % n_folds) + 1 == int(split)
+                if (mode == "train") != in_fold:
+                    files.append(w)
+                    labels.append(label)
+        else:
+            n = 112 if mode == "train" else 28
+            files, labels = _synthetic(n, 2441, 7)
+        super().__init__(files, labels, self.sample_rate, feat_type,
+                        **kwargs)

@@ -139,3 +139,177 @@ class Cifar100(Cifar10):
             self._fake = FakeImageDataset(n, (32, 32, 3), self.num_classes)
             self.data = None
             self._n = n
+
+
+def _load_image(path, backend=None):
+    """Image file -> HWC uint8 numpy (PIL backend, 'cv2' unavailable)."""
+    from PIL import Image
+    with Image.open(path) as im:
+        return np.asarray(im.convert("RGB"))
+
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm",
+                  ".tif", ".tiff", ".webp")
+
+
+class DatasetFolder(Dataset):
+    """Parity: vision.datasets.DatasetFolder — `root/<class>/<file>`
+    layout; classes are the sorted subdirectory names."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or _load_image
+        self.transform = transform
+        exts = tuple(e.lower() for e in (extensions or IMG_EXTENSIONS))
+        classes = sorted(e for e in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, e)))
+        if not classes:
+            raise RuntimeError(f"DatasetFolder: no class folders in {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for f in sorted(files):
+                    path = os.path.join(dirpath, f)
+                    ok = is_valid_file(path) if is_valid_file else \
+                        f.lower().endswith(exts)
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(
+                f"DatasetFolder: no valid files under {root} "
+                f"(extensions {exts})")
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(target, np.int64)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Parity: vision.datasets.ImageFolder — a flat (or nested) folder of
+    images, no labels."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or _load_image
+        self.transform = transform
+        exts = tuple(e.lower() for e in (extensions or IMG_EXTENSIONS))
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for f in sorted(files):
+                path = os.path.join(dirpath, f)
+                ok = is_valid_file(path) if is_valid_file else \
+                    f.lower().endswith(exts)
+                if ok:
+                    self.samples.append(path)
+        if not self.samples:
+            raise RuntimeError(f"ImageFolder: no valid files under {root}")
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Flowers(Dataset):
+    """Parity: vision.datasets.Flowers (102 Category Flowers). Reads the
+    standard local artifacts (102flowers.tgz extracted + setid.mat +
+    imagelabels.mat) under data_file; synthetic fallback when absent
+    (zero-egress build — same stance as MNIST above)."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        self.transform = transform
+        base = data_file or os.path.join(_DATA_HOME, "flowers")
+        jpg_dir = os.path.join(base, "jpg")
+        labels_f = label_file or os.path.join(base, "imagelabels.mat")
+        setid_f = setid_file or os.path.join(base, "setid.mat")
+        if os.path.isdir(jpg_dir) and os.path.exists(labels_f) \
+                and os.path.exists(setid_f):
+            from scipy.io import loadmat
+            labels = loadmat(labels_f)["labels"].reshape(-1)
+            key = {"train": "trnid", "valid": "valid",
+                   "test": "tstid"}[mode]
+            ids = loadmat(setid_f)[key].reshape(-1)
+            self._items = [
+                (os.path.join(jpg_dir, f"image_{i:05d}.jpg"),
+                 int(labels[i - 1]) - 1) for i in ids]
+            self._fake = None
+        else:
+            n = {"train": 1020, "valid": 1020, "test": 6149}[mode]
+            self._fake = FakeImageDataset(n, (64, 64, 3), 102)
+            self._items = None
+            self._n = n
+
+    def __getitem__(self, idx):
+        if self._fake is not None:
+            img, label = self._fake[idx]
+        else:
+            path, label = self._items[idx]
+            img = _load_image(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(label, np.int64)
+
+    def __len__(self):
+        return self._n if self._items is None else len(self._items)
+
+
+class VOC2012(Dataset):
+    """Parity: vision.datasets.VOC2012 (segmentation pairs). Reads a
+    local VOCdevkit/VOC2012 tree; synthetic (image, mask) fallback when
+    absent."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.transform = transform
+        base = data_file or os.path.join(_DATA_HOME, "VOCdevkit", "VOC2012")
+        lst = os.path.join(base, "ImageSets", "Segmentation",
+                           {"train": "train", "valid": "val",
+                            "test": "val"}[mode] + ".txt")
+        if os.path.exists(lst):
+            names = [l.strip() for l in open(lst) if l.strip()]
+            self._items = [
+                (os.path.join(base, "JPEGImages", n + ".jpg"),
+                 os.path.join(base, "SegmentationClass", n + ".png"))
+                for n in names]
+        else:
+            self._items = None
+            self._n = 32
+            rng = np.random.RandomState(0)
+            self._imgs = rng.randint(0, 255, (self._n, 64, 64, 3),
+                                     np.uint8)
+            self._masks = rng.randint(0, 21, (self._n, 64, 64), np.uint8)
+
+    def __getitem__(self, idx):
+        if self._items is None:
+            img, mask = self._imgs[idx], self._masks[idx]
+        else:
+            ip, mp = self._items[idx]
+            from PIL import Image
+            img = _load_image(ip)
+            with Image.open(mp) as m:
+                mask = np.asarray(m)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, mask
+
+    def __len__(self):
+        return self._n if self._items is None else len(self._items)
+
+
+__all__ += ["DatasetFolder", "ImageFolder", "Flowers", "VOC2012"]

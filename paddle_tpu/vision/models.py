@@ -336,8 +336,24 @@ def resnext50_32x4d(pretrained=False, **kwargs):
     return ResNet(BottleneckBlock, 50, width=4, groups=32, **kwargs)
 
 
+def resnext50_64x4d(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 50, width=4, groups=64, **kwargs)
+
+
+def resnext101_32x4d(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 101, width=4, groups=32, **kwargs)
+
+
 def resnext101_64x4d(pretrained=False, **kwargs):
     return ResNet(BottleneckBlock, 101, width=4, groups=64, **kwargs)
+
+
+def resnext152_32x4d(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 152, width=4, groups=32, **kwargs)
+
+
+def resnext152_64x4d(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 152, width=4, groups=64, **kwargs)
 
 
 class MobileNetV1(nn.Layer):
@@ -487,12 +503,26 @@ class MobileNetV3(nn.Layer):
         return x
 
 
+class MobileNetV3Large(MobileNetV3):
+    """Parity: vision.models.MobileNetV3Large."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__("large", scale, num_classes, with_pool)
+
+
+class MobileNetV3Small(MobileNetV3):
+    """Parity: vision.models.MobileNetV3Small."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__("small", scale, num_classes, with_pool)
+
+
 def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
-    return MobileNetV3("large", scale=scale, **kwargs)
+    return MobileNetV3Large(scale=scale, **kwargs)
 
 
 def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
-    return MobileNetV3("small", scale=scale, **kwargs)
+    return MobileNetV3Small(scale=scale, **kwargs)
 
 
 class SqueezeNet(nn.Layer):
@@ -512,18 +542,30 @@ class SqueezeNet(nn.Layer):
             s = self.squeeze(x)
             return concat([self.e1(s), self.e3(s)], axis=1)
 
-    def __init__(self, num_classes=1000):
+    def __init__(self, num_classes=1000, version="1.1"):
         super().__init__()
         F = SqueezeNet.Fire
-        self.features = nn.Sequential(
-            nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(),
-            nn.MaxPool2D(3, 2),
-            F(64, 16, 64, 64), F(128, 16, 64, 64),
-            nn.MaxPool2D(3, 2),
-            F(128, 32, 128, 128), F(256, 32, 128, 128),
-            nn.MaxPool2D(3, 2),
-            F(256, 48, 192, 192), F(384, 48, 192, 192),
-            F(384, 64, 256, 256), F(512, 64, 256, 256))
+        if version == "1.0":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 96, 7, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, 2),
+                F(96, 16, 64, 64), F(128, 16, 64, 64),
+                F(128, 32, 128, 128),
+                nn.MaxPool2D(3, 2),
+                F(256, 32, 128, 128), F(256, 48, 192, 192),
+                F(384, 48, 192, 192), F(384, 64, 256, 256),
+                nn.MaxPool2D(3, 2),
+                F(512, 64, 256, 256))
+        else:
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, 2),
+                F(64, 16, 64, 64), F(128, 16, 64, 64),
+                nn.MaxPool2D(3, 2),
+                F(128, 32, 128, 128), F(256, 32, 128, 128),
+                nn.MaxPool2D(3, 2),
+                F(256, 48, 192, 192), F(384, 48, 192, 192),
+                F(384, 64, 256, 256), F(512, 64, 256, 256))
         self.classifier = nn.Sequential(
             nn.Dropout(), nn.Conv2D(512, num_classes, 1), nn.ReLU(),
             nn.AdaptiveAvgPool2D((1, 1)))
@@ -534,13 +576,18 @@ class SqueezeNet(nn.Layer):
         return flatten(x, 1)
 
 
+def squeezenet1_0(pretrained=False, **kwargs):
+    return SqueezeNet(version="1.0", **kwargs)
+
+
 def squeezenet1_1(pretrained=False, **kwargs):
-    return SqueezeNet(**kwargs)
+    return SqueezeNet(version="1.1", **kwargs)
 
 
 class _ShuffleUnit(nn.Layer):
-    def __init__(self, inp, oup, stride):
+    def __init__(self, inp, oup, stride, act="relu"):
         super().__init__()
+        Act = nn.Swish if act == "swish" else nn.ReLU
         self.stride = stride
         branch = oup // 2
         if stride == 2:
@@ -549,19 +596,19 @@ class _ShuffleUnit(nn.Layer):
                           bias_attr=False),
                 nn.BatchNorm2D(inp),
                 nn.Conv2D(inp, branch, 1, bias_attr=False),
-                nn.BatchNorm2D(branch), nn.ReLU())
+                nn.BatchNorm2D(branch), Act())
             in2 = inp
         else:
             self.branch1 = None
             in2 = inp // 2
         self.branch2 = nn.Sequential(
             nn.Conv2D(in2, branch, 1, bias_attr=False),
-            nn.BatchNorm2D(branch), nn.ReLU(),
+            nn.BatchNorm2D(branch), Act(),
             nn.Conv2D(branch, branch, 3, stride=stride, padding=1,
                       groups=branch, bias_attr=False),
             nn.BatchNorm2D(branch),
             nn.Conv2D(branch, branch, 1, bias_attr=False),
-            nn.BatchNorm2D(branch), nn.ReLU())
+            nn.BatchNorm2D(branch), Act())
         self.shuffle = nn.ChannelShuffle(2)
 
     def forward(self, x):
@@ -577,28 +624,31 @@ class _ShuffleUnit(nn.Layer):
 class ShuffleNetV2(nn.Layer):
     """Parity: vision/models/shufflenetv2.py (x1.0)."""
 
-    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True,
+                 act="relu"):
         super().__init__()
-        stage_out = {0.5: [48, 96, 192, 1024], 1.0: [116, 232, 464, 1024],
+        stage_out = {0.25: [24, 48, 96, 512], 0.33: [32, 64, 128, 512],
+                     0.5: [48, 96, 192, 1024], 1.0: [116, 232, 464, 1024],
                      1.5: [176, 352, 704, 1024],
                      2.0: [244, 488, 976, 2048]}[scale]
+        Act = nn.Swish if act == "swish" else nn.ReLU
         self.conv1 = nn.Sequential(
             nn.Conv2D(3, 24, 3, stride=2, padding=1, bias_attr=False),
-            nn.BatchNorm2D(24), nn.ReLU())
+            nn.BatchNorm2D(24), Act())
         self.maxpool = nn.MaxPool2D(3, 2, padding=1)
         inp = 24
         stages = []
         for i, reps in enumerate([4, 8, 4]):
             oup = stage_out[i]
-            units = [_ShuffleUnit(inp, oup, 2)]
+            units = [_ShuffleUnit(inp, oup, 2, act)]
             for _ in range(reps - 1):
-                units.append(_ShuffleUnit(oup, oup, 1))
+                units.append(_ShuffleUnit(oup, oup, 1, act))
             stages.append(nn.Sequential(*units))
             inp = oup
         self.stages = nn.Sequential(*stages)
         self.conv_last = nn.Sequential(
             nn.Conv2D(inp, stage_out[3], 1, bias_attr=False),
-            nn.BatchNorm2D(stage_out[3]), nn.ReLU())
+            nn.BatchNorm2D(stage_out[3]), Act())
         self.with_pool, self.num_classes = with_pool, num_classes
         if with_pool:
             self.pool = nn.AdaptiveAvgPool2D((1, 1))
@@ -617,6 +667,30 @@ class ShuffleNetV2(nn.Layer):
 
 def shufflenet_v2_x1_0(pretrained=False, **kwargs):
     return ShuffleNetV2(scale=1.0, **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.25, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.33, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.5, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.5, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=2.0, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, act="swish", **kwargs)
 
 
 class _DenseLayer(nn.Layer):
@@ -675,6 +749,22 @@ class DenseNet(nn.Layer):
 
 def densenet121(pretrained=False, **kwargs):
     return DenseNet(121, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return DenseNet(161, growth_rate=48, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return DenseNet(169, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return DenseNet(201, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return DenseNet(264, **kwargs)
 
 
 class _Inception(nn.Layer):
@@ -737,3 +827,159 @@ class GoogLeNet(nn.Layer):
 
 def googlenet(pretrained=False, **kwargs):
     return GoogLeNet(**kwargs)
+
+
+class _ConvBN(nn.Layer):
+    def __init__(self, inp, oup, k, stride=1, padding=0):
+        super().__init__()
+        self.fn = nn.Sequential(
+            nn.Conv2D(inp, oup, k, stride=stride, padding=padding,
+                      bias_attr=False),
+            nn.BatchNorm2D(oup), nn.ReLU())
+
+    def forward(self, x):
+        return self.fn(x)
+
+
+class _InceptionA(nn.Layer):
+    def __init__(self, inp, pool_ch):
+        super().__init__()
+        self.b1 = _ConvBN(inp, 64, 1)
+        self.b5 = nn.Sequential(_ConvBN(inp, 48, 1),
+                                _ConvBN(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(_ConvBN(inp, 64, 1),
+                                _ConvBN(64, 96, 3, padding=1),
+                                _ConvBN(96, 96, 3, padding=1))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, 1, padding=1),
+                                _ConvBN(inp, pool_ch, 1))
+
+    def forward(self, x):
+        from ..ops.manipulation import concat
+        return concat([self.b1(x), self.b5(x), self.b3(x), self.bp(x)],
+                      axis=1)
+
+
+class _InceptionB(nn.Layer):  # grid reduction 35 -> 17
+    def __init__(self, inp):
+        super().__init__()
+        self.b3 = _ConvBN(inp, 384, 3, stride=2)
+        self.b33 = nn.Sequential(_ConvBN(inp, 64, 1),
+                                 _ConvBN(64, 96, 3, padding=1),
+                                 _ConvBN(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, 2)
+
+    def forward(self, x):
+        from ..ops.manipulation import concat
+        return concat([self.b3(x), self.b33(x), self.pool(x)], axis=1)
+
+
+class _InceptionC(nn.Layer):  # factorized 7x7
+    def __init__(self, inp, ch7):
+        super().__init__()
+        self.b1 = _ConvBN(inp, 192, 1)
+        self.b7 = nn.Sequential(
+            _ConvBN(inp, ch7, 1),
+            _ConvBN(ch7, ch7, (1, 7), padding=(0, 3)),
+            _ConvBN(ch7, 192, (7, 1), padding=(3, 0)))
+        self.b77 = nn.Sequential(
+            _ConvBN(inp, ch7, 1),
+            _ConvBN(ch7, ch7, (7, 1), padding=(3, 0)),
+            _ConvBN(ch7, ch7, (1, 7), padding=(0, 3)),
+            _ConvBN(ch7, ch7, (7, 1), padding=(3, 0)),
+            _ConvBN(ch7, 192, (1, 7), padding=(0, 3)))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, 1, padding=1),
+                                _ConvBN(inp, 192, 1))
+
+    def forward(self, x):
+        from ..ops.manipulation import concat
+        return concat([self.b1(x), self.b7(x), self.b77(x), self.bp(x)],
+                      axis=1)
+
+
+class _InceptionD(nn.Layer):  # grid reduction 17 -> 8
+    def __init__(self, inp):
+        super().__init__()
+        self.b3 = nn.Sequential(_ConvBN(inp, 192, 1),
+                                _ConvBN(192, 320, 3, stride=2))
+        self.b7 = nn.Sequential(
+            _ConvBN(inp, 192, 1),
+            _ConvBN(192, 192, (1, 7), padding=(0, 3)),
+            _ConvBN(192, 192, (7, 1), padding=(3, 0)),
+            _ConvBN(192, 192, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, 2)
+
+    def forward(self, x):
+        from ..ops.manipulation import concat
+        return concat([self.b3(x), self.b7(x), self.pool(x)], axis=1)
+
+
+class _InceptionE(nn.Layer):  # expanded-filter-bank output blocks
+    def __init__(self, inp):
+        super().__init__()
+        self.b1 = _ConvBN(inp, 320, 1)
+        self.b3_stem = _ConvBN(inp, 384, 1)
+        self.b3_a = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.b33_stem = nn.Sequential(_ConvBN(inp, 448, 1),
+                                      _ConvBN(448, 384, 3, padding=1))
+        self.b33_a = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b33_b = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, 1, padding=1),
+                                _ConvBN(inp, 192, 1))
+
+    def forward(self, x):
+        from ..ops.manipulation import concat
+        s = self.b3_stem(x)
+        t = self.b33_stem(x)
+        return concat([self.b1(x),
+                       concat([self.b3_a(s), self.b3_b(s)], axis=1),
+                       concat([self.b33_a(t), self.b33_b(t)], axis=1),
+                       self.bp(x)], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    """Parity: vision/models/inceptionv3.py (Szegedy et al. 2015; the
+    standard A/B/C/D/E block stack over a 299x299 stem; aux head omitted
+    like the reference at inference)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = nn.Sequential(
+            _ConvBN(3, 32, 3, stride=2), _ConvBN(32, 32, 3),
+            _ConvBN(32, 64, 3, padding=1), nn.MaxPool2D(3, 2),
+            _ConvBN(64, 80, 1), _ConvBN(80, 192, 3), nn.MaxPool2D(3, 2))
+        self.blocks = nn.Sequential(
+            _InceptionA(192, 32), _InceptionA(256, 64), _InceptionA(288, 64),
+            _InceptionB(288),
+            _InceptionC(768, 128), _InceptionC(768, 160),
+            _InceptionC(768, 160), _InceptionC(768, 192),
+            _InceptionD(768),
+            _InceptionE(1280), _InceptionE(2048))
+        self.with_pool, self.num_classes = with_pool, num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.head = nn.Sequential(nn.Dropout(0.5),
+                                      nn.Linear(2048, num_classes))
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ..ops.manipulation import flatten
+            x = self.head(flatten(x, 1))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    return InceptionV3(**kwargs)
+
+
+__all__ += ["resnext50_64x4d", "resnext101_32x4d", "resnext152_32x4d",
+            "resnext152_64x4d", "MobileNetV3Small", "MobileNetV3Large",
+            "densenet161", "densenet169", "densenet201", "densenet264",
+            "InceptionV3", "inception_v3", "squeezenet1_0",
+            "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+            "shufflenet_v2_x0_5", "shufflenet_v2_x1_5",
+            "shufflenet_v2_x2_0", "shufflenet_v2_swish"]

@@ -512,3 +512,123 @@ class RandomErasing:
                     a[y:y + eh, x:x + ew] = self.value
                 break
         return a
+
+
+class BaseTransform:
+    """Parity: transforms.BaseTransform — the base class of the paired
+    image/label transform protocol (keys select which inputs the
+    transform touches; subclasses implement _apply_image et al.)."""
+
+    def __init__(self, keys=None):
+        self.keys = keys if keys is not None else ("image",)
+
+    def _get_params(self, inputs):
+        return None
+
+    def _apply_image(self, image):
+        return image
+
+    def _apply_boxes(self, boxes):
+        return boxes
+
+    def _apply_mask(self, mask):
+        return mask
+
+    def __call__(self, inputs):
+        single = not isinstance(inputs, (list, tuple))
+        items = (inputs,) if single else tuple(inputs)
+        self.params = self._get_params(items)
+        out = []
+        for key, item in zip(self.keys, items):
+            base = key.rstrip("0123456789")
+            fn = getattr(self, f"_apply_{base}", None)
+            out.append(fn(item) if fn is not None else item)
+        out += list(items[len(self.keys):])
+        return out[0] if single else tuple(out)
+
+
+# ------------------------- functional forms (transforms.functional) ----
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Parity: transforms.rotate — fixed-angle rotation about the image
+    center (nearest sampling)."""
+    if expand or center is not None:
+        raise NotImplementedError("rotate expand/center not supported")
+    a = _chw(np.asarray(img))
+    ang = np.deg2rad(angle)
+    c, s = np.cos(ang), np.sin(ang)
+    mat = np.array([[c, -s, 0.0], [s, c, 0.0]], np.float32)
+    return _affine_grid_sample(a, mat, fill)
+
+
+def affine(img, angle=0.0, translate=(0, 0), scale=1.0, shear=(0.0, 0.0),
+           interpolation="nearest", fill=0, center=None):
+    """Parity: transforms.affine — deterministic affine warp."""
+    if center is not None:
+        raise NotImplementedError("affine center not supported")
+    a = _chw(np.asarray(img))
+    if isinstance(shear, numbers.Number):
+        shear = (shear, 0.0)
+    ang = np.deg2rad(angle)
+    shx = np.deg2rad(shear[0])
+    c, s = np.cos(ang), np.sin(ang)
+    rot = np.array([[c, -s], [s, c]], np.float32)
+    sh = np.array([[1.0, np.tan(shx)], [0.0, 1.0]], np.float32)
+    lin = (rot @ sh) / float(scale)
+    mat = np.array([[lin[0, 0], lin[0, 1], -translate[0]],
+                    [lin[1, 0], lin[1, 1], -translate[1]]], np.float32)
+    return _affine_grid_sample(a, mat, fill)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """Parity: transforms.perspective — warp mapping endpoints back onto
+    startpoints (8-dof projective fit, nearest sampling)."""
+    a = _chw(np.asarray(img))
+    h, w = a.shape[:2]
+    src = np.asarray(startpoints, np.float32)
+    dst = np.asarray(endpoints, np.float32)
+    A = []
+    for (x, y), (u, v) in zip(dst, src):
+        A.append([x, y, 1, 0, 0, 0, -u * x, -u * y])
+        A.append([0, 0, 0, x, y, 1, -v * x, -v * y])
+    coef = np.linalg.lstsq(np.array(A, np.float32), src.reshape(-1),
+                           rcond=None)[0]
+    M = np.append(coef, 1.0).reshape(3, 3)
+    yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    den = M[2, 0] * xx + M[2, 1] * yy + M[2, 2]
+    sx = (M[0, 0] * xx + M[0, 1] * yy + M[0, 2]) / den
+    sy = (M[1, 0] * xx + M[1, 1] * yy + M[1, 2]) / den
+    return _grid_sample_nearest(a, sx, sy, fill)
+
+
+def to_grayscale(img, num_output_channels=1):
+    """Parity: transforms.to_grayscale."""
+    return Grayscale(num_output_channels)(img)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Parity: transforms.erase — fill the (i, j, h, w) box with v.
+    Accepts Tensors (CHW) or numpy arrays (CHW/HWC)."""
+    from ..core.tensor import Tensor
+    if isinstance(img, Tensor):
+        import jax.numpy as jnp
+        a = img._data
+        patch = jnp.broadcast_to(jnp.asarray(v, a.dtype),
+                                 a[..., i:i + h, j:j + w].shape)
+        out = a.at[..., i:i + h, j:j + w].set(patch)
+        if inplace:
+            img._data = out
+            return img
+        return Tensor(out)
+    a = np.array(img, copy=not inplace)
+    chw = a.ndim == 3 and a.shape[0] in (1, 3)
+    if chw:
+        a[:, i:i + h, j:j + w] = v
+    else:
+        a[i:i + h, j:j + w] = v
+    return a
+
+
+__all__ += ["BaseTransform", "affine", "rotate", "perspective",
+            "to_grayscale", "erase"]
