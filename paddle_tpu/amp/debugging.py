@@ -109,3 +109,88 @@ def enable_tensor_checker(config: TensorCheckerConfig):
 def disable_tensor_checker():
     from ..utils.flags import set_flags
     set_flags({"FLAGS_check_nan_inf": False})
+
+
+class DebugMode:
+    """Parity: amp.debugging.DebugMode (tensor-checker verbosity levels)."""
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL_FOR_OVERFLOW = 2
+    CHECK_ALL = 3
+    CHECK_ALL_AND_ABORT = 4
+    DUMP_ALL = 5
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+    """Parity: amp.debugging.check_numerics — count/flag nan/inf in one
+    tensor; returns (stats, values) like the reference kernel's outputs:
+    stats = [num_nan, num_inf, num_zero], values = [max, min, mean]."""
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    from ..ops.dispatch import apply_op
+
+    def _f(a):
+        af = a.astype(jnp.float32)
+        stats = jnp.stack([jnp.isnan(af).sum(), jnp.isinf(af).sum(),
+                           (af == 0).sum()]).astype(jnp.int64)
+        finite = jnp.where(jnp.isfinite(af), af, 0.0)
+        values = jnp.stack([finite.max(), finite.min(), finite.mean()])
+        return stats, values
+
+    return apply_op("check_numerics", _f, tensor)
+
+
+def check_layer_numerics(func):
+    """Parity: amp.debugging.check_layer_numerics — decorator for a
+    Layer.forward that validates every input/output tensor."""
+    import functools
+    from ..core.tensor import Tensor
+
+    @functools.wraps(func)
+    def wrapper(self, *args, **kwargs):
+        import numpy as np
+        for i, a in enumerate(args):
+            if isinstance(a, Tensor):
+                stats, _ = check_numerics(a)
+                s = np.asarray(stats._data)
+                if s[0] or s[1]:
+                    raise RuntimeError(
+                        f"{type(self).__name__} input {i}: {int(s[0])} nan "
+                        f"/ {int(s[1])} inf values")
+        out = func(self, *args, **kwargs)
+        if isinstance(out, Tensor):
+            stats, _ = check_numerics(out)
+            s = np.asarray(stats._data)
+            if s[0] or s[1]:
+                raise RuntimeError(
+                    f"{type(self).__name__} output: {int(s[0])} nan / "
+                    f"{int(s[1])} inf values")
+        return out
+    return wrapper
+
+
+def compare_accuracy(dump_path, another_dump_path, output_filename,
+                     loss_scale=1.0, dump_all_module_name=None):
+    """Parity: amp.debugging.compare_accuracy — diff two operator-stats
+    dumps (produced by collect_operator_stats runs) into a CSV report."""
+    import csv
+    import json
+    import os
+
+    def load(path):
+        with open(path) as f:
+            return json.load(f)
+
+    a, b = load(dump_path), load(another_dump_path)
+    keys = sorted(set(a) | set(b))
+    os.makedirs(os.path.dirname(output_filename) or ".", exist_ok=True)
+    with open(output_filename, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["op", "run1", "run2", "equal"])
+        for k in keys:
+            w.writerow([k, a.get(k), b.get(k), a.get(k) == b.get(k)])
+    return output_filename
+
+
+__all__ += ["DebugMode", "check_numerics", "check_layer_numerics",
+            "compare_accuracy"]

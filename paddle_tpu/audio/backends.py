@@ -85,3 +85,7 @@ def set_backend(backend_name):
         raise NotImplementedError(
             f"backend {backend_name!r} unavailable; only the stdlib wave "
             "backend ships in the TPU build (no soundfile/sox)")
+
+
+get_current_backend = get_current_audio_backend
+__all__ += ["get_current_backend"]

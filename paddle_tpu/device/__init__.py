@@ -147,6 +147,47 @@ class _CudaNamespace:
     def empty_cache():
         pass
 
+    @staticmethod
+    def current_stream(device=None):
+        return Stream()
+
+    @staticmethod
+    def synchronize(device=None):
+        import jax
+        import jax.numpy as jnp
+        jnp.zeros(()).block_until_ready()
+
+    @staticmethod
+    def stream_guard(stream):
+        import contextlib
+        return contextlib.nullcontext(stream)
+
+    @staticmethod
+    def get_device_properties(device=None):
+        import jax
+        d = jax.devices()[0]
+        class _Props:
+            name = getattr(d, "device_kind", d.platform)
+            major, minor = 0, 0
+            total_memory = 0
+            multi_processor_count = 0
+        try:
+            _Props.total_memory = int((d.memory_stats() or {}).get(
+                "bytes_limit", 0))
+        except Exception:
+            pass
+        return _Props()
+
+    @staticmethod
+    def get_device_name(device=None):
+        import jax
+        d = jax.devices()[0]
+        return getattr(d, "device_kind", d.platform)
+
+    @staticmethod
+    def get_device_capability(device=None):
+        return (0, 0)
+
     Stream = Stream
     Event = Event
 

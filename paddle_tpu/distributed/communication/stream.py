@@ -16,3 +16,12 @@ reduce_scatter = _ns.reduce_scatter
 
 __all__ = ["all_reduce", "all_gather", "all_to_all", "broadcast",
            "reduce", "scatter", "reduce_scatter"]
+
+
+from ..collective import send, recv  # noqa: E402,F401
+
+alltoall = all_to_all
+from ..collective import all_to_all_single as alltoall_single  # noqa: E402
+from ..extras import gather  # noqa: E402,F401
+
+__all__ += ["alltoall", "alltoall_single", "send", "recv", "gather"]

@@ -549,3 +549,7 @@ __all__ += [
     "SigmoidTransform", "SoftmaxTransform", "StackTransform",
     "StickBreakingTransform", "TanhTransform", "LKJCholesky",
 ]
+
+
+# module-path parity (reference has one file per distribution)
+from . import chi2, kl, lkj_cholesky, transform  # noqa: F401,E402

@@ -1,0 +1,5 @@
+"""paddle.distribution.chi2 — module-path parity (reference
+distribution/chi2.py); the implementation lives in distribution.extra."""
+from . import Chi2  # noqa: F401
+
+__all__ = ["Chi2"]

@@ -1,0 +1,5 @@
+"""paddle.distribution.kl — module-path parity (reference
+distribution/kl.py: kl_divergence + register_kl dispatch)."""
+from . import kl_divergence, register_kl  # noqa: F401
+
+__all__ = ["kl_divergence", "register_kl"]

@@ -1,0 +1,5 @@
+"""paddle.distribution.lkj_cholesky — module-path parity (reference
+distribution/lkj_cholesky.py); the implementation lives in distribution.extra."""
+from . import LKJCholesky  # noqa: F401
+
+__all__ = ["LKJCholesky"]

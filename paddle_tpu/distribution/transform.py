@@ -1,0 +1,14 @@
+"""paddle.distribution.transform — module-path parity (reference
+distribution/transform.py); implementations live in distribution.extra."""
+from . import (  # noqa: F401
+    Transform, AbsTransform, AffineTransform, ChainTransform,
+    ExpTransform, IndependentTransform, PowerTransform, ReshapeTransform,
+    SigmoidTransform, SoftmaxTransform, StackTransform,
+    StickBreakingTransform, TanhTransform,
+)
+
+__all__ = ["Transform", "AbsTransform", "AffineTransform",
+           "ChainTransform", "ExpTransform", "IndependentTransform",
+           "PowerTransform", "ReshapeTransform", "SigmoidTransform",
+           "SoftmaxTransform", "StackTransform", "StickBreakingTransform",
+           "TanhTransform"]

@@ -186,3 +186,110 @@ class LRScheduler(Callback):
             s = self._sched()
             if s:
                 s.step()
+
+
+class ReduceLROnPlateau(Callback):
+    """Parity: callbacks.ReduceLROnPlateau — scale the optimizer LR when
+    the monitored metric stops improving."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0.0):
+        self.monitor = monitor
+        self.factor = float(factor)
+        self.patience = int(patience)
+        self.verbose = verbose
+        self.min_delta = float(min_delta)
+        self.cooldown = int(cooldown)
+        self.min_lr = float(min_lr)
+        better_is_less = mode == "min" or (mode == "auto"
+                                           and "acc" not in monitor)
+        self._cmp = ((lambda a, b: a < b - self.min_delta)
+                     if better_is_less else
+                     (lambda a, b: a > b + self.min_delta))
+        self._best = None
+        self._wait = 0
+        self._cool = 0
+
+    def on_eval_end(self, logs=None):
+        self._step(logs or {})
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._step(logs or {})
+
+    def _step(self, logs):
+        import numpy as np
+        val = logs.get(self.monitor)
+        if val is None:
+            return
+        val = float(np.ravel(val)[0])
+        if self._cool > 0:
+            self._cool -= 1
+            return
+        if self._best is None or self._cmp(val, self._best):
+            self._best = val
+            self._wait = 0
+            return
+        self._wait += 1
+        if self._wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is not None:
+                try:
+                    lr = opt.get_lr()
+                    new = max(lr * self.factor, self.min_lr)
+                    if new < lr:
+                        opt.set_lr(new)
+                        if self.verbose:
+                            print(f"ReduceLROnPlateau: lr -> {new:.3e}")
+                except RuntimeError:
+                    pass  # LRScheduler-driven optimizer owns its LR
+            self._wait = 0
+            self._cool = self.cooldown
+
+
+class VisualDL(Callback):
+    """Parity: callbacks.VisualDL. The visualdl package does not ship in
+    the TPU image; this writes the same scalar stream as JSONL next to
+    the would-be logdir so runs remain inspectable."""
+
+    def __init__(self, log_dir="./log"):
+        import os
+        self.log_dir = log_dir
+        os.makedirs(log_dir, exist_ok=True)
+        self._f = open(os.path.join(log_dir, "scalars.jsonl"), "a")
+        self._step = 0
+
+    def _write(self, tag, logs):
+        import json
+        import numpy as np
+        rec = {"step": self._step, "tag": tag}
+        for k, v in (logs or {}).items():
+            try:
+                rec[k] = float(np.ravel(v)[0])
+            except (TypeError, ValueError):
+                pass
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        if self._step % 100 == 0:
+            self._write("train", logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._write("train_epoch", logs)
+
+    def on_eval_end(self, logs=None):
+        self._write("eval", logs)
+
+
+class WandbCallback(Callback):
+    """Parity: callbacks.WandbCallback — requires the wandb package,
+    which the zero-egress TPU image does not ship."""
+
+    def __init__(self, *args, **kwargs):
+        raise ImportError(
+            "wandb is not installed in the TPU image (zero egress); use "
+            "VisualDL (JSONL scalars) or ProgBarLogger instead")
+
+
+__all__ += ["ReduceLROnPlateau", "VisualDL", "WandbCallback"]

@@ -166,3 +166,11 @@ def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
 
 
 from . import inference  # noqa: F401,E402
+
+
+from . import autograd  # noqa: F401,E402
+from . import autotune  # noqa: F401,E402
+__all__ += ["autograd", "autotune"]
+
+from . import distributed  # noqa: F401,E402
+__all__ += ["distributed"]

@@ -131,3 +131,18 @@ class _ASPOptimizer:
 
 def decorate(optimizer):
     return _ASPOptimizer(optimizer)
+
+
+_extra_supported = set()
+
+
+def add_supported_layer(layer, pruning_func=None):
+    """Parity: incubate.asp.add_supported_layer — register an extra layer
+    type (or parameter-name substring) whose weights ASP should prune."""
+    name = layer if isinstance(layer, str) else getattr(
+        layer, "__name__", str(layer))
+    _extra_supported.add((name, pruning_func))
+    return name
+
+
+__all__ += ["add_supported_layer"]

@@ -1,0 +1,41 @@
+"""incubate.autograd — functional AD surface + prim toggles.
+
+Parity: reference `python/paddle/incubate/autograd/` (Jacobian, Hessian,
+jvp, vjp, forward_grad via the prim system). The functional transforms
+live in paddle.autograd; the prim ops system collapses into jax's
+program transforms (SURVEY A.7), so enable/disable_prim only record the
+flag."""
+from ..autograd import jacobian as Jacobian  # noqa: F401
+from ..autograd import hessian as Hessian  # noqa: F401
+from ..autograd import jvp, vjp  # noqa: F401
+
+__all__ = ["Jacobian", "Hessian", "jvp", "vjp", "enable_prim",
+           "disable_prim", "prim_enabled", "forward_grad", "grad"]
+
+_prim = [False]
+
+
+def enable_prim():
+    _prim[0] = True
+
+
+def disable_prim():
+    _prim[0] = False
+
+
+def prim_enabled():
+    return _prim[0]
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    """Forward-mode grad (parity: incubate.autograd.forward_grad): jvp of
+    the identity program between inputs and outputs is not recoverable
+    post-hoc in eager; use paddle.incubate.autograd.jvp on a function."""
+    raise NotImplementedError(
+        "forward_grad over captured programs requires the static prim "
+        "pipeline; use incubate.autograd.jvp(func, xs) instead")
+
+
+def grad(outputs, inputs, grad_outputs=None):
+    from ..autograd import grad as _grad
+    return _grad(outputs, inputs, grad_outputs)

@@ -1,0 +1,32 @@
+"""incubate.autotune — kernel/layout/dataloader autotuning config.
+
+Parity: reference `python/paddle/incubate/autotune.py` set_config (JSON
+or dict with kernel/layout/dataloader sections). TPU-native: the kernel
+section maps onto the Pallas block-size autotuner
+(paddle_tpu.kernels.autotune); layout/dataloader tuning collapse into
+XLA/the C++ DataLoader workers.
+"""
+import json
+
+__all__ = ["set_config"]
+
+_config = {"kernel": {"enable": True, "tuning_range": [1, 10]},
+           "layout": {"enable": False},
+           "dataloader": {"enable": False}}
+
+
+def set_config(config=None):
+    global _config
+    if config is None:
+        return dict(_config)
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    for k, v in config.items():
+        _config.setdefault(k, {}).update(v)
+    if "kernel" in config:
+        from ..kernels import autotune as _at
+        enable = bool(config["kernel"].get("enable", True))
+        if hasattr(_at, "set_enabled"):
+            _at.set_enabled(enable)
+    return dict(_config)

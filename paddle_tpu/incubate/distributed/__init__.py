@@ -1,0 +1,7 @@
+"""paddle.incubate.distributed — module-path parity (reference
+incubate/distributed/): the live implementations are
+paddle.distributed.*; the PS-era fleet_util surface raises."""
+from . import fleet  # noqa: F401
+from . import utils  # noqa: F401
+
+__all__ = ["fleet", "utils"]

@@ -1,0 +1,22 @@
+"""incubate.distributed.fleet — PS-era fleet utilities (module-path
+parity). The collective fleet lives at paddle.distributed.fleet; the
+fleet_util/role-maker PS machinery is excluded per SURVEY A.7."""
+from ...distributed.fleet import (  # noqa: F401
+    init, distributed_model, distributed_optimizer, DistributedStrategy,
+    UtilBase,
+)
+
+
+class fleet_util:
+    """Reference incubate fleet_util singleton surface (GPUPS/PSLIB);
+    server-side ops raise, worker-side helpers ride UtilBase."""
+
+    _util = UtilBase()
+
+    @classmethod
+    def __getattr__(cls, item):
+        return getattr(cls._util, item)
+
+
+__all__ = ["init", "distributed_model", "distributed_optimizer",
+           "DistributedStrategy", "UtilBase", "fleet_util"]

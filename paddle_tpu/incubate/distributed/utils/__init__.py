@@ -1,0 +1,3 @@
+from . import io  # noqa: F401
+
+__all__ = ["io"]

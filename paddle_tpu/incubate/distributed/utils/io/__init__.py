@@ -1,0 +1,14 @@
+"""incubate.distributed.utils.io — gathered/sharded state-dict IO.
+
+Parity: reference incubate/distributed/utils/io/ (dist_save.py save,
+dist_load.py load, save_for_auto.py save_for_auto_inference). The
+sharded implementation is paddle.distributed.checkpoint (orbax); these
+entry points add the gather-to-rank-0 convention."""
+from . import dist_save  # noqa: F401
+from . import save_for_auto  # noqa: F401
+from .dist_save import save  # noqa: F401
+from .dist_load import load  # noqa: F401
+from .save_for_auto import save_for_auto_inference  # noqa: F401
+
+__all__ = ["save", "load", "save_for_auto_inference", "dist_save",
+           "save_for_auto"]

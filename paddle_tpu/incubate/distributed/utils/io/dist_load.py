@@ -1,0 +1,8 @@
+import pickle
+
+__all__ = ["load"]
+
+
+def load(path, **configs):
+    with open(path, "rb") as f:
+        return pickle.load(f)

@@ -1,0 +1,19 @@
+"""incubate dist_save: gather-then-save (reference dist_save.py save —
+gathers sharded/TP state to one rank before serialization)."""
+import numpy as np
+
+__all__ = ["save"]
+
+
+def save(state_dict, path, **configs):
+    import pickle
+    from .....core.tensor import Tensor
+    host = {}
+    for k, v in state_dict.items():
+        if isinstance(v, Tensor):
+            host[k] = np.asarray(v._data)   # gathers across the mesh
+        else:
+            host[k] = v
+    with open(path, "wb") as f:
+        pickle.dump(host, f)
+    return path

@@ -1,0 +1,32 @@
+"""incubate save_for_auto: persist a dygraph dist model so the
+auto-parallel loader can reshard it (reference save_for_auto.py).
+Artifacts: one pickled host state dict + a JSON of per-parameter
+placements."""
+import json
+import os
+
+import numpy as np
+
+__all__ = ["save_for_auto_inference"]
+
+
+def save_for_auto_inference(path_prefix, dist_model, cvt2cpu=False):
+    from .....core.tensor import Tensor
+    net = getattr(dist_model, "network", dist_model)
+    state = {}
+    placements = {}
+    for name, p in net.state_dict().items():
+        state[name] = np.asarray(p._data)
+        mesh = getattr(p, "process_mesh", None)
+        pl = getattr(p, "placements", None)
+        placements[name] = {
+            "mesh_shape": list(getattr(mesh, "shape", []) or []),
+            "placements": [str(x) for x in (pl or [])],
+        }
+    import pickle
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdparams", "wb") as f:
+        pickle.dump(state, f)
+    with open(path_prefix + ".dist_attr.json", "w") as f:
+        json.dump(placements, f)
+    return path_prefix

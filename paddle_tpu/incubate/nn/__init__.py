@@ -109,3 +109,144 @@ class FusedTransformerEncoderLayer(Layer):
 
     def forward(self, src, src_mask=None, cache=None):
         return self.ffn(self.fused_attn(src, attn_mask=src_mask))
+
+
+def _Ones():
+    from ...nn.initializer import Constant
+    return Constant(1.0)
+
+
+class FusedLinear(Layer):
+    """Parity: incubate.nn.FusedLinear (fused_matmul_bias layer)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        shape = ((out_features, in_features) if transpose_weight
+                 else (in_features, out_features))
+        self.weight = self.create_parameter(shape, attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (out_features,), attr=bias_attr, is_bias=True)
+        self._tw = transpose_weight
+
+    def forward(self, x):
+        from .functional import fused_matmul_bias
+        return fused_matmul_bias(x, self.weight, self.bias,
+                                 transpose_y=self._tw)
+
+
+class FusedDropoutAdd(Layer):
+    """Parity: incubate.nn.FusedDropoutAdd — y = x + dropout(y_in)...
+    precisely dropout(x) + y in the reference."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        from .functional import fused_dropout_add
+        return fused_dropout_add(x, y, p=self.p, training=self.training,
+                                 mode=self.mode)
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """Parity: incubate.nn.FusedBiasDropoutResidualLayerNorm."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self.ln_scale = self.create_parameter(
+            (embed_dim,), attr=weight_attr, default_initializer=_Ones())
+        self.ln_bias = self.create_parameter((embed_dim,), attr=bias_attr,
+                                             is_bias=True)
+        self.linear_bias = self.create_parameter((embed_dim,), is_bias=True)
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+
+    def forward(self, x, residual):
+        from .functional import fused_bias_dropout_residual_layer_norm
+        return fused_bias_dropout_residual_layer_norm(
+            x, residual, bias=self.linear_bias, ln_scale=self.ln_scale,
+            ln_bias=self.ln_bias, dropout_rate=self.dropout_rate,
+            ln_epsilon=self.epsilon, training=self.training)
+
+
+class FusedMultiTransformer(Layer):
+    """Parity: incubate.nn.FusedMultiTransformer — the serving decoder
+    stack owning per-layer weight lists, forwarded through
+    functional.fused_multi_transformer."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu", normalize_before=True,
+                 ln_scale_attrs=None, qkv_weight_attrs=None,
+                 linear_weight_attrs=None, ffn_ln_scale_attrs=None,
+                 ffn1_weight_attrs=None, ffn2_weight_attrs=None,
+                 epsilon=1e-5, num_layers=-1, nranks=1, trans_qkvw=True,
+                 ring_id=-1, norm_type="layernorm", name=None):
+        super().__init__()
+        if num_layers < 0:
+            num_layers = len(qkv_weight_attrs) if qkv_weight_attrs else 1
+        head_dim = embed_dim // num_heads
+        self._cfg = dict(pre_layer_norm=normalize_before, epsilon=epsilon,
+                         activation=activation, trans_qkvw=trans_qkvw,
+                         norm_type=norm_type, dropout_rate=dropout_rate)
+        self.ln_scales, self.ln_biases = [], []
+        self.qkv_weights, self.qkv_biases = [], []
+        self.linear_weights, self.linear_biases = [], []
+        self.ffn_ln_scales, self.ffn_ln_biases = [], []
+        self.ffn1_weights, self.ffn1_biases = [], []
+        self.ffn2_weights, self.ffn2_biases = [], []
+        for i in range(num_layers):
+            add = self.add_parameter
+            add(f"ln_scale_{i}", self.create_parameter(
+                (embed_dim,), default_initializer=_Ones()))
+            add(f"ln_bias_{i}", self.create_parameter((embed_dim,),
+                                                      is_bias=True))
+            add(f"qkv_weight_{i}", self.create_parameter(
+                (3, num_heads, head_dim, embed_dim)))
+            add(f"qkv_bias_{i}", self.create_parameter(
+                (3, num_heads, head_dim), is_bias=True))
+            add(f"linear_weight_{i}", self.create_parameter(
+                (embed_dim, embed_dim)))
+            add(f"linear_bias_{i}", self.create_parameter((embed_dim,),
+                                                          is_bias=True))
+            add(f"ffn_ln_scale_{i}", self.create_parameter(
+                (embed_dim,), default_initializer=_Ones()))
+            add(f"ffn_ln_bias_{i}", self.create_parameter((embed_dim,),
+                                                          is_bias=True))
+            add(f"ffn1_weight_{i}", self.create_parameter(
+                (embed_dim, dim_feedforward)))
+            add(f"ffn1_bias_{i}", self.create_parameter(
+                (dim_feedforward,), is_bias=True))
+            add(f"ffn2_weight_{i}", self.create_parameter(
+                (dim_feedforward, embed_dim)))
+            add(f"ffn2_bias_{i}", self.create_parameter((embed_dim,),
+                                                        is_bias=True))
+            self.ln_scales.append(getattr(self, f"ln_scale_{i}"))
+            self.ln_biases.append(getattr(self, f"ln_bias_{i}"))
+            self.qkv_weights.append(getattr(self, f"qkv_weight_{i}"))
+            self.qkv_biases.append(getattr(self, f"qkv_bias_{i}"))
+            self.linear_weights.append(getattr(self, f"linear_weight_{i}"))
+            self.linear_biases.append(getattr(self, f"linear_bias_{i}"))
+            self.ffn_ln_scales.append(getattr(self, f"ffn_ln_scale_{i}"))
+            self.ffn_ln_biases.append(getattr(self, f"ffn_ln_bias_{i}"))
+            self.ffn1_weights.append(getattr(self, f"ffn1_weight_{i}"))
+            self.ffn1_biases.append(getattr(self, f"ffn1_bias_{i}"))
+            self.ffn2_weights.append(getattr(self, f"ffn2_weight_{i}"))
+            self.ffn2_biases.append(getattr(self, f"ffn2_bias_{i}"))
+
+    def forward(self, x, attn_mask=None, caches=None, pre_caches=None,
+                rotary_embs=None, rotary_emb_dims=0, seq_lens=None,
+                time_step=None):
+        from .functional import fused_multi_transformer
+        return fused_multi_transformer(
+            x, self.ln_scales, self.ln_biases, self.qkv_weights,
+            self.qkv_biases, self.linear_weights, self.linear_biases,
+            self.ffn_ln_scales, self.ffn_ln_biases, self.ffn1_weights,
+            self.ffn1_biases, self.ffn2_weights, self.ffn2_biases,
+            attn_mask=attn_mask, cache_kvs=caches, **self._cfg)
+
+
+__all__ += ["FusedLinear", "FusedDropoutAdd",
+            "FusedBiasDropoutResidualLayerNorm", "FusedMultiTransformer"]

@@ -207,3 +207,11 @@ class ModelAverage:
         raise RuntimeError(
             "ModelAverage tracks another optimizer's parameters; call "
             "step() after the training optimizer's step()")
+
+
+# reference incubate.optimizer re-exports LBFGS (its __all__ is ['LBFGS'])
+from ..optimizer.optimizer import LBFGS  # noqa: E402
+
+__all__ += ["LBFGS", "functional"]
+
+from . import optimizer_functional as functional  # noqa: E402

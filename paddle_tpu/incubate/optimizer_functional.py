@@ -1,0 +1,138 @@
+"""incubate.optimizer.functional — functional quasi-Newton minimizers.
+
+Parity: reference `python/paddle/incubate/optimizer/functional/`
+(minimize_bfgs / minimize_lbfgs: line-search quasi-Newton over a scalar
+objective, returning (is_converge, num_func_calls, position, f, g[, Hk])).
+TPU-native: the objective is jax-differentiable; updates are jnp.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["minimize_bfgs", "minimize_lbfgs"]
+
+
+def _as_arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _value_and_grad(objective_func):
+    def f(x):
+        out = objective_func(Tensor(x))
+        return _as_arr(out).reshape(())
+    return jax.value_and_grad(f)
+
+
+def _backtrack(fg, x, d, f0, g0, max_ls=20):
+    """Armijo backtracking line search."""
+    alpha = 1.0
+    c1 = 1e-4
+    gd = float(jnp.vdot(g0, d))
+    calls = 0
+    for _ in range(max_ls):
+        f1, _ = fg(x + alpha * d)
+        calls += 1
+        if float(f1) <= float(f0) + c1 * alpha * gd:
+            return alpha, calls
+        alpha *= 0.5
+    return alpha, calls
+
+
+def minimize_bfgs(objective_func, initial_position, max_iters=50,
+                  tolerance_grad=1e-7, tolerance_change=1e-9,
+                  initial_inverse_hessian_estimate=None, line_search_fn=
+                  "strong_wolfe", max_line_search_iters=50, dtype="float32",
+                  name=None):
+    fg = _value_and_grad(objective_func)
+    x = _as_arr(initial_position).astype(dtype)
+    n = x.size
+    H = (jnp.eye(n, dtype=x.dtype)
+         if initial_inverse_hessian_estimate is None
+         else _as_arr(initial_inverse_hessian_estimate))
+    f, g = fg(x)
+    calls = 1
+    converged = False
+    for _ in range(max_iters):
+        if float(jnp.max(jnp.abs(g))) < tolerance_grad:
+            converged = True
+            break
+        d = -(H @ g.reshape(-1)).reshape(x.shape)
+        alpha, c = _backtrack(fg, x, d, f, g, max_line_search_iters)
+        calls += c
+        s = alpha * d
+        x_new = x + s
+        f_new, g_new = fg(x_new)
+        calls += 1
+        y = (g_new - g).reshape(-1)
+        sv = s.reshape(-1)
+        sy = float(jnp.vdot(sv, y))
+        if abs(float(f_new - f)) < tolerance_change:
+            x, f, g = x_new, f_new, g_new
+            converged = True
+            break
+        if sy > 1e-10:
+            rho = 1.0 / sy
+            I = jnp.eye(n, dtype=x.dtype)
+            V = I - rho * jnp.outer(sv, y)
+            H = V @ H @ V.T + rho * jnp.outer(sv, sv)
+        x, f, g = x_new, f_new, g_new
+    return (Tensor(jnp.asarray(converged)), Tensor(jnp.asarray(calls)),
+            Tensor(x), Tensor(f), Tensor(g), Tensor(H))
+
+
+def minimize_lbfgs(objective_func, initial_position, history_size=100,
+                   max_iters=50, tolerance_grad=1e-7, tolerance_change=1e-9,
+                   initial_inverse_hessian_estimate=None,
+                   line_search_fn="strong_wolfe", max_line_search_iters=50,
+                   dtype="float32", name=None):
+    fg = _value_and_grad(objective_func)
+    x = _as_arr(initial_position).astype(dtype)
+    f, g = fg(x)
+    calls = 1
+    s_hist, y_hist = [], []
+    converged = False
+    for _ in range(max_iters):
+        if float(jnp.max(jnp.abs(g))) < tolerance_grad:
+            converged = True
+            break
+        # two-loop recursion
+        q = g.reshape(-1)
+        alphas = []
+        for s, y in reversed(list(zip(s_hist, y_hist))):
+            rho = 1.0 / float(jnp.vdot(s, y))
+            a = rho * float(jnp.vdot(s, q))
+            alphas.append((a, rho, s, y))
+            q = q - a * y
+        gamma = 1.0
+        if s_hist:
+            gamma = float(jnp.vdot(s_hist[-1], y_hist[-1])
+                          / jnp.vdot(y_hist[-1], y_hist[-1]))
+        r = gamma * q
+        for a, rho, s, y in reversed(alphas):
+            b = rho * float(jnp.vdot(y, r))
+            r = r + s * (a - b)
+        d = -r.reshape(x.shape)
+        alpha, c = _backtrack(fg, x, d, f, g, max_line_search_iters)
+        calls += c
+        s = alpha * d
+        x_new = x + s
+        f_new, g_new = fg(x_new)
+        calls += 1
+        yv = (g_new - g).reshape(-1)
+        if float(jnp.vdot(s.reshape(-1), yv)) > 1e-10:
+            s_hist.append(s.reshape(-1))
+            y_hist.append(yv)
+            if len(s_hist) > history_size:
+                s_hist.pop(0)
+                y_hist.pop(0)
+        if abs(float(f_new - f)) < tolerance_change:
+            x, f, g = x_new, f_new, g_new
+            converged = True
+            break
+        x, f, g = x_new, f_new, g_new
+    return (Tensor(jnp.asarray(converged)), Tensor(jnp.asarray(calls)),
+            Tensor(x), Tensor(f), Tensor(g))

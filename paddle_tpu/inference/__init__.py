@@ -184,3 +184,110 @@ class Predictor:
 def create_predictor(config: Config) -> Predictor:
     """Parity: paddle.inference.create_predictor."""
     return Predictor(config)
+
+
+class DataType:
+    """Parity: inference.DataType (paddle_infer_declare.h enum)."""
+    FLOAT32 = 0
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+    FLOAT16 = 5
+    BFLOAT16 = 6
+    BOOL = 7
+    FLOAT64 = 8
+
+
+class PlaceType:
+    """Parity: inference.PlaceType. kCUSTOM covers the TPU device."""
+    UNK = -1
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM = 3
+
+
+class PrecisionType:
+    """Parity: inference.PrecisionType (AnalysisConfig::Precision)."""
+    Float32 = 0
+    Int8 = 1
+    Half = 2
+    Bfloat16 = 3
+
+
+Tensor = PredictorTensor  # reference exports the handle type as Tensor
+
+
+class PredictorPool:
+    """Parity: inference.PredictorPool — N predictors over one model."""
+
+    def __init__(self, config, size=1):
+        self._preds = [create_predictor(config) for _ in range(size)]
+
+    def retrieve(self, idx):
+        return self._preds[idx]
+
+
+def get_num_bytes_of_data_type(dtype):
+    return {DataType.FLOAT32: 4, DataType.INT64: 8, DataType.INT32: 4,
+            DataType.UINT8: 1, DataType.INT8: 1, DataType.FLOAT16: 2,
+            DataType.BFLOAT16: 2, DataType.BOOL: 1,
+            DataType.FLOAT64: 8}.get(dtype, 4)
+
+
+def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
+                               mixed_params_file, mixed_precision=None,
+                               backend=None, keep_io_types=True,
+                               black_list=None, **kwargs):
+    """Parity: inference.convert_to_mixed_precision — rewrite a saved
+    artifact's parameters to bf16 (the serving-side precision on TPU).
+    The StableHLO program stays as exported; parameters are cast at load
+    by the Predictor, so only the params artifact is rewritten."""
+    import pickle
+    import numpy as np
+    import ml_dtypes
+    with open(params_file, "rb") as f:
+        state = pickle.load(f)
+    out = {k: (v.astype(ml_dtypes.bfloat16)
+               if isinstance(v, np.ndarray) and v.dtype == np.float32
+               else v)
+           for k, v in state.items()}
+    with open(mixed_params_file, "wb") as f:
+        pickle.dump(out, f)
+    if model_file != mixed_model_file:
+        import shutil
+        for ext in ("", ".meta.json"):
+            try:
+                shutil.copy(model_file + ext, mixed_model_file + ext)
+            except FileNotFoundError:
+                pass
+    return mixed_params_file
+
+
+def get_trt_compile_version():
+    return (0, 0, 0)   # no TensorRT on TPU (API parity only)
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def _get_phi_kernel_name(op_name):
+    """Parity: inference._get_phi_kernel_name — maps a legacy op name to
+    its phi kernel; here the registry key IS the kernel name."""
+    return op_name
+
+
+class XpuConfig:
+    """Parity: inference.XpuConfig — config holder; XPU backends are not
+    part of the TPU build (constructing is allowed, attaching raises)."""
+
+    def __init__(self, **kwargs):
+        self.__dict__.update(kwargs)
+
+
+__all__ += ["DataType", "PlaceType", "PrecisionType", "Tensor",
+            "PredictorPool", "get_num_bytes_of_data_type",
+            "convert_to_mixed_precision", "get_trt_compile_version",
+            "get_trt_runtime_version", "_get_phi_kernel_name", "XpuConfig"]

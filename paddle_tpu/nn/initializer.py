@@ -160,6 +160,8 @@ class Orthogonal(Initializer):
 # default initializer used by create_parameter
 def _init_tensor(shape, dtype, initializer=None, is_bias=False):
     if initializer is None:
+        initializer = _global_initializer["bias" if is_bias else "weight"]
+    if initializer is None:
         initializer = Constant(0.0) if is_bias else XavierUniform()
     if callable(initializer) and not isinstance(initializer, Initializer):
         # support paddle-style ParamAttr(initializer=...) or plain callables
@@ -171,3 +173,61 @@ def _init_tensor(shape, dtype, initializer=None, is_bias=False):
     t = Tensor(arr, stop_gradient=False)
     t._is_param = True
     return t
+
+
+class Dirac(Initializer):
+    """Parity: nn.initializer.Dirac — identity-preserving conv init:
+    weight[i, i % in_c, center...] = 1 (groups split the identity)."""
+
+    def __init__(self, groups=1, name=None):
+        self.groups = groups
+
+    def __call__(self, shape, dtype):
+        import numpy as np
+        if len(shape) < 3:
+            raise ValueError("Dirac needs a conv weight (>=3 dims)")
+        w = np.zeros(shape, np.float32)
+        out_c, in_c = shape[0], shape[1]
+        per = out_c // self.groups
+        center = tuple(s // 2 for s in shape[2:])
+        for i in range(out_c):
+            w[(i,) + ((i % per) % in_c,) + center] = 1.0
+        return jnp.asarray(w, dtype)
+
+
+class Bilinear(Initializer):
+    """Parity: nn.initializer.Bilinear — upsampling-kernel init for
+    transposed conv weights."""
+
+    def __call__(self, shape, dtype):
+        import numpy as np
+        if len(shape) < 4:
+            raise ValueError("Bilinear needs a 4-D conv weight")
+        kh, kw = shape[-2], shape[-1]
+        fh, fw = (kh + 1) // 2, (kw + 1) // 2
+        cy = fh - 1 if kh % 2 == 1 else fh - 0.5
+        cx = fw - 1 if kw % 2 == 1 else fw - 0.5
+        yy, xx = np.meshgrid(np.arange(kh), np.arange(kw), indexing="ij")
+        filt = (1 - np.abs(yy - cy) / fh) * (1 - np.abs(xx - cx) / fw)
+        w = np.zeros(shape, np.float32)
+        w[range(shape[0]), list(np.arange(shape[0]) % shape[1]), :, :] = filt
+        return jnp.asarray(w, dtype)
+
+
+_global_initializer = {"weight": None, "bias": None}
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Parity: nn.initializer.set_global_initializer — default inits for
+    subsequently created parameters (None restores the built-ins)."""
+    _global_initializer["weight"] = weight_init
+    _global_initializer["bias"] = bias_init
+
+
+__all__ += ["Dirac", "Bilinear", "set_global_initializer"]
+
+
+# module-path parity: nn.initializer.lazy_init
+from . import initializer_lazy as lazy_init  # noqa: E402
+from .initializer_lazy import LazyGuard  # noqa: E402,F401
+__all__ += ["lazy_init", "LazyGuard"]

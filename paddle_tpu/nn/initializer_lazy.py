@@ -1,0 +1,18 @@
+"""nn.initializer.lazy_init — LazyGuard (module-path parity).
+
+Parity: reference nn/initializer/lazy_init.py — defer parameter
+materialization until the first forward. Eager jax arrays are cheap to
+create, so the guard is a recorded no-op scope (parameters initialize
+immediately; the deferral buys nothing on TPU where init compiles into
+the first jit anyway)."""
+import contextlib
+
+__all__ = ["LazyGuard"]
+
+
+class LazyGuard:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

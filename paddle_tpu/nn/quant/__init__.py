@@ -199,3 +199,21 @@ def llm_int8_linear(x, weight, bias=None, weight_scale=None, threshold=6.0):
     outlier split is a no-op on TPU where fp accumulate is used anyway).
     Parity: quantized_linear.py:276."""
     return weight_only_linear(x, weight, bias, weight_scale, "int8")
+
+
+class Stub(object):
+    """Parity: nn.quant.Stub — a placeholder layer the quantization
+    config replaces with a quanter during QAT model conversion."""
+
+    def __init__(self, observer=None):
+        self._observer = observer
+
+    def forward(self, input):
+        return input
+
+    def __call__(self, input):
+        return self.forward(input)
+
+
+from . import quant_layers  # noqa: E402,F401
+__all__ += ["Stub", "quant_layers"]

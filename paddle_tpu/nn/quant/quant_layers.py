@@ -1,0 +1,75 @@
+"""nn.quant.quant_layers — module-path parity (reference
+nn/quant/quant_layers.py QuantizedLinear etc.); the live implementations
+are the quantization package's QuantedLinear + fake quanters."""
+from ...quantization import (  # noqa: F401
+    QuantedLinear, FakeQuanterWithAbsMaxObserver)
+
+QuantizedLinear = QuantedLinear
+
+__all__ = ["QuantizedLinear", "QuantedLinear",
+           "FakeQuanterWithAbsMaxObserver"]
+
+
+from ...quantization import (  # noqa: E402
+    AbsmaxObserver as _Absmax,
+    AbsMaxChannelWiseWeightObserver as _ChAbsmax)
+
+# reference quant_layers fake-quant class names over our quanter set
+FakeQuantAbsMax = _Absmax
+FakeQuantChannelWiseAbsMax = _ChAbsmax
+FakeQuantMovingAverageAbsMax = FakeQuanterWithAbsMaxObserver
+
+
+class MovingAverageAbsMaxScale(FakeQuanterWithAbsMaxObserver):
+    """Parity: quant_layers.MovingAverageAbsMaxScale — tracks the scale
+    without quantizing the pass-through value."""
+
+    def forward(self, x):
+        super().forward(x)       # update the running scale
+        return x
+
+
+class MAOutputScaleLayer:
+    """Parity: quant_layers.MAOutputScaleLayer — wrap a layer and record
+    its output scale."""
+
+    def __init__(self, layer, moving_rate=0.9, name=None):
+        self._layer = layer
+        self._scale = MovingAverageAbsMaxScale(moving_rate=moving_rate)
+
+    def __call__(self, *args, **kwargs):
+        return self._scale(self._layer(*args, **kwargs))
+
+
+FakeQuantMAOutputScaleLayer = MAOutputScaleLayer
+
+
+class QuantizedConv2D:
+    """Parity: quant_layers.QuantizedConv2D — conv with fake-quantized
+    weights/activations."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, **kwargs):
+        self._layer = layer
+        self._wq = FakeQuantAbsMax()
+        self._aq = FakeQuanterWithAbsMaxObserver(moving_rate=moving_rate)
+
+    def __call__(self, x):
+        from ...core.tensor import Tensor
+        w = self._layer.weight
+        saved = w._data
+        w._data = self._wq(Tensor(saved))._data
+        try:
+            return self._layer(self._aq(x))
+        finally:
+            w._data = saved
+
+
+class QuantizedConv2DTranspose(QuantizedConv2D):
+    """Parity: quant_layers.QuantizedConv2DTranspose."""
+
+
+__all__ += ["FakeQuantAbsMax", "FakeQuantMovingAverageAbsMax",
+            "FakeQuantChannelWiseAbsMax", "QuantizedConv2D",
+            "QuantizedConv2DTranspose", "MovingAverageAbsMaxScale",
+            "MAOutputScaleLayer", "FakeQuantMAOutputScaleLayer"]

@@ -409,3 +409,9 @@ class QAT(Quantization):
                 model._sub_layers[name] = target
             else:
                 self._convert(child)
+
+
+# module-path parity with reference quantization/{observers,quanters}/
+from . import observers  # noqa: F401,E402
+from . import quanters  # noqa: F401,E402
+__all__ += ["observers", "quanters"]

@@ -1,0 +1,39 @@
+"""paddle.quantization.observers — module-path parity (reference
+quantization/observers/); implementations live in the package root."""
+from . import (AbsmaxObserver, BaseObserver,  # noqa: F401
+               AbsMaxChannelWiseWeightObserver)
+
+__all__ = ["AbsmaxObserver", "AbsMaxChannelWiseWeightObserver",
+           "BaseObserver"]
+
+
+
+
+class GroupWiseWeightObserver(BaseObserver):
+    """Parity: observers.GroupWiseWeightObserver — absmax per group of
+    `group_size` input channels (the int4 grouped-quant observer)."""
+
+    def __init__(self, quant_bits=4, group_size=128, **kwargs):
+        super().__init__()
+        self.bits = quant_bits
+        self.group_size = group_size
+        self._scales = None
+
+    def forward(self, x):
+        import jax.numpy as jnp
+        a = x._data if hasattr(x, "_data") else x
+        g = self.group_size
+        k = a.shape[0]
+        pad = (-k) % g
+        ap = jnp.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1))
+        grouped = ap.reshape(ap.shape[0] // g, g, *ap.shape[1:])
+        qmax = 2 ** (self.bits - 1) - 1
+        self._scales = jnp.max(jnp.abs(grouped), axis=1) / qmax
+        return x
+
+    def scales(self):
+        from ..core.tensor import Tensor
+        return Tensor(self._scales)
+
+
+__all__ += ["GroupWiseWeightObserver"]

@@ -1,0 +1,7 @@
+"""paddle.quantization.quanters — module-path parity (reference
+quantization/quanters/)."""
+from . import (BaseQuanter, FakeQuanterWithAbsMaxObserver,  # noqa: F401
+               QuanterFactory, quanter)
+
+__all__ = ["BaseQuanter", "FakeQuanterWithAbsMaxObserver",
+           "QuanterFactory", "quanter"]

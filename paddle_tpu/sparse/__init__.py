@@ -521,3 +521,7 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
 
 
 from . import nn  # noqa: E402,F401  (layers/functional subpackage)
+
+
+# module-path parity (reference sparse/creation.py)
+from . import creation  # noqa: F401,E402

@@ -1,0 +1,5 @@
+"""paddle.sparse.creation — module-path parity (reference
+sparse/creation.py); implementations live in the package root."""
+from . import sparse_coo_tensor, sparse_csr_tensor  # noqa: F401
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor"]

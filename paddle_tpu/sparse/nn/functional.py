@@ -318,3 +318,21 @@ def attention(query, key, value, sparse_mask, key_padding_mask=None,
     if attn_mask is not None:
         args.append(attn_mask)
     return apply_op("sparse_attention", _f, *args)
+
+
+def subm_conv2d_igemm(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                      groups=1, data_format="NHWC", key=None, name=None):
+    """Parity: sparse.nn.functional.subm_conv2d_igemm — the implicit-GEMM
+    schedule variant; on TPU the same gather+MXU lowering serves both."""
+    return subm_conv2d(x, weight, bias, stride, padding, dilation, groups,
+                       data_format, key)
+
+
+def subm_conv3d_igemm(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                      groups=1, data_format="NDHWC", key=None, name=None):
+    """Parity: sparse.nn.functional.subm_conv3d_igemm."""
+    return subm_conv3d(x, weight, bias, stride, padding, dilation, groups,
+                       data_format, key)
+
+
+__all__ += ["subm_conv2d_igemm", "subm_conv3d_igemm"]

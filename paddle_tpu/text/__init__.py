@@ -143,3 +143,4 @@ class WMT14(_LocalTextDataset):
 
 class WMT16(_LocalTextDataset):
     URL = "https://dataset.bj.bcebos.com/wmt16%2Fwmt16.tar.gz"
+

@@ -1,0 +1,5 @@
+"""paddle.text.viterbi_decode — module-path parity (reference
+text/viterbi_decode.py); implementations live in paddle_tpu.text."""
+from . import viterbi_decode, ViterbiDecoder  # noqa: F401
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
