@@ -254,17 +254,20 @@ class Strategy:
 
     def __init__(self, config=None):
         cfg = config or {}
-        self.sharding = _Flags(enable=False, stage=1, degree=8,
-                               **cfg.get("sharding", {}))
-        self.amp = _Flags(enable=False, dtype="float16", level="O1",
-                          **cfg.get("amp", {}))
-        self.pipeline = _Flags(enable=False, schedule_mode="1F1B",
-                               micro_batch_size=1, accumulate_steps=1,
-                               **cfg.get("pipeline", {}))
-        self.fused_passes = _Flags(enable=False, fused_passes_list=[],
-                                   **cfg.get("fused_passes", {}))
-        self.gradient_merge = _Flags(enable=False, k_steps=1, avg=True,
-                                     **cfg.get("gradient_merge", {}))
+
+        def flags(key, **defaults):
+            defaults.update(cfg.get(key, {}))
+            return _Flags(**defaults)
+
+        self.sharding = flags("sharding", enable=False, stage=1, degree=8)
+        self.amp = flags("amp", enable=False, dtype="float16", level="O1")
+        self.pipeline = flags("pipeline", enable=False,
+                              schedule_mode="1F1B", micro_batch_size=1,
+                              accumulate_steps=1)
+        self.fused_passes = flags("fused_passes", enable=False,
+                                  fused_passes_list=[])
+        self.gradient_merge = flags("gradient_merge", enable=False,
+                                    k_steps=1, avg=True)
 
 
 # --------------------------------------------------- PS-era data configs

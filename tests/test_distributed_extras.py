@@ -1,0 +1,162 @@
+"""Round-3 distributed surface: extras, fleet utils, io, recompute.
+
+Parity targets: reference distributed/__init__.py tail names, fleet
+utils (fs.py, recompute), distributed/io.py, data_generator.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+rng = np.random.RandomState(0)
+
+
+def test_split_linear_column_and_row():
+    """dist.split builds the megatron layer and runs it (1-rank group:
+    numeric identity with a plain matmul)."""
+    x = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+    out = dist.split(x, (8, 6), operation="linear", axis=1)
+    assert list(out.shape) == [4, 6]
+    layer = dist.split.last_layer
+    w = np.asarray(layer.weight._data)
+    want = np.asarray(x._data) @ w
+    if layer.bias is not None:
+        want = want + np.asarray(layer.bias._data)
+    np.testing.assert_allclose(np.asarray(out._data), want, rtol=1e-5)
+    out2 = dist.split(x, (8, 6), operation="linear", axis=0)
+    assert list(out2.shape) == [4, 6]
+    ids = paddle.to_tensor(rng.randint(0, 16, (4, 3)))
+    emb = dist.split(ids, (16, 5), operation="embedding")
+    assert list(emb.shape) == [4, 3, 5]
+
+
+def test_wait_gather_scatter_objects():
+    t = paddle.to_tensor(np.ones(3, np.float32))
+    assert dist.wait(t) is None
+    lst = []
+    task = dist.gather(t, lst)
+    assert len(lst) == 1 and isinstance(task, dist.Task)
+    out = []
+    dist.scatter_object_list(out, ["a", "b"], src=0)
+    assert out == ["a"]
+
+
+def test_spawn_two_processes():
+    """dist.spawn launches real processes with the trainer env set."""
+    import paddle_tpu.distributed.extras as ex
+    ctx = dist.spawn(_spawn_child, args=(), nprocs=2)
+    assert all(p.exitcode == 0 for p in ctx.processes)
+
+
+def _spawn_child():
+    import os
+    assert os.environ["PADDLE_TRAINERS_NUM"] == "2"
+    assert os.environ["PADDLE_TRAINER_ID"] in ("0", "1")
+    assert os.environ["PADDLE_MASTER"].startswith("127.0.0.1:")
+
+
+def test_util_base_helpers():
+    from paddle_tpu.distributed.fleet import util
+    files = [f"f{i}" for i in range(7)]
+    shard = util.get_file_shard(files)
+    assert shard == files  # world of 1
+    got = util.all_reduce(np.asarray([1.0, 2.0]), mode="sum")
+    np.testing.assert_allclose(got, [1.0, 2.0])
+    util.barrier()
+
+
+def test_data_generator_line_protocol():
+    from paddle_tpu.distributed.fleet import MultiSlotDataGenerator
+
+    class Gen(MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def it():
+                yield [("ids", [1, 2, 3]), ("label", [0])]
+            return it
+
+    g = Gen()
+    lines = g.run_from_memory()
+    assert lines == ["3 1 2 3 1 0\n"]
+    with pytest.raises(ValueError, match="int/float"):
+        class Bad(MultiSlotDataGenerator):
+            def generate_sample(self, line):
+                def it():
+                    yield [("ids", ["x"])]
+                return it
+        Bad().run_from_memory()
+
+
+def test_distributed_io_persistables(tmp_path):
+    from paddle_tpu.static import global_scope
+    w = paddle.to_tensor(np.ones(4, np.float32))
+    w._is_param = True
+    w.name = "w_io_test"
+    global_scope().vars["w_io_test"] = w
+    path = dist.io.save_persistables(dirname=str(tmp_path))
+    w._data = paddle.zeros([4])._data
+    dist.io.load_persistables(dirname=str(tmp_path))
+    np.testing.assert_allclose(np.asarray(
+        global_scope().vars["w_io_test"]._data), np.ones(4))
+    assert dist.io.is_persistable(w)
+    del global_scope().vars["w_io_test"]
+
+
+def test_fleet_utils_recompute_grads_match():
+    from paddle_tpu.distributed.fleet.utils import recompute
+    w = paddle.to_tensor(rng.randn(4, 4).astype(np.float32),
+                         stop_gradient=False)
+    x = paddle.to_tensor(rng.randn(2, 4).astype(np.float32))
+
+    def block(a, b):
+        return (a @ b).tanh()
+
+    out = recompute(block, x, w)
+    out.sum().backward()
+    g_rc = np.asarray(w.grad._data).copy()
+    w.clear_grad()
+    out2 = block(x, w)
+    out2.sum().backward()
+    np.testing.assert_allclose(g_rc, np.asarray(w.grad._data), rtol=1e-5)
+
+
+def test_shard_dataloader_places_batches():
+    import jax
+    from jax.sharding import Mesh
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(8), ("data",))
+    batches = [(paddle.to_tensor(rng.randn(8, 4).astype(np.float32)),
+                paddle.to_tensor(rng.randn(8).astype(np.float32)))
+               for _ in range(2)]
+    dl = dist.shard_dataloader(batches, mesh)
+    assert len(dl) == 2
+    for x, y in dl:
+        assert "data" in str(x._data.sharding.spec)
+
+
+def test_ps_dataset_configs_raise_on_pipeline():
+    ds = dist.InMemoryDataset()
+    ds.init(batch_size=4, thread_num=2)
+    ds.set_filelist(["a.txt"])
+    with pytest.raises(NotImplementedError, match="SURVEY A.7"):
+        ds.load_into_memory()
+    with pytest.raises(NotImplementedError):
+        ds.global_shuffle()
+    with pytest.raises(ValueError):
+        dist.ProbabilityEntry(1.5)
+
+
+def test_strategy_config_object():
+    s = dist.Strategy({"sharding": {"enable": True, "stage": 2}})
+    assert s.sharding.enable and s.sharding.stage == 2
+    assert s.pipeline.schedule_mode == "1F1B"
+
+
+def test_passes_registry():
+    from paddle_tpu.distributed.passes import new_pass, PassManager
+    p = new_pass("pipeline_scheduler_ZBH1")
+    with pytest.raises(NotImplementedError, match="ZeroBubbleRunner"):
+        p.apply()
+    with pytest.raises(NotImplementedError, match="no TPU analog"):
+        new_pass("nonexistent_pass").apply()
