@@ -94,8 +94,13 @@ class PipelineParallel(_MetaParallelBase):
         """Micro-batch schedule. On TPU every 'rank' sees the whole graph
         (SPMD); the 1F1B ordering is realized for memory by interleaving
         fwd/bwd over micro-batches — backward for micro i is issued as soon
-        as its forward completes in the steady state."""
+        as its forward completes in the steady state. schedule_mode in
+        {ZB-H1, ZB, zero_bubble, ZBH1} routes through the fleet executor's
+        ZeroBubbleRunner with the backward split per stage segment."""
         micros = self._split_micro(data)
+        from ..pipeline import ZB_SCHEDULES
+        if self._schedule in ZB_SCHEDULES or self._schedule == "ZBH1":
+            return self._zb_forward_backward(micros, scaler)
         total = None
         n = len(micros)
         warmup = min(self._hcg.get_pipe_parallel_world_size() - 1, n) \
@@ -131,6 +136,82 @@ class PipelineParallel(_MetaParallelBase):
             total = loss.detach() if total is None else total + loss.detach()
             bwd(loss_s)
         return total / n if total is not None else None
+
+    def _zb_forward_backward(self, micros, scaler=None):
+        """EXECUTED ZB-H1 over the PipelineLayer's stage segments: the
+        fleet executor Plan runs split-backward B (input-grad) and W
+        (weight-grad) jobs, W deferred into cooldown bubbles (parity:
+        passes/pipeline_scheduler_pass/pipeline_zero_bubble.py). Grads
+        accumulate into the live parameters' grad buffers, so the normal
+        optimizer.step() applies them.
+
+        Determinism note: each stage function pins the RNG state captured
+        at batch start, so the B/W recomputation linearizes the same
+        forward (the reference preserves RNG per micro-batch the same
+        way); dropout masks therefore repeat across micro-batches inside
+        one ZB batch."""
+        import jax
+        from ...core import autograd
+        from ...core.tensor import Tensor
+        from ...framework import random as _random
+        from ..fleet_executor import ZeroBubbleRunner
+
+        n_stages = len(self._layers.segment_parts) - 1
+        rng_state = _random.get_rng_state()
+
+        def make_stage(stage_layers):
+            tensors = {}
+            for li, layer in enumerate(stage_layers):
+                for name, t in layer.state_dict().items():
+                    tensors[f"{li}.{name}"] = t
+            params0 = {k: t._data for k, t in tensors.items()}
+
+            def fn(params, x):
+                _random.set_rng_state(rng_state)
+                saved = {k: t._data for k, t in tensors.items()}
+                try:
+                    with autograd.no_grad():
+                        for k, t in tensors.items():
+                            t._data = params[k]
+                        h = Tensor(x)
+                        for layer in stage_layers:
+                            h = layer(h)
+                        return h._data
+                finally:
+                    for k, t in tensors.items():
+                        t._data = saved[k]
+
+            return fn, params0, tensors
+
+        stages = [make_stage(self._layers.get_stage_layers(s))
+                  for s in range(n_stages)]
+        stage_fns = [s[0] for s in stages]
+        stage_params = [s[1] for s in stages]
+
+        def loss_fn(pred, label):
+            with autograd.no_grad():
+                l = self._layers.loss(Tensor(pred), Tensor(label))
+                if scaler is not None:
+                    l = scaler.scale(l)
+                return l._data
+
+        runner = ZeroBubbleRunner(stage_fns, stage_params, loss_fn,
+                                  schedule="ZB-H1")
+        xs = [m[0]._data for m in micros]
+        ys = [m[1]._data for m in micros]
+        mean_loss, grads = runner.run(xs, ys)
+        n = len(micros)
+        for (fn, params0, tensors), g in zip(stages, grads):
+            if g is None:
+                continue
+            for k, t in tensors.items():
+                gk = g[k] / n
+                t._grad_buffer = gk if t._grad_buffer is None \
+                    else t._grad_buffer + gk
+        for cb in self._step_callbacks:
+            cb(n - 1)
+        import jax.numpy as jnp
+        return Tensor(jnp.asarray(mean_loss))
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         """Parity: pipeline_parallel.py:810."""

@@ -272,6 +272,48 @@ def test_zb_h1_executed_split_backward_matches_autograd():
     assert any(pos[f"W{m}"] > pos[f"B{m + 1}"] for m in range(3))
 
 
+def test_zbh1_schedule_mode_through_fleet_matches_1f1b():
+    """schedule_mode='ZBH1' routes PipelineParallel.train_batch through
+    the executed ZeroBubbleRunner (split backward over the stage
+    segments); the loss and updated parameters must match the 1F1B path
+    on a dropout-free model."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet import fleet, DistributedStrategy
+    from paddle_tpu.distributed.fleet.pp_layers import PipelineLayer
+
+    rng2 = np.random.RandomState(3)
+    x_np = rng2.randn(8, 6).astype(np.float32)
+    y_np = rng2.randn(8, 4).astype(np.float32)
+
+    def build(schedule):
+        st = DistributedStrategy()
+        st.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 2,
+                             "sharding_degree": 1, "sep_degree": 1}
+        st.pipeline_configs = {"micro_batch_size": 2,
+                               "accumulate_steps": 4,
+                               "schedule_mode": schedule}
+        fleet.init(is_collective=True, strategy=st)
+        paddle.seed(11)
+        net = PipelineLayer(
+            layers=[paddle.nn.Linear(6, 16), paddle.nn.Tanh(),
+                    paddle.nn.Linear(16, 4)],
+            num_stages=2, loss_fn=paddle.nn.MSELoss())
+        model = fleet.distributed_model(net)
+        opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+        data = (paddle.to_tensor(x_np), paddle.to_tensor(y_np))
+        losses = [float(np.asarray(
+            model.train_batch(data, opt)._data)) for _ in range(3)]
+        w = np.asarray(net.run_function[0].weight._data).copy()
+        fleet._hcg = None
+        return losses, w
+
+    l_ref, w_ref = build("1F1B")
+    l_zb, w_zb = build("ZBH1")
+    np.testing.assert_allclose(l_zb, l_ref, rtol=1e-5)
+    np.testing.assert_allclose(w_zb, w_ref, rtol=1e-5)
+    assert l_zb[-1] < l_zb[0]
+
+
 def test_zb_h1_makespan_beats_1f1b():
     """VERDICT r2 weak #5: assert the bubble REDUCTION, not just event
     ordering — dependency-respecting makespan under a unit-time model."""
