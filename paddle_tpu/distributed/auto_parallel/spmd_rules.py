@@ -189,7 +189,7 @@ def transpose_rule(x_spec, perm=None, **attrs):
     return SpmdResult([x_spec], P(*out))
 
 
-@register_spmd_rule(["concat", "stack"])
+@register_spmd_rule("concat")
 def concat_rule(*in_specs, axis=0, **attrs):
     """spmd_rules/concat.cc: the concat dim must be replicated; others
     merge like elementwise."""
@@ -201,13 +201,35 @@ def concat_rule(*in_specs, axis=0, **attrs):
     return SpmdResult(list(in_specs), spec)
 
 
-@register_spmd_rule(["split", "unbind"])
+@register_spmd_rule("stack")
+def stack_rule(*in_specs, axis=0, **attrs):
+    """spmd_rules/stack.cc: inputs merge elementwise, the new stacked
+    dim is replicated (each input lands whole on its index)."""
+    merged = list(tuple(elementwise_rule(*in_specs).out_specs[0] or ()))
+    a = axis if axis >= 0 else len(merged) + 1 + axis
+    a = max(0, min(a, len(merged)))
+    out = merged[:a] + [None] + merged[a:]
+    spec = P(*out)
+    return SpmdResult(list(in_specs), spec)
+
+
+@register_spmd_rule("split")
 def split_rule(x_spec, axis=0, **attrs):
     xs = list(tuple(x_spec or ()))
     if xs and axis < len(xs):
         xs[axis] = None
     spec = P(*xs)
     return SpmdResult([spec], spec)
+
+
+@register_spmd_rule("unbind")
+def unbind_rule(x_spec, axis=0, **attrs):
+    """Like split, but the unbound dim disappears from each output."""
+    xs = list(tuple(x_spec or ()))
+    a = axis if axis >= 0 else len(xs) + axis
+    out = [e for i, e in enumerate(xs) if i != a]
+    spec = P(*out)
+    return SpmdResult([x_spec], spec)
 
 
 @register_spmd_rule(["flash_attention", "sdpa"])
@@ -304,3 +326,191 @@ def plain_ce_rule(logits_spec, label_spec, *rest, **attrs):
     base = cross_entropy_rule(logits_spec, label_spec, **attrs)
     return SpmdResult(base.in_specs + [P() for _ in rest],
                       base.out_specs, partial_axes=base.partial_axes)
+
+
+# -- round-4 growth toward rules.h's full registry (VERDICT r3 item 2) -----
+
+# prod/amax/amin share the reduction shape rule; their non-sum combine is
+# why partial_axes makes the hook abstain rather than pin.
+register_spmd_rule(["prod", "amax", "amin"])(reduction_rule)
+
+
+def _norm_axes(axes, ndim):
+    if axes is None:
+        return list(range(ndim))
+    axes = axes if isinstance(axes, (list, tuple)) else [axes]
+    return [int(a) if int(a) >= 0 else ndim + int(a) for a in axes]
+
+
+@register_spmd_rule("slice")
+def slice_rule(x_spec, axes=(), **attrs):
+    """spmd_rules/slice.cc SliceInferSpmd: sliced dims lose their
+    sharding (a partial extent cannot stay block-distributed); untouched
+    dims pass through."""
+    xs = list(tuple(x_spec or ()))
+    for a in _norm_axes(axes, len(xs)):
+        if a < len(xs):
+            xs[a] = None
+    spec = P(*xs)
+    return SpmdResult([spec], spec)
+
+
+@register_spmd_rule("strided_slice")
+def strided_slice_rule(x_spec, axes=(), **attrs):
+    return slice_rule(x_spec, axes=axes, **attrs)
+
+
+@register_spmd_rule("pad")
+def pad_rule(x_spec, padded_dims=None, **attrs):
+    """spmd_rules/pad.cc: padded dims must be replicated. `padded_dims`
+    is the resolved list of dim indices that receive nonzero padding
+    (the call site resolves paddle's two pad-list layouts); unpadded
+    dims pass through."""
+    xs = list(tuple(x_spec or ()))
+    if padded_dims is None:
+        spec = P()
+        return SpmdResult([spec], spec)
+    for d in padded_dims:
+        if 0 <= int(d) < len(xs):
+            xs[int(d)] = None
+    spec = P(*xs)
+    return SpmdResult([spec], spec)
+
+
+@register_spmd_rule("tile")
+def tile_rule(x_spec, repeat_times=(), x_ndim=None, **attrs):
+    """spmd_rules/tile.cc: any repeated dim is replicated (tiling a
+    block-sharded dim would interleave shards); reps align to the
+    right like broadcasting, new leading dims replicated. `x_ndim`
+    (threaded by the call site) pads a truncated left-aligned spec to
+    the tensor rank so right-alignment lands on the real dims."""
+    xs = _pad(x_spec, x_ndim if x_ndim is not None
+              else len(tuple(x_spec or ())))
+    reps = list(repeat_times)
+    ndim_out = max(len(xs), len(reps))
+    out = [None] * ndim_out
+    for i in range(ndim_out):
+        xi = len(xs) - ndim_out + i
+        ri = len(reps) - ndim_out + i
+        rep = reps[ri] if ri >= 0 else 1
+        if xi >= 0 and rep == 1:
+            out[i] = xs[xi]
+    spec = P(*out)
+    return SpmdResult([x_spec], spec)
+
+
+@register_spmd_rule(["expand", "broadcast_to", "expand_as"])
+def expand_rule(x_spec, shape=(), x_ndim=None, **attrs):
+    """spmd_rules/expand_as.cc: existing dims keep their sharding (a
+    size-1 dim is never sharded so broadcast is local); new leading dims
+    replicated. The input spec is padded to `x_ndim` (left-aligned
+    PartitionSpec semantics) before right-aligning against `shape`."""
+    xs = _pad(x_spec, x_ndim if x_ndim is not None
+              else len(tuple(x_spec or ())))
+    ndim_out = max(len(shape), len(xs)) if shape else len(xs)
+    out = [None] * (ndim_out - len(xs)) + xs
+    spec = P(*out)
+    return SpmdResult([x_spec], spec)
+
+
+@register_spmd_rule(["cumsum", "cumprod", "cummax", "cummin",
+                     "logcumsumexp"])
+def cumsum_rule(x_spec, axis=None, **attrs):
+    """spmd_rules/cumsum.cc: the scan dim must be replicated (prefix
+    dependency crosses shard boundaries); axis=None flattens, so the
+    1-D output is replicated."""
+    if axis is None:
+        spec = P()
+        return SpmdResult([x_spec], spec)
+    xs = list(tuple(x_spec or ()))
+    a = int(axis) if int(axis) >= 0 else len(xs) + int(axis)
+    if 0 <= a < len(xs):
+        xs[a] = None
+    spec = P(*xs)
+    return SpmdResult([spec], spec)
+
+
+@register_spmd_rule("one_hot")
+def one_hot_rule(x_spec, **attrs):
+    """spmd_rules/one_hot.cc: input dims pass through, the new classes
+    dim is replicated."""
+    out = list(tuple(x_spec or ())) + [None]
+    return SpmdResult([x_spec], P(*out))
+
+
+@register_spmd_rule("gather")
+def gather_axis_rule(x_spec, idx_spec=None, axis=0, **attrs):
+    """spmd_rules/gather.cc with a 1-D index: the gathered dim takes the
+    index's sharding, other dims pass through."""
+    xs = list(tuple(x_spec or ()))
+    a = int(axis) if int(axis) >= 0 else len(xs) + int(axis)
+    if 0 <= a < len(xs):
+        xs[a] = _ent(idx_spec, 0)
+    spec = P(*xs)
+    return SpmdResult([x_spec, idx_spec], spec)
+
+
+@register_spmd_rule(["scatter", "scatter_nd_add", "put_along_axis"])
+def scatter_rule(x_spec, idx_spec=None, upd_spec=None, **attrs):
+    """spmd_rules/scatter.cc conservative default: the scattered (first)
+    dim is replicated — indices may target any shard — remaining dims
+    keep the destination's sharding."""
+    xs = list(tuple(x_spec or ()))
+    if xs:
+        xs[0] = None
+    spec = P(*xs)
+    return SpmdResult([spec, idx_spec, upd_spec], spec)
+
+
+@register_spmd_rule(["p_norm", "logsumexp", "squared_l2_norm", "norm"])
+def norm_reduce_rule(x_spec, axis=None, keepdim=False, **attrs):
+    """Reduction-shaped but NOT sum-combinable: reducing a sharded dim is
+    marked Partial so the dispatch hook abstains and GSPMD emits the
+    correct combined collective (spmd_rules/p_norm, logsumexp,
+    squared_l2_norm entries in rules.h map Partial with a custom reduce
+    type)."""
+    base = reduction_rule(x_spec, axis=axis, keepdim=keepdim)
+    return SpmdResult(base.in_specs, base.out_specs,
+                      partial_axes=base.partial_axes)
+
+
+@register_spmd_rule("moe_gate_dispatch")
+def moe_gate_dispatch_rule(x_spec, gate_spec=None, **attrs):
+    """rules.h moe_gate_dispatch: dispatched output is laid out
+    (experts, capacity, hidden) — expert dim takes the gate's expert-dim
+    sharding (the EP axis), capacity replicated, hidden follows x."""
+    e_axis = _ent(gate_spec, 1)
+    h_axis = _ent(x_spec, len(tuple(x_spec or ())) - 1)
+    out = P(e_axis, None, h_axis)
+    return SpmdResult([x_spec, gate_spec], out)
+
+
+@register_spmd_rule("moe_combine")
+def moe_combine_rule(y_spec, gate_spec=None, **attrs):
+    """rules.h moe_combine: combining expert outputs back to (tokens,
+    hidden); an expert-dim sharding becomes Partial (the EP all-reduce),
+    token dim follows the gate."""
+    e_axis = _ent(y_spec, 0)
+    out = P(_ent(gate_spec, 0), _ent(y_spec, len(tuple(y_spec or ())) - 1))
+    partial = (e_axis,) if e_axis is not None else ()
+    return SpmdResult([y_spec, gate_spec], out, partial_axes=partial)
+
+
+@register_spmd_rule(["check_finite_and_unscale", "update_loss_scaling"])
+def amp_check_rule(*in_specs, **attrs):
+    """rules.h check_finite_and_unscale: each grad keeps its placement;
+    found_inf is a replicated scalar the hook leaves alone."""
+    return SpmdResult(list(in_specs), list(in_specs))
+
+
+@register_spmd_rule(["adam", "adamw", "sgd", "momentum", "adam_update",
+                     "adamw_update", "sgd_update", "momentum_update"])
+def optimizer_update_rule(param_spec, grad_spec=None, *state_specs,
+                          **attrs):
+    """rules.h optimizer rules (adam_spmd etc.): updated param and every
+    moment state inherit the param/grad merged placement — the property
+    ZeRO sharding relies on."""
+    merged = elementwise_rule(param_spec, grad_spec).out_specs[0] \
+        if grad_spec is not None else (param_spec or P())
+    return SpmdResult([merged, merged] + [merged for _ in state_specs],
+                      merged)

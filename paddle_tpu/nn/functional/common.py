@@ -104,7 +104,20 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", pad_from_left_ax
         if mode == "constant":
             return jnp.pad(a, widths, mode="constant", constant_values=value)
         return jnp.pad(a, widths, mode=_pad_mode_to_np(mode))
-    return apply_op("pad", _f, x)
+    # resolve which dims get nonzero padding for the SPMD pad rule
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        padded = [i for i in range(nd) if pad[2 * i] or pad[2 * i + 1]]
+    else:
+        n_spatial = len(pad) // 2
+        spatial = list(range(1, nd - 1)) if data_format.endswith("C") \
+            else list(range(2, nd))
+        padded = []
+        for i in range(n_spatial):
+            dim = spatial[-(i + 1)] if n_spatial <= len(spatial) else i
+            if pad[2 * i] or pad[2 * i + 1]:
+                padded.append(dim)
+    return apply_op("pad", _f, x, op_attrs={"padded_dims": padded})
 
 
 def zeropad2d(x, padding, data_format="NCHW", name=None):
@@ -132,7 +145,8 @@ def embedding(x, weight, padding_idx=None, sparse=False, max_norm=None, norm_typ
 
 def one_hot(x, num_classes, name=None):
     return apply_op("one_hot",
-                    lambda a: jax.nn.one_hot(a, int(num_classes), dtype=jnp.float32), x)
+                    lambda a: jax.nn.one_hot(a, int(num_classes), dtype=jnp.float32),
+                    x, op_attrs={"num_classes": int(num_classes)})
 
 
 def interpolate(x, size=None, scale_factor=None, mode="nearest",

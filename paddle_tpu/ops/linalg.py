@@ -91,7 +91,8 @@ def norm(x, p=None, axis=None, keepdim=False, name=None):
             return jnp.sum((a != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
         flat_ax = ax
         return jnp.sum(jnp.abs(a) ** p, axis=flat_ax, keepdims=keepdim) ** (1.0 / p)
-    return apply_op("norm", _f, x)
+    return apply_op("norm", _f, x,
+                    op_attrs={"axis": ax, "keepdim": keepdim})
 
 
 def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):

@@ -59,16 +59,20 @@ def view_as(x, other, name=None):
 def transpose(x, perm=None, name=None):
     if perm is not None:
         perm = [int(p) for p in perm]
-    return apply_op("transpose", lambda a: jnp.transpose(a, perm), x)
+    return apply_op("transpose", lambda a: jnp.transpose(a, perm), x,
+                    op_attrs={"perm": perm if perm is not None
+                              else list(reversed(range(x.ndim)))})
 
 
 def concat(x, axis=0, name=None):
     axis = int(axis._data) if isinstance(axis, Tensor) else int(axis)
-    return apply_op("concat", lambda xs: jnp.concatenate(xs, axis=axis), list(x))
+    return apply_op("concat", lambda xs: jnp.concatenate(xs, axis=axis),
+                    list(x), op_attrs={"axis": axis})
 
 
 def stack(x, axis=0, name=None):
-    return apply_op("stack", lambda xs: jnp.stack(xs, axis=int(axis)), list(x))
+    return apply_op("stack", lambda xs: jnp.stack(xs, axis=int(axis)),
+                    list(x), op_attrs={"axis": int(axis)})
 
 
 def split(x, num_or_sections, axis=0, name=None):
@@ -87,7 +91,7 @@ def split(x, num_or_sections, axis=0, name=None):
         offsets = np.cumsum(sizes)[:-1].tolist()
         def _f(a):
             return tuple(jnp.split(a, offsets, axis=axis))
-    return list(apply_op("split", _f, x))
+    return list(apply_op("split", _f, x, op_attrs={"axis": axis}))
 
 
 def builtins_sum(it):
@@ -181,7 +185,8 @@ def rot90(x, k=1, axes=(0, 1), name=None):
 
 def tile(x, repeat_times, name=None):
     reps = _static_shape(repeat_times)
-    return apply_op("tile", lambda a: jnp.tile(a, reps), x)
+    return apply_op("tile", lambda a: jnp.tile(a, reps), x,
+                    op_attrs={"repeat_times": list(reps), "x_ndim": x.ndim})
 
 
 def expand(x, shape, name=None):
@@ -194,7 +199,8 @@ def expand(x, shape, name=None):
             if s == -1 and i >= offset:
                 full[i] = a.shape[i - offset]
         return jnp.broadcast_to(a, tuple(full))
-    return apply_op("expand", _f, x)
+    return apply_op("expand", _f, x,
+                    op_attrs={"shape": list(sh), "x_ndim": x.ndim})
 
 
 def expand_as(x, y, name=None):
@@ -223,7 +229,7 @@ def gather(x, index, axis=0, name=None):
     axis = int(axis._data) if isinstance(axis, Tensor) else int(axis)
     def _f(a, idx):
         return jnp.take(a, idx.reshape(-1) if idx.ndim > 1 else idx, axis=axis)
-    return apply_op("gather", _f, x, index)
+    return apply_op("gather", _f, x, index, op_attrs={"axis": axis})
 
 
 @def_op("gather_nd")
@@ -357,7 +363,8 @@ def slice(input, axes, starts, ends, name=None):
         for ax, st, en in zip(axes, starts, ends):
             idx[int(ax)] = jnp.s_[st:en]
         return a[tuple(idx)]
-    return apply_op("slice", _f, input)
+    return apply_op("slice", _f, input,
+                    op_attrs={"axes": [int(a) for a in axes]})
 
 
 def strided_slice(x, axes, starts, ends, strides, name=None):
@@ -366,14 +373,15 @@ def strided_slice(x, axes, starts, ends, strides, name=None):
         for ax, st, en, sd in zip(axes, starts, ends, strides):
             idx[int(ax)] = jnp.s_[int(st):int(en):int(sd)]
         return a[tuple(idx)]
-    return apply_op("strided_slice", _f, x)
+    return apply_op("strided_slice", _f, x,
+                    op_attrs={"axes": [int(a) for a in axes]})
 
 
 def unbind(input, axis=0, name=None):
     n = input.shape[axis]
     def _f(a):
         return tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(a, n, axis=axis))
-    return list(apply_op("unbind", _f, input))
+    return list(apply_op("unbind", _f, input, op_attrs={"axis": axis}))
 
 
 def unstack(x, axis=0, num=None, name=None):

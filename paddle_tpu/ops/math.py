@@ -205,7 +205,8 @@ def _reduction(op_name, fn, bool_out=False):
     def op(x, axis=None, keepdim=False, name=None):
         ax = _norm_axis(axis)
         return apply_op(op_name,
-                        lambda a: fn(a, axis=ax, keepdims=keepdim), x)
+                        lambda a: fn(a, axis=ax, keepdims=keepdim), x,
+                        op_attrs={"axis": ax, "keepdim": keepdim})
     op.__name__ = op_name
     return op
 
@@ -219,7 +220,7 @@ def sum(x, axis=None, dtype=None, keepdim=False, name=None):
         if d is None and jnp.issubdtype(a.dtype, jnp.bool_):
             out = out.astype(jnp.int64)
         return out
-    return apply_op("sum", _sum, x)
+    return apply_op("sum", _sum, x, op_attrs={"axis": ax, "keepdim": keepdim})
 
 
 mean = _reduction("mean", jnp.mean)
@@ -234,12 +235,14 @@ any = _reduction("any", jnp.any)
 
 def max(x, axis=None, keepdim=False, name=None):
     ax = _norm_axis(axis)
-    return apply_op("max", lambda a: jnp.max(a, axis=ax, keepdims=keepdim), x)
+    return apply_op("max", lambda a: jnp.max(a, axis=ax, keepdims=keepdim), x,
+                    op_attrs={"axis": ax, "keepdim": keepdim})
 
 
 def min(x, axis=None, keepdim=False, name=None):
     ax = _norm_axis(axis)
-    return apply_op("min", lambda a: jnp.min(a, axis=ax, keepdims=keepdim), x)
+    return apply_op("min", lambda a: jnp.min(a, axis=ax, keepdims=keepdim), x,
+                    op_attrs={"axis": ax, "keepdim": keepdim})
 
 
 def std(x, axis=None, unbiased=True, keepdim=False, name=None):
@@ -273,7 +276,8 @@ def count_nonzero(x, axis=None, keepdim=False, name=None):
 def logsumexp(x, axis=None, keepdim=False, name=None):
     ax = _norm_axis(axis)
     return apply_op("logsumexp",
-                    lambda a: jax.scipy.special.logsumexp(a, axis=ax, keepdims=keepdim), x)
+                    lambda a: jax.scipy.special.logsumexp(a, axis=ax, keepdims=keepdim),
+                    x, op_attrs={"axis": ax, "keepdim": keepdim})
 
 
 @def_op("logcumsumexp")
@@ -298,7 +302,8 @@ def cumsum(x, axis=None, dtype=None, name=None):
             a = a.reshape(-1)
             return jnp.cumsum(a, dtype=d)
         return jnp.cumsum(a, axis=int(axis), dtype=d)
-    return apply_op("cumsum", _f, x)
+    return apply_op("cumsum", _f, x,
+                    op_attrs={"axis": None if axis is None else int(axis)})
 
 
 def cumprod(x, dim=None, dtype=None, name=None):
@@ -309,7 +314,8 @@ def cumprod(x, dim=None, dtype=None, name=None):
             a = a.reshape(-1)
             return jnp.cumprod(a, dtype=d)
         return jnp.cumprod(a, axis=int(dim), dtype=d)
-    return apply_op("cumprod", _f, x)
+    return apply_op("cumprod", _f, x,
+                    op_attrs={"axis": None if dim is None else int(dim)})
 
 
 def cummax(x, axis=None, dtype="int64", name=None):
@@ -323,7 +329,8 @@ def cummax(x, axis=None, dtype="int64", name=None):
         idx = jnp.broadcast_to(idx, aa.shape)
         indices = jax.lax.cummax(jnp.where(eq, idx, -1), axis=ax)
         return vals, indices.astype(jnp.int64)
-    return apply_op("cummax", _f, x)
+    return apply_op("cummax", _f, x,
+                    op_attrs={"axis": None if axis is None else int(axis)})
 
 
 def cummin(x, axis=None, dtype="int64", name=None):
@@ -337,7 +344,8 @@ def cummin(x, axis=None, dtype="int64", name=None):
         idx = jnp.broadcast_to(idx, aa.shape)
         indices = jax.lax.cummax(jnp.where(eq, idx, -1), axis=ax)
         return vals, indices.astype(jnp.int64)
-    return apply_op("cummin", _f, x)
+    return apply_op("cummin", _f, x,
+                    op_attrs={"axis": None if axis is None else int(axis)})
 
 
 # ------------------------------------------------------------ linalg-lite

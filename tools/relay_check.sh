@@ -1,0 +1,7 @@
+#!/bin/sh
+# Cheap axon-relay liveness probe. The grant-claim leg dials
+# 127.0.0.1:8082 (axon/register/ifrt.py ":8082 claim"); when nothing
+# listens there jax.devices() blocks forever. Run this before TPU work:
+#   sh tools/relay_check.sh && <tpu command>
+# Exit 0 = a listener exists on the claim port range (relay likely up).
+ss -tln 2>/dev/null | grep -qE ':(808[2-9]|809[0-9]|810[0-9]|811[0-7]) '
