@@ -98,8 +98,9 @@ class PipelineParallel(_MetaParallelBase):
         {ZB-H1, ZB, zero_bubble, ZBH1} routes through the fleet executor's
         ZeroBubbleRunner with the backward split per stage segment."""
         micros = self._split_micro(data)
-        from ..pipeline import ZB_SCHEDULES
-        if self._schedule in ZB_SCHEDULES or self._schedule == "ZBH1":
+        from ..pipeline import ZB_SCHEDULES, ZBV_SCHEDULES
+        if self._schedule in ZB_SCHEDULES or self._schedule == "ZBH1" \
+                or self._schedule in ZBV_SCHEDULES:
             return self._zb_forward_backward(micros, scaler)
         total = None
         n = len(micros)
@@ -195,8 +196,15 @@ class PipelineParallel(_MetaParallelBase):
                     l = scaler.scale(l)
                 return l._data
 
+        # jit_stages=False: a fresh runner (fresh stage closures — they
+        # capture this batch's RNG state) is built per batch, so jitted
+        # jobs could never reuse their cache and every step would pay a
+        # full retrace+compile; the compiled measured path is
+        # ThreadedFleetExecutor/tools/bench_pipeline.py. ZB-V requires an
+        # even stage-segment count (2 chunks per rank).
+        sched = "ZB-H1" if self._schedule == "ZBH1" else self._schedule
         runner = ZeroBubbleRunner(stage_fns, stage_params, loss_fn,
-                                  schedule="ZB-H1")
+                                  schedule=sched, jit_stages=False)
         xs = [m[0]._data for m in micros]
         ys = [m[1]._data for m in micros]
         mean_loss, grads = runner.run(xs, ys)

@@ -212,6 +212,11 @@ def _pipeline_circular(stage_params, micro_inputs, stage_fn, mesh, axis,
 
 
 ZB_SCHEDULES = ("ZB-H1", "ZB", "zero_bubble")
+# ZB composed with the 2-chunk virtual pipeline (V placement). Kept
+# separate from ZB_SCHEDULES: consumers that only know the flat H1
+# ordering must fail loudly on these, not silently run H1 under a V name
+# (fleet_executor.build_zbv_rank_schedules owns the V machinery).
+ZBV_SCHEDULES = ("ZB-V", "ZBV")
 
 
 class PipelineMicroScheduler:
@@ -234,7 +239,11 @@ class PipelineMicroScheduler:
             for i in range(self.n_micro):
                 yield ("B", i)
             return
-        if self.schedule in ZB_SCHEDULES:
+        if self.schedule in ZB_SCHEDULES or self.schedule in ZBV_SCHEDULES:
+            # Host-sequential event view: the B/W split is identical for
+            # flat ZB-H1 and chunked ZB-V (the V placement changes which
+            # RANK owns which virtual stage — build_zbv_rank_schedules —
+            # not the single-process topological order).
             yield from self._zb_h1_steps()
             return
         # n_stages=1 has no pipeline overlap: warmup must still cover
