@@ -151,6 +151,7 @@ class ZeroBubbleRunner:
     def __init__(self, stage_fns, stage_params, loss_fn,
                  schedule: str = "ZB-H1", jit_stages: bool = True):
         import jax
+        self.stage_fns = list(stage_fns)   # materialize before validating
         if schedule not in ZB_SCHEDULES and schedule not in ZBV_SCHEDULES:
             # (ADVICE r3) a non-ZB schedule emits plain 'backward' jobs
             # this runner does not re-wrap — fail loudly instead of a
@@ -159,12 +160,11 @@ class ZeroBubbleRunner:
                 f"ZeroBubbleRunner only executes zero-bubble schedules "
                 f"{ZB_SCHEDULES + ZBV_SCHEDULES}, got {schedule!r}; use "
                 f"FleetExecutor with build_pipeline_plan for 1F1B/FThenB")
-        if schedule in ZBV_SCHEDULES and len(list(stage_fns)) % 2:
+        if schedule in ZBV_SCHEDULES and len(self.stage_fns) % 2:
             raise ValueError(
                 "ZB-V places 2 chunks per rank: pass an even number of "
-                "virtual stage fns (got %d)" % len(list(stage_fns)))
+                "virtual stage fns (got %d)" % len(self.stage_fns))
         self._jax = jax
-        self.stage_fns = list(stage_fns)
         self.stage_params = list(stage_params)
         self.loss_fn = loss_fn
         self.schedule = schedule
@@ -185,7 +185,7 @@ class ZeroBubbleRunner:
                              jax.vjp(lambda pp: fn(pp, x), p)[1](g)[0])
                 return fwd, dx, dw
 
-            jobs = [make_jobs(fn) for fn in self.stage_fns]
+            jobs = [make_jobs(f) for f in self.stage_fns]
             self._fwd_jit = [j[0] for j in jobs]
             self._dx_jit = [j[1] for j in jobs]
             self._dw_jit = [j[2] for j in jobs]

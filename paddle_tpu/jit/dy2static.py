@@ -3,9 +3,10 @@
 Parity: the reference's AST transform pipeline
 (`python/paddle/jit/dy2static/program_translator.py:377`,
 `convert_operators.py` convert_ifelse/convert_while_loop — ~35k LoC with
-a bytecode VM on top). This is the load-bearing subset: `if` statements
-and `while` loops whose predicates turn out to be traced tensors are
-rewritten into `paddle.static.nn.cond` / `while_loop` calls, so the
+a bytecode VM on top). This is the load-bearing subset: `if` statements,
+`while` loops and `for` loops (over `range(...)` — incl. tensor bounds —
+and over tensors) whose predicates/bounds turn out to be traced tensors
+are rewritten into `paddle.static.nn.cond` / `while_loop` calls, so the
 model COMPILES instead of graph-breaking to eager.
 
 Pipeline position (jit/api.py): trace fails with a concretization error
@@ -73,6 +74,91 @@ def _run_if(pred, true_fn, false_fn):
             raise DygraphToStaticBreak(
                 f"converted `if` could not lower to cond: {e}") from e
     return true_fn() if _to_bool(pred) else false_fn()
+
+
+def _to_int(v):
+    from ..core.tensor import Tensor
+    if isinstance(v, Tensor):
+        return int(np.asarray(v._data).reshape(()))
+    return int(v)
+
+
+def _run_for_range(start, stop, step, body_fn, loop_vars):
+    """Runtime helper for rewritten `for t in range(...)` (parity:
+    the reference loop transformer converts `for`-over-range into its
+    while lowering, `jit/dy2static/transformers/loop_transformer.py:111`).
+
+    Contract: loop_vars = (target_init, *carried); body_fn(k, *carried)
+    -> (target_out, *carried_out) where k is the iteration counter —
+    python rebinds the target from the iterator each step regardless of
+    body reassignment, and the post-loop target is the LAST body value.
+    Concrete bounds keep exact python semantics (including a possibly
+    still-undefined target when the range is empty); a traced bound
+    lowers to static.nn.while_loop with (counter, target, *carried)."""
+    import jax
+
+    def traced(v):
+        return isinstance(getattr(v, "_data", v), jax.core.Tracer)
+
+    tgt, carried = loop_vars[0], tuple(loop_vars[1:])
+    if not traced(step) and _to_int(step) == 0:
+        raise ValueError("range() arg 3 must not be zero")
+    if not (traced(start) or traced(stop) or traced(step)):
+        i, st, sp = _to_int(start), _to_int(stop), _to_int(step)
+        while (i < st) if sp > 0 else (i > st):
+            out = body_fn(i, *carried)
+            tgt, carried = out[0], tuple(out[1:])
+            i += sp
+        return (tgt,) + carried
+    if traced(step):
+        raise DygraphToStaticBreak(
+            "for-range with a traced step: the loop direction is "
+            "data-dependent; rewrite with lax primitives")
+    sp = _to_int(step)
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+    start_v = start._data if isinstance(start, Tensor) else start
+    k0 = Tensor(jnp.asarray(start_v))
+    stop_v = stop._data if isinstance(stop, Tensor) else stop
+    if isinstance(tgt, _Undefined):
+        # while_loop carried values need a concrete type; python would
+        # leave the target unbound on an empty range — benign deviation,
+        # documented: the target reads as the start counter then
+        tgt = k0
+    from ..static import nn as snn
+
+    def cond(k, t, *vs):
+        return Tensor(k._data < stop_v) if sp > 0 else \
+            Tensor(k._data > stop_v)
+
+    def body(k, t, *vs):
+        out = body_fn(k, *vs)
+        return (Tensor(k._data + sp), out[0]) + tuple(out[1:])
+
+    try:
+        res = snn.while_loop(cond, body, [k0, tgt] + list(carried))
+    except Exception as e:
+        raise DygraphToStaticBreak(
+            f"converted `for` could not lower to while_loop: {e}") from e
+    return tuple(res[1:])
+
+
+def _run_for_iter(seq, body_fn, loop_vars):
+    """Runtime helper for rewritten `for x in seq`. Tensors iterate along
+    dim 0 with a STATIC trip count (shapes are static under jit), so the
+    python loop below unrolls into a valid trace; other iterables keep
+    plain python semantics. Same (target, *carried) contract as
+    `_run_for_range`."""
+    from ..core.tensor import Tensor
+    tgt, carried = loop_vars[0], tuple(loop_vars[1:])
+    if isinstance(seq, Tensor):
+        items = (Tensor(seq._data[j]) for j in range(seq.shape[0]))
+    else:
+        items = iter(seq)
+    for item in items:
+        out = body_fn(item, *carried)
+        tgt, carried = out[0], tuple(out[1:])
+    return (tgt,) + carried
 
 
 def _run_while(cond_fn, body_fn, loop_vars):
@@ -211,6 +297,13 @@ class _Rewriter:
             elif isinstance(st, ast.While) and not st.orelse \
                     and not _blocked(st.body):
                 out.extend(self._rewrite_while(st, bound))
+            elif isinstance(st, ast.For) and not st.orelse \
+                    and isinstance(st.target, ast.Name) \
+                    and not _blocked(st.body):
+                # `for` with break/continue/return in the body is left as
+                # plain python (the _blocked guard above): semantics are
+                # preserved and the eager fallback still trains it
+                out.extend(self._rewrite_for(st, bound))
             else:
                 # recurse into compound statements' bodies in place
                 for field in ("body", "orelse", "finalbody"):
@@ -307,6 +400,55 @@ class _Rewriter:
         self.count += 1
         return pre + [cf, bf, assign]
 
+    def _rewrite_for(self, node: ast.For,
+                     bound: Set[str]) -> List[ast.stmt]:
+        """`for t in range(...)` -> __pt_run_for_range (lowers to
+        while_loop on a traced bound); `for t in seq` ->
+        __pt_run_for_iter (static trip count over tensors). Parity:
+        reference loop_transformer.py:111-138 converts both forms."""
+        self.uid += 1
+        k = self.uid
+        tname = node.target.id
+        body = self.rewrite_body(node.body, set(bound) | {tname})
+        carried = sorted(_assigned_names(node.body) - {tname})
+        pre: List[ast.stmt] = []
+        for t in [tname] + carried:
+            if t not in bound:
+                pre.append(ast.Assign(
+                    targets=[_name(t, ast.Store())],
+                    value=ast.Call(
+                        func=_name("__pt_undef", ast.Load()),
+                        args=[ast.Constant(value=t)], keywords=[])))
+        bf = self._fn_def(f"__pt_forbody_{k}", [tname] + carried, body,
+                          [tname] + carried)
+        loop_vars = _tuple_of([tname] + carried, ast.Load())
+        it = node.iter
+        if isinstance(it, ast.Call) and isinstance(it.func, ast.Name) \
+                and it.func.id == "range" and not it.keywords \
+                and 1 <= len(it.args) <= 3 \
+                and not any(isinstance(a, ast.Starred) for a in it.args):
+            a = list(it.args)
+            if len(a) == 1:
+                start, stop, step = ast.Constant(0), a[0], ast.Constant(1)
+            elif len(a) == 2:
+                start, stop, step = a[0], a[1], ast.Constant(1)
+            else:
+                start, stop, step = a
+            call = ast.Call(
+                func=_name("__pt_run_for_range", ast.Load()),
+                args=[start, stop, step, _name(bf.name, ast.Load()),
+                      loop_vars], keywords=[])
+        else:
+            call = ast.Call(
+                func=_name("__pt_run_for_iter", ast.Load()),
+                args=[it, _name(bf.name, ast.Load()), loop_vars],
+                keywords=[])
+        assign = ast.Assign(
+            targets=[_tuple_of([tname] + carried, ast.Store())],
+            value=call)
+        self.count += 1
+        return pre + [bf, assign]
+
 
 def try_convert(fn) -> Optional[types.FunctionType]:
     """AST-convert `fn`'s data-dependent control flow. Returns the
@@ -352,6 +494,8 @@ def _convert(fn):
             return None  # empty cell: cannot snapshot
     namespace["__pt_run_if"] = _run_if
     namespace["__pt_run_while"] = _run_while
+    namespace["__pt_run_for_range"] = _run_for_range
+    namespace["__pt_run_for_iter"] = _run_for_iter
     namespace["__pt_undef"] = _Undefined
     exec(code, namespace)
     new_fn = namespace[fdef.name]
