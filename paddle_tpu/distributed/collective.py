@@ -330,6 +330,11 @@ def _stream_variant(fn):
     distinction; XLA owns scheduling here, so it only gates the eager
     wait) and returns the Task."""
     def wrapper(*args, sync_op=True, use_calc_stream=False, **kwargs):
+        # sync_op defaults True like the reference stream APIs
+        # (communication/stream/all_reduce.py:108 declares
+        # `sync_op: bool = True`; ADVICE r3 claimed False — checked and
+        # the reference says otherwise); use_calc_stream forces the
+        # eager wait like the reference's calc-stream semantics
         return fn(*args, sync_op=sync_op or use_calc_stream, **kwargs)
     wrapper.__name__ = fn.__name__
     wrapper.__doc__ = fn.__doc__

@@ -289,7 +289,11 @@ class TracedFunction:
         track = getattr(self, "_cell_track", None)
         if track is None:
             track = self._cell_track = {}
+        state_ids = self._state_tensor_ids()
         sig = []
+        # entries carry a type tag ("t"ensor/"s"calar/"o"bject/"state")
+        # so a version counter can never collide with a scalar VALUE
+        # (e.g. object-at-version-0 vs the int 0)
         for name, cell in zip(f.__code__.co_freevars, f.__closure__):
             try:
                 v = cell.cell_contents
@@ -298,21 +302,55 @@ class TracedFunction:
                 continue
             if isinstance(v, Tensor):
                 d = v._data
+                if id(v) in state_ids:
+                    # bundle-tracked tensors are RUNTIME state: the trace
+                    # reads them through bundle.load, never bakes them as
+                    # constants, and the optimizer swaps _data every step
+                    # — versioning them would retrace per step. Guard on
+                    # shape/dtype only.
+                    sig.append((name, "state",
+                                tuple(getattr(d, "shape", ())),
+                                str(getattr(d, "dtype", ""))))
+                    continue
                 rec = track.get(name)
                 if rec is None or rec[0] is not d:
                     rec = (d, (rec[1] + 1) if rec else 0)
                     track[name] = rec
-                sig.append((name, rec[1], tuple(getattr(d, "shape", ())),
+                sig.append((name, "t", rec[1],
+                            tuple(getattr(d, "shape", ())),
                             str(getattr(d, "dtype", ""))))
             elif isinstance(v, (int, float, bool, str, bytes, type(None))):
-                sig.append((name, v))
+                sig.append((name, "s", v))
             else:
                 rec = track.get(name)
                 if rec is None or rec[0] is not v:
                     rec = (v, (rec[1] + 1) if rec else 0)
                     track[name] = rec
-                sig.append((name, rec[1]))
+                sig.append((name, "o", rec[1]))
         return tuple(sig)
+
+    def _state_tensor_ids(self):
+        """ids of Tensors owned by the state bundle (parameters, buffers,
+        optimizer accumulators reachable via parameters()/state_dict()).
+        Tensor objects are stable across steps (only their _data swaps),
+        so this is computed once."""
+        ids = getattr(self, "_state_ids_cache", None)
+        if ids is None:
+            ids = set()
+            for obj in self._bundle.objects:
+                if hasattr(obj, "parameters"):
+                    try:
+                        ids |= {id(p) for p in obj.parameters()}
+                    except Exception:
+                        pass
+                if hasattr(obj, "state_dict"):
+                    try:
+                        ids |= {id(t) for t in obj.state_dict().values()
+                                if isinstance(t, Tensor)}
+                    except Exception:
+                        pass
+            self._state_ids_cache = ids
+        return ids
 
     def _refresh_conversion(self, cur_sig):
         """Re-snapshot the dy2static conversion when the original

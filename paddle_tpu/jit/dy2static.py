@@ -83,6 +83,19 @@ def _to_int(v):
     return int(v)
 
 
+def _grad_sensitive(vals):
+    """True when autograd is on and any loop-carried Tensor requires
+    grad: lax.while_loop has NO reverse-mode AD, so lowering such a loop
+    would silently emit stop_gradient outputs — raise instead, and the
+    eager fallback trains with correct gradients."""
+    from ..core import autograd
+    from ..core.tensor import Tensor
+    if not autograd.is_grad_enabled():
+        return False
+    return any(isinstance(v, Tensor) and not v.stop_gradient
+               for v in vals)
+
+
 def _run_for_range(start, stop, step, body_fn, loop_vars):
     """Runtime helper for rewritten `for t in range(...)` (parity:
     the reference loop transformer converts `for`-over-range into its
@@ -114,6 +127,11 @@ def _run_for_range(start, stop, step, body_fn, loop_vars):
         raise DygraphToStaticBreak(
             "for-range with a traced step: the loop direction is "
             "data-dependent; rewrite with lax primitives")
+    if _grad_sensitive(loop_vars):
+        raise DygraphToStaticBreak(
+            "traced-bound for carries grad-requiring tensors; "
+            "while_loop is forward-only — using the eager fallback so "
+            "gradients stay correct")
     sp = _to_int(step)
     from ..core.tensor import Tensor
     import jax.numpy as jnp
@@ -174,6 +192,11 @@ def _run_while(cond_fn, body_fn, loop_vars):
             loop_vars = tuple(out) if isinstance(out, (list, tuple)) \
                 else (out,)
         return tuple(loop_vars)
+    if _grad_sensitive(loop_vars):
+        raise DygraphToStaticBreak(
+            "traced while carries grad-requiring tensors; while_loop is "
+            "forward-only — using the eager fallback so gradients stay "
+            "correct")
     from ..static import nn as snn
     try:
         return tuple(snn.while_loop(cond_fn, body_fn, list(loop_vars)))

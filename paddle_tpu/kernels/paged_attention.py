@@ -123,10 +123,15 @@ def check_supported_paged(q_shape, cache_shape, dtype):
         raise ValueError(f"page_size {page_size} must be a multiple of 8 "
                          "(sublane tiling)")
     if str(dtype) not in ("bfloat16", "float32"):
-        raise ValueError(f"unsupported dtype {dtype}")
+        # float16 is deliberately rejected: bf16/f32 are the TPU's native
+        # compute dtypes; Mosaic fp16 support is not something we can
+        # rely on unvalidated (ADVICE r3 asked to confirm on-chip — still
+        # pending a live relay; loosen only after a real-chip run passes)
+        raise ValueError(f"unsupported dtype {dtype} (TPU-native kernels "
+                         "accept bfloat16/float32)")
 
 
-def paged_blockspecs(B, H, KVH, D, page_size, num_pages, max_pages):
+def paged_blockspecs(B, H, KVH, D, page_size, num_pages):
     """The exact (block_shape, array_shape) pairs the pallas_call below
     constructs, plus the VMEM scratch shapes — enumerable for the static
     legality test without running the kernel."""

@@ -380,8 +380,18 @@ def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
     for t in (bsize, bsum, bsq):
         t.stop_gradient = True
     mean = bsum / bsize
-    scale = (bsize / (bsq - (bsum * bsum) / bsize + epsilon)).sqrt()
+    # reference kernel math (ipu/popart_canonicalization/nn_ops.cc:734-753
+    # data_norm_handler): scale = sqrt(BatchSize / BatchSquareSum) — the
+    # accumulated second moment is used directly, NO mean^2 subtraction
+    # (ADVICE r3: the previous variance-corrected form diverged once
+    # batch_sum accumulated)
+    scale = (bsize / bsq).sqrt()
     out = (input - mean) * scale
+    if enable_scale_and_shift:
+        sw = _param(f"{name}.scale_w" if name else None, (c,),
+                    initializer="ones")
+        sb = _param(f"{name}.bias" if name else None, (c,), is_bias=True)
+        out = out * sw + sb
     if not _is_tracer(input):
         n = float(input.shape[0])
         x = input.detach()

@@ -303,6 +303,66 @@ def test_converted_closure_snapshot_refreshes_on_mutation():
     np.testing.assert_allclose(np.asarray(out2._data), 6 * np.ones(2))
 
 
+def test_grad_carrying_for_loop_falls_back_and_trains():
+    """lax.while_loop has no reverse AD: a traced-bound for whose carried
+    tensors require grad must NOT silently compile with stop_gradient
+    outputs — it falls back to eager and produces real gradients."""
+    paddle.seed(0)
+    net = paddle.nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+
+    def step(x, y, n):
+        h = net(x)
+        s = h * 0.0
+        for i in range(n):
+            s = s + h          # s carries grad through the loop
+        loss = ((s - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    traced = paddle.jit.to_static(step, state_objects=[net, opt])
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+    w0 = np.asarray(net.weight._data).copy()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        l0 = float(np.asarray(traced(x, y, paddle.to_tensor(3))._data))
+        l1 = float(np.asarray(traced(x, y, paddle.to_tensor(3))._data))
+    assert traced._fallback_count == 1     # eager, by design
+    assert not np.allclose(w0, np.asarray(net.weight._data))  # real grads
+    assert l1 < l0
+
+
+def test_bundle_param_in_closure_does_not_retrace_per_step():
+    """Bundle-tracked tensors enter the trace as runtime state (never
+    baked constants); the closure guard must not version them, or every
+    optimizer step would force a full retrace+recompile."""
+    paddle.seed(0)
+    net = paddle.nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    w = net.weight                     # closure cell holding a parameter
+
+    def step(x, y):
+        h = x @ w + net.bias
+        loss = ((h - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    traced = paddle.jit.to_static(step, state_objects=[net, opt])
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(8, 2).astype(np.float32))
+    losses = [float(np.asarray(traced(x, y)._data)) for _ in range(4)]
+    assert traced._fallback_count == 0
+    assert len(traced._cache) == 1, traced._cache.keys()  # ONE program
+    assert losses[-1] < losses[0]                         # and it trains
+
+
 def test_unconvertible_python_still_falls_back():
     """float() on a tensor inside the predicate cannot be AST-rescued —
     the converted function breaks again and eager fallback engages."""

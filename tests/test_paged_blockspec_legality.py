@@ -30,8 +30,7 @@ def test_paged_blockspecs_tpu_legal(B, H, KVH, D, page, S):
     max_pages = S // page
     num_pages = B * max_pages
     check_supported_paged((B, H, D), (num_pages, KVH, page, D), "bfloat16")
-    specs, scratch = paged_blockspecs(B, H, KVH, D, page, num_pages,
-                                      max_pages)
+    specs, scratch = paged_blockspecs(B, H, KVH, D, page, num_pages)
     for block, array in specs:
         assert mosaic_legal(block, array), (
             f"illegal block {block} for array {array} "
