@@ -308,16 +308,98 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
     return _task(sync_op, tensor)
 
 
+_P2P_SEQ = {}
+
+
+def _p2p_store():
+    from . import env as _env
+    if _env._store[0] is None:
+        raise RuntimeError(
+            "cross-process send/recv rides the native TCPStore mailbox: "
+            "call paddle.distributed.create_store(endpoint) first, on a "
+            "port DISTINCT from the jax coordinator (or init_rpc, which "
+            "creates one)")
+    return _env._store[0]
+
+
 def send(tensor, dst=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "point-to-point send/recv outside a pipeline schedule is not "
-        "supported; use distributed.pipeline (ppermute-based) instead.")
+    """Cross-process point-to-point send (parity: the reference pipeline's
+    NCCL p2p, `fleet/meta_parallel/pp_utils/p2p_communication.py:52`).
+
+    TPU-native split: the COMPILED pipeline path keeps stage edges
+    in-graph (ppermute, distributed/pipeline.py); this host-side path
+    carries eager stage boundaries between PROCESSES over the native
+    TCPStore mailbox with per-(src,dst) sequence keys — the transport the
+    launcher already provides. Single-process worlds have no second
+    process to talk to and raise (in-graph collectives are the tool
+    there)."""
+    import jax
+    if jax.process_count() <= 1:
+        raise NotImplementedError(
+            "send/recv needs a multi-process world (jax.process_count() "
+            "> 1); inside one process use distributed.pipeline "
+            "(ppermute-based) instead.")
+    import pickle
+    import numpy as np
+    store = _p2p_store()
+    rank = jax.process_index()
+    seq = _P2P_SEQ.get((rank, dst), 0)
+    _P2P_SEQ[(rank, dst)] = seq + 1
+    host = np.asarray(jax.device_get(getattr(tensor, "_data", tensor)))
+    store.set(f"p2p/{rank}/{dst}/{seq}", pickle.dumps(host))
+    return _task(sync_op, tensor)
+
+
+class _RecvTask(Task):
+    """recv with sync_op=False defers the blocking mailbox read to
+    wait() — irecv-then-send on both ranks must not deadlock (the
+    reference's post-receives-first pattern)."""
+
+    def __init__(self, tensor, fetch):
+        super().__init__(tensor)
+        self._fetch = fetch
+        self._done = False
+
+    def wait(self, timeout=None):
+        if not self._done:
+            self._fetch()
+            self._done = True
+        return super().wait(timeout)
+
+    def is_completed(self):
+        return self._done
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "point-to-point send/recv outside a pipeline schedule is not "
-        "supported; use distributed.pipeline (ppermute-based) instead.")
+    """Receive matching `send` (fills `tensor._data` like the reference's
+    buffer-receiving recv). sync_op=False returns a Task whose wait()
+    performs the blocking read; the mailbox key is deleted after a
+    successful read so the store does not grow unboundedly."""
+    import jax
+    if jax.process_count() <= 1:
+        raise NotImplementedError(
+            "send/recv needs a multi-process world (jax.process_count() "
+            "> 1); inside one process use distributed.pipeline "
+            "(ppermute-based) instead.")
+    import pickle
+    store = _p2p_store()
+    rank = jax.process_index()
+    seq = _P2P_SEQ.get((src, rank), 0)
+    _P2P_SEQ[(src, rank)] = seq + 1
+    key = f"p2p/{src}/{rank}/{seq}"
+
+    def _fetch():
+        raw = store.get(key, wait=True)
+        try:
+            store.delete_key(key)
+        except Exception:
+            pass  # cleanup is best-effort; correctness needs only get
+        tensor._data = jnp.asarray(pickle.loads(raw))
+
+    if sync_op:
+        _fetch()
+        return _task(True, tensor)
+    return _RecvTask(tensor, _fetch)
 
 
 def barrier(group=None):

@@ -306,9 +306,12 @@ class TracedFunction:
                     # bundle-tracked tensors are RUNTIME state: the trace
                     # reads them through bundle.load, never bakes them as
                     # constants, and the optimizer swaps _data every step
-                    # — versioning them would retrace per step. Guard on
-                    # shape/dtype only.
-                    sig.append((name, "state",
+                    # — versioning their DATA would retrace per step. The
+                    # tensor object id still guards against rebinding the
+                    # cell to a DIFFERENT parameter of the same shape
+                    # (ids are stable: the bundle keeps the objects
+                    # alive, only _data swaps).
+                    sig.append((name, "state", id(v),
                                 tuple(getattr(d, "shape", ())),
                                 str(getattr(d, "dtype", ""))))
                     continue

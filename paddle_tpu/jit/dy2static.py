@@ -96,6 +96,25 @@ def _grad_sensitive(vals):
                for v in vals)
 
 
+def _probe_body_grads(body_fn, args):
+    """Entry carries may be grad-free while the BODY pulls grad-requiring
+    closure tensors into the carry (s = s + h with h from the net) — run
+    one probe iteration and inspect its outputs. The probe's ops are dead
+    code in the final trace (XLA DCEs them); any non-grad probe failure
+    is ignored here because the while_loop attempt right after will
+    surface it as a proper conversion break."""
+    try:
+        out = body_fn(*args)
+    except Exception:
+        return
+    vals = tuple(out) if isinstance(out, (list, tuple)) else (out,)
+    if _grad_sensitive(vals):
+        raise DygraphToStaticBreak(
+            "loop body produces grad-requiring tensors; while_loop is "
+            "forward-only — using the eager fallback so gradients stay "
+            "correct")
+
+
 def _run_for_range(start, stop, step, body_fn, loop_vars):
     """Runtime helper for rewritten `for t in range(...)` (parity:
     the reference loop transformer converts `for`-over-range into its
@@ -132,6 +151,7 @@ def _run_for_range(start, stop, step, body_fn, loop_vars):
             "traced-bound for carries grad-requiring tensors; "
             "while_loop is forward-only — using the eager fallback so "
             "gradients stay correct")
+    _probe_body_grads(body_fn, (start,) + carried)
     sp = _to_int(step)
     from ..core.tensor import Tensor
     import jax.numpy as jnp
@@ -197,6 +217,7 @@ def _run_while(cond_fn, body_fn, loop_vars):
             "traced while carries grad-requiring tensors; while_loop is "
             "forward-only — using the eager fallback so gradients stay "
             "correct")
+    _probe_body_grads(body_fn, tuple(loop_vars))
     from ..static import nn as snn
     try:
         return tuple(snn.while_loop(cond_fn, body_fn, list(loop_vars)))

@@ -336,6 +336,39 @@ def test_grad_carrying_for_loop_falls_back_and_trains():
     assert l1 < l0
 
 
+def test_grad_via_body_closure_also_falls_back():
+    """The carry can enter the loop grad-free while the BODY pulls a
+    grad-requiring tensor in (s = s + h): the probe iteration must catch
+    it and fall back — not silently compile a gradient-stopping loop."""
+    paddle.seed(0)
+    net = paddle.nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+
+    def step(x, y, n):
+        h = net(x)
+        s = paddle.zeros([8, 4])       # grad-free leaf carry
+        for i in range(n):
+            s = s + h                  # h requires grad (closure pull-in)
+        loss = ((s - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    traced = paddle.jit.to_static(step, state_objects=[net, opt])
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+    w0 = np.asarray(net.weight._data).copy()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        l0 = float(np.asarray(traced(x, y, paddle.to_tensor(2))._data))
+        l1 = float(np.asarray(traced(x, y, paddle.to_tensor(2))._data))
+    assert traced._fallback_count == 1
+    assert not np.allclose(w0, np.asarray(net.weight._data))
+    assert l1 < l0
+
+
 def test_bundle_param_in_closure_does_not_retrace_per_step():
     """Bundle-tracked tensors enter the trace as runtime state (never
     baked constants); the closure guard must not version them, or every
