@@ -5,7 +5,8 @@ from __future__ import annotations
 import os
 import shutil
 
-__all__ = ["LocalFS", "recompute", "DistributedInfer", "HDFSClient"]
+__all__ = ["LocalFS", "recompute", "DistributedInfer", "HDFSClient",
+           "recompute_sequential", "recompute_hybrid"]
 
 
 def recompute(function, *args, **kwargs):
@@ -20,18 +21,102 @@ def recompute(function, *args, **kwargs):
     kwargs.pop("use_reentrant", None)   # accepted, meaningless here
     kwargs.pop("preserve_rng_state", None)
     tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    # Layer parameters enter as differentiable INPUTS of the checkpointed
+    # region (swapped in for the trace) — otherwise they would be baked
+    # into the closure as constants and get NO gradients (the reference's
+    # recompute backpropagates into the block's weights).
+    params = [p for p in function.parameters()
+              if not p.stop_gradient] \
+        if hasattr(function, "parameters") else []
+    n_args = len(tensor_idx)
 
     def _f(*arrays):
         full = list(args)
-        for i, a in zip(tensor_idx, arrays):
+        for i, a in zip(tensor_idx, arrays[:n_args]):
             full[i] = Tensor(a)
-        out = function(*full, **kwargs)
+        saved = [p._data for p in params]
+        try:
+            for p, a in zip(params, arrays[n_args:]):
+                p._data = a
+            out = function(*full, **kwargs)
+        finally:
+            for p, s in zip(params, saved):
+                p._data = s
         return jax.tree_util.tree_map(
             lambda t: t._data if isinstance(t, Tensor) else t, out,
             is_leaf=lambda t: isinstance(t, Tensor))
 
     return apply_op("recompute", jax.checkpoint(_f),
-                    *[args[i] for i in tensor_idx])
+                    *([args[i] for i in tensor_idx] + params))
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Parity: reference fleet/recompute/recompute.py:622
+    recompute_sequential — chunk a Sequential into ctx['segments']
+    segments; every segment except the last is recomputed in backward
+    (the reference runs the final segment plain, same here).
+
+    ctx keys: 'segments' (int, default 1), 'preserve_rng_state'
+    (accepted; RNG determinism is structural here — jax.checkpoint
+    replays the same traced program, so the forward RNG is preserved by
+    construction)."""
+    segments = int(ctx.get("segments", 1))
+    if hasattr(functions, "_sub_layers"):     # nn.Sequential
+        funcs = list(functions._sub_layers.values())
+    else:
+        funcs = list(functions)
+
+    class _Segment:
+        """Callable over funcs[begin..end] exposing their parameters so
+        `recompute` threads them as differentiable inputs."""
+
+        def __init__(self, begin, end):
+            self.begin, self.end = begin, end
+
+        def parameters(self):
+            ps = []
+            for f in funcs[self.begin:self.end + 1]:
+                if hasattr(f, "parameters"):
+                    ps.extend(f.parameters())
+            return ps
+
+        def __call__(self, x):
+            for i in range(self.begin, self.end + 1):
+                x = funcs[i](x)
+            return x
+
+    def _run(begin, end):
+        return _Segment(begin, end)
+
+    segments = min(segments, len(funcs))   # never index past the layers
+    if segments <= 1 or len(funcs) < 2:
+        return recompute(_run(0, len(funcs) - 1), *args, **kwargs)
+    segment_size = max(len(funcs) // segments, 1)
+    end = -1
+    out = args[0] if len(args) == 1 else args
+    for begin in range(0, segment_size * (segments - 1), segment_size):
+        end = begin + segment_size - 1
+        out = recompute(_run(begin, end), out, **kwargs)
+    return _run(end + 1, len(funcs) - 1)(out)
+
+
+def recompute_hybrid(ctx, function, *args, **kwargs):
+    """Parity: reference fleet/recompute/recompute_hybrid.py:265
+    recompute_hybrid — recompute in the hybrid-parallel scene.
+
+    ctx keys: 'mp_group' (required, like the reference), 'offload' and
+    'partition'. TPU-native collapse: the reference's activation
+    partitioning over the mp group and CPU offload are manual memory
+    management around cached activations; under jax.checkpoint there ARE
+    no cached segment activations (they are rematerialized), and what
+    little is saved rides GSPMD's sharding of the traced residuals — so
+    both flags are accepted and subsumed."""
+    if ctx.get("mp_group", None) is None:
+        raise AssertionError(
+            "ctx must contains mp_group and mp_group can not be None.")
+    ctx.get("offload", False)
+    ctx.get("partition", False)
+    return recompute(function, *args, **kwargs)
 
 
 class LocalFS:

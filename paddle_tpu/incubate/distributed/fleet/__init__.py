@@ -1,9 +1,12 @@
 """incubate.distributed.fleet — PS-era fleet utilities (module-path
 parity). The collective fleet lives at paddle.distributed.fleet; the
 fleet_util/role-maker PS machinery is excluded per SURVEY A.7."""
-from ...distributed.fleet import (  # noqa: F401
+from ....distributed.fleet import (  # noqa: F401
     init, distributed_model, distributed_optimizer, DistributedStrategy,
     UtilBase,
+)
+from ....distributed.fleet.utils import (  # noqa: F401
+    recompute_sequential, recompute_hybrid,
 )
 
 
@@ -18,5 +21,8 @@ class fleet_util:
         return getattr(cls._util, item)
 
 
+from . import utils  # noqa: F401,E402
+
 __all__ = ["init", "distributed_model", "distributed_optimizer",
-           "DistributedStrategy", "UtilBase", "fleet_util"]
+           "DistributedStrategy", "UtilBase", "fleet_util",
+           "recompute_sequential", "recompute_hybrid", "utils"]

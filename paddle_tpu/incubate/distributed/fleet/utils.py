@@ -1,0 +1,137 @@
+"""incubate.distributed.fleet.utils — saved-program inspection helpers.
+
+Parity: reference `incubate/distributed/fleet/utils.py` (__all__:
+load_program, save_program, program_type_trans, check_saved_vars_try_dump,
+parse_program, check_pruned_program_vars, graphviz) — debugging tools for
+serialized inference programs. TPU-native mapping: a static `Program`
+here is a placeholder registry whose op graph lives on the autograd tape
+(static/__init__.py:49), so these tools serialize/inspect that
+description: binary format = pickled dict, text format = JSON. The
+reference's ProgramDesc-protobuf surgery (PS-era) is excluded per
+SURVEY A.7; the entry points keep the same shapes so tooling scripts
+port across.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+
+__all__ = ["load_program", "save_program", "program_type_trans",
+           "check_saved_vars_try_dump", "parse_program",
+           "check_pruned_program_vars", "graphviz"]
+
+
+def _describe(program):
+    """A Program's serializable description: its placeholder variables
+    (name, shape, dtype) — the persistable-var inventory the reference's
+    tools walk."""
+    out = []
+    for t in getattr(program, "placeholders", []):
+        d = getattr(t, "_data", None)
+        out.append({
+            "name": getattr(t, "name", None) or f"var_{id(t) & 0xffff}",
+            "shape": list(getattr(d, "shape", ())),
+            "dtype": str(getattr(d, "dtype", "")),
+        })
+    return {"vars": out}
+
+
+def save_program(program, model_filename="__model__", is_text=False):
+    """Parity: utils.py:82 — binary (pickle) or text (JSON) dump."""
+    desc = _describe(program)
+    if is_text:
+        with open(model_filename, "w") as f:
+            json.dump(desc, f, indent=2)
+    else:
+        with open(model_filename, "wb") as f:
+            pickle.dump(desc, f)
+    return model_filename
+
+
+def load_program(model_filename, is_text=False):
+    """Parity: utils.py:59 — returns the program description dict."""
+    if is_text:
+        with open(model_filename) as f:
+            return json.load(f)
+    with open(model_filename, "rb") as f:
+        return pickle.load(f)
+
+
+def program_type_trans(prog_dir, prog_fn, is_text):
+    """Parity: utils.py:141 — convert a saved program between binary and
+    text; returns the converted filename (reference convention:
+    `<name>.bin` / `<name>.pbtxt` sibling)."""
+    path = os.path.join(prog_dir, prog_fn)
+    desc = load_program(path, is_text=is_text)
+    if is_text:      # text -> binary
+        out = prog_fn + ".bin"
+        with open(os.path.join(prog_dir, out), "wb") as f:
+            pickle.dump(desc, f)
+    else:            # binary -> text
+        out = prog_fn + ".pbtxt"
+        with open(os.path.join(prog_dir, out), "w") as f:
+            json.dump(desc, f, indent=2)
+    return out
+
+
+def parse_program(program, output_dir):
+    """Parity: utils.py:454 — write a human-readable program report."""
+    desc = program if isinstance(program, dict) else _describe(program)
+    os.makedirs(output_dir, exist_ok=True)
+    path = os.path.join(output_dir, "program.txt")
+    with open(path, "w") as f:
+        f.write(f"program: {len(desc['vars'])} vars\n")
+        for v in desc["vars"]:
+            f.write(f"  {v['name']}: shape={v['shape']} "
+                    f"dtype={v['dtype']}\n")
+    return path
+
+
+def check_pruned_program_vars(train_prog, pruned_prog):
+    """Parity: utils.py:91 — every pruned-program var must exist in the
+    train program with matching shape/dtype; returns True on match and
+    logs mismatches like the reference."""
+    train = {v["name"]: v for v in _describe(train_prog)["vars"]}
+    is_match = True
+    for v in _describe(pruned_prog)["vars"]:
+        tv = train.get(v["name"])
+        if tv is None:
+            print(f"var {v['name']} not in train program")
+            is_match = False
+        elif tv["shape"] != v["shape"] or tv["dtype"] != v["dtype"]:
+            print(f"var {v['name']} shape/dtype mismatch: "
+                  f"{tv['shape']}/{tv['dtype']} vs {v['shape']}/{v['dtype']}")
+            is_match = False
+    return is_match
+
+
+def check_saved_vars_try_dump(dump_dir, dump_prog_fn, is_text_dump_program,
+                              feed_config=None, fetch_config=None,
+                              batch_size=1, save_filename=None):
+    """Parity: utils.py:421 — load a saved program description and verify
+    each declared var; returns the var list (the reference additionally
+    replays a batch through the PS executor, excluded per A.7)."""
+    desc = load_program(os.path.join(dump_dir, dump_prog_fn),
+                        is_text=is_text_dump_program)
+    missing = [v["name"] for v in desc["vars"] if not v["shape"]]
+    if missing:
+        print(f"vars with unknown shapes: {missing}")
+    return desc["vars"]
+
+
+def graphviz(block, output_dir="", filename="debug"):
+    """Parity: utils.py:127 — emit a Graphviz .dot of the block's vars
+    (the tape-resident op graph has no static description to plot; the
+    placeholder inventory is what a Program owns here)."""
+    desc = block if isinstance(block, dict) else _describe(block)
+    os.makedirs(output_dir or ".", exist_ok=True)
+    path = os.path.join(output_dir or ".", filename + ".dot")
+    lines = ["digraph G {"]
+    for v in desc["vars"]:
+        lines.append(f'  "{v["name"]}" [shape=box, '
+                     f'label="{v["name"]}\\n{v["shape"]}"];')
+    lines.append("}")
+    with open(path, "w") as f:
+        f.write("\n".join(lines))
+    return path

@@ -1,8 +1,12 @@
 """incubate dist_save: gather-then-save (reference dist_save.py save —
-gathers sharded/TP state to one rank before serialization)."""
+gathers sharded/TP state to one rank before serialization; the module
+also re-exports save_for_auto_inference like the reference's
+dist_save.py:30 import surface)."""
 import numpy as np
 
-__all__ = ["save"]
+from .save_for_auto import save_for_auto_inference  # noqa: F401
+
+__all__ = ["save", "save_for_auto_inference"]
 
 
 def save(state_dict, path, **configs):

@@ -69,7 +69,148 @@ class QuantizedConv2DTranspose(QuantizedConv2D):
     """Parity: quant_layers.QuantizedConv2DTranspose."""
 
 
+# Parity: reference quant_layers.py:541 `QuantStub =
+# MovingAverageAbsMaxScale` — records the input scale, passes through.
+QuantStub = MovingAverageAbsMaxScale
+
+
+def _per_channel_fake_quant(w, bits):
+    """Fake-quantize a (in, out) weight per OUTPUT channel (the
+    reference's _linear_quant_axis=1) with straight-through gradients.
+    TP note: the reference computes channel absmax per shard and
+    all-reduces it with reduce_type='max' over the mp group
+    (quant_layers.py:902); here the TP weight is ONE sharded array under
+    GSPMD, so the channel absmax already spans every shard and the max
+    reduction is implicit in the compiled reduce."""
+    import jax
+    import jax.numpy as jnp
+    from ...quantization import _fake_quant
+    qmax = 2.0 ** (bits - 1) - 1
+    absmax = jnp.max(jnp.abs(jax.lax.stop_gradient(w)), axis=0)
+    scale = jnp.maximum(absmax / qmax, 1e-10)
+    return _fake_quant(w, scale, qmax)
+
+
+class _QuantizedParallelLinearBase:
+    """Shared QAT machinery for the TP linears: moving-average absmax on
+    the input activation, per-output-channel fake-quant on the weight,
+    then the WRAPPED layer's own forward (its GSPMD sharding constraints
+    play the reference's c_identity/c_concat/allreduce collectives)."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, weight_quantize_type="abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_pre_layer=None, act_pre_layer=None,
+                 weight_quant_layer=None, act_quant_layer=None):
+        if weight_quant_layer is not None or act_quant_layer is not None:
+            raise AssertionError(
+                "When quantizing a parallel Linear, weight_quant_layer "
+                "and act_quant_layer should be None (reference "
+                "quant_layers.py:875-880 contract)")
+        self._layer = layer
+        self._weight_bits = weight_bits
+        self._fake_quant_input = FakeQuanterWithAbsMaxObserver(
+            moving_rate=moving_rate, bit_length=activation_bits)
+        self._act_preprocess = act_pre_layer() if act_pre_layer else None
+        self._weight_preprocess = \
+            weight_pre_layer() if weight_pre_layer else None
+
+    # the reference exposes the wrapped layer's weight/bias directly
+    @property
+    def weight(self):
+        return self._layer.weight
+
+    @property
+    def bias(self):
+        return self._layer.bias
+
+    def parameters(self):
+        return self._layer.parameters()
+
+    def __call__(self, x):
+        if self._act_preprocess is not None:
+            x = self._act_preprocess(x)
+        qx = self._fake_quant_input(x)
+        w = self._layer.weight
+        # preprocess (if any) feeds the fake quant, and the result is
+        # swapped into the LAYER's weight so its forward actually uses it
+        src = w if self._weight_preprocess is None \
+            else self._weight_preprocess(w)
+        saved = w._data
+        w._data = _per_channel_fake_quant(src._data, self._weight_bits)
+        try:
+            return self._layer(qx)
+        finally:
+            w._data = saved
+
+    forward = __call__
+
+
+class QuantizedColumnParallelLinear(_QuantizedParallelLinearBase):
+    """Parity: quant_layers.py:850 QuantizedColumnParallelLinear — QAT
+    over the column-parallel linear: identity-forward of the replicated
+    input (GSPMD's version of _c_identity), fake-quant input + weight,
+    the wrapped layer's gather_output constraint stands in for
+    _c_concat."""
+
+    def __init__(self, layer, **kwargs):
+        from ...distributed.fleet.mpu import ColumnParallelLinear
+        if not isinstance(layer, ColumnParallelLinear):
+            raise TypeError(
+                f"QuantizedColumnParallelLinear wraps a "
+                f"ColumnParallelLinear, got {type(layer).__name__}")
+        super().__init__(layer, **kwargs)
+        self.gather_output = layer.gather_output
+
+
+class QuantizedRowParallelLinear(_QuantizedParallelLinearBase):
+    """Parity: quant_layers.py:953 QuantizedRowParallelLinear — QAT over
+    the row-parallel linear; the wrapped forward's P() output constraint
+    is the reference's mp_allreduce_sum."""
+
+    def __init__(self, layer, **kwargs):
+        from ...distributed.fleet.mpu import RowParallelLinear
+        if not isinstance(layer, RowParallelLinear):
+            raise TypeError(
+                f"QuantizedRowParallelLinear wraps a RowParallelLinear, "
+                f"got {type(layer).__name__}")
+        super().__init__(layer, **kwargs)
+        self.input_is_parallel = layer.input_is_parallel
+
+
+class QuantizedMatmul:
+    """Parity: quant_layers.py:1060 QuantizedMatmul — both operands fake
+    quantized (activation quanters), then paddle.matmul."""
+
+    def __init__(self, layer=None, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, weight_quantize_type="abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_pre_layer=None, act_pre_layer=None,
+                 weight_quant_layer=None, act_quant_layer=None):
+        mk = act_quant_layer if act_quant_layer is not None else (
+            lambda: FakeQuanterWithAbsMaxObserver(
+                moving_rate=moving_rate, bit_length=activation_bits))
+        self._fake_quant_x = mk()
+        self._fake_quant_y = mk()
+        self._act_preprocess_x = act_pre_layer() if act_pre_layer else None
+        self._act_preprocess_y = act_pre_layer() if act_pre_layer else None
+
+    def __call__(self, x, y, transpose_x=False, transpose_y=False,
+                 name=None):
+        from ...ops.linalg import matmul
+        if self._act_preprocess_x is not None:
+            x = self._act_preprocess_x(x)
+        if self._act_preprocess_y is not None:
+            y = self._act_preprocess_y(y)
+        return matmul(self._fake_quant_x(x), self._fake_quant_y(y),
+                      transpose_x=transpose_x, transpose_y=transpose_y)
+
+    forward = __call__
+
+
 __all__ += ["FakeQuantAbsMax", "FakeQuantMovingAverageAbsMax",
             "FakeQuantChannelWiseAbsMax", "QuantizedConv2D",
             "QuantizedConv2DTranspose", "MovingAverageAbsMaxScale",
-            "MAOutputScaleLayer", "FakeQuantMAOutputScaleLayer"]
+            "MAOutputScaleLayer", "FakeQuantMAOutputScaleLayer",
+            "QuantStub", "QuantizedColumnParallelLinear",
+            "QuantizedRowParallelLinear", "QuantizedMatmul"]

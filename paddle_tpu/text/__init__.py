@@ -16,85 +16,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.tensor import Tensor
-from ..nn.layer.layers import Layer
-from ..ops.dispatch import apply_op
+from .viterbi_decode import viterbi_decode, ViterbiDecoder  # noqa: F401
 
 __all__ = ["viterbi_decode", "ViterbiDecoder", "Conll05st", "Imdb",
            "Imikolov", "Movielens", "UCIHousing", "WMT14", "WMT16"]
-
-
-def viterbi_decode(potentials, transition_params, lengths,
-                   include_bos_eos_tag=True, name=None):
-    """CRF Viterbi decoding. Parity: text/viterbi_decode.py.
-
-    potentials: (B, T, N) unary emissions; transition_params: (N, N);
-    lengths: (B,) valid lengths. Returns (scores (B,), paths (B, T))."""
-
-    def _f(emis, trans, lens):
-        B, T, N = emis.shape
-        lens = lens.astype(jnp.int32)
-        if include_bos_eos_tag:
-            # reference convention: tags N-2 = BOS, N-1 = EOS
-            bos, eos = N - 2, N - 1
-            alpha0 = emis[:, 0] + trans[bos][None, :]
-        else:
-            alpha0 = emis[:, 0]
-
-        def step(carry, t):
-            alpha = carry                               # (B, N)
-            scores = alpha[:, :, None] + trans[None]    # (B, from, to)
-            best = jnp.max(scores, axis=1) + emis[:, t]
-            back = jnp.argmax(scores, axis=1)           # (B, N)
-            # positions past the sequence end keep their alpha
-            mask = (t < lens)[:, None]
-            alpha = jnp.where(mask, best, alpha)
-            back = jnp.where(mask, back,
-                             jnp.arange(N, dtype=back.dtype)[None, :])
-            return alpha, back
-
-        if T == 1:
-            alpha = alpha0
-            if include_bos_eos_tag:
-                alpha = alpha + trans[:, eos][None, :]
-            scores = jnp.max(alpha, axis=1)
-            last = jnp.argmax(alpha, axis=1)
-            return scores, last[:, None].astype(jnp.int64)
-        alpha, backs = jax.lax.scan(step, alpha0, jnp.arange(1, T))
-        if include_bos_eos_tag:
-            alpha = alpha + trans[:, eos][None, :]
-        scores = jnp.max(alpha, axis=1)
-        last = jnp.argmax(alpha, axis=1)                # (B,)
-
-        def trace(carry, back_t):
-            tag = carry                                 # (B,)
-            prev = jnp.take_along_axis(back_t, tag[:, None], axis=1)[:, 0]
-            return prev, tag
-
-        _, path_rev = jax.lax.scan(trace, last, backs, reverse=True)
-        # path_rev: (T-1, B) tags for steps 1..T-1 — prepend step-0 tags
-        first = jnp.where(
-            (1 < lens), jnp.take_along_axis(
-                backs[0], path_rev[0][:, None], axis=1)[:, 0], last)
-        paths = jnp.concatenate([first[None], path_rev], axis=0).T  # (B, T)
-        return scores, paths.astype(jnp.int64)
-
-    return apply_op("viterbi_decode", _f, potentials, transition_params,
-                    lengths)
-
-
-class ViterbiDecoder(Layer):
-    """Parity: text/viterbi_decode.py ViterbiDecoder layer."""
-
-    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
-        super().__init__()
-        self.transitions = transitions if isinstance(transitions, Tensor) \
-            else Tensor(jnp.asarray(transitions))
-        self.include_bos_eos_tag = include_bos_eos_tag
-
-    def forward(self, potentials, lengths):
-        return viterbi_decode(potentials, self.transitions, lengths,
-                              self.include_bos_eos_tag)
 
 
 class _LocalTextDataset:

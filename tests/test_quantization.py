@@ -184,3 +184,100 @@ def test_ptq_uses_observer_scales():
     conv = ptq.convert(qm)
     np.testing.assert_allclose(np.asarray(conv[0].weight_scale._data),
                                wob.scales(), rtol=1e-6)
+
+
+# ------------------------------------------- QAT for TP layers (VERDICT r3 #6)
+def test_quant_stub_passthrough_records_scale():
+    """Parity: quant_layers.py:541 QuantStub = MovingAverageAbsMaxScale —
+    identity forward, running scale recorded."""
+    from paddle_tpu.nn.quant.quant_layers import QuantStub
+    stub = QuantStub()
+    x = paddle.to_tensor(rng.randn(4, 8).astype(np.float32) * 3.0)
+    out = stub(x)
+    np.testing.assert_array_equal(np.asarray(out._data),
+                                  np.asarray(x._data))
+    assert stub.scales() > 0
+
+
+def test_quantized_matmul_close_and_transpose():
+    from paddle_tpu.nn.quant.quant_layers import QuantizedMatmul
+    qm = QuantizedMatmul()
+    x = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(8, 5).astype(np.float32))
+    out = qm(x, y)
+    ref = np.asarray(x._data) @ np.asarray(y._data)
+    np.testing.assert_allclose(np.asarray(out._data), ref,
+                               rtol=0.1, atol=0.15)  # 8-bit error bound
+    yt = paddle.to_tensor(np.asarray(y._data).T.copy())
+    out_t = qm(x, yt, transpose_y=True)
+    np.testing.assert_allclose(np.asarray(out_t._data),
+                               np.asarray(out._data), rtol=0.05, atol=0.05)
+
+
+def _tp_mlp():
+    from paddle_tpu.distributed.fleet.mpu import (ColumnParallelLinear,
+                                                  RowParallelLinear)
+    paddle.seed(3)
+    col = ColumnParallelLinear(16, 32, gather_output=False)
+    row = RowParallelLinear(32, 16, input_is_parallel=True)
+    return col, row
+
+
+def test_quantized_parallel_linears_qat_roundtrip():
+    """VERDICT r3 item 6 'done' criterion: quantize a TP mlp -> train a
+    step (grads reach the WRAPPED parameters through the fake-quant STE)
+    -> export via the QAT convert flow."""
+    from paddle_tpu.nn.quant.quant_layers import (
+        QuantizedColumnParallelLinear, QuantizedRowParallelLinear)
+    col, row = _tp_mlp()
+    qcol = QuantizedColumnParallelLinear(col)
+    qrow = QuantizedRowParallelLinear(row)
+    params = list(col.parameters()) + list(row.parameters())
+    opt = paddle.optimizer.SGD(0.05, parameters=params)
+
+    x = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+
+    # quantized forward tracks the float forward within 8-bit error
+    ref = row(col(x))
+    out = qrow(qcol(x))
+    np.testing.assert_allclose(np.asarray(out._data),
+                               np.asarray(ref._data), rtol=0.25, atol=0.25)
+
+    w0 = np.asarray(col.weight._data).copy()
+    losses = []
+    for _ in range(5):
+        loss = ((qrow(qcol(x)) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(np.asarray(loss._data)))
+    assert not np.allclose(w0, np.asarray(col.weight._data))
+    assert losses[-1] < losses[0]
+
+    # weight restored (fake quant is forward-only state)
+    assert col.weight._data.dtype == jnp.float32
+
+    # export: the QAT flow converts TP linears to QuantedLinear
+    from paddle_tpu.quantization import quanter  # noqa: F401
+    net = paddle.nn.Sequential(*_tp_mlp())
+    cfg = QuantConfig(
+        activation=QuanterFactory(FakeQuanterWithAbsMaxObserver),
+        weight=QuanterFactory(FakeQuanterWithAbsMaxObserver))
+    qat = QAT(cfg)
+    qnet = qat.quantize(net, inplace=False)
+    qnet(x)
+    converted = qat.convert(qnet, inplace=False)
+    assert any(isinstance(l, QuantedLinear)
+               for _, l in converted.named_sublayers())
+    assert converted(x)._data.shape == (8, 16)
+
+
+def test_quantized_parallel_linear_rejects_wrong_layer():
+    from paddle_tpu.nn.quant.quant_layers import (
+        QuantizedColumnParallelLinear, QuantizedRowParallelLinear)
+    lin = paddle.nn.Linear(4, 4)
+    with pytest.raises(TypeError):
+        QuantizedColumnParallelLinear(lin)
+    with pytest.raises(TypeError):
+        QuantizedRowParallelLinear(lin)

@@ -17,6 +17,11 @@ EXCLUDED_PREFIXES = (
     "cinn", "tensorrt", "device.xpu", "incubate.xpu",
     "distributed.ps", "autograd.ir_backward", "cost_model",
     "incubate.distributed.fleet.fleet_util",
+    # the package re-export shadows the module attribute in the REFERENCE
+    # too (paddle.text.viterbi_decode is the function there as well, so
+    # this attribute walk fails identically on the reference); the module
+    # file exists with matching __all__ at paddle_tpu/text/viterbi_decode.py
+    "text.viterbi_decode",
 )
 
 
