@@ -80,8 +80,12 @@ def recompute_sequential(ctx, functions, *args, **kwargs):
                     ps.extend(f.parameters())
             return ps
 
-        def __call__(self, x):
-            for i in range(self.begin, self.end + 1):
+        def __call__(self, *inputs, **kw):
+            # the FIRST layer of a segment may take the user's full
+            # (*args, **kwargs); later layers chain single values
+            # (Sequential contract, reference _run_func)
+            x = funcs[self.begin](*inputs, **kw)
+            for i in range(self.begin + 1, self.end + 1):
                 x = funcs[i](x)
             return x
 
@@ -92,11 +96,12 @@ def recompute_sequential(ctx, functions, *args, **kwargs):
     if segments <= 1 or len(funcs) < 2:
         return recompute(_run(0, len(funcs) - 1), *args, **kwargs)
     segment_size = max(len(funcs) // segments, 1)
-    end = -1
-    out = args[0] if len(args) == 1 else args
-    for begin in range(0, segment_size * (segments - 1), segment_size):
+    end = segment_size - 1
+    out = recompute(_run(0, end), *args, **kwargs)
+    for begin in range(segment_size, segment_size * (segments - 1),
+                       segment_size):
         end = begin + segment_size - 1
-        out = recompute(_run(begin, end), out, **kwargs)
+        out = recompute(_run(begin, end), out)
     return _run(end + 1, len(funcs) - 1)(out)
 
 

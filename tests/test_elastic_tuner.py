@@ -105,6 +105,76 @@ def test_tuner_measures_on_live_mesh():
     assert all(isinstance(h["max_mem_usage"], int) for h in measured)
 
 
+def test_tuner_measures_users_model_not_proxy():
+    """VERDICT r3 item 7: tune(train_step_fn=...) times the USER'S model.
+    The user model's cost profile inverts both the analytic ranking and
+    what the mesh proxy would say (the proxy favors fewer collectives,
+    i.e. mp=1); the tuner must follow the user measurement."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.distributed.auto_tuner import measure_on_mesh
+
+    cands = [
+        {"dp_degree": 8, "mp_degree": 1, "pp_degree": 1,
+         "sharding_degree": 1, "micro_batch_size": 1},
+        {"dp_degree": 4, "mp_degree": 2, "pp_degree": 1,
+         "sharding_degree": 1, "micro_batch_size": 1},
+    ]
+    tuner = AutoTuner(dict(TUNER_CFG, candidates=[dict(c) for c in cands]))
+    # analytic model prefers mp=1 (no mp efficiency penalty)
+    assert tuner.candidates[0]["mp_degree"] == 1
+
+    built = []
+
+    def user_step_builder(tuner_cfg, cfg):
+        """The user's 'model': for mp=1 it must run extra host-side work
+        every step (say, a data pipeline the proxy knows nothing about),
+        so the REAL ranking favors mp=2."""
+        built.append(cfg["mp_degree"])
+        size = 4096 if cfg["mp_degree"] == 1 else 256
+
+        def step():
+            x = jnp.ones((size, size), jnp.float32)
+            return (x @ x).sum()
+        return step
+
+    best = tuner.tune(train_step_fn=user_step_builder)
+    assert sorted(built) == [1, 2]            # both candidates measured
+    assert best["mp_degree"] == 2             # real measurement wins
+    assert all(h.get("user_model") for h in tuner.history_cfgs
+               if h.get("measured"))
+    # and the proxy would NOT have produced this ranking: it models only
+    # layout/collective cost, where mp=1 avoids the weight collectives
+    p1 = measure_on_mesh(TUNER_CFG, cands[0])
+    p2 = measure_on_mesh(TUNER_CFG, cands[1])
+    assert p1["time"] > 0 and p2["time"] > 0
+
+
+def test_tuner_user_step_failure_recorded_not_fatal():
+    """A candidate whose user-model build/step raises is recorded as
+    SKIP/OOM and the search continues."""
+    tuner = AutoTuner(dict(TUNER_CFG, candidates=[
+        {"dp_degree": 8, "mp_degree": 1, "pp_degree": 1,
+         "sharding_degree": 1, "micro_batch_size": 1},
+        {"dp_degree": 4, "mp_degree": 2, "pp_degree": 1,
+         "sharding_degree": 1, "micro_batch_size": 1},
+    ]))
+
+    def builder(tuner_cfg, cfg):
+        if cfg["mp_degree"] == 1:
+            raise RuntimeError("RESOURCE_EXHAUSTED: pretend OOM")
+
+        def step():
+            import jax.numpy as jnp
+            return jnp.ones(()).sum()
+        return step
+
+    best = tuner.tune(train_step_fn=builder)
+    assert best["mp_degree"] == 2
+    skipped = [h for h in tuner.history_cfgs if h.get("time") == -1]
+    assert len(skipped) == 1
+
+
 def test_tuner_predicts_oom_from_memory_budget():
     """Candidates whose modeled memory exceeds the per-chip budget are
     recorded as predicted OOM without launching."""
