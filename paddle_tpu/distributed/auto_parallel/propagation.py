@@ -48,6 +48,9 @@ _ATTR_DEPENDENT = {
     "cumprod": ("axis",), "cummax": ("axis",), "cummin": ("axis",),
     "logcumsumexp": ("axis",), "logsumexp": ("axis",), "p_norm": ("axis",),
     "norm": ("axis",), "pad": ("padded_dims",), "gather": ("axis",),
+    "squeeze": ("axis", "x_ndim"), "unsqueeze": ("axis", "x_ndim"),
+    "argmax": ("axis",), "argmin": ("axis",),
+    "conv2d": ("channel_last",),
 }
 
 # Observability (VERDICT r3 weak #4: silent `except: pass` made a broken

@@ -496,6 +496,92 @@ def moe_combine_rule(y_spec, gate_spec=None, **attrs):
     return SpmdResult([y_spec, gate_spec], out, partial_axes=partial)
 
 
+@register_spmd_rule("squeeze")
+def squeeze_rule(x_spec, axis=None, x_ndim=None, **attrs):
+    """spmd_rules/squeeze.cc: squeezed (size-1) dims are never sharded;
+    their entries drop out, others pass through."""
+    nd = x_ndim if x_ndim is not None else len(tuple(x_spec or ()))
+    xs = _pad(x_spec, nd)
+    if axis is None:
+        # without shapes we cannot know which dims are size-1 — abstain
+        return SpmdResult([x_spec], P())
+    axes = {int(a) % nd for a in
+            (axis if isinstance(axis, (list, tuple)) else [axis])}
+    out = [e for i, e in enumerate(xs) if i not in axes]
+    return SpmdResult([x_spec], P(*out))
+
+
+@register_spmd_rule("unsqueeze")
+def unsqueeze_rule(x_spec, axis=None, x_ndim=None, **attrs):
+    """spmd_rules/unsqueeze.cc: new dims enter replicated; existing dims
+    keep their sharding."""
+    nd = x_ndim if x_ndim is not None else len(tuple(x_spec or ()))
+    out = list(_pad(x_spec, nd))
+    axes = [int(a) for a in
+            (axis if isinstance(axis, (list, tuple)) else [axis or 0])]
+    for a in axes:
+        a = a if a >= 0 else len(out) + 1 + a
+        out.insert(min(max(a, 0), len(out)), None)
+    return SpmdResult([x_spec], P(*out))
+
+
+# argmax/argmin share the reduction shape rule (spmd_rules/argmax.cc);
+# a sharded reduced dim is marked Partial — argmax does not combine by
+# sum, so the hook abstains and GSPMD handles it.
+register_spmd_rule(["argmax", "argmin"])(reduction_rule)
+
+
+@register_spmd_rule("numel")
+def numel_rule(x_spec, **attrs):
+    """spmd_rules/numel.cc: scalar count — replicated output (partial if
+    the input is sharded). REGISTRY PARITY ONLY: paddle_tpu's numel
+    constructs its result without dispatching through apply_op, so this
+    rule never fires on the live path — it exists for planners querying
+    `infer_spmd` like the reference registry."""
+    sharded = [e for e in tuple(x_spec or ()) if e is not None]
+    return SpmdResult([x_spec], P(), partial_axes=tuple(sharded))
+
+
+@register_spmd_rule("nonzero")
+def nonzero_rule(x_spec, **attrs):
+    """spmd_rules/nonzero.cc: data-dependent output extent — replicated
+    input/output. REGISTRY PARITY ONLY (same caveat as numel; and the
+    behavior matches the replicated fallback by design)."""
+    return SpmdResult([P()], P())
+
+
+@register_spmd_rule(["full_like", "zeros_like", "ones_like",
+                     "empty_like"])
+def full_like_rule(x_spec, *rest, **attrs):
+    """spmd_rules/full_like.cc: shape follows the input, so its
+    placement can too (value is constant everywhere). REGISTRY PARITY
+    ONLY: the *_like creation ops build Tensors directly."""
+    return SpmdResult([x_spec] + [P() for _ in rest], x_spec)
+
+
+@register_spmd_rule("add_n")
+def add_n_rule(*in_specs, **attrs):
+    """spmd_rules/add_n.cc: elementwise sum over the operand list."""
+    return elementwise_rule(*in_specs, **attrs)
+
+
+@register_spmd_rule("conv2d")
+def conv2d_rule(x_spec, w_spec, *rest, channel_last=False, **attrs):
+    """spmd_rules/conv2d.cc: batch follows x dim 0, out-channel follows
+    the weight's dim 0 (jax OIHW layout); spatial dims replicated (halo
+    exchange is GSPMD's call); a sharded in-channel is Partial. The
+    call site threads `channel_last` so NHWC places the channel on the
+    last dim instead of dim 1."""
+    xs, ws = _pad(x_spec, 4), _pad(w_spec, 4)
+    c_dim = 3 if channel_last else 1
+    partial = tuple(e for e in (xs[c_dim], ws[1]) if e is not None)
+    out = [None] * 4
+    out[0] = xs[0]
+    out[c_dim] = ws[0]
+    return SpmdResult([x_spec, w_spec] + [P() for _ in rest], P(*out),
+                      partial_axes=partial)
+
+
 @register_spmd_rule(["check_finite_and_unscale", "update_loss_scaling"])
 def amp_check_rule(*in_specs, **attrs):
     """rules.h check_finite_and_unscale: each grad keeps its placement;

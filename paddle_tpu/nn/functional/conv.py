@@ -62,7 +62,8 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, data_format, n):
             shape[out.ndim - 1 if channel_last else 1] = b.shape[0]
             out = out + b.reshape(shape)
         return out
-    return apply_op("conv%dd" % n, _f, x, weight, bias)
+    return apply_op("conv%dd" % n, _f, x, weight, bias,
+                    op_attrs={"channel_last": bool(channel_last)})
 
 
 def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,

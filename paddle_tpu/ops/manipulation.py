@@ -116,13 +116,18 @@ def vsplit(x, num_or_indices, name=None):
 
 
 def squeeze(x, axis=None, name=None):
+    # normalized size-1 axes, shared by the kernel and the SPMD rule
+    sq_axes = None if axis is None else \
+        [int(ax) % x.ndim for ax in
+         (axis if isinstance(axis, (list, tuple)) else [axis])
+         if x.shape[int(ax) % x.ndim] == 1]
+
     def _f(a):
-        if axis is None:
+        if sq_axes is None:
             return jnp.squeeze(a)
-        axes = axis if isinstance(axis, (list, tuple)) else [axis]
-        axes = tuple(int(ax) % a.ndim for ax in axes if a.shape[int(ax) % a.ndim] == 1)
-        return jnp.squeeze(a, axis=axes) if axes else a
-    return apply_op("squeeze", _f, x)
+        return jnp.squeeze(a, axis=tuple(sq_axes)) if sq_axes else a
+    return apply_op("squeeze", _f, x,
+                    op_attrs={"axis": sq_axes, "x_ndim": x.ndim})
 
 
 def squeeze_(x, axis=None, name=None):
@@ -138,7 +143,8 @@ def unsqueeze(x, axis, name=None):
         for ax in axes:
             out = jnp.expand_dims(out, ax)
         return out
-    return apply_op("unsqueeze", _f, x)
+    return apply_op("unsqueeze", _f, x,
+                    op_attrs={"axis": axes, "x_ndim": x.ndim})
 
 
 def unsqueeze_(x, axis, name=None):

@@ -28,7 +28,9 @@ def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
                          axis=None if axis is None else int(axis),
                          keepdims=keepdim if axis is not None else False)
         return out.astype(d)
-    return apply_op("argmax", _f, x)
+    return apply_op("argmax", _f, x,
+                    op_attrs={"axis": None if axis is None else int(axis),
+                              "keepdim": keepdim})
 
 
 def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
@@ -39,7 +41,9 @@ def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
                          axis=None if axis is None else int(axis),
                          keepdims=keepdim if axis is not None else False)
         return out.astype(d)
-    return apply_op("argmin", _f, x)
+    return apply_op("argmin", _f, x,
+                    op_attrs={"axis": None if axis is None else int(axis),
+                              "keepdim": keepdim})
 
 
 def argsort(x, axis=-1, descending=False, stable=False, name=None):

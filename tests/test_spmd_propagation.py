@@ -284,6 +284,34 @@ def test_new_rules_registry_semantics():
     # p_norm over a sharded dim abstains via Partial
     r = infer_spmd("p_norm", P("data", "model"), axis=1)
     assert r.partial_axes == ("model",)
+    # squeeze drops the squeezed entry; unsqueeze inserts a replicated dim
+    r = infer_spmd("squeeze", P("data", None, "model"), axis=[1], x_ndim=3)
+    assert r.out_specs[0] == P("data", "model")
+    r = infer_spmd("unsqueeze", P("data", "model"), axis=[1], x_ndim=2)
+    assert r.out_specs[0] == P("data", None, "model")
+    # argmax over a sharded dim abstains (not sum-combinable)
+    r = infer_spmd("argmax", P("data", "model"), axis=1)
+    assert r.partial_axes == ("model",)
+    # conv2d: batch + out-channel propagate, in-channel sharding -> Partial
+    r = infer_spmd("conv2d", P("data", None, None, None),
+                   P("model", None, None, None))
+    assert r.out_specs[0] == P("data", "model", None, None)
+    r = infer_spmd("conv2d", P("data", "model", None, None),
+                   P(None, "model", None, None))
+    assert r.partial_axes == ("model", "model")
+    # NHWC: out-channel lands on the LAST dim, in-channel check moves too
+    r = infer_spmd("conv2d", P("data", None, None, None),
+                   P("model", None, None, None), channel_last=True)
+    assert r.out_specs[0] == P("data", None, None, "model")
+    r = infer_spmd("conv2d", P("data", None, None, "model"),
+                   P(None, "model", None, None), channel_last=True)
+    assert r.partial_axes == ("model", "model")
+    # numel of a sharded tensor abstains via Partial; replicated is exact
+    assert infer_spmd("numel", P("data")).partial_axes == ("data",)
+    assert infer_spmd("numel", P()).partial_axes == ()
+    # add_n merges elementwise
+    r = infer_spmd("add_n", P("data", None), P("data", None))
+    assert r.out_specs[0] == P("data", None)
 
 
 def test_shard_layer_enables_propagation():
