@@ -23,7 +23,8 @@ from .pipeline import PipelineMicroScheduler, ZB_SCHEDULES, ZBV_SCHEDULES
 __all__ = ["Job", "Plan", "FleetExecutor", "build_pipeline_plan",
            "ZeroBubbleRunner", "simulate_pipeline_makespan",
            "per_rank_schedule", "ThreadedFleetExecutor",
-           "zbv_stage_of", "build_zbv_rank_schedules"]
+           "ThreadedZBVExecutor", "zbv_stage_of",
+           "build_zbv_rank_schedules"]
 
 
 class Job:
@@ -286,7 +287,92 @@ class ZeroBubbleRunner:
         return mean_loss, self.grads
 
 
-class ThreadedFleetExecutor:
+class _ThreadedPipelineBase:
+    """Shared per-rank-thread machinery for the measured pipeline
+    executors: dependency events, per-job timing (waits excluded),
+    error fan-out, join/alive detection, per-kind durations.
+
+    Subclass contract:
+      _n_workers() -> int
+      _worker_rows(r) -> iterable of schedule rows for rank r
+      _event_key(r, row) -> (kind, micro, stage) event key
+      _prepare_job(r, row, ctx, wait) -> zero-arg compute thunk; performs
+          its dependency waits + input fetches BEFORE returning so only
+          the compute lands in the timeline. ctx = {acts, cots, inputs,
+          labels} shared stores.
+    """
+
+    timeline: Dict[tuple, tuple]
+    errors: List[BaseException]
+
+    def run(self, micro_inputs, micro_labels, timeout=300.0):
+        """Execute all ranks concurrently; returns the wall-clock
+        makespan in seconds (first job start -> last job end)."""
+        import threading
+        import time
+
+        self.timeline = {}   # reentrant: drop any previous run's spans
+        self.errors = []
+        n = self._n_workers()
+        events = {self._event_key(r, row): threading.Event()
+                  for r in range(n) for row in self._worker_rows(r)}
+        ctx = {"acts": {}, "cots": {},
+               "inputs": micro_inputs, "labels": micro_labels}
+
+        def wait(key):
+            ev = events.get(key)
+            if ev is not None and not ev.wait(timeout):
+                raise TimeoutError(f"dependency {key} never fired")
+
+        def worker(r):
+            try:
+                for row in self._worker_rows(r):
+                    key = self._event_key(r, row)
+                    thunk = self._prepare_job(r, row, ctx, wait)
+                    t0 = time.perf_counter()
+                    thunk()
+                    t1 = time.perf_counter()
+                    self.timeline[key] = (t0, t1)
+                    events[key].set()
+            except BaseException as e:  # surface to the caller
+                self.errors.append(e)
+                for ev in events.values():  # unblock everyone
+                    ev.set()
+
+        threads = [threading.Thread(target=worker, args=(r,), daemon=True)
+                   for r in range(n)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + timeout
+        for t in threads:
+            t.join(max(deadline - time.monotonic(), 0.0))
+        if any(t.is_alive() for t in threads):
+            raise TimeoutError(
+                f"pipeline ranks still running after {timeout}s join — "
+                "refusing to report a partial makespan")
+        if self.errors:
+            raise self.errors[0]
+        if not self.timeline:
+            raise RuntimeError("no jobs executed (empty schedule?)")
+        spans = list(self.timeline.values())
+        return max(t1 for _, t1 in spans) - min(t0 for t0, _ in spans)
+
+    def measured_durations(self):
+        """Mean measured duration per job kind — feed these to the
+        dependency model (`simulate_pipeline_makespan` /
+        `build_zbv_rank_schedules`) to compare it against the wall
+        clock."""
+        import statistics
+        out = {}
+        for kind in ("F", "B", "W"):
+            ds = [t1 - t0 for (k, _, _), (t0, t1) in self.timeline.items()
+                  if k == kind]
+            if ds:
+                out[kind] = statistics.mean(ds)
+        return out
+
+
+class ThreadedFleetExecutor(_ThreadedPipelineBase):
     """Per-rank worker threads executing `per_rank_schedule` event lists
     with cross-rank dependency waits — a MEASURED pipeline makespan, not a
     simulated one (VERDICT r3 weak #5: the bubble-reduction evidence was
@@ -314,97 +400,45 @@ class ThreadedFleetExecutor:
         if schedule in ZBV_SCHEDULES:
             raise NotImplementedError(
                 "ThreadedFleetExecutor runs one flat stage per rank; the "
-                "chunked ZB-V placement lives in build_zbv_rank_schedules "
-                "— refusing to silently run ZB-H1 under a V name")
+                "chunked ZB-V placement lives in ThreadedZBVExecutor — "
+                "refusing to silently run ZB-H1 under a V name")
         if schedule in ZB_SCHEDULES and bwd_w is None:
             raise ValueError("ZB schedules need bwd_w (deferred weight "
                              "grads would silently be dropped)")
         self.n_stages, self.n_micro = n_stages, n_micro
         self.schedule = schedule
         self._fwd, self._bwd_b, self._bwd_w = fwd, bwd_b, bwd_w
-        self.timeline: Dict[tuple, tuple] = {}   # (kind,m,r) -> (t0,t1)
-        self.errors: List[BaseException] = []
-
-    def run(self, micro_inputs, micro_labels, timeout=300.0):
-        """Execute all ranks concurrently; returns the wall-clock
-        makespan in seconds (first job start -> last job end)."""
-        import threading
-        import time
-
-        self.timeline = {}   # reentrant: drop any previous run's spans
+        self.timeline = {}
         self.errors = []
-        events = {}
-        acts: Dict[tuple, Any] = {}
-        cots: Dict[tuple, Any] = {}
-        for r in range(self.n_stages):
-            for kind, m in per_rank_schedule(r, self.n_stages,
-                                             self.n_micro, self.schedule):
-                events[(kind, m, r)] = threading.Event()
 
-        def wait(key):
-            ev = events.get(key)
-            if ev is not None and not ev.wait(timeout):
-                raise TimeoutError(f"dependency {key} never fired")
+    def _n_workers(self):
+        return self.n_stages
 
-        def worker(r):
-            try:
-                for kind, m in per_rank_schedule(
-                        r, self.n_stages, self.n_micro, self.schedule):
-                    if kind == "F":
-                        if r > 0:
-                            wait(("F", m, r - 1))
-                        x = micro_inputs[m] if r == 0 else acts[(m, r - 1)]
-                        t0 = time.perf_counter()
-                        acts[(m, r)] = self._fwd(r, m, x)
-                        t1 = time.perf_counter()
-                    elif kind == "B":
-                        if r < self.n_stages - 1:
-                            wait(("B", m, r + 1))
-                        g = micro_labels[m] if r == self.n_stages - 1 \
-                            else cots[(m, r + 1)]
-                        t0 = time.perf_counter()
-                        cots[(m, r)] = self._bwd_b(r, m, g)
-                        t1 = time.perf_counter()
-                    else:  # W — own B already ran (sequential rank order)
-                        t0 = time.perf_counter()
-                        self._bwd_w(r, m)
-                        t1 = time.perf_counter()
-                    self.timeline[(kind, m, r)] = (t0, t1)
-                    events[(kind, m, r)].set()
-            except BaseException as e:  # surface to the caller
-                self.errors.append(e)
-                for ev in events.values():  # unblock everyone
-                    ev.set()
+    def _worker_rows(self, r):
+        return per_rank_schedule(r, self.n_stages, self.n_micro,
+                                 self.schedule)
 
-        threads = [threading.Thread(target=worker, args=(r,), daemon=True)
-                   for r in range(self.n_stages)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join(timeout)
-        if any(t.is_alive() for t in threads):
-            raise TimeoutError(
-                f"pipeline ranks still running after {timeout}s join — "
-                "refusing to report a partial makespan")
-        if self.errors:
-            raise self.errors[0]
-        if not self.timeline:
-            raise RuntimeError("no jobs executed (empty schedule?)")
-        spans = list(self.timeline.values())
-        return max(t1 for _, t1 in spans) - min(t0 for t0, _ in spans)
+    def _event_key(self, r, row):
+        kind, m = row
+        return (kind, m, r)
 
-    def measured_durations(self):
-        """Mean measured duration per job kind — feed these to
-        `simulate_pipeline_makespan(t_f=..., t_b=..., t_w=...)` to compare
-        the dependency-model makespan against the wall clock."""
-        import statistics
-        out = {}
-        for kind in ("F", "B", "W"):
-            ds = [t1 - t0 for (k, _, _), (t0, t1) in self.timeline.items()
-                  if k == kind]
-            if ds:
-                out[kind] = statistics.mean(ds)
-        return out
+    def _prepare_job(self, r, row, ctx, wait):
+        kind, m = row
+        if kind == "F":
+            if r > 0:
+                wait(("F", m, r - 1))
+            x = ctx["inputs"][m] if r == 0 else ctx["acts"][(m, r - 1)]
+            return lambda: ctx["acts"].__setitem__(
+                (m, r), self._fwd(r, m, x))
+        if kind == "B":
+            if r < self.n_stages - 1:
+                wait(("B", m, r + 1))
+            g = ctx["labels"][m] if r == self.n_stages - 1 \
+                else ctx["cots"][(m, r + 1)]
+            return lambda: ctx["cots"].__setitem__(
+                (m, r), self._bwd_b(r, m, g))
+        # W — own B already ran (sequential rank order)
+        return lambda: self._bwd_w(r, m)
 
 
 def per_rank_schedule(rank, n_stages, n_micro, schedule):
@@ -433,6 +467,70 @@ def per_rank_schedule(rank, n_stages, n_micro, schedule):
     while zb and w < n_micro:
         evs.append(("W", w)); w += 1
     return evs
+
+
+class ThreadedZBVExecutor(_ThreadedPipelineBase):
+    """ZB-V executed with true per-rank concurrency: each rank thread
+    runs its (kind, micro, chunk) list from `build_zbv_rank_schedules`,
+    with cross-rank dependency events keyed by VIRTUAL stage. This is
+    the chunked sibling of ThreadedFleetExecutor (which deliberately
+    refuses ZB-V names) — ZB-V is thereby executed AND measurable, not
+    just enumerated. Parity: the reference's
+    PipelineZeroBubbleVirtualPipelinePass schedules run on the
+    interceptor runtime (`pipeline_zero_bubble.py:150`).
+
+    Job signatures take the VIRTUAL stage s = zbv_stage_of(rank, chunk):
+      fwd(s, m, x) -> activation
+      bwd_b(s, m, g_or_label) -> cotangent  (split dx; fused when
+                                             split_w=False)
+      bwd_w(s, m) -> None                   (deferred dw, split_w only)
+    """
+
+    def __init__(self, n_ranks, n_micro, fwd, bwd_b, bwd_w=None,
+                 split_w=True):
+        if split_w and bwd_w is None:
+            raise ValueError("split_w=True needs bwd_w (deferred weight "
+                             "grads would silently be dropped)")
+        self.n_ranks, self.n_micro = n_ranks, n_micro
+        self.n_stages = 2 * n_ranks
+        self._fwd, self._bwd_b, self._bwd_w = fwd, bwd_b, bwd_w
+        self._split_w = split_w
+        self.schedules, self.sim_makespan = build_zbv_rank_schedules(
+            n_ranks, n_micro, split_w=split_w)
+        self.timeline = {}
+        self.errors = []
+
+    def _n_workers(self):
+        return self.n_ranks
+
+    def _worker_rows(self, r):
+        return self.schedules[r]
+
+    def _event_key(self, r, row):
+        kind, m, c = row
+        return (kind, m, zbv_stage_of(r, c, self.n_ranks))
+
+    def _prepare_job(self, r, row, ctx, wait):
+        kind, m, c = row
+        s = zbv_stage_of(r, c, self.n_ranks)
+        if kind == "F":
+            if s > 0:
+                wait(("F", m, s - 1))
+            x = ctx["inputs"][m] if s == 0 else ctx["acts"][(m, s - 1)]
+            return lambda: ctx["acts"].__setitem__(
+                (m, s), self._fwd(s, m, x))
+        if kind == "B":
+            # own chunk's F may be on this rank but EARLIER events don't
+            # imply it ran: the other chunk's jobs interleave
+            wait(("F", m, s))
+            if s < self.n_stages - 1:
+                wait(("B", m, s + 1))
+            g = ctx["labels"][m] if s == self.n_stages - 1 \
+                else ctx["cots"][(m, s + 1)]
+            return lambda: ctx["cots"].__setitem__(
+                (m, s), self._bwd_b(s, m, g))
+        wait(("B", m, s))
+        return lambda: self._bwd_w(s, m)
 
 
 def zbv_stage_of(rank, chunk, n_ranks):

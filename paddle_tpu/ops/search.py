@@ -30,7 +30,8 @@ def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
         return out.astype(d)
     return apply_op("argmax", _f, x,
                     op_attrs={"axis": None if axis is None else int(axis),
-                              "keepdim": keepdim})
+                              "keepdim": keepdim if axis is not None
+                              else False})
 
 
 def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
@@ -43,7 +44,8 @@ def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
         return out.astype(d)
     return apply_op("argmin", _f, x,
                     op_attrs={"axis": None if axis is None else int(axis),
-                              "keepdim": keepdim})
+                              "keepdim": keepdim if axis is not None
+                              else False})
 
 
 def argsort(x, axis=-1, descending=False, stable=False, name=None):

@@ -27,7 +27,7 @@ import numpy as np
 
 
 def build_stage_jobs(n_stages, hidden=512, layers_per_stage=3, batch=64,
-                     seed=0):
+                     seed=0, device_of=None):
     """Per-stage MLP jobs with a HAND-SPLIT backward, the way the
     reference ZB pass splits each matmul_grad into independent dx / dw
     ops sharing saved inputs (pipeline_zero_bubble.py) — no forward
@@ -74,12 +74,15 @@ def build_stage_jobs(n_stages, hidden=512, layers_per_stage=3, batch=64,
         gx, gzs = bwd_dx(params, resid, g)
         return gx, bwd_dw(resid, gzs)
 
+    # device_of maps stage index -> device slot (ZB-V pins both of a
+    # rank's chunks to that rank's device); default = stage index
+    dev_of = device_of or (lambda s: s)
     stage_params = []
     for r in range(n_stages):
         Ws = [jnp.asarray(rng.randn(hidden, hidden).astype(np.float32)
                           * (1.0 / np.sqrt(hidden)))
               for _ in range(layers_per_stage)]
-        stage_params.append(jax.device_put(Ws, devs[r % len(devs)]))
+        stage_params.append(jax.device_put(Ws, devs[dev_of(r) % len(devs)]))
 
     def loss_fn(pred, label):
         return ((pred - label) ** 2).mean()
@@ -99,7 +102,7 @@ def build_stage_jobs(n_stages, hidden=512, layers_per_stage=3, batch=64,
              "grads": [None] * n_stages, "losses": []}
 
     def to_dev(v, r):
-        return jax.device_put(v, devs[r % len(devs)])
+        return jax.device_put(v, devs[dev_of(r) % len(devs)])
 
     def fwd(r, m, x):
         out, resid = fwd_jit(stage_params[r], to_dev(x, r))
@@ -170,6 +173,7 @@ def measure(n_stages, n_micro, hidden=1024, layers_per_stage=2, batch=128,
     ys = [rng.randn(batch, hidden).astype(np.float32)
           for _ in range(n_micro)]
 
+    repeats = max(repeats, 1)   # iteration 0 is always jit warmup
     row = {"pp": n_stages, "micro": n_micro}
     for sched, label in (("1F1B", "1f1b"), ("ZB-H1", "zb")):
         best_wall, durs = None, None
@@ -210,6 +214,69 @@ def measure(n_stages, n_micro, hidden=1024, layers_per_stage=2, batch=128,
     return row
 
 
+def measure_zbv(n_ranks, n_micro, hidden=1024, layers_per_stage=1,
+                batch=128, repeats=2):
+    """ZB-V (2 chunks/rank, V placement) vs the same placement with a
+    fused backward — both EXECUTED on the ThreadedZBVExecutor."""
+    from paddle_tpu.distributed.fleet_executor import (
+        ThreadedZBVExecutor, zbv_stage_of)
+
+    n_stages = 2 * n_ranks
+    rank_of = {}
+    for r in range(n_ranks):
+        for c in (0, 1):
+            rank_of[zbv_stage_of(r, c, n_ranks)] = r
+
+    rng = np.random.RandomState(1)
+    xs = [rng.randn(batch, hidden).astype(np.float32)
+          for _ in range(n_micro)]
+    ys = [rng.randn(batch, hidden).astype(np.float32)
+          for _ in range(n_micro)]
+
+    from paddle_tpu.distributed.fleet_executor import \
+        build_zbv_rank_schedules
+
+    repeats = max(repeats, 1)   # iteration 0 is always jit warmup
+    row = {"ranks": n_ranks, "micro": n_micro}
+    for split_w, label in ((False, "fused"), (True, "zbv")):
+        best_wall, durs, sim = None, None, None
+        jobs = build_stage_jobs(n_stages, hidden, layers_per_stage,
+                                batch, device_of=lambda s: rank_of[s])
+        for it in range(repeats + 1):
+            jobs["reset"]()
+            ex = ThreadedZBVExecutor(
+                n_ranks, n_micro, jobs["fwd"],
+                jobs["bwd_b_split"] if split_w else jobs["bwd_fused"],
+                jobs["bwd_w"] if split_w else None, split_w=split_w)
+            wall = ex.run(xs, ys)
+            if it > 0 and (best_wall is None or wall < best_wall):
+                best_wall, durs = wall, ex.measured_durations()
+                sim = ex.sim_makespan
+        row[f"wall_{label}_ms"] = best_wall * 1e3
+        row[f"durs_{label}"] = {k: v * 1e3 for k, v in durs.items()}
+        row[f"unitsim_{label}"] = sim
+        # the dependency model fed with the MEASURED durations — the
+        # makespan these jobs imply with true per-rank parallelism (the
+        # honest column on a serializing 1-core host)
+        if split_w:
+            _, msim = build_zbv_rank_schedules(
+                n_ranks, n_micro, t_f=durs.get("F", 1.0),
+                t_b=durs.get("B", 1.0), t_w=durs.get("W", 1.0))
+        else:
+            fb = durs.get("B", 1.0)
+            _, msim = build_zbv_rank_schedules(
+                n_ranks, n_micro, t_f=durs.get("F", 1.0),
+                t_b=fb * 0.5, t_w=fb * 0.5, split_w=False)
+        row[f"sim_{label}_ms"] = msim * 1e3
+    row["measured_reduction_pct"] = 100.0 * (
+        1.0 - row["wall_zbv_ms"] / row["wall_fused_ms"])
+    row["sim_reduction_pct"] = 100.0 * (
+        1.0 - row["sim_zbv_ms"] / row["sim_fused_ms"])
+    row["predicted_reduction_pct"] = 100.0 * (
+        1.0 - row["unitsim_zbv"] / row["unitsim_fused"])
+    return row
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--write-md", action="store_true")
@@ -222,6 +289,8 @@ def main(argv=None):
 
     configs = [(2, 4)] if args.quick else [(2, 4), (2, 8), (4, 4), (4, 8)]
     rows = [measure(pp, mi) for pp, mi in configs]
+    zbv_configs = [(2, 4)] if args.quick else [(2, 4), (2, 8), (4, 8)]
+    zbv_rows = [measure_zbv(p, mi) for p, mi in zbv_configs]
     hdr = ("| pp | micro | wall 1F1B (ms) | wall ZB-H1 (ms) | measured "
            "t_f/t_b/t_w (ms) | sim(measured t) 1F1B | sim(measured t) "
            "ZB-H1 | sim reduction | unit-sim predicted |")
@@ -237,6 +306,21 @@ def main(argv=None):
             f"{r['sim_1f1b_ms']:.1f} | {r['sim_zb_ms']:.1f} | "
             f"{sim_red:+.1f}% | {r['predicted_reduction_pct']:+.1f}% |")
     table = "\n".join(lines)
+    zlines = ["", "ZB-V (2 chunks/rank, V placement) vs fused backward "
+              "on the same placement — EXECUTED (ThreadedZBVExecutor):",
+              "",
+              "| ranks | micro | wall fused (ms) | wall ZB-V (ms) | "
+              "wall reduction | sim(measured t) fused | sim(measured t) "
+              "ZB-V | sim reduction | unit-sim predicted |",
+              "|" + "---|" * 9]
+    for r in zbv_rows:
+        zlines.append(
+            f"| {r['ranks']} | {r['micro']} | {r['wall_fused_ms']:.1f} | "
+            f"{r['wall_zbv_ms']:.1f} | {r['measured_reduction_pct']:+.1f}% "
+            f"| {r['sim_fused_ms']:.1f} | {r['sim_zbv_ms']:.1f} | "
+            f"{r['sim_reduction_pct']:+.1f}% | "
+            f"{r['predicted_reduction_pct']:+.1f}% |")
+    table = table + "\n" + "\n".join(zlines)
     print(table)
     if args.write_md:
         import os
