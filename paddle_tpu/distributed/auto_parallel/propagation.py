@@ -51,6 +51,7 @@ _ATTR_DEPENDENT = {
     "squeeze": ("axis", "x_ndim"), "unsqueeze": ("axis", "x_ndim"),
     "argmax": ("axis",), "argmin": ("axis",),
     "conv2d": ("channel_last",),
+    "moe_dispatch": ("x_ndim",), "moe_combine": ("y_ndim",),
 }
 
 # Observability (VERDICT r3 weak #4: silent `except: pass` made a broken

@@ -63,7 +63,9 @@ def infer_spmd(name: str, *in_specs, **attrs) -> SpmdResult:
 
 def _ent(spec, i):
     entries = tuple(spec) if spec is not None else ()
-    return entries[i] if i < len(entries) else None
+    # negative i = a broadcast dim the shorter operand doesn't have:
+    # replicated, NOT python wrap-around
+    return entries[i] if 0 <= i < len(entries) else None
 
 
 def _pad(spec, ndim):
@@ -83,12 +85,13 @@ def _replicated_rule(*in_specs, **attrs):
 def elementwise_rule(*in_specs, **attrs):
     """Broadcast elementwise: merge shardings dim-by-dim from the right;
     conflicting meshes axes fall back to replicated on that dim
-    (spmd_rules elementwise.cc)."""
-    ndim = max((len(tuple(s) or ()) for s in in_specs), default=0)
+    (spmd_rules elementwise.cc). `None` specs (unknown placement) are
+    treated as fully replicated."""
+    ndim = max((len(tuple(s or ())) for s in in_specs), default=0)
     out = []
     for i in range(ndim):
         picks = {e for s in in_specs
-                 for e in [_ent(s, len(tuple(s) or ()) - ndim + i)]
+                 for e in [_ent(s, len(tuple(s or ())) - ndim + i)]
                  if e is not None}
         out.append(picks.pop() if len(picks) == 1 else None)
     spec = P(*out)
@@ -474,26 +477,42 @@ def norm_reduce_rule(x_spec, axis=None, keepdim=False, **attrs):
                       partial_axes=base.partial_axes)
 
 
-@register_spmd_rule("moe_gate_dispatch")
-def moe_gate_dispatch_rule(x_spec, gate_spec=None, **attrs):
-    """rules.h moe_gate_dispatch: dispatched output is laid out
-    (experts, capacity, hidden) — expert dim takes the gate's expert-dim
-    sharding (the EP axis), capacity replicated, hidden follows x."""
+@register_spmd_rule(["moe_gate_dispatch", "moe_dispatch"])
+def moe_gate_dispatch_rule(x_spec, gate_spec=None, *rest, x_ndim=None,
+                           **attrs):
+    """rules.h moe_gate_dispatch (paddle_tpu op name: moe_dispatch):
+    dispatched output is laid out (experts, capacity, hidden) — expert
+    dim takes the gate's expert-dim sharding (the EP axis), capacity
+    replicated, hidden follows x's LAST dim (the call site threads
+    x_ndim so a truncated left-aligned spec cannot misattribute a
+    leading axis to the hidden dim). Secondary outputs (slot indices /
+    weights, aux scalar) have different ranks, so the hook's
+    rank-validity check leaves them to GSPMD."""
+    xs = _pad(x_spec, x_ndim if x_ndim is not None
+              else len(tuple(x_spec or ())))
     e_axis = _ent(gate_spec, 1)
-    h_axis = _ent(x_spec, len(tuple(x_spec or ())) - 1)
+    h_axis = xs[-1] if xs else None
     out = P(e_axis, None, h_axis)
-    return SpmdResult([x_spec, gate_spec], out)
+    return SpmdResult([x_spec, gate_spec] + [P() for _ in rest], out)
 
 
 @register_spmd_rule("moe_combine")
-def moe_combine_rule(y_spec, gate_spec=None, **attrs):
-    """rules.h moe_combine: combining expert outputs back to (tokens,
-    hidden); an expert-dim sharding becomes Partial (the EP all-reduce),
-    token dim follows the gate."""
-    e_axis = _ent(y_spec, 0)
-    out = P(_ent(gate_spec, 0), _ent(y_spec, len(tuple(y_spec or ())) - 1))
-    partial = (e_axis,) if e_axis is not None else ()
-    return SpmdResult([y_spec, gate_spec], out, partial_axes=partial)
+def moe_combine_rule(y_spec, info_spec=None, *rest, y_ndim=None, **attrs):
+    """rules.h moe_combine: scatter-add expert outputs back to (tokens,
+    hidden). The token distribution of the output is NOT derivable from
+    the inputs (the second operand is the flat expert-major SLOT index
+    array, whose sharding is over slots, not tokens) — so the token dim
+    stays unconstrained, hidden follows y's last dim, and a sharded
+    expert/slot dim is marked Partial (the scatter-add spans shards:
+    the hook abstains and GSPMD inserts the combine)."""
+    ys = _pad(y_spec, y_ndim if y_ndim is not None
+              else len(tuple(y_spec or ())))
+    h_axis = ys[-1] if ys else None
+    out = P(None, h_axis)
+    partial = tuple(a for a in (ys[0] if ys else None,
+                                _ent(info_spec, 0)) if a is not None)
+    return SpmdResult([y_spec, info_spec] + [P() for _ in rest], out,
+                      partial_axes=partial)
 
 
 @register_spmd_rule("squeeze")

@@ -107,7 +107,8 @@ def moe_dispatch_combine(x, gates, topk, capacity):
         expert_in, slot_tok, slot_w, aux = _sort_dispatch(xx, gg, topk,
                                                           capacity)
         return expert_in, (slot_tok, slot_w), aux
-    return apply_op("moe_dispatch", _f, x, gates)
+    return apply_op("moe_dispatch", _f, x, gates,
+                    op_attrs={"x_ndim": x.ndim})
 
 
 def moe_combine(expert_out, combine_info, num_tokens):
@@ -116,7 +117,8 @@ def moe_combine(expert_out, combine_info, num_tokens):
     return apply_op(
         "moe_combine",
         lambda eo, stok, sw: _sort_combine(eo, stok, sw, num_tokens),
-        expert_out, slot_tok, slot_w)
+        expert_out, slot_tok, slot_w,
+        op_attrs={"y_ndim": expert_out.ndim})
 
 
 # ------------------------------------------------- explicit EP collectives
