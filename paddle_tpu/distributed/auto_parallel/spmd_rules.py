@@ -509,8 +509,9 @@ def moe_combine_rule(y_spec, info_spec=None, *rest, y_ndim=None, **attrs):
               else len(tuple(y_spec or ())))
     h_axis = ys[-1] if ys else None
     out = P(None, h_axis)
-    partial = tuple(a for a in (ys[0] if ys else None,
-                                _ent(info_spec, 0)) if a is not None)
+    partial = tuple(dict.fromkeys(   # unique, order-preserving
+        a for a in (ys[0] if ys else None, _ent(info_spec, 0))
+        if a is not None))
     return SpmdResult([y_spec, info_spec] + [P() for _ in rest], out,
                       partial_axes=partial)
 
