@@ -13,13 +13,23 @@ def recompute(function, *args, **kwargs):
     """Parity: fleet.utils.recompute (reference fleet/recompute/
     recompute.py — drop activations in forward, recompute in backward).
     TPU-native: jax.checkpoint over the Tensor-level function; the tape
-    records ONE op whose vjp re-runs the rematerialized forward."""
+    records ONE op whose vjp re-runs the rematerialized forward.
+
+    TPU-native extensions: `offload=True` applies the
+    offload-dots-to-host remat policy (saved matmul residuals live in
+    pinned host memory instead of HBM — the role of the reference
+    recompute_hybrid's CPU offload); `policy=` passes any
+    jax.checkpoint_policies entry through for finer control."""
     import jax
     from ....core.tensor import Tensor
     from ....ops.dispatch import apply_op
 
     kwargs.pop("use_reentrant", None)   # accepted, meaningless here
     kwargs.pop("preserve_rng_state", None)
+    policy = kwargs.pop("policy", None)
+    if kwargs.pop("offload", False) and policy is None:
+        policy = jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+            "device", "pinned_host")
     tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
     # Layer parameters enter as differentiable INPUTS of the checkpointed
     # region (swapped in for the trace) — otherwise they would be baked
@@ -46,7 +56,7 @@ def recompute(function, *args, **kwargs):
             lambda t: t._data if isinstance(t, Tensor) else t, out,
             is_leaf=lambda t: isinstance(t, Tensor))
 
-    return apply_op("recompute", jax.checkpoint(_f),
+    return apply_op("recompute", jax.checkpoint(_f, policy=policy),
                     *([args[i] for i in tensor_idx] + params))
 
 
@@ -110,17 +120,16 @@ def recompute_hybrid(ctx, function, *args, **kwargs):
     recompute_hybrid — recompute in the hybrid-parallel scene.
 
     ctx keys: 'mp_group' (required, like the reference), 'offload' and
-    'partition'. TPU-native collapse: the reference's activation
-    partitioning over the mp group and CPU offload are manual memory
-    management around cached activations; under jax.checkpoint there ARE
-    no cached segment activations (they are rematerialized), and what
-    little is saved rides GSPMD's sharding of the traced residuals — so
-    both flags are accepted and subsumed."""
+    'partition'. TPU-native mapping: 'offload' applies the
+    offload-dots-to-host remat policy (saved residuals in pinned host
+    memory — the reference's CPU offload of cached activations);
+    'partition' stays subsumed: what little the policy saves rides
+    GSPMD's sharding of the traced residuals over the mp group."""
     if ctx.get("mp_group", None) is None:
         raise AssertionError(
             "ctx must contains mp_group and mp_group can not be None.")
-    ctx.get("offload", False)
     ctx.get("partition", False)
+    kwargs["offload"] = bool(ctx.get("offload", False))
     return recompute(function, *args, **kwargs)
 
 

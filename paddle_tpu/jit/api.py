@@ -243,7 +243,7 @@ class TracedFunction:
         self._refresh_conversion(closure_sig)
         key = (treedef, tuple(_hashable(l) for l in static_leaves),
                tuple((tuple(a.shape), str(a.dtype)) for a in tensor_arrays),
-               tuple(sg_flags), closure_sig)
+               tuple(sg_flags), closure_sig, self._globals_sig())
         entry = self._cache.get(key)
         if entry is _EAGER_FALLBACK:       # guard hit on a broken graph
             return self._callable(*args, **kwargs)
@@ -270,66 +270,61 @@ class TracedFunction:
         out_leaves = [Tensor(a) if hasattr(a, "dtype") else a for a in out_arrays]
         return jax.tree_util.tree_unflatten(out_treedef, out_leaves)
 
+    def _track_value(self, key, name, v):
+        """One signature entry for a guarded value (closure cell or
+        module global). Entries carry a type tag ("t"ensor / "s"calar /
+        "o"bject / "state") so a version counter can never collide with
+        a scalar VALUE (e.g. object-at-version-0 vs the int 0).
+
+        Tensor values are tracked by OBJECT IDENTITY with a per-key
+        version counter — not by `id()` alone, which CPython reuses
+        after GC and would let a recycled address silently replay a
+        stale compiled program. Bundle-tracked tensors are RUNTIME
+        state: the trace reads them through bundle.load, never bakes
+        them as constants, and the optimizer swaps _data every step —
+        versioning their DATA would retrace per step; the tensor object
+        id still guards against rebinding to a DIFFERENT parameter of
+        the same shape (the bundle keeps the objects alive)."""
+        track = getattr(self, "_cell_track", None)
+        if track is None:
+            track = self._cell_track = {}
+        if isinstance(v, Tensor):
+            d = v._data
+            if id(v) in self._state_tensor_ids():
+                return (name, "state", id(v),
+                        tuple(getattr(d, "shape", ())),
+                        str(getattr(d, "dtype", "")))
+            rec = track.get(key)
+            if rec is None or rec[0] is not d:
+                rec = (d, (rec[1] + 1) if rec else 0)
+                track[key] = rec
+            return (name, "t", rec[1], tuple(getattr(d, "shape", ())),
+                    str(getattr(d, "dtype", "")))
+        if isinstance(v, (int, float, bool, str, bytes, type(None))):
+            return (name, "s", v)
+        rec = track.get(key)
+        if rec is None or rec[0] is not v:
+            rec = (v, (rec[1] + 1) if rec else 0)
+            track[key] = rec
+        return (name, "o", rec[1])
+
     def _closure_sig(self):
         """Versioned fingerprint of the ORIGINAL callable's closure cells
         (an AST-converted fn carries a by-value snapshot instead, so the
-        live cells always belong to `_eager_callable` when set).
-
-        Tensor cells are tracked by OBJECT IDENTITY with a per-cell
-        version counter — not by `id()` alone, which CPython reuses after
-        GC and would let a recycled address silently replay a stale
-        compiled program. The tracker holds a reference to the current
-        data object (the Tensor holds it anyway), so `is` comparison is
-        exact."""
+        live cells always belong to `_eager_callable` when set)."""
         import types as _types
         src = getattr(self, "_eager_callable", None) or self._callable
         f = src.__func__ if isinstance(src, _types.MethodType) else src
         if not isinstance(f, _types.FunctionType) or not f.__closure__:
             return ()
-        track = getattr(self, "_cell_track", None)
-        if track is None:
-            track = self._cell_track = {}
-        state_ids = self._state_tensor_ids()
         sig = []
-        # entries carry a type tag ("t"ensor/"s"calar/"o"bject/"state")
-        # so a version counter can never collide with a scalar VALUE
-        # (e.g. object-at-version-0 vs the int 0)
         for name, cell in zip(f.__code__.co_freevars, f.__closure__):
             try:
                 v = cell.cell_contents
             except ValueError:
                 sig.append((name, "<empty>"))
                 continue
-            if isinstance(v, Tensor):
-                d = v._data
-                if id(v) in state_ids:
-                    # bundle-tracked tensors are RUNTIME state: the trace
-                    # reads them through bundle.load, never bakes them as
-                    # constants, and the optimizer swaps _data every step
-                    # — versioning their DATA would retrace per step. The
-                    # tensor object id still guards against rebinding the
-                    # cell to a DIFFERENT parameter of the same shape
-                    # (ids are stable: the bundle keeps the objects
-                    # alive, only _data swaps).
-                    sig.append((name, "state", id(v),
-                                tuple(getattr(d, "shape", ())),
-                                str(getattr(d, "dtype", ""))))
-                    continue
-                rec = track.get(name)
-                if rec is None or rec[0] is not d:
-                    rec = (d, (rec[1] + 1) if rec else 0)
-                    track[name] = rec
-                sig.append((name, "t", rec[1],
-                            tuple(getattr(d, "shape", ())),
-                            str(getattr(d, "dtype", ""))))
-            elif isinstance(v, (int, float, bool, str, bytes, type(None))):
-                sig.append((name, "s", v))
-            else:
-                rec = track.get(name)
-                if rec is None or rec[0] is not v:
-                    rec = (v, (rec[1] + 1) if rec else 0)
-                    track[name] = rec
-                sig.append((name, "o", rec[1]))
+            sig.append(self._track_value(name, name, v))
         return tuple(sig)
 
     def _state_tensor_ids(self):
@@ -354,6 +349,33 @@ class TracedFunction:
                         pass
             self._state_ids_cache = ids
         return ids
+
+    def _globals_sig(self):
+        """Fingerprint of module-GLOBAL tensors the function reads — the
+        same staleness class as closure cells: a global tensor is baked
+        into the trace as a constant, so replacing its data must
+        retrace. The tracked name set is snapshotted on first call
+        (co_names that currently hold Tensors); a global that only
+        becomes a Tensor later is not guarded."""
+        import types as _types
+        src = getattr(self, "_eager_callable", None) or self._callable
+        f = src.__func__ if isinstance(src, _types.MethodType) else src
+        if not isinstance(f, _types.FunctionType):
+            return ()
+        names = getattr(self, "_global_tensor_names", None)
+        if names is None:
+            g = f.__globals__
+            names = tuple(n for n in f.__code__.co_names
+                          if isinstance(g.get(n), Tensor))
+            self._global_tensor_names = names
+        if not names:
+            return ()
+        # _track_value handles rebinding to non-Tensors too (scalar and
+        # object branches), so a global flipping Tensor -> float -> float
+        # keeps retracing on every change
+        return tuple(self._track_value("g:" + name, name,
+                                       f.__globals__.get(name))
+                     for name in names)
 
     def _refresh_conversion(self, cur_sig):
         """Re-snapshot the dy2static conversion when the original

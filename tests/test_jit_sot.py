@@ -449,3 +449,23 @@ def test_shape_dependent_break_also_falls_back():
         out = traced(paddle.to_tensor(np.ones(4, np.float32)))
     assert out.shape[0] == 2  # 4 % 3 + 1
     assert any("graph break" in str(w.message) for w in caught)
+
+
+_GLOBAL_SCALE = paddle.to_tensor(np.float32(2.0))
+
+
+def test_global_tensor_mutation_triggers_retrace():
+    """Module-global tensors are baked into the trace like closure
+    cells; replacing their data must retrace (globals guard)."""
+    import jax.numpy as jnp
+
+    def fn(x):
+        return x * _GLOBAL_SCALE
+
+    traced = paddle.jit.to_static(fn)
+    x = paddle.to_tensor(np.ones(3, np.float32))
+    np.testing.assert_allclose(np.asarray(traced(x)._data),
+                               2 * np.ones(3))
+    _GLOBAL_SCALE._data = jnp.asarray(np.float32(7.0))
+    np.testing.assert_allclose(np.asarray(traced(x)._data),
+                               7 * np.ones(3))
