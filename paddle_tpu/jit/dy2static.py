@@ -99,10 +99,15 @@ def _grad_sensitive(vals):
 def _probe_body_grads(body_fn, args):
     """Entry carries may be grad-free while the BODY pulls grad-requiring
     closure tensors into the carry (s = s + h with h from the net) — run
-    one probe iteration and inspect its outputs. The probe's ops are dead
-    code in the final trace (XLA DCEs them); any non-grad probe failure
-    is ignored here because the while_loop attempt right after will
-    surface it as a proper conversion break."""
+    one probe iteration and inspect its outputs. Under no_grad the probe
+    is skipped entirely: it could never raise, and its python-level side
+    effects (RNG draws, buffer snapshots) would otherwise run one extra
+    time (only the pure traced ops are DCE'd by XLA). Any non-grad probe
+    failure is ignored here because the while_loop attempt right after
+    surfaces it as a proper conversion break."""
+    from ..core import autograd
+    if not autograd.is_grad_enabled():
+        return
     try:
         out = body_fn(*args)
     except Exception:
@@ -181,14 +186,43 @@ def _run_for_range(start, stop, step, body_fn, loop_vars):
     return tuple(res[1:])
 
 
+_ITER_UNROLL_LIMIT = 64
+
+
 def _run_for_iter(seq, body_fn, loop_vars):
     """Runtime helper for rewritten `for x in seq`. Tensors iterate along
-    dim 0 with a STATIC trip count (shapes are static under jit), so the
-    python loop below unrolls into a valid trace; other iterables keep
-    plain python semantics. Same (target, *carried) contract as
+    dim 0 with a STATIC trip count (shapes are static under jit): short
+    loops unroll into the trace; LONG tensor loops (> 64 rows) lower to
+    a while_loop indexing `seq[i]` so the HLO stays O(1) in the length —
+    unless the carry is grad-sensitive (while_loop is forward-only;
+    unrolling keeps gradients correct there). Other iterables keep plain
+    python semantics. Same (target, *carried) contract as
     `_run_for_range`."""
     from ..core.tensor import Tensor
     tgt, carried = loop_vars[0], tuple(loop_vars[1:])
+    if isinstance(seq, Tensor) and seq.shape[0] > _ITER_UNROLL_LIMIT \
+            and not _grad_sensitive((seq,) + tuple(loop_vars)):
+        # Any reason the compact lowering cannot apply (grad-producing
+        # body, carry-structure mismatch, ...) falls THROUGH to the
+        # unroll below — it is always available and keeps the function
+        # compiled; raising here would needlessly demote the whole
+        # function to the eager fallback.
+        try:
+            import jax.numpy as jnp
+            probe_x = Tensor(seq._data[0])
+            _probe_body_grads(body_fn, (probe_x,) + carried)
+            from ..static import nn as snn
+            n = seq.shape[0]
+            k0 = Tensor(jnp.asarray(0))
+            t0 = probe_x if isinstance(tgt, _Undefined) else tgt
+            res = snn.while_loop(
+                lambda k, t, *vs: Tensor(k._data < n),
+                lambda k, t, *vs: (Tensor(k._data + 1),) + tuple(
+                    body_fn(Tensor(seq._data[k._data]), *vs)),
+                [k0, t0] + list(carried))
+            return tuple(res[1:])
+        except Exception:
+            pass   # unroll instead
     if isinstance(seq, Tensor):
         items = (Tensor(seq._data[j]) for j in range(seq.shape[0]))
     else:

@@ -469,3 +469,97 @@ def test_global_tensor_mutation_triggers_retrace():
     _GLOBAL_SCALE._data = jnp.asarray(np.float32(7.0))
     np.testing.assert_allclose(np.asarray(traced(x)._data),
                                7 * np.ones(3))
+
+
+def test_long_tensor_iteration_lowers_to_while_loop():
+    """`for row in tensor` with > 64 rows lowers to a while_loop (O(1)
+    HLO in the length) instead of unrolling; result matches eager and
+    nothing falls back."""
+    def fn(x, t):
+        s = x.sum() * 0.0
+        if x.mean() > -1e9:        # tensor predicate forces conversion
+            s = s * 1.0
+        for row in t:
+            s = s + row.sum()
+        return s
+
+    x = paddle.to_tensor(np.ones(2, np.float32))
+    t = paddle.to_tensor(np.full((130, 4), 0.5, np.float32))
+    eager = fn(x, t)
+    traced = paddle.jit.to_static(fn)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        out = traced(x, t)
+    np.testing.assert_allclose(np.asarray(out._data),
+                               np.asarray(eager._data), rtol=1e-6)
+    assert traced._fallback_count == 0
+
+
+def test_long_grad_carrying_tensor_iteration_still_trains():
+    """A long tensor-iter whose carry requires grad must NOT take the
+    forward-only while_loop: it unrolls (or falls back) and real
+    gradients reach the parameters."""
+    paddle.seed(0)
+    net = paddle.nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+
+    def step(x, t, y):
+        h = net(x)
+        s = h * 0.0
+        if x.mean() > -1e9:        # force conversion
+            s = s * 1.0
+        for row in t:
+            s = s + h * row.sum()
+        loss = ((s - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+    t = paddle.to_tensor(np.full((70, 2), 0.01, np.float32))
+    y = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+    w0 = np.asarray(net.weight._data).copy()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        tr = paddle.jit.to_static(step, state_objects=[net, opt])
+        l0 = float(np.asarray(tr(x, t, y)._data))
+        l1 = float(np.asarray(tr(x, t, y)._data))
+    assert not np.allclose(w0, np.asarray(net.weight._data))
+    assert l1 < l0
+
+
+def test_long_grad_body_iteration_unrolls_and_stays_compiled():
+    """A long tensor-iter whose BODY produces grad-requiring values must
+    fall through to the unroll (still compiled, correct grads) — not
+    demote the whole function to eager."""
+    paddle.seed(0)
+    net = paddle.nn.Linear(4, 1)
+    opt = paddle.optimizer.SGD(1e-4, parameters=net.parameters())
+
+    def step(x, t, y):
+        s = x.sum() * 0.0              # grad-free entry carry
+        if x.mean() > -1e9:            # force conversion
+            s = s * 1.0
+        for row in t:
+            s = s + net(row).sum()     # grad-producing body
+        loss = ((s - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(np.ones(2, np.float32))
+    t = paddle.to_tensor(rng.randn(70, 4).astype(np.float32) * 0.01)
+    y = paddle.to_tensor(np.ones((), np.float32))
+    w0 = np.asarray(net.weight._data).copy()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        tr = paddle.jit.to_static(step, state_objects=[net, opt])
+        l0 = float(np.asarray(tr(x, t, y)._data))
+        l1 = float(np.asarray(tr(x, t, y)._data))
+    assert tr._fallback_count == 0     # compiled via unroll
+    assert not np.allclose(w0, np.asarray(net.weight._data))
+    assert l1 < l0
