@@ -241,9 +241,14 @@ class TracedFunction:
         # cells the same way).
         closure_sig = self._closure_sig()
         self._refresh_conversion(closure_sig)
+        # ambient grad mode is part of the key: the dy2static loop
+        # lowerings choose forward-only structures under no_grad, so a
+        # trace built in no_grad must not replay for a grad-enabled call
+        from ..core import autograd as _autograd
         key = (treedef, tuple(_hashable(l) for l in static_leaves),
                tuple((tuple(a.shape), str(a.dtype)) for a in tensor_arrays),
-               tuple(sg_flags), closure_sig, self._globals_sig())
+               tuple(sg_flags), closure_sig, self._globals_sig(),
+               _autograd.is_grad_enabled())
         entry = self._cache.get(key)
         if entry is _EAGER_FALLBACK:       # guard hit on a broken graph
             return self._callable(*args, **kwargs)

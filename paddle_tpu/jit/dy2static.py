@@ -189,6 +189,25 @@ def _run_for_range(start, stop, step, body_fn, loop_vars):
 _ITER_UNROLL_LIMIT = 64
 
 
+def _register_debug_flag():
+    from ..utils.flags import define_flag
+    define_flag("dy2static_debug", False,
+                "log dy2static loop-lowering decisions")
+
+
+_register_debug_flag()
+
+
+def _dy2static_debug_log(msg):
+    """FLAGS_dy2static_debug=1 surfaces silent lowering decisions (a
+    failed while_loop lowering is otherwise indistinguishable from a
+    successful one — both keep the function compiled). The flag is
+    registered once at import so runtime set_flags overrides stick."""
+    from ..utils.flags import flags
+    if flags("dy2static_debug"):
+        print(f"[dy2static_debug] {msg}")
+
+
 def _run_for_iter(seq, body_fn, loop_vars):
     """Runtime helper for rewritten `for x in seq`. Tensors iterate along
     dim 0 with a STATIC trip count (shapes are static under jit): short
@@ -200,31 +219,63 @@ def _run_for_iter(seq, body_fn, loop_vars):
     `_run_for_range`."""
     from ..core.tensor import Tensor
     tgt, carried = loop_vars[0], tuple(loop_vars[1:])
+    start = 0
     if isinstance(seq, Tensor) and seq.shape[0] > _ITER_UNROLL_LIMIT \
             and not _grad_sensitive((seq,) + tuple(loop_vars)):
-        # Any reason the compact lowering cannot apply (grad-producing
-        # body, carry-structure mismatch, ...) falls THROUGH to the
-        # unroll below — it is always available and keeps the function
-        # compiled; raising here would needlessly demote the whole
-        # function to the eager fallback.
-        try:
-            import jax.numpy as jnp
-            probe_x = Tensor(seq._data[0])
-            _probe_body_grads(body_fn, (probe_x,) + carried)
-            from ..static import nn as snn
-            n = seq.shape[0]
-            k0 = Tensor(jnp.asarray(0))
-            t0 = probe_x if isinstance(tgt, _Undefined) else tgt
-            res = snn.while_loop(
-                lambda k, t, *vs: Tensor(k._data < n),
-                lambda k, t, *vs: (Tensor(k._data + 1),) + tuple(
-                    body_fn(Tensor(seq._data[k._data]), *vs)),
-                [k0, t0] + list(carried))
-            return tuple(res[1:])
-        except Exception:
-            pass   # unroll instead
+        # Probe = ITERATION 0, always kept: its python-level side
+        # effects (appends, RNG draws) happened exactly once, like
+        # eager. The probe's outcome picks the path:
+        #   * body drew from the RNG or produced grad-requiring values
+        #     -> continue UNROLLING from row 1 (per-iteration draws and
+        #     gradients stay correct; while_loop would trace the body
+        #     once / is forward-only);
+        #   * pure grad-free body -> while_loop over ALL rows (re-running
+        #     row 0 inside it is unobservable for a pure body; the
+        #     probe's traced ops are DCE'd);
+        #   * while_loop trace failure -> continue unrolling from row 1.
+        # Every RNG draw REPLACES the global key object
+        # (RNGState.next_key rebinds), so object identity detects a draw
+        # even for traced keys.
+        from ..framework import random as _random
+        orig = (tgt,) + carried            # pre-probe carries
+        rng_before = _random.get_rng_state()
+        out = body_fn(Tensor(seq._data[0]), *carried)  # raises like eager
+        vals = tuple(out) if isinstance(out, (list, tuple)) else (out,)
+        tgt, carried = vals[0], tuple(vals[1:])
+        start = 1
+        drew_rng = _random.get_rng_state() is not rng_before
+        if drew_rng:
+            _dy2static_debug_log(
+                "body draws from the RNG: unrolling keeps per-iteration "
+                "draws")
+        elif _grad_sensitive(vals):
+            _dy2static_debug_log(
+                "body produces grad-requiring values: unrolling "
+                "(while_loop is forward-only)")
+        else:
+            try:
+                import jax.numpy as jnp
+                from ..static import nn as snn
+                n = seq.shape[0]
+                k0 = Tensor(jnp.asarray(0))
+                # start from the PRE-probe carries (the loop re-runs row
+                # 0 — unobservable for this pure body); probe values
+                # only seed _Undefined slots as type placeholders
+                seeds = [vals[j] if isinstance(v, _Undefined) else v
+                         for j, v in enumerate(orig)]
+                res = snn.while_loop(
+                    lambda k, t, *vs: Tensor(k._data < n),
+                    lambda k, t, *vs: (Tensor(k._data + 1),) + tuple(
+                        body_fn(Tensor(seq._data[k._data]), *vs)),
+                    [k0] + seeds)
+                return tuple(res[1:])
+            except Exception as e:
+                _dy2static_debug_log(
+                    f"tensor-iter while_loop lowering failed, "
+                    f"unrolling: {e!r}")
     if isinstance(seq, Tensor):
-        items = (Tensor(seq._data[j]) for j in range(seq.shape[0]))
+        items = (Tensor(seq._data[j])
+                 for j in range(start, seq.shape[0]))
     else:
         items = iter(seq)
     for item in items:

@@ -473,26 +473,98 @@ def test_global_tensor_mutation_triggers_retrace():
 
 def test_long_tensor_iteration_lowers_to_while_loop():
     """`for row in tensor` with > 64 rows lowers to a while_loop (O(1)
-    HLO in the length) instead of unrolling; result matches eager and
-    nothing falls back."""
+    HLO in the length) instead of unrolling; the while path is ASSERTED
+    to fire (a silent unroll would also pass the value check), including
+    for bodies that bind temporaries (probe-seeded carries)."""
+    from paddle_tpu.static import nn as snn
+    calls = []
+    orig_while = snn.while_loop
+
+    def counting_while(*a, **k):
+        calls.append(1)
+        return orig_while(*a, **k)
+
     def fn(x, t):
         s = x.sum() * 0.0
         if x.mean() > -1e9:        # tensor predicate forces conversion
             s = s * 1.0
         for row in t:
-            s = s + row.sum()
+            h = row * 2.0          # body-local temporary (seeded carry)
+            s = s + h.sum()
         return s
 
     x = paddle.to_tensor(np.ones(2, np.float32))
     t = paddle.to_tensor(np.full((130, 4), 0.5, np.float32))
     eager = fn(x, t)
     traced = paddle.jit.to_static(fn)
-    with warnings.catch_warnings():
-        warnings.simplefilter("ignore")
-        out = traced(x, t)
+    snn.while_loop = counting_while
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = traced(x, t)
+    finally:
+        snn.while_loop = orig_while
     np.testing.assert_allclose(np.asarray(out._data),
                                np.asarray(eager._data), rtol=1e-6)
     assert traced._fallback_count == 0
+    assert calls, "while_loop lowering never fired (silent unroll)"
+
+
+def test_rng_drawing_loop_body_unrolls_for_fresh_draws():
+    """A loop body drawing from the framework RNG must NOT lower to
+    while_loop (one traced draw would repeat every iteration): it
+    unrolls, keeping per-iteration draws — outputs across rows differ."""
+    def fn(x, t):
+        s = x.sum() * 0.0
+        if x.mean() > -1e9:
+            s = s * 1.0
+        outs = t * 0.0
+        for i in range(2):     # cheap conversion trigger
+            outs = outs
+        acc = []
+        for row in t:
+            acc.append(row + paddle.rand([4]))
+        return acc[0], acc[1]
+
+    paddle.seed(0)
+    x = paddle.to_tensor(np.ones(2, np.float32))
+    t = paddle.to_tensor(np.zeros((70, 4), np.float32))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        traced = paddle.jit.to_static(fn)
+        a, b = traced(x, t)
+    # fresh draw per iteration: row 0 (the probe IS iteration 0, its
+    # draw kept) differs from row 1
+    assert not np.allclose(np.asarray(a._data), np.asarray(b._data))
+
+
+def test_no_grad_trace_not_replayed_for_grad_call():
+    """Ambient grad mode is part of the guard key: a trace built under
+    no_grad (forward-only loop structures allowed) must retrace for a
+    grad-enabled call so gradients flow."""
+    paddle.seed(0)
+    net = paddle.nn.Linear(4, 2)
+
+    def fn(x):
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        g = net.weight.grad
+        gn = (g * g).sum() if g is not None else x.sum() * 0.0
+        for p in net.parameters():
+            p.clear_gradient()
+        return loss, gn
+
+    traced = paddle.jit.to_static(fn, state_objects=[net])
+    x = paddle.to_tensor(np.ones((3, 4), np.float32))
+    with paddle.no_grad():
+        _, gn0 = traced(x)
+    _, gn1 = traced(x)
+    # no_grad trace: no tape, zero grad-norm; the grad-enabled call MUST
+    # retrace (new guard key) and produce a real gradient — without the
+    # grad-mode key the cached no_grad program would replay gn == 0
+    assert float(np.asarray(gn0._data)) == 0.0
+    assert float(np.asarray(gn1._data)) > 0.0
+    assert len(traced._cache) == 2     # one entry per grad mode
 
 
 def test_long_grad_carrying_tensor_iteration_still_trains():

@@ -522,6 +522,10 @@ def test_zbh1_schedule_mode_through_fleet_matches_1f1b():
     np.testing.assert_allclose(l_zb, l_ref, rtol=1e-5)
     np.testing.assert_allclose(w_zb, w_ref, rtol=1e-5)
     assert l_zb[-1] < l_zb[0]
+    # ZB-V routes through the same runner on the chunked stage segments
+    l_zbv, w_zbv = build("ZB-V")
+    np.testing.assert_allclose(l_zbv, l_ref, rtol=1e-5)
+    np.testing.assert_allclose(w_zbv, w_ref, rtol=1e-5)
 
 
 def test_zb_h1_makespan_beats_1f1b():
