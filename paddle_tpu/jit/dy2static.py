@@ -19,6 +19,11 @@ python semantics — the fallback still works):
   * nested function definitions are not descended into
   * closure variables are bound by VALUE at conversion time (the
     reference snapshots cells the same way when synthesizing code)
+  * compiled while/for-range loops trace the body ONCE (static-graph
+    loop semantics, like the reference's converted loops): under
+    grad-enabled tracing a probe detects RNG draws / grad-carrying
+    bodies and falls back to eager; under no_grad a converted loop
+    keeps the single-draw semantics
 """
 from __future__ import annotations
 
@@ -96,22 +101,49 @@ def _grad_sensitive(vals):
                for v in vals)
 
 
+def _rng_fingerprint():
+    """Identity fingerprint of every live RNG stream: the global key
+    object plus each TP tracker substream's key (draws REBIND the key
+    object, so identity change == a draw happened — works for traced
+    keys where value comparison is impossible)."""
+    from ..framework import random as _random
+    fp = [id(_random._global._key)]
+    try:
+        from ..distributed.fleet.mpu import get_rng_state_tracker
+        for name, st in sorted(
+                get_rng_state_tracker().states_.items()):
+            fp.append((name, id(st._key)))
+    except Exception:
+        pass
+    return tuple(fp)
+
+
 def _probe_body_grads(body_fn, args):
     """Entry carries may be grad-free while the BODY pulls grad-requiring
     closure tensors into the carry (s = s + h with h from the net) — run
     one probe iteration and inspect its outputs. Under no_grad the probe
-    is skipped entirely: it could never raise, and its python-level side
-    effects (RNG draws, buffer snapshots) would otherwise run one extra
-    time (only the pure traced ops are DCE'd by XLA). Any non-grad probe
-    failure is ignored here because the while_loop attempt right after
-    surfaces it as a proper conversion break."""
+    is DELIBERATELY skipped: converted loops then keep static-graph
+    single-draw semantics (module docstring) and the probe's python-level
+    side effects don't run an extra time; this is a semantics choice,
+    not merely an optimization. Any non-grad probe failure is ignored
+    here because the while_loop attempt right after surfaces it as a
+    proper conversion break."""
     from ..core import autograd
     if not autograd.is_grad_enabled():
         return
+    rng_before = _rng_fingerprint()
     try:
         out = body_fn(*args)
     except Exception:
         return
+    if _rng_fingerprint() != rng_before:
+        # one traced body = ONE draw repeated every iteration; the eager
+        # fallback keeps per-iteration draws. Covers the TP tracker
+        # substreams too (get_rng_state_tracker().rng_state(...) swaps
+        # the global in and out, leaving ITS identity unchanged).
+        raise DygraphToStaticBreak(
+            "loop body draws from the RNG; a compiled loop would repeat "
+            "one draw — using the eager fallback for per-iteration draws")
     vals = tuple(out) if isinstance(out, (list, tuple)) else (out,)
     if _grad_sensitive(vals):
         raise DygraphToStaticBreak(
@@ -156,12 +188,15 @@ def _run_for_range(start, stop, step, body_fn, loop_vars):
             "traced-bound for carries grad-requiring tensors; "
             "while_loop is forward-only — using the eager fallback so "
             "gradients stay correct")
-    _probe_body_grads(body_fn, (start,) + carried)
     sp = _to_int(step)
     from ..core.tensor import Tensor
     import jax.numpy as jnp
     start_v = start._data if isinstance(start, Tensor) else start
     k0 = Tensor(jnp.asarray(start_v))
+    # probe with the TENSOR counter the real body will receive — an int
+    # probe would raise on tensor-method counter use and silently skip
+    # both the RNG and grad checks
+    _probe_body_grads(body_fn, (k0,) + carried)
     stop_v = stop._data if isinstance(stop, Tensor) else stop
     if isinstance(tgt, _Undefined):
         # while_loop carried values need a concrete type; python would
@@ -233,17 +268,16 @@ def _run_for_iter(seq, body_fn, loop_vars):
         #     row 0 inside it is unobservable for a pure body; the
         #     probe's traced ops are DCE'd);
         #   * while_loop trace failure -> continue unrolling from row 1.
-        # Every RNG draw REPLACES the global key object
-        # (RNGState.next_key rebinds), so object identity detects a draw
-        # even for traced keys.
-        from ..framework import random as _random
+        # Every RNG draw REPLACES its stream's key object
+        # (RNGState.next_key rebinds), so the identity fingerprint
+        # detects a draw even for traced keys and tracker substreams.
         orig = (tgt,) + carried            # pre-probe carries
-        rng_before = _random.get_rng_state()
+        rng_before = _rng_fingerprint()
         out = body_fn(Tensor(seq._data[0]), *carried)  # raises like eager
         vals = tuple(out) if isinstance(out, (list, tuple)) else (out,)
         tgt, carried = vals[0], tuple(vals[1:])
         start = 1
-        drew_rng = _random.get_rng_state() is not rng_before
+        drew_rng = _rng_fingerprint() != rng_before
         if drew_rng:
             _dy2static_debug_log(
                 "body draws from the RNG: unrolling keeps per-iteration "

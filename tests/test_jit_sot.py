@@ -635,3 +635,27 @@ def test_long_grad_body_iteration_unrolls_and_stays_compiled():
     assert tr._fallback_count == 0     # compiled via unroll
     assert not np.allclose(w0, np.asarray(net.weight._data))
     assert l1 < l0
+
+
+def test_rng_drawing_range_loop_falls_back_for_fresh_draws():
+    """A traced-bound range loop whose body draws from the RNG must not
+    compile (one traced draw would repeat every iteration): the probe
+    detects the draw and the eager fallback reproduces eager semantics
+    exactly."""
+    def fn(x, n):
+        s = x * 0.0
+        for i in range(n):
+            s = s + paddle.rand([4])
+        return s
+
+    x = paddle.to_tensor(np.zeros(4, np.float32))
+    paddle.seed(123)
+    eager = fn(x, 3)
+    traced = paddle.jit.to_static(fn)
+    paddle.seed(123)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        out = traced(x, paddle.to_tensor(3))
+    assert traced._fallback_count == 1
+    np.testing.assert_allclose(np.asarray(out._data),
+                               np.asarray(eager._data), rtol=1e-6)
