@@ -324,14 +324,23 @@ class _ThreadedPipelineBase:
             if ev is not None and not ev.wait(timeout):
                 raise TimeoutError(f"dependency {key} never fired")
 
+        from .. import profiler as _prof
+
         def worker(r):
             try:
                 for row in self._worker_rows(r):
                     key = self._event_key(r, row)
                     thunk = self._prepare_job(r, row, ctx, wait)
-                    t0 = time.perf_counter()
-                    thunk()
-                    t1 = time.perf_counter()
+                    # pipeline jobs on the profiler timeline, like the
+                    # per-op dispatch spans; RecordEvent self-gates on
+                    # the tracer and the `with` keeps the device-trace
+                    # annotation balanced even when the job raises
+                    with _prof.RecordEvent(
+                            f"pipe/{key[0]}{key[1]}@s{key[2]}",
+                            _prof.TracerEventType.UserDefined):
+                        t0 = time.perf_counter()
+                        thunk()
+                        t1 = time.perf_counter()
                     self.timeline[key] = (t0, t1)
                     events[key].set()
             except BaseException as e:  # surface to the caller

@@ -557,3 +557,29 @@ def test_zb_plan_builder():
     assert kinds == {"forward", "backward_b", "backward_w", "optimizer"}
     FleetExecutor(plan).run()
     assert log.count("F") == 4 and log.count("B") == 4 and log.count("W") == 4
+
+
+def test_threaded_executor_emits_profiler_spans():
+    """Pipeline jobs appear on the profiler timeline like per-op
+    dispatch spans (one pipe/<kind><micro>@s<stage> span per job)."""
+    import paddle_tpu as paddle
+    from tools.bench_pipeline import build_stage_jobs
+    from paddle_tpu.distributed.fleet_executor import ThreadedFleetExecutor
+
+    prof = paddle.profiler.Profiler(
+        targets=[paddle.profiler.ProfilerTarget.CPU])
+    prof.start()
+    try:
+        jobs = build_stage_jobs(2, hidden=16, layers_per_stage=1, batch=4)
+        ex = ThreadedFleetExecutor(2, 4, "1F1B", jobs["fwd"],
+                                   jobs["bwd_fused"])
+        rng = np.random.RandomState(0)
+        xs = [rng.randn(4, 16).astype(np.float32) for _ in range(4)]
+        ys = [rng.randn(4, 16).astype(np.float32) for _ in range(4)]
+        ex.run(xs, ys)
+    finally:
+        prof.stop()
+    evs = [e for e in prof.events
+           if e["name"].startswith("pipe/")]
+    assert len(evs) == 16          # 2 ranks x (4 F + 4 B)
+    assert any(e["name"] == "pipe/F0@s0" for e in evs)
