@@ -312,12 +312,21 @@ _P2P_SEQ = {}
 
 
 def _p2p_store():
+    import os
     from . import env as _env
+    # paddle_tpu.distributed.launch exports PADDLE_P2P_STORE (the
+    # coordinator's sibling port): prefer THAT store for the mailbox —
+    # the registry returns the existing instance or lazily creates it
+    ep = os.environ.get("PADDLE_P2P_STORE")
+    if ep:
+        return _env.create_store(ep)
     if _env._store[0] is None:
         raise RuntimeError(
             "cross-process send/recv rides the native TCPStore mailbox: "
-            "call paddle.distributed.create_store(endpoint) first, on a "
-            "port DISTINCT from the jax coordinator (or init_rpc, which "
+            "launch via paddle_tpu.distributed.launch (which exports "
+            "PADDLE_P2P_STORE), or call "
+            "paddle.distributed.create_store(endpoint) first, on a port "
+            "DISTINCT from the jax coordinator (or init_rpc, which "
             "creates one)")
     return _env._store[0]
 

@@ -16,7 +16,16 @@ __all__ = ["get_rank", "get_world_size", "init_parallel_env",
            "is_initialized", "ParallelEnv", "create_store", "barrier_store"]
 
 _initialized = [False]
-_store = [None]
+_store = [None]    # default store (first created)
+_stores = {}       # endpoint -> store
+
+
+def _split_endpoint(ep, default_host="127.0.0.1"):
+    """'host:port' -> (host, int port); bare ':port'/'port' get the
+    default host. Shared by create_store and the launcher's
+    PADDLE_P2P_STORE derivation."""
+    host, _, port = ep.rpartition(":")
+    return host or default_host, int(port)
 
 
 def create_store(endpoint=None, rank=None, timeout_ms=120000):
@@ -26,28 +35,35 @@ def create_store(endpoint=None, rank=None, timeout_ms=120000):
     coordination service does collective bootstrap; this store carries the
     remaining roles: launch/elastic KV, barriers, user rendezvous.
 
-    Process-wide singleton: a second call must use the same endpoint (or
-    none); conflicting endpoints raise instead of silently returning the
-    first store."""
+    Process-wide registry keyed by endpoint: a second call with the same
+    endpoint returns the existing store; a DIFFERENT endpoint creates a
+    second store (the launcher's eager PADDLE_P2P_STORE mailbox and a
+    user-chosen rendezvous store legitimately coexist). `_store[0]`
+    remains the default store — the first one created — for consumers
+    that don't name an endpoint."""
     from .._native import TCPStore
-    endpoint = endpoint or os.environ.get("PADDLE_MASTER") \
-        or os.environ.get("MASTER_ENDPOINT", "127.0.0.1:29600")
-    if _store[0] is not None:
-        if endpoint != _store[0]._pt_endpoint:
-            raise RuntimeError(
-                f"store already created for {_store[0]._pt_endpoint}; "
-                f"cannot rebind to {endpoint}")
-        return _store[0]
-    host, _, port = endpoint.rpartition(":")
+    # PADDLE_P2P_STORE (exported by the launcher) takes precedence:
+    # PADDLE_MASTER is the jax coordinator's endpoint, whose PORT the
+    # coordination service owns — binding a TCPStore there clashes.
+    # PADDLE_MASTER stays as a last-resort compat default for callers
+    # outside any launcher.
+    endpoint = endpoint or os.environ.get("PADDLE_P2P_STORE") \
+        or os.environ.get("MASTER_ENDPOINT") \
+        or os.environ.get("PADDLE_MASTER", "127.0.0.1:29600")
+    if endpoint in _stores:
+        return _stores[endpoint]
+    host, port = _split_endpoint(endpoint)
     rank = int(os.environ.get("PADDLE_TRAINER_ID", "0")) if rank is None \
         else rank
-    store = TCPStore(host or "127.0.0.1", int(port), is_master=(rank == 0),
+    store = TCPStore(host, port, is_master=(rank == 0),
                      timeout_ms=timeout_ms)
     try:
         store._pt_endpoint = endpoint
     except AttributeError:  # native type: wrap in a proxy attribute holder
         store = _StoreProxy(store, endpoint)
-    _store[0] = store
+    _stores[endpoint] = store
+    if _store[0] is None:
+        _store[0] = store
     return store
 
 
@@ -98,6 +114,15 @@ def init_parallel_env(strategy=None):
             coordinator_address=coord,
             num_processes=world,
             process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+        # eagerly stand up the p2p/rpc TCPStore when the launcher
+        # exported one: rank 0 must BIND the mailbox port even if it
+        # never performs p2p itself (otherwise ranks 1..n-1 would spin
+        # against a port nobody serves until the connect timeout)
+        if os.environ.get("PADDLE_P2P_STORE"):
+            try:
+                create_store(os.environ["PADDLE_P2P_STORE"])
+            except Exception:
+                pass  # p2p stays usable via explicit create_store
     _initialized[0] = True
     return ParallelEnv()
 

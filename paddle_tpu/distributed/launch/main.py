@@ -73,6 +73,19 @@ def _rank_env(args, local_rank):
     })
     if args.master:
         env["PADDLE_MASTER"] = args.master
+        # The TCPStore for host-side p2p (dist.send/recv) needs a port
+        # DISTINCT from the jax coordinator; export the sibling port so
+        # workers get a working mailbox out of the box. port+1 is the
+        # only deterministic choice every NODE can agree on without
+        # coordination; a clash surfaces as a clear TCPStore bind error
+        # and the user overrides by exporting PADDLE_P2P_STORE.
+        from ..env import _split_endpoint
+        try:
+            host, port = _split_endpoint(args.master)
+            if port + 1 <= 65535:
+                env.setdefault("PADDLE_P2P_STORE", f"{host}:{port + 1}")
+        except ValueError:
+            pass
     if args.devices is not None:
         env["PADDLE_DEVICES"] = args.devices
     return env

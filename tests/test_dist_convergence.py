@@ -189,8 +189,9 @@ PP_PAYLOAD = textwrap.dedent(f"""
     assert jax.process_count() == 2
     rank = jax.process_index()
     # stage-boundary p2p rides the native TCPStore mailbox on its own
-    # port (the jax coordinator owns PADDLE_MASTER's port)
-    dist.create_store(os.environ["PADDLE_P2P_STORE"])
+    # port: NOT created explicitly here — send/recv lazily build it from
+    # PADDLE_P2P_STORE (the env the launcher exports), which this test's
+    # harness sets
 
     paddle.seed(7)   # both ranks build the full net -> identical init
     net = paddle.nn.Sequential(paddle.nn.Linear({HIDDEN}, 32),
