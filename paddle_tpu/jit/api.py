@@ -369,9 +369,16 @@ class TracedFunction:
             return ()
         names = getattr(self, "_global_tensor_names", None)
         if names is None:
+            # only names the bytecode actually LOADS as globals —
+            # co_names also lists attribute/import names, which would
+            # guard-track unrelated module tensors that happen to share
+            # an attribute's name
+            import dis
             g = f.__globals__
-            names = tuple(n for n in f.__code__.co_names
-                          if isinstance(g.get(n), Tensor))
+            loads = {ins.argval for ins in dis.get_instructions(f.__code__)
+                     if ins.opname == "LOAD_GLOBAL"}
+            names = tuple(sorted(n for n in loads
+                                 if isinstance(g.get(n), Tensor)))
             self._global_tensor_names = names
         if not names:
             return ()

@@ -84,39 +84,31 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", pad_from_left_ax
     if isinstance(pad, Tensor):
         pad = [int(v) for v in np.asarray(pad._data)]
     pad = [int(p) for p in pad]
+    # ONE per-dim widths resolution feeds both the kernel and the SPMD
+    # pad rule (two parallel copies of paddle's two pad-list layouts
+    # would silently desync)
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        # full-tensor pad, paddle order: axis-major from first axis
+        widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # partial pad on spatial dims, paddle order: last-dim-first pairs
+        n_spatial = len(pad) // 2
+        widths = [(0, 0)] * nd
+        if data_format.endswith("C"):  # NHWC-ish: spatial dims are 1..nd-1
+            spatial = list(range(1, nd - 1))
+        else:  # NCHW-ish: spatial dims are 2..nd-1
+            spatial = list(range(2, nd))
+        # paddle pads [left,right] for the LAST spatial dim first
+        for i in range(n_spatial):
+            dim = spatial[-(i + 1)] if n_spatial <= len(spatial) else i
+            widths[dim] = (pad[2 * i], pad[2 * i + 1])
+
     def _f(a):
-        nd = a.ndim
-        if len(pad) == 2 * nd:
-            # full-tensor pad, paddle order: axis-major from first axis
-            widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
-        else:
-            # partial pad on spatial dims, paddle order: last-dim-first pairs
-            n_spatial = len(pad) // 2
-            widths = [(0, 0)] * nd
-            if data_format.endswith("C"):  # NHWC-ish: spatial dims are 1..nd-1
-                spatial = list(range(1, nd - 1))
-            else:  # NCHW-ish: spatial dims are 2..nd-1
-                spatial = list(range(2, nd))
-            # paddle pads [left,right] for the LAST spatial dim first
-            for i in range(n_spatial):
-                dim = spatial[-(i + 1)] if n_spatial <= len(spatial) else i
-                widths[dim] = (pad[2 * i], pad[2 * i + 1])
         if mode == "constant":
             return jnp.pad(a, widths, mode="constant", constant_values=value)
         return jnp.pad(a, widths, mode=_pad_mode_to_np(mode))
-    # resolve which dims get nonzero padding for the SPMD pad rule
-    nd = x.ndim
-    if len(pad) == 2 * nd:
-        padded = [i for i in range(nd) if pad[2 * i] or pad[2 * i + 1]]
-    else:
-        n_spatial = len(pad) // 2
-        spatial = list(range(1, nd - 1)) if data_format.endswith("C") \
-            else list(range(2, nd))
-        padded = []
-        for i in range(n_spatial):
-            dim = spatial[-(i + 1)] if n_spatial <= len(spatial) else i
-            if pad[2 * i] or pad[2 * i + 1]:
-                padded.append(dim)
+    padded = [i for i, (lo, hi) in enumerate(widths) if lo or hi]
     return apply_op("pad", _f, x, op_attrs={"padded_dims": padded})
 
 

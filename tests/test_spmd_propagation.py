@@ -210,11 +210,19 @@ def test_attr_dependent_rules_fire_with_counters():
         F.pad(x, [0, 0, 1, 1])
         idx = paddle.Tensor(jnp.asarray([0, 1], jnp.int32))
         paddle.gather(xm, idx, axis=0)
+        w = paddle.Tensor(jax.device_put(
+            jnp.ones((4, 3, 3, 3)) * 0.1,
+            NamedSharding(mesh, P("model", None, None, None))))
+        img = paddle.Tensor(jax.device_put(
+            jnp.ones((2, 3, 8, 8)), NamedSharding(mesh, P("data"))))
+        conv_out = F.conv2d(img, w, padding=1)
     hits = prop.rule_stats()["hits"]
     for op in ["transpose", "sum", "mean", "max", "concat", "stack",
                "split", "slice", "strided_slice", "tile", "expand",
-               "cumsum", "cumprod", "one_hot", "pad", "gather"]:
+               "cumsum", "cumprod", "one_hot", "pad", "gather", "conv2d"]:
         assert hits.get(op, 0) > 0, (op, prop.rule_stats())
+    # NCHW: batch kept on 'data', out-channel pinned on 'model'
+    assert conv_out._spmd_spec == P("data", "model", None, None)
 
 
 def test_broken_rule_counted_not_raised():
