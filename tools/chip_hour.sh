@@ -1,0 +1,72 @@
+#!/bin/sh
+# THE CHIP HOUR (VERDICT r3/r4 item 1): run on a LIVE axon relay only.
+#   sh tools/relay_check.sh && sh tools/chip_hour.sh
+# Rules (CLAUDE.md): ONE TPU python process at a time, generous
+# timeouts, SIGTERM not SIGKILL. Each step is a separate process so a
+# wedged step doesn't hold the grant.
+set -x
+cd "$(dirname "$0")/.."
+
+# 1. claim + device sanity (fast; watchdog via timeout -s TERM)
+timeout -s TERM 300 python -c "import jax; print(jax.devices())" || exit 1
+
+# 2. Pallas pack validation on the real chip (interpret=False):
+#    flash fwd/bwd at S in {2k, 8k, 32k}, varlen/flashmask, paged
+#    folded grid, rms_norm_rows. Plain python (pytest is CPU-pinned).
+timeout -s TERM 900 python - <<'EOF'
+import numpy as np, jax, jax.numpy as jnp
+import paddle_tpu  # registers kernels
+from paddle_tpu.kernels.flash_attention import flash_attention_bshd
+print("devices:", jax.devices())
+for S in (2048, 8192, 32768):
+    B, H, D = 1, 4, 128
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, S, H, D), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(B, S, H, D), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(B, S, H, D), jnp.bfloat16)
+    out = flash_attention_bshd(q, k, v, causal=True)
+    jax.block_until_ready(out)
+    print(f"flash fwd S={S} OK", np.asarray(out[0,0,0,:2], np.float32))
+    if S <= 8192:  # bwd at 2k/8k
+        def loss(q, k, v):
+            return flash_attention_bshd(q, k, v, causal=True).astype(
+                jnp.float32).sum()
+        g = jax.grad(loss)(q, k, v)
+        jax.block_until_ready(g)
+        print(f"flash bwd S={S} OK")
+print("FLASH_CHIP_OK")
+EOF
+
+timeout -s TERM 600 python - <<'EOF'
+import numpy as np, jax, jax.numpy as jnp
+from paddle_tpu.kernels.paged_attention import paged_attention_decode
+B, H, KVH, D, page, pages_per_seq = 4, 8, 8, 128, 16, 8
+num_pages = B * pages_per_seq
+rng = np.random.RandomState(0)
+q = jnp.asarray(rng.randn(B, H, D), jnp.bfloat16)
+kc = jnp.asarray(rng.randn(num_pages, KVH, page, D), jnp.bfloat16)
+vc = jnp.asarray(rng.randn(num_pages, KVH, page, D), jnp.bfloat16)
+tables = jnp.arange(num_pages, dtype=jnp.int32).reshape(B, pages_per_seq)
+lens = jnp.full((B,), page * pages_per_seq, jnp.int32)
+out = paged_attention_decode(q, kc, vc, tables, lens)
+jax.block_until_ready(out)
+print("PAGED_CHIP_OK", out.shape)
+EOF
+
+timeout -s TERM 600 python - <<'EOF'
+import numpy as np, jax, jax.numpy as jnp
+from paddle_tpu.kernels.fused_norm import rms_norm_rows
+x = jnp.asarray(np.random.RandomState(0).randn(256, 512), jnp.float32)
+w = jnp.ones((512,), jnp.float32)
+out = rms_norm_rows(x, w, eps=1e-6)
+jax.block_until_ready(out)
+ref = x * jax.lax.rsqrt(jnp.mean(x * x, -1, keepdims=True) + 1e-6) * w
+np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-2)
+print("RMSNORM_CHIP_OK")
+EOF
+
+# 3. the real benchmark numbers
+timeout -s TERM 900 python bench.py
+timeout -s TERM 1500 python bench_ops.py --write-md
+
+echo "CHIP_HOUR_DONE — commit BENCH_OPS.md and record numbers"
