@@ -277,3 +277,41 @@ def test_recompute_offload_policy_grads_match():
                             net, x)
     np.testing.assert_allclose(np.asarray(out2._data),
                                np.asarray(ref._data), rtol=1e-6)
+
+
+def test_collective_perf_measures_on_live_mesh():
+    """fleet.collective_perf (parity: fleet.py:632 self-test) times a
+    psum over the live mesh and returns per-size averages."""
+    import paddle_tpu as paddle  # noqa: F401
+    from paddle_tpu.distributed.fleet import (fleet, DistributedStrategy,
+                                              collective_perf)
+    st = DistributedStrategy()
+    st.hybrid_configs = {"dp_degree": 8, "mp_degree": 1, "pp_degree": 1,
+                         "sharding_degree": 1, "sep_degree": 1}
+    fleet.init(is_collective=True, strategy=st)
+    try:
+        r = collective_perf("allreduce", round=2,
+                            size_and_time={1 << 16: None, 1 << 18: None})
+        assert set(r) == {1 << 16, 1 << 18}
+        assert all(v > 0 for v in r.values())
+    finally:
+        fleet._hcg = None
+
+
+def test_localfs_roundtrip(tmp_path):
+    """fleet.utils.LocalFS (parity: fleet/utils/fs.py) basic surface."""
+    from paddle_tpu.distributed.fleet.utils import LocalFS
+    fs = LocalFS()
+    d = str(tmp_path / "d")
+    fs.mkdirs(d)
+    assert fs.is_dir(d) and fs.is_exist(d)
+    f = str(tmp_path / "d" / "x.txt")
+    fs.touch(f)
+    assert fs.is_file(f)
+    fs.upload(f, str(tmp_path / "y.txt"))
+    dirs, files = fs.ls_dir(str(tmp_path))
+    assert "d" in dirs and "y.txt" in files
+    fs.mv(f, str(tmp_path / "d" / "z.txt"))
+    assert fs.is_file(str(tmp_path / "d" / "z.txt"))
+    fs.delete(d)
+    assert not fs.is_exist(d)

@@ -2,20 +2,29 @@
 # THE CHIP HOUR (VERDICT r3/r4 item 1): run on a LIVE axon relay only.
 #   sh tools/relay_check.sh && sh tools/chip_hour.sh
 # Rules (CLAUDE.md): ONE TPU python process at a time, generous
-# timeouts, SIGTERM not SIGKILL. Each step is a separate process so a
-# wedged step doesn't hold the grant.
+# timeouts, SIGTERM first (a SIGKILLed client leaks the grant; the
+# delayed -k KILL is the lesser evil vs holding the grant forever).
+# Each step is a separate process so a wedged step doesn't hold the
+# grant; failures are COUNTED and the script exits non-zero if any
+# validation failed — it still runs the benchmarks (they have their own
+# fallback chains) so a partial live window isn't wasted.
 set -x
 cd "$(dirname "$0")/.."
+FAILED=""
 
-# 1. claim + device sanity (fast; watchdog via timeout -s TERM)
-timeout -s TERM 300 python -c "import jax; print(jax.devices())" || exit 1
+step() {  # step <name> <timeout_s> <<'EOF' python EOF  (via stdin file)
+  name="$1"; t="$2"; shift 2
+  timeout -s TERM -k 60 "$t" python "$@" || FAILED="$FAILED $name"
+}
 
-# 2. Pallas pack validation on the real chip (interpret=False):
-#    flash fwd/bwd at S in {2k, 8k, 32k}, varlen/flashmask, paged
-#    folded grid, rms_norm_rows. Plain python (pytest is CPU-pinned).
-timeout -s TERM 900 python - <<'EOF'
+# 1. claim + device sanity
+timeout -s TERM -k 60 300 python -c "import jax; print(jax.devices())" \
+  || { echo "CHIP_HOUR_ABORT: device claim failed"; exit 1; }
+
+# 2. Pallas pack validation on the real chip (interpret=False). Plain
+#    python (pytest is CPU-pinned).
+cat > /tmp/chip_flash.py <<'EOF'
 import numpy as np, jax, jax.numpy as jnp
-import paddle_tpu  # registers kernels
 from paddle_tpu.kernels.flash_attention import flash_attention_bshd
 print("devices:", jax.devices())
 for S in (2048, 8192, 32768):
@@ -26,18 +35,42 @@ for S in (2048, 8192, 32768):
     v = jnp.asarray(rng.randn(B, S, H, D), jnp.bfloat16)
     out = flash_attention_bshd(q, k, v, causal=True)
     jax.block_until_ready(out)
-    print(f"flash fwd S={S} OK", np.asarray(out[0,0,0,:2], np.float32))
-    if S <= 8192:  # bwd at 2k/8k
-        def loss(q, k, v):
-            return flash_attention_bshd(q, k, v, causal=True).astype(
-                jnp.float32).sum()
-        g = jax.grad(loss)(q, k, v)
-        jax.block_until_ready(g)
-        print(f"flash bwd S={S} OK")
+    print(f"flash fwd S={S} OK", np.asarray(out[0, 0, 0, :2], np.float32))
+    def loss(q, k, v):
+        return flash_attention_bshd(q, k, v, causal=True).astype(
+            jnp.float32).sum()
+    g = jax.grad(loss)(q, k, v)
+    jax.block_until_ready(g)
+    print(f"flash bwd S={S} OK")
 print("FLASH_CHIP_OK")
 EOF
+step flash 1200 /tmp/chip_flash.py
 
-timeout -s TERM 600 python - <<'EOF'
+cat > /tmp/chip_varlen.py <<'EOF'
+import numpy as np, jax, jax.numpy as jnp
+from paddle_tpu.kernels.flash_attention import (
+    flash_attention_varlen_bshd, flashmask_attention_bshd)
+B, S, H, D = 1, 2048, 4, 128
+rng = np.random.RandomState(0)
+q = jnp.asarray(rng.randn(B, S, H, D), jnp.bfloat16)
+k = jnp.asarray(rng.randn(B, S, H, D), jnp.bfloat16)
+v = jnp.asarray(rng.randn(B, S, H, D), jnp.bfloat16)
+# two packed sequences of S/2
+seg = jnp.concatenate([jnp.zeros((B, S // 2), jnp.int32),
+                       jnp.ones((B, S // 2), jnp.int32)], axis=1)
+out = flash_attention_varlen_bshd(q, k, v, seg, seg, causal=True)
+jax.block_until_ready(out)
+print("VARLEN_CHIP_OK", out.shape)
+# flashmask: causal bounds (every key visible to rows >= its index)
+idx = jnp.broadcast_to(
+    jnp.full((S, 1), S, jnp.int32)[None, None], (B, 1, S, 1))
+out2 = flashmask_attention_bshd(q, k, v, idx, causal=True)
+jax.block_until_ready(out2)
+print("FLASHMASK_CHIP_OK", out2.shape)
+EOF
+step varlen_flashmask 900 /tmp/chip_varlen.py
+
+cat > /tmp/chip_paged.py <<'EOF'
 import numpy as np, jax, jax.numpy as jnp
 from paddle_tpu.kernels.paged_attention import paged_attention_decode
 B, H, KVH, D, page, pages_per_seq = 4, 8, 8, 128, 16, 8
@@ -52,8 +85,9 @@ out = paged_attention_decode(q, kc, vc, tables, lens)
 jax.block_until_ready(out)
 print("PAGED_CHIP_OK", out.shape)
 EOF
+step paged 600 /tmp/chip_paged.py
 
-timeout -s TERM 600 python - <<'EOF'
+cat > /tmp/chip_rmsnorm.py <<'EOF'
 import numpy as np, jax, jax.numpy as jnp
 from paddle_tpu.kernels.fused_norm import rms_norm_rows
 x = jnp.asarray(np.random.RandomState(0).randn(256, 512), jnp.float32)
@@ -64,9 +98,15 @@ ref = x * jax.lax.rsqrt(jnp.mean(x * x, -1, keepdims=True) + 1e-6) * w
 np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-2)
 print("RMSNORM_CHIP_OK")
 EOF
+step rms_norm 600 /tmp/chip_rmsnorm.py
 
-# 3. the real benchmark numbers
-timeout -s TERM 900 python bench.py
-timeout -s TERM 1500 python bench_ops.py --write-md
+# 3. the real benchmark numbers (bench.py never exits non-zero by
+#    design; bench_ops failures are recorded like validation steps)
+timeout -s TERM -k 60 900 python bench.py
+step bench_ops 1500 bench_ops.py --write-md
 
+if [ -n "$FAILED" ]; then
+  echo "CHIP_HOUR_FAILURES:$FAILED"
+  exit 1
+fi
 echo "CHIP_HOUR_DONE — commit BENCH_OPS.md and record numbers"
