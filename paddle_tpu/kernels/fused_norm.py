@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from .flash_attention import _interpret_mode
+from .flash_attention import _I0, _interpret_mode
 
 __all__ = ["rms_norm_rows", "check_supported_rms"]
 
@@ -61,8 +61,11 @@ def rms_norm_rows(x, weight, residual=None, eps=1e-6, block_rows=256):
             break
     grid = (r // block_rows,) if r % block_rows == 0 else (1,)
 
-    row_spec = pl.BlockSpec((block_rows, h), lambda i: (i, 0))
-    w_spec = pl.BlockSpec((h,), lambda i: (0,))
+    # _I0, not a bare 0: the package enables x64, so literal ints in
+    # index maps trace as i64 and Mosaic's func.return fails to
+    # legalize (found on chip; interpret=True hides it).
+    row_spec = pl.BlockSpec((block_rows, h), lambda i: (i, _I0))
+    w_spec = pl.BlockSpec((h,), lambda i: (_I0,))
     if residual is not None:
         kernel = functools.partial(_kernel_res, eps=eps)
         in_specs = [row_spec, row_spec, w_spec]

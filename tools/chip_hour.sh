@@ -10,6 +10,10 @@
 # fallback chains) so a partial live window isn't wasted.
 set -x
 cd "$(dirname "$0")/.."
+# Step scripts live in /tmp, so python puts /tmp (not the repo) on
+# sys.path; the repo root must come from PYTHONPATH.
+PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+export PYTHONPATH
 FAILED=""
 
 step() {  # step <name> <timeout_s> <<'EOF' python EOF  (via stdin file)
@@ -100,10 +104,14 @@ print("RMSNORM_CHIP_OK")
 EOF
 step rms_norm 600 /tmp/chip_rmsnorm.py
 
-# 3. the real benchmark numbers (bench.py never exits non-zero by
-#    design; bench_ops failures are recorded like validation steps)
-timeout -s TERM -k 60 900 python bench.py
-step bench_ops 1500 bench_ops.py --write-md
+# 2b. numeric parity on chip (kernels execute AND match XLA references)
+step parity 900 tools/chip_parity.py
+
+# 3. the real benchmark numbers. bench.py never exits non-zero by
+#    design, but timeout(1) itself exits 124/143 on a wedge — count
+#    that; bench_ops failures are recorded like validation steps.
+timeout -s TERM -k 60 900 python bench.py || FAILED="$FAILED bench"
+step bench_ops 2700 bench_ops.py --write-md
 
 if [ -n "$FAILED" ]; then
   echo "CHIP_HOUR_FAILURES:$FAILED"

@@ -1,0 +1,92 @@
+"""On-chip NUMERIC parity for the Pallas pack (interpret=False).
+
+Execution alone (chip_hour.sh steps) proves Mosaic compiles the
+kernels; this asserts the numbers match an XLA reference computed on
+the same chip, closing the interpret-mode-only validation gap
+(ADVICE r3 medium finding).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.kernels.flash_attention import (
+    flash_attention_bshd, flash_attention_varlen_bshd)
+from paddle_tpu.kernels.paged_attention import paged_attention_decode
+print("devices:", jax.devices())
+
+
+def sdpa_ref(q, k, v, mask=None, causal=True):
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * (1.0 / np.sqrt(q.shape[-1]))
+    S, Sk = q.shape[1], k.shape[1]
+    if causal:
+        cm = jnp.tril(jnp.ones((S, Sk), bool))
+        s = jnp.where(cm[None, None], s, -1e30)
+    if mask is not None:
+        s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+
+
+def relerr(a, b):
+    a = np.asarray(a, np.float32)
+    b = np.asarray(b, np.float32)
+    return float(np.abs(a - b).max() / (np.abs(b).max() + 1e-9))
+
+
+# ---- flash fwd + bwd vs SDPA, S=2048 --------------------------------
+B, S, H, D = 2, 2048, 4, 128
+rng = np.random.RandomState(0)
+q = jnp.asarray(rng.randn(B, S, H, D), jnp.bfloat16)
+k = jnp.asarray(rng.randn(B, S, H, D), jnp.bfloat16)
+v = jnp.asarray(rng.randn(B, S, H, D), jnp.bfloat16)
+out = flash_attention_bshd(q, k, v, causal=True)
+ref = sdpa_ref(q, k, v)
+e = relerr(out, ref)
+assert e < 3e-2, f"flash fwd parity {e}"
+print(f"PARITY flash fwd rel_err={e:.4f} OK")
+
+dq, dk, dv = jax.grad(
+    lambda q, k, v: flash_attention_bshd(q, k, v, causal=True)
+    .astype(jnp.float32).sum(), argnums=(0, 1, 2))(q, k, v)
+rq, rk, rv = jax.grad(
+    lambda q, k, v: sdpa_ref(q, k, v).sum(), argnums=(0, 1, 2))(q, k, v)
+for name, a, b in [("dq", dq, rq), ("dk", dk, rk), ("dv", dv, rv)]:
+    e = relerr(a, b)
+    assert e < 5e-2, f"flash bwd {name} parity {e}"
+    print(f"PARITY flash bwd {name} rel_err={e:.4f} OK")
+
+# ---- varlen (two packed sequences) vs block-diagonal SDPA -----------
+seg = jnp.concatenate([jnp.zeros((B, S // 2), jnp.int32),
+                       jnp.ones((B, S // 2), jnp.int32)], axis=1)
+out = flash_attention_varlen_bshd(q, k, v, seg, seg, causal=True)
+mask = (seg[:, None, :, None] == seg[:, None, None, :])
+ref = sdpa_ref(q, k, v, mask=mask)
+e = relerr(out, ref)
+assert e < 3e-2, f"varlen parity {e}"
+print(f"PARITY varlen rel_err={e:.4f} OK")
+
+# ---- paged decode vs gathered dense attention -----------------------
+B2, H2, KVH, D2, page, pps = 4, 8, 8, 128, 16, 8
+num_pages = B2 * pps
+q1 = jnp.asarray(rng.randn(B2, H2, D2), jnp.bfloat16)
+kc = jnp.asarray(rng.randn(num_pages, KVH, page, D2), jnp.bfloat16)
+vc = jnp.asarray(rng.randn(num_pages, KVH, page, D2), jnp.bfloat16)
+tables = jnp.arange(num_pages, dtype=jnp.int32).reshape(B2, pps)
+lens = jnp.full((B2,), page * pps, jnp.int32)
+out = paged_attention_decode(q1, kc, vc, tables, lens)
+# dense ref: gather pages -> (B, S, KVH, D), single-query attention
+kd = kc[tables].transpose(0, 2, 1, 3, 4).reshape(B2, KVH, pps * page, D2)
+vd = vc[tables].transpose(0, 2, 1, 3, 4).reshape(B2, KVH, pps * page, D2)
+g = H2 // KVH
+qf = q1.astype(jnp.float32).reshape(B2, KVH, g, D2)
+sc = jnp.einsum("bkgd,bkSd->bkgS", qf, kd.astype(jnp.float32))
+sc = sc * (1.0 / np.sqrt(D2))
+p = jax.nn.softmax(sc, axis=-1)
+ref = jnp.einsum("bkgS,bkSd->bkgd", p, vd.astype(jnp.float32)).reshape(
+    B2, H2, D2)
+e = relerr(out, ref)
+assert e < 3e-2, f"paged parity {e}"
+print(f"PARITY paged decode rel_err={e:.4f} OK")
+
+print("CHIP_PARITY_ALL_OK")
