@@ -153,7 +153,22 @@ def _run_inner(paddle, LlamaConfig, LlamaForCausalLM, jax, use_pallas, shrink):
 
     t_short, loss = timed(2)
     t_long, loss = timed(2 + iters)
-    dt = max(t_long - t_short, 1e-9) / iters
+    timing = "differenced"
+    for _ in range(2):
+        if t_long > t_short:
+            break
+        # noise inversion (relay hiccup): retry rather than fabricate
+        # a near-zero dt and an impossible MFU
+        t_short, loss = timed(2)
+        t_long, loss = timed(2 + iters)
+    if t_long > t_short:
+        dt = (t_long - t_short) / iters
+    else:
+        # still inverted: fall back to the un-differenced total — it
+        # includes the fetch overhead, so it UNDERSTATES MFU (the
+        # honest direction) and is labeled as such in the JSON
+        dt = t_long / (2 + iters)
+        timing = "fallback_total"
 
     # attn_flops_share (VERDICT r2 weak #3): MFU of a small model is not
     # predictive of 8B+mesh MFU; record where the FLOPs are so rounds are
@@ -170,6 +185,7 @@ def _run_inner(paddle, LlamaConfig, LlamaForCausalLM, jax, use_pallas, shrink):
         "vs_baseline": round(mfu / 0.40, 4),
         "tokens_per_sec_per_chip": round(tokens_per_s, 1),
         "step_time_s": round(dt, 4),
+        "timing": timing,
         "n_params": int(n_params),
         "loss": float(np.asarray(loss._data)),
         "device": str(getattr(dev, "device_kind", dev.platform)),

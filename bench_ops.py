@@ -64,76 +64,11 @@ def _emit_all(error=None):
 
 
 def _time_it(fn, *args, iters=10):
-    """Time fn with the iteration loop ON DEVICE (lax.fori_loop).
-
-    Over the axon relay a host-side loop of independent calls measures
-    the transport, not the op — and jax.block_until_ready does NOT
-    actually block there (measured: loop totals were flat in N until
-    the result was fetched).  So: one dispatch runs `iters` chained
-    calls — a loop-carried scalar feeds an iteration-dependent,
-    value-preserving epsilon into the first float arg (defeats hoisting
-    and caching), an optimization_barrier forces the output to
-    materialize (keeps memory-bound benches honest), and a 1-element
-    slice of it becomes the next carry (serializes iterations at ~zero
-    extra HBM traffic).  The ONLY reliable sync is materializing the
-    scalar to host (float(...)); timing loops at N and 2N and
-    differencing cancels the round-trip + fetch overhead, which
-    measured ~66 ms and stable to ±1 ms, so modest N suffices.
-    """
-    import jax
-    import jax.numpy as jnp
-    from jax import lax
-
-    bump_idx = next((j for j, a in enumerate(args)
-                     if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)),
-                    None)
-
-    def make(n):
-        @jax.jit
-        def run(*a):
-            def body(i, dep):
-                aa = list(a)
-                if bump_idx is not None:
-                    eps = ((i.astype(jnp.float32) + dep) * 1e-38)
-                    x = aa[bump_idx]
-                    aa[bump_idx] = x + eps.astype(x.dtype)
-                out = fn(*aa)
-                tok = lax.optimization_barrier(out)
-                leaf = jax.tree_util.tree_leaves(tok)[0]
-                return jnp.ravel(leaf)[0].astype(jnp.float32)
-            return lax.fori_loop(0, n, body, jnp.float32(0.0))
-        return run
-
-    def best_of(run, reps=3):
-        float(run(*args))                    # compile / warm
-        best = float("inf")
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            float(run(*args))                # host fetch = real sync
-            best = min(best, time.perf_counter() - t0)
-        return best
-
-    # Adaptive N: grow until the 2N-N delta clears a ~20 ms signal
-    # floor.  Cap the LONGEST dispatched loop at 512 iterations (checked
-    # before growing) — a several-thousand-iteration loop of Mosaic
-    # kernels has wedged the device before, and with fetch-based sync
-    # the overhead is stable enough that this resolves ~0.1 ms ops.
-    n = iters
-    while True:
-        run_long, run_short = make(2 * n), make(n)
-        delta = best_of(run_long) - best_of(run_short)
-        at_cap = 2 * (4 * n) > 512
-        if delta > 0.02 or at_cap:
-            if delta <= 0:
-                # noise inversion at the cap: one retry (reusing the
-                # compiled loops), then refuse to fabricate a time —
-                # NaN makes _record emit an "unresolved" row instead
-                # of impossible MFU/GB/s
-                delta = best_of(run_long) - best_of(run_short)
-                if delta <= 0:
-                    return float("nan")
-            return delta / n
-        n *= 4
+    """Relay-proof device-side timing; see kernels/timing.py for the
+    full methodology (fori_loop chaining, fetch sync, 2N-N
+    differencing, NaN sentinel for unresolvably fast ops)."""
+    from paddle_tpu.kernels.timing import device_time
+    return device_time(fn, *args, iters=iters)
 
 
 def _record(name, variant, shape, dt, flops=None, bytes_moved=None,

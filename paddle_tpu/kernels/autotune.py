@@ -19,7 +19,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
-__all__ = ["AutoTuneCache", "autotune", "set_autotune_enabled",
+__all__ = ["AutoTuneCache", "autotune", "lookup", "set_autotune_enabled",
            "autotune_enabled", "attention_block_candidates"]
 
 from ..utils.flags import define_flag, flags
@@ -108,14 +108,30 @@ def _device_kind():
         return "cpu"
 
 
+def lookup(kernel_name: str, shape_sig: Tuple) -> Optional[dict]:
+    """Cached winner for (kernel, shape, device) or None.
+
+    Pure host logic on static shapes — safe to call at TRACE time, so
+    jitted models pick up winners a previous eager search persisted
+    (the search itself cannot run under tracing)."""
+    cache = AutoTuneCache.instance()
+    key = json.dumps([kernel_name, list(shape_sig), _device_kind()])
+    return cache.get(key)
+
+
 def autotune(kernel_name: str, shape_sig: Tuple, candidates: List[dict],
              run_fn: Callable[[dict], Callable], warmup: int = 1,
-             iters: int = 3):
-    """Pick the fastest candidate config for `run_fn(cfg)()`.
+             iters: int = 8, default: Optional[dict] = None):
+    """Pick the fastest candidate config.
 
-    run_fn(cfg) -> zero-arg callable returning a jax array (the timed
-    computation, typically a jitted kernel invocation). Returns the best
-    cfg; cached by (kernel, shape, device kind)."""
+    run_fn(cfg) returns either a zero-arg callable (legacy; timed with
+    host-fetch sync per call — coarse over the relay transport; runs
+    max(1, warmup) un-timed calls first) or an (fn, args) tuple, timed
+    with kernels/timing.py::device_time (the relay-proof path:
+    device-side loop, fetch sync, 2N-N differencing; compiles are its
+    warmup). Returns the best cfg, cached by (kernel, shape, device
+    kind); if every candidate fails/can't be resolved, returns
+    `default` when given (NOT cached) instead of raising."""
     cache = AutoTuneCache.instance()
     key = json.dumps([kernel_name, list(shape_sig), _device_kind()])
     hit = cache.get(key)
@@ -123,22 +139,34 @@ def autotune(kernel_name: str, shape_sig: Tuple, candidates: List[dict],
         return hit
     if not candidates:
         raise ValueError("no candidates")
+    from .timing import device_time
+    import numpy as _np
     best_cfg, best_t = None, float("inf")
     for cfg in candidates:
         try:
-            fn = run_fn(cfg)
-            for _ in range(warmup):
-                fn().block_until_ready()
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                out = fn()
-            out.block_until_ready()
-            dt = (time.perf_counter() - t0) / iters
+            timed = run_fn(cfg)
+            if isinstance(timed, tuple):
+                fn, args = timed
+                dt = device_time(fn, *args, iters=iters)
+                if dt != dt:        # NaN: unresolvable — skip honestly
+                    continue
+            else:
+                # legacy zero-arg form: fetch-sync each call
+                # (block_until_ready does not block over the relay)
+                for _ in range(max(1, warmup)):
+                    _np.asarray(timed()).ravel()[:1]
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    out = timed()
+                _np.asarray(out).ravel()[:1]
+                dt = (time.perf_counter() - t0) / iters
         except Exception:
             continue  # illegal tiling for this shape: skip the candidate
         if dt < best_t:
             best_cfg, best_t = cfg, dt
     if best_cfg is None:
+        if default is not None:
+            return dict(default)     # not cached: a later window can tune
         raise RuntimeError(f"all {len(candidates)} candidates failed for "
                            f"{kernel_name} {shape_sig}")
     best = dict(best_cfg)

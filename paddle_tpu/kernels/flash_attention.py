@@ -620,11 +620,17 @@ def _pick_block(n, target):
     return max(b, 128)
 
 
-def _pick_block_q(sq, target=256):
+def _pick_block_q(sq, target=512):
+    """Default 512: the on-chip block sweep (v5e, S=2048, D∈{64,128},
+    causal) found (block_q=512, block_k=1024) fastest for BOTH fwd and
+    fwd+bwd at every shape tried — 1.4-1.8× over the previous
+    (256, 512) defaults. Streaming bigger K/V tiles amortizes the
+    per-block online-softmax bookkeeping; VMEM stays well under budget
+    (k+v tiles at 1024×128 bf16 = 512 KB)."""
     return _pick_block(sq, target)
 
 
-def _pick_block_k(sk, target=512):
+def _pick_block_k(sk, target=1024):
     return _pick_block(sk, target)
 
 
@@ -663,7 +669,8 @@ def flash_attention_bshd(q, k, v, causal=False, sm_scale=None):
     block_q = _pick_block_q(Sq)
     block_k = _pick_block_k(Sk)
     qf, kf, vf = _to_bhsd(q), _to_bhsd(k), _to_bhsd(v)
-    from .autotune import autotune_enabled
+    from .autotune import autotune_enabled, lookup
+    sig = (B * H, Sq, Sk, D, str(q.dtype), bool(causal))
     if autotune_enabled() and not _interpret_mode() \
             and not isinstance(q, jax.core.Tracer):
         # eager concrete inputs on real TPU: search the legal block grid
@@ -672,14 +679,22 @@ def flash_attention_bshd(q, k, v, causal=False, sm_scale=None):
 
         def run(cfg):
             bq, bk = int(cfg["block_q"]), int(cfg["block_k"])
-            f = jax.jit(lambda a, b, c: _flash_core(
-                a, b, c, float(sm_scale), bool(causal), bq, bk))
-            return lambda: f(qf, kf, vf)
+            return (lambda a, b, c: _flash_core(
+                a, b, c, float(sm_scale), bool(causal), bq, bk),
+                (qf, kf, vf))
 
-        best = autotune(
-            "flash_fwd", (B * H, Sq, Sk, D, str(q.dtype), bool(causal)),
-            attention_block_candidates(Sq, Sk), run)
+        best = autotune("flash_fwd", sig,
+                        attention_block_candidates(Sq, Sk), run,
+                        default={"block_q": block_q, "block_k": block_k})
         block_q, block_k = best["block_q"], best["block_k"]
+    elif autotune_enabled():
+        # trace time (jitted models) with the flag on: shapes are
+        # static, so a previously persisted winner still applies.
+        # Gated on the flag — with autotune off, heuristics stand (a
+        # stale cache must not silently override retuned defaults).
+        hit = lookup("flash_fwd", sig)
+        if hit is not None:
+            block_q, block_k = int(hit["block_q"]), int(hit["block_k"])
     out = _flash_core(qf, kf, vf, float(sm_scale),
                       bool(causal), int(block_q), int(block_k))
     return _from_bhsd(out, B, H, Sq, D)
