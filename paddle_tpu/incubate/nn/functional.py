@@ -48,6 +48,13 @@ def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
         out = out.astype(a.dtype) * w
         if nb is not None:
             out = out + nb
+        # Only emit the residual-chain tensor when a residual/bias was
+        # actually added: with neither, `a` IS the input, and returning
+        # it forces XLA to materialize an un-aliasable copy — measured
+        # on chip as a full extra HBM pass (339 vs 455 GB/s at
+        # 32768x4096).
+        if res is None and b is None:
+            return out
         return out, a
 
     args = [x, norm_weight]
@@ -57,7 +64,10 @@ def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
         args.append(residual)
     if bias is not None:
         args.append(bias)
-    out, res_out = apply_op("fused_rms_norm", _f, *args)
+    r = apply_op("fused_rms_norm", _f, *args)
+    if residual is None and bias is None:
+        return r
+    out, res_out = r
     return (out, res_out) if residual is not None else out
 
 
@@ -76,6 +86,10 @@ def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
         var = jnp.var(a, axis=-1, keepdims=True)
         out = (a - mu) * jax.lax.rsqrt(var + epsilon)
         out = out * w + b
+        # see fused_rms_norm: don't force an un-aliasable copy of the
+        # input as a second output when nothing was added to it
+        if res is None and pre_b is None:
+            return out
         return out, a
 
     args = [x, norm_weight, norm_bias]
@@ -83,7 +97,10 @@ def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
         args.append(residual)
     if bias is not None:
         args.append(bias)
-    out, res_out = apply_op("fused_layer_norm", _f, *args)
+    r = apply_op("fused_layer_norm", _f, *args)
+    if residual is None and bias is None:
+        return r
+    out, res_out = r
     return (out, res_out) if residual is not None else out
 
 

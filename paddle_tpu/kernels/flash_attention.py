@@ -620,13 +620,15 @@ def _pick_block(n, target):
     return max(b, 128)
 
 
-def _pick_block_q(sq, target=512):
-    """Default 512: the on-chip block sweep (v5e, S=2048, D∈{64,128},
-    causal) found (block_q=512, block_k=1024) fastest for BOTH fwd and
-    fwd+bwd at every shape tried — 1.4-1.8× over the previous
-    (256, 512) defaults. Streaming bigger K/V tiles amortizes the
-    per-block online-softmax bookkeeping; VMEM stays well under budget
-    (k+v tiles at 1024×128 bf16 = 512 KB)."""
+def _pick_block_q(sq, target=1024):
+    """Default (1024, 1024): the on-chip block sweeps (v5e; S∈{2048,
+    8192}, D∈{64, 128}, causal; fwd and fwd+bwd; device-side timing)
+    found it fastest at every shape tried — 1.5-1.9× over the original
+    (256, 512) defaults. Bigger tiles amortize the per-block
+    online-softmax bookkeeping and keep the MXU fed; VMEM stays under
+    budget (k+v tiles at 1024×128 bf16 = 512 KB, scores 1024×1024 fp32
+    = 4 MB). (2048, 2048) fails to compile (VMEM); (1024, 2048)
+    regresses fwd badly — don't chase full-axis K."""
     return _pick_block(sq, target)
 
 

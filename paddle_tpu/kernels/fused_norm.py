@@ -54,10 +54,29 @@ def rms_norm_rows(x, weight, residual=None, eps=1e-6, block_rows=256):
     """rms_norm over the last dim of a 2-D (rows, H) array."""
     r, h = x.shape
     check_supported_rms(x.shape, x.dtype)
+    # VMEM guard (found on chip): the kernel computes in fp32, so a
+    # block holds ~4 f32 copies (x, x*x, y, out) plus Mosaic's
+    # double-buffered bf16 in/out tiles — block_rows=256 at H=4096
+    # hits "scoped vmem 24.2M > 16M". Shrink until ~24 B/element of
+    # block fits in half of VMEM.
+    while block_rows > 8 and block_rows * h * 24 > 8 * 1024 * 1024:
+        block_rows //= 2
+    if block_rows * h * 24 > 8 * 1024 * 1024:
+        raise ValueError(
+            f"pallas rms_norm: even an 8-row block at H={h} exceeds the "
+            "VMEM budget — use the XLA composition for this shape")
     while r % block_rows != 0:
         block_rows //= 2
         if block_rows < 8:
-            block_rows = r  # whole-array block (legal: equals array dim)
+            # whole-array block (legal: equals array dim) — but only if
+            # it also fits VMEM, else the fallback would reintroduce
+            # the scoped-vmem OOM the guard above prevents
+            if r * h * 24 > 8 * 1024 * 1024:
+                raise ValueError(
+                    f"pallas rms_norm: rows={r} not tileable (no "
+                    f"divisor >= 8) and too large for a single VMEM "
+                    f"block at H={h}")
+            block_rows = r
             break
     grid = (r // block_rows,) if r % block_rows == 0 else (1,)
 

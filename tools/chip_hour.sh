@@ -107,6 +107,9 @@ step rms_norm 600 /tmp/chip_rmsnorm.py
 # 2b. numeric parity on chip (kernels execute AND match XLA references)
 step parity 900 tools/chip_parity.py
 
+# 2c. serving path: compiled decode loop vs eager + int8 parity
+step serving 1200 tools/chip_serving.py
+
 # 3. the real benchmark numbers. bench.py never exits non-zero by
 #    design, but timeout(1) itself exits 124/143 on a wedge — count
 #    that; bench_ops failures are recorded like validation steps.

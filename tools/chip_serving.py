@@ -1,0 +1,57 @@
+"""On-chip serving-path validation: compiled decode loop + int8 parity.
+
+1. LlamaForCausalLM.generate(use_jit=True) — prefill + whole decode
+   loop + sampling as ONE XLA program — on the real chip, checked
+   against the eager decode loop token-for-token (greedy).
+2. weight_only_linear int8 vs the bf16 matmul it approximates.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+print("devices:", jax.devices())
+
+cfg = LlamaConfig(vocab_size=1024, hidden_size=256, intermediate_size=512,
+                  num_hidden_layers=2, num_attention_heads=4,
+                  num_key_value_heads=4, max_position_embeddings=256)
+paddle.seed(0)
+model = LlamaForCausalLM(cfg)
+model.bfloat16()
+rng = np.random.RandomState(0)
+ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (2, 16)))
+
+out_eager = model.generate(ids, max_new_tokens=24, temperature=0.0)
+out_jit = model.generate(ids, max_new_tokens=24, temperature=0.0,
+                         use_jit=True)
+a = np.asarray(out_eager._data if hasattr(out_eager, "_data") else out_eager)
+b = np.asarray(out_jit._data if hasattr(out_jit, "_data") else out_jit)
+match = (a == b).mean()
+print(f"decode greedy eager-vs-jit token match: {match:.3f}")
+# greedy at temperature 0 must agree EXACTLY — one flipped token
+# cascades, so anything < 1.0 is a real regression
+assert match == 1.0, (a, b)
+print("SERVING_JIT_CHIP_OK", a.shape)
+
+# sampled path executes (no parity claim — different RNG streams ok)
+out_s = model.generate(ids, max_new_tokens=8, temperature=0.8, top_p=0.9,
+                       use_jit=True, seed=7)
+print("SERVING_SAMPLED_CHIP_OK",
+      np.asarray(out_s._data if hasattr(out_s, "_data") else out_s).shape)
+
+# --- int8 weight-only parity -----------------------------------------
+from paddle_tpu.nn.quant import weight_quantize, weight_only_linear
+K, N, M = 1024, 1024, 64
+w = paddle.to_tensor((rng.randn(K, N) * 0.02).astype(np.float32))
+x = paddle.to_tensor(rng.randn(M, K).astype(np.float32))
+qw, scale = weight_quantize(w, algo="weight_only_int8")
+y_q = np.asarray(weight_only_linear(
+    x, qw, weight_scale=scale, weight_dtype="int8")._data, np.float32)
+y_f = np.asarray((x._data @ w._data), np.float32)
+rel = np.abs(y_q - y_f).max() / (np.abs(y_f).max() + 1e-9)
+print(f"int8 weight-only rel_err {rel:.4f}")
+assert rel < 2e-2, rel
+print("INT8_CHIP_OK")
+print("CHIP_SERVING_ALL_OK")
