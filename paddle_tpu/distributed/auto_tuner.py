@@ -216,11 +216,14 @@ def measure_on_mesh(tuner_cfg, cfg, iters=3):
 
     params = (w1, w2)
     params, loss = step(params, x, y)          # compile
-    jax.block_until_ready(loss)
+    np.asarray(loss)
     t0 = time.perf_counter()
     for _ in range(iters):
         params, loss = step(params, x, y)
-    jax.block_until_ready(loss)
+    # host fetch, not block_until_ready: over relayed transports (axon)
+    # block_until_ready does not actually block (see kernels/timing.py);
+    # the steps themselves serialize through the params chain
+    np.asarray(loss)
     dt = (time.perf_counter() - t0) / iters
 
     from ..device import max_memory_allocated
@@ -257,12 +260,25 @@ def measure_user_step(train_step_builder, iters=3):
             return {"time": -1, "max_mem_usage": "SKIP",
                     "error": repr(e)}
         try:
-            jax.block_until_ready(step())     # warmup: traces + compiles
+            import numpy as _np
+            import jax.numpy as _jnp
+
+            def _sync(o):
+                # host fetch of ONE element — the only sync that also
+                # works over relayed transports (see kernels/timing.py);
+                # slicing on device first so a large first leaf (e.g.
+                # returned params) doesn't turn the timed region into a
+                # full D2H transfer
+                leaves = jax.tree_util.tree_leaves(o)
+                if leaves:
+                    _np.asarray(_jnp.ravel(leaves[0])[0])
+
+            _sync(step())                     # warmup: traces + compiles
             t0 = time.perf_counter()
             out = None
             for _ in range(iters):
                 out = step()
-            jax.block_until_ready(out)
+            _sync(out)
             dt = (time.perf_counter() - t0) / iters
         except Exception as e:
             oom = "RESOURCE_EXHAUSTED" in str(e) or "Out of memory" in str(e)

@@ -57,11 +57,13 @@ def rms_norm_rows(x, weight, residual=None, eps=1e-6, block_rows=256):
     # VMEM guard (found on chip): the kernel computes in fp32, so a
     # block holds ~4 f32 copies (x, x*x, y, out) plus Mosaic's
     # double-buffered bf16 in/out tiles — block_rows=256 at H=4096
-    # hits "scoped vmem 24.2M > 16M". Shrink until ~24 B/element of
-    # block fits in half of VMEM.
-    while block_rows > 8 and block_rows * h * 24 > 8 * 1024 * 1024:
+    # hits "scoped vmem 24.2M > 16M". Shrink until the per-element
+    # estimate fits in half of VMEM; a residual adds its own
+    # double-buffered tile + fp32 upcast (~8 B/element more).
+    bytes_per_elem = 24 + (8 if residual is not None else 0)
+    while block_rows > 8 and block_rows * h * bytes_per_elem > 8 * 1024 * 1024:
         block_rows //= 2
-    if block_rows * h * 24 > 8 * 1024 * 1024:
+    if block_rows * h * bytes_per_elem > 8 * 1024 * 1024:
         raise ValueError(
             f"pallas rms_norm: even an 8-row block at H={h} exceeds the "
             "VMEM budget — use the XLA composition for this shape")

@@ -135,17 +135,22 @@ def check_supported_paged(q_shape, cache_shape, dtype):
                          "accept bfloat16/float32)")
 
 
-def paged_blockspecs(B, H, KVH, D, page_size, num_pages):
+def paged_blockspecs(B, H, KVH, D, page_size, num_pages, max_pages=None):
     """The exact (block_shape, array_shape) pairs the pallas_call below
-    constructs, plus the VMEM scratch shapes — enumerable for the static
-    legality test without running the kernel."""
+    constructs — including the `fold` repetition of the k/v page specs
+    the folded grid uses — plus the VMEM scratch shapes; enumerable for
+    the static legality test without running the kernel."""
     G = H // KVH
-    specs = [
-        ((1, KVH, G, D), (B, KVH, G, D)),                 # q block
-        ((1, KVH, page_size, D), (num_pages, KVH, page_size, D)),  # k
-        ((1, KVH, page_size, D), (num_pages, KVH, page_size, D)),  # v
-        ((1, KVH, G, D), (B, KVH, G, D)),                 # out block
-    ]
+    if max_pages is None:
+        max_pages = num_pages
+    fold = max(1, min(max(128, 2 * page_size) // page_size, max_pages))
+    page = ((1, KVH, page_size, D), (num_pages, KVH, page_size, D))
+    specs = (
+        [((1, KVH, G, D), (B, KVH, G, D))]                # q block
+        + [page] * fold                                   # k pages
+        + [page] * fold                                   # v pages
+        + [((1, KVH, G, D), (B, KVH, G, D))]              # out block
+    )
     scratch = [(KVH, G, D), (KVH, G, _STATS_LANES), (KVH, G, _STATS_LANES)]
     return specs, scratch
 

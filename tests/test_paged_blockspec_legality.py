@@ -76,3 +76,37 @@ def test_paged_decode_still_runs_after_guard():
     out = paged_attention_decode(q, k_cache, v_cache, bt, sl)
     assert out.shape == (B, H, D)
     assert bool(jnp.isfinite(out.astype(jnp.float32)).all())
+
+def test_paged_decode_fold_padding_parity():
+    """The fold rule batches max(128 tokens, 2 pages) per grid step and
+    pads the block table to a fold multiple; max_pages=9 at page=16
+    gives fold=8 -> pad=7, so the jnp.pad branch actually runs (fold
+    clamps to max_pages, so pps must EXCEED the fold to pad). Must
+    still match dense attention exactly, padded slots masked by
+    seq_lens."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.kernels.paged_attention import paged_attention_decode
+
+    B, H, KVH, D, page, pps = 2, 4, 2, 64, 16, 9
+    num_pages = B * pps
+    rng = np.random.RandomState(0)
+    kc = jnp.asarray(rng.randn(num_pages, KVH, page, D), jnp.float32)
+    vc = jnp.asarray(rng.randn(num_pages, KVH, page, D), jnp.float32)
+    q = jnp.asarray(rng.randn(B, H, D), jnp.float32)
+    bt = jnp.arange(num_pages, dtype=jnp.int32).reshape(B, pps)
+    sl = jnp.asarray([page * pps, 3 * page + 7], jnp.int32)
+    out = paged_attention_decode(q, kc, vc, bt, sl)
+
+    G = H // KVH
+    for b in range(B):
+        L = int(sl[b])
+        kd = kc[bt[b]].transpose(1, 0, 2, 3).reshape(KVH, pps * page, D)[:, :L]
+        vd = vc[bt[b]].transpose(1, 0, 2, 3).reshape(KVH, pps * page, D)[:, :L]
+        qf = q[b].reshape(KVH, G, D)
+        s = jnp.einsum("kgd,kSd->kgS", qf, kd) / np.sqrt(D)
+        ref = jnp.einsum("kgS,kSd->kgd", jax.nn.softmax(s, -1), vd)
+        np.testing.assert_allclose(np.asarray(out[b]),
+                                   np.asarray(ref.reshape(H, D)),
+                                   rtol=2e-5, atol=2e-5)

@@ -10,7 +10,8 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.kernels.flash_attention import (
-    flash_attention_bshd, flash_attention_varlen_bshd)
+    flash_attention_bshd, flash_attention_varlen_bshd,
+    flashmask_attention_bshd)
 from paddle_tpu.kernels.paged_attention import paged_attention_decode
 print("devices:", jax.devices())
 
@@ -65,6 +66,18 @@ ref = sdpa_ref(q, k, v, mask=mask)
 e = relerr(out, ref)
 assert e < 3e-2, f"varlen parity {e}"
 print(f"PARITY varlen rel_err={e:.4f} OK")
+
+# ---- flashmask (causal C=1: rows >= start[k] masked) vs dense mask --
+start = jnp.asarray(
+    rng.randint(1, S + 1, (B, 1, S, 1)).astype(np.int32)
+    .clip(min=np.arange(S).reshape(1, 1, S, 1) + 1))
+out = flashmask_attention_bshd(q, k, v, start, causal=True)
+rows = jnp.arange(S)[:, None]
+keep = rows < start[:, 0, :, 0][:, None, :]      # (B, Sq, Sk)
+ref = sdpa_ref(q, k, v, mask=keep[:, None], causal=True)
+e = relerr(out, ref)
+assert e < 3e-2, f"flashmask parity {e}"
+print(f"PARITY flashmask rel_err={e:.4f} OK")
 
 # ---- paged decode vs gathered dense attention -----------------------
 B2, H2, KVH, D2, page, pps = 4, 8, 8, 128, 16, 8
