@@ -264,14 +264,19 @@ def measure_user_step(train_step_builder, iters=3):
             import jax.numpy as _jnp
 
             def _sync(o):
-                # host fetch of ONE element — the only sync that also
-                # works over relayed transports (see kernels/timing.py);
-                # slicing on device first so a large first leaf (e.g.
-                # returned params) doesn't turn the timed region into a
-                # full D2H transfer
-                leaves = jax.tree_util.tree_leaves(o)
-                if leaves:
-                    _np.asarray(_jnp.ravel(leaves[0])[0])
+                # host fetch of ONE element PER ARRAY leaf — the only
+                # sync that also works over relayed transports (see
+                # kernels/timing.py). Every device leaf must be
+                # awaited (a host-scalar first leaf would complete
+                # instantly and collapse dt to dispatch time); slicing
+                # on device first keeps large leaves (e.g. returned
+                # params) from turning the timed region into a full
+                # D2H transfer.
+                for leaf in jax.tree_util.tree_leaves(o):
+                    if hasattr(leaf, "addressable_shards") or hasattr(
+                            leaf, "device_buffer") or hasattr(leaf, "devices"):
+                        _np.asarray(_jnp.ravel(leaf)[0] if getattr(
+                            leaf, "ndim", 0) else leaf)
 
             _sync(step())                     # warmup: traces + compiles
             t0 = time.perf_counter()

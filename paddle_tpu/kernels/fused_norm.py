@@ -73,7 +73,7 @@ def rms_norm_rows(x, weight, residual=None, eps=1e-6, block_rows=256):
             # whole-array block (legal: equals array dim) — but only if
             # it also fits VMEM, else the fallback would reintroduce
             # the scoped-vmem OOM the guard above prevents
-            if r * h * 24 > 8 * 1024 * 1024:
+            if r * h * bytes_per_elem > 8 * 1024 * 1024:
                 raise ValueError(
                     f"pallas rms_norm: rows={r} not tileable (no "
                     f"divisor >= 8) and too large for a single VMEM "

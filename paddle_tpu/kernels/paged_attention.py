@@ -135,7 +135,17 @@ def check_supported_paged(q_shape, cache_shape, dtype):
                          "accept bfloat16/float32)")
 
 
-def paged_blockspecs(B, H, KVH, D, page_size, num_pages, max_pages=None):
+def _fold_pages(page_size, max_pages, fold_tokens=None):
+    """Pages batched per grid step: max(128 tokens, 2 pages), clamped to
+    the table width. Single source of truth for the kernel AND the
+    static legality enumeration (they drifted once — don't re-fork)."""
+    if fold_tokens is None:
+        fold_tokens = max(128, 2 * page_size)
+    return max(1, min(fold_tokens // page_size, max_pages))
+
+
+def paged_blockspecs(B, H, KVH, D, page_size, num_pages, max_pages=None,
+                     fold_tokens=None):
     """The exact (block_shape, array_shape) pairs the pallas_call below
     constructs — including the `fold` repetition of the k/v page specs
     the folded grid uses — plus the VMEM scratch shapes; enumerable for
@@ -143,7 +153,7 @@ def paged_blockspecs(B, H, KVH, D, page_size, num_pages, max_pages=None):
     G = H // KVH
     if max_pages is None:
         max_pages = num_pages
-    fold = max(1, min(max(128, 2 * page_size) // page_size, max_pages))
+    fold = _fold_pages(page_size, max_pages, fold_tokens)
     page = ((1, KVH, page_size, D), (num_pages, KVH, page_size, D))
     specs = (
         [((1, KVH, G, D), (B, KVH, G, D))]                # q block
@@ -187,9 +197,7 @@ def paged_attention_decode(q, k_cache, v_cache, block_tables, seq_lens,
     # unfolded; folds deeper than this regressed every small-page
     # config). Pad the block table to a fold multiple; padded slots
     # reuse page 0 and are masked by seq_lens.
-    if fold_tokens is None:
-        fold_tokens = max(128, 2 * page_size)
-    fold = max(1, min(fold_tokens // page_size, max_pages))
+    fold = _fold_pages(page_size, max_pages, fold_tokens)
     if max_pages % fold != 0:
         pad = fold - max_pages % fold
         bt = jnp.pad(bt, ((0, 0), (0, pad)))

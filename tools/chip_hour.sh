@@ -110,6 +110,9 @@ step parity 900 tools/chip_parity.py
 # 2c. serving path: compiled decode loop vs eager + int8 parity
 step serving 1200 tools/chip_serving.py
 
+# 2d. BASELINE config ladder: ResNet/ERNIE/DiT/Qwen2-MoE train steps
+step ladder 1800 tools/chip_ladder.py
+
 # 3. the real benchmark numbers. bench.py never exits non-zero by
 #    design, but timeout(1) itself exits 124/143 on a wedge — count
 #    that; bench_ops failures are recorded like validation steps.
