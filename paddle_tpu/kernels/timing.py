@@ -38,9 +38,19 @@ def device_time(fn, *args, iters=10, signal_floor_s=0.02, loop_cap=512):
     import jax.numpy as jnp
     from jax import lax
 
+    def _bumpable(a):
+        d = jnp.asarray(a).dtype
+        return (jnp.issubdtype(d, jnp.floating)
+                or jnp.issubdtype(d, jnp.integer))
+
+    # prefer a float arg (epsilon is value-preserving but nonzero in
+    # the IR); fall back to an int arg, where casting the traced tiny
+    # float yields a runtime 0 that XLA cannot constant-fold — without
+    # ANY bump the body is loop-invariant and hoistable
     bump_idx = next((j for j, a in enumerate(args)
                      if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)),
-                    None)
+                    next((j for j, a in enumerate(args) if _bumpable(a)),
+                         None))
 
     def make(n):
         @jax.jit
