@@ -13,9 +13,21 @@ Pipeline position (jit/api.py): trace fails with a concretization error
 -> try_convert() rewrites the function's AST -> retrace; only if the
 converted function still breaks does the SOT-lite eager fallback engage.
 
+`break`/`continue` in while/for bodies ARE converted (parity:
+break_continue_transformer.py): each lowers to a masked flag — `break`
+joins the compiled loop's condition, `continue` guards the rest of the
+iteration — with the flag-guarded tails going through the normal
+traced-`if` conversion, so its both-branches-and-select caveat
+applies. A TRACED break flag is only sound where the flag can actually
+stop the loop (the while_loop lowerings); host-executed loops
+(concrete bounds, short unrolled tensor iteration) raise to the eager
+fallback instead of running a loop the flag cannot stop.
+
 Restrictions (each skips the rewrite for that statement, keeping plain
 python semantics — the fallback still works):
-  * branches/loop bodies containing return/break/continue/yield
+  * branches containing return/break/continue/yield; loop bodies
+    containing return/yield, or break/continue inside an opaque
+    compound (try/with)
   * nested function definitions are not descended into
   * closure variables are bound by VALUE at conversion time (the
     reference snapshots cells the same way when synthesizing code)
@@ -66,6 +78,36 @@ def _to_bool(p):
     if isinstance(p, Tensor):
         return bool(np.asarray(p._data).reshape(()))
     return bool(p)
+
+
+def _t_not(v):
+    """`not v` for python bools and (possibly traced) Tensors."""
+    from ..core.tensor import Tensor
+    if isinstance(v, Tensor):
+        import jax.numpy as jnp
+        return Tensor(jnp.logical_not(v._data))
+    return not v
+
+
+def _t_and(a, b):
+    """`a and b` (non-short-circuit) for bools and Tensors."""
+    from ..core.tensor import Tensor
+    if isinstance(a, Tensor) or isinstance(b, Tensor):
+        import jax.numpy as jnp
+        ad = a._data if isinstance(a, Tensor) else a
+        bd = b._data if isinstance(b, Tensor) else b
+        return Tensor(jnp.logical_and(ad, bd))
+    return bool(a) and bool(b)
+
+
+def _none_set(*flags):
+    """True iff no lowered break/continue flag is set; Tensor-valued when
+    any flag is traced (the rewritten guard `if __pt_none_set(...)` then
+    lowers through the normal traced-if path)."""
+    out = True
+    for f in flags:
+        out = _t_and(out, _t_not(f))
+    return out
 
 
 def _run_if(pred, true_fn, false_fn):
@@ -127,15 +169,19 @@ def _probe_body_grads(body_fn, args):
     side effects don't run an extra time; this is a semantics choice,
     not merely an optimization. Any non-grad probe failure is ignored
     here because the while_loop attempt right after surfaces it as a
-    proper conversion break."""
+    proper conversion break.
+
+    Returns the probe outputs (a tuple) when the probe ran and passed,
+    else None — callers may reuse them (e.g. to seed _Undefined carry
+    slots) WITHOUT running the body's side effects a second time."""
     from ..core import autograd
     if not autograd.is_grad_enabled():
-        return
+        return None
     rng_before = _rng_fingerprint()
     try:
         out = body_fn(*args)
     except Exception:
-        return
+        return None
     if _rng_fingerprint() != rng_before:
         # one traced body = ONE draw repeated every iteration; the eager
         # fallback keeps per-iteration draws. Covers the TP tracker
@@ -150,9 +196,10 @@ def _probe_body_grads(body_fn, args):
             "loop body produces grad-requiring tensors; while_loop is "
             "forward-only — using the eager fallback so gradients stay "
             "correct")
+    return vals
 
 
-def _run_for_range(start, stop, step, body_fn, loop_vars):
+def _run_for_range(start, stop, step, body_fn, loop_vars, brk_idx=None):
     """Runtime helper for rewritten `for t in range(...)` (parity:
     the reference loop transformer converts `for`-over-range into its
     while lowering, `jit/dy2static/transformers/loop_transformer.py:111`).
@@ -175,6 +222,18 @@ def _run_for_range(start, stop, step, body_fn, loop_vars):
     if not (traced(start) or traced(stop) or traced(step)):
         i, st, sp = _to_int(start), _to_int(stop), _to_int(step)
         while (i < st) if sp > 0 else (i > st):
+            if brk_idx is not None:
+                bf = carried[brk_idx]
+                if traced(getattr(bf, "_data", bf)):
+                    # only the masked TAIL of the setting iteration is
+                    # guarded; statements before the flag check would
+                    # keep executing in a host loop the flag cannot
+                    # stop — eager is the only correct semantics
+                    raise DygraphToStaticBreak(
+                        "break flag became traced inside a "
+                        "concrete-bound for — using the eager fallback")
+                if _to_bool(bf):
+                    break   # exact python: stop before the next iteration
             out = body_fn(i, *carried)
             tgt, carried = out[0], tuple(out[1:])
             i += sp
@@ -196,7 +255,16 @@ def _run_for_range(start, stop, step, body_fn, loop_vars):
     # probe with the TENSOR counter the real body will receive — an int
     # probe would raise on tensor-method counter use and silently skip
     # both the RNG and grad checks
-    _probe_body_grads(body_fn, (k0,) + carried)
+    p_vals = _probe_body_grads(body_fn, (k0,) + carried)
+    if p_vals is not None and any(isinstance(v, _Undefined)
+                                  for v in carried):
+        # names first assigned INSIDE the body (e.g. a nested loop's
+        # target) enter the carry as sentinels, which while_loop cannot
+        # type — seed them from the probe's outputs (NO extra body
+        # call: under no_grad the probe is skipped by design and the
+        # undefined carry falls through to the conversion break below)
+        carried = tuple(p_vals[1 + j] if isinstance(v, _Undefined) else v
+                        for j, v in enumerate(carried))
     stop_v = stop._data if isinstance(stop, Tensor) else stop
     if isinstance(tgt, _Undefined):
         # while_loop carried values need a concrete type; python would
@@ -206,8 +274,11 @@ def _run_for_range(start, stop, step, body_fn, loop_vars):
     from ..static import nn as snn
 
     def cond(k, t, *vs):
-        return Tensor(k._data < stop_v) if sp > 0 else \
+        base = Tensor(k._data < stop_v) if sp > 0 else \
             Tensor(k._data > stop_v)
+        if brk_idx is None:
+            return base
+        return _t_and(base, _t_not(vs[brk_idx]))
 
     def body(k, t, *vs):
         out = body_fn(k, *vs)
@@ -243,7 +314,7 @@ def _dy2static_debug_log(msg):
         print(f"[dy2static_debug] {msg}")
 
 
-def _run_for_iter(seq, body_fn, loop_vars):
+def _run_for_iter(seq, body_fn, loop_vars, brk_idx=None):
     """Runtime helper for rewritten `for x in seq`. Tensors iterate along
     dim 0 with a STATIC trip count (shapes are static under jit): short
     loops unroll into the trace; LONG tensor loops (> 64 rows) lower to
@@ -297,8 +368,14 @@ def _run_for_iter(seq, body_fn, loop_vars):
                 # only seed _Undefined slots as type placeholders
                 seeds = [vals[j] if isinstance(v, _Undefined) else v
                          for j, v in enumerate(orig)]
+                def _iter_cond(k, t, *vs):
+                    base = Tensor(k._data < n)
+                    if brk_idx is None:
+                        return base
+                    return _t_and(base, _t_not(vs[brk_idx]))
+
                 res = snn.while_loop(
-                    lambda k, t, *vs: Tensor(k._data < n),
+                    _iter_cond,
                     lambda k, t, *vs: (Tensor(k._data + 1),) + tuple(
                         body_fn(Tensor(seq._data[k._data]), *vs)),
                     [k0] + seeds)
@@ -307,26 +384,59 @@ def _run_for_iter(seq, body_fn, loop_vars):
                 _dy2static_debug_log(
                     f"tensor-iter while_loop lowering failed, "
                     f"unrolling: {e!r}")
+    import jax as _jax
+
+    def _tr(v):
+        return isinstance(getattr(v, "_data", v), _jax.core.Tracer)
+
     if isinstance(seq, Tensor):
         items = (Tensor(seq._data[j])
                  for j in range(start, seq.shape[0]))
     else:
         items = iter(seq)
     for item in items:
+        if brk_idx is not None:
+            bf = carried[brk_idx]
+            if _tr(bf):
+                # an unrolled host loop cannot be stopped by a traced
+                # flag, and only the setting iteration's tail is masked
+                # — eager is the only correct semantics
+                raise DygraphToStaticBreak(
+                    "break flag became traced in an unrolled for — "
+                    "using the eager fallback")
+            if _to_bool(bf):
+                break       # exact python semantics for a concrete flag
         out = body_fn(item, *carried)
         tgt, carried = out[0], tuple(out[1:])
     return (tgt,) + carried
 
 
-def _run_while(cond_fn, body_fn, loop_vars):
-    """Runtime helper for rewritten `while`."""
+def _run_while(cond_fn, body_fn, loop_vars, brk_idx=None):
+    """Runtime helper for rewritten `while`.
+
+    brk_idx: index in loop_vars of a lowered `break` flag (the masked
+    break/continue conversion) — the loop additionally stops once it is
+    set: short-circuited exactly in the concrete path, folded into the
+    while_loop condition in the traced path."""
     import jax
     first = cond_fn(*loop_vars)
     tracers = _is_tracer_tensor(first) or any(
         isinstance(getattr(v, "_data", v), jax.core.Tracer)
         for v in loop_vars)
     if not tracers:
-        while _to_bool(cond_fn(*loop_vars)):
+        while True:
+            if brk_idx is not None:
+                bf = loop_vars[brk_idx]
+                if _is_tracer_tensor(bf):
+                    # a traced predicate set the flag mid-loop while the
+                    # cond stayed concrete: only eager keeps semantics
+                    raise DygraphToStaticBreak(
+                        "break flag became traced inside a concrete "
+                        "while — using the eager fallback")
+                if _to_bool(bf):
+                    break
+            if not _to_bool(cond_fn(*loop_vars)):
+                break
             out = body_fn(*loop_vars)
             loop_vars = tuple(out) if isinstance(out, (list, tuple)) \
                 else (out,)
@@ -337,9 +447,13 @@ def _run_while(cond_fn, body_fn, loop_vars):
             "forward-only — using the eager fallback so gradients stay "
             "correct")
     _probe_body_grads(body_fn, tuple(loop_vars))
+    cond2 = cond_fn
+    if brk_idx is not None:
+        def cond2(*vs):
+            return _t_and(_t_not(vs[brk_idx]), cond_fn(*vs))
     from ..static import nn as snn
     try:
-        return tuple(snn.while_loop(cond_fn, body_fn, list(loop_vars)))
+        return tuple(snn.while_loop(cond2, body_fn, list(loop_vars)))
     except Exception as e:
         raise DygraphToStaticBreak(
             f"converted `while` could not lower to while_loop: {e}") from e
@@ -436,6 +550,99 @@ def _blocked(stmts) -> bool:
     return s.blocked
 
 
+def _ctrl_profile(st):
+    """(escapes, at_level): `escapes` = Return/Yield anywhere in the
+    statement (excluding nested function/class defs) — never lowerable
+    inside a loop body; `at_level` = Break/Continue bound to THE
+    ENCLOSING loop (i.e. not inside a nested For/While)."""
+    escapes = [False]
+    at_level = [False]
+
+    def walk(n, level):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            return
+        if isinstance(n, (ast.Return, ast.Yield, ast.YieldFrom)):
+            escapes[0] = True
+        if level and isinstance(n, (ast.Break, ast.Continue)):
+            at_level[0] = True
+        if isinstance(n, (ast.For, ast.While)):
+            for c in n.body:
+                walk(c, False)       # bound to the nested loop itself
+            for c in n.orelse:
+                walk(c, level)       # else-clause breaks bind the
+            handled = set(map(id, n.body)) | set(map(id, n.orelse))
+            for c in ast.iter_child_nodes(n):
+                if id(c) not in handled:
+                    walk(c, False)   # header exprs: escapes (yield) only
+            return                   # ENCLOSING loop, not the nested one
+        for child in ast.iter_child_nodes(n):
+            walk(child, level)
+
+    walk(st, True)
+    return escapes[0], at_level[0]
+
+
+def _assign_flag(name, value):
+    return ast.Assign(targets=[_name(name, ast.Store())],
+                      value=ast.Constant(value=value))
+
+
+def _mask_ctrl(stmts, brk, cont):
+    """Lower Break/Continue in a loop-body statement list to masked flag
+    assignments: `break` -> `brk = True`, `continue` -> `cont = True`,
+    and every statement that can follow a flag-set runs under
+    `if __pt_none_set(flags):` (which the normal if-rewriter then
+    converts — traced flags go through cond's both-branches-and-select
+    semantics, same caveats as any converted traced `if`).
+
+    Returns (new_stmts, used_brk, used_cont) or None when the list is
+    not lowerable (Return/Yield at loop level, or Break/Continue inside
+    an opaque compound like try/with)."""
+    out: List[ast.stmt] = []
+    used_b = used_c = False
+    for i, st in enumerate(stmts):
+        if isinstance(st, ast.Break):
+            out.append(_assign_flag(brk, True))
+            return out, True, used_c      # tail is unreachable python
+        if isinstance(st, ast.Continue):
+            out.append(_assign_flag(cont, True))
+            return out, used_b, True
+        escapes, at_level = _ctrl_profile(st)
+        if escapes:
+            return None
+        if at_level:
+            if not isinstance(st, ast.If):
+                return None               # break inside try/with/...
+            r_body = _mask_ctrl(st.body, brk, cont)
+            r_else = _mask_ctrl(st.orelse, brk, cont)
+            if r_body is None or r_else is None:
+                return None
+            used_b |= r_body[1] or r_else[1]
+            used_c |= r_body[2] or r_else[2]
+            out.append(ast.If(test=st.test,
+                              body=r_body[0] or [ast.Pass()],
+                              orelse=r_else[0]))
+            rest = stmts[i + 1:]
+            if rest:
+                r_tail = _mask_ctrl(rest, brk, cont)
+                if r_tail is None:
+                    return None
+                flags = [n for n, u in ((brk, used_b), (cont, used_c))
+                         if u]
+                out.append(ast.If(
+                    test=ast.Call(
+                        func=_name("__pt_none_set", ast.Load()),
+                        args=[_name(f, ast.Load()) for f in flags],
+                        keywords=[]),
+                    body=r_tail[0] or [ast.Pass()], orelse=[]))
+                used_b |= r_tail[1]
+                used_c |= r_tail[2]
+            return out, used_b, used_c
+        out.append(st)
+    return out, used_b, used_c
+
+
 def _name(id_, ctx):
     return ast.Name(id=id_, ctx=ctx)
 
@@ -457,15 +664,13 @@ class _Rewriter:
         for st in stmts:
             if isinstance(st, ast.If) and not _blocked(st.body + st.orelse):
                 out.extend(self._rewrite_if(st, bound))
-            elif isinstance(st, ast.While) and not st.orelse \
-                    and not _blocked(st.body):
+            elif isinstance(st, ast.While) and not st.orelse:
+                # bodies with break/continue are lowered to masked flags
+                # inside _rewrite_while; return/yield (or flags in
+                # opaque compounds) leave the loop as plain python
                 out.extend(self._rewrite_while(st, bound))
             elif isinstance(st, ast.For) and not st.orelse \
-                    and isinstance(st.target, ast.Name) \
-                    and not _blocked(st.body):
-                # `for` with break/continue/return in the body is left as
-                # plain python (the _blocked guard above): semantics are
-                # preserved and the eager fallback still trains it
+                    and isinstance(st.target, ast.Name):
                 out.extend(self._rewrite_for(st, bound))
             else:
                 # recurse into compound statements' bodies in place
@@ -534,30 +739,81 @@ class _Rewriter:
         self.count += 1
         return pre + [tf, ff, assign]
 
-    def _rewrite_while(self, node: ast.While,
-                       bound: Set[str]) -> List[ast.stmt]:
+    def _lower_flags(self, stmts):
+        """break/continue -> masked flags (see _mask_ctrl). Returns
+        (new_stmts, brk_name|None, cont_name|None) or None."""
         self.uid += 1
-        k = self.uid
-        body = self.rewrite_body(node.body, set(bound))
-        carried = sorted(_assigned_names(node.body))
-        if not carried:
-            return [node]  # nothing loop-carried: leave as plain python
+        brk = f"__pt_brk_{self.uid}"
+        cont = f"__pt_cont_{self.uid}"
+        res = _mask_ctrl(stmts, brk, cont)
+        if res is None:
+            return None
+        new, used_b, used_c = res
+        if not (used_b or used_c):
+            # the blockage belongs to NESTED loops (their own
+            # break/continue): nothing to mask here — convert this
+            # loop normally; rewrite_body lowers the inner loops
+            return stmts, None, None
+        if used_c:
+            # continue-flag resets at the top of EVERY iteration
+            new = [_assign_flag(cont, False)] + new
+        return new, (brk if used_b else None), (cont if used_c else None)
+
+    def _loop_pre_inits(self, carried, bound, flag_names):
         pre: List[ast.stmt] = []
         for t in carried:
-            if t not in bound:
+            if t in flag_names:
+                pre.append(_assign_flag(t, False))
+            elif t not in bound:
                 pre.append(ast.Assign(
                     targets=[_name(t, ast.Store())],
                     value=ast.Call(
                         func=_name("__pt_undef", ast.Load()),
                         args=[ast.Constant(value=t)], keywords=[])))
+        return pre
+
+    def _keep_plain(self, node, bound):
+        """Leave the loop as plain python but still rewrite its body so
+        nested convertible ifs/loops compile (the pre-flag-lowering code
+        reached these through rewrite_body's fallthrough branch)."""
+        node.body = self.rewrite_body(node.body, set(bound))
+        return [node]
+
+    def _rewrite_while(self, node: ast.While,
+                      bound: Set[str]) -> List[ast.stmt]:
+        body_src = node.body
+        brk_name = cont_name = None
+        if _blocked(node.body):
+            low = self._lower_flags(node.body)
+            if low is None:
+                # return/yield or opaque break: plain python loop
+                return self._keep_plain(node, bound)
+            body_src, brk_name, cont_name = low
+        self.uid += 1
+        k = self.uid
+        carried = sorted(_assigned_names(body_src))
+        if not carried:
+            # nothing loop-carried: plain python loop
+            return self._keep_plain(node, bound)
+        # carried names are body-fn PARAMS — bound at body entry (flags
+        # are pre-initialized to False; without this an if that only
+        # assigns a flag would wrongly sentinel-init it)
+        body = self.rewrite_body(body_src, set(bound) | set(carried))
+        flag_names = {n for n in (brk_name, cont_name) if n}
+        pre = self._loop_pre_inits(carried, bound, flag_names)
         cf = self._fn_def(f"__pt_cond_{k}", carried,
                           [], [])  # placeholder, replaced below
         cf.body = [ast.Return(value=node.test)]
         bf = self._fn_def(f"__pt_body_{k}", carried, body, carried)
+        kw = []
+        if brk_name is not None:
+            kw.append(ast.keyword(
+                arg="brk_idx",
+                value=ast.Constant(value=carried.index(brk_name))))
         call = ast.Call(
             func=_name("__pt_run_while", ast.Load()),
             args=[_name(cf.name, ast.Load()), _name(bf.name, ast.Load()),
-                  _tuple_of(carried, ast.Load())], keywords=[])
+                  _tuple_of(carried, ast.Load())], keywords=kw)
         assign = ast.Assign(targets=[_tuple_of(carried, ast.Store())],
                             value=call)
         self.count += 1
@@ -569,22 +825,30 @@ class _Rewriter:
         while_loop on a traced bound); `for t in seq` ->
         __pt_run_for_iter (static trip count over tensors). Parity:
         reference loop_transformer.py:111-138 converts both forms."""
+        body_src = node.body
+        brk_name = cont_name = None
+        if _blocked(node.body):
+            low = self._lower_flags(node.body)
+            if low is None:
+                # return/yield or opaque break: plain python loop
+                return self._keep_plain(node, bound)
+            body_src, brk_name, cont_name = low
         self.uid += 1
         k = self.uid
         tname = node.target.id
-        body = self.rewrite_body(node.body, set(bound) | {tname})
-        carried = sorted(_assigned_names(node.body) - {tname})
-        pre: List[ast.stmt] = []
-        for t in [tname] + carried:
-            if t not in bound:
-                pre.append(ast.Assign(
-                    targets=[_name(t, ast.Store())],
-                    value=ast.Call(
-                        func=_name("__pt_undef", ast.Load()),
-                        args=[ast.Constant(value=t)], keywords=[])))
+        carried = sorted(_assigned_names(body_src) - {tname})
+        body = self.rewrite_body(body_src,
+                                 set(bound) | {tname} | set(carried))
+        flag_names = {n for n in (brk_name, cont_name) if n}
+        pre = self._loop_pre_inits([tname] + carried, bound, flag_names)
         bf = self._fn_def(f"__pt_forbody_{k}", [tname] + carried, body,
                           [tname] + carried)
         loop_vars = _tuple_of([tname] + carried, ast.Load())
+        kw = []
+        if brk_name is not None:
+            kw.append(ast.keyword(
+                arg="brk_idx",
+                value=ast.Constant(value=carried.index(brk_name))))
         it = node.iter
         if isinstance(it, ast.Call) and isinstance(it.func, ast.Name) \
                 and it.func.id == "range" and not it.keywords \
@@ -600,12 +864,12 @@ class _Rewriter:
             call = ast.Call(
                 func=_name("__pt_run_for_range", ast.Load()),
                 args=[start, stop, step, _name(bf.name, ast.Load()),
-                      loop_vars], keywords=[])
+                      loop_vars], keywords=kw)
         else:
             call = ast.Call(
                 func=_name("__pt_run_for_iter", ast.Load()),
                 args=[it, _name(bf.name, ast.Load()), loop_vars],
-                keywords=[])
+                keywords=kw)
         assign = ast.Assign(
             targets=[_tuple_of([tname] + carried, ast.Store())],
             value=call)
@@ -660,6 +924,7 @@ def _convert(fn):
     namespace["__pt_run_for_range"] = _run_for_range
     namespace["__pt_run_for_iter"] = _run_for_iter
     namespace["__pt_undef"] = _Undefined
+    namespace["__pt_none_set"] = _none_set
     exec(code, namespace)
     new_fn = namespace[fdef.name]
     functools.update_wrapper(new_fn, func)

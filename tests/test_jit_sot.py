@@ -659,3 +659,190 @@ def test_rng_drawing_range_loop_falls_back_for_fresh_draws():
     assert traced._fallback_count == 1
     np.testing.assert_allclose(np.asarray(out._data),
                                np.asarray(eager._data), rtol=1e-6)
+
+
+# ------------------------------------------------- break/continue lowering
+def test_while_with_break_compiles_and_matches_eager():
+    """`break` under a traced predicate lowers to a masked flag folded
+    into the while_loop condition (no eager fallback)."""
+    def fn(x):
+        s = x * 0.0
+        while s.sum() < 100.0:
+            s = s + x
+            if s.sum() > 2.5:
+                break
+        return s
+
+    xe = paddle.to_tensor(np.ones(2, np.float32))
+    ref = fn(xe)
+    traced = paddle.jit.to_static(fn)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        out = traced(xe)
+    assert any("AST-converted" in str(w.message) for w in caught)
+    assert traced._fallback_count == 0
+    np.testing.assert_allclose(np.asarray(out._data), np.asarray(ref._data))
+    np.testing.assert_allclose(np.asarray(out._data), 2 * np.ones(2))
+
+
+def test_for_range_with_break_compiles_and_keeps_target():
+    """Traced-bound `for` with break: the loop compiles, stops early,
+    and the post-loop target holds its break-iteration value."""
+    def fn(x, n):
+        s = x * 0.0
+        i = -1
+        for i in range(n):
+            s = s + x
+            if s.sum() > 2.5:
+                break
+        return s, i
+
+    xe = paddle.to_tensor(np.ones(2, np.float32))
+    s_ref, i_ref = fn(xe, 10)
+    traced = paddle.jit.to_static(fn)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        s_t, i_t = traced(xe, paddle.to_tensor(10))
+    assert traced._fallback_count == 0
+    np.testing.assert_allclose(np.asarray(s_t._data),
+                               np.asarray(s_ref._data))
+    assert int(np.asarray(getattr(i_t, "_data", i_t))) == i_ref == 1
+
+
+def test_for_range_with_continue_compiles_and_matches_eager():
+    def fn(x, n):
+        s = x * 0.0
+        for i in range(n):
+            if i % 2 == 0:
+                continue
+            s = s + x
+        return s
+
+    xe = paddle.to_tensor(np.ones(2, np.float32))
+    ref = fn(xe, 6)
+    traced = paddle.jit.to_static(fn)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        out = traced(xe, paddle.to_tensor(6))
+    assert traced._fallback_count == 0
+    np.testing.assert_allclose(np.asarray(out._data), np.asarray(ref._data))
+    np.testing.assert_allclose(np.asarray(out._data), 3 * np.ones(2))
+
+
+def test_nested_loop_break_binds_inner_loop():
+    def fn(x, n):
+        s = x * 0.0
+        for i in range(n):
+            for j in range(3):
+                s = s + x
+                if j >= 1:
+                    break
+        return s
+
+    xe = paddle.to_tensor(np.ones(2, np.float32))
+    ref = fn(xe, 2)
+    traced = paddle.jit.to_static(fn)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        out = traced(xe, paddle.to_tensor(2))
+    assert traced._fallback_count == 0
+    np.testing.assert_allclose(np.asarray(out._data), np.asarray(ref._data))
+    np.testing.assert_allclose(np.asarray(out._data), 4 * np.ones(2))
+
+
+def test_while_with_continue_compiles_and_matches_eager():
+    def fn(x):
+        s = x * 0.0
+        t = x * 0.0
+        while s.sum() < 6.0:
+            s = s + x
+            if s.sum() < 3.0:
+                continue
+            t = t + x
+        return t
+
+    xe = paddle.to_tensor(np.ones(2, np.float32))
+    ref = fn(xe)
+    traced = paddle.jit.to_static(fn)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        out = traced(xe)
+    assert traced._fallback_count == 0
+    np.testing.assert_allclose(np.asarray(out._data), np.asarray(ref._data))
+
+
+def test_unrolled_tensor_iter_break_falls_back_correctly():
+    """A traced break flag cannot stop a host-unrolled loop; the runner
+    must raise to the eager fallback (NOT silently keep accumulating —
+    the masked tail only guards the setting iteration)."""
+    def fn(seq):
+        s = seq[0] * 0.0
+        for v in seq:
+            s = s + v
+            if s.sum() > 2.5:
+                break
+        return s
+
+    seq = paddle.to_tensor(np.ones((6, 2), np.float32))
+    ref = fn(seq)
+    np.testing.assert_allclose(np.asarray(ref._data), 2 * np.ones(2))
+    traced = paddle.jit.to_static(fn)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        out = traced(seq)
+    np.testing.assert_allclose(np.asarray(out._data), np.asarray(ref._data))
+    assert traced._fallback_count == 1   # eager keeps break semantics
+
+
+def test_blocked_loop_body_still_converts_inner_if():
+    """A loop left as plain python (return in body) must still have its
+    INNER traced if converted, so the function compiles overall."""
+    def fn(x):
+        n = 0
+        while n < 3:
+            if x.sum() > 0.0:
+                x = x * 2.0
+            else:
+                x = x - 1.0
+            n += 1
+            if n >= 3:
+                return x
+        return x
+
+    traced = paddle.jit.to_static(fn)
+    xe = paddle.to_tensor(np.ones(2, np.float32))
+    ref = fn(xe)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        out = traced(xe)
+    np.testing.assert_allclose(np.asarray(out._data), np.asarray(ref._data))
+    assert traced._fallback_count == 0
+
+
+def test_nested_loop_else_break_binds_outer_and_stays_python():
+    """A break in a nested for's ELSE clause binds the ENCLOSING loop;
+    the outer loop must not convert (the orphaned break would be a
+    SyntaxError in extracted code) — and sibling convertible ifs must
+    keep converting."""
+    def fn(x):
+        s = x * 0.0
+        n = 0
+        while n < 5:
+            n += 1
+            for j in range(2):
+                s = s + x
+            else:
+                break
+        if x.sum() > 0.0:          # sibling if: must still convert
+            s = s * 2.0
+        return s
+
+    xe = paddle.to_tensor(np.ones(2, np.float32))
+    ref = fn(xe)
+    np.testing.assert_allclose(np.asarray(ref._data), 4 * np.ones(2))
+    traced = paddle.jit.to_static(fn)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        out = traced(xe)
+    np.testing.assert_allclose(np.asarray(out._data), np.asarray(ref._data))
+    assert traced._fallback_count == 0
