@@ -224,7 +224,7 @@ def _run_for_range(start, stop, step, body_fn, loop_vars, brk_idx=None):
         while (i < st) if sp > 0 else (i > st):
             if brk_idx is not None:
                 bf = carried[brk_idx]
-                if traced(getattr(bf, "_data", bf)):
+                if traced(bf):
                     # only the masked TAIL of the setting iteration is
                     # guarded; statements before the flag check would
                     # keep executing in a host loop the flag cannot
@@ -394,7 +394,11 @@ def _run_for_iter(seq, body_fn, loop_vars, brk_idx=None):
                  for j in range(start, seq.shape[0]))
     else:
         items = iter(seq)
-    for item in items:
+    while True:
+        # flag check BEFORE pulling the next item: python's `break`
+        # does not advance the iterator again, and an extra next()
+        # would run stateful-iterator side effects / over-advance a
+        # generator the caller keeps using
         if brk_idx is not None:
             bf = carried[brk_idx]
             if _tr(bf):
@@ -406,6 +410,10 @@ def _run_for_iter(seq, body_fn, loop_vars, brk_idx=None):
                     "using the eager fallback")
             if _to_bool(bf):
                 break       # exact python semantics for a concrete flag
+        try:
+            item = next(items)
+        except StopIteration:
+            break
         out = body_fn(item, *carried)
         tgt, carried = out[0], tuple(out[1:])
     return (tgt,) + carried

@@ -846,3 +846,102 @@ def test_nested_loop_else_break_binds_outer_and_stays_python():
         out = traced(xe)
     np.testing.assert_allclose(np.asarray(out._data), np.asarray(ref._data))
     assert traced._fallback_count == 0
+
+
+def test_break_and_continue_same_body_compiles():
+    def fn(x, n):
+        s = x * 0.0
+        t = x * 0.0
+        for i in range(n):
+            s = s + x
+            if s.sum() < 3.0:
+                continue
+            if s.sum() > 6.5:
+                break
+            t = t + x
+        return s, t
+
+    xe = paddle.to_tensor(np.ones(2, np.float32))
+    s_ref, t_ref = fn(xe, 10)
+    traced = paddle.jit.to_static(fn)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        s_t, t_t = traced(xe, paddle.to_tensor(10))
+    assert traced._fallback_count == 0
+    np.testing.assert_allclose(np.asarray(s_t._data),
+                               np.asarray(s_ref._data))
+    np.testing.assert_allclose(np.asarray(t_t._data),
+                               np.asarray(t_ref._data))
+
+
+def test_break_in_else_branch_and_masked_tail():
+    """break in the ELSE branch; the statement AFTER the if must be
+    masked once the flag is set (tail-guard correctness)."""
+    def fn(x, n):
+        s = x * 0.0
+        post = x * 0.0
+        for i in range(n):
+            if s.sum() < 2.5:
+                s = s + x
+            else:
+                break
+            post = post + x        # must NOT run on the break iteration
+        return s, post
+
+    xe = paddle.to_tensor(np.ones(2, np.float32))
+    s_ref, p_ref = fn(xe, 10)
+    traced = paddle.jit.to_static(fn)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        s_t, p_t = traced(xe, paddle.to_tensor(10))
+    assert traced._fallback_count == 0
+    np.testing.assert_allclose(np.asarray(s_t._data),
+                               np.asarray(s_ref._data))
+    np.testing.assert_allclose(np.asarray(p_t._data),
+                               np.asarray(p_ref._data))
+
+
+def test_generator_break_does_not_over_advance_iterator():
+    """python's break does not pull another item; the converted runner
+    must not either (stateful iterators / generator side effects).
+    Tested against the runner directly — through to_static, failed
+    trace attempts legitimately re-instantiate the generator."""
+    from paddle_tpu.jit.dy2static import _run_for_iter
+
+    pulled = []
+
+    def gen():
+        for j in range(6):
+            pulled.append(j)
+            yield float(j)
+
+    def body(item, s, brk):
+        s = s + item
+        return item, s, (s >= 3.0)
+
+    tgt, s, brk = _run_for_iter(gen(), body, (None, 0.0, False), brk_idx=1)
+    assert s == 3.0                  # 0+1+2
+    assert pulled == [0, 1, 2]       # no extra next() after the break
+
+
+def test_concrete_range_traced_break_flag_falls_back():
+    """A traced break predicate inside a CONCRETE-bound for must raise
+    to the eager fallback (the host loop can't be stopped by a traced
+    flag; silently continuing would corrupt the accumulation)."""
+    def fn(x):
+        s = x * 0.0
+        for i in range(10):
+            s = s + x
+            if s.sum() > 2.5:
+                break
+        return s
+
+    xe = paddle.to_tensor(np.ones(2, np.float32))
+    ref = fn(xe)
+    np.testing.assert_allclose(np.asarray(ref._data), 2 * np.ones(2))
+    traced = paddle.jit.to_static(fn)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        out = traced(xe)
+    np.testing.assert_allclose(np.asarray(out._data), np.asarray(ref._data))
+    assert traced._fallback_count == 1
