@@ -651,6 +651,83 @@ def _mask_ctrl(stmts, brk, cont):
     return out, used_b, used_c
 
 
+_MUTATOR_METHODS = {
+    "append", "extend", "insert", "remove", "clear", "sort", "reverse",
+    "discard", "update", "setdefault", "popitem", "appendleft",
+    "popleft", "pop",
+}
+
+
+def _has_uncarried_mutation(stmts, carried: Set[str]) -> bool:
+    """True when a loop body mutates python-level state that is NOT
+    loop-carried: container mutator methods (lst.append, d.update, ...),
+    paddle in-place tensor ops (trailing underscore: add_, clip_, ...),
+    and subscript/attribute stores whose base name isn't carried. A
+    compiled loop traces its body ONCE, so such mutations would run
+    once instead of per-iteration — silently diverging from eager
+    (measured: 5 eager appends vs 2 under the old conversion). Carried
+    names are safe: their updates flow functionally through the carry
+    (and non-jax carried types fail while_loop into the eager
+    fallback)."""
+    found = [False]
+
+    def base_name(n):
+        while isinstance(n, (ast.Subscript, ast.Attribute)):
+            n = n.value
+        return n.id if isinstance(n, ast.Name) else None
+
+    class V(ast.NodeVisitor):
+        def visit_Call(self, node):
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                mut = f.attr in _MUTATOR_METHODS or (
+                    f.attr.endswith("_") and not f.attr.endswith("__"))
+                if mut and base_name(f.value) not in carried:
+                    found[0] = True
+            elif isinstance(f, ast.Name) and f.id in ("setattr", "delattr"):
+                found[0] = True
+            self.generic_visit(node)
+
+        def _store_target(self, t):
+            if isinstance(t, (ast.Subscript, ast.Attribute)):
+                if base_name(t) not in carried:
+                    found[0] = True
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    self._store_target(e)
+            elif isinstance(t, ast.Starred):
+                self._store_target(t.value)
+
+        def visit_Assign(self, node):
+            for t in node.targets:
+                self._store_target(t)
+            self.generic_visit(node)
+
+        def visit_AnnAssign(self, node):
+            self._store_target(node.target)
+            self.generic_visit(node)
+
+        def visit_AugAssign(self, node):
+            self._store_target(node.target)
+            self.generic_visit(node)
+
+        def visit_Delete(self, node):
+            for t in node.targets:
+                self._store_target(t)
+            self.generic_visit(node)
+
+        def visit_FunctionDef(self, node):
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+        visit_Lambda = visit_FunctionDef
+        visit_ClassDef = visit_FunctionDef
+
+    for s in stmts:
+        V().visit(s)
+    return found[0]
+
+
 def _name(id_, ctx):
     return ast.Name(id=id_, ctx=ctx)
 
@@ -803,6 +880,13 @@ class _Rewriter:
         if not carried:
             # nothing loop-carried: plain python loop
             return self._keep_plain(node, bound)
+        if _has_uncarried_mutation(body_src, set(carried)) \
+                or _has_uncarried_mutation(
+                    [ast.Expr(value=node.test)], set(carried)):
+            # trace-once conversion would run the mutation once, not
+            # per-iteration — plain python keeps eager semantics (the
+            # TEST is also per-iteration code: `while stack.pop():`)
+            return self._keep_plain(node, bound)
         # carried names are body-fn PARAMS — bound at body entry (flags
         # are pre-initialized to False; without this an if that only
         # assigns a flag would wrongly sentinel-init it)
@@ -845,6 +929,10 @@ class _Rewriter:
         k = self.uid
         tname = node.target.id
         carried = sorted(_assigned_names(body_src) - {tname})
+        if _has_uncarried_mutation(body_src, set(carried) | {tname}):
+            # see _rewrite_while: mutations of non-carried state must
+            # keep plain-python per-iteration semantics
+            return self._keep_plain(node, bound)
         body = self.rewrite_body(body_src,
                                  set(bound) | {tname} | set(carried))
         flag_names = {n for n in (brk_name, cont_name) if n}

@@ -945,3 +945,70 @@ def test_concrete_range_traced_break_flag_falls_back():
         out = traced(xe)
     np.testing.assert_allclose(np.asarray(out._data), np.asarray(ref._data))
     assert traced._fallback_count == 1
+
+
+def test_uncarried_container_mutation_keeps_eager_semantics():
+    """A loop body mutating a non-carried container (out.append) must
+    NOT be trace-once converted — python semantics (one append per
+    iteration) win over compilation."""
+    def fn(x, n):
+        out = []
+        s = x * 0.0
+        for i in range(n):
+            s = s + x
+            out.append(1)
+        return s, len(out)
+
+    xe = paddle.to_tensor(np.ones(2, np.float32))
+    s_ref, n_ref = fn(xe, 5)
+    assert n_ref == 5
+    traced = paddle.jit.to_static(fn)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        s_t, n_t = traced(xe, paddle.to_tensor(5))
+    np.testing.assert_allclose(np.asarray(s_t._data),
+                               np.asarray(s_ref._data))
+    assert int(np.asarray(getattr(n_t, "_data", n_t))) == 5
+
+
+def test_uncarried_subscript_store_keeps_eager_semantics():
+    def fn(x, n):
+        buf = [None] * 10
+        s = x * 0.0
+        for i in range(n):
+            s = s + x
+            buf[i] = 1
+        return s, sum(v or 0 for v in buf)
+
+    xe = paddle.to_tensor(np.ones(2, np.float32))
+    s_ref, c_ref = fn(xe, 4)
+    assert c_ref == 4
+    traced = paddle.jit.to_static(fn)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        s_t, c_t = traced(xe, paddle.to_tensor(4))
+    np.testing.assert_allclose(np.asarray(s_t._data),
+                               np.asarray(s_ref._data))
+    assert int(np.asarray(getattr(c_t, "_data", c_t))) == 4
+
+
+def test_mutating_while_condition_keeps_eager_semantics():
+    """`while stack.pop():`-style conditions run per iteration; the
+    conversion must not trace them once."""
+    def fn(x):
+        stack = [0, 1, 1, 1]
+        s = x * 0.0
+        while stack.pop():
+            s = s + x
+        return s, len(stack)
+
+    xe = paddle.to_tensor(np.ones(2, np.float32))
+    s_ref, n_ref = fn(xe)
+    assert n_ref == 0
+    traced = paddle.jit.to_static(fn)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        s_t, n_t = traced(xe)
+    np.testing.assert_allclose(np.asarray(s_t._data),
+                               np.asarray(s_ref._data))
+    assert int(np.asarray(getattr(n_t, "_data", n_t))) == 0
