@@ -651,6 +651,81 @@ def _mask_ctrl(stmts, brk, cont):
     return out, used_b, used_c
 
 
+def _if_contains_return(st) -> bool:
+    """Return directly in an If's branches (recursing through nested
+    Ifs only — returns inside loops/try/with are NOT this pass's
+    business)."""
+    if not isinstance(st, ast.If):
+        return False
+    for stmts in (st.body, st.orelse):
+        for s in stmts:
+            if isinstance(s, ast.Return) or _if_contains_return(s):
+                return True
+    return False
+
+
+def _lower_returns(stmts, cont, rv):
+    """Single-exit lowering for returns under IF statements: the
+    classic continuation-into-branches transform (parity: the
+    reference's return transformer,
+    jit/dy2static/transformers/return_transformer.py) —
+
+        if p: return a          if p: rv = a
+        REST            ==>     else: REST'
+                                (fn ends with `return rv`)
+
+    `cont` is the ALREADY-LOWERED continuation (what runs if control
+    falls through `stmts`); a Return terminates its path with an
+    rv-assign and drops the continuation, and a return-bearing If
+    pushes the continuation into each non-terminal branch (deep-copied
+    — the rewriter later mutates statements in place, so branches must
+    not share AST nodes). Statements containing returns this pass
+    cannot lift (loops, try/with) pass through verbatim: their returns
+    still execute as real python returns, making the trailing
+    `return rv` simply unreachable on those paths."""
+    import copy
+
+    out = []
+    for i, st in enumerate(stmts):
+        if isinstance(st, ast.Return):
+            out.append(ast.Assign(
+                targets=[_name(rv, ast.Store())],
+                value=st.value if st.value is not None
+                else ast.Constant(value=None)))
+            return out                # continuation dropped: path done
+        if _if_contains_return(st):
+            k = _lower_returns(stmts[i + 1:], cont, rv)
+            nt = _lower_returns(st.body, k, rv)
+            nf = _lower_returns(st.orelse, copy.deepcopy(k), rv)
+            out.append(ast.If(test=st.test,
+                              body=nt or [ast.Pass()], orelse=nf))
+            return out
+        out.append(st)
+    out.extend(cont)
+    return out
+
+
+def _maybe_single_exit(fdef) -> bool:
+    """Apply _lower_returns to a function body when (and only when)
+    some If contains a return — the pattern that otherwise forces the
+    eager fallback for traced predicates. Mutates fdef in place;
+    True if transformed."""
+
+    def has_candidate(stmts):
+        return any(_if_contains_return(s) for s in stmts)
+
+    if not has_candidate(fdef.body):
+        return False
+    rv = "__pt_rv"
+    new = _lower_returns(fdef.body, [], rv)
+    fdef.body = (
+        [ast.Assign(targets=[_name(rv, ast.Store())],
+                    value=ast.Constant(value=None))]
+        + new
+        + [ast.Return(value=_name(rv, ast.Load()))])
+    return True
+
+
 _MUTATOR_METHODS = {
     "append", "extend", "insert", "remove", "clear", "sort", "reverse",
     "discard", "update", "setdefault", "popitem", "appendleft",
@@ -736,6 +811,29 @@ def _tuple_of(names: List[str], ctx):
     return ast.Tuple(elts=[_name(n, ctx) for n in names], ctx=ctx)
 
 
+def _live_read_names(stmts) -> Set[str]:
+    """Over-approximate liveness reads: EVERY Name load, including
+    inside nested function/lambda bodies (unlike _read_names, which
+    models direct-scope reads for the captured-defaults machinery —
+    liveness must see closure reads too or it would prune a name a
+    nested def still needs)."""
+    names: Set[str] = set()
+    for s in stmts:
+        for n in ast.walk(s):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                names.add(n.id)
+            elif isinstance(n, ast.AugAssign) and isinstance(
+                    n.target, ast.Name):
+                # `y += 1` requires y bound — a liveness USE even
+                # though the target ctx is Store
+                names.add(n.target.id)
+            elif isinstance(n, ast.Delete):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+    return names
+
+
 class _Rewriter:
     """Statement-list rewriter tracking which names are bound so far (to
     know when a branch-assigned name needs an undefined-sentinel init)."""
@@ -744,11 +842,19 @@ class _Rewriter:
         self.count = 0
         self.uid = 0
 
-    def rewrite_body(self, stmts, bound: Set[str]) -> List[ast.stmt]:
+    def rewrite_body(self, stmts, bound: Set[str],
+                     live_after: Set[str] = frozenset()) -> List[ast.stmt]:
         out: List[ast.stmt] = []
-        for st in stmts:
+        # names live AFTER each statement: one backward accumulation
+        # (recomputing reads of every suffix would be O(n^2) AST walks)
+        suffix = [set(live_after)]
+        for st in reversed(stmts[1:] if stmts else []):
+            suffix.append(suffix[-1] | _live_read_names([st]))
+        suffix.reverse()
+        for i, st in enumerate(stmts):
+            live = suffix[i]
             if isinstance(st, ast.If) and not _blocked(st.body + st.orelse):
-                out.extend(self._rewrite_if(st, bound))
+                out.extend(self._rewrite_if(st, bound, live))
             elif isinstance(st, ast.While) and not st.orelse:
                 # bodies with break/continue are lowered to masked flags
                 # inside _rewrite_while; return/yield (or flags in
@@ -758,13 +864,19 @@ class _Rewriter:
                     and isinstance(st.target, ast.Name):
                 out.extend(self._rewrite_for(st, bound))
             else:
-                # recurse into compound statements' bodies in place
+                # recurse into compound statements' bodies in place.
+                # Sibling fields of the SAME statement (while/for else,
+                # try handlers/finally) run after the field being
+                # rewritten, so their reads must join the liveness —
+                # over-approximate with the whole statement's reads
+                live_in_st = live | _live_read_names([st])
                 for field in ("body", "orelse", "finalbody"):
                     sub = getattr(st, field, None)
                     if sub and not isinstance(
                             st, (ast.FunctionDef, ast.AsyncFunctionDef,
                                  ast.ClassDef)):
-                        setattr(st, field, self.rewrite_body(sub, bound))
+                        setattr(st, field,
+                                self.rewrite_body(sub, bound, live_in_st))
                 out.append(st)
             bound |= _assigned_names([st])
         return out
@@ -787,27 +899,37 @@ class _Rewriter:
         return ast.FunctionDef(name=fname, args=args, body=body,
                                decorator_list=[], returns=None)
 
-    def _rewrite_if(self, node: ast.If, bound: Set[str]) -> List[ast.stmt]:
+    def _rewrite_if(self, node: ast.If, bound: Set[str],
+                    live: Set[str] = frozenset()) -> List[ast.stmt]:
         self.uid += 1
         k = self.uid
-        body = self.rewrite_body(node.body, set(bound))
-        orelse = self.rewrite_body(node.orelse, set(bound)) if node.orelse \
-            else [ast.Pass()]
-        targets = sorted(_assigned_names(node.body)
-                         | _assigned_names(node.orelse))
+        targets_all = _assigned_names(node.body) | _assigned_names(
+            node.orelse)
+        # LIVENESS PRUNING: only names read after the if join the
+        # select — a branch-local temp assigned in one branch would
+        # otherwise force a select against an undefined sentinel and
+        # fail the whole conversion (the single-exit return lowering
+        # produces exactly that shape: rv assigned in every path, the
+        # temp dead after)
+        targets = sorted(t for t in targets_all if t in live)
+        body = self.rewrite_body(node.body, set(bound),
+                                 set(targets) | set(live))
+        orelse = self.rewrite_body(node.orelse, set(bound),
+                                   set(targets) | set(live)) \
+            if node.orelse else [ast.Pass()]
+        # names a branch reads AND a branch assigns: must enter as
+        # captured default params (see _fn_def); the sentinel inits
+        # below guarantee the default expression is evaluable
+        reads = _read_names(node.body) | _read_names(node.orelse)
+        captured = sorted(reads & targets_all)
         pre: List[ast.stmt] = []
-        for t in targets:
+        for t in sorted(set(targets) | set(captured)):
             if t not in bound:
                 pre.append(ast.Assign(
                     targets=[_name(t, ast.Store())],
                     value=ast.Call(
                         func=_name("__pt_undef", ast.Load()),
                         args=[ast.Constant(value=t)], keywords=[])))
-        # names a branch reads AND a branch assigns: must enter as
-        # captured default params (see _fn_def); the sentinel inits above
-        # guarantee the default expression is evaluable
-        reads = _read_names(node.body) | _read_names(node.orelse)
-        captured = sorted(reads & set(targets))
         tf = self._fn_def(f"__pt_true_{k}", [], body, targets,
                           default_params=captured)
         ff = self._fn_def(f"__pt_false_{k}", [], orelse, targets,
@@ -860,8 +982,12 @@ class _Rewriter:
     def _keep_plain(self, node, bound):
         """Leave the loop as plain python but still rewrite its body so
         nested convertible ifs/loops compile (the pre-flag-lowering code
-        reached these through rewrite_body's fallthrough branch)."""
-        node.body = self.rewrite_body(node.body, set(bound))
+        reached these through rewrite_body's fallthrough branch). The
+        after-loop liveness is unknown here, so over-approximate with
+        everything the loop reads or assigns (pruning less only costs
+        select width, never correctness)."""
+        live = _live_read_names([node]) | _assigned_names(node.body)
+        node.body = self.rewrite_body(node.body, set(bound), live)
         return [node]
 
     def _rewrite_while(self, node: ast.While,
@@ -889,8 +1015,12 @@ class _Rewriter:
             return self._keep_plain(node, bound)
         # carried names are body-fn PARAMS — bound at body entry (flags
         # are pre-initialized to False; without this an if that only
-        # assigns a flag would wrongly sentinel-init it)
-        body = self.rewrite_body(body_src, set(bound) | set(carried))
+        # assigns a flag would wrongly sentinel-init it). live_after:
+        # every carried name feeds the next iteration / the result
+        # tuple, plus anything the body itself reads
+        body = self.rewrite_body(
+            body_src, set(bound) | set(carried),
+            set(carried) | _live_read_names(body_src))
         flag_names = {n for n in (brk_name, cont_name) if n}
         pre = self._loop_pre_inits(carried, bound, flag_names)
         cf = self._fn_def(f"__pt_cond_{k}", carried,
@@ -933,8 +1063,9 @@ class _Rewriter:
             # see _rewrite_while: mutations of non-carried state must
             # keep plain-python per-iteration semantics
             return self._keep_plain(node, bound)
-        body = self.rewrite_body(body_src,
-                                 set(bound) | {tname} | set(carried))
+        body = self.rewrite_body(
+            body_src, set(bound) | {tname} | set(carried),
+            {tname} | set(carried) | _live_read_names(body_src))
         flag_names = {n for n in (brk_name, cont_name) if n}
         pre = self._loop_pre_inits([tname] + carried, bound, flag_names)
         bf = self._fn_def(f"__pt_forbody_{k}", [tname] + carried, body,
@@ -994,6 +1125,10 @@ def _convert(fn):
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         return None
     fdef.decorator_list = []
+    # single-exit lowering FIRST: ifs that return become rv-assigning
+    # ifs the rewriter below can convert (traced early returns
+    # otherwise always fall back to eager)
+    _maybe_single_exit(fdef)
     rw = _Rewriter()
     arg_names = {a.arg for a in (fdef.args.posonlyargs + fdef.args.args
                                  + fdef.args.kwonlyargs)}

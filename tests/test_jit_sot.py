@@ -1012,3 +1012,126 @@ def test_mutating_while_condition_keeps_eager_semantics():
     np.testing.assert_allclose(np.asarray(s_t._data),
                                np.asarray(s_ref._data))
     assert int(np.asarray(getattr(n_t, "_data", n_t))) == 0
+
+
+# ----------------------------------------------------- early returns
+def test_traced_early_return_guard_compiles():
+    """`if traced: return a` + trailing return — the single-exit
+    lowering turns it into an rv-selecting cond and COMPILES."""
+    def fn(x):
+        if x.sum() > 0.0:
+            return x * 2.0
+        return x - 1.0
+
+    traced = paddle.jit.to_static(fn)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        pos = traced(paddle.to_tensor(np.ones(2, np.float32)))
+        neg = traced(paddle.to_tensor(-np.ones(2, np.float32)))
+    assert any("AST-converted" in str(w.message) for w in caught)
+    assert traced._fallback_count == 0
+    np.testing.assert_allclose(np.asarray(pos._data), 2 * np.ones(2))
+    np.testing.assert_allclose(np.asarray(neg._data), -2 * np.ones(2))
+
+
+def test_chained_return_guards_compile():
+    def fn(x):
+        if x.sum() > 10.0:
+            return x * 10.0
+        if x.sum() > 0.0:
+            return x + 1.0
+        return x * 0.0
+
+    traced = paddle.jit.to_static(fn)
+    cases = [np.full(2, 20.0, np.float32), np.ones(2, np.float32),
+             -np.ones(2, np.float32)]
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for arr in cases:
+            ref = fn(paddle.to_tensor(arr))
+            out = traced(paddle.to_tensor(arr))
+            np.testing.assert_allclose(np.asarray(out._data),
+                                       np.asarray(ref._data))
+    assert traced._fallback_count == 0
+
+
+def test_return_guard_with_tail_code_compiles():
+    def fn(x):
+        if x.sum() > 0.0:
+            return x * 2.0
+        y = x - 3.0
+        y = y * 2.0
+        return y
+
+    traced = paddle.jit.to_static(fn)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        pos = traced(paddle.to_tensor(np.ones(2, np.float32)))
+        neg = traced(paddle.to_tensor(-np.ones(2, np.float32)))
+    assert traced._fallback_count == 0
+    np.testing.assert_allclose(np.asarray(pos._data), 2 * np.ones(2))
+    np.testing.assert_allclose(np.asarray(neg._data), -8 * np.ones(2))
+
+
+def test_partial_return_elif_compiles_with_liveness_pruning():
+    """elif chain where one branch returns and another assigns a local
+    temp: liveness pruning drops the dead temp from the cond select,
+    so even this COMPILES (it used to need the eager fallback)."""
+    def fn(x):
+        if x.sum() > 10.0:
+            return x * 10.0
+        elif x.sum() > 0.0:
+            y = x + 1.0
+        else:
+            return x * 0.0
+        y = y * 2.0
+        return y
+
+    traced = paddle.jit.to_static(fn)
+    cases = [np.full(2, 20.0, np.float32), np.ones(2, np.float32),
+             -np.ones(2, np.float32)]
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for arr in cases:
+            ref = fn(paddle.to_tensor(arr))
+            out = traced(paddle.to_tensor(arr))
+            np.testing.assert_allclose(np.asarray(out._data),
+                                       np.asarray(ref._data))
+    assert traced._fallback_count == 0
+
+
+def test_liveness_sees_sibling_fields_and_augassign():
+    """Liveness pruning must count reads in sibling compound fields
+    (while-else) and AugAssign targets as uses — both shapes compiled
+    before pruning existed and must keep compiling."""
+    def f1(x):
+        i = 0
+        while i < 1:
+            if x.sum() > 0:
+                z = x * 2
+            else:
+                z = x - 1
+            i = i + 1
+        else:
+            w = z + 1
+        return w
+
+    def f2(x):
+        acc = x * 0
+        if x.sum() > 0:
+            y = x * 2
+        else:
+            y = x - 1
+        y += 1.0
+        return acc + y
+
+    xe = paddle.to_tensor(np.ones(2, np.float32))
+    for fn in (f1, f2):
+        ref = fn(xe)
+        traced = paddle.jit.to_static(fn)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = traced(xe)
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   np.asarray(ref._data))
+        assert traced._fallback_count == 0
