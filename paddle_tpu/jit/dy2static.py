@@ -110,6 +110,126 @@ def _none_set(*flags):
     return out
 
 
+def _is_tensorish(v):
+    from ..core.tensor import Tensor
+    return isinstance(v, Tensor)
+
+
+def _bool_and(*thunks):
+    """`a and b and ...` in TEST position: python short-circuit for
+    concrete values, tensor logical_and when any operand is a Tensor
+    (no short-circuit across tensor operands — side-effect-free test
+    expressions assumed, like every converted predicate). Returns a
+    truth value (bool or boolean Tensor), not python's last-operand."""
+    acc = None
+    for th in thunks:
+        v = th()
+        if _is_tensorish(v):
+            acc = v if acc is None else _t_and(acc, v)
+        elif not v:
+            return False          # concrete falsy short-circuits all
+    return True if acc is None else acc
+
+
+def _t_or(a, b):
+    """`a or b` (non-short-circuit) for bools and Tensors."""
+    from ..core.tensor import Tensor
+    if isinstance(a, Tensor) or isinstance(b, Tensor):
+        import jax.numpy as jnp
+        ad = a._data if isinstance(a, Tensor) else a
+        bd = b._data if isinstance(b, Tensor) else b
+        return Tensor(jnp.logical_or(ad, bd))
+    return bool(a) or bool(b)
+
+
+def _bool_or(*thunks):
+    acc = None
+    for th in thunks:
+        v = th()
+        if _is_tensorish(v):
+            acc = v if acc is None else _t_or(acc, v)
+        elif v:
+            return True           # concrete truthy short-circuits all
+    return False if acc is None else acc
+
+
+def _bool_not(v):
+    return _t_not(v) if _is_tensorish(v) else (not v)
+
+
+_CHAIN_OPS = {
+    "Lt": lambda a, b: a < b, "LtE": lambda a, b: a <= b,
+    "Gt": lambda a, b: a > b, "GtE": lambda a, b: a >= b,
+    "Eq": lambda a, b: a == b, "NotEq": lambda a, b: a != b,
+    "Is": lambda a, b: a is b, "IsNot": lambda a, b: a is not b,
+    "In": lambda a, b: a in b, "NotIn": lambda a, b: a not in b,
+}
+
+
+def _chain(left_th, *parts):
+    """Chained comparison `a < b < c` in TEST position: each comparator
+    evaluates exactly ONCE (python semantics), pairwise results combine
+    like _bool_and."""
+    prev = left_th()
+    acc = None
+    it = iter(parts)
+    for opname in it:
+        cur = next(it)()
+        r = _CHAIN_OPS[opname](prev, cur)
+        if _is_tensorish(r):
+            acc = r if acc is None else _t_and(acc, r)
+        elif not r:
+            return False
+        prev = cur
+    return True if acc is None else acc
+
+
+_CMP_NAME = {ast.Lt: "Lt", ast.LtE: "LtE", ast.Gt: "Gt", ast.GtE: "GtE",
+             ast.Eq: "Eq", ast.NotEq: "NotEq", ast.Is: "Is",
+             ast.IsNot: "IsNot", ast.In: "In", ast.NotIn: "NotIn"}
+
+
+def _thunk(expr):
+    return ast.Lambda(
+        args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                           kwonlyargs=[], kw_defaults=[], kwarg=None,
+                           defaults=[]),
+        body=expr)
+
+
+def _lower_bool_test(e):
+    """Rewrite a TEST expression so tensor operands stop hitting
+    bool(tracer): and/or/not become lazy helper calls (python
+    short-circuit preserved for concrete operands, logical ops for
+    tensors), multi-op comparison chains become __pt_chain (each
+    comparator still evaluated once). Parity: the reference's
+    convert_logical_and/or/not (jit/dy2static/convert_operators.py).
+
+    Walrus assignments inside the test would become lambda-local and
+    lose their binding — leave such tests untouched (traced operands
+    then fall back to eager, exactly the pre-lowering behavior)."""
+    if any(isinstance(n, ast.NamedExpr) for n in ast.walk(e)):
+        return e
+    if isinstance(e, ast.BoolOp):
+        fname = "__pt_bool_and" if isinstance(e.op, ast.And) \
+            else "__pt_bool_or"
+        return ast.Call(func=_name(fname, ast.Load()),
+                        args=[_thunk(_lower_bool_test(v))
+                              for v in e.values], keywords=[])
+    if isinstance(e, ast.UnaryOp) and isinstance(e.op, ast.Not):
+        return ast.Call(func=_name("__pt_bool_not", ast.Load()),
+                        args=[_lower_bool_test(e.operand)], keywords=[])
+    if isinstance(e, ast.Compare) and len(e.ops) > 1 \
+            and all(type(op) in _CMP_NAME for op in e.ops):
+        args = [_thunk(e.left)]
+        for op, comp in zip(e.ops, e.comparators):
+            args.append(ast.Constant(value=_CMP_NAME[type(op)]))
+            args.append(_thunk(comp))
+        return ast.Call(func=_name("__pt_chain", ast.Load()),
+                        args=args, keywords=[])
+    return e
+
+
 def _run_if(pred, true_fn, false_fn):
     """Runtime helper for rewritten `if`: concrete predicates keep exact
     python semantics; traced predicates lower to static.nn.cond."""
@@ -935,7 +1055,7 @@ class _Rewriter:
         ff = self._fn_def(f"__pt_false_{k}", [], orelse, targets,
                           default_params=captured)
         call = ast.Call(func=_name("__pt_run_if", ast.Load()),
-                        args=[node.test,
+                        args=[_lower_bool_test(node.test),
                               _name(tf.name, ast.Load()),
                               _name(ff.name, ast.Load())], keywords=[])
         if targets:
@@ -1025,7 +1145,7 @@ class _Rewriter:
         pre = self._loop_pre_inits(carried, bound, flag_names)
         cf = self._fn_def(f"__pt_cond_{k}", carried,
                           [], [])  # placeholder, replaced below
-        cf.body = [ast.Return(value=node.test)]
+        cf.body = [ast.Return(value=_lower_bool_test(node.test))]
         bf = self._fn_def(f"__pt_body_{k}", carried, body, carried)
         kw = []
         if brk_name is not None:
@@ -1156,6 +1276,10 @@ def _convert(fn):
     namespace["__pt_run_for_iter"] = _run_for_iter
     namespace["__pt_undef"] = _Undefined
     namespace["__pt_none_set"] = _none_set
+    namespace["__pt_bool_and"] = _bool_and
+    namespace["__pt_bool_or"] = _bool_or
+    namespace["__pt_bool_not"] = _bool_not
+    namespace["__pt_chain"] = _chain
     exec(code, namespace)
     new_fn = namespace[fdef.name]
     functools.update_wrapper(new_fn, func)

@@ -1135,3 +1135,65 @@ def test_liveness_sees_sibling_fields_and_augassign():
         np.testing.assert_allclose(np.asarray(out._data),
                                    np.asarray(ref._data))
         assert traced._fallback_count == 0
+
+
+# ---------------------------------------------- boolean test lowering
+def test_boolop_tensor_predicates_compile():
+    def fn(x):
+        if x.sum() > 0.0 and x.max() < 10.0:
+            return x * 2.0
+        if x.sum() < -10.0 or x.min() < -2.0:
+            return x * 3.0
+        return x - 1.0
+
+    cases = [np.ones(2, np.float32), -np.full(2, 3.0, np.float32),
+             -np.ones(2, np.float32)]
+    traced = paddle.jit.to_static(fn)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for arr in cases:
+            ref = fn(paddle.to_tensor(arr))
+            out = traced(paddle.to_tensor(arr))
+            np.testing.assert_allclose(np.asarray(out._data),
+                                       np.asarray(ref._data))
+    assert traced._fallback_count == 0
+
+
+def test_chained_comparison_tensor_predicate_compiles():
+    def fn(x):
+        if 0.0 < x.sum() < 10.0:
+            return x * 2.0
+        return x - 1.0
+
+    traced = paddle.jit.to_static(fn)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        mid = traced(paddle.to_tensor(np.ones(2, np.float32)))
+        out_ = traced(paddle.to_tensor(np.full(2, 20.0, np.float32)))
+    assert traced._fallback_count == 0
+    np.testing.assert_allclose(np.asarray(mid._data), 2 * np.ones(2))
+    np.testing.assert_allclose(np.asarray(out_._data),
+                               np.full(2, 19.0))
+
+
+def test_not_tensor_predicate_and_mixed_concrete_shortcircuit():
+    """`not traced` lowers to logical_not; a concrete falsy left
+    operand short-circuits exactly like python (the tensor thunk on
+    the right must not even be evaluated)."""
+    evaluated = []
+
+    def fn(x, flag):
+        if not (x.sum() > 0.0):
+            return x * 3.0
+        if flag and evaluated.append(1) is None and x.sum() > 0.0:
+            return x * 2.0
+        return x - 1.0
+
+    traced = paddle.jit.to_static(fn)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        neg = traced(paddle.to_tensor(-np.ones(2, np.float32)), False)
+        pos = traced(paddle.to_tensor(np.ones(2, np.float32)), False)
+    np.testing.assert_allclose(np.asarray(neg._data), -3 * np.ones(2))
+    np.testing.assert_allclose(np.asarray(pos._data), -0 * np.zeros(2))
+    assert evaluated == []          # flag=False short-circuited the rest
