@@ -825,6 +825,35 @@ def _lower_returns(stmts, cont, rv):
     return out
 
 
+class _IfExpLowerer(ast.NodeTransformer):
+    """`a if pred else b` anywhere in the function becomes
+    __pt_run_if(pred', lambda: a, lambda: b): concrete predicates keep
+    exact python semantics (only the taken branch evaluates); traced
+    predicates lower to cond's both-branches-and-select instead of
+    dying at bool(tracer). Ternaries containing walrus assignments are
+    left alone (lambda-wrapping would localize the binding)."""
+
+    def __init__(self):
+        self.count = 0
+
+    def visit_IfExp(self, node):
+        node = self.generic_visit(node)      # innermost-first
+        if any(isinstance(n, (ast.NamedExpr, ast.Yield, ast.YieldFrom,
+                              ast.Await))
+               for n in ast.walk(node)):
+            # walrus would bind lambda-locally; yield inside a lambda
+            # is LEGAL (silently a generator-lambda — corrupting the
+            # enclosing generator); await in a lambda is a
+            # SyntaxError that would kill the whole conversion
+            return node
+        self.count += 1
+        return ast.Call(
+            func=_name("__pt_run_if", ast.Load()),
+            args=[_lower_bool_test(node.test),
+                  _thunk(node.body), _thunk(node.orelse)],
+            keywords=[])
+
+
 def _maybe_single_exit(fdef) -> bool:
     """Apply _lower_returns to a function body when (and only when)
     some If contains a return — the pattern that otherwise forces the
@@ -1245,10 +1274,18 @@ def _convert(fn):
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         return None
     fdef.decorator_list = []
+    if any(isinstance(n, ast.Global) for n in ast.walk(fdef)):
+        # the recompiled copy executes in a COPIED globals dict, so a
+        # `global x` write would update the snapshot, not the module —
+        # silent state divergence (also covers the eager-fallback path,
+        # which permanently runs the copy after a second graph break)
+        return None
     # single-exit lowering FIRST: ifs that return become rv-assigning
     # ifs the rewriter below can convert (traced early returns
     # otherwise always fall back to eager)
     _maybe_single_exit(fdef)
+    ifexp = _IfExpLowerer()
+    ifexp.visit(fdef)
     rw = _Rewriter()
     arg_names = {a.arg for a in (fdef.args.posonlyargs + fdef.args.args
                                  + fdef.args.kwonlyargs)}
@@ -1257,7 +1294,7 @@ def _convert(fn):
     if fdef.args.kwarg:
         arg_names.add(fdef.args.kwarg.arg)
     fdef.body = rw.rewrite_body(fdef.body, set(arg_names))
-    if rw.count == 0:
+    if rw.count == 0 and ifexp.count == 0:
         return None
     ast.fix_missing_locations(tree)
     code = compile(tree, filename=f"<dy2static {func.__name__}>",
@@ -1283,7 +1320,7 @@ def _convert(fn):
     exec(code, namespace)
     new_fn = namespace[fdef.name]
     functools.update_wrapper(new_fn, func)
-    new_fn._dy2static_converted = rw.count
+    new_fn._dy2static_converted = rw.count + ifexp.count
     if bound_self is not None:
         return types.MethodType(new_fn, bound_self)
     return new_fn

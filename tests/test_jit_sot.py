@@ -1197,3 +1197,45 @@ def test_not_tensor_predicate_and_mixed_concrete_shortcircuit():
     np.testing.assert_allclose(np.asarray(neg._data), -3 * np.ones(2))
     np.testing.assert_allclose(np.asarray(pos._data), -0 * np.zeros(2))
     assert evaluated == []          # flag=False short-circuited the rest
+
+
+def test_ternary_traced_predicate_compiles():
+    def fn(x):
+        scale = 2.0 if x.sum() > 0.0 else -1.0
+        shift = (x * 1.5 if x.max() > 0.5 else x * 0.5) if True else x
+        return x * scale + shift
+
+    xe = paddle.to_tensor(np.ones(2, np.float32))
+    ne = paddle.to_tensor(-np.ones(2, np.float32))
+    ref_p, ref_n = fn(xe), fn(ne)
+    traced = paddle.jit.to_static(fn)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        out_p, out_n = traced(xe), traced(ne)
+    assert traced._fallback_count == 0
+    np.testing.assert_allclose(np.asarray(out_p._data),
+                               np.asarray(ref_p._data))
+    np.testing.assert_allclose(np.asarray(out_n._data),
+                               np.asarray(ref_n._data))
+
+
+def test_ternary_concrete_predicate_evaluates_one_branch():
+    """Concrete ternary THROUGH the lowering (a traced ternary in the
+    same function forces conversion): the untaken thunk must never
+    evaluate — exact python semantics."""
+    calls = []
+
+    def fn(x, flag):
+        s = 2.0 if x.sum() > 0.0 else -1.0     # traced: forces convert
+        y = (calls.append("t") or x * s) if flag \
+            else (calls.append("f") or x * 3.0)
+        return y
+
+    xe = paddle.to_tensor(np.ones(2, np.float32))
+    traced = paddle.jit.to_static(fn)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        out = traced(xe, True)
+    assert traced._fallback_count == 0        # converted, not eager
+    np.testing.assert_allclose(np.asarray(out._data), 2 * np.ones(2))
+    assert calls == ["t"]        # untaken branch never evaluated
