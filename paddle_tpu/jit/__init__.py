@@ -9,8 +9,8 @@ replayed after the call, and XLA compiles fwd+bwd+update into one program.
 """
 from .api import to_static, not_to_static, TracedFunction, save, load, functional_call, ignore_module  # noqa: F401
 from .api import (TranslatedLayer, set_code_level, set_verbosity,  # noqa: F401
-                  enable_to_static)
+                  enable_to_static, to_static_report)
 
 __all__ = ["to_static", "not_to_static", "save", "load", "functional_call",
            "TranslatedLayer", "set_code_level", "set_verbosity",
-           "enable_to_static"]
+           "enable_to_static", "to_static_report"]

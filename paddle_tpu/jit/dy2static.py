@@ -57,6 +57,38 @@ class DygraphToStaticBreak(Exception):
     jit/api.py treats it exactly like a jax concretization error."""
 
 
+from collections import Counter  # noqa: E402
+
+# Per-reason fallback observability (VERDICT r4 item 9): every decision
+# that keeps code out of the compiled path increments a named counter —
+# the SOT-gap inventory that makes the cost of eager fallbacks measurable.
+_FALLBACK_COUNTS: Counter = Counter()
+
+
+def fallback_counters():
+    """Snapshot of the per-reason break/decline counters. Reasons:
+    grad-loop, rng-draw, traced-step, break-flag-traced,
+    cond-lower-failed, while-lower-failed, for-lower-failed,
+    scan-declined (a lax.scan lowering attempted but abandoned)."""
+    return dict(_FALLBACK_COUNTS)
+
+
+def reset_fallback_counters():
+    _FALLBACK_COUNTS.clear()
+
+
+def _note(reason):
+    _FALLBACK_COUNTS[reason] += 1
+
+
+def _break(reason, msg):
+    """Count + build (not raise) the break exception, so call sites keep
+    their explicit `raise` and exception chaining."""
+    _FALLBACK_COUNTS[reason] += 1
+    _dy2static_debug_log(f"fallback[{reason}]: {msg}")
+    return DygraphToStaticBreak(msg)
+
+
 class _Undefined:
     __slots__ = ("name",)
 
@@ -238,7 +270,8 @@ def _run_if(pred, true_fn, false_fn):
         try:
             return snn.cond(pred, true_fn, false_fn)
         except Exception as e:  # structure mismatch, undefined var, ...
-            raise DygraphToStaticBreak(
+            raise _break(
+                "cond-lower-failed",
                 f"converted `if` could not lower to cond: {e}") from e
     return true_fn() if _to_bool(pred) else false_fn()
 
@@ -267,17 +300,11 @@ def _rng_fingerprint():
     """Identity fingerprint of every live RNG stream: the global key
     object plus each TP tracker substream's key (draws REBIND the key
     object, so identity change == a draw happened — works for traced
-    keys where value comparison is impossible)."""
-    from ..framework import random as _random
-    fp = [id(_random._global._key)]
-    try:
-        from ..distributed.fleet.mpu import get_rng_state_tracker
-        for name, st in sorted(
-                get_rng_state_tracker().states_.items()):
-            fp.append((name, id(st._key)))
-    except Exception:
-        pass
-    return tuple(fp)
+    keys where value comparison is impossible). The stream enumeration
+    has ONE owner — loop_grad._rng_snapshot — so a stream added there is
+    never missed here (or vice versa)."""
+    from .loop_grad import _rng_snapshot
+    return tuple(id(key) for _st, key in _rng_snapshot())
 
 
 def _probe_body_grads(body_fn, args):
@@ -307,12 +334,14 @@ def _probe_body_grads(body_fn, args):
         # fallback keeps per-iteration draws. Covers the TP tracker
         # substreams too (get_rng_state_tracker().rng_state(...) swaps
         # the global in and out, leaving ITS identity unchanged).
-        raise DygraphToStaticBreak(
+        raise _break(
+            "rng-draw",
             "loop body draws from the RNG; a compiled loop would repeat "
             "one draw — using the eager fallback for per-iteration draws")
     vals = tuple(out) if isinstance(out, (list, tuple)) else (out,)
     if _grad_sensitive(vals):
-        raise DygraphToStaticBreak(
+        raise _break(
+            "grad-loop",
             "loop body produces grad-requiring tensors; while_loop is "
             "forward-only — using the eager fallback so gradients stay "
             "correct")
@@ -341,6 +370,21 @@ def _run_for_range(start, stop, step, body_fn, loop_vars, brk_idx=None):
         raise ValueError("range() arg 3 must not be zero")
     if not (traced(start) or traced(stop) or traced(step)):
         i, st, sp = _to_int(start), _to_int(stop), _to_int(step)
+        if len(range(i, st, sp)) > _ITER_UNROLL_LIMIT:
+            # long concrete-bound loop: try the lax.scan lowering (ONE
+            # compiled op with reverse AD instead of an O(n) unrolled
+            # trace; loop_grad.py). The probe is iteration 0 either way.
+            from .loop_grad import try_scan_range
+            res = try_scan_range(i, st, sp, body_fn, carried, brk_idx)
+            if res[0] == "done":
+                return res[1]
+            _, reason, i, vals = res
+            tgt, carried = vals[0], tuple(vals[1:])
+            if reason is not None:
+                _note(reason if reason == "rng-draw" else "scan-declined")
+                _dy2static_debug_log(
+                    f"for-range scan lowering declined ({reason}); host "
+                    "loop continues from iteration 1")
         while (i < st) if sp > 0 else (i > st):
             if brk_idx is not None:
                 bf = carried[brk_idx]
@@ -349,7 +393,8 @@ def _run_for_range(start, stop, step, body_fn, loop_vars, brk_idx=None):
                     # guarded; statements before the flag check would
                     # keep executing in a host loop the flag cannot
                     # stop — eager is the only correct semantics
-                    raise DygraphToStaticBreak(
+                    raise _break(
+                        "break-flag-traced",
                         "break flag became traced inside a "
                         "concrete-bound for — using the eager fallback")
                 if _to_bool(bf):
@@ -359,11 +404,17 @@ def _run_for_range(start, stop, step, body_fn, loop_vars, brk_idx=None):
             i += sp
         return (tgt,) + carried
     if traced(step):
-        raise DygraphToStaticBreak(
+        raise _break(
+            "traced-step",
             "for-range with a traced step: the loop direction is "
             "data-dependent; rewrite with lax primitives")
     if _grad_sensitive(loop_vars):
-        raise DygraphToStaticBreak(
+        # a traced bound has NO static trip count (it lives in tensor
+        # data, not shapes) — the scan lowering cannot apply; this is
+        # the one loop family that stays eager under grad (see
+        # loop_grad.py module docstring)
+        raise _break(
+            "grad-loop",
             "traced-bound for carries grad-requiring tensors; "
             "while_loop is forward-only — using the eager fallback so "
             "gradients stay correct")
@@ -407,7 +458,8 @@ def _run_for_range(start, stop, step, body_fn, loop_vars, brk_idx=None):
     try:
         res = snn.while_loop(cond, body, [k0, tgt] + list(carried))
     except Exception as e:
-        raise DygraphToStaticBreak(
+        raise _break(
+            "for-lower-failed",
             f"converted `for` could not lower to while_loop: {e}") from e
     return tuple(res[1:])
 
@@ -446,15 +498,17 @@ def _run_for_iter(seq, body_fn, loop_vars, brk_idx=None):
     from ..core.tensor import Tensor
     tgt, carried = loop_vars[0], tuple(loop_vars[1:])
     start = 0
-    if isinstance(seq, Tensor) and seq.shape[0] > _ITER_UNROLL_LIMIT \
-            and not _grad_sensitive((seq,) + tuple(loop_vars)):
+    if isinstance(seq, Tensor) and seq.shape[0] > _ITER_UNROLL_LIMIT:
         # Probe = ITERATION 0, always kept: its python-level side
         # effects (appends, RNG draws) happened exactly once, like
         # eager. The probe's outcome picks the path:
-        #   * body drew from the RNG or produced grad-requiring values
-        #     -> continue UNROLLING from row 1 (per-iteration draws and
-        #     gradients stay correct; while_loop would trace the body
-        #     once / is forward-only);
+        #   * body drew from the RNG -> continue UNROLLING from row 1
+        #     (per-iteration draws stay correct; a compiled loop traces
+        #     the body once);
+        #   * grad-sensitive (the seq, a carry, or a probe output
+        #     requires grad) -> lax.scan lowering with external capture
+        #     (loop_grad.try_scan_iter: ONE taped op with reverse AD);
+        #     a declined lowering unrolls from row 1 instead;
         #   * pure grad-free body -> while_loop over ALL rows (re-running
         #     row 0 inside it is unobservable for a pure body; the
         #     probe's traced ops are DCE'd);
@@ -462,21 +516,37 @@ def _run_for_iter(seq, body_fn, loop_vars, brk_idx=None):
         # Every RNG draw REPLACES its stream's key object
         # (RNGState.next_key rebinds), so the identity fingerprint
         # detects a draw even for traced keys and tracker substreams.
+        from . import loop_grad
+        from ..core import autograd as _ag
         orig = (tgt,) + carried            # pre-probe carries
         rng_before = _rng_fingerprint()
-        out = body_fn(Tensor(seq._data[0]), *carried)  # raises like eager
+        cap = loop_grad._Capture(
+            exclude_ids=[id(v) for v in (seq,) + orig
+                         if isinstance(v, Tensor)])
+        with loop_grad._capturing(cap if _ag.is_grad_enabled() else None):
+            # row via __getitem__ (taped): a raw Tensor(seq._data[0])
+            # wrapper would sever the gradient path into seq for the
+            # probe's iteration
+            out = body_fn(seq[0], *carried)  # raises like eager
         vals = tuple(out) if isinstance(out, (list, tuple)) else (out,)
         tgt, carried = vals[0], tuple(vals[1:])
         start = 1
         drew_rng = _rng_fingerprint() != rng_before
         if drew_rng:
+            _note("rng-draw")
             _dy2static_debug_log(
                 "body draws from the RNG: unrolling keeps per-iteration "
                 "draws")
-        elif _grad_sensitive(vals):
-            _dy2static_debug_log(
-                "body produces grad-requiring values: unrolling "
-                "(while_loop is forward-only)")
+        elif _grad_sensitive((seq,) + orig + vals):
+            res, reason = loop_grad.try_scan_iter(seq, body_fn, vals,
+                                                  cap.externals, brk_idx)
+            if res is not None:
+                return res
+            if reason is not None:
+                _note(reason if reason == "rng-draw" else "scan-declined")
+                _dy2static_debug_log(
+                    f"tensor-iter scan lowering declined ({reason}); "
+                    "unrolling from row 1 keeps gradients correct")
         else:
             try:
                 import jax.numpy as jnp
@@ -510,8 +580,10 @@ def _run_for_iter(seq, body_fn, loop_vars, brk_idx=None):
         return isinstance(getattr(v, "_data", v), _jax.core.Tracer)
 
     if isinstance(seq, Tensor):
-        items = (Tensor(seq._data[j])
-                 for j in range(start, seq.shape[0]))
+        # rows through the op funnel: unrolled iterations must keep the
+        # gradient edge into seq, exactly like python's `for row in t`
+        # (Tensor.__iter__ -> __getitem__)
+        items = (seq[j] for j in range(start, seq.shape[0]))
     else:
         items = iter(seq)
     while True:
@@ -525,7 +597,8 @@ def _run_for_iter(seq, body_fn, loop_vars, brk_idx=None):
                 # an unrolled host loop cannot be stopped by a traced
                 # flag, and only the setting iteration's tail is masked
                 # — eager is the only correct semantics
-                raise DygraphToStaticBreak(
+                raise _break(
+                    "break-flag-traced",
                     "break flag became traced in an unrolled for — "
                     "using the eager fallback")
             if _to_bool(bf):
@@ -558,7 +631,8 @@ def _run_while(cond_fn, body_fn, loop_vars, brk_idx=None):
                 if _is_tracer_tensor(bf):
                     # a traced predicate set the flag mid-loop while the
                     # cond stayed concrete: only eager keeps semantics
-                    raise DygraphToStaticBreak(
+                    raise _break(
+                        "break-flag-traced",
                         "break flag became traced inside a concrete "
                         "while — using the eager fallback")
                 if _to_bool(bf):
@@ -570,7 +644,10 @@ def _run_while(cond_fn, body_fn, loop_vars, brk_idx=None):
                 else (out,)
         return tuple(loop_vars)
     if _grad_sensitive(loop_vars):
-        raise DygraphToStaticBreak(
+        # a while's trip count is never static — unbounded whiles keep
+        # the eager fallback by design (VERDICT r4 item 2)
+        raise _break(
+            "grad-loop",
             "traced while carries grad-requiring tensors; while_loop is "
             "forward-only — using the eager fallback so gradients stay "
             "correct")
@@ -583,7 +660,8 @@ def _run_while(cond_fn, body_fn, loop_vars, brk_idx=None):
     try:
         return tuple(snn.while_loop(cond2, body_fn, list(loop_vars)))
     except Exception as e:
-        raise DygraphToStaticBreak(
+        raise _break(
+            "while-lower-failed",
             f"converted `while` could not lower to while_loop: {e}") from e
 
 

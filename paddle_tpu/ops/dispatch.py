@@ -29,6 +29,9 @@ def _is_tensor(x):
 
 _profiler_mod = None
 _spmd_prop = None
+# jit.loop_grad external-tensor capture (active only while a converted
+# loop probes its body / traces its scan lowering); one None-check per op
+_loop_capture = None
 
 
 def apply_op(name: str, fn: Callable, *args, **kwargs):
@@ -118,6 +121,8 @@ def _apply_op(name: str, fn: Callable, *args, **kwargs):
             t._grad_node = node
             t._grad_out_idx = idx
         out_tensors.append(t)
+    if _loop_capture is not None:
+        _loop_capture.observe(tensors, out_tensors)
     # SPMD rule propagation hook (parity: InferSpmd step of the generated
     # dist branch, dist_api_gen.py:49-110) — active only inside a
     # spmd_propagation(mesh) scope; one dict lookup otherwise.
