@@ -204,12 +204,34 @@ def _error_json(msg: str, **extra) -> str:
     return json.dumps(rec)
 
 
+def _enable_compile_cache():
+    """Persistent XLA compilation cache (VERDICT r4 item 1: shrink the
+    happy path so a short relay window at driver time still lands a
+    number). The first in-session run pays the ~minutes 0.8B compile and
+    populates .jax_cache/; the driver's later run of the SAME committed
+    program is a disk hit and compiles in seconds. Importing jax here is
+    safe — the TPU grant is only claimed at the first jax operation."""
+    try:
+        import jax
+        cache_dir = os.environ.get(
+            "JAX_COMPILATION_CACHE_DIR",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".jax_cache"))
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:
+        pass            # cache is an optimization, never a failure mode
+
+
 def worker():
     """Runs the attempt chain. A watchdog thread guarantees a JSON line even
     if the TPU transport wedges mid-call (exceptions can be caught; hangs
     cannot — round 2's rc=124 was jax.devices() blocking on a dead relay)."""
     import threading
     import traceback
+
+    _enable_compile_cache()
 
     state = {"phase": "import jax", "done": False}
 
