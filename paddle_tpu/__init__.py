@@ -61,6 +61,7 @@ from . import audio  # noqa: F401,E402
 from . import text  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
+from . import serving  # noqa: F401,E402
 from . import regularizer  # noqa: F401,E402
 from . import tensor  # noqa: F401,E402
 from . import signal  # noqa: F401,E402

@@ -106,6 +106,13 @@ class Tensor:
         arr = np.asarray(self._data)
         return arr.astype(dtype) if dtype is not None else arr
 
+    def __jax_array__(self):
+        # jnp.asarray(Tensor) consults this before __array__; without it
+        # older jax rejects Tensors outright (newer jax accepts them via
+        # the numpy protocol, but returns a host copy — this keeps the
+        # device array and works on both)
+        return self._data
+
     def item(self, *args):
         return np.asarray(self._data).item(*args)
 

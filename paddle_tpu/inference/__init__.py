@@ -47,31 +47,60 @@ class Config:
         self._mem_optim = True
         self._ir_optim = True
         self._cpu_threads = 1
+        # every toggle call is recorded here, no-op or not, so deployed
+        # configs stay introspectable (summary()) even though XLA owns
+        # the actual optimization decisions on TPU
+        self._settings: Dict[str, object] = {}
 
     # ---- reference toggle surface (recorded, XLA decides) ----
     def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
         self._use_gpu = True
+        self._settings["use_gpu"] = True
+        self._settings["gpu_memory_pool_mb"] = memory_pool_init_size_mb
+        self._settings["gpu_device_id"] = device_id
 
     def disable_gpu(self):
         self._use_gpu = False
+        self._settings["use_gpu"] = False
 
     def enable_memory_optim(self, x=True):
         self._mem_optim = x
+        self._settings["memory_optim"] = x
 
     def switch_ir_optim(self, x=True):
         self._ir_optim = x
+        self._settings["ir_optim"] = x
 
     def set_cpu_math_library_num_threads(self, n):
         self._cpu_threads = n
+        self._settings["cpu_math_library_num_threads"] = n
 
     def enable_mkldnn(self):
-        pass
+        self._settings["mkldnn"] = True
 
     def disable_glog_info(self):
-        pass
+        self._settings["glog_info"] = False
 
     def model_dir(self):
         return os.path.dirname(self.prog_file or "")
+
+    def summary(self):
+        """The recorded configuration: file paths + every toggle the
+        caller set (reference Config::Summary(), analysis_config.cc).
+        Returns the formatted table; `.settings()` gives the raw dict."""
+        rows = [("prog_file", self.prog_file),
+                ("params_file", self.params_file),
+                ("use_gpu", self._use_gpu),
+                ("memory_optim", self._mem_optim),
+                ("ir_optim", self._ir_optim),
+                ("cpu_math_threads", self._cpu_threads)]
+        rows += sorted((k, v) for k, v in self._settings.items()
+                       if k not in dict(rows))
+        width = max(len(k) for k, _ in rows)
+        return "\n".join(f"{k:<{width}}  {v}" for k, v in rows)
+
+    def settings(self):
+        return dict(self._settings)
 
 
 class PredictorTensor:

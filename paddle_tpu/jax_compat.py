@@ -1,0 +1,51 @@
+"""Shims for older jax releases.
+
+The package is written against newer jax (`jax.shard_map` with
+`check_vma=`); some environments pin an older jax where shard_map lives
+under `jax.experimental` and the kwarg is named `check_rep`. Import
+sites fall back here when the top-level import is missing:
+
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax: experimental
+        from paddle_tpu.jax_compat import shard_map
+"""
+from __future__ import annotations
+
+__all__ = ["shard_map", "axis_size", "patch_pltpu"]
+
+
+def patch_pltpu():
+    """Alias pltpu.CompilerParams on older jax (named TPUCompilerParams
+    there) so kernel modules can use the new name uniformly. Idempotent;
+    every module that touches pltpu.CompilerParams calls this at import
+    instead of relying on another kernel module having patched first."""
+    from jax.experimental.pallas import tpu as pltpu
+    if not hasattr(pltpu, "CompilerParams"):
+        pltpu.CompilerParams = pltpu.TPUCompilerParams
+
+
+def shard_map(f, **kwargs):
+    # imported lazily: on jax new enough to have dropped
+    # jax.experimental.shard_map this fallback is never reached, and a
+    # top-level import would break modules that import this shim only
+    # for patch_pltpu
+    from jax.experimental.shard_map import shard_map as _shard_map
+    if "check_vma" in kwargs:          # renamed from check_rep in newer jax
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    if "axis_names" in kwargs:
+        # newer jax: axis_names = the MANUAL axes; older jax expresses the
+        # same partial-manual lowering as auto = mesh axes - manual axes
+        manual = set(kwargs.pop("axis_names"))
+        mesh_axes = set(kwargs["mesh"].axis_names)
+        kwargs["auto"] = frozenset(mesh_axes - manual)
+    return _shard_map(f, **kwargs)
+
+
+def axis_size(axis_name):
+    """jax.lax.axis_size fallback: older jax resolves the size through the
+    bound axis env (jax.core.axis_frame returns the size directly)."""
+    import jax
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.core.axis_frame(axis_name)

@@ -47,8 +47,22 @@ __all__ = ["to_static", "not_to_static", "TracedFunction", "save", "load",
            "functional_call", "ignore_module", "to_static_report"]
 
 # Every function-level eager fallback lands here (VERDICT r4 item 9):
-# the observable inventory of what did NOT compile and why.
+# the observable inventory of what did NOT compile and why. Capped so a
+# long-lived serving process whose traffic keeps hitting graph breaks
+# cannot grow it unboundedly (ADVICE r5 #3): the most recent
+# _FALLBACK_REGISTRY_MAX entries are kept, older ones are dropped and
+# counted.
 _fallback_registry: List[dict] = []
+_FALLBACK_REGISTRY_MAX = 256
+_fallback_dropped = [0]
+
+
+def _record_fallback(entry: dict):
+    _fallback_registry.append(entry)
+    overflow = len(_fallback_registry) - _FALLBACK_REGISTRY_MAX
+    if overflow > 0:
+        del _fallback_registry[:overflow]
+        _fallback_dropped[0] += overflow
 
 
 def to_static_report(reset=False):
@@ -56,14 +70,18 @@ def to_static_report(reset=False):
     the error that broke them) plus dy2static's per-reason break/decline
     counters. The report is the SOT-gap inventory — it measures how much
     of a workload runs eager before deciding whether a bytecode tracer
-    (reference jit/sot/, ~35k LoC) would ever pay for itself."""
+    (reference jit/sot/, ~35k LoC) would ever pay for itself.
+    `eager_fallbacks` holds the most recent entries (bounded);
+    `eager_fallbacks_dropped` counts what aged out of the window."""
     from . import dy2static
     rep = {
         "eager_fallbacks": list(_fallback_registry),
+        "eager_fallbacks_dropped": _fallback_dropped[0],
         "break_counters": dy2static.fallback_counters(),
     }
     if reset:
         _fallback_registry.clear()
+        _fallback_dropped[0] = 0
         dy2static.reset_fallback_counters()
     return rep
 
@@ -469,7 +487,7 @@ class TracedFunction:
         name = getattr(self._callable, "__qualname__",
                        getattr(self._callable, "__name__", "<fn>"))
         first_line = str(err).strip().split("\n")[0]
-        _fallback_registry.append({
+        _record_fallback({
             "function": name,
             "error": type(err).__name__,
             "message": first_line[:200],
@@ -536,15 +554,19 @@ def ignore_module(modules):
     return None
 
 
-def functional_call(layer, params_and_buffers, *args, **kwargs):
+def functional_call(layer, params_and_buffers, *args, method=None, **kwargs):
     """Run `layer.forward` with parameters temporarily replaced by the given
-    dict of arrays (jit-friendly module application)."""
+    dict of arrays (jit-friendly module application). `method` names an
+    alternate entry point on the layer (e.g. the serving engine drives
+    `forward_paged_decode` through the same state swap)."""
     sd = layer.state_dict()
     saved = {k: t._data for k, t in sd.items()}
     try:
         for k, v in params_and_buffers.items():
             if k in sd:
                 sd[k]._data = v._data if isinstance(v, Tensor) else v
+        if method is not None:
+            return getattr(layer, method)(*args, **kwargs)
         return layer(*args, **kwargs)
     finally:
         for k, t in sd.items():

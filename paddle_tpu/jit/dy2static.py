@@ -304,7 +304,8 @@ def _rng_fingerprint():
     has ONE owner — loop_grad._rng_snapshot — so a stream added there is
     never missed here (or vice versa)."""
     from .loop_grad import _rng_snapshot
-    return tuple(id(key) for _st, key in _rng_snapshot())
+    snap = _rng_snapshot()
+    return (tuple(id(key) for _st, key in snap["pairs"]), snap["names"])
 
 
 def _probe_body_grads(body_fn, args):

@@ -58,6 +58,16 @@ class _Capture:
         self.produced = set()
         self.externals = []
         self._seen = set()
+        self._pinned = []
+
+    def pin(self, objs):
+        """Exclude `objs` AND hold strong references to them: an id() in
+        `exclude` is only meaningful while its object is alive — if a
+        wrapper Tensor were GC'd mid-trace, CPython could hand its id to
+        a genuinely-late grad-requiring tensor, which would then be
+        silently excluded from the late-external check (ADVICE r5 #2)."""
+        self._pinned.extend(objs)
+        self.exclude.update(id(o) for o in objs)
 
     def observe(self, in_tensors, out_tensors):
         for t in in_tensors:
@@ -98,25 +108,45 @@ def _rng_snapshot():
     comparison detects a draw even for traced keys, and keeping the
     object allows restoration after an abandoned scan trace (a draw
     inside the trace would otherwise leak a TRACER into live RNG
-    state)."""
+    state). The tracker object + its CURRENT substream names ride along:
+    a substream first registered inside a trace is invisible to the
+    pairs, yet a draw from it leaves a tracer-valued key too (ADVICE
+    r5 #4) — so new names count as an RNG effect and are dropped on
+    restore."""
     from ..framework import random as _random
-    snap = [(_random._global, _random._global._key)]
+    pairs = [(_random._global, _random._global._key)]
+    tracker, names = None, frozenset()
     try:
         from ..distributed.fleet.mpu import get_rng_state_tracker
-        for _name, st in sorted(get_rng_state_tracker().states_.items()):
-            snap.append((st, st._key))
+        tracker = get_rng_state_tracker()
+        names = frozenset(tracker.states_)
+        for _name, st in sorted(tracker.states_.items()):
+            pairs.append((st, st._key))
     except Exception:
         pass
-    return snap
+    return {"pairs": pairs, "tracker": tracker, "names": names}
 
 
 def _rng_changed(snap):
-    return any(st._key is not key for st, key in snap)
+    if any(st._key is not key for st, key in snap["pairs"]):
+        return True
+    tracker = snap["tracker"]
+    # a substream registered since the snapshot is an RNG effect of the
+    # observed region (its draws don't rebind any snapshotted key)
+    return tracker is not None and \
+        frozenset(tracker.states_) != snap["names"]
 
 
 def _rng_restore(snap):
-    for st, key in snap:
+    for st, key in snap["pairs"]:
         st._key = key
+    tracker = snap["tracker"]
+    if tracker is not None:
+        for name in list(tracker.states_):
+            if name not in snap["names"]:
+                # registered inside the abandoned trace: its key may be a
+                # tracer — keeping it would poison every later draw
+                del tracker.states_[name]
 
 
 def _normalize_carry(vals):
@@ -184,7 +214,7 @@ def _step_body(body_fn, late, first_arg, carry_vals, brk_idx):
     from ..core.tensor import Tensor
     wraps = [Tensor(a) for a in carry_vals]
     fw = Tensor(first_arg)
-    late.exclude.update([id(w) for w in wraps] + [id(fw)])
+    late.pin(wraps + [fw])
     with autograd.no_grad():
         o = body_fn(fw, *wraps[1:])
     o = tuple(o) if isinstance(o, (list, tuple)) else (o,)

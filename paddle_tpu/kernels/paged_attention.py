@@ -40,9 +40,13 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..jax_compat import patch_pltpu
 from .flash_attention import _interpret_mode
 
-__all__ = ["paged_attention_decode", "paged_cache_write", "alloc_paged_cache",
+patch_pltpu()
+
+__all__ = ["paged_attention_decode", "paged_cache_write",
+           "paged_cache_write_range", "alloc_paged_cache",
            "check_supported_paged", "paged_blockspecs"]
 
 NEG_INF = np.float32(-1e30)
@@ -242,6 +246,55 @@ def paged_attention_decode(q, k_cache, v_cache, block_tables, seq_lens,
     return out.reshape(B, H, D)
 
 
+def paged_cache_write_range(k_cache, v_cache, k_new, v_new, block_table,
+                            length):
+    """Scatter a whole prefill's K/V (one sequence) into the paged cache.
+
+    k_new/v_new:  (S, KVH, D) — keys/values for token positions 0..S-1
+                  (S may exceed `length`: the tail is prompt padding).
+    block_table:  (max_pages,) int32 — the sequence's page ids; slot j
+                  covers tokens [j*page_size, (j+1)*page_size).
+    length:       () int32 — live tokens; positions >= length are routed
+                  to page 0, the reserved pad page the decode kernel
+                  never reads un-masked (same contract as the padded
+                  block-table slots in `paged_attention_decode`).
+    Returns the updated (k_cache, v_cache).
+
+    Serving prefill companion of `paged_cache_write`: one scatter moves
+    the whole prompt instead of a token per step, so the engine's
+    prefill program is a single fused write (the read path stays the
+    Pallas kernel).
+    """
+    num_pages, KVH, page_size, D = k_cache.shape
+    S = k_new.shape[0]
+    t = jnp.arange(S, dtype=jnp.int32)
+    live = t < jnp.asarray(length, jnp.int32)
+    page_idx = jax.lax.div(t, jnp.int32(page_size))
+    page_off = jax.lax.rem(t, jnp.int32(page_size))
+    pages = jnp.where(live, block_table.astype(jnp.int32)[page_idx], 0)
+    heads = jnp.arange(KVH, dtype=jnp.int32)
+    idx = jnp.stack([
+        jnp.broadcast_to(pages[:, None], (S, KVH)),
+        jnp.broadcast_to(heads[None, :], (S, KVH)),
+        jnp.broadcast_to(page_off[:, None], (S, KVH)),
+    ], axis=-1)
+    dnums = jax.lax.ScatterDimensionNumbers(
+        update_window_dims=(1,),
+        inserted_window_dims=(0, 1, 2),
+        scatter_dims_to_operand_dims=(0, 1, 2))
+    # padded positions collide on page 0 — duplicates allowed there (the
+    # pad page's contents are never read un-masked)
+    k_cache = jax.lax.scatter(
+        k_cache, idx.reshape(S * KVH, 3),
+        k_new.reshape(S * KVH, D).astype(k_cache.dtype), dnums,
+        indices_are_sorted=False, unique_indices=False)
+    v_cache = jax.lax.scatter(
+        v_cache, idx.reshape(S * KVH, 3),
+        v_new.reshape(S * KVH, D).astype(v_cache.dtype), dnums,
+        indices_are_sorted=False, unique_indices=False)
+    return k_cache, v_cache
+
+
 def alloc_paged_cache(num_kv_heads, num_pages, page_size, head_dim,
                       dtype=jnp.bfloat16):
     """Allocate an empty paged KV cache pair in the kernel's layout."""
@@ -278,12 +331,17 @@ def paged_cache_write(k_cache, v_cache, k_new, v_new, block_tables,
         update_window_dims=(1,),
         inserted_window_dims=(0, 1, 2),
         scatter_dims_to_operand_dims=(0, 1, 2))
+    # NOT unique: a bucket-padded decode batch (serving engine) carries
+    # pad rows with write_pos = -1 that all fold to the same (page 0,
+    # head, -1) index — FILL_OR_DROP discards them (offset out of
+    # bounds), but declaring uniqueness over duplicate indices is
+    # undefined behavior, so don't
     k_cache = jax.lax.scatter(
         k_cache, idx.reshape(B * KVH, 3),
         k_new.reshape(B * KVH, D).astype(k_cache.dtype), dnums,
-        indices_are_sorted=False, unique_indices=True)
+        indices_are_sorted=False, unique_indices=False)
     v_cache = jax.lax.scatter(
         v_cache, idx.reshape(B * KVH, 3),
         v_new.reshape(B * KVH, D).astype(v_cache.dtype), dnums,
-        indices_are_sorted=False, unique_indices=True)
+        indices_are_sorted=False, unique_indices=False)
     return k_cache, v_cache

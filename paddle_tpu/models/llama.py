@@ -170,6 +170,57 @@ class LlamaAttention(nn.Layer):
         out = self.o_proj(out)
         return (out, cache) if cache is not None else out
 
+    def forward_paged(self, x, cos_b, sin_b, k_cache, v_cache,
+                      block_tables, seq_lens):
+        """One decode step over the PAGED KV cache (serving engine path).
+
+        x (B, 1, hidden); cos_b/sin_b (B, D/2) at each row's position;
+        k/v_cache (num_pages, KVH, page, D); block_tables (B, max_pages);
+        seq_lens (B,) INCLUDING the token being decoded. Writes the
+        current token's K/V at position seq_lens-1, then attends through
+        kernels.paged_attention_decode. Returns (out, k_cache, v_cache).
+        """
+        from ..kernels.paged_attention import (paged_attention_decode,
+                                               paged_cache_write)
+        b, s, _ = x.shape
+        q = M.reshape(self.q_proj(x), [b, s, self.n_heads, self.head_dim])
+        k = M.reshape(self.k_proj(x), [b, s, self.n_kv, self.head_dim])
+        v = M.reshape(self.v_proj(x), [b, s, self.n_kv, self.head_dim])
+        q = apply_op("rope_pos", apply_rotary_positions, q, cos_b, sin_b)
+        k = apply_op("rope_pos", apply_rotary_positions, k, cos_b, sin_b)
+
+        def _write(kc, vc, kn, vn, bt, sl):
+            return paged_cache_write(kc, vc, kn[:, 0], vn[:, 0], bt,
+                                     sl.astype(jnp.int32) - 1)
+
+        k_cache, v_cache = apply_op("paged_cache_write", _write,
+                                    k_cache, v_cache, k, v,
+                                    block_tables, seq_lens)
+
+        def _attend(qq, kc, vc, bt, sl):
+            return paged_attention_decode(
+                qq.reshape(b, self.n_heads, self.head_dim), kc, vc, bt, sl)
+
+        out = apply_op("paged_attention_decode", _attend, q, k_cache,
+                       v_cache, block_tables, seq_lens)
+        out = M.reshape(out, [b, s, self.n_heads * self.head_dim])
+        return self.o_proj(out), k_cache, v_cache
+
+
+def apply_rotary_positions(x, cos_b, sin_b):
+    """Rotary at PER-ROW positions: x (B, 1, H, D), cos_b/sin_b (B, D/2)
+    gathered at each row's own position (serving decode batches sequences
+    of different lengths). Same pair-view convention as `apply_rotary`."""
+    xr = x.reshape(*x.shape[:-1], x.shape[-1] // 2, 2)
+    x1 = xr[..., 0]
+    x2 = xr[..., 1]
+    c = cos_b[:, None, None, :]
+    s = sin_b[:, None, None, :]
+    o1 = x1 * c - x2 * s
+    o2 = x2 * c + x1 * s
+    out = jnp.stack([o1, o2], axis=-1)
+    return out.reshape(x.shape)
+
 
 class LlamaMLP(nn.Layer):
     def __init__(self, config: LlamaConfig):
@@ -205,6 +256,15 @@ class LlamaDecoderLayer(nn.Layer):
         x = x + attn
         x = x + self.mlp(self.post_attention_layernorm(x))
         return (x, cache) if cache is not None else x
+
+    def forward_paged(self, x, cos_b, sin_b, k_cache, v_cache,
+                      block_tables, seq_lens):
+        h = self.input_layernorm(x)
+        attn, k_cache, v_cache = self.self_attn.forward_paged(
+            h, cos_b, sin_b, k_cache, v_cache, block_tables, seq_lens)
+        x = x + attn
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x, k_cache, v_cache
 
 
 class LlamaModel(nn.Layer):
@@ -249,6 +309,29 @@ class LlamaModel(nn.Layer):
                 x = layer(x, cos, sin)
         x = self.norm(x)
         return (x, new_caches) if caches is not None else x
+
+    def forward_paged_decode(self, input_ids, paged_caches, block_tables,
+                             seq_lens):
+        """One batched decode step over per-layer paged KV caches.
+
+        input_ids (B, 1); paged_caches: list of (k_cache, v_cache) per
+        layer; seq_lens counts the token being decoded (its position is
+        seq_lens-1). Returns (hidden (B, 1, H), new_caches)."""
+        def _gather_rope(c, sl):
+            return jnp.take(c, sl.astype(jnp.int32) - 1, axis=0)
+
+        cos_b = apply_op("rope_gather", _gather_rope, self.rope_cos,
+                         seq_lens)
+        sin_b = apply_op("rope_gather", _gather_rope, self.rope_sin,
+                         seq_lens)
+        x = self.embed_tokens(input_ids)
+        new_caches = []
+        for i, layer in enumerate(self.layers):
+            kc, vc = paged_caches[i]
+            x, kc, vc = layer.forward_paged(x, cos_b, sin_b, kc, vc,
+                                            block_tables, seq_lens)
+            new_caches.append((kc, vc))
+        return self.norm(x), new_caches
 
 
 def _recompute_layer(layer, x, cos, sin):
@@ -319,6 +402,16 @@ class LlamaForCausalLM(nn.Layer):
         if labels is not None:
             return out
         return (out, caches) if caches is not None else out
+
+    def forward_paged_decode(self, input_ids, paged_caches, block_tables,
+                             seq_lens):
+        """Serving decode step: paged-KV transformer + LM head.
+        Returns (logits (B, 1, V), new_caches)."""
+        h, caches = self.model.forward_paged_decode(
+            input_ids, paged_caches, block_tables, seq_lens)
+        tied = self.model.embed_tokens.weight if self.lm_head is None else None
+        logits = _head_and_loss(h, None, self.lm_head, tied)
+        return logits, caches
 
     # -------------------------------------------------------- generation
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0,

@@ -98,7 +98,10 @@ def _wint8_matmul_pallas(x2d, qw, scale):
     """x2d (M, K) float; qw (K, N) int8; scale (N,) -> (M, N)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+    from ...jax_compat import patch_pltpu
     from ...kernels.flash_attention import _interpret_mode
+
+    patch_pltpu()
 
     M, K = x2d.shape
     N = qw.shape[1]

@@ -27,7 +27,9 @@ from typing import Callable, Iterable, Optional
 
 __all__ = ["ProfilerState", "ProfilerTarget", "TracerEventType",
            "RecordEvent", "Profiler", "make_scheduler", "benchmark",
-           "export_chrome_tracing", "load_profiler_result"]
+           "export_chrome_tracing", "load_profiler_result",
+           "register_counter_provider", "unregister_counter_provider",
+           "counters"]
 
 
 class ProfilerState(Enum):
@@ -75,6 +77,30 @@ class _HostTracer:
 
 
 _tracer = _HostTracer()
+
+# Counter providers: subsystems (e.g. serving.metrics) register a zero-arg
+# callable returning {counter: value}; Profiler.summary() appends the live
+# values and counters() exposes them programmatically.
+_counter_providers: dict = {}
+
+
+def register_counter_provider(name: str, fn):
+    _counter_providers[name] = fn
+
+
+def unregister_counter_provider(name: str):
+    _counter_providers.pop(name, None)
+
+
+def counters() -> dict:
+    """{provider: {counter: value}} from every registered provider."""
+    out = {}
+    for name, fn in list(_counter_providers.items()):
+        try:
+            out[name] = fn()
+        except Exception as e:        # a dead provider must not sink summary()
+            out[name] = {"error": repr(e)}
+    return out
 
 
 class RecordEvent(ContextDecorator):
@@ -310,6 +336,12 @@ class Profiler:
                 f"steps: {len(sr)}  avg {statistics.mean(sr):.3f} ms  "
                 f"p50 {statistics.median(sr):.3f} ms  "
                 f"max {max(sr):.3f} ms")
+        ctrs = counters()
+        if ctrs:
+            lines.append("")
+            for prov, vals in sorted(ctrs.items()):
+                pairs = "  ".join(f"{k}={v}" for k, v in vals.items())
+                lines.append(f"[{prov}] {pairs}")
         table = "\n".join(lines)
         print(table)
         return table

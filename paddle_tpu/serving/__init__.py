@@ -1,0 +1,14 @@
+"""paddle_tpu.serving — continuous-batching inference engine.
+
+Architecture (SERVING.md): Orca-style iteration-level scheduling +
+vLLM-style paged KV management, compiled into a bounded grid of
+bucketed XLA programs over the chip-validated paged-attention kernels.
+"""
+from .engine import ServingEngine
+from .kv_cache import BlockAllocator, BlocksExhausted, KVSequence, PAD_PAGE
+from .metrics import ServingMetrics
+from .scheduler import Request, RequestState, ScheduleStep, Scheduler
+
+__all__ = ["ServingEngine", "BlockAllocator", "BlocksExhausted",
+           "KVSequence", "PAD_PAGE", "ServingMetrics", "Request",
+           "RequestState", "ScheduleStep", "Scheduler"]

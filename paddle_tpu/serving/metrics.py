@@ -1,0 +1,121 @@
+"""Serving observability: counters + gauges wired into the profiler.
+
+Two integration points with the existing profiler subsystem:
+  * the engine wraps prefill/decode program launches in
+    `profiler.RecordEvent` spans, so they land on the host timeline and
+    in `Profiler.summary()` like any other op;
+  * a ServingMetrics registers itself as a profiler counter provider
+    (`profiler.register_counter_provider`), so `Profiler.summary()`
+    appends the live serving counters to its table.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+__all__ = ["ServingMetrics"]
+
+
+class ServingMetrics:
+    """Counters/gauges for one ServingEngine."""
+
+    def __init__(self, name: str = "serving"):
+        self.name = name
+        self.counters: Dict[str, int] = {
+            "requests_added": 0,
+            "requests_finished": 0,
+            "requests_preempted": 0,
+            "prefill_tokens": 0,
+            "decode_tokens": 0,
+            "engine_steps": 0,
+            "recompiles": 0,
+        }
+        self._registered = False
+        self._t_start = time.perf_counter()
+        self._arrive_t: Dict[int, float] = {}   # in-flight only (popped
+        # on finish) — the TTFT record is a running aggregate so a
+        # long-lived server doesn't keep a per-request entry forever
+        self._ttft_sum = 0.0
+        self._ttft_count = 0
+        # gauges updated by the engine each step
+        self.queue_depth = 0
+        self.running = 0
+        self.kv_used_pages = 0
+        self.kv_occupancy = 0.0
+
+    # ---- event hooks -----------------------------------------------------
+    def on_add(self, request_id: int):
+        self.counters["requests_added"] += 1
+        self._arrive_t[request_id] = time.perf_counter()
+
+    def on_first_token(self, request_id: int):
+        # called once per request (the engine guards on num_generated==0)
+        t0 = self._arrive_t.get(request_id)
+        if t0 is not None:
+            self._ttft_sum += time.perf_counter() - t0
+            self._ttft_count += 1
+
+    def on_prefill(self, num_tokens: int):
+        self.counters["prefill_tokens"] += num_tokens
+
+    def on_decode(self, num_tokens: int):
+        self.counters["decode_tokens"] += num_tokens
+
+    def on_finish(self, request_id: int):
+        self.counters["requests_finished"] += 1
+        self._arrive_t.pop(request_id, None)
+
+    def on_preempt(self):
+        self.counters["requests_preempted"] += 1
+
+    def on_step(self):
+        self.counters["engine_steps"] += 1
+
+    def on_recompile(self):
+        self.counters["recompiles"] += 1
+
+    def update_gauges(self, *, queue_depth, running, kv_used_pages,
+                      kv_occupancy):
+        self.queue_depth = queue_depth
+        self.running = running
+        self.kv_used_pages = kv_used_pages
+        self.kv_occupancy = kv_occupancy
+
+    # ---- derived ---------------------------------------------------------
+    def tokens_per_second(self) -> float:
+        dt = time.perf_counter() - self._t_start
+        total = self.counters["prefill_tokens"] + self.counters["decode_tokens"]
+        return total / dt if dt > 0 else 0.0
+
+    def mean_ttft(self) -> Optional[float]:
+        if not self._ttft_count:
+            return None
+        return self._ttft_sum / self._ttft_count
+
+    def snapshot(self) -> dict:
+        snap = dict(self.counters)
+        snap.update({
+            "queue_depth": self.queue_depth,
+            "running": self.running,
+            "kv_used_pages": self.kv_used_pages,
+            "kv_occupancy": round(self.kv_occupancy, 4),
+            "tokens_per_second": round(self.tokens_per_second(), 2),
+        })
+        ttft = self.mean_ttft()
+        if ttft is not None:
+            snap["mean_ttft_ms"] = round(ttft * 1e3, 3)
+        return snap
+
+    # ---- profiler integration -------------------------------------------
+    def register(self):
+        """Expose this engine's counters through Profiler.summary()."""
+        from .. import profiler
+        profiler.register_counter_provider(self.name, self.snapshot)
+        self._registered = True
+        return self
+
+    def unregister(self):
+        if self._registered:
+            from .. import profiler
+            profiler.unregister_counter_provider(self.name)
+            self._registered = False

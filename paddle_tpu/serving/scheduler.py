@@ -1,0 +1,226 @@
+"""Iteration-level continuous-batching scheduler.
+
+Follows Orca's iteration-level scheduling (Yu et al., OSDI '22): every
+engine step re-forms the batch from whatever is in flight, so a finishing
+request's slot is reused immediately instead of waiting for the whole
+batch to drain. Admission is FCFS under a per-step token budget; memory
+pressure is resolved by preempt-by-eviction (vLLM-style recompute
+preemption: the victim's pages are freed and it re-enters the waiting
+queue with its generated tokens folded into the prompt).
+
+Per-request state machine:
+
+    WAITING --admit--> PREFILL --first token--> DECODE --eos/len--> FINISHED
+       ^                                          |
+       +------------------ preempt ---------------+
+
+The scheduler is pure host logic and deterministic: given the same
+arrival sequence and the same allocator geometry it produces the same
+step-by-step batch composition (golden-trace tested).
+"""
+from __future__ import annotations
+
+import enum
+import itertools
+from collections import deque
+from typing import List, Optional
+
+from .kv_cache import BlockAllocator, BlocksExhausted
+
+__all__ = ["RequestState", "Request", "ScheduleStep", "Scheduler"]
+
+
+class RequestState(enum.Enum):
+    WAITING = "waiting"
+    PREFILL = "prefill"
+    DECODE = "decode"
+    FINISHED = "finished"
+
+
+_req_counter = itertools.count()
+
+
+class Request:
+    """One generation request tracked through the state machine."""
+
+    def __init__(self, prompt_ids, max_new_tokens: int,
+                 eos_token_id: Optional[int] = None,
+                 request_id: Optional[int] = None):
+        self.request_id = (next(_req_counter) if request_id is None
+                           else request_id)
+        self.prompt_ids = [int(t) for t in prompt_ids]
+        if not self.prompt_ids:
+            raise ValueError("empty prompt")
+        self.max_new_tokens = int(max_new_tokens)
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.eos_token_id = eos_token_id
+        self.state = RequestState.WAITING
+        self.output_ids: List[int] = []
+        self.seq = None                 # KVSequence while holding pages
+        self.pending_copies = []        # CoW copies due before this step
+        self.num_preemptions = 0
+        self.finish_reason: Optional[str] = None
+        self.arrival = self.request_id  # FCFS key (monotonic ids)
+
+    # prompt the next prefill must process (original prompt + anything
+    # generated before a preemption — recompute-style resume)
+    @property
+    def resume_ids(self) -> List[int]:
+        return self.prompt_ids + self.output_ids
+
+    @property
+    def num_generated(self) -> int:
+        return len(self.output_ids)
+
+    def remaining_new_tokens(self) -> int:
+        return self.max_new_tokens - self.num_generated
+
+    def __repr__(self):
+        return (f"Request({self.request_id}, {self.state.name}, "
+                f"prompt={len(self.prompt_ids)}, out={len(self.output_ids)})")
+
+
+class ScheduleStep:
+    """One engine step's worth of work: prompts to prefill (each runs as
+    its own bucketed program) + the decode batch."""
+
+    __slots__ = ("prefills", "decodes", "preempted")
+
+    def __init__(self, prefills, decodes, preempted):
+        self.prefills = prefills
+        self.decodes = decodes
+        self.preempted = preempted
+
+    def is_empty(self):
+        return not (self.prefills or self.decodes)
+
+
+class Scheduler:
+    """FCFS continuous-batching scheduler over a BlockAllocator.
+
+    token_budget caps the tokens processed per step (each decode request
+    costs 1, a prefill costs its prompt length) — the knob that trades
+    time-to-first-token against decode throughput when prefills and
+    decodes interleave. max_batch_size caps concurrent in-flight
+    (PREFILL/DECODE) requests, which bounds the decode batch bucket.
+    """
+
+    def __init__(self, allocator: BlockAllocator, max_batch_size: int = 8,
+                 token_budget: int = 512,
+                 max_prompt_len: Optional[int] = None):
+        self.allocator = allocator
+        self.max_batch_size = int(max_batch_size)
+        self.token_budget = int(token_budget)
+        self.max_prompt_len = max_prompt_len
+        self.waiting: deque = deque()
+        self.running: List[Request] = []   # arrival order
+        self.num_preemptions = 0
+
+    # ---- intake ----------------------------------------------------------
+    def add_request(self, req: Request):
+        if self.max_prompt_len is not None and \
+                len(req.prompt_ids) > self.max_prompt_len:
+            raise ValueError(
+                f"prompt length {len(req.prompt_ids)} exceeds engine "
+                f"max_prompt_len {self.max_prompt_len}")
+        cap = (self.allocator.num_pages - 1) * self.allocator.page_size
+        if len(req.prompt_ids) + req.max_new_tokens > cap:
+            raise ValueError(
+                f"request needs {len(req.prompt_ids) + req.max_new_tokens} "
+                f"tokens of KV > total capacity {cap}")
+        req.state = RequestState.WAITING
+        self.waiting.append(req)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.waiting)
+
+    # ---- preemption ------------------------------------------------------
+    def _preempt_one(self, keep: Request) -> Optional[Request]:
+        """Evict the LAST-arrived running request — possibly `keep`
+        itself when IT is the newest (strict FCFS priority: a newer
+        request never survives at an older one's expense). The victim's
+        pages free immediately; it resumes by re-prefilling
+        prompt+generated (recompute, not swap — there is no host swap
+        space worth the round-trip on TPU)."""
+        victim = self.running[-1]
+        self.running.remove(victim)
+        self.allocator.free_sequence(victim.seq)
+        victim.seq = None
+        victim.state = RequestState.WAITING
+        victim.num_preemptions += 1
+        self.num_preemptions += 1
+        # preempted requests head the queue: FCFS by original arrival
+        self.waiting.appendleft(victim)
+        return victim
+
+    # ---- the per-step decision ------------------------------------------
+    def schedule(self) -> ScheduleStep:
+        preempted: List[Request] = []
+
+        # 1. guarantee every running request can append this step's token
+        #    (may cross a page boundary); evict newest-first on pressure.
+        survivors: List[Request] = []
+        for req in list(self.running):
+            if req not in self.running:
+                continue               # evicted by an earlier iteration
+            while True:
+                try:
+                    copies = self.allocator.append_token(req.seq)
+                    req.pending_copies = copies
+                    survivors.append(req)
+                    break
+                except BlocksExhausted:
+                    victim = self._preempt_one(keep=req)
+                    preempted.append(victim)
+                    if victim is req:
+                        break
+        decodes = [r for r in survivors if r in self.running]
+        budget = self.token_budget - len(decodes)
+
+        # 2. admit waiting prompts FCFS while budget/slots/pages allow.
+        #    Headroom check only: a prompt must see pages for prompt
+        #    tokens + 1 free, which makes an immediate post-prefill
+        #    preemption unlikely but does NOT reserve the extra page —
+        #    same-step admissions crossing a boundary together can still
+        #    contend, and preemption (step 1) resolves it.
+        prefills: List[Request] = []
+        while self.waiting and budget > 0 and \
+                len(self.running) + len(prefills) < self.max_batch_size:
+            req = self.waiting[0]
+            n = len(req.resume_ids)
+            if n > budget and (prefills or budget < self.token_budget):
+                break                  # FCFS head-of-line: wait for budget
+            # else: n exceeds even the FULL budget — admit it alone once
+            # the step is otherwise empty, or it would livelock at the
+            # head of the queue forever (the budget is a latency knob,
+            # not an admissibility bound)
+            if not self.allocator.can_allocate(n + 1):
+                break                  # no pages — decodes will drain/free
+            self.waiting.popleft()
+            req.seq = self.allocator.alloc_sequence(n)
+            req.state = RequestState.PREFILL
+            prefills.append(req)
+            budget -= n
+        return ScheduleStep(prefills, decodes, preempted)
+
+    # ---- completion hooks (engine calls these) ---------------------------
+    def on_prefilled(self, req: Request):
+        """Prompt processed and first token sampled: request joins the
+        decode batch (unless that token already finished it)."""
+        req.state = RequestState.DECODE
+        self.running.append(req)
+        self.running.sort(key=lambda r: r.arrival)
+
+    def finish(self, req: Request, reason: str):
+        if req in self.running:
+            self.running.remove(req)
+        if req.seq is not None:
+            self.allocator.free_sequence(req.seq)
+            req.seq = None
+        req.state = RequestState.FINISHED
+        req.finish_reason = reason

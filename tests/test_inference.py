@@ -134,3 +134,32 @@ def test_program_guard_scopes_placeholders():
     assert any(a is p for p in prog.placeholders)
     from paddle_tpu.static import default_main_program
     assert all(a is not p for p in default_main_program().placeholders)
+
+
+def test_config_records_settings_and_summary():
+    """The reference's tuning toggles are no-ops on TPU (XLA owns
+    optimization) but must stay introspectable: every call is recorded
+    and Config.summary() reports the full configuration."""
+    from paddle_tpu import inference
+    c = inference.Config("m.pdmodel.mlir", "m.pdiparams")
+    assert c.settings() == {}
+    c.enable_use_gpu(256, 1)
+    c.enable_mkldnn()
+    c.disable_glog_info()
+    c.set_cpu_math_library_num_threads(4)
+    c.switch_ir_optim(False)
+    c.enable_memory_optim(True)
+    assert c.settings() == {
+        "use_gpu": True, "gpu_memory_pool_mb": 256, "gpu_device_id": 1,
+        "mkldnn": True, "glog_info": False,
+        "cpu_math_library_num_threads": 4, "ir_optim": False,
+        "memory_optim": True}
+    c.disable_gpu()
+    assert c.settings()["use_gpu"] is False
+    text = c.summary()
+    assert "m.pdiparams" in text and "mkldnn" in text
+    # line-per-setting "key  value" layout, stable for log scraping
+    rows = dict(line.split(None, 1) for line in text.splitlines())
+    assert rows["cpu_math_threads"].strip() == "4"
+    assert rows["use_gpu"].strip() == "False"
+    assert len(rows) >= 8

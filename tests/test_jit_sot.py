@@ -1239,3 +1239,26 @@ def test_ternary_concrete_predicate_evaluates_one_branch():
     assert traced._fallback_count == 0        # converted, not eager
     np.testing.assert_allclose(np.asarray(out._data), 2 * np.ones(2))
     assert calls == ["t"]        # untaken branch never evaluated
+
+
+def test_fallback_registry_is_capped():
+    """A long-lived serving process whose traffic keeps graph-breaking
+    must not grow the fallback registry unboundedly: the most recent
+    _FALLBACK_REGISTRY_MAX entries are kept, older ones counted."""
+    from paddle_tpu.jit import api
+    api.to_static_report(reset=True)
+    n_extra = 40
+    for i in range(api._FALLBACK_REGISTRY_MAX + n_extra):
+        api._record_fallback({"function": f"f{i}", "error": "E",
+                              "message": ""})
+    rep = api.to_static_report()
+    assert len(rep["eager_fallbacks"]) == api._FALLBACK_REGISTRY_MAX
+    assert rep["eager_fallbacks_dropped"] == n_extra
+    # the WINDOW slides: oldest entries dropped, newest kept
+    assert rep["eager_fallbacks"][0]["function"] == f"f{n_extra}"
+    assert rep["eager_fallbacks"][-1]["function"] == \
+        f"f{api._FALLBACK_REGISTRY_MAX + n_extra - 1}"
+    api.to_static_report(reset=True)
+    rep = api.to_static_report()
+    assert rep["eager_fallbacks"] == [] and \
+        rep["eager_fallbacks_dropped"] == 0

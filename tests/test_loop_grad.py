@@ -410,3 +410,81 @@ def test_scan_fires_under_no_grad_even_reading_params():
         loop_grad.try_scan_range = orig_scan
     assert float(np.asarray(out._data)) == pytest.approx(2.0 * N)
     assert scans == ["done"], (scans, fallback_counters())
+
+
+def test_capture_pin_holds_strong_refs():
+    """late.exclude entries are raw id()s: the excluded wrapper Tensors
+    must stay ALIVE for the whole trace, or CPython could reuse a dead
+    wrapper's id for a genuinely-late grad-requiring tensor and silently
+    exclude it (ADVICE r5 #2)."""
+    import gc
+    import weakref
+    from paddle_tpu.jit.loop_grad import _Capture
+    cap = _Capture()
+    t = paddle.to_tensor(np.zeros(2, np.float32))
+    ref = weakref.ref(t)
+    cap.pin([t])
+    assert id(t) in cap.exclude
+    del t
+    gc.collect()
+    assert ref() is not None, "pinned wrapper was garbage-collected"
+    del cap
+    gc.collect()
+    assert ref() is None        # no leak once the capture itself dies
+
+
+def test_rng_restore_drops_substreams_registered_after_snapshot():
+    """Unit contract of ADVICE r5 #4: a tracker substream registered
+    AFTER the snapshot counts as an RNG effect (declines the lowering)
+    and is dropped by restore, so a tracer-valued key can never survive
+    an abandoned trace."""
+    from paddle_tpu.distributed.fleet.mpu import get_rng_state_tracker
+    from paddle_tpu.jit.loop_grad import (_rng_changed, _rng_restore,
+                                          _rng_snapshot)
+    tracker = get_rng_state_tracker()
+    base = dict(tracker.states_)
+    try:
+        snap = _rng_snapshot()
+        assert not _rng_changed(snap)
+        tracker.add("trace_born_stream", 11)
+        assert _rng_changed(snap)
+        _rng_restore(snap)
+        assert "trace_born_stream" not in tracker.states_
+        assert not _rng_changed(snap)
+    finally:
+        tracker.states_ = base
+
+
+def test_scan_decline_drops_trace_born_substream():
+    """End-to-end through try_scan_range: a body that is RNG-silent in
+    the probe but registers + draws from a fresh tracker substream
+    inside the scan trace must decline the lowering AND leave no
+    tracer-keyed stream behind."""
+    import jax
+    from paddle_tpu.distributed.fleet.mpu import get_rng_state_tracker
+    from paddle_tpu.jit.loop_grad import try_scan_range
+    tracker = get_rng_state_tracker()
+    base = dict(tracker.states_)
+    calls = [0]
+
+    def body(k, s):
+        calls[0] += 1
+        if calls[0] >= 2:          # probe (call 1) stays RNG-silent
+            name = f"trace_born_{calls[0]}"
+            tracker.add(name, 3)
+            with tracker.rng_state(name):
+                s = s + paddle.rand([1]).sum() * 0.0
+        return (k, s + 1.0)
+
+    try:
+        s0 = paddle.to_tensor(np.zeros((), np.float32))
+        kind, reason, _i, _vals = try_scan_range(0, N, 1, body, (s0,))
+        assert kind == "probed" and reason == "rng-draw"
+        # every stream the abandoned trace registered was dropped...
+        leaked = set(tracker.states_) - set(base)
+        assert not leaked, leaked
+        # ...so no live RNG key is a tracer
+        for name, st in tracker.states_.items():
+            assert not isinstance(st._key, jax.core.Tracer), name
+    finally:
+        tracker.states_ = base

@@ -1,0 +1,141 @@
+"""Property-style tests for the serving BlockAllocator: the refcount /
+free-list partition invariant must hold under arbitrary interleavings of
+alloc / append / fork / free, double frees must raise, and a drained
+allocator must return to zero occupancy (the KV-reclamation half of the
+engine acceptance check)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.serving import (BlockAllocator, BlocksExhausted, KVSequence,
+                                PAD_PAGE)
+
+
+def test_pad_page_reserved_and_basic_alloc():
+    a = BlockAllocator(num_pages=8, page_size=8)
+    assert a.num_free == 7
+    s = a.alloc_sequence(17)            # 3 pages
+    assert len(s.pages) == 3 and PAD_PAGE not in s.pages
+    assert a.num_used == 3
+    a.free_sequence(s)
+    assert a.num_used == 0 and a.occupancy() == 0.0
+
+
+def test_page_size_must_be_sublane_tiled():
+    with pytest.raises(ValueError):
+        BlockAllocator(num_pages=8, page_size=12)
+
+
+def test_append_crosses_page_boundary_exactly():
+    a = BlockAllocator(num_pages=8, page_size=8)
+    s = a.alloc_sequence(8)             # exactly one full page
+    assert len(s.pages) == 1
+    assert a.append_token(s) == []      # crosses into page 2
+    assert len(s.pages) == 2 and s.num_tokens == 9
+    for _ in range(7):
+        a.append_token(s)
+    assert len(s.pages) == 2            # page 2 now full
+    a.append_token(s)
+    assert len(s.pages) == 3
+
+
+def test_exhaustion_is_all_or_nothing():
+    a = BlockAllocator(num_pages=4, page_size=8)   # 3 usable pages
+    s = a.alloc_sequence(16)            # 2 pages
+    with pytest.raises(BlocksExhausted):
+        a.alloc_sequence(17)            # needs 3
+    assert a.num_used == 2              # failed alloc held nothing
+    a.check_invariants()
+    a.free_sequence(s)
+    assert a.num_used == 0
+
+
+def test_double_free_raises():
+    a = BlockAllocator(num_pages=8, page_size=8)
+    s = a.alloc_sequence(4)
+    a.free_sequence(s)
+    with pytest.raises(RuntimeError):
+        a.free_sequence(s)
+    with pytest.raises(RuntimeError):
+        a.append_token(s)
+    a.check_invariants()
+
+
+def test_fork_refcounts_and_copy_on_write():
+    a = BlockAllocator(num_pages=16, page_size=8)
+    s = a.alloc_sequence(12)            # 2 pages, second half-full
+    child = a.fork_sequence(s)
+    assert child.pages == s.pages and a.num_used == 2   # shared
+    # appending into the SHARED half-full page must CoW for the child
+    copies = a.append_token(child)
+    assert len(copies) == 1
+    src, dst = copies[0]
+    assert src == s.pages[1] and dst == child.pages[1] and src != dst
+    assert a.num_used == 3
+    # parent appends into its own page now — no further copy
+    assert a.append_token(s) == []
+    # freeing one side keeps the other's pages alive
+    a.free_sequence(s)
+    assert a.num_used == 2
+    a.check_invariants()
+    a.free_sequence(child)
+    assert a.num_used == 0
+
+
+def test_fork_at_page_boundary_needs_no_cow():
+    a = BlockAllocator(num_pages=16, page_size=8)
+    s = a.alloc_sequence(8)             # page exactly full
+    child = a.fork_sequence(s)
+    copies = a.append_token(child)      # lands in a FRESH page
+    assert copies == [] and len(child.pages) == 2
+    a.free_sequence(s)
+    a.free_sequence(child)
+    assert a.num_used == 0
+
+
+def test_block_table_padding_contract():
+    a = BlockAllocator(num_pages=16, page_size=8)
+    s1 = a.alloc_sequence(20)           # 3 pages
+    s2 = a.alloc_sequence(5)            # 1 page
+    bt = a.block_table([s1, s2], max_pages=4)
+    assert bt.shape == (2, 4) and bt.dtype == np.int32
+    assert list(bt[0, :3]) == s1.pages and bt[0, 3] == PAD_PAGE
+    assert bt[1, 0] == s2.pages[0] and (bt[1, 1:] == PAD_PAGE).all()
+    with pytest.raises(ValueError):
+        a.block_table([s1], max_pages=2)
+    np.testing.assert_array_equal(a.seq_lens([s1, s2]), [20, 5])
+    a.free_sequence(s1)
+    a.free_sequence(s2)
+
+
+def test_random_alloc_free_fork_sequences_hold_invariants():
+    """Randomized soak: occupancy accounting + partition invariant under
+    every operation mix, ending at exactly zero occupancy."""
+    rng = np.random.RandomState(7)
+    a = BlockAllocator(num_pages=32, page_size=8)
+    live = []
+    for step in range(600):
+        op = rng.randint(4)
+        if op == 0 or not live:
+            try:
+                live.append(a.alloc_sequence(int(rng.randint(1, 40))))
+            except BlocksExhausted:
+                pass
+        elif op == 1:
+            s = live[rng.randint(len(live))]
+            try:
+                a.append_token(s)
+            except BlocksExhausted:
+                pass
+        elif op == 2:
+            live.append(a.fork_sequence(live[rng.randint(len(live))]))
+        else:
+            a.free_sequence(live.pop(rng.randint(len(live))))
+        a.check_invariants()
+        # occupancy == distinct pages referenced by live sequences
+        distinct = {p for s in live for p in s.pages}
+        assert a.num_used == len(distinct)
+        assert 0.0 <= a.occupancy() <= 1.0
+    for s in live:
+        a.free_sequence(s)
+    a.check_invariants()
+    assert a.num_used == 0 and a.occupancy() == 0.0

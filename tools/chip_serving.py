@@ -54,4 +54,37 @@ rel = np.abs(y_q - y_f).max() / (np.abs(y_f).max() + 1e-9)
 print(f"int8 weight-only rel_err {rel:.4f}")
 assert rel < 2e-2, rel
 print("INT8_CHIP_OK")
+
+# --- ServingEngine continuous-batching decode throughput --------------
+# VERDICT open item #9 ("measure serving decode"): 8 requests decode in
+# ONE batched program over the real Pallas paged kernel. Each step()
+# host-fetches the sampled tokens, which is the only honest sync over
+# the axon relay, so wall-clock across steps is a true step time.
+from paddle_tpu.serving import ServingEngine
+from paddle_tpu.serving.scheduler import RequestState
+
+eng = ServingEngine(model, num_pages=128, page_size=16,
+                    batch_buckets=[8], prefill_buckets=[16, 128],
+                    pages_buckets=[8], temperature=0.0)
+for _ in range(8):
+    eng.add_request(rng.randint(0, cfg.vocab_size, (12,)).tolist(),
+                    max_new_tokens=100)
+# warm: prefills + first decode launch (compiles both programs)
+while not all(r.state is RequestState.DECODE
+              for r in eng.requests.values()):
+    eng.step()
+eng.step()
+import time
+N_STEPS = 64
+t0 = time.perf_counter()
+for _ in range(N_STEPS):
+    eng.step()
+dt = time.perf_counter() - t0
+tps = 8 * N_STEPS / dt
+print(f"serving engine: batch=8 decode {dt / N_STEPS * 1e3:.2f} ms/step "
+      f"SERVING_ENGINE_TOKS_PER_S {tps:.1f}")
+print("serving engine counters:", eng.metrics.snapshot())
+assert eng.num_compiled_programs <= eng.max_program_count()
+eng.shutdown()
+print("SERVING_ENGINE_CHIP_OK")
 print("CHIP_SERVING_ALL_OK")
