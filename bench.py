@@ -135,7 +135,7 @@ def _run_inner(paddle, LlamaConfig, LlamaForCausalLM, jax, use_pallas, shrink):
 
     # warmup (compile). NOTE: over the axon relay block_until_ready does
     # not actually block — only a host fetch synchronizes (measured in
-    # bench_ops.py::_time_it). Fetch the loss scalar to sync, and time
+    # bench_ops.py::_time_stats). Fetch the loss scalar to sync, and time
     # two loop lengths so differencing cancels the ~66 ms round-trip +
     # fetch overhead; the donated to_static state chains step N+1 on
     # step N, so the steps themselves cannot overlap or be elided.

@@ -28,7 +28,8 @@ from . import auto_parallel  # noqa: F401
 from .auto_parallel.api import (  # noqa: F401
     shard_tensor, reshard, dtensor_from_local, dtensor_to_local, shard_layer,
     shard_optimizer, to_static as dist_to_static, unshard_dtensor,
-    to_static, DistModel, DistAttr,
+    to_static, DistModel, DistAttr, moe_global_mesh_tensor,
+    moe_sub_mesh_tensors,
 )
 from . import communication  # noqa: F401
 from . import extras as _extras  # noqa: F401

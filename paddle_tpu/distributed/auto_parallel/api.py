@@ -29,7 +29,8 @@ from .process_mesh import ProcessMesh
 
 __all__ = ["shard_tensor", "reshard", "dtensor_from_local", "dtensor_to_local",
            "shard_layer", "shard_optimizer", "to_static", "unshard_dtensor",
-           "placements_to_spec", "DistAttr"]
+           "placements_to_spec", "DistAttr", "moe_global_mesh_tensor",
+           "moe_sub_mesh_tensors"]
 
 
 def placements_to_spec(placements: Sequence[Placement], ndim: int) -> P:
@@ -176,6 +177,106 @@ def dtensor_to_local(dist_tensor, mesh=None, placements=None):
     """The local shard for this process (single-process: addressable shard 0)."""
     shards = dist_tensor._data.addressable_shards
     return Tensor(shards[0].data)
+
+
+def _normalize_mesh_dim(mesh: ProcessMesh, local_mesh_dim: int) -> int:
+    ndim = mesh.ndim
+    if not -ndim <= local_mesh_dim < ndim:
+        raise ValueError(
+            f"local_mesh_dim {local_mesh_dim} out of range for mesh with "
+            f"{ndim} dims")
+    return local_mesh_dim % ndim
+
+
+def _sub_meshes(mesh: ProcessMesh, local_mesh_dim: int):
+    """Split `mesh` along `local_mesh_dim` into one sub-mesh per index
+    (e.g. a [ep, mp] mesh at dim 0 -> one [mp] mesh per expert group)."""
+    arr = np.asarray(mesh.process_ids).reshape(mesh.shape)
+    names = [n for i, n in enumerate(mesh.dim_names)
+             if i != local_mesh_dim]
+    return [ProcessMesh(np.take(arr, idx, axis=local_mesh_dim), names)
+            for idx in range(mesh.shape[local_mesh_dim])]
+
+
+def moe_global_mesh_tensor(local_tensor_list, mesh: ProcessMesh, placements,
+                          local_mesh_dim: int = -1):
+    """Parity: dist.moe_global_mesh_tensor (reference
+    `python/paddle/distributed/auto_parallel/api.py:462`, there named
+    over `_moe_global_mesh_tensor`). Build ONE dist tensor on the
+    global `mesh` from per-sub-mesh locals — the MoE pattern: each
+    expert group owns a local tensor on its sub-mesh (the global mesh
+    sliced along `local_mesh_dim`, conventionally the expert-parallel
+    axis); the returned global view concatenates them along the tensor
+    dim `placements[local_mesh_dim]` shards (or validates equality for
+    Replicate).
+
+    TPU-native: the locals are (sub-mesh-)jax.Arrays; the global view
+    is one device_put to the full-mesh NamedSharding — GSPMD then owns
+    the layout exactly as for any shard_tensor result.
+    """
+    dim = _normalize_mesh_dim(mesh, local_mesh_dim)
+    n_sub = mesh.shape[dim]
+    if len(local_tensor_list) != n_sub:
+        raise ValueError(
+            f"need one local tensor per sub-mesh: got "
+            f"{len(local_tensor_list)} for mesh dim of size {n_sub}")
+    arrs = [t._data if isinstance(t, Tensor) else jnp.asarray(t)
+            for t in local_tensor_list]
+    pl = placements[dim]
+    if isinstance(pl, Shard):
+        global_data = jnp.concatenate(arrs, axis=pl.get_dim())
+    elif isinstance(pl, Replicate):
+        for i, a in enumerate(arrs[1:], 1):
+            if a.shape != arrs[0].shape or not bool(
+                    jnp.array_equal(a, arrs[0])):
+                raise ValueError(
+                    f"Replicate on mesh dim {dim} requires identical "
+                    f"locals; sub-mesh {i} differs from sub-mesh 0")
+        global_data = arrs[0]
+    else:
+        raise ValueError(
+            "moe_global_mesh_tensor supports Shard/Replicate on the "
+            f"local mesh dim; got {pl!r} (Partial locals carry pending "
+            "reductions a stacked jax.Array cannot represent here)")
+    return shard_tensor(Tensor(global_data), mesh, placements)
+
+
+def moe_sub_mesh_tensors(dist_tensor, global_mesh: ProcessMesh = None,
+                         local_mesh_dim: int = -1,
+                         global_placements=None):
+    """Parity: dist.moe_sub_mesh_tensors (reference api.py:603) — the
+    inverse of moe_global_mesh_tensor: split a global dist tensor into
+    one local dist tensor per sub-mesh along `local_mesh_dim`. Shard on
+    the local mesh dim splits the tensor dim it names; Replicate hands
+    every sub-mesh the full view."""
+    mesh = global_mesh or getattr(dist_tensor, "process_mesh", None)
+    if mesh is None:
+        raise ValueError("dist_tensor carries no mesh and none was given")
+    placements = global_placements or \
+        getattr(dist_tensor, "placements", None)
+    if placements is None:
+        raise ValueError("dist_tensor carries no placements and none "
+                         "were given")
+    dim = _normalize_mesh_dim(mesh, local_mesh_dim)
+    pl = placements[dim]
+    local_placements = [p for i, p in enumerate(placements) if i != dim]
+    data = dist_tensor._data
+    n_sub = mesh.shape[dim]
+    if isinstance(pl, Shard):
+        td = pl.get_dim()
+        if data.shape[td] % n_sub:
+            raise ValueError(
+                f"tensor dim {td} of size {data.shape[td]} does not "
+                f"split over {n_sub} sub-meshes")
+        chunks = jnp.split(data, n_sub, axis=td)
+    elif isinstance(pl, Replicate):
+        chunks = [data] * n_sub
+    else:
+        raise ValueError(
+            "moe_sub_mesh_tensors supports Shard/Replicate on the local "
+            f"mesh dim; got {pl!r}")
+    return [shard_tensor(Tensor(c), sub, local_placements)
+            for c, sub in zip(chunks, _sub_meshes(mesh, dim))]
 
 
 def unshard_dtensor(dist_tensor):

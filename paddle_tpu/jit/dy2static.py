@@ -23,6 +23,16 @@ stop the loop (the while_loop lowerings); host-executed loops
 (concrete bounds, short unrolled tensor iteration) raise to the eager
 fallback instead of running a loop the flag cannot stop.
 
+Side-effect caveat, sibling of the cond one above (ADVICE r5 #1): a
+loop body that lowers to lax.scan / while_loop is TRACED ONCE — a call
+to a side-effecting builtin (`print`, `breakpoint`, `input`) inside it
+runs at trace time (once, printing tracer reprs), not per iteration.
+Mutation of python state is detected and keeps the loop eager (see the
+Restrictions below), but pure-output builtins are invisible to those
+checks, so the successful lowering emits a `UserWarning` naming the
+builtin instead (`_warn_trace_time_side_effects`) — the compiled result
+is numerically right; only the printing cadence changes.
+
 Restrictions (each skips the rewrite for that statement, keeping plain
 python semantics — the fallback still works):
   * branches containing return/break/continue/yield; loop bodies
@@ -87,6 +97,44 @@ def _break(reason, msg):
     _FALLBACK_COUNTS[reason] += 1
     _dy2static_debug_log(f"fallback[{reason}]: {msg}")
     return DygraphToStaticBreak(msg)
+
+
+_SIDE_EFFECT_BUILTINS = frozenset({"print", "breakpoint", "input"})
+
+
+def _global_loads_in_code(code):
+    """Names loaded as globals/builtins (LOAD_GLOBAL/LOAD_NAME), NOT
+    attribute accesses — co_names alone would flag `layer.input` as a
+    call of the builtin `input`."""
+    import dis
+    names = set()
+    for ins in dis.get_instructions(code):
+        if ins.opname in ("LOAD_GLOBAL", "LOAD_NAME"):
+            names.add(ins.argval)
+    for const in code.co_consts:
+        if isinstance(const, types.CodeType):
+            names |= _global_loads_in_code(const)
+    return names
+
+
+def _warn_trace_time_side_effects(body_fn, kind):
+    """A loop body lowered to a compiled loop (lax.scan / while_loop)
+    runs its python ONCE, at trace time — a `print` inside it prints a
+    tracer repr once instead of a value per iteration (module-docstring
+    caveat, ADVICE r5 #1). Mutating side effects are detected elsewhere
+    and keep the loop eager; pure-output builtins can't be, so warn."""
+    code = getattr(body_fn, "__code__", None)
+    if code is None:
+        return
+    found = sorted(_global_loads_in_code(code) & _SIDE_EFFECT_BUILTINS)
+    if found:
+        import warnings
+        warnings.warn(
+            f"loop body calling {', '.join(found)}() was compiled to a "
+            f"{kind}: the call ran ONCE at trace time (printing tracer "
+            "values), not per iteration. Wrap the loop in "
+            "paddle.jit.not_to_static (or drop the call) if you need "
+            "per-iteration side effects.", UserWarning, stacklevel=3)
 
 
 class _Undefined:
@@ -378,6 +426,7 @@ def _run_for_range(start, stop, step, body_fn, loop_vars, brk_idx=None):
             from .loop_grad import try_scan_range
             res = try_scan_range(i, st, sp, body_fn, carried, brk_idx)
             if res[0] == "done":
+                _warn_trace_time_side_effects(body_fn, "lax.scan")
                 return res[1]
             _, reason, i, vals = res
             tgt, carried = vals[0], tuple(vals[1:])
@@ -462,6 +511,7 @@ def _run_for_range(start, stop, step, body_fn, loop_vars, brk_idx=None):
         raise _break(
             "for-lower-failed",
             f"converted `for` could not lower to while_loop: {e}") from e
+    _warn_trace_time_side_effects(body_fn, "while_loop")
     return tuple(res[1:])
 
 
@@ -542,6 +592,7 @@ def _run_for_iter(seq, body_fn, loop_vars, brk_idx=None):
             res, reason = loop_grad.try_scan_iter(seq, body_fn, vals,
                                                   cap.externals, brk_idx)
             if res is not None:
+                _warn_trace_time_side_effects(body_fn, "lax.scan")
                 return res
             if reason is not None:
                 _note(reason if reason == "rng-draw" else "scan-declined")
@@ -570,6 +621,7 @@ def _run_for_iter(seq, body_fn, loop_vars, brk_idx=None):
                     lambda k, t, *vs: (Tensor(k._data + 1),) + tuple(
                         body_fn(Tensor(seq._data[k._data]), *vs)),
                     [k0] + seeds)
+                _warn_trace_time_side_effects(body_fn, "while_loop")
                 return tuple(res[1:])
             except Exception as e:
                 _dy2static_debug_log(
@@ -659,11 +711,13 @@ def _run_while(cond_fn, body_fn, loop_vars, brk_idx=None):
             return _t_and(_t_not(vs[brk_idx]), cond_fn(*vs))
     from ..static import nn as snn
     try:
-        return tuple(snn.while_loop(cond2, body_fn, list(loop_vars)))
+        res = tuple(snn.while_loop(cond2, body_fn, list(loop_vars)))
     except Exception as e:
         raise _break(
             "while-lower-failed",
             f"converted `while` could not lower to while_loop: {e}") from e
+    _warn_trace_time_side_effects(body_fn, "while_loop")
+    return res
 
 
 # --------------------------------------------------------- AST analysis

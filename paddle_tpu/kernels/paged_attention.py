@@ -247,30 +247,36 @@ def paged_attention_decode(q, k_cache, v_cache, block_tables, seq_lens,
 
 
 def paged_cache_write_range(k_cache, v_cache, k_new, v_new, block_table,
-                            length):
-    """Scatter a whole prefill's K/V (one sequence) into the paged cache.
+                            length, start=0):
+    """Scatter a prefill span's K/V (one sequence) into the paged cache.
 
-    k_new/v_new:  (S, KVH, D) — keys/values for token positions 0..S-1
-                  (S may exceed `length`: the tail is prompt padding).
+    k_new/v_new:  (S, KVH, D) — keys/values for token positions
+                  start..start+S-1 (S may exceed `length`: the tail is
+                  prompt padding).
     block_table:  (max_pages,) int32 — the sequence's page ids; slot j
                   covers tokens [j*page_size, (j+1)*page_size).
-    length:       () int32 — live tokens; positions >= length are routed
-                  to page 0, the reserved pad page the decode kernel
-                  never reads un-masked (same contract as the padded
-                  block-table slots in `paged_attention_decode`).
+    length:       () int32 — live tokens IN THIS SPAN; span positions
+                  >= length are routed to page 0, the reserved pad page
+                  the decode kernel never reads un-masked (same contract
+                  as the padded block-table slots in
+                  `paged_attention_decode`).
+    start:        () int32 — absolute token position of k_new[0]
+                  (chunked prefill writes a partial prompt at an
+                  offset; whole-prompt callers keep the default 0).
     Returns the updated (k_cache, v_cache).
 
     Serving prefill companion of `paged_cache_write`: one scatter moves
-    the whole prompt instead of a token per step, so the engine's
-    prefill program is a single fused write (the read path stays the
-    Pallas kernel).
+    a whole chunk instead of a token per step, so the engine's prefill
+    program is a single fused write (the read path stays the Pallas
+    kernel).
     """
     num_pages, KVH, page_size, D = k_cache.shape
     S = k_new.shape[0]
     t = jnp.arange(S, dtype=jnp.int32)
     live = t < jnp.asarray(length, jnp.int32)
-    page_idx = jax.lax.div(t, jnp.int32(page_size))
-    page_off = jax.lax.rem(t, jnp.int32(page_size))
+    pos = t + jnp.asarray(start, jnp.int32)
+    page_idx = jax.lax.div(pos, jnp.int32(page_size))
+    page_off = jax.lax.rem(pos, jnp.int32(page_size))
     pages = jnp.where(live, block_table.astype(jnp.int32)[page_idx], 0)
     heads = jnp.arange(KVH, dtype=jnp.int32)
     idx = jnp.stack([

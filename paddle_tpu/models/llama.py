@@ -206,6 +206,65 @@ class LlamaAttention(nn.Layer):
         out = M.reshape(out, [b, s, self.n_heads * self.head_dim])
         return self.o_proj(out), k_cache, v_cache
 
+    def forward_paged_prefill(self, x, cos_c, sin_c, k_cache, v_cache,
+                              block_table, cache_len, chunk_len):
+        """One CHUNK of prompt prefill over the paged cache (the chunked
+        prefill / prefix-cache serving path).
+
+        x (1, S, hidden) holds tokens at absolute positions
+        cache_len..cache_len+S-1, of which only the first chunk_len are
+        live (the rest is bucket padding); cos_c/sin_c (S, D/2) are the
+        rope rows already gathered at those absolute positions;
+        block_table (P,) is the sequence's page ids (PAD_PAGE-padded).
+        Writes the chunk's roped K/V into the pages at offset cache_len,
+        then attends over the GATHERED dense view of the sequence's
+        pages — the cached prefix [0, cache_len) plus the chunk itself —
+        with a position mask kpos <= cache_len + i. Prefill is
+        compute-bound, so one XLA gather per layer is the right
+        capability-axis cost; a fused chunk-attention Pallas kernel is a
+        perf follow-up (BASELINE). Returns (out, k_cache, v_cache).
+        """
+        from ..kernels.paged_attention import paged_cache_write_range
+        b, s, _ = x.shape
+        q = M.reshape(self.q_proj(x), [b, s, self.n_heads, self.head_dim])
+        k = M.reshape(self.k_proj(x), [b, s, self.n_kv, self.head_dim])
+        v = M.reshape(self.v_proj(x), [b, s, self.n_kv, self.head_dim])
+        q = apply_op("rope", apply_rotary, q, cos_c, sin_c)
+        k = apply_op("rope", apply_rotary, k, cos_c, sin_c)
+
+        def _write(kc, vc, kn, vn, bt, ln, st):
+            return paged_cache_write_range(kc, vc, kn[0], vn[0], bt, ln, st)
+
+        k_cache, v_cache = apply_op("paged_cache_write_range", _write,
+                                    k_cache, v_cache, k, v, block_table,
+                                    chunk_len, cache_len)
+        n_kv, hd = self.n_kv, self.head_dim
+
+        def _gather(cache, bt):
+            g = jnp.take(cache, bt.astype(jnp.int32), axis=0)
+            g = jnp.swapaxes(g, 1, 2)          # (P, page, KVH, D)
+            return g.reshape(1, -1, n_kv, hd)  # (1, P*page, KVH, D)
+
+        kd = apply_op("paged_gather", _gather, k_cache, block_table)
+        vd = apply_op("paged_gather", _gather, v_cache, block_table)
+        if self.n_kv != self.n_heads:
+            rep = self.n_heads // self.n_kv
+            kd = apply_op("repeat_kv",
+                          lambda a: jnp.repeat(a, rep, axis=2), kd)
+            vd = apply_op("repeat_kv",
+                          lambda a: jnp.repeat(a, rep, axis=2), vd)
+        sk = int(kd.shape[1])
+
+        def _mask(cl):
+            qpos = jnp.asarray(cl, jnp.int32) + jnp.arange(s, dtype=jnp.int32)
+            kpos = jnp.arange(sk, dtype=jnp.int32)
+            return (kpos[None, :] <= qpos[:, None])[None, None]
+
+        mask = apply_op("chunk_mask", _mask, cache_len)
+        out = F.scaled_dot_product_attention(q, kd, vd, attn_mask=mask)
+        out = M.reshape(out, [b, s, self.n_heads * self.head_dim])
+        return self.o_proj(out), k_cache, v_cache
+
 
 def apply_rotary_positions(x, cos_b, sin_b):
     """Rotary at PER-ROW positions: x (B, 1, H, D), cos_b/sin_b (B, D/2)
@@ -262,6 +321,16 @@ class LlamaDecoderLayer(nn.Layer):
         h = self.input_layernorm(x)
         attn, k_cache, v_cache = self.self_attn.forward_paged(
             h, cos_b, sin_b, k_cache, v_cache, block_tables, seq_lens)
+        x = x + attn
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x, k_cache, v_cache
+
+    def forward_paged_prefill(self, x, cos_c, sin_c, k_cache, v_cache,
+                              block_table, cache_len, chunk_len):
+        h = self.input_layernorm(x)
+        attn, k_cache, v_cache = self.self_attn.forward_paged_prefill(
+            h, cos_c, sin_c, k_cache, v_cache, block_table, cache_len,
+            chunk_len)
         x = x + attn
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x, k_cache, v_cache
@@ -330,6 +399,37 @@ class LlamaModel(nn.Layer):
             kc, vc = paged_caches[i]
             x, kc, vc = layer.forward_paged(x, cos_b, sin_b, kc, vc,
                                             block_tables, seq_lens)
+            new_caches.append((kc, vc))
+        return self.norm(x), new_caches
+
+    def forward_paged_prefill(self, input_ids, paged_caches, block_table,
+                              cache_len, chunk_len):
+        """One prefill CHUNK over per-layer paged KV caches.
+
+        input_ids (1, S) — prompt tokens at absolute positions
+        cache_len..cache_len+S-1 (first chunk_len live, rest padding);
+        block_table (P,) — the sequence's pages. Returns
+        (hidden (1, S, H), new_caches). Chunked prefill and radix
+        prefix-cache hits are the same program: a hit just starts at
+        cache_len = matched tokens."""
+        s = input_ids.shape[1]
+
+        def _gather_rope(c, cl):
+            pos = jnp.asarray(cl, jnp.int32) + jnp.arange(s, dtype=jnp.int32)
+            # padded tail positions may run past the rope table; clip —
+            # their rows are masked out of the attention anyway
+            return jnp.take(c, jnp.clip(pos, 0, c.shape[0] - 1), axis=0)
+
+        cos_c = apply_op("rope_gather", _gather_rope, self.rope_cos,
+                         cache_len)
+        sin_c = apply_op("rope_gather", _gather_rope, self.rope_sin,
+                         cache_len)
+        x = self.embed_tokens(input_ids)
+        new_caches = []
+        for i, layer in enumerate(self.layers):
+            kc, vc = paged_caches[i]
+            x, kc, vc = layer.forward_paged_prefill(
+                x, cos_c, sin_c, kc, vc, block_table, cache_len, chunk_len)
             new_caches.append((kc, vc))
         return self.norm(x), new_caches
 
@@ -411,6 +511,25 @@ class LlamaForCausalLM(nn.Layer):
             input_ids, paged_caches, block_tables, seq_lens)
         tied = self.model.embed_tokens.weight if self.lm_head is None else None
         logits = _head_and_loss(h, None, self.lm_head, tied)
+        return logits, caches
+
+    def forward_paged_prefill(self, input_ids, paged_caches, block_table,
+                              cache_len, chunk_len):
+        """Serving prefill chunk: paged-KV transformer + LM head at the
+        chunk's LAST LIVE position only — the sole row serving consumes
+        (and only on the final chunk at that); a full (S, V) head would
+        spend ~S x the head FLOPs per chunk for nothing.
+        Returns (logits (1, 1, V), new_caches)."""
+        h, caches = self.model.forward_paged_prefill(
+            input_ids, paged_caches, block_table, cache_len, chunk_len)
+
+        def _last(hh, ln):
+            return jax.lax.dynamic_slice_in_dim(
+                hh, jnp.asarray(ln, jnp.int32) - 1, 1, axis=1)
+
+        h_last = apply_op("chunk_last", _last, h, chunk_len)
+        tied = self.model.embed_tokens.weight if self.lm_head is None else None
+        logits = _head_and_loss(h_last, None, self.lm_head, tied)
         return logits, caches
 
     # -------------------------------------------------------- generation

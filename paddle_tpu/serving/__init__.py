@@ -1,14 +1,18 @@
 """paddle_tpu.serving — continuous-batching inference engine.
 
 Architecture (SERVING.md): Orca-style iteration-level scheduling +
-vLLM-style paged KV management, compiled into a bounded grid of
-bucketed XLA programs over the chip-validated paged-attention kernels.
+vLLM-style paged KV management + SGLang-style radix prefix caching +
+Sarathi-style chunked prefill, compiled into a bounded grid of bucketed
+XLA programs over the chip-validated paged-attention kernels.
 """
 from .engine import ServingEngine
 from .kv_cache import BlockAllocator, BlocksExhausted, KVSequence, PAD_PAGE
 from .metrics import ServingMetrics
-from .scheduler import Request, RequestState, ScheduleStep, Scheduler
+from .radix_cache import RadixCache, RadixNode
+from .scheduler import (PrefillChunk, Request, RequestState, ScheduleStep,
+                        Scheduler)
 
 __all__ = ["ServingEngine", "BlockAllocator", "BlocksExhausted",
-           "KVSequence", "PAD_PAGE", "ServingMetrics", "Request",
-           "RequestState", "ScheduleStep", "Scheduler"]
+           "KVSequence", "PAD_PAGE", "ServingMetrics", "RadixCache",
+           "RadixNode", "PrefillChunk", "Request", "RequestState",
+           "ScheduleStep", "Scheduler"]

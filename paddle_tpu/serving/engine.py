@@ -1,33 +1,41 @@
 """ServingEngine: continuous-batching inference over the paged-KV kernels.
 
-The XLA-shaped answer to Orca/vLLM-style serving: iteration-level
-scheduling and block-based KV management run on the host (scheduler.py /
-kv_cache.py), while all device work funnels through a SMALL, FIXED set of
-compiled programs — one per shape bucket — so continuous batching never
-triggers unbounded recompilation:
+The XLA-shaped answer to Orca/vLLM/SGLang-style serving: iteration-level
+scheduling, block-based KV management and the radix prefix cache run on
+the host (scheduler.py / kv_cache.py / radix_cache.py), while all device
+work funnels through a SMALL, FIXED set of compiled programs — one per
+shape bucket — so continuous batching never triggers unbounded
+recompilation:
 
-  * prefill program, keyed by (prompt-length bucket): runs the model's
-    ordinary cached forward (via jit.api.functional_call — the same
-    state-swap machinery to_static/jit.save use) on ONE padded prompt,
-    scatters the resulting per-layer K/V into the paged cache with
-    `paged_cache_write_range`, and samples the first token;
+  * prefill CHUNK program, keyed by (chunk-length bucket, block-table
+    bucket): processes one span of ONE padded prompt through
+    `model.forward_paged_prefill` — rope at absolute positions,
+    `paged_cache_write_range` at the chunk's offset, attention over the
+    gathered paged prefix — and samples a token from the chunk's last
+    live position (used only when the chunk completes the prompt).
+    Whole-prompt prefill, chunked prefill, and radix prefix-cache hits
+    are all THIS ONE program: a hit just starts at cache_len = matched
+    tokens, so cache on/off cannot change program shapes (the
+    determinism contract, SERVING.md);
   * decode program, keyed by (batch bucket, block-table-width bucket):
     one batched step through `model.forward_paged_decode` — per-row rope
     positions, `paged_cache_write` of the current token, Pallas
     `paged_attention_decode` over the block tables — plus sampling.
 
-Shape buckets pad up: a prompt of 19 tokens runs in the 32-bucket, a
-decode batch of 5 in the 8-bucket. The recompile counter (metrics) is
-bounded by the bucket grid, which the engine test asserts.
+Shape buckets pad up: a 19-token chunk runs in the 32-bucket, a decode
+batch of 5 in the 8-bucket. The recompile counter (metrics) is bounded
+by the bucket grid, which the engine test asserts.
 
 Determinism contract: greedy decode is deterministic, and a request's
-tokens are bit-identical whether it runs alone or batched with others —
+tokens are bit-identical whether it runs alone or batched with others,
+and whether its prefix came from the radix cache or its own prefill —
 PROVIDED the same shape buckets are hit (XLA does not promise identical
 rounding across different program shapes; rows within one program are
-independent). The acceptance test pins one decode bucket for exactly
-this reason. Sampled decode draws from one engine-level key stream and
-is reproducible per (engine seed, arrival order) but not across
-different interleavings.
+independent). The acceptance tests pin single buckets for exactly this
+reason. Sampled decode draws from one engine-level key stream (final
+chunks and decode steps draw; non-final chunks do not) and is
+reproducible per (engine seed, arrival order) but not across different
+interleavings.
 """
 from __future__ import annotations
 
@@ -44,6 +52,7 @@ from ..jit.api import functional_call
 from ..models.generation import _sample_arr
 from .kv_cache import BlockAllocator, PAD_PAGE
 from .metrics import ServingMetrics
+from .radix_cache import RadixCache
 from .scheduler import Request, RequestState, Scheduler
 
 __all__ = ["ServingEngine"]
@@ -70,9 +79,11 @@ def _pow2_buckets(lo: int, hi: int) -> List[int]:
 class ServingEngine:
     """Continuous-batching engine over a causal LM with paged-KV decode.
 
-    model: a LlamaForCausalLM-protocol model — `forward(ids, caches=...)`
-    for prefill and `forward_paged_decode(ids, paged_caches,
-    block_tables, seq_lens)` for batched decode.
+    model: a LlamaForCausalLM-protocol model — `forward_paged_prefill`
+    for (chunked) prompt processing and `forward_paged_decode` for the
+    batched decode step, both over the engine-owned paged caches.
+    enable_prefix_cache turns the radix tree on (default); off, the
+    engine behaves like PR 1 plus chunked prefill.
     """
 
     def __init__(self, model, *, num_pages: int = 128, page_size: int = 16,
@@ -82,7 +93,8 @@ class ServingEngine:
                  pages_buckets: Optional[List[int]] = None,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, seed: int = 0,
-                 max_retained_finished: int = 1024):
+                 max_retained_finished: int = 1024,
+                 enable_prefix_cache: bool = True):
         cfg = model.cfg
         self.model = model
         self.cfg = cfg
@@ -95,6 +107,10 @@ class ServingEngine:
         self.top_k = int(top_k)
         self.top_p = float(top_p)
         self._key = jax.random.PRNGKey(seed)
+        # non-final chunks pass a fixed key (their sampled token is
+        # discarded) so the engine's key stream advances once per token
+        # actually emitted, not once per chunk
+        self._null_key = jax.random.PRNGKey(0)
 
         # serving weights are immutable: snapshot the flat {name: array}
         # view once instead of re-walking state_dict() every step
@@ -132,10 +148,13 @@ class ServingEngine:
             raise ValueError("prefill bucket exceeds max sequence length")
 
         self.allocator = BlockAllocator(self.num_pages, self.page_size)
+        self.radix = (RadixCache(self.allocator)
+                      if enable_prefix_cache else None)
         self.scheduler = Scheduler(
             self.allocator, max_batch_size=self.batch_buckets[-1],
-            token_budget=token_budget,
-            max_prompt_len=self.prefill_buckets[-1])
+            token_budget=min(token_budget, self.prefill_buckets[-1]),
+            max_prompt_len=self.max_seq_len,
+            prefix_cache=self.radix)
         # per-engine provider name: two live engines must not shadow each
         # other in profiler.counters(), nor unregister each other
         self.metrics = ServingMetrics(
@@ -168,18 +187,10 @@ class ServingEngine:
                 f"prompt {len(req.prompt_ids)} + max_new_tokens "
                 f"{req.max_new_tokens} exceeds max_seq_len "
                 f"{self.max_seq_len}")
-        # recompute preemption re-prefills prompt+generated, which can
-        # reach prompt + max_new - 1 tokens — every possible resume must
-        # fit the prefill bucket grid, or a preemption could strand the
-        # request un-resumable mid-flight
-        worst_resume = len(req.prompt_ids) + req.max_new_tokens - 1
-        if worst_resume > self.prefill_buckets[-1]:
-            raise ValueError(
-                f"prompt {len(req.prompt_ids)} + max_new_tokens "
-                f"{req.max_new_tokens} could resume at {worst_resume} "
-                f"tokens after a preemption > largest prefill bucket "
-                f"{self.prefill_buckets[-1]}; widen prefill_buckets or "
-                f"lower max_new_tokens")
+        # NOTE: PR 1 also rejected requests whose post-preemption resume
+        # (prompt + max_new - 1) outsized the largest prefill bucket.
+        # Chunked prefill removed that failure mode: a resume of any
+        # length within max_seq_len re-prefills in budget-sized chunks.
         self.requests[req.request_id] = req
         self.scheduler.add_request(req)
         self.metrics.on_add(req.request_id)
@@ -207,57 +218,54 @@ class ServingEngine:
 
     def max_program_count(self) -> int:
         """The bucket-grid bound the recompile counter can never exceed."""
-        return (len(self.prefill_buckets)
-                + len(self.batch_buckets) * len(self.pages_buckets))
+        return ((len(self.prefill_buckets) + len(self.batch_buckets))
+                * len(self.pages_buckets))
 
-    # ---------------------------------------------------------- prefill
-    def _build_prefill(self, S: int):
-        """One padded prompt -> paged cache + first sampled token."""
-        L, KV, D = self.num_layers, self.num_kv, self.head_dim
-        model, dtype = self.model, self._cache_dtype
+    # ----------------------------------------------------- prefill chunks
+    def _build_chunk(self, S: int, P: int):
+        """One padded prompt CHUNK -> paged cache + sampled token (the
+        token is only consumed when the chunk is the prompt's last)."""
+        L = self.num_layers
+        model = self.model
         temperature, top_k, top_p = self.temperature, self.top_k, self.top_p
 
-        def program(state, kcs, vcs, ids, true_len, bt, key):
+        def program(state, kcs, vcs, ids, cache_len, live, bt, key):
             st = {k: Tensor(v) for k, v in state.items()}
-            empty = [(Tensor(jnp.zeros((1, 0, KV, D), dtype)),
-                      Tensor(jnp.zeros((1, 0, KV, D), dtype)))
-                     for _ in range(L)]
-            logits, caches = functional_call(model, st, Tensor(ids),
-                                             caches=empty)
-            from ..kernels.paged_attention import paged_cache_write_range
-            new_kcs, new_vcs = [], []
-            for l in range(L):
-                k_seq = caches[l][0]._data[0]        # (S, KV, D), roped
-                v_seq = caches[l][1]._data[0]
-                kc, vc = paged_cache_write_range(kcs[l], vcs[l], k_seq,
-                                                 v_seq, bt, true_len)
-                new_kcs.append(kc)
-                new_vcs.append(vc)
-            last = logits._data[0, true_len - 1]      # (V,) at prompt end
+            paged = [(Tensor(kcs[l]), Tensor(vcs[l])) for l in range(L)]
+            logits, caches = functional_call(
+                model, st, Tensor(ids), paged, Tensor(bt),
+                Tensor(cache_len), Tensor(live),
+                method="forward_paged_prefill")
+            last = logits._data[0, 0]   # head ran at the chunk end only
             tok = _sample_arr(last[None], key, temperature, top_k, top_p)[0]
-            return tok, new_kcs, new_vcs
+            return (tok, [c[0]._data for c in caches],
+                    [c[1]._data for c in caches])
 
         return jax.jit(program, donate_argnums=self._donate)
 
-    def _run_prefill(self, req: Request):
+    def _run_chunk(self, chunk):
         from .. import profiler
-        ids = req.resume_ids
-        n = len(ids)
-        S = _bucket_for(n, self.prefill_buckets)
-        prog = self._get_program(("prefill", S),
-                                 lambda: self._build_prefill(S))
-        P = -(-S // self.page_size)                  # table rows the
-        bt = np.full((P,), PAD_PAGE, np.int32)       # scatter may index
-        bt[:len(req.seq.pages)] = req.seq.pages
+        req = chunk.request
+        ids = req.resume_ids[chunk.start:chunk.start + chunk.length]
+        S = _bucket_for(chunk.length, self.prefill_buckets)
+        P = _bucket_for(
+            self.allocator.pages_needed(chunk.start + chunk.length),
+            self.pages_buckets)
+        prog = self._get_program(("chunk", S, P),
+                                 lambda: self._build_chunk(S, P))
+        bt = np.full((P,), PAD_PAGE, np.int32)
+        npages = min(len(req.seq.pages), P)
+        bt[:npages] = req.seq.pages[:npages]
         padded = np.zeros((1, S), np.int32)
-        padded[0, :n] = ids
-        with profiler.RecordEvent("serving.prefill"), no_grad():
+        padded[0, :chunk.length] = ids
+        key = self._next_key() if chunk.is_last else self._null_key
+        with profiler.RecordEvent("serving.prefill_chunk"), no_grad():
             tok, self._k_caches, self._v_caches = prog(
                 self._state, self._k_caches, self._v_caches,
-                jnp.asarray(padded), jnp.int32(n), jnp.asarray(bt),
-                self._next_key())
-        self.metrics.on_prefill(n)
-        return int(tok)
+                jnp.asarray(padded), jnp.int32(chunk.start),
+                jnp.int32(chunk.length), jnp.asarray(bt), key)
+        self.metrics.on_prefill(chunk.length)
+        return tok
 
     # ----------------------------------------------------------- decode
     def _build_decode(self, B: int, P: int):
@@ -298,6 +306,9 @@ class ServingEngine:
             toks, self._k_caches, self._v_caches = prog(
                 self._state, self._k_caches, self._v_caches, jnp.asarray(ids),
                 jnp.asarray(bt), jnp.asarray(sl), self._next_key())
+        for r in reqs:
+            # this step wrote the K/V of each row's input token
+            r.num_computed = r.seq.num_tokens
         self.metrics.on_decode(len(reqs))
         return np.asarray(toks)
 
@@ -325,22 +336,29 @@ class ServingEngine:
         return None
 
     def step(self):
-        """One engine iteration: schedule, prefill admitted prompts,
-        run the batched decode step. Returns [(request_id, token)] in
-        emission order (empty when idle)."""
+        """One engine iteration: schedule, run prefill chunks, run the
+        batched decode step. Returns [(request_id, token)] in emission
+        order (empty when idle)."""
         emitted = []
         sched = self.scheduler.schedule()
         for req in sched.preempted:
             self.metrics.on_preempt()
 
-        for req in sched.prefills:
-            tok = self._run_prefill(req)
-            reason = self._emit(req, tok, emitted)
-            if reason is not None:
-                self.scheduler.finish(req, reason)
-                self._on_finished(req)
-            else:
-                self.scheduler.on_prefilled(req)
+        for chunk in sched.prefills:
+            req = chunk.request
+            if chunk.is_first:
+                self.metrics.on_admission(req.request_id,
+                                          req.cached_tokens,
+                                          resumed=req.num_preemptions > 0)
+            tok = self._run_chunk(chunk)
+            req.num_computed = chunk.start + chunk.length
+            if chunk.is_last:
+                reason = self._emit(req, int(tok), emitted)
+                if reason is not None:
+                    self.scheduler.finish(req, reason)
+                    self._on_finished(req)
+                else:
+                    self.scheduler.on_prefilled(req)
 
         if sched.decodes:
             for req in sched.decodes:
@@ -358,7 +376,11 @@ class ServingEngine:
             queue_depth=self.scheduler.queue_depth,
             running=len(self.scheduler.running),
             kv_used_pages=self.allocator.num_used,
-            kv_occupancy=self.allocator.occupancy())
+            kv_occupancy=self.allocator.occupancy(),
+            cached_pages=self.radix.num_cached_pages if self.radix else 0,
+            radix_nodes=self.radix.num_nodes if self.radix else 0,
+            radix_evicted_pages=(self.radix.num_evicted_pages
+                                 if self.radix else None))
         return emitted
 
     def _on_finished(self, req: Request):
@@ -367,6 +389,16 @@ class ServingEngine:
         while len(self._finished_order) > self.max_retained_finished:
             self.requests.pop(self._finished_order.pop(0), None)
             self.num_evicted_finished += 1
+
+    # --------------------------------------------------- prefix cache ops
+    def reset_prefix_cache(self) -> int:
+        """Drop every cached prefix (the tree's page refs release);
+        returns the number of pages returned to the free list. With no
+        live requests this brings allocator occupancy back to zero —
+        the drain-reclamation check in the acceptance test."""
+        if self.radix is None:
+            return 0
+        return self.radix.clear()
 
     # ------------------------------------------------------- convenience
     def stream(self):

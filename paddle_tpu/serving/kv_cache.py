@@ -118,6 +118,30 @@ class BlockAllocator:
         seq.num_tokens = num_tokens
         return seq
 
+    def alloc_sequence_with_prefix(self, num_tokens,
+                                   prefix_pages) -> KVSequence:
+        """Pages for `num_tokens` tokens whose first
+        len(prefix_pages) * page_size tokens are already cached: the
+        prefix pages are SHARED (refcounts bumped — the radix tree or a
+        donor sequence keeps its own refs) and only the remainder is
+        freshly allocated. All-or-nothing like alloc_sequence."""
+        need = self.pages_needed(num_tokens)
+        if len(prefix_pages) > need:
+            raise ValueError(
+                f"prefix of {len(prefix_pages)} pages exceeds the "
+                f"{need} pages {num_tokens} tokens need")
+        fresh = need - len(prefix_pages)
+        if fresh > self.num_free:
+            raise BlocksExhausted(
+                f"need {fresh} fresh pages, {self.num_free} free")
+        seq = KVSequence()
+        for pid in prefix_pages:
+            self._incref(pid)
+        seq.pages = list(prefix_pages) + \
+            [self._alloc_page() for _ in range(fresh)]
+        seq.num_tokens = num_tokens
+        return seq
+
     def append_token(self, seq: KVSequence) -> List[Tuple[int, int]]:
         """Grow `seq` by one token, returning the (src_page, dst_page)
         device copies the caller must perform (copy-on-write when the

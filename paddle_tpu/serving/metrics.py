@@ -7,13 +7,31 @@ Two integration points with the existing profiler subsystem:
   * a ServingMetrics registers itself as a profiler counter provider
     (`profiler.register_counter_provider`), so `Profiler.summary()`
     appends the live serving counters to its table.
+
+Prefix-cache / chunked-prefill observability (ISSUE 2): prefix hit
+rate, cached-tokens-served, prefill-tokens-skipped, radix evictions,
+prefill chunks, and per-request queue-wait / TTFT percentiles (bounded
+reservoirs — a long-lived server keeps the last `PERCENTILE_WINDOW`
+samples, not one entry per request ever served).
 """
 from __future__ import annotations
 
 import time
+from collections import deque
 from typing import Dict, Optional
 
 __all__ = ["ServingMetrics"]
+
+PERCENTILE_WINDOW = 1024
+
+
+def _percentile(samples, q):
+    """Nearest-rank percentile over a small window (no numpy needed)."""
+    if not samples:
+        return None
+    s = sorted(samples)
+    idx = min(len(s) - 1, max(0, int(round(q / 100.0 * (len(s) - 1)))))
+    return s[idx]
 
 
 class ServingMetrics:
@@ -29,34 +47,66 @@ class ServingMetrics:
             "decode_tokens": 0,
             "engine_steps": 0,
             "recompiles": 0,
+            # --- prefix cache / chunked prefill (ISSUE 2) ---
+            "prefill_chunks": 0,           # chunk launches (incl. final)
+            "admissions": 0,               # first-chunk admissions
+            "prefix_hits": 0,              # admissions with a cache match
+            "cached_tokens_served": 0,     # matched tokens reused from cache
+            "prefill_tokens_skipped": 0,   # prefill work those tokens saved
+            "radix_evicted_pages": 0,
         }
         self._registered = False
         self._t_start = time.perf_counter()
         self._arrive_t: Dict[int, float] = {}   # in-flight only (popped
-        # on finish) — the TTFT record is a running aggregate so a
-        # long-lived server doesn't keep a per-request entry forever
+        # on finish) — aggregates + bounded reservoirs, so a long-lived
+        # server doesn't keep a per-request entry forever
         self._ttft_sum = 0.0
         self._ttft_count = 0
+        self._ttft_samples: deque = deque(maxlen=PERCENTILE_WINDOW)
+        self._queue_wait_samples: deque = deque(maxlen=PERCENTILE_WINDOW)
         # gauges updated by the engine each step
         self.queue_depth = 0
         self.running = 0
         self.kv_used_pages = 0
         self.kv_occupancy = 0.0
+        self.cached_pages = 0
+        self.radix_nodes = 0
 
     # ---- event hooks -----------------------------------------------------
     def on_add(self, request_id: int):
         self.counters["requests_added"] += 1
         self._arrive_t[request_id] = time.perf_counter()
 
+    def on_admission(self, request_id: int, cached_tokens: int,
+                     resumed: bool = False):
+        """First chunk of an admission scheduled. `admissions` and the
+        hit accounting count RE-admissions after preemption too (a
+        donated prefix turning a resume into a hit is the point);
+        the queue-wait sample is taken only for the ORIGINAL admission —
+        on a resume the arrival-to-now span includes time already spent
+        running, which is not queue wait."""
+        self.counters["admissions"] += 1
+        if cached_tokens > 0:
+            self.counters["prefix_hits"] += 1
+            self.counters["cached_tokens_served"] += cached_tokens
+            self.counters["prefill_tokens_skipped"] += cached_tokens
+        if not resumed:
+            t0 = self._arrive_t.get(request_id)
+            if t0 is not None:
+                self._queue_wait_samples.append(time.perf_counter() - t0)
+
     def on_first_token(self, request_id: int):
         # called once per request (the engine guards on num_generated==0)
         t0 = self._arrive_t.get(request_id)
         if t0 is not None:
-            self._ttft_sum += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            self._ttft_sum += dt
             self._ttft_count += 1
+            self._ttft_samples.append(dt)
 
     def on_prefill(self, num_tokens: int):
         self.counters["prefill_tokens"] += num_tokens
+        self.counters["prefill_chunks"] += 1
 
     def on_decode(self, num_tokens: int):
         self.counters["decode_tokens"] += num_tokens
@@ -75,11 +125,16 @@ class ServingMetrics:
         self.counters["recompiles"] += 1
 
     def update_gauges(self, *, queue_depth, running, kv_used_pages,
-                      kv_occupancy):
+                      kv_occupancy, cached_pages=0, radix_nodes=0,
+                      radix_evicted_pages=None):
         self.queue_depth = queue_depth
         self.running = running
         self.kv_used_pages = kv_used_pages
         self.kv_occupancy = kv_occupancy
+        self.cached_pages = cached_pages
+        self.radix_nodes = radix_nodes
+        if radix_evicted_pages is not None:
+            self.counters["radix_evicted_pages"] = radix_evicted_pages
 
     # ---- derived ---------------------------------------------------------
     def tokens_per_second(self) -> float:
@@ -92,6 +147,20 @@ class ServingMetrics:
             return None
         return self._ttft_sum / self._ttft_count
 
+    def prefix_hit_rate(self) -> Optional[float]:
+        if not self.counters["admissions"]:
+            return None
+        return self.counters["prefix_hits"] / self.counters["admissions"]
+
+    def ttft_percentiles(self):
+        """{p50, p90, p99} seconds over the bounded TTFT window."""
+        return {f"p{q}": _percentile(self._ttft_samples, q)
+                for q in (50, 90, 99)}
+
+    def queue_wait_percentiles(self):
+        return {f"p{q}": _percentile(self._queue_wait_samples, q)
+                for q in (50, 90, 99)}
+
     def snapshot(self) -> dict:
         snap = dict(self.counters)
         snap.update({
@@ -99,11 +168,21 @@ class ServingMetrics:
             "running": self.running,
             "kv_used_pages": self.kv_used_pages,
             "kv_occupancy": round(self.kv_occupancy, 4),
+            "cached_pages": self.cached_pages,
+            "radix_nodes": self.radix_nodes,
             "tokens_per_second": round(self.tokens_per_second(), 2),
         })
+        hr = self.prefix_hit_rate()
+        if hr is not None:
+            snap["prefix_hit_rate"] = round(hr, 4)
         ttft = self.mean_ttft()
         if ttft is not None:
             snap["mean_ttft_ms"] = round(ttft * 1e3, 3)
+        for label, pct in (("ttft", self.ttft_percentiles()),
+                           ("queue_wait", self.queue_wait_percentiles())):
+            for q, v in pct.items():
+                if v is not None:
+                    snap[f"{label}_{q}_ms"] = round(v * 1e3, 3)
         return snap
 
     # ---- profiler integration -------------------------------------------

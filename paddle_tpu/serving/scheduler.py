@@ -4,19 +4,35 @@ Follows Orca's iteration-level scheduling (Yu et al., OSDI '22): every
 engine step re-forms the batch from whatever is in flight, so a finishing
 request's slot is reused immediately instead of waiting for the whole
 batch to drain. Admission is FCFS under a per-step token budget; memory
-pressure is resolved by preempt-by-eviction (vLLM-style recompute
+pressure is resolved by cached-prefix LRU eviction first (radix tree,
+when enabled), then preempt-by-eviction (vLLM-style recompute
 preemption: the victim's pages are freed and it re-enters the waiting
 queue with its generated tokens folded into the prompt).
 
+Two serving optimizations ride the same admission path (ISSUE 2):
+
+* **Radix prefix reuse** (SGLang RadixAttention): intake matches the
+  longest block-aligned cached prefix of the (resume) prompt, shares
+  those pages through the allocator's refcounts, and skips their
+  prefill; finished/preempted sequences donate their full pages back.
+* **Chunked prefill** (Sarathi-Serve): a prompt is processed in
+  token-budget-sized CHUNKS interleaved with ongoing decode steps —
+  a long prompt no longer monopolizes an engine step, and the old
+  "oversized prompts admitted alone" special case is gone: any positive
+  budget admits the head-of-line request with a budget-sized first
+  chunk.
+
 Per-request state machine:
 
-    WAITING --admit--> PREFILL --first token--> DECODE --eos/len--> FINISHED
-       ^                                          |
-       +------------------ preempt ---------------+
+    WAITING --admit--> PREFILL --last chunk + first token--> DECODE
+       ^               (1..k chunk steps)                      |
+       +---------------------- preempt ------------------------+
+                                                  --eos/len--> FINISHED
 
 The scheduler is pure host logic and deterministic: given the same
 arrival sequence and the same allocator geometry it produces the same
-step-by-step batch composition (golden-trace tested).
+step-by-step batch composition (golden-trace tested; the radix LRU uses
+a monotonic counter, never wall-clock).
 """
 from __future__ import annotations
 
@@ -27,7 +43,8 @@ from typing import List, Optional
 
 from .kv_cache import BlockAllocator, BlocksExhausted
 
-__all__ = ["RequestState", "Request", "ScheduleStep", "Scheduler"]
+__all__ = ["RequestState", "Request", "PrefillChunk", "ScheduleStep",
+           "Scheduler"]
 
 
 class RequestState(enum.Enum):
@@ -62,6 +79,12 @@ class Request:
         self.num_preemptions = 0
         self.finish_reason: Optional[str] = None
         self.arrival = self.request_id  # FCFS key (monotonic ids)
+        # tokens whose K/V is valid in the paged cache (cached-prefix
+        # match at admission + every chunk/decode write; maintained by
+        # the scheduler at admission and the engine after each launch)
+        self.num_computed = 0
+        # cached-prefix tokens matched at the LAST admission
+        self.cached_tokens = 0
 
     # prompt the next prefill must process (original prompt + anything
     # generated before a preemption — recompute-style resume)
@@ -81,9 +104,33 @@ class Request:
                 f"prompt={len(self.prompt_ids)}, out={len(self.output_ids)})")
 
 
+class PrefillChunk:
+    """One scheduled prefill chunk: process resume_ids[start:start+length]
+    (is_last == the chunk reaches the prompt end, so the engine samples
+    the first token from its final live position)."""
+
+    __slots__ = ("request", "start", "length", "is_last", "is_first")
+
+    def __init__(self, request, start, length, is_last, is_first):
+        self.request = request
+        self.start = start
+        self.length = length
+        self.is_last = is_last
+        self.is_first = is_first
+
+    @property
+    def request_id(self):
+        return self.request.request_id
+
+    def __repr__(self):
+        return (f"PrefillChunk(req={self.request_id}, "
+                f"[{self.start}:{self.start + self.length}]"
+                f"{' last' if self.is_last else ''})")
+
+
 class ScheduleStep:
-    """One engine step's worth of work: prompts to prefill (each runs as
-    its own bucketed program) + the decode batch."""
+    """One engine step's worth of work: prefill chunks (each runs as its
+    own bucketed program) + the decode batch."""
 
     __slots__ = ("prefills", "decodes", "preempted")
 
@@ -100,21 +147,26 @@ class Scheduler:
     """FCFS continuous-batching scheduler over a BlockAllocator.
 
     token_budget caps the tokens processed per step (each decode request
-    costs 1, a prefill costs its prompt length) — the knob that trades
+    costs 1, a prefill chunk costs its length) — the knob that trades
     time-to-first-token against decode throughput when prefills and
     decodes interleave. max_batch_size caps concurrent in-flight
     (PREFILL/DECODE) requests, which bounds the decode batch bucket.
+    prefix_cache (a RadixCache over the same allocator, or None) enables
+    cached-prefix reuse + donation.
     """
 
     def __init__(self, allocator: BlockAllocator, max_batch_size: int = 8,
                  token_budget: int = 512,
-                 max_prompt_len: Optional[int] = None):
+                 max_prompt_len: Optional[int] = None,
+                 prefix_cache=None):
         self.allocator = allocator
         self.max_batch_size = int(max_batch_size)
         self.token_budget = int(token_budget)
         self.max_prompt_len = max_prompt_len
+        self.prefix_cache = prefix_cache
         self.waiting: deque = deque()
-        self.running: List[Request] = []   # arrival order
+        self.prefilling: List[Request] = []   # admitted, chunks pending
+        self.running: List[Request] = []      # decoding, arrival order
         self.num_preemptions = 0
 
     # ---- intake ----------------------------------------------------------
@@ -133,25 +185,60 @@ class Scheduler:
         self.waiting.append(req)
 
     def has_work(self) -> bool:
-        return bool(self.waiting or self.running)
+        return bool(self.waiting or self.prefilling or self.running)
 
     @property
     def queue_depth(self) -> int:
         return len(self.waiting)
 
+    @property
+    def num_in_flight(self) -> int:
+        return len(self.running) + len(self.prefilling)
+
+    # ---- prefix cache plumbing ------------------------------------------
+    def _donate(self, req: Request):
+        """Hand the request's computed full pages to the radix tree
+        (finish AND preemption both donate — an evicted victim's resume
+        then re-matches its own prefix instead of recomputing it)."""
+        if self.prefix_cache is None or req.seq is None:
+            return
+        ids = req.prompt_ids + req.output_ids
+        n = min(req.num_computed, len(ids), req.seq.num_tokens)
+        ps = self.allocator.page_size
+        full = (n // ps) * ps
+        if full:
+            self.prefix_cache.insert(ids[:full], req.seq.pages[:full // ps])
+
+    def _reclaim(self, need_pages: int, protect=()) -> bool:
+        """Cached-prefix LRU eviction — ALWAYS tried before preempting a
+        live request (SERVING.md eviction ordering)."""
+        if self.prefix_cache is None or need_pages <= 0:
+            return False
+        return self.prefix_cache.evict(need_pages, protect) >= need_pages
+
     # ---- preemption ------------------------------------------------------
     def _preempt_one(self, keep: Request) -> Optional[Request]:
-        """Evict the LAST-arrived running request — possibly `keep`
-        itself when IT is the newest (strict FCFS priority: a newer
-        request never survives at an older one's expense). The victim's
-        pages free immediately; it resumes by re-prefilling
-        prompt+generated (recompute, not swap — there is no host swap
-        space worth the round-trip on TPU)."""
-        victim = self.running[-1]
-        self.running.remove(victim)
+        """Evict the LAST-arrived in-flight request (decoding OR
+        mid-prefill) — possibly `keep` itself when IT is the newest
+        (strict FCFS priority: a newer request never survives at an
+        older one's expense). The victim donates its computed pages to
+        the prefix cache (when enabled), frees the rest, and resumes by
+        re-prefilling prompt+generated (recompute, not swap — there is
+        no host swap space worth the round-trip on TPU; with the radix
+        tree the donated pages usually turn the recompute into a cache
+        hit)."""
+        pool = self.running + self.prefilling
+        victim = max(pool, key=lambda r: r.arrival)
+        if victim in self.running:
+            self.running.remove(victim)
+        else:
+            self.prefilling.remove(victim)
+        self._donate(victim)
         self.allocator.free_sequence(victim.seq)
         victim.seq = None
         victim.state = RequestState.WAITING
+        victim.num_computed = 0
+        victim.cached_tokens = 0
         victim.num_preemptions += 1
         self.num_preemptions += 1
         # preempted requests head the queue: FCFS by original arrival
@@ -162,8 +249,9 @@ class Scheduler:
     def schedule(self) -> ScheduleStep:
         preempted: List[Request] = []
 
-        # 1. guarantee every running request can append this step's token
-        #    (may cross a page boundary); evict newest-first on pressure.
+        # 1. guarantee every decoding request can append this step's
+        #    token (may cross a page boundary); on pressure evict cached
+        #    prefixes first, then the newest in-flight request.
         survivors: List[Request] = []
         for req in list(self.running):
             if req not in self.running:
@@ -175,6 +263,8 @@ class Scheduler:
                     survivors.append(req)
                     break
                 except BlocksExhausted:
+                    if self._reclaim(1):
+                        continue
                     victim = self._preempt_one(keep=req)
                     preempted.append(victim)
                     if victim is req:
@@ -182,36 +272,71 @@ class Scheduler:
         decodes = [r for r in survivors if r in self.running]
         budget = self.token_budget - len(decodes)
 
-        # 2. admit waiting prompts FCFS while budget/slots/pages allow.
+        # 2. continue in-flight prefills FCFS: each gets at most one
+        #    chunk per step, sized to the remaining budget.
+        chunks: List[PrefillChunk] = []
+        # (snapshot taken after step 1: preemption cannot mutate it here)
+        for req in sorted(self.prefilling, key=lambda r: r.arrival):
+            if budget <= 0:
+                break
+            n = len(req.resume_ids)
+            take = min(budget, n - req.num_computed)
+            if take <= 0:
+                continue
+            chunks.append(PrefillChunk(req, req.num_computed, take,
+                                       req.num_computed + take == n,
+                                       is_first=False))
+            budget -= take
+
+        # 3. admit waiting prompts FCFS while budget/slots/pages allow.
+        #    A cached-prefix match shares its pages and shrinks what
+        #    must be prefilled; the first chunk takes whatever budget is
+        #    left (chunked prefill — no oversized-prompt special case).
         #    Headroom check only: a prompt must see pages for prompt
         #    tokens + 1 free, which makes an immediate post-prefill
         #    preemption unlikely but does NOT reserve the extra page —
         #    same-step admissions crossing a boundary together can still
         #    contend, and preemption (step 1) resolves it.
-        prefills: List[Request] = []
         while self.waiting and budget > 0 and \
-                len(self.running) + len(prefills) < self.max_batch_size:
+                self.num_in_flight < self.max_batch_size:
             req = self.waiting[0]
-            n = len(req.resume_ids)
-            if n > budget and (prefills or budget < self.token_budget):
-                break                  # FCFS head-of-line: wait for budget
-            # else: n exceeds even the FULL budget — admit it alone once
-            # the step is otherwise empty, or it would livelock at the
-            # head of the queue forever (the budget is a latency knob,
-            # not an admissibility bound)
-            if not self.allocator.can_allocate(n + 1):
+            ids = req.resume_ids
+            n = len(ids)
+            mpages, m = [], 0
+            if self.prefix_cache is not None:
+                mpages, m = self.prefix_cache.match(ids)
+                if m >= n:
+                    # full hit: the LAST token must still run through
+                    # the model to produce the next-token logits
+                    keep = (n - 1) // self.allocator.page_size
+                    mpages, m = mpages[:keep], \
+                        keep * self.allocator.page_size
+            short = (self.allocator.pages_needed(n + 1) - len(mpages)
+                     - self.allocator.num_free)
+            if short > 0 and not self._reclaim(short, protect=mpages):
                 break                  # no pages — decodes will drain/free
+            try:
+                req.seq = self.allocator.alloc_sequence_with_prefix(
+                    n, mpages)
+            except BlocksExhausted:
+                break
             self.waiting.popleft()
-            req.seq = self.allocator.alloc_sequence(n)
             req.state = RequestState.PREFILL
-            prefills.append(req)
-            budget -= n
-        return ScheduleStep(prefills, decodes, preempted)
+            req.num_computed = m
+            req.cached_tokens = m
+            self.prefilling.append(req)
+            take = min(budget, n - m)
+            chunks.append(PrefillChunk(req, m, take, m + take == n,
+                                       is_first=True))
+            budget -= take
+        return ScheduleStep(chunks, decodes, preempted)
 
     # ---- completion hooks (engine calls these) ---------------------------
     def on_prefilled(self, req: Request):
-        """Prompt processed and first token sampled: request joins the
-        decode batch (unless that token already finished it)."""
+        """Last chunk processed and first token sampled: request joins
+        the decode batch (unless that token already finished it)."""
+        if req in self.prefilling:
+            self.prefilling.remove(req)
         req.state = RequestState.DECODE
         self.running.append(req)
         self.running.sort(key=lambda r: r.arrival)
@@ -219,7 +344,10 @@ class Scheduler:
     def finish(self, req: Request, reason: str):
         if req in self.running:
             self.running.remove(req)
+        if req in self.prefilling:
+            self.prefilling.remove(req)
         if req.seq is not None:
+            self._donate(req)
             self.allocator.free_sequence(req.seq)
             req.seq = None
         req.state = RequestState.FINISHED

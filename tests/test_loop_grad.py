@@ -488,3 +488,69 @@ def test_scan_decline_drops_trace_born_substream():
             assert not isinstance(st._key, jax.core.Tracer), name
     finally:
         tracker.states_ = base
+
+
+def test_scan_lowered_print_warns_trace_time_side_effects():
+    """ADVICE r5 #1: a body calling print() lowers fine (print is not a
+    python-state mutation the eager-keeping detector can see) but runs
+    at TRACE time — the successful scan lowering must say so."""
+    def fn(x):
+        s = x * 1.0
+        for i in range(N):
+            s = s + 0.5
+            print("tick")
+        return s.sum()
+
+    conv = try_convert(fn)
+    assert conv is not None
+    x = paddle.to_tensor(np.ones(4, np.float32))
+    with pytest.warns(UserWarning, match="trace time"):
+        out = conv(x)
+    # the lowering itself is untouched: one compiled loop, right answer
+    assert float(np.asarray(out._data)) == pytest.approx(4 * (1 + 0.5 * N))
+
+
+def test_scan_lowering_without_side_effects_is_silent():
+    def fn(x):
+        s = x * 1.0
+        for i in range(N):
+            s = s + 0.5
+        return s.sum()
+
+    conv = try_convert(fn)
+    x = paddle.to_tensor(np.ones(4, np.float32))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", UserWarning)
+        out = conv(x)
+    assert float(np.asarray(out._data)) == pytest.approx(4 * (1 + 0.5 * N))
+
+
+def test_while_loop_lowered_print_warns_too():
+    """The same trace-once caveat holds for the while_loop lowerings —
+    which only engage under jit (a concrete while stays a host loop and
+    prints per iteration, warning-free: the concrete-path half of this
+    test). Note print(s) of a TRACED tensor breaks the lowering outright
+    (Tensor.__repr__ concretizes) and falls back to per-iteration eager;
+    the silent hazard is printing values that trace fine — constants,
+    shapes — which is what the warning covers."""
+    def fn(x):
+        s = x.sum()
+        while s < 100.0:
+            s = s + 7.0
+            print("tick")
+        return s
+
+    x = paddle.to_tensor(np.ones(2, np.float32))
+    conv = try_convert(fn)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", UserWarning)
+        out_eager = conv(x)        # concrete: host loop, no warning
+
+    f = paddle.jit.to_static(fn)
+    with pytest.warns(UserWarning, match="trace time"):
+        out = f(x)
+    ref = 2.0
+    while ref < 100.0:
+        ref += 7.0
+    for o in (out_eager, out):
+        assert float(np.asarray(o._data)) == pytest.approx(ref)

@@ -1,0 +1,115 @@
+"""moe_global_mesh_tensor / moe_sub_mesh_tensors (VERDICT r5 #8;
+reference `python/paddle/distributed/auto_parallel/api.py:462,603`):
+per-expert-group locals on sub-meshes <-> one global dist tensor on the
+full mesh. Dryrun-able: runs on the 8-virtual-CPU-device mesh the test
+env forces (same virtual mesh dryrun_multichip uses)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import ProcessMesh, Replicate, Shard, Partial
+from paddle_tpu.distributed.auto_parallel.api import (
+    moe_global_mesh_tensor, moe_sub_mesh_tensors)
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+@pytest.fixture()
+def mesh():
+    # [ep, mp] — 2 expert groups x 4-way tensor parallel
+    return ProcessMesh(np.arange(8).reshape(2, 4), ["ep", "mp"])
+
+
+def test_global_from_locals_shard_roundtrip(mesh):
+    """Experts sharded along dim 0 over 'ep', dim 1 over 'mp'."""
+    rng = np.random.RandomState(0)
+    locals_np = [rng.randn(3, 8).astype(np.float32) for _ in range(2)]
+    locals_t = [paddle.to_tensor(a) for a in locals_np]
+    placements = [Shard(0), Shard(1)]
+    g = moe_global_mesh_tensor(locals_t, mesh, placements,
+                               local_mesh_dim=0)
+    assert g.process_mesh == mesh and g.placements == placements
+    np.testing.assert_array_equal(_np(g), np.concatenate(locals_np, 0))
+    # the global array really is laid out over the 8-device mesh
+    assert len(g._data.sharding.device_set) == 8
+
+    subs = moe_sub_mesh_tensors(g, mesh, 0, placements)
+    assert len(subs) == 2
+    for i, (sub, ref) in enumerate(zip(subs, locals_np)):
+        np.testing.assert_array_equal(_np(sub), ref)
+        # sub-mesh = the global mesh sliced at ep=i, keeping 'mp'
+        assert sub.process_mesh.dim_names == ["mp"]
+        assert sub.process_mesh.process_ids == list(range(4 * i, 4 * i + 4))
+        # local placements drop the ep entry
+        assert sub.placements == [Shard(1)]
+        assert len(sub._data.sharding.device_set) == 4
+
+
+def test_replicate_on_local_dim(mesh):
+    """Replicate over 'ep': every expert group sees the same tensor."""
+    x = np.arange(16, dtype=np.float32).reshape(4, 4)
+    placements = [Replicate(), Shard(0)]
+    g = moe_global_mesh_tensor([paddle.to_tensor(x)] * 2, mesh,
+                               placements, local_mesh_dim=0)
+    np.testing.assert_array_equal(_np(g), x)
+    subs = moe_sub_mesh_tensors(g, mesh, 0, placements)
+    assert len(subs) == 2
+    for sub in subs:
+        np.testing.assert_array_equal(_np(sub), x)
+        assert sub.placements == [Shard(0)]
+
+
+def test_negative_local_mesh_dim_and_attr_fallback(mesh):
+    """local_mesh_dim=-1 counts from the end; moe_sub_mesh_tensors can
+    read mesh/placements off the dist tensor itself."""
+    rng = np.random.RandomState(1)
+    locals_np = [rng.randn(4, 2).astype(np.float32) for _ in range(4)]
+    placements = [Replicate(), Shard(1)]          # 'mp' is dim -1
+    g = moe_global_mesh_tensor([paddle.to_tensor(a) for a in locals_np],
+                               mesh, placements, local_mesh_dim=-1)
+    np.testing.assert_array_equal(_np(g), np.concatenate(locals_np, 1))
+    subs = moe_sub_mesh_tensors(g, local_mesh_dim=-1)
+    assert len(subs) == 4
+    for sub, ref in zip(subs, locals_np):
+        np.testing.assert_array_equal(_np(sub), ref)
+        assert sub.process_mesh.dim_names == ["ep"]
+        assert sub.placements == [Replicate()]
+
+
+def test_validation_errors(mesh):
+    x = paddle.to_tensor(np.zeros((2, 2), np.float32))
+    with pytest.raises(ValueError):
+        moe_global_mesh_tensor([x], mesh, [Shard(0), Shard(1)], 0)
+    with pytest.raises(ValueError):
+        moe_global_mesh_tensor([x, x], mesh, [Partial(), Shard(1)], 0)
+    with pytest.raises(ValueError):
+        moe_global_mesh_tensor([x, x], mesh, [Shard(0), Shard(1)], 5)
+    g = moe_global_mesh_tensor([x, x], mesh, [Replicate(), Replicate()], 0)
+    with pytest.raises(ValueError):
+        # 3 rows do not split over 4 'mp' sub-meshes
+        moe_sub_mesh_tensors(
+            paddle.to_tensor(np.zeros((3, 2), np.float32)), mesh, 1,
+            [Replicate(), Shard(0)])
+    bare = paddle.to_tensor(np.zeros((2, 2), np.float32))
+    with pytest.raises(ValueError):
+        moe_sub_mesh_tensors(bare)                # no mesh anywhere
+
+
+def test_moe_layer_expert_weights_pattern(mesh):
+    """The pattern the reference MoE layer uses: per-expert weight
+    matrices live as one global [num_experts*out, in] tensor sharded
+    over 'ep', reconstructed per group for the expert matmul."""
+    rng = np.random.RandomState(2)
+    experts = [rng.randn(8, 4).astype(np.float32) for _ in range(2)]
+    g = moe_global_mesh_tensor(
+        [paddle.to_tensor(w) for w in experts], mesh,
+        [Shard(0), Replicate()], local_mesh_dim=0)
+    assert _np(g).shape == (16, 4)
+    subs = moe_sub_mesh_tensors(g, mesh, 0, [Shard(0), Replicate()])
+    x = rng.randn(5, 8).astype(np.float32)
+    for w_local, w_ref in zip(subs, experts):
+        got = x @ _np(w_local)
+        np.testing.assert_allclose(got, x @ w_ref, rtol=1e-6)

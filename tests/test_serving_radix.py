@@ -1,0 +1,177 @@
+"""RadixCache unit + property tests: page-aligned matching, donation
+with split-at-page-boundary, dedup of already-cached spans, LRU
+eviction with live-sequence protection, clear(), and allocator
+refcount invariants under randomized donate/match/evict traffic."""
+import numpy as np
+import pytest
+
+from paddle_tpu.serving import BlockAllocator, RadixCache
+from paddle_tpu.serving.kv_cache import BlocksExhausted
+
+PS = 8
+
+
+def mk(num_pages=64):
+    a = BlockAllocator(num_pages=num_pages, page_size=PS)
+    return a, RadixCache(a)
+
+
+def donate(a, rc, tokens):
+    """Simulate a finished sequence: allocate pages, donate full pages,
+    free. Returns the pages that went into the tree."""
+    seq = a.alloc_sequence(len(tokens))
+    full = (len(tokens) // PS) * PS
+    rc.insert(tokens[:full], seq.pages[:full // PS])
+    pages = list(seq.pages[:full // PS])
+    a.free_sequence(seq)
+    return pages
+
+
+def test_match_empty_and_short():
+    a, rc = mk()
+    assert rc.match([1, 2, 3]) == ([], 0)          # below page granularity
+    donate(a, rc, list(range(100, 116)))
+    assert rc.match(list(range(100, 107))) == ([], 0)  # 7 < page_size
+
+
+def test_match_is_block_aligned_and_longest():
+    a, rc = mk()
+    toks = list(range(100, 124))                   # 3 pages
+    pages = donate(a, rc, toks)
+    assert rc.match(toks) == (pages, 24)
+    # partial tail: only full pages count
+    p, m = rc.match(toks[:20])
+    assert (p, m) == (pages[:2], 16)
+    # divergence mid-page 2
+    p, m = rc.match(toks[:12] + [999] * 8)
+    assert (p, m) == (pages[:1], 8)
+    rc.check_invariants()
+
+
+def test_insert_splits_at_page_boundary():
+    a, rc = mk()
+    toks = list(range(100, 124))
+    pages = donate(a, rc, toks)
+    assert rc.num_nodes == 1
+    fork = toks[:16] + [7] * 8
+    donate(a, rc, fork)
+    # edge [24] split into [16] + [8], sibling [8] added
+    assert rc.num_nodes == 3
+    assert rc.match(toks) == (pages, 24)
+    p, m = rc.match(fork)
+    assert m == 24 and p[:2] == pages[:2] and p[2] != pages[2]
+    rc.check_invariants()
+
+
+def test_insert_dedups_already_cached_spans():
+    a, rc = mk()
+    toks = list(range(100, 124))
+    donate(a, rc, toks)
+    used = a.num_used
+    # a second donor of the same content adopts nothing
+    adopted_before = rc.num_inserted_pages
+    donate(a, rc, toks)
+    assert rc.num_inserted_pages == adopted_before
+    assert a.num_used == used
+    # extending donor adopts only the new tail page
+    donate(a, rc, toks + list(range(500, 508)))
+    assert rc.num_inserted_pages == adopted_before + 1
+    rc.check_invariants()
+
+
+def test_lru_eviction_order_and_protection():
+    a, rc = mk(num_pages=32)
+    t1 = donate(a, rc, list(range(0, 16)))         # oldest
+    t2 = donate(a, rc, list(range(100, 116)))
+    t3 = donate(a, rc, list(range(200, 216)))      # newest
+    rc.match(list(range(0, 16)))                   # bump t1: now t2 is LRU
+    freed = rc.evict(2)
+    assert freed == 2
+    assert rc.match(list(range(100, 116))) == ([], 0)   # t2 gone
+    assert rc.match(list(range(0, 16)))[1] == 16        # t1 survived
+    # protection: t3's pages cannot be evicted even under demand
+    freed = rc.evict(10, protect=t3)
+    assert rc.match(list(range(200, 216)))[1] == 16
+    assert rc.match(list(range(0, 16))) == ([], 0)      # t1 sacrificed
+
+
+def test_eviction_skips_pages_shared_with_live_sequences():
+    a, rc = mk(num_pages=8)                        # 7 usable
+    toks = list(range(0, 16))
+    donate(a, rc, toks)
+    mpages, m = rc.match(toks)
+    assert m == 16
+    # a live request forks the cached prefix
+    seq = a.alloc_sequence_with_prefix(20, mpages)
+    assert a.num_used == 3                         # 2 shared + 1 fresh
+    # eviction cannot free shared pages: it reports failure instead of
+    # uselessly dropping a prefix a live sequence still holds
+    assert rc.evict(4) == 0
+    assert rc.match(toks)[1] == 16
+    a.free_sequence(seq)
+    assert rc.evict(4) == 2                        # now they free
+    a.check_invariants()
+
+
+def test_clear_releases_everything():
+    a, rc = mk()
+    donate(a, rc, list(range(0, 24)))
+    donate(a, rc, list(range(100, 132)))
+    assert a.num_used == rc.num_cached_pages > 0
+    freed = rc.clear()
+    assert freed > 0 and a.num_used == 0 and rc.num_cached_pages == 0
+    a.check_invariants()
+
+
+def test_alloc_sequence_with_prefix_all_or_nothing():
+    a, rc = mk(num_pages=6)                        # 5 usable
+    mpages = donate(a, rc, list(range(0, 16)))     # 2 cached
+    with pytest.raises(BlocksExhausted):
+        # needs 6 total -> 4 fresh, only 3 free: nothing must leak
+        a.alloc_sequence_with_prefix(48, mpages)
+    assert a.num_used == 2
+    a.check_invariants()
+    with pytest.raises(ValueError):
+        a.alloc_sequence_with_prefix(8, mpages)    # prefix > need
+    seq = a.alloc_sequence_with_prefix(30, mpages)
+    assert seq.pages[:2] == mpages and len(seq.pages) == 4
+    a.free_sequence(seq)
+    rc.clear()
+    a.check_invariants()
+
+
+def test_randomized_donate_match_evict_invariants():
+    """Property test: random traffic never breaks the page-partition
+    invariant or the tree's ref contract."""
+    rng = np.random.RandomState(0)
+    a, rc = mk(num_pages=48)
+    vocab = 6          # tiny vocab -> lots of shared prefixes + splits
+    live = []
+    for it in range(300):
+        op = rng.randint(4)
+        if op == 0 and a.num_free > 8:
+            toks = rng.randint(0, vocab, rng.randint(8, 40)).tolist()
+            mpages, m = rc.match(toks)
+            try:
+                seq = a.alloc_sequence_with_prefix(len(toks), mpages)
+                live.append((toks, seq))
+            except BlocksExhausted:
+                pass
+        elif op == 1 and live:
+            toks, seq = live.pop(rng.randint(len(live)))
+            full = (seq.num_tokens // PS) * PS
+            if full:
+                rc.insert(toks[:full], seq.pages[:full // PS])
+            a.free_sequence(seq)
+        elif op == 2:
+            rc.evict(rng.randint(1, 4))
+        else:
+            toks = rng.randint(0, vocab, rng.randint(8, 40)).tolist()
+            rc.match(toks)
+        a.check_invariants()
+        rc.check_invariants()
+    for toks, seq in live:
+        a.free_sequence(seq)
+    rc.clear()
+    assert a.num_used == 0
+    a.check_invariants()

@@ -1,12 +1,13 @@
 """Golden-trace tests for the continuous-batching scheduler: fixed
 request arrivals must produce an exact, deterministic step-by-step batch
-composition (prefill/decode interleave, FCFS admission under the token
-budget, preempt-by-eviction on block exhaustion)."""
+composition (prefill-chunk/decode interleave, FCFS admission under the
+token budget, chunked prefill of long prompts, cached-prefix reuse, and
+the eviction ordering: radix LRU first, preempt-by-eviction second)."""
 import numpy as np
 import pytest
 
-from paddle_tpu.serving import (BlockAllocator, Request, RequestState,
-                                Scheduler)
+from paddle_tpu.serving import (BlockAllocator, RadixCache, Request,
+                                RequestState, Scheduler)
 
 
 def mk(prompt_len, max_new=8, rid=None):
@@ -17,10 +18,22 @@ def ids(reqs):
     return [r.request_id for r in reqs]
 
 
-def drive(sched, req):
-    """Admit helper: prefill happened, first token emitted."""
-    req.output_ids.append(0)
-    sched.on_prefilled(req)
+def spans(chunks):
+    return [(c.request_id, c.start, c.length, c.is_last) for c in chunks]
+
+
+def run_chunk(c):
+    """What the engine does after launching a chunk (sans device work)."""
+    c.request.num_computed = c.start + c.length
+
+
+def drive(sched, chunk):
+    """Chunk helper: run it; when it completes the prompt, emit the
+    first token and join the decode batch."""
+    run_chunk(chunk)
+    if chunk.is_last:
+        chunk.request.output_ids.append(0)
+        sched.on_prefilled(chunk.request)
 
 
 def test_fcfs_admission_under_token_budget():
@@ -30,17 +43,19 @@ def test_fcfs_admission_under_token_budget():
     for r in (r1, r2, r3):
         s.add_request(r)
     step = s.schedule()
-    # budget 20: r1 (8) + r2 (10) fit; r3 (4) would exceed -> waits even
-    # though it is short (FCFS, no head-of-line bypass)... r3 arrives
-    # after r2, budget left is 2 < 4.
-    assert ids(step.prefills) == [101, 102] and step.decodes == []
-    assert s.queue_depth == 1
-    for r in step.prefills:
-        drive(s, r)
+    # budget 20: r1 (8) + r2 (10) fit whole; r3 gets the leftover 2
+    # tokens as a FIRST CHUNK (chunked prefill fills the budget — the
+    # old scheduler made r3 wait a full step for those 2 tokens)
+    assert spans(step.prefills) == [(101, 0, 8, True), (102, 0, 10, True),
+                                    (103, 0, 2, False)]
+    assert step.decodes == []
+    assert s.queue_depth == 0
+    for c in step.prefills:
+        drive(s, c)
     step2 = s.schedule()
-    # next step: both running decode (2 tokens), budget 18 admits r3
+    # next step: r1+r2 decode (2 tokens), r3's remaining 2 tokens finish
     assert ids(step2.decodes) == [101, 102]
-    assert ids(step2.prefills) == [103]
+    assert spans(step2.prefills) == [(103, 2, 2, True)]
 
 
 def test_exact_golden_trace_with_finishes():
@@ -55,8 +70,8 @@ def test_exact_golden_trace_with_finishes():
         for r in new:
             s.add_request(r)
         st = s.schedule()
-        for r in st.prefills:
-            drive(s, r)
+        for c in st.prefills:
+            drive(s, c)
         # every decode emits one token; finish on max_new
         done = []
         for r in st.decodes:
@@ -65,7 +80,8 @@ def test_exact_golden_trace_with_finishes():
                 done.append(r)
         for r in done:
             s.finish(r, "length")
-        trace.append((ids(st.prefills), ids(st.decodes)))
+        trace.append((ids([c.request for c in st.prefills]),
+                      ids(st.decodes)))
 
     r2 = mk(3, max_new=2, rid=2)
     r3 = mk(9, max_new=2, rid=3)
@@ -81,6 +97,38 @@ def test_exact_golden_trace_with_finishes():
     assert a.num_used == 0
 
 
+def test_long_prompt_chunks_interleave_with_decodes():
+    """The chunked-prefill golden trace (ISSUE 2 acceptance): a prompt
+    larger than the token budget is admitted in chunks that ride along
+    with the ongoing decode batch instead of monopolizing a step."""
+    a = BlockAllocator(num_pages=64, page_size=8)
+    s = Scheduler(a, max_batch_size=4, token_budget=8)
+    r1 = mk(4, max_new=8, rid=11)
+    s.add_request(r1)
+    st = s.schedule()
+    assert spans(st.prefills) == [(11, 0, 4, True)]
+    for c in st.prefills:
+        drive(s, c)
+    r2 = mk(20, max_new=4, rid=12)     # 20 tokens >> budget 8
+    s.add_request(r2)
+    trace = []
+    for _ in range(4):
+        st = s.schedule()
+        trace.append((ids(st.decodes), spans(st.prefills)))
+        for c in st.prefills:
+            drive(s, c)
+        for r in st.decodes:
+            r.output_ids.append(0)
+    # r1 keeps decoding EVERY step while r2's prompt trickles in at
+    # budget-minus-decodes tokens per step (7, 7, 6): no step was
+    # monopolized by the long prompt
+    assert trace == [([11], [(12, 0, 7, False)]),
+                     ([11], [(12, 7, 7, False)]),
+                     ([11], [(12, 14, 6, True)]),
+                     ([11, 12], [])]
+    assert r2.state == RequestState.DECODE
+
+
 def test_preempt_by_eviction_lets_older_requests_grow():
     a = BlockAllocator(num_pages=8, page_size=8)   # 7 usable pages
     s = Scheduler(a, max_batch_size=4, token_budget=64)
@@ -89,9 +137,10 @@ def test_preempt_by_eviction_lets_older_requests_grow():
     for r in (r1, r2, r3):
         s.add_request(r)
     st = s.schedule()                    # 2 pages each: 6 used, 1 free
-    assert ids(st.prefills) == [41, 42, 43] and a.num_free == 1
-    for r in st.prefills:
-        drive(s, r)
+    assert ids([c.request for c in st.prefills]) == [41, 42, 43]
+    assert a.num_free == 1
+    for c in st.prefills:
+        drive(s, c)
 
     # token 17 crosses a page boundary for everyone: r1 takes the free
     # page, r2's crossing evicts the NEWEST (r3) and reuses its pages
@@ -103,7 +152,7 @@ def test_preempt_by_eviction_lets_older_requests_grow():
     assert r3.resume_ids == r3.prompt_ids + r3.output_ids
     # r3 stays queued: its resume (18 tokens -> 3 pages) outsizes the 1
     # page r2's crossing left behind
-    assert ids(st.prefills) == [] and s.waiting[0] is r3
+    assert st.prefills == [] and s.waiting[0] is r3
 
 
 def test_preemption_victim_is_newest_not_oldest():
@@ -112,11 +161,14 @@ def test_preemption_victim_is_newest_not_oldest():
     r1, r2 = mk(23, max_new=16, rid=21), mk(23, max_new=16, rid=22)
     s.add_request(r1)
     st = s.schedule()
-    drive(s, r1)          # r1: 3 pages (23 tokens), 4 free
+    for c in st.prefills:
+        drive(s, c)       # r1: 3 pages (23 tokens), 4 free
     s.add_request(r2)
     st = s.schedule()     # r1 decodes (24th token fits page 3), r2 admitted
-    assert ids(st.decodes) == [21] and ids(st.prefills) == [22]
-    drive(s, r2)          # r2: 3 pages, 1 free page left
+    assert ids(st.decodes) == [21]
+    assert spans(st.prefills) == [(22, 0, 23, True)]
+    for c in st.prefills:
+        drive(s, c)       # r2: 3 pages, 1 free page left
     st = s.schedule()     # r1 crosses -> takes last page; r2's 24th fits
     assert ids(st.decodes) == [21, 22] and a.num_free == 0
     st = s.schedule()     # r2 crosses, no pages: NEWEST (r2) is evicted,
@@ -125,23 +177,115 @@ def test_preemption_victim_is_newest_not_oldest():
     assert r1.state == RequestState.DECODE
     assert r2.state == RequestState.WAITING
     # r2 stays queued: its resume needs 4 pages but only 3 are free
-    assert ids(st.prefills) == []
+    assert st.prefills == []
 
 
-def test_oversized_prompt_admitted_alone_when_budget_free():
-    """Head-of-line prompt larger than the whole token budget: admitted
-    by itself once nothing else consumes the step, instead of blocking
-    the queue forever."""
-    a = BlockAllocator(num_pages=64, page_size=8)
+def test_mid_prefill_request_can_be_preempted():
+    """A request still chunking its prompt holds pages too — it is
+    preemptible exactly like a decoding one (newest-first)."""
+    a = BlockAllocator(num_pages=8, page_size=8)   # 7 usable
     s = Scheduler(a, max_batch_size=4, token_budget=8)
-    r1, r2 = mk(12, rid=201), mk(3, rid=202)
+    r1 = mk(23, max_new=16, rid=61)
     s.add_request(r1)
+    for expect in [(61, 0, 8, False), (61, 8, 8, False), (61, 16, 7, True)]:
+        st = s.schedule()
+        assert spans(st.prefills) == [expect]
+        for c in st.prefills:
+            drive(s, c)   # r1 decoding after 3 chunk steps: 3 pages held
+    r2 = mk(30, max_new=4, rid=62)     # 4 pages, chunking at 7/step
+    s.add_request(r2)
+    st = s.schedule()                  # r1's 24th token fills page 3
+    assert ids(st.decodes) == [61]
+    assert spans(st.prefills) == [(62, 0, 7, False)]
+    for c in st.prefills:
+        drive(s, c)
+    for r in st.decodes:
+        r.output_ids.append(0)
+    assert a.num_free == 0
+    # r1's 25th token crosses into a 4th page: no pages free, and the
+    # newest in-flight request (mid-prefill r2) is evicted
+    st = s.schedule()
+    assert ids(st.preempted) == [62]
+    assert r2.state == RequestState.WAITING and r2.num_computed == 0
+    assert ids(st.decodes) == [61]
+
+
+def test_cached_prefix_reuse_and_lru_eviction_order():
+    """Radix integration golden trace: donation at finish, block-aligned
+    match at admission, and allocator pressure evicting the cached
+    prefix BEFORE preempting any live request."""
+    a = BlockAllocator(num_pages=12, page_size=8)  # 11 usable
+    rc = RadixCache(a)
+    s = Scheduler(a, max_batch_size=4, token_budget=64, prefix_cache=rc)
+    r1 = mk(24, max_new=2, rid=71)
+    s.add_request(r1)
+    st = s.schedule()
+    assert spans(st.prefills) == [(71, 0, 24, True)]
+    for c in st.prefills:
+        drive(s, c)
+    r1.output_ids.append(0)            # 2 generated -> finished
+    s.finish(r1, "length")
+    # finish donated the full pages of the 25 computed tokens (24
+    # prompt + 1 generated KV): 3 pages stay cached, refcounted by the
+    # tree alone
+    assert a.num_used == 3 and rc.num_cached_pages == 3
+    rc.check_invariants()
+
+    # same-prefix follower: matches all 3 pages, prefills only the tail
+    r2 = Request(r1.prompt_ids + [99] * 6, 2, request_id=72)
     s.add_request(r2)
     st = s.schedule()
-    assert ids(st.prefills) == [201] and st.decodes == []
-    drive(s, r1)
-    st = s.schedule()      # r1 decodes; budget 7 left admits r2 normally
-    assert ids(st.decodes) == [201] and ids(st.prefills) == [202]
+    assert r2.cached_tokens == 24
+    assert spans(st.prefills) == [(72, 24, 6, True)]
+    for c in st.prefills:
+        drive(s, c)
+    # r2 holds 4 pages (3 shared with the tree + 1 fresh)
+    assert a.num_used == 4 and a.num_free == 7
+
+    # memory pressure from a big newcomer: the radix tree gives up its
+    # zero-active-ref pages before anyone gets preempted... but r2 still
+    # shares them, so eviction frees nothing there and the tree only
+    # drops truly-free pages. Fill the pool to force the decision:
+    r3 = Request(list(range(200, 260)), 2, request_id=73)  # 8 pages; 7 free
+    s.add_request(r3)
+    st = s.schedule()
+    # shared pages free nothing -> no admission possible, and CRUCIALLY
+    # r2 was NOT preempted (eviction ordering: cache first, requests
+    # only when the cache cannot help AND a decode needs the page)
+    assert st.prefills == [] and ids(st.decodes) == [72]
+    assert r2.state == RequestState.DECODE
+    s.finish(r2, "length")
+    # r2's finish donated its tail page too; now ALL cached pages are
+    # tree-only and evictable
+    rc.check_invariants()
+    st = s.schedule()
+    # admission of r3 evicted LRU cached nodes to make room
+    assert spans(st.prefills) == [(73, 0, 60, True)]
+    assert rc.num_cached_pages < 4
+    assert a.check_invariants() is None
+
+
+def test_full_prefix_hit_still_recomputes_last_token():
+    """A 100% cached prompt must still run its final token through the
+    model — the next-token logits come from it."""
+    a = BlockAllocator(num_pages=16, page_size=8)
+    rc = RadixCache(a)
+    s = Scheduler(a, max_batch_size=4, token_budget=64, prefix_cache=rc)
+    r1 = mk(16, max_new=2, rid=81)
+    s.add_request(r1)
+    st = s.schedule()
+    for c in st.prefills:
+        drive(s, c)
+    r1.output_ids.append(0)
+    s.finish(r1, "length")
+    r2 = mk(16, max_new=2, rid=82)     # identical prompt
+    s.add_request(r2)
+    st = s.schedule()
+    # match covers both pages, but the admission clamps to the last
+    # page boundary BELOW n-1: 8 cached, 8 recomputed (incl. the final
+    # position)
+    assert r2.cached_tokens == 8
+    assert spans(st.prefills) == [(82, 8, 8, True)]
 
 
 def test_resume_prompt_includes_generated_tokens():
@@ -150,7 +294,8 @@ def test_resume_prompt_includes_generated_tokens():
     r = mk(6, max_new=8, rid=31)
     s.add_request(r)
     st = s.schedule()
-    drive(s, r)
+    for c in st.prefills:
+        drive(s, c)
     r.output_ids = [7, 8, 9]
     assert r.resume_ids == list(range(1, 7)) + [7, 8, 9]
 
