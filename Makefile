@@ -3,7 +3,7 @@
 # otherwise block every interpreter on the single TPU grant).
 TEST_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: test test-fast bench lint
+.PHONY: test test-fast bench soak lint
 
 test:
 	$(TEST_ENV) python -m pytest tests/ -x -q
@@ -13,6 +13,13 @@ test-fast:
 
 bench:
 	python bench.py
+
+# Randomized fault-injection soak of the serving engine (ISSUE 3): the
+# 200-request acceptance run + extra seeds. CPU-only, minutes-bounded;
+# excluded from tier-1 via the `slow` marker (pytest.ini addopts).
+soak:
+	$(TEST_ENV) python tools/soak_serving.py --requests 200 --seed 0
+	$(TEST_ENV) python -m pytest tests/test_soak_serving.py -m slow -q
 
 # Sanitizer builds of the native extension (parity: reference
 # SANITIZER_TYPE configure option). Runs the native test suite against an

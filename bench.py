@@ -224,6 +224,25 @@ def _enable_compile_cache():
         pass            # cache is an optimization, never a failure mode
 
 
+def _maybe_inject_fault(i: int, kw: dict):
+    """Test hook for the fallback chain (BENCH_FAULT_INJECT env var):
+    'all' fails every attempt, 'pallas'/'xla' fail the matching
+    attention paths, a digit fails that attempt index. Raises BEFORE
+    run() so an injected attempt never touches jax or the TPU grant —
+    the regression test drives the whole Pallas -> XLA -> shrink ->
+    error-JSON chain without a device. Inert unless the env var is set."""
+    spec = os.environ.get("BENCH_FAULT_INJECT", "")
+    if not spec:
+        return
+    tokens = {t.strip() for t in spec.split(",") if t.strip()}
+    hit = ("all" in tokens or str(i) in tokens
+           or ("pallas" in tokens and kw.get("use_pallas"))
+           or ("xla" in tokens and not kw.get("use_pallas")))
+    if hit:
+        raise RuntimeError(
+            f"BENCH_FAULT_INJECT: injected failure of attempt {i} ({kw})")
+
+
 def worker():
     """Runs the attempt chain. A watchdog thread guarantees a JSON line even
     if the TPU transport wedges mid-call (exceptions can be caught; hangs
@@ -256,9 +275,10 @@ def worker():
         {"use_pallas": False, "shrink": 1},
     ]
     errors = []
-    for kw in attempts:
+    for i, kw in enumerate(attempts):
         state["phase"] = f"run({kw})"
         try:
+            _maybe_inject_fault(i, kw)
             result = run(**kw)
             if errors:
                 result["recovered_from"] = errors[-1][:300]

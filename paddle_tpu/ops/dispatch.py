@@ -28,6 +28,7 @@ def _is_tensor(x):
 
 
 _profiler_mod = None
+_nan_inf_mod = None
 _spmd_prop = None
 # jit.loop_grad external-tensor capture (active only while a converted
 # loop probes its body / traces its scan lowering); one None-check per op
@@ -100,9 +101,17 @@ def _apply_op(name: str, fn: Callable, *args, **kwargs):
 
     out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
 
-    from ..utils.nan_inf import check_nan_inf_enabled, maybe_check
-    if check_nan_inf_enabled():
-        maybe_check(name, out_leaves)
+    # NaN/Inf hook (cached module ref like _profiler_mod: this runs on
+    # EVERY op). maybe_check raises FloatingPointError carrying the op
+    # name and any active `nan_inf.poison_scope` label — the serving
+    # supervisor classifies that as deterministic poison (quarantine the
+    # attributed request, never retry).
+    global _nan_inf_mod
+    if _nan_inf_mod is None:
+        from ..utils import nan_inf as _ni
+        _nan_inf_mod = _ni
+    if _nan_inf_mod.check_nan_inf_enabled():
+        _nan_inf_mod.maybe_check(name, out_leaves)
 
     from ..amp import debugging as _amp_dbg
     if _amp_dbg._is_collecting():

@@ -3,16 +3,24 @@
 Architecture (SERVING.md): Orca-style iteration-level scheduling +
 vLLM-style paged KV management + SGLang-style radix prefix caching +
 Sarathi-style chunked prefill, compiled into a bounded grid of bucketed
-XLA programs over the chip-validated paged-attention kernels.
+XLA programs over the chip-validated paged-attention kernels; a
+resilience layer (ISSUE 3) adds request deadlines/abort, bounded-queue
+admission control, supervised step retries with poison quarantine, and
+snapshot/resume across device failures.
 """
 from .engine import ServingEngine
+from .errors import (EngineFailure, EngineOverloaded, PoisonedComputation,
+                     TransientDeviceError)
 from .kv_cache import BlockAllocator, BlocksExhausted, KVSequence, PAD_PAGE
 from .metrics import ServingMetrics
 from .radix_cache import RadixCache, RadixNode
 from .scheduler import (PrefillChunk, Request, RequestState, ScheduleStep,
                         Scheduler)
+from .supervisor import RetryPolicy, StepSupervisor, classify_failure
 
 __all__ = ["ServingEngine", "BlockAllocator", "BlocksExhausted",
            "KVSequence", "PAD_PAGE", "ServingMetrics", "RadixCache",
            "RadixNode", "PrefillChunk", "Request", "RequestState",
-           "ScheduleStep", "Scheduler"]
+           "ScheduleStep", "Scheduler", "EngineFailure", "EngineOverloaded",
+           "PoisonedComputation", "TransientDeviceError", "RetryPolicy",
+           "StepSupervisor", "classify_failure"]

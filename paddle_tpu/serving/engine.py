@@ -26,6 +26,17 @@ Shape buckets pad up: a 19-token chunk runs in the 32-bucket, a decode
 batch of 5 in the 8-bucket. The recompile counter (metrics) is bounded
 by the bucket grid, which the engine test asserts.
 
+Resilience layer (ISSUE 3, SERVING.md "Failure semantics"): per-request
+deadlines/TTL and client `abort()`, cancelled at the next iteration
+boundary in any state with valid KV donated to the radix cache;
+bounded-queue admission control (`EngineOverloaded`); every compiled
+launch runs under a `StepSupervisor` that retries transient device
+errors with capped backoff, quarantines NaN-poisoned requests (each
+program returns per-row finiteness flags computed in-graph — the jit
+counterpart of the eager dispatch NaN hooks), and on unrecoverable
+errors drains to a serializable snapshot a fresh engine resumes from
+(`ServingEngine.from_snapshot`).
+
 Determinism contract: greedy decode is deterministic, and a request's
 tokens are bit-identical whether it runs alone or batched with others,
 and whether its prefix came from the radix cache or its own prefill —
@@ -40,6 +51,7 @@ interleavings.
 from __future__ import annotations
 
 import itertools
+import time
 from typing import Dict, List, Optional
 
 import jax
@@ -50,14 +62,32 @@ from ..core.autograd import no_grad
 from ..core.tensor import Tensor
 from ..jit.api import functional_call
 from ..models.generation import _sample_arr
+from ..utils import faults
+from ..utils.nan_inf import poison_scope
+from .errors import EngineFailure, EngineOverloaded
 from .kv_cache import BlockAllocator, PAD_PAGE
 from .metrics import ServingMetrics
 from .radix_cache import RadixCache
-from .scheduler import Request, RequestState, Scheduler
+from .scheduler import (Request, RequestState, Scheduler,
+                        bump_request_counter)
+from .supervisor import POISON, RetryPolicy, StepSupervisor, classify_failure
 
 __all__ = ["ServingEngine"]
 
 _engine_counter = itertools.count()
+
+SNAPSHOT_VERSION = 1
+
+# Fault-injection points (ISSUE 3; utils/faults.py). The step-exception
+# points fire BEFORE the compiled launch, so an injected transient
+# retries the identical, not-yet-executed launch; nan_logits poisons the
+# per-row finiteness flags AFTER the launch (the in-graph isfinite check
+# is exercised for real by tests that NaN a weight); deadline_storm
+# returns seconds of forward clock skew applied at the next boundary.
+FAULT_CHUNK = faults.register_point("serving.engine.prefill_chunk")
+FAULT_DECODE = faults.register_point("serving.engine.decode_step")
+FAULT_NAN = faults.register_point("serving.engine.nan_logits")
+FAULT_STORM = faults.register_point("serving.engine.deadline_storm")
 
 
 def _bucket_for(value: int, buckets: List[int]) -> int:
@@ -94,7 +124,11 @@ class ServingEngine:
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, seed: int = 0,
                  max_retained_finished: int = 1024,
-                 enable_prefix_cache: bool = True):
+                 enable_prefix_cache: bool = True,
+                 max_queue_len: Optional[int] = None,
+                 default_ttl_s: Optional[float] = None,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 clock=None):
         cfg = model.cfg
         self.model = model
         self.cfg = cfg
@@ -154,7 +188,20 @@ class ServingEngine:
             self.allocator, max_batch_size=self.batch_buckets[-1],
             token_budget=min(token_budget, self.prefill_buckets[-1]),
             max_prompt_len=self.max_seq_len,
-            prefix_cache=self.radix)
+            prefix_cache=self.radix,
+            max_queue_len=max_queue_len)
+        # --- resilience (ISSUE 3) ---
+        # deadlines use an injectable clock (tests/soak pass a fake one;
+        # the fault harness adds skew) so expiry stays deterministic
+        self._clock = clock if clock is not None else time.monotonic
+        self._clock_skew = 0.0
+        self.default_ttl_s = default_ttl_s
+        self.supervisor = StepSupervisor(
+            policy=retry_policy,
+            on_retry=lambda label, n: self.metrics.on_step_retry(),
+            retryable=self._caches_alive)
+        self.failed = False
+        self.last_snapshot: Optional[dict] = None
         # per-engine provider name: two live engines must not shadow each
         # other in profiler.counters(), nor unregister each other
         self.metrics = ServingMetrics(
@@ -178,9 +225,35 @@ class ServingEngine:
         # warns per call and keeps the copy anyway
         self._donate = (1, 2) if jax.default_backend() == "tpu" else ()
 
+    def _caches_alive(self) -> bool:
+        """Retry gate for the donated-buffer hazard: on TPU the compiled
+        programs donate the K/V caches (`donate_argnums`), and a launch
+        that failed AFTER the dispatch consumed them leaves deleted
+        arrays behind — re-passing those would raise, so the supervisor
+        must fail over to the snapshot path instead of retrying. On CPU
+        (donation off) and for failures raised BEFORE dispatch (fault
+        injection, relay connect errors) the buffers stay alive and
+        retries proceed."""
+        probe = (self._k_caches[0], self._v_caches[0])
+        return not any(getattr(a, "is_deleted", lambda: False)()
+                       for a in probe)
+
     # ------------------------------------------------------------- intake
+    def _now(self) -> float:
+        return self._clock() + self._clock_skew
+
     def add_request(self, prompt_ids, max_new_tokens: int = 32,
-                    eos_token_id: Optional[int] = None) -> int:
+                    eos_token_id: Optional[int] = None,
+                    ttl_s: Optional[float] = None,
+                    deadline: Optional[float] = None) -> int:
+        """Queue one request. `ttl_s` (or an absolute engine-clock
+        `deadline`) bounds its total lifetime: past it, the request is
+        cancelled at the next iteration boundary whatever its state.
+        Raises `EngineOverloaded` when the bounded waiting queue is full
+        (admission control — shed at the door, never grow unbounded)."""
+        if self.failed:
+            raise EngineFailure("engine has failed; resume from "
+                                "last_snapshot", snapshot=self.last_snapshot)
         req = Request(prompt_ids, max_new_tokens, eos_token_id)
         if len(req.prompt_ids) + req.max_new_tokens > self.max_seq_len:
             raise ValueError(
@@ -191,10 +264,34 @@ class ServingEngine:
         # (prompt + max_new - 1) outsized the largest prefill bucket.
         # Chunked prefill removed that failure mode: a resume of any
         # length within max_seq_len re-prefills in budget-sized chunks.
+        if ttl_s is None and deadline is None and \
+                self.default_ttl_s is not None:
+            ttl_s = self.default_ttl_s
+        if ttl_s is not None and deadline is not None:
+            raise ValueError("pass ttl_s or deadline, not both")
+        if ttl_s is not None:
+            deadline = self._now() + float(ttl_s)
+        req.deadline = deadline
+        try:
+            self.scheduler.add_request(req)
+        except EngineOverloaded:
+            self.metrics.on_shed()
+            raise
         self.requests[req.request_id] = req
-        self.scheduler.add_request(req)
         self.metrics.on_add(req.request_id)
         return req.request_id
+
+    def abort(self, request_id: int) -> bool:
+        """Client abort: the request is cancelled at the next iteration
+        boundary in whatever state it is in (queued, chunk-prefilling,
+        decoding, or preempted), its valid KV donated to the radix
+        cache. Returns False when the request is unknown or already
+        finished."""
+        req = self.requests.get(request_id)
+        if req is None or req.state is RequestState.FINISHED:
+            return False
+        req.aborted = True
+        return True
 
     def has_work(self) -> bool:
         return self.scheduler.has_work()
@@ -237,8 +334,12 @@ class ServingEngine:
                 Tensor(cache_len), Tensor(live),
                 method="forward_paged_prefill")
             last = logits._data[0, 0]   # head ran at the chunk end only
+            # in-graph NaN detection (the jit counterpart of the eager
+            # dispatch NaN hook): NaN/Inf anywhere in the network flows
+            # into the chunk-end logits, so one reduction covers the step
+            ok = jnp.all(jnp.isfinite(last))
             tok = _sample_arr(last[None], key, temperature, top_k, top_p)[0]
-            return (tok, [c[0]._data for c in caches],
+            return (tok, ok, [c[0]._data for c in caches],
                     [c[1]._data for c in caches])
 
         return jax.jit(program, donate_argnums=self._donate)
@@ -258,14 +359,27 @@ class ServingEngine:
         bt[:npages] = req.seq.pages[:npages]
         padded = np.zeros((1, S), np.int32)
         padded[0, :chunk.length] = ids
+        # the RNG key is drawn ONCE, before the supervised launch, so a
+        # transient-failure retry re-runs the identical program (bit-
+        # identical token) instead of burning a new key per attempt
         key = self._next_key() if chunk.is_last else self._null_key
-        with profiler.RecordEvent("serving.prefill_chunk"), no_grad():
-            tok, self._k_caches, self._v_caches = prog(
-                self._state, self._k_caches, self._v_caches,
-                jnp.asarray(padded), jnp.int32(chunk.start),
-                jnp.int32(chunk.length), jnp.asarray(bt), key)
+
+        def launch():
+            faults.fire(FAULT_CHUNK)
+            with profiler.RecordEvent("serving.prefill_chunk"), \
+                    poison_scope(f"serving.prefill_chunk[req="
+                                 f"{req.request_id}]"), no_grad():
+                return prog(
+                    self._state, self._k_caches, self._v_caches,
+                    jnp.asarray(padded), jnp.int32(chunk.start),
+                    jnp.int32(chunk.length), jnp.asarray(bt), key)
+
+        tok, ok, self._k_caches, self._v_caches = self.supervisor.run(
+            launch, label="prefill_chunk")
+        if faults.fire(FAULT_NAN) is not None:
+            ok = False
         self.metrics.on_prefill(chunk.length)
-        return tok
+        return tok, bool(ok)
 
     # ----------------------------------------------------------- decode
     def _build_decode(self, B: int, P: int):
@@ -280,9 +394,13 @@ class ServingEngine:
             logits, caches = functional_call(
                 model, st, Tensor(ids), paged, Tensor(bt), Tensor(sl),
                 method="forward_paged_decode")
-            toks = _sample_arr(logits._data[:, 0, :], key, temperature,
-                               top_k, top_p)
-            return (toks, [c[0]._data for c in caches],
+            rows = logits._data[:, 0, :]
+            # per-row finiteness: rows are independent (SERVING.md), so a
+            # poisoned request flags ONLY its own row — the quarantine
+            # granularity ("fail one request, not the engine")
+            ok = jnp.all(jnp.isfinite(rows), axis=-1)
+            toks = _sample_arr(rows, key, temperature, top_k, top_p)
+            return (toks, ok, [c[0]._data for c in caches],
                     [c[1]._data for c in caches])
 
         return jax.jit(program, donate_argnums=self._donate)
@@ -302,15 +420,46 @@ class ServingEngine:
         for i, r in enumerate(reqs):
             ids[i, 0] = r.output_ids[-1]
             sl[i] = r.seq.num_tokens
-        with profiler.RecordEvent("serving.decode_step"), no_grad():
-            toks, self._k_caches, self._v_caches = prog(
-                self._state, self._k_caches, self._v_caches, jnp.asarray(ids),
-                jnp.asarray(bt), jnp.asarray(sl), self._next_key())
+        key = self._next_key()    # drawn once: retries re-run identically
+        rids = [r.request_id for r in reqs]
+
+        def launch():
+            faults.fire(FAULT_DECODE)
+            with profiler.RecordEvent("serving.decode_step"), \
+                    poison_scope(f"serving.decode_step[reqs={rids}]"), \
+                    no_grad():
+                return prog(
+                    self._state, self._k_caches, self._v_caches,
+                    jnp.asarray(ids), jnp.asarray(bt), jnp.asarray(sl),
+                    key)
+
+        toks, oks, self._k_caches, self._v_caches = self.supervisor.run(
+            launch, label="decode_step")
+        oks = np.asarray(oks)[:len(reqs)].copy()
+        poison = faults.fire(FAULT_NAN)
+        if poison is not None:
+            for i in self._poison_rows(poison, reqs):
+                oks[i] = False
         for r in reqs:
             # this step wrote the K/V of each row's input token
             r.num_computed = r.seq.num_tokens
         self.metrics.on_decode(len(reqs))
-        return np.asarray(toks)
+        return np.asarray(toks), oks
+
+    @staticmethod
+    def _poison_rows(poison, reqs) -> List[int]:
+        """Normalize a nan_logits fault payload into row indices:
+        callable(reqs) -> rows, True/'all' -> every row, int or list of
+        ints -> those rows (out-of-range ignored)."""
+        if callable(poison):
+            rows = poison(reqs)
+        elif poison is True or poison == "all":
+            rows = range(len(reqs))
+        elif isinstance(poison, int):
+            rows = [poison]
+        else:
+            rows = poison
+        return [int(i) for i in rows if 0 <= int(i) < len(reqs)]
 
     # ---------------------------------------------------- CoW page copies
     def _apply_copies(self, copies):
@@ -335,22 +484,83 @@ class ServingEngine:
             return "length"
         return None
 
+    # ------------------------------------------- boundary cancellations
+    def _cancel_boundary(self):
+        """Iteration-boundary cancellation sweep: apply any injected
+        clock skew (deadline-storm fault), then cancel aborted and
+        past-deadline requests in ANY state. Valid KV is donated."""
+        skew = faults.fire(FAULT_STORM)
+        if skew is not None:
+            self._clock_skew += float(skew)
+        now = self._now()
+        for req in list(self.requests.values()):
+            if req.state is RequestState.FINISHED:
+                continue
+            if req.aborted:
+                if self.scheduler.cancel(req, "abort"):
+                    self.metrics.on_abort(req.request_id)
+                    self._retain(req)
+            elif req.deadline is not None and now >= req.deadline:
+                if self.scheduler.cancel(req, "expired"):
+                    self.metrics.on_expire(req.request_id)
+                    self._retain(req)
+
+    def _quarantine(self, req: Request):
+        """Fail ONE poisoned request, not the engine: no token is
+        emitted, its pages are freed WITHOUT donation (they may hold
+        NaN K/V — the radix tree must never serve them)."""
+        if self.scheduler.cancel(req, "quarantined", donate=False):
+            self.metrics.on_quarantine(req.request_id)
+            self._retain(req)
+
+    def _fail(self, exc: BaseException):
+        """Unrecoverable: drain to a serializable snapshot and raise
+        EngineFailure. The engine refuses further work afterwards."""
+        self.metrics.on_engine_failure()
+        self.last_snapshot = self.snapshot(reason=repr(exc))
+        self.failed = True
+        raise EngineFailure(
+            f"unrecoverable engine error: {exc!r}; state drained to "
+            f"snapshot ({len(self.last_snapshot['requests'])} requests)",
+            snapshot=self.last_snapshot, cause=exc) from exc
+
+    # ------------------------------------------------------------- step
     def step(self):
-        """One engine iteration: schedule, run prefill chunks, run the
-        batched decode step. Returns [(request_id, token)] in emission
-        order (empty when idle)."""
+        """One engine iteration: cancellation sweep, schedule, run
+        prefill chunks, run the batched decode step. Returns
+        [(request_id, token)] in emission order (empty when idle).
+
+        Failure semantics per launch: transients retried by the
+        supervisor; a poison failure quarantines the offending
+        request(s) and the step continues; anything else drains to a
+        snapshot and raises EngineFailure."""
+        if self.failed:
+            raise EngineFailure("engine has failed; resume from "
+                                "last_snapshot", snapshot=self.last_snapshot)
         emitted = []
+        self._cancel_boundary()
         sched = self.scheduler.schedule()
         for req in sched.preempted:
             self.metrics.on_preempt()
 
         for chunk in sched.prefills:
             req = chunk.request
+            if req.state is RequestState.FINISHED:
+                continue               # quarantined earlier this step
             if chunk.is_first:
                 self.metrics.on_admission(req.request_id,
                                           req.cached_tokens,
                                           resumed=req.num_preemptions > 0)
-            tok = self._run_chunk(chunk)
+            try:
+                tok, ok = self._run_chunk(chunk)
+            except Exception as exc:   # noqa: BLE001
+                if classify_failure(exc) == POISON:
+                    self._quarantine(req)
+                    continue
+                self._fail(exc)
+            if not ok:
+                self._quarantine(req)
+                continue
             req.num_computed = chunk.start + chunk.length
             if chunk.is_last:
                 reason = self._emit(req, int(tok), emitted)
@@ -360,12 +570,26 @@ class ServingEngine:
                 else:
                     self.scheduler.on_prefilled(req)
 
-        if sched.decodes:
-            for req in sched.decodes:
+        decodes = [r for r in sched.decodes
+                   if r.state is not RequestState.FINISHED]
+        if decodes:
+            for req in decodes:
                 self._apply_copies(req.pending_copies)
                 req.pending_copies = []
-            toks = self._run_decode(sched.decodes)
-            for i, req in enumerate(sched.decodes):
+            try:
+                toks, oks = self._run_decode(decodes)
+            except Exception as exc:   # noqa: BLE001
+                if classify_failure(exc) == POISON:
+                    # unattributed poison (a FloatingPointError raised
+                    # by an eager/dispatch NaN hook instead of the
+                    # in-graph flags): isolate by running rows solo
+                    toks, oks = self._isolate_poisoned(decodes)
+                else:
+                    self._fail(exc)
+            for i, req in enumerate(decodes):
+                if not oks[i]:
+                    self._quarantine(req)
+                    continue
                 reason = self._emit(req, int(toks[i]), emitted)
                 if reason is not None:
                     self.scheduler.finish(req, reason)
@@ -383,12 +607,114 @@ class ServingEngine:
                                  if self.radix else None))
         return emitted
 
-    def _on_finished(self, req: Request):
-        self.metrics.on_finish(req.request_id)
+    def _isolate_poisoned(self, reqs: List[Request]):
+        """Degraded mode for an UNATTRIBUTED poison failure of a decode
+        batch: re-run each row as a solo launch to find the poisoned
+        request(s), returning (toks, oks) for the caller to emit or
+        quarantine from. Solo launches are idempotent K/V-wise (same
+        tokens written at the same positions) but use the B=1 bucket —
+        a different program shape, so this path trades the cross-shape
+        bit-identity guarantee for failure isolation (greedy tokens in
+        practice agree; SERVING.md documents the caveat)."""
+        toks = np.zeros((len(reqs),), np.int64)
+        oks = np.ones((len(reqs),), bool)
+        for i, req in enumerate(reqs):
+            try:
+                t, o = self._run_decode([req])
+            except Exception as exc:   # noqa: BLE001
+                if classify_failure(exc) == POISON:
+                    oks[i] = False
+                    continue
+                self._fail(exc)
+            toks[i] = int(t[0])
+            oks[i] = bool(o[0])
+        return toks, oks
+
+    def _retain(self, req: Request):
+        """Terminal-request retention bookkeeping (bounded window)."""
         self._finished_order.append(req.request_id)
         while len(self._finished_order) > self.max_retained_finished:
             self.requests.pop(self._finished_order.pop(0), None)
             self.num_evicted_finished += 1
+
+    def _on_finished(self, req: Request):
+        self.metrics.on_finish(req.request_id)
+        self._retain(req)
+
+    # --------------------------------------------------- snapshot/resume
+    def snapshot(self, reason: str = "requested") -> dict:
+        """Serializable drain state: every non-finished request (queued,
+        mid-prefill, decoding, preempted) with its prompt, tokens
+        generated so far, and remaining deadline. Device state (KV
+        pages) is deliberately NOT captured — it is lost with the device
+        anyway; a resumed request re-prefills prompt+generated exactly
+        like a preemption resume, so greedy outputs stay bit-identical
+        under the same bucket grid. JSON-roundtrip-safe by construction
+        (plain ints/floats/lists only)."""
+        now = self._now()
+        recs = []
+        for req in self.requests.values():
+            if req.state is RequestState.FINISHED:
+                continue
+            recs.append({
+                "request_id": int(req.request_id),
+                "prompt_ids": [int(t) for t in req.prompt_ids],
+                "output_ids": [int(t) for t in req.output_ids],
+                "max_new_tokens": int(req.max_new_tokens),
+                "eos_token_id": (None if req.eos_token_id is None
+                                 else int(req.eos_token_id)),
+                "num_preemptions": int(req.num_preemptions),
+                "aborted": bool(req.aborted),
+                "deadline_remaining_s": (
+                    None if req.deadline is None
+                    else float(req.deadline - now)),
+            })
+        recs.sort(key=lambda r: r["request_id"])   # FCFS order on resume
+        return {"version": SNAPSHOT_VERSION, "reason": str(reason),
+                "rng_key": np.asarray(self._key).tolist(),
+                "requests": recs}
+
+    @classmethod
+    def from_snapshot(cls, model, snapshot: dict, **engine_kw):
+        """Build a fresh engine that resumes a drained one. Restored
+        requests keep their ORIGINAL ids (the global id counter is
+        bumped past them) and re-enter WAITING with their generated
+        tokens folded into the resume prompt — the same recompute path
+        a preemption uses. Greedy outputs complete bit-identically
+        given the same bucket grid; the sampled-path key stream is
+        restored but its position reflects the resume's chunking, so
+        sampled continuations are reproducible per snapshot, not
+        bit-equal to the uninterrupted run."""
+        if snapshot.get("version") != SNAPSHOT_VERSION:
+            raise ValueError(f"unsupported snapshot version "
+                             f"{snapshot.get('version')!r}")
+        eng = cls(model, **engine_kw)
+        eng._key = jnp.asarray(np.asarray(snapshot["rng_key"], np.uint32))
+        max_id = -1
+        for rec in snapshot["requests"]:
+            req = Request(rec["prompt_ids"], rec["max_new_tokens"],
+                          rec.get("eos_token_id"),
+                          request_id=rec["request_id"])
+            if len(req.prompt_ids) + req.max_new_tokens > eng.max_seq_len:
+                raise ValueError(
+                    f"snapshot request {req.request_id} needs "
+                    f"{len(req.prompt_ids) + req.max_new_tokens} tokens "
+                    f"> resumed engine max_seq_len {eng.max_seq_len}")
+            req.output_ids = [int(t) for t in rec.get("output_ids", [])]
+            req.num_preemptions = int(rec.get("num_preemptions", 0))
+            req.aborted = bool(rec.get("aborted", False))
+            rem = rec.get("deadline_remaining_s")
+            if rem is not None:
+                req.deadline = eng._now() + float(rem)
+            # restored work was already admitted once: bypass the
+            # admission bound (shedding it would drop accepted work)
+            eng.scheduler.add_request(req, force=True)
+            eng.requests[req.request_id] = req
+            eng.metrics.on_add(req.request_id)
+            max_id = max(max_id, req.request_id)
+        if max_id >= 0:
+            bump_request_counter(max_id)
+        return eng
 
     # --------------------------------------------------- prefix cache ops
     def reset_prefix_cache(self) -> int:

@@ -1,0 +1,60 @@
+"""Typed failure surface of the serving engine.
+
+Every way the engine can refuse or lose work has a distinct type, so
+clients and the soak harness can branch on *what* failed instead of
+string-matching messages:
+
+* `EngineOverloaded` — admission control shed the request (bounded
+  queue); retry-after semantics belong to the caller.
+* `TransientDeviceError` — a device/transport error the supervisor
+  believes is retryable (UNAVAILABLE, relay loss). Raised internally
+  and by fault injection; callers normally never see it because the
+  supervisor retries it away.
+* `PoisonedComputation` — a deterministic numeric failure (NaN/Inf)
+  attributed to specific request(s); subclasses FloatingPointError so
+  the existing `utils.nan_inf` contract (dispatch NaN hooks raise
+  FloatingPointError) and the supervisor's classifier agree.
+* `EngineFailure` — the engine hit an unrecoverable error and drained
+  to `snapshot` (see SERVING.md "Failure semantics"); a fresh engine
+  resumes from it via `ServingEngine.from_snapshot`.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["EngineOverloaded", "TransientDeviceError",
+           "PoisonedComputation", "EngineFailure"]
+
+
+class EngineOverloaded(RuntimeError):
+    """Admission refused: the bounded waiting queue is full."""
+
+    def __init__(self, msg: str, queue_depth: int = 0,
+                 max_queue_len: int = 0):
+        super().__init__(msg)
+        self.queue_depth = queue_depth
+        self.max_queue_len = max_queue_len
+
+
+class TransientDeviceError(RuntimeError):
+    """A retryable device/transport failure (UNAVAILABLE-class)."""
+
+
+class PoisonedComputation(FloatingPointError):
+    """NaN/Inf attributed to a specific computation; `request_ids`
+    carries the quarantine targets when the engine can attribute it."""
+
+    def __init__(self, msg: str, request_ids=()):
+        super().__init__(msg)
+        self.request_ids = tuple(request_ids)
+
+
+class EngineFailure(RuntimeError):
+    """Unrecoverable engine error. `snapshot` is the serializable
+    drain state (queued + preempted + in-flight requests)."""
+
+    def __init__(self, msg: str, snapshot: Optional[dict] = None,
+                 cause: Optional[BaseException] = None):
+        super().__init__(msg)
+        self.snapshot = snapshot
+        self.cause = cause

@@ -19,9 +19,17 @@ from typing import Dict, List, Tuple
 
 import numpy as np
 
+from ..utils import faults
+
 __all__ = ["BlockAllocator", "KVSequence", "BlocksExhausted", "PAD_PAGE"]
 
 PAD_PAGE = 0
+
+# Fault-injection point (ISSUE 3): an armed spec makes _alloc_page raise
+# BlocksExhausted as if the pool were dry — the scheduler must degrade
+# through its reclamation ladder (radix LRU eviction, then
+# preempt-by-eviction), never crash or leak.
+FAULT_ALLOC = faults.register_point("serving.kv.alloc_page")
 
 
 class BlocksExhausted(Exception):
@@ -67,6 +75,8 @@ class BlockAllocator:
 
     # ---- low-level page ops ---------------------------------------------
     def _alloc_page(self) -> int:
+        if faults.fire(FAULT_ALLOC) is not None:
+            raise BlocksExhausted("injected allocator OOM")
         if not self._free:
             raise BlocksExhausted(
                 f"all {self.num_pages - 1} KV pages in use")
@@ -106,6 +116,21 @@ class BlockAllocator:
         return self.pages_needed(num_tokens) <= self.num_free
 
     # ---- sequence API ----------------------------------------------------
+    def _alloc_pages(self, n: int) -> List[int]:
+        """n fresh pages, all-or-nothing: a mid-loop BlocksExhausted
+        (possible via the injected-OOM fault even after a num_free
+        pre-check) rolls the partial allocation back before re-raising,
+        so no page ever leaks with a refcount and no owner."""
+        got: List[int] = []
+        try:
+            for _ in range(n):
+                got.append(self._alloc_page())
+        except BlocksExhausted:
+            for pid in got:
+                self._decref(pid)
+            raise
+        return got
+
     def alloc_sequence(self, num_tokens: int) -> KVSequence:
         """Pages for `num_tokens` tokens (a prompt about to prefill).
         All-or-nothing: on exhaustion nothing is held."""
@@ -114,7 +139,7 @@ class BlockAllocator:
             raise BlocksExhausted(
                 f"need {need} pages, {self.num_free} free")
         seq = KVSequence()
-        seq.pages = [self._alloc_page() for _ in range(need)]
+        seq.pages = self._alloc_pages(need)
         seq.num_tokens = num_tokens
         return seq
 
@@ -137,8 +162,13 @@ class BlockAllocator:
         seq = KVSequence()
         for pid in prefix_pages:
             self._incref(pid)
-        seq.pages = list(prefix_pages) + \
-            [self._alloc_page() for _ in range(fresh)]
+        try:
+            fresh_pages = self._alloc_pages(fresh)
+        except BlocksExhausted:
+            for pid in prefix_pages:   # all-or-nothing: drop shared refs
+                self._decref(pid)
+            raise
+        seq.pages = list(prefix_pages) + fresh_pages
         seq.num_tokens = num_tokens
         return seq
 

@@ -54,6 +54,13 @@ class ServingMetrics:
             "cached_tokens_served": 0,     # matched tokens reused from cache
             "prefill_tokens_skipped": 0,   # prefill work those tokens saved
             "radix_evicted_pages": 0,
+            # --- failure modes (ISSUE 3) ---
+            "requests_aborted": 0,         # client abort() honored
+            "deadline_expired": 0,         # TTL/deadline cancellations
+            "requests_shed": 0,            # EngineOverloaded rejections
+            "step_retries": 0,             # transient-failure re-launches
+            "requests_quarantined": 0,     # poisoned (NaN) requests failed
+            "engine_failures": 0,          # unrecoverable -> snapshot
         }
         self._registered = False
         self._t_start = time.perf_counter()
@@ -117,6 +124,28 @@ class ServingMetrics:
 
     def on_preempt(self):
         self.counters["requests_preempted"] += 1
+
+    # ---- failure-mode hooks (ISSUE 3) -----------------------------------
+    def on_abort(self, request_id: int):
+        self.counters["requests_aborted"] += 1
+        self._arrive_t.pop(request_id, None)
+
+    def on_expire(self, request_id: int):
+        self.counters["deadline_expired"] += 1
+        self._arrive_t.pop(request_id, None)
+
+    def on_shed(self):
+        self.counters["requests_shed"] += 1
+
+    def on_step_retry(self):
+        self.counters["step_retries"] += 1
+
+    def on_quarantine(self, request_id: int):
+        self.counters["requests_quarantined"] += 1
+        self._arrive_t.pop(request_id, None)
+
+    def on_engine_failure(self):
+        self.counters["engine_failures"] += 1
 
     def on_step(self):
         self.counters["engine_steps"] += 1

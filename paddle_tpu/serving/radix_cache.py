@@ -31,9 +31,15 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Tuple
 
+from ..utils import faults
 from .kv_cache import BlockAllocator
 
 __all__ = ["RadixCache", "RadixNode"]
+
+# Fault-injection point (ISSUE 3): donation failure. The scheduler's
+# finish/preempt paths must treat a failed insert as "nothing cached"
+# — the donor still frees its sequence normally, pages reclaim fully.
+FAULT_INSERT = faults.register_point("serving.radix.insert")
 
 
 class RadixNode:
@@ -124,6 +130,7 @@ class RadixCache:
         duplicates are simply not adopted). The caller retains its refs
         and frees its sequence normally afterwards. Returns the number
         of newly adopted pages."""
+        faults.fire(FAULT_INSERT)
         tokens = tuple(tokens)
         if len(tokens) != len(pages) * self.page_size:
             raise ValueError(

@@ -29,6 +29,13 @@ Per-request state machine:
        +---------------------- preempt ------------------------+
                                                   --eos/len--> FINISHED
 
+`cancel()` exits any live state (queued, chunk-prefilling, decoding,
+preempted-and-waiting) into FINISHED at an iteration boundary, with the
+finish_reason recording why ("abort" / "expired" / "quarantined").
+Aborted and expired requests DONATE their computed pages to the radix
+cache (their KV is valid — the client just stopped wanting it);
+quarantined requests never donate (their pages may hold NaN K/V).
+
 The scheduler is pure host logic and deterministic: given the same
 arrival sequence and the same allocator geometry it produces the same
 step-by-step batch composition (golden-trace tested; the radix LRU uses
@@ -55,6 +62,18 @@ class RequestState(enum.Enum):
 
 
 _req_counter = itertools.count()
+
+
+def bump_request_counter(beyond: int):
+    """Advance the global request-id counter past `beyond` — resuming a
+    snapshot restores requests under their ORIGINAL ids, and new
+    requests added afterwards must not collide with them."""
+    global _req_counter
+    nxt = next(_req_counter)
+    if nxt <= beyond:
+        _req_counter = itertools.count(beyond + 1)
+    else:
+        _req_counter = itertools.count(nxt)
 
 
 class Request:
@@ -85,6 +104,12 @@ class Request:
         self.num_computed = 0
         # cached-prefix tokens matched at the LAST admission
         self.cached_tokens = 0
+        # --- resilience (ISSUE 3) ---
+        # absolute engine-clock deadline (None = no TTL); the engine
+        # cancels past-deadline requests at each iteration boundary
+        self.deadline: Optional[float] = None
+        # set by ServingEngine.abort(); honored at the next boundary
+        self.aborted = False
 
     # prompt the next prefill must process (original prompt + anything
     # generated before a preemption — recompute-style resume)
@@ -158,19 +183,28 @@ class Scheduler:
     def __init__(self, allocator: BlockAllocator, max_batch_size: int = 8,
                  token_budget: int = 512,
                  max_prompt_len: Optional[int] = None,
-                 prefix_cache=None):
+                 prefix_cache=None,
+                 max_queue_len: Optional[int] = None):
         self.allocator = allocator
         self.max_batch_size = int(max_batch_size)
         self.token_budget = int(token_budget)
         self.max_prompt_len = max_prompt_len
         self.prefix_cache = prefix_cache
+        # admission control: bound on len(waiting). A preempted request
+        # re-entering the queue is NOT subject to it (it was already
+        # admitted once; shedding it would drop accepted work).
+        self.max_queue_len = (None if max_queue_len is None
+                              else int(max_queue_len))
         self.waiting: deque = deque()
         self.prefilling: List[Request] = []   # admitted, chunks pending
         self.running: List[Request] = []      # decoding, arrival order
         self.num_preemptions = 0
 
     # ---- intake ----------------------------------------------------------
-    def add_request(self, req: Request):
+    def add_request(self, req: Request, force: bool = False):
+        """Queue `req` (FCFS). `force=True` bypasses the admission bound
+        — used for snapshot-restored requests, which were admitted once
+        already; validation still applies."""
         if self.max_prompt_len is not None and \
                 len(req.prompt_ids) > self.max_prompt_len:
             raise ValueError(
@@ -181,6 +215,14 @@ class Scheduler:
             raise ValueError(
                 f"request needs {len(req.prompt_ids) + req.max_new_tokens} "
                 f"tokens of KV > total capacity {cap}")
+        if not force and self.max_queue_len is not None and \
+                len(self.waiting) >= self.max_queue_len:
+            from .errors import EngineOverloaded
+            raise EngineOverloaded(
+                f"waiting queue full ({len(self.waiting)} >= "
+                f"max_queue_len {self.max_queue_len})",
+                queue_depth=len(self.waiting),
+                max_queue_len=self.max_queue_len)
         req.state = RequestState.WAITING
         self.waiting.append(req)
 
@@ -207,7 +249,15 @@ class Scheduler:
         ps = self.allocator.page_size
         full = (n // ps) * ps
         if full:
-            self.prefix_cache.insert(ids[:full], req.seq.pages[:full // ps])
+            try:
+                self.prefix_cache.insert(ids[:full],
+                                         req.seq.pages[:full // ps])
+            except Exception:
+                # a failed donation (e.g. injected fault) only costs a
+                # future cache hit; the donor still frees normally and
+                # the tree was not mutated (insert raises before any
+                # adoption), so reclamation stays exact
+                pass
 
     def _reclaim(self, need_pages: int, protect=()) -> bool:
         """Cached-prefix LRU eviction — ALWAYS tried before preempting a
@@ -341,14 +391,31 @@ class Scheduler:
         self.running.append(req)
         self.running.sort(key=lambda r: r.arrival)
 
-    def finish(self, req: Request, reason: str):
+    def finish(self, req: Request, reason: str, donate: bool = True):
         if req in self.running:
             self.running.remove(req)
         if req in self.prefilling:
             self.prefilling.remove(req)
         if req.seq is not None:
-            self._donate(req)
+            if donate:
+                self._donate(req)
             self.allocator.free_sequence(req.seq)
             req.seq = None
         req.state = RequestState.FINISHED
         req.finish_reason = reason
+
+    def cancel(self, req: Request, reason: str,
+               donate: bool = True) -> bool:
+        """Cancel a request in ANY live state — queued, mid-prefill,
+        decoding, or preempted-back-to-waiting. Pages are donated to the
+        prefix cache (valid KV; `donate=False` for quarantine — poisoned
+        KV must never enter the tree) and freed. Returns False when the
+        request already finished."""
+        if req.state is RequestState.FINISHED:
+            return False
+        try:
+            self.waiting.remove(req)
+        except ValueError:
+            pass
+        self.finish(req, reason, donate=donate)
+        return True

@@ -5,8 +5,18 @@ Parity: reference `FLAGS_check_nan_inf` + per-op scan
 When enabled, the op-dispatch funnel checks every float output eagerly and
 raises with the op name — the same observability point as the reference's
 eager hook.
+
+Poison attribution (ISSUE 3): `poison_scope(label)` pushes a label onto
+a scope stack that every raised FloatingPointError message carries —
+the serving engine wraps each compiled launch in a scope naming the
+request(s) in flight, so a NaN caught by a dispatch hook is attributed
+to the batch that produced it (the supervisor classifies any
+FloatingPointError as deterministic poison, never retried).
 """
 from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import List
 
 import jax
 import jax.numpy as jnp
@@ -15,7 +25,24 @@ import numpy as np
 from .flags import flags, set_flags
 
 __all__ = ["check_numerics", "enable_check_nan_inf", "check_nan_inf_enabled",
-           "maybe_check"]
+           "maybe_check", "poison_scope", "current_poison_scope"]
+
+_SCOPES: List[str] = []
+
+
+@contextmanager
+def poison_scope(label: str):
+    """Attribute any NaN-check failure raised in the body to `label`."""
+    _SCOPES.append(str(label))
+    try:
+        yield
+    finally:
+        _SCOPES.pop()
+
+
+def current_poison_scope():
+    """The active attribution path, or None outside every scope."""
+    return "/".join(_SCOPES) if _SCOPES else None
 
 
 def enable_check_nan_inf(enable=True, level=0):
@@ -34,7 +61,9 @@ def check_numerics(x, op_name="tensor", action="raise"):
     if bad:
         n_nan = int(jnp.sum(jnp.isnan(arr)))
         n_inf = int(jnp.sum(jnp.isinf(arr)))
-        msg = (f"[check_nan_inf] op `{op_name}` produced {n_nan} NaN / "
+        scope = current_poison_scope()
+        where = f" in scope `{scope}`" if scope else ""
+        msg = (f"[check_nan_inf] op `{op_name}`{where} produced {n_nan} NaN / "
                f"{n_inf} Inf values (shape={tuple(arr.shape)}, dtype={arr.dtype})")
         if action == "raise" and int(flags("check_nan_inf_level", 0)) == 0:
             raise FloatingPointError(msg)

@@ -20,6 +20,8 @@ import pytest
 
 import paddle_tpu as paddle
 
+import _env_probes
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 STEPS = 5
 HIDDEN = 16
@@ -367,6 +369,7 @@ def _launch_two(payload_text, tmp_path, extra_env, timeout=360):
     return outs
 
 
+@_env_probes.skip_unless(_env_probes.multiprocess_collectives)
 def test_tp4_dp2_cross_process_matches_single_process(tmp_path):
     """VERDICT r2 #6: REAL multi-process TP — 2 processes x 4 virtual CPU
     devices bootstrap via jax.distributed.initialize; a dp2 x mp4 mesh
@@ -416,6 +419,7 @@ def test_pp2_cross_process_matches_single_process(tmp_path):
     assert got[-1] < got[0]
 
 
+@_env_probes.skip_unless(_env_probes.multiprocess_collectives)
 def test_ep_moe_cross_process_matches_single_process(tmp_path):
     """Expert parallelism across processes: the EP ('model') mesh axis
     spans two launched processes, so the MoE dispatch/combine
@@ -455,6 +459,7 @@ def test_ep_moe_cross_process_matches_single_process(tmp_path):
     assert ref[-1] < ref[0]
 
 
+@_env_probes.skip_unless(_env_probes.multiprocess_collectives)
 def test_dp2_matches_single_process(tmp_path):
     payload = tmp_path / "payload.py"
     payload.write_text(PAYLOAD)

@@ -10,6 +10,8 @@ import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 import paddle_tpu as paddle
+
+import _env_probes
 import paddle_tpu.distributed as dist
 from paddle_tpu.distributed import Partial, ProcessMesh, Replicate, Shard
 from paddle_tpu.distributed.fleet import (CommunicateTopology,
@@ -501,6 +503,7 @@ def test_spmd_rule_registry():
     assert r.out_specs[0] == P("model", "data")
 
 
+@_env_probes.skip_unless(_env_probes.banked_average_bitwise)
 def test_gradient_merge_strategy():
     """fleet gradient_merge: k_steps of grads bank, apply every k-th
     (parity: fleet meta-optimizer gradient_merge)."""

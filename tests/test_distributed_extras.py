@@ -9,6 +9,8 @@ import numpy as np
 import pytest
 
 import paddle_tpu as paddle
+
+import _env_probes
 import paddle_tpu.distributed as dist
 
 rng = np.random.RandomState(0)
@@ -243,6 +245,7 @@ def test_dist_save_exports_save_for_auto_inference(tmp_path):
     assert p and (tmp_path / "m.pdparams").exists()
 
 
+@_env_probes.skip_unless(_env_probes.host_offload_remat)
 def test_recompute_offload_policy_grads_match():
     """recompute(offload=True) applies the offload-dots remat policy
     (saved residuals to pinned host) and still matches plain autograd;

@@ -17,6 +17,8 @@ import numpy as np
 import pytest
 
 import paddle_tpu as paddle
+
+import _env_probes
 from paddle_tpu.distributed.launch.main import _parse_args, _rank_env
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -81,6 +83,7 @@ def test_launch_requires_master_for_multinode():
         launch(["--nnodes", "2", "x.py"])
 
 
+@_env_probes.skip_unless(_env_probes.multiprocess_collectives)
 def test_fake_multinode_launch(tmp_path):
     """Two launch CLIs on localhost (fake multinode) bootstrap one 2-process
     job: jax.distributed + cross-process reduction + TCPStore KV."""

@@ -1,0 +1,27 @@
+"""Slow wrapper for the fault-injection soak (ISSUE 3 acceptance).
+
+Excluded from tier-1 by the `slow` marker (pytest.ini addopts runs
+`-m "not slow"` by default); run it with `make soak` or
+`pytest tests/test_soak_serving.py -m slow`.
+"""
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+@pytest.mark.slow
+def test_soak_200_requests_all_faults():
+    from tools import soak_serving
+    assert soak_serving.main(["--requests", "200", "--seed", "0"]) == 0
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [1, 2])
+def test_soak_other_seeds(seed):
+    from tools import soak_serving
+    assert soak_serving.main(["--requests", "60", "--seed", str(seed)]) == 0

@@ -85,8 +85,30 @@ print(f"serving engine: batch=8 decode {dt / N_STEPS * 1e3:.2f} ms/step "
       f"SERVING_ENGINE_TOKS_PER_S {tps:.1f}")
 print("serving engine counters:", eng.metrics.snapshot())
 assert eng.num_compiled_programs <= eng.max_program_count()
+
+# --- failure-mode probe (ISSUE 3): abort + TTL on the real chip -------
+# Two of the decoding requests are aborted mid-flight and two more are
+# added with a microscopic TTL; the engine must drain cleanly, donate
+# the aborted KV to the radix tree, and report the failure counters.
+live = [r for r in eng.requests.values()
+        if r.state is RequestState.DECODE][:2]
+for r in live:
+    assert eng.abort(r.request_id)
+for _ in range(2):
+    eng.add_request(rng.randint(0, cfg.vocab_size, (12,)).tolist(),
+                    max_new_tokens=50, ttl_s=1e-6)
+eng.run()
+snap = eng.metrics.snapshot()
+fail_keys = ("requests_aborted", "deadline_expired", "requests_shed",
+             "step_retries", "requests_quarantined", "engine_failures")
+print("serving failure counters:",
+      {k: snap[k] for k in fail_keys})
+assert snap["requests_aborted"] == 2 and snap["deadline_expired"] == 2
+assert snap["requests_quarantined"] == 0 and snap["engine_failures"] == 0
+eng.reset_prefix_cache()
+assert eng.allocator.num_used == 0
 eng.shutdown()
-print("SERVING_ENGINE_CHIP_OK")
+print("SERVING_ENGINE_CHIP_OK SERVING_FAULTS_CHIP_OK")
 
 # --- shared-prefix throughput probe (ISSUE 2) --------------------------
 # 8 requests sharing a 96-token system-prompt-style prefix, radix cache

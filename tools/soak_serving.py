@@ -1,0 +1,260 @@
+"""Randomized fault-injection soak for the serving engine (ISSUE 3).
+
+Runs the SAME seeded mixed workload twice on CPU — once clean, once
+with every registered fault point armed (allocator OOM, transient
+step exceptions on prefill and decode, NaN logits, deadline storms,
+radix donation failures) plus seeded client aborts — and asserts the
+resilience acceptance criteria:
+
+* zero engine crashes (injected transients can never exhaust the retry
+  budget by construction: times <= max_retries);
+* every KV page reclaimed and allocator/radix ref-counts consistent at
+  drain;
+* greedy outputs of UNAFFECTED requests bit-identical to the clean run
+  (affected = quarantined / expired / aborted / shed);
+* every armed fault point actually fired (a soak that injected nothing
+  proves nothing).
+
+Deterministic end to end: workload, fault schedule, aborts and the
+deadline clock all derive from --seed; wall-clock never enters the
+engine (FakeClock + storm skew only). Bounded runtime: the engine's own
+drain guard plus a hard step ceiling.
+
+Usage:  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+            python tools/soak_serving.py [--requests 200] [--seed 0]
+(or `make soak`). Exits 0 on success, 1 with a report on violation —
+this is a test harness, not bench.py; it is allowed to fail loudly.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# CPU pin BEFORE jax initializes (the hosting image's sitecustomize
+# force-registers a TPU platform; mirror tests/conftest.py)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax                                                   # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np                                           # noqa: E402
+
+import paddle_tpu as paddle                                  # noqa: E402
+from paddle_tpu.models.llama import (LlamaConfig,            # noqa: E402
+                                     LlamaForCausalLM)
+from paddle_tpu.serving import (EngineOverloaded,            # noqa: E402
+                                RetryPolicy, ServingEngine,
+                                TransientDeviceError)
+from paddle_tpu.utils import faults                          # noqa: E402
+
+# single-bucket grid: every run hits identical program shapes, so the
+# bit-identity comparison is exact (SERVING.md determinism contract)
+ENGINE_KW = dict(num_pages=40, page_size=8, token_budget=48,
+                 batch_buckets=[8], prefill_buckets=[32], pages_buckets=[8],
+                 temperature=0.0, max_queue_len=32)
+TTL_S = 1000.0          # generous; only storm skew can expire anything
+ABORT_FRACTION = 0.04
+MAX_STEPS_FACTOR = 400  # hard ceiling: steps <= factor * num_requests
+
+
+class FakeClock:
+    """Engine deadline clock: advances a fixed tick per call, so expiry
+    is a function of step count + injected storm skew, never host
+    wall-clock."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        self.t += 1e-3
+        return self.t
+
+
+def make_workload(n, seed):
+    rng = np.random.RandomState(seed)
+    shared = rng.randint(0, 128, (16,)).tolist()    # 2 full pages
+    work = []
+    for i in range(n):
+        if rng.random() < 0.3:                      # radix exercise
+            p = shared + rng.randint(0, 128, (rng.randint(2, 8),)).tolist()
+        else:
+            p = rng.randint(0, 128, (rng.randint(4, 24),)).tolist()
+        work.append((p, int(rng.randint(3, 10))))
+    return work
+
+
+def run_workload(model, work, *, chaos, seed, report):
+    """One full soak pass; returns ({idx: tokens}, affected_idx_set)."""
+    rng = np.random.RandomState(seed + 1)
+    abort_at = {i for i in range(len(work))
+                if rng.random() < ABORT_FRACTION} if chaos else set()
+
+    eng = ServingEngine(
+        model, clock=FakeClock(), default_ttl_s=TTL_S,
+        retry_policy=RetryPolicy(max_retries=12, base_s=0.0,
+                                 sleep=lambda s: None),
+        **ENGINE_KW)
+    if chaos:
+        # Every point gets one DETERMINISTIC early spec (the "every
+        # registered point fired" assertion must not ride on a seeded
+        # coin) plus a seeded probabilistic spec for spread. Transient
+        # totals stay < max_retries(12), so retry exhaustion (and thus
+        # EngineFailure) is impossible by construction.
+        faults.inject("serving.engine.prefill_chunk",
+                      exc=TransientDeviceError("soak: UNAVAILABLE"),
+                      after=3, times=1)
+        faults.inject("serving.engine.prefill_chunk",
+                      exc=TransientDeviceError("soak: UNAVAILABLE"),
+                      prob=0.03, times=9, seed=seed + 2)
+        faults.inject("serving.engine.decode_step",
+                      exc=TransientDeviceError("soak: relay loss"),
+                      after=4, times=1)
+        faults.inject("serving.engine.decode_step",
+                      exc=TransientDeviceError("soak: relay loss"),
+                      prob=0.03, times=9, seed=seed + 3)
+        faults.inject("serving.kv.alloc_page", payload=True,
+                      after=5, times=2)
+        faults.inject("serving.kv.alloc_page", payload=True,
+                      prob=0.05, times=20, seed=seed + 4)
+        nan_rng = np.random.RandomState(seed + 5)
+        faults.inject("serving.engine.nan_logits",
+                      payload=lambda reqs: [nan_rng.randint(len(reqs))],
+                      after=6, times=1)
+        faults.inject("serving.engine.nan_logits",
+                      payload=lambda reqs: [nan_rng.randint(len(reqs))],
+                      prob=0.02, times=3, seed=seed + 6)
+        # the storm fires at boundary hits 11-12, whose combined 1200 s
+        # of skew blows every pre-storm deadline (TTL 1000) — a burst
+        # expiry wave mid-traffic
+        faults.inject("serving.engine.deadline_storm", payload=600.0,
+                      after=10, times=2)
+        faults.inject("serving.radix.insert",
+                      exc=RuntimeError("soak: donation failed"),
+                      after=2, times=1)
+        faults.inject("serving.radix.insert",
+                      exc=RuntimeError("soak: donation failed"),
+                      prob=0.05, times=7, seed=seed + 8)
+
+    idx_of = {}
+    pending = list(enumerate(work))
+    sheds = 0
+    steps = 0
+    max_steps = MAX_STEPS_FACTOR * max(1, len(work))
+    out = {}
+    try:
+        while pending or eng.has_work():
+            # arrival waves: up to 4 per step; shed -> retry next step
+            admitted_this_step = 0
+            while pending and admitted_this_step < 4:
+                i, (p, m) = pending[0]
+                try:
+                    rid = eng.add_request(p, max_new_tokens=m)
+                except EngineOverloaded:
+                    sheds += 1
+                    break
+                idx_of[rid] = i
+                pending.pop(0)
+                admitted_this_step += 1
+            for rid, tok in eng.step():
+                i = idx_of[rid]
+                out.setdefault(i, []).append(tok)
+                if i in abort_at and len(out[i]) == 1:
+                    eng.abort(rid)
+            steps += 1
+            if steps > max_steps:
+                raise AssertionError(
+                    f"soak failed to drain after {steps} steps")
+
+        affected = set()
+        reasons = {}
+        for rid, i in idx_of.items():
+            req = eng.requests.get(rid)
+            assert req is not None, f"request {rid} evicted mid-soak"
+            reasons[req.finish_reason] = reasons.get(
+                req.finish_reason, 0) + 1
+            if req.finish_reason in ("quarantined", "expired", "abort"):
+                affected.add(i)
+            out[i] = list(req.output_ids)
+
+        # ---- reclamation + ref-count consistency at drain -----------
+        if eng.radix is not None:
+            eng.radix.check_invariants()
+            assert eng.allocator.num_used == eng.radix.num_cached_pages
+        eng.reset_prefix_cache()
+        assert eng.allocator.num_used == 0, "KV pages leaked"
+        eng.allocator.check_invariants()
+
+        snap = eng.metrics.snapshot()
+        report.update({
+            ("chaos" if chaos else "clean"): {
+                "steps": steps, "sheds": sheds,
+                "finish_reasons": reasons,
+                "affected": len(affected),
+                "preemptions": snap["requests_preempted"],
+                "step_retries": snap["step_retries"],
+                "quarantined": snap["requests_quarantined"],
+                "expired": snap["deadline_expired"],
+                "aborted": snap["requests_aborted"],
+                "prefix_hits": snap["prefix_hits"],
+            }})
+        if chaos:
+            fired = faults.fired_counts()
+            report["fired"] = fired
+            for pt in faults.points():
+                if pt.startswith("serving."):
+                    assert fired.get(pt, 0) >= 1, \
+                        f"armed fault point {pt} never fired"
+        return out, affected
+    finally:
+        faults.clear()
+        faults.reset_counts()
+        eng.shutdown()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--requests", type=int, default=200)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    cfg = LlamaConfig(vocab_size=128, hidden_size=128,
+                      intermediate_size=256, num_hidden_layers=2,
+                      num_attention_heads=2, num_key_value_heads=1,
+                      max_position_embeddings=128)
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    work = make_workload(args.requests, args.seed)
+
+    report = {"requests": args.requests, "seed": args.seed}
+    t0 = time.perf_counter()
+    clean, _ = run_workload(model, work, chaos=False, seed=args.seed,
+                            report=report)
+    chaotic, affected = run_workload(model, work, chaos=True,
+                                     seed=args.seed, report=report)
+
+    # ---- bit-identity of unaffected requests ------------------------
+    diverged = [i for i in range(len(work))
+                if i not in affected and chaotic.get(i) != clean.get(i)]
+    assert not diverged, \
+        f"unaffected requests diverged from the clean run: {diverged[:10]}"
+    # the chaos run must actually have exercised the failure paths
+    ch = report["chaos"]
+    assert ch["step_retries"] >= 1 and ch["quarantined"] >= 1, ch
+    report["wall_s"] = round(time.perf_counter() - t0, 2)
+    report["unaffected_bit_identical"] = args.requests - len(affected)
+    print(json.dumps(report))
+    print("SOAK_SERVING_OK")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except AssertionError as e:
+        print(f"SOAK_SERVING_FAILED: {e}", file=sys.stderr)
+        sys.exit(1)
