@@ -5,10 +5,15 @@ TEST_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
 .PHONY: test test-fast bench soak lint
 
-test:
+# tpu-lint: static trace-safety analysis (ANALYSIS.md). AST-only — no
+# jax import, no TPU grant, ~1 s; gates `make test`.
+lint:
+	$(TEST_ENV) python tools/tpu_lint.py paddle_tpu
+
+test: lint
 	$(TEST_ENV) python -m pytest tests/ -x -q
 
-test-fast:
+test-fast: lint
 	$(TEST_ENV) python -m pytest tests/ -x -q -m "not slow"
 
 bench:

@@ -1,0 +1,146 @@
+"""Small AST helpers shared by the tpu-lint rules (stdlib-only)."""
+from __future__ import annotations
+
+import ast
+
+__all__ = ["dotted_name", "get_arg", "lambdas_in", "resolve_int",
+           "resolve_shape", "module_int_consts", "dtype_name",
+           "local_functions"]
+
+
+def dotted_name(node):
+    """'pl.BlockSpec' for Attribute chains, 'BlockSpec' for Names,
+    None otherwise."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def get_arg(call: ast.Call, idx, kwname):
+    """Positional arg idx or keyword kwname of a Call, else None."""
+    if idx is not None and len(call.args) > idx:
+        a = call.args[idx]
+        if not isinstance(a, ast.Starred):
+            return a
+    for kw in call.keywords:
+        if kw.arg == kwname:
+            return kw.value
+    return None
+
+
+def lambdas_in(node):
+    """Every Lambda inside `node` (including `node` itself)."""
+    return [n for n in ast.walk(node) if isinstance(n, ast.Lambda)]
+
+
+_INT_WRAPPERS = {"int32", "int64", "int16", "int8", "int", "uint32"}
+
+
+def resolve_int(node, consts):
+    """Best-effort static int: literals, module-level constants,
+    np.int32(...)-style wrappers, unary minus and + - * // % arithmetic
+    over resolvable operands. None when unresolvable."""
+    if isinstance(node, ast.Constant):
+        if isinstance(node.value, bool):
+            return None
+        return node.value if isinstance(node.value, int) else None
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = resolve_int(node.operand, consts)
+        return -v if v is not None else None
+    if isinstance(node, ast.Call):
+        fname = dotted_name(node.func) or ""
+        if fname.split(".")[-1] in _INT_WRAPPERS and len(node.args) == 1 \
+                and not node.keywords:
+            return resolve_int(node.args[0], consts)
+        return None
+    if isinstance(node, ast.BinOp):
+        l = resolve_int(node.left, consts)
+        r = resolve_int(node.right, consts)
+        if l is None or r is None:
+            return None
+        try:
+            if isinstance(node.op, ast.Add):
+                return l + r
+            if isinstance(node.op, ast.Sub):
+                return l - r
+            if isinstance(node.op, ast.Mult):
+                return l * r
+            if isinstance(node.op, ast.FloorDiv):
+                return l // r
+            if isinstance(node.op, ast.Mod):
+                return l % r
+            if isinstance(node.op, ast.Pow):
+                # bound the result: resolve_int runs over every
+                # module-level assignment of every linted file, and an
+                # unbounded `l ** r` on a typo'd exponent chain would
+                # materialize astronomically large ints and stall the
+                # lint gate
+                if r < 0 or r > 64 or abs(l) > 1 << 20:
+                    return None
+                return l ** r
+        except (ZeroDivisionError, OverflowError):
+            return None
+    return None
+
+
+def resolve_shape(node, consts):
+    """Tuple of ints for a literal Tuple/List shape, else None (None
+    also when ANY element is unresolvable — rules must skip, not
+    guess)."""
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return None
+    dims = []
+    for e in node.elts:
+        v = resolve_int(e, consts)
+        if v is None:
+            return None
+        dims.append(v)
+    return tuple(dims)
+
+
+def module_int_consts(tree):
+    """Module-level `NAME = <int>` bindings (incl. np.int32(0)-style),
+    resolved to a fixpoint so consts may reference earlier consts."""
+    consts = {}
+    for _ in range(3):  # tiny fixpoint: const chains are shallow
+        changed = False
+        for st in tree.body:
+            if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                    and isinstance(st.targets[0], ast.Name):
+                name = st.targets[0].id
+                if name in consts:
+                    continue
+                v = resolve_int(st.value, consts)
+                if v is not None:
+                    consts[name] = v
+                    changed = True
+        if not changed:
+            break
+    return consts
+
+
+def dtype_name(node):
+    """'float32' from jnp.float32 / np.float32 / 'float32' / "float32"."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    d = dotted_name(node)
+    if d is not None:
+        return d.split(".")[-1]
+    return None
+
+
+def local_functions(tree):
+    """name -> FunctionDef for every def in the file (any nesting);
+    later defs win, mirroring runtime rebinding."""
+    fns = {}
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fns[n.name] = n
+    return fns

@@ -1,0 +1,154 @@
+"""tpu-lint drivers: parse a file once, run the selected rules, apply
+escape hatches.
+
+Escape-hatch syntax (ANALYSIS.md):
+    # tpu-lint: <slug>-ok          suppress that slug on this line
+    # tpu-lint: ok                 suppress every rule on this line
+    # tpu-lint: skip-file          skip the whole file
+A hatch comment counts for the line it sits on AND the next line, so it
+can ride above a flagged expression or at the end of it.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+from . import astutil
+from .diagnostics import Diagnostic
+from .registry import all_rules
+
+__all__ = ["FileContext", "lint_source", "lint_file", "lint_paths",
+           "iter_python_files"]
+
+_HATCH_RE = re.compile(r"#\s*tpu-lint:\s*([A-Za-z0-9_,\- ]+)")
+
+
+def _parse_hatches(source):
+    """line (1-based) -> set of tokens ('ok', '<slug>-ok', 'skip-file').
+
+    Hatches are extracted from REAL comment tokens (tokenize), not a
+    substring scan of raw lines: a docstring or test string that merely
+    QUOTES the hatch syntax must not suppress anything — a regex over
+    lines silently skip-file'd any module documenting the syntax. On a
+    tokenize failure the file simply has no hatches (the conservative
+    direction: more findings, never fewer)."""
+    hatches = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _HATCH_RE.search(tok.string)
+            if m:
+                toks = {t.strip().lower() for t in m.group(1).split(",")
+                        if t.strip()}
+                if toks:
+                    hatches.setdefault(tok.start[0], set()).update(toks)
+    except (tokenize.TokenError, IndentationError, SyntaxError,
+            ValueError):
+        return {}
+    return hatches
+
+
+@dataclass
+class FileContext:
+    path: str
+    source: str
+    tree: ast.AST
+    lines: list
+    is_test: bool
+    consts: dict = field(default_factory=dict)
+    functions: dict = field(default_factory=dict)
+    hatches: dict = field(default_factory=dict)
+
+    @property
+    def skip_file(self):
+        return any("skip-file" in toks for toks in self.hatches.values())
+
+    def suppressed(self, diag: Diagnostic):
+        for line in (diag.line, diag.line - 1):
+            toks = self.hatches.get(line)
+            if toks and ("ok" in toks or f"{diag.slug}-ok" in toks):
+                return True
+        return False
+
+
+def _infer_is_test(path):
+    parts = os.path.normpath(path).split(os.sep)
+    base = os.path.basename(path)
+    return ("tests" in parts or base.startswith("test_")
+            or base == "conftest.py")
+
+
+def lint_source(source, path="<string>", rules=None, is_test=None):
+    """Lint one source string. Returns a sorted diagnostic list.
+    Syntax errors produce a single parse-error diagnostic rather than
+    raising (the linter must be runnable over arbitrary trees)."""
+    if rules is None:
+        rules = all_rules()
+    if is_test is None:
+        is_test = _infer_is_test(path)
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Diagnostic(rule="parse", slug="parse", severity="error",
+                           path=path, line=int(e.lineno or 0),
+                           message=f"syntax error: {e.msg}")]
+    lines = source.splitlines()
+    ctx = FileContext(
+        path=path, source=source, tree=tree, lines=lines, is_test=is_test,
+        consts=astutil.module_int_consts(tree),
+        functions=astutil.local_functions(tree),
+        hatches=_parse_hatches(source))
+    if ctx.skip_file:
+        return []
+    out = []
+    for rule in rules:
+        for diag in rule.check(ctx):
+            if not ctx.suppressed(diag):
+                out.append(diag)
+    out.sort(key=Diagnostic.sort_key)
+    return out
+
+
+def lint_file(path, rules=None, is_test=None):
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    return lint_source(src, path=path, rules=rules, is_test=is_test)
+
+
+def iter_python_files(paths, exclude=()):
+    """Yield .py files under `paths` (files or directories), sorted,
+    skipping any whose path contains an `exclude` substring."""
+    seen = []
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                seen.append(p)
+        else:
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                for fn in sorted(files):
+                    if fn.endswith(".py"):
+                        seen.append(os.path.join(root, fn))
+    for p in seen:
+        norm = p.replace(os.sep, "/")
+        if any(x in norm for x in exclude):
+            continue
+        yield p
+
+
+def lint_paths(paths, rules=None, exclude=(), is_test=None):
+    """Lint every .py file under `paths`. Returns (diagnostics,
+    files_scanned)."""
+    diags = []
+    n = 0
+    for path in iter_python_files(paths, exclude=exclude):
+        n += 1
+        diags.extend(lint_file(path, rules=rules, is_test=is_test))
+    diags.sort(key=Diagnostic.sort_key)
+    return diags, n

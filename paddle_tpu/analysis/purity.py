@@ -1,0 +1,171 @@
+"""Trace-purity vocabulary + runtime diagnostic recorder (rule A5).
+
+This is the PROMOTION of dy2static's mutation/side-effect detection
+into reportable diagnostics: the canonical name sets live here (and
+`jit/dy2static.py` imports them back, so the linter and the converter
+can never drift), and the runtime events that used to be only warnings
+or silent declines — a `print` in a scan/while-lowered body, a loop
+kept eager because its body mutates non-carried python state, an
+out-of-trace collective on a >1-rank group — now also record a shared
+`Diagnostic` that `jit.to_static_report()` exposes and
+`tools/fallback_report.py --lint` renders into FALLBACKS.md.
+
+Stdlib-only (see diagnostics.py docstring for why).
+"""
+from __future__ import annotations
+
+import ast
+import threading
+
+from .diagnostics import Diagnostic, Severity
+
+__all__ = [
+    "SIDE_EFFECT_BUILTINS", "MUTATOR_METHODS", "side_effect_calls",
+    "record", "drain", "snapshot", "reset", "set_context", "clear_context",
+    "record_loop_side_effect", "record_loop_mutation",
+    "record_out_of_trace_collective",
+]
+
+# Pure-output builtins that are invisible to the mutation checks but run
+# ONCE at trace time inside a compiled loop body (dy2static module
+# docstring, ADVICE r5 #1).
+SIDE_EFFECT_BUILTINS = frozenset({"print", "breakpoint", "input"})
+
+# Container mutator methods: a call `x.append(...)` on non-carried state
+# inside a trace-once body runs once, not per iteration (dy2static
+# `_has_uncarried_mutation`).
+MUTATOR_METHODS = frozenset({
+    "append", "extend", "insert", "remove", "clear", "sort", "reverse",
+    "discard", "update", "setdefault", "popitem", "appendleft",
+    "popleft", "pop",
+})
+
+
+def side_effect_calls(node):
+    """AST sweep shared by the static A5 rule: (name, lineno) for every
+    side-effecting call in `node` — SIDE_EFFECT_BUILTINS by name,
+    container mutator methods, setattr/delattr, and paddle in-place ops
+    (trailing single underscore). Nested defs/lambdas ARE descended:
+    a cond branch runs everything it closes over."""
+    found = []
+    for n in ast.walk(node):
+        if not isinstance(n, ast.Call):
+            continue
+        f = n.func
+        if isinstance(f, ast.Name):
+            if f.id in SIDE_EFFECT_BUILTINS or f.id in ("setattr", "delattr"):
+                found.append((f.id, n.lineno))
+        elif isinstance(f, ast.Attribute):
+            if f.attr in MUTATOR_METHODS or (
+                    f.attr.endswith("_") and not f.attr.endswith("__")):
+                found.append((f.attr, n.lineno))
+    return found
+
+
+# --------------------------------------------------------------- recorder
+_LOCK = threading.Lock()
+_DIAGS: list = []
+_SEEN: set = set()  # (slug, path, line, message) dedup: a retraced
+#                     function (guard miss per shape/dtype/grad mode)
+#                     re-runs the converter and would re-record the
+#                     same event every time
+_MAX = 256          # bounded like jit.api's _fallback_registry
+_DROPPED = [0]
+# (path, first_lineno, qualname) of the function dy2static is currently
+# converting — stamped by _convert so AST-relative linenos can be mapped
+# back to real file positions.
+_CTX = threading.local()
+
+
+def set_context(path, first_line, qualname):
+    _CTX.value = (path or "<unknown>", int(first_line or 1), qualname)
+
+
+def clear_context():
+    _CTX.value = None
+
+
+def _context():
+    return getattr(_CTX, "value", None)
+
+
+def record(diag: Diagnostic):
+    key = (diag.slug, diag.path, diag.line, diag.message)
+    with _LOCK:
+        if key in _SEEN:
+            return
+        _SEEN.add(key)
+        if len(_DIAGS) >= _MAX:
+            del _DIAGS[0]
+            _DROPPED[0] += 1
+        _DIAGS.append(diag)
+
+
+def snapshot():
+    """Copy of the recorded diagnostics (does not clear)."""
+    with _LOCK:
+        return list(_DIAGS)
+
+
+def drain():
+    """Return and clear the recorded diagnostics (dedup window too: a
+    recurrence after a drain is a new report)."""
+    with _LOCK:
+        out = list(_DIAGS)
+        _DIAGS.clear()
+        _SEEN.clear()
+        return out
+
+
+def reset():
+    with _LOCK:
+        _DIAGS.clear()
+        _SEEN.clear()
+        _DROPPED[0] = 0
+
+
+def dropped():
+    return _DROPPED[0]
+
+
+# ----------------------------------------------------- event constructors
+def record_loop_side_effect(builtins_found, kind, path, line, funcname):
+    record(Diagnostic(
+        rule="A5", slug="loop-side-effect", severity=Severity.WARNING,
+        path=path or "<unknown>", line=int(line or 0), source="runtime",
+        message=(f"loop body of {funcname}() calling "
+                 f"{', '.join(sorted(builtins_found))}() was compiled to a "
+                 f"{kind}: the call ran once at trace time, not per "
+                 "iteration"),
+        hint="wrap the loop in paddle.jit.not_to_static or drop the call"))
+
+
+def record_loop_mutation(rel_line, kind):
+    """A dy2static loop rewrite declined because the body (or while
+    test) mutates non-carried python state — the loop stays eager by
+    design; surface WHERE so the cost is visible."""
+    ctx = _context()
+    if ctx is None:
+        path, base, fname = "<unknown>", 1, "<unknown>"
+    else:
+        path, base, fname = ctx
+    record(Diagnostic(
+        rule="A5", slug="loop-mutation", severity=Severity.WARNING,
+        path=path, line=base + max(int(rel_line) - 1, 0), source="runtime",
+        message=(f"{kind} in {fname}() kept as an eager python loop: its "
+                 "body mutates python state that is not loop-carried "
+                 "(a trace-once conversion would run the mutation once, "
+                 "not per iteration)"),
+        hint="carry the state through the loop (reassign the name) or "
+             "accept the eager fallback"))
+
+
+def record_out_of_trace_collective(name, nranks, axis):
+    record(Diagnostic(
+        rule="A5", slug="collective", severity=Severity.ERROR,
+        path="<runtime>", line=0, source="runtime",
+        message=(f"{name} on a {nranks}-rank group (axis={axis!r}) was "
+                 "called outside a mesh-bound trace — it would silently "
+                 "return local data, so it raised"),
+        hint="run the collective inside shard_map/to_static with the "
+             "axis bound, or use GSPMD sharding constraints"))

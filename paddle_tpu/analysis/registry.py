@@ -1,0 +1,63 @@
+"""Rule registry for tpu-lint.
+
+A rule is a callable `check(ctx) -> iterable[Diagnostic]` registered
+with an id (A1..A5), a set of slugs it may emit (the escape-hatch
+tokens), a default severity and a one-line summary. The drivers in
+driver.py run every selected rule over a parsed FileContext.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Tuple
+
+__all__ = ["Rule", "register_rule", "all_rules", "select_rules"]
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    slugs: Tuple[str, ...]
+    severity: str
+    summary: str
+    check: Callable = field(compare=False)
+
+
+_RULES: dict = {}
+
+
+def register_rule(id, slugs, severity, summary):
+    """Decorator: register `check(ctx)` under rule `id`."""
+    def deco(fn):
+        if id in _RULES:
+            raise ValueError(f"duplicate rule id {id}")
+        _RULES[id] = Rule(id=id, slugs=tuple(slugs), severity=severity,
+                          summary=summary, check=fn)
+        return fn
+    return deco
+
+
+def all_rules():
+    return [_RULES[k] for k in sorted(_RULES)]
+
+
+def select_rules(tokens=None):
+    """Rules whose id OR one of whose slugs matches any token
+    (case-insensitive). tokens=None selects everything."""
+    rules = all_rules()
+    if not tokens:
+        return rules
+    toks = {t.strip().lower() for t in tokens if t.strip()}
+    if not toks:
+        # "--rules ," / "--rules ''" must not select NOTHING and pass
+        # vacuously — an empty selection is a usage error
+        raise ValueError("empty rule selection (no ids/slugs given)")
+    out = []
+    for r in rules:
+        if r.id.lower() in toks or any(s.lower() in toks for s in r.slugs):
+            out.append(r)
+    unknown = toks - {r.id.lower() for r in rules} \
+        - {s.lower() for r in rules for s in r.slugs}
+    if unknown:
+        raise ValueError(f"unknown rule selector(s): {sorted(unknown)}; "
+                         f"known: {[r.id for r in rules]} + slugs")
+    return out

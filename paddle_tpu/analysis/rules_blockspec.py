@@ -1,0 +1,183 @@
+"""Rules A2 + A3 — BlockSpec tiling legality and VMEM budgeting.
+
+A2 replays Mosaic's `_check_block_mappings` rule statically: the last
+two dims of a block shape must be divisible by (8, 128) respectively —
+or equal the corresponding ARRAY dims, which a linter cannot see, hence
+the `# tpu-lint: blockspec-ok` escape hatch for that case. The lse
+(1, block_q) out-spec crash of round 1 and the legality sweeps in
+tests/test_flash_blockspec_legality.py are the chip history here.
+
+A3 runs the vmem.py estimator over every pallas_call whose block
+shapes, out dtype and scratch shapes all resolve statically; the rms
+`block_rows=256 @ H=4096` fp32 pick that OOM'd on chip ("scoped vmem
+24.2M > 16M") is the motivating catch. Anything unresolvable is
+skipped — the rule never guesses shapes.
+"""
+from __future__ import annotations
+
+import ast
+
+from . import astutil
+from .diagnostics import Diagnostic, Severity
+from .registry import register_rule
+from .vmem import VMEM_BUDGET_BYTES, DTYPE_BYTES, fits_vmem
+
+_MB = 1024.0 * 1024.0
+
+
+def _calls_named(tree, leaf):
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Call):
+            name = astutil.dotted_name(n.func) or ""
+            if name.split(".")[-1] == leaf:
+                yield n
+
+
+# ------------------------------------------------------------------- A2
+@register_rule(
+    "A2", ("blockspec",), Severity.ERROR,
+    "BlockSpec last-two block dims must be (8, 128)-divisible")
+def check_blockspec_divisibility(ctx):
+    out = []
+    for call in _calls_named(ctx.tree, "BlockSpec"):
+        shape_node = astutil.get_arg(call, 0, "block_shape")
+        if not isinstance(shape_node, (ast.Tuple, ast.List)) \
+                or not shape_node.elts:
+            continue
+        elts = shape_node.elts
+        # check only when the trailing dims all resolve — a partially
+        # literal shape says nothing about legality
+        tail = elts[-2:] if len(elts) >= 2 else elts[-1:]
+        dims = [astutil.resolve_int(e, ctx.consts) for e in tail]
+        if any(d is None for d in dims):
+            continue
+        checks = []
+        if len(dims) == 2:
+            checks = [(tail[0], dims[0], 8, "second-to-last"),
+                      (tail[1], dims[1], 128, "last")]
+        else:
+            checks = [(tail[0], dims[0], 128, "last")]
+        for node, val, div, which in checks:
+            if val % div != 0:
+                out.append(Diagnostic(
+                    rule="A2", slug="blockspec", severity=Severity.ERROR,
+                    path=ctx.path, line=node.lineno, col=node.col_offset,
+                    message=(f"{which} block dim {val} is not divisible "
+                             f"by {div}: Mosaic rejects this tiling "
+                             "unless the block dim equals the array dim "
+                             "(interpret=True hides it; round-1 lse-spec "
+                             "chip crash)"),
+                    hint="pick an (8, 128)-divisible block, or — if the "
+                         "block spans the whole array dim — annotate the "
+                         "line with `# tpu-lint: blockspec-ok`"))
+    return out
+
+
+# ------------------------------------------------------------------- A3
+def _spec_shapes(node, ctx):
+    """Resolve a single BlockSpec-call node to a block shape tuple.
+    Returns None when unresolvable."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = astutil.dotted_name(node.func) or ""
+    if name.split(".")[-1] != "BlockSpec":
+        return None
+    shape_node = astutil.get_arg(node, 0, "block_shape")
+    if shape_node is None:
+        return None
+    return astutil.resolve_shape(shape_node, ctx.consts)
+
+
+def _spec_list(node, ctx):
+    """[(shape, ...)] for an in_specs/out_specs node: a single BlockSpec
+    or a plain list of them. None when any entry is unresolvable."""
+    if node is None:
+        return []
+    items = node.elts if isinstance(node, (ast.Tuple, ast.List)) else [node]
+    shapes = []
+    for it in items:
+        s = _spec_shapes(it, ctx)
+        if s is None:
+            return None
+        shapes.append(s)
+    return shapes
+
+
+def _out_dtype(call, ctx):
+    """dtype string from out_shape=jax.ShapeDtypeStruct(shape, dtype);
+    float32 (the conservative worst case) when unresolvable."""
+    node = astutil.get_arg(call, None, "out_shape")
+    if node is None:
+        return "float32"
+    cands = node.elts if isinstance(node, (ast.Tuple, ast.List)) else [node]
+    for c in cands:
+        if isinstance(c, ast.Call):
+            dt = astutil.get_arg(c, 1, "dtype")
+            name = astutil.dtype_name(dt) if dt is not None else None
+            if name in DTYPE_BYTES:
+                return name
+    return "float32"
+
+
+def _scratch_blocks(call, ctx):
+    """[(shape, dtype)] for scratch_shapes=[pltpu.VMEM(shape, dtype),
+    ...]. None when present but unresolvable; [] when absent."""
+    node = astutil.get_arg(call, None, "scratch_shapes")
+    if node is None:
+        return []
+    items = node.elts if isinstance(node, (ast.Tuple, ast.List)) else [node]
+    blocks = []
+    for it in items:
+        if not isinstance(it, ast.Call):
+            return None
+        shape = astutil.resolve_shape(astutil.get_arg(it, 0, "shape"),
+                                      ctx.consts)
+        if shape is None:
+            return None
+        dt_node = astutil.get_arg(it, 1, "dtype")
+        dt = astutil.dtype_name(dt_node) if dt_node is not None else None
+        blocks.append((shape, dt if dt in DTYPE_BYTES else "float32"))
+    return blocks
+
+
+@register_rule(
+    "A3", ("vmem",), Severity.ERROR,
+    "pallas_call block picks must fit the ~16 MB scoped-VMEM budget")
+def check_vmem_budget(ctx):
+    out = []
+    for call in _calls_named(ctx.tree, "pallas_call"):
+        spec_src = call
+        gs = astutil.get_arg(call, None, "grid_spec")
+        if isinstance(gs, ast.Call):
+            spec_src = gs  # PrefetchScalarGridSpec carries the specs
+        in_shapes = _spec_list(
+            astutil.get_arg(spec_src, None, "in_specs"), ctx)
+        out_shapes = _spec_list(
+            astutil.get_arg(spec_src, None, "out_specs"), ctx)
+        if not in_shapes or out_shapes is None or not out_shapes:
+            continue  # unresolvable (or spec-less): never guess
+        scratch = _scratch_blocks(spec_src, ctx)
+        if scratch is None and spec_src is not call:
+            scratch = _scratch_blocks(call, ctx)
+        if scratch is None:
+            continue
+        dtype = _out_dtype(call, ctx)
+        fits, est = fits_vmem([(s, dtype) for s in in_shapes],
+                              [(s, dtype) for s in out_shapes],
+                              scratch)
+        if not fits:
+            out.append(Diagnostic(
+                rule="A3", slug="vmem", severity=Severity.ERROR,
+                path=ctx.path, line=call.lineno, col=call.col_offset,
+                message=(f"estimated VMEM for this pallas_call is "
+                         f"{est / _MB:.1f} MB > the ~"
+                         f"{VMEM_BUDGET_BYTES / _MB:.0f} MB scoped-vmem "
+                         "budget (double-buffered blocks + scratch + "
+                         "fp32 compute temps); the rms block_rows=256 @ "
+                         "H=4096 fp32 pick failed exactly this way on "
+                         "chip"),
+                hint="shrink the block (halve rows until it fits — see "
+                     "fused_norm.pick_block_rows) or annotate with "
+                     "`# tpu-lint: vmem-ok` if the estimate is wrong "
+                     "for this kernel"))
+    return out

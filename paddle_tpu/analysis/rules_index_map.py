@@ -1,0 +1,111 @@
+"""Rule A1 — trace-unsafe BlockSpec index maps.
+
+Chip lessons this encodes (CLAUDE.md round-4 notes):
+  * the package enables x64, so a bare int literal returned from a
+    BlockSpec index map traces as i64 and Mosaic's func.return fails to
+    legalize (found for real in fused_norm.py — hence its `_I0 =
+    np.int32(0)` pin);
+  * Python `//` (or `%`) on a traced index lowers through an i64
+    convert that hits an infinite recursion in Mosaic's convert
+    fallback (found on real v5e — flash_attention's `bdiv` uses
+    `jax.lax.div` on pinned int32 instead).
+interpret=True on CPU hides both failures entirely, which is exactly
+why this is a static rule.
+"""
+from __future__ import annotations
+
+import ast
+
+from . import astutil
+from .diagnostics import Diagnostic, Severity
+from .registry import register_rule
+
+_SLUG = "index-map"
+
+
+def _blockspec_calls(tree):
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Call):
+            name = astutil.dotted_name(n.func) or ""
+            if name.split(".")[-1] == "BlockSpec":
+                yield n
+
+
+def _index_fns(call, ctx):
+    """Callables acting as the index map of one BlockSpec: every Lambda
+    inside the index_map argument (covers wrapper patterns like
+    `qmap(lambda ...)`) plus a named function passed by name."""
+    arg = astutil.get_arg(call, 1, "index_map")
+    if arg is None:
+        return []
+    fns = list(astutil.lambdas_in(arg))
+    if isinstance(arg, ast.Name) and arg.id in ctx.functions:
+        fns.append(ctx.functions[arg.id])
+    return fns
+
+
+def _returned_exprs(fn):
+    if isinstance(fn, ast.Lambda):
+        return [fn.body]
+    return [r.value for r in ast.walk(fn)
+            if isinstance(r, ast.Return) and r.value is not None]
+
+
+def _body_nodes(fn):
+    """Nodes of the function BODY only — lambda defaults are evaluated
+    at definition time (outside the trace) and must not be flagged."""
+    if isinstance(fn, ast.Lambda):
+        return ast.walk(fn.body)
+    nodes = []
+    for st in fn.body:
+        nodes.extend(ast.walk(st))
+    return nodes
+
+
+def _bare_int(node):
+    return (isinstance(node, ast.Constant)
+            and isinstance(node.value, int)
+            and not isinstance(node.value, bool))
+
+
+@register_rule(
+    "A1", (_SLUG,), Severity.ERROR,
+    "bare int literal or python // / % inside a BlockSpec index map")
+def check_index_maps(ctx):
+    out = []
+    seen = set()  # a lambda can sit under several wrappers; flag once
+    for call in _blockspec_calls(ctx.tree):
+        for fn in _index_fns(call, ctx):
+            key = (fn.lineno, fn.col_offset)
+            if key in seen:
+                continue
+            seen.add(key)
+            for ret in _returned_exprs(fn):
+                elems = ret.elts if isinstance(ret, (ast.Tuple, ast.List)) \
+                    else [ret]
+                for e in elems:
+                    if _bare_int(e):
+                        out.append(Diagnostic(
+                            rule="A1", slug=_SLUG, severity=Severity.ERROR,
+                            path=ctx.path, line=e.lineno, col=e.col_offset,
+                            message=(f"bare int literal {e.value} returned "
+                                     "from a BlockSpec index map traces as "
+                                     "i64 under package x64 mode; Mosaic "
+                                     "rejects i64 index-map results on "
+                                     "chip (interpret=True hides this)"),
+                            hint="pin it: _I0 = np.int32(0) at module "
+                                 "scope and return _I0"))
+            for n in _body_nodes(fn):
+                if isinstance(n, ast.BinOp) and isinstance(
+                        n.op, (ast.FloorDiv, ast.Mod)):
+                    opname = "//" if isinstance(n.op, ast.FloorDiv) else "%"
+                    out.append(Diagnostic(
+                        rule="A1", slug=_SLUG, severity=Severity.ERROR,
+                        path=ctx.path, line=n.lineno, col=n.col_offset,
+                        message=(f"python `{opname}` inside a BlockSpec "
+                                 "index map lowers through an i64 convert "
+                                 "that infinitely recurses in Mosaic's "
+                                 "convert fallback on chip"),
+                        hint="use jax.lax.div / jax.lax.rem on "
+                             "np.int32-pinned operands"))
+    return out

@@ -1,0 +1,112 @@
+"""Rule A5 (static half) — trace-purity diagnostics.
+
+Promotes dy2static's side-effect vocabulary (purity.py, imported back
+by `jit/dy2static.py`) into lintable rules:
+
+  * side effects in `static.nn.cond` branches: a traced cond executes
+    BOTH branches and selects, so branch side effects run twice by
+    design (round-3 notes) — mutations or prints in a branch are a
+    correctness smell;
+  * `print`/`breakpoint`/`input` in a body passed to lax.scan /
+    while_loop / fori_loop: the body is traced ONCE, so the call fires
+    once with tracer values, not per iteration (ADVICE r5 #1 — the
+    runtime warning in dy2static records the same diagnostic when it
+    actually happens; this rule catches it before it runs).
+
+The runtime half (loop-mutation declines, out-of-trace collectives on
+>1-rank groups) cannot be seen statically with zero false positives;
+those record diagnostics through purity.record_* at the moment they
+happen and surface via `jit.to_static_report()` /
+`tools/fallback_report.py --lint`.
+"""
+from __future__ import annotations
+
+import ast
+
+from . import astutil
+from .diagnostics import Diagnostic, Severity
+from .purity import SIDE_EFFECT_BUILTINS, side_effect_calls
+from .registry import register_rule
+
+_SLUG = "purity"
+
+
+def _branch_fns(node, ctx):
+    """Callables for a cond/loop argument node: lambdas inside it plus
+    a same-file function passed by name."""
+    if node is None:
+        return []
+    fns = list(astutil.lambdas_in(node))
+    if isinstance(node, ast.Name) and node.id in ctx.functions:
+        fns.append(ctx.functions[node.id])
+    return fns
+
+
+def _is_static_cond(name):
+    parts = name.split(".")
+    return parts[-1] == "cond" and len(parts) > 1 \
+        and any(p in ("nn", "static") for p in parts[:-1])
+
+
+_LOOP_BODY_ARGS = {
+    # leaf name -> [(positional idx, kwarg name), ...] of traced bodies
+    "scan": [(0, "f")],
+    "while_loop": [(0, "cond_fun"), (1, "body_fun"), (0, "cond_fn"),
+                   (1, "body_fn")],
+    "fori_loop": [(2, "body_fun")],
+}
+
+
+@register_rule(
+    "A5", (_SLUG,), Severity.WARNING,
+    "side effects in traced cond branches / scan-while-lowered bodies")
+def check_trace_purity(ctx):
+    out = []
+    seen = set()
+    for n in ast.walk(ctx.tree):
+        if not isinstance(n, ast.Call):
+            continue
+        name = astutil.dotted_name(n.func) or ""
+        leaf = name.split(".")[-1]
+        if _is_static_cond(name):
+            for arg_node in (astutil.get_arg(n, 1, "true_fn"),
+                             astutil.get_arg(n, 2, "false_fn")):
+                for fn in _branch_fns(arg_node, ctx):
+                    for eff, line in side_effect_calls(fn):
+                        key = (line, eff, "cond")
+                        if key in seen:
+                            continue
+                        seen.add(key)
+                        out.append(Diagnostic(
+                            rule="A5", slug=_SLUG,
+                            severity=Severity.WARNING,
+                            path=ctx.path, line=line,
+                            message=(f"`{eff}` inside a static.nn.cond "
+                                     "branch: a traced cond executes "
+                                     "BOTH branches and selects, so this "
+                                     "side effect runs twice by design"),
+                            hint="make branches pure; do side effects "
+                                 "after the select"))
+        elif leaf in _LOOP_BODY_ARGS:
+            for idx, kwname in _LOOP_BODY_ARGS[leaf]:
+                for fn in _branch_fns(astutil.get_arg(n, idx, kwname), ctx):
+                    for eff, line in side_effect_calls(fn):
+                        if eff not in SIDE_EFFECT_BUILTINS:
+                            continue  # mutations in jax loop bodies are
+                            # the body fn's own business (carried state)
+                        key = (line, eff, leaf)
+                        if key in seen:
+                            continue
+                        seen.add(key)
+                        out.append(Diagnostic(
+                            rule="A5", slug=_SLUG,
+                            severity=Severity.WARNING,
+                            path=ctx.path, line=line,
+                            message=(f"`{eff}` inside a {leaf} body: the "
+                                     "body is traced once, so this fires "
+                                     "once with tracer values, not per "
+                                     "iteration"),
+                            hint="use jax.debug.print for per-iteration "
+                                 "output, or hoist the call out of the "
+                                 "loop"))
+    return out

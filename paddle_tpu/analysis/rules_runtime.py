@@ -1,0 +1,87 @@
+"""Rule A4 — runtime-safety hazards: interpret=True shipping in
+non-test code, and device-side loops long enough to wedge the chip.
+
+Chip history: interpret=True on CPU hides every Mosaic legality issue
+(round-1 lesson — all kernels route through `_interpret_mode()`, which
+is False on real TPU, never a literal True); and a 4096-iteration
+device-side Mosaic loop wedged the device UNAVAILABLE for minutes,
+which is why kernels/timing.py caps its fori_loop chains at 512
+iterations.
+"""
+from __future__ import annotations
+
+import ast
+
+from . import astutil
+from .diagnostics import Diagnostic, Severity
+from .registry import register_rule
+
+WEDGE_CAP = 512  # kernels/timing.py loop_cap — the measured safe bound
+
+
+def _calls(tree):
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Call):
+            name = astutil.dotted_name(n.func) or ""
+            yield n, name.split(".")[-1]
+
+
+@register_rule(
+    "A4", ("interpret", "timing-cap"), Severity.ERROR,
+    "interpret=True in non-test code / device loops over the 512-iter "
+    "wedge cap")
+def check_runtime_safety(ctx):
+    out = []
+    for call, leaf in _calls(ctx.tree):
+        if leaf == "pallas_call" and not ctx.is_test:
+            for kw in call.keywords:
+                if kw.arg == "interpret" \
+                        and isinstance(kw.value, ast.Constant) \
+                        and kw.value.value is True:
+                    out.append(Diagnostic(
+                        rule="A4", slug="interpret", severity=Severity.ERROR,
+                        path=ctx.path, line=kw.value.lineno,
+                        col=kw.value.col_offset,
+                        message="interpret=True hardcoded in non-test "
+                                "code: the kernel would run the Pallas "
+                                "interpreter on real TPU too, and "
+                                "interpret mode hides every Mosaic "
+                                "legality violation",
+                        hint="route through a backend probe like "
+                             "kernels.flash_attention._interpret_mode()"))
+        elif leaf == "device_time":
+            for arg_kw in ("loop_cap", "iters"):
+                node = astutil.get_arg(call, None, arg_kw)
+                val = astutil.resolve_int(node, ctx.consts) \
+                    if node is not None else None
+                if val is not None and val > WEDGE_CAP:
+                    out.append(Diagnostic(
+                        rule="A4", slug="timing-cap", severity=Severity.ERROR,
+                        path=ctx.path, line=node.lineno, col=node.col_offset,
+                        message=(f"device_time {arg_kw}={val} exceeds the "
+                                 f"{WEDGE_CAP}-iteration wedge cap: a "
+                                 "4096-iteration device-side Mosaic loop "
+                                 "left the chip UNAVAILABLE for minutes"),
+                        hint=f"stay at or under {WEDGE_CAP}; device_time "
+                             "differences N vs 2N loops, so long loops "
+                             "buy no accuracy"))
+        elif leaf == "fori_loop":
+            lo = astutil.get_arg(call, 0, "lower")
+            hi = astutil.get_arg(call, 1, "upper")
+            lo_v = astutil.resolve_int(lo, ctx.consts) if lo is not None \
+                else None
+            hi_v = astutil.resolve_int(hi, ctx.consts) if hi is not None \
+                else None
+            if lo_v is not None and hi_v is not None \
+                    and hi_v - lo_v > WEDGE_CAP:
+                out.append(Diagnostic(
+                    rule="A4", slug="timing-cap", severity=Severity.ERROR,
+                    path=ctx.path, line=call.lineno, col=call.col_offset,
+                    message=(f"fori_loop with a static {hi_v - lo_v}"
+                             "-iteration trip count: device-side loops "
+                             f"past ~{WEDGE_CAP} iterations have wedged "
+                             "the chip (UNAVAILABLE) over this transport"),
+                    hint="chunk the loop or derive the bound from data "
+                         "shapes; annotate `# tpu-lint: timing-cap-ok` "
+                         "if this cannot run device-side"))
+    return out

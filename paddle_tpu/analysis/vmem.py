@@ -1,0 +1,85 @@
+"""Per-`pallas_call` VMEM budget estimator (rule A3).
+
+Model (cross-checked against the round-4 chip data points, see
+tests/test_tpu_lint.py::TestVmemCrossCheck):
+
+    vmem_bytes = sum(in  blocks: elems * width * depth)   # double-buffered
+               + sum(out blocks: elems * width * depth)   #   DMA pipeline
+               + sum(scratch    : elems * width)          # single-buffered
+               + fp32_copies * max_block_elems * 4        # compute temps
+               + extra_bytes                              # kernel-specific
+
+`depth=2` is Mosaic's default double buffering of streamed blocks;
+`fp32_copies=2` models the upcast-input + result fp32 temporaries a
+kernel computing in fp32 materializes per block (the rms kernel's
+chip-measured "scoped vmem 24.2M > 16M" at block (256, 4096) fp32 is
+reproduced by exactly this accounting: 8 MB x-in + 8 MB out + 2x4 MB
+temps); `extra_bytes` carries kernel-shaped intermediates the block
+specs cannot see (e.g. a flash-attention (block_q, block_k) fp32 score
+tile).
+
+The estimate is deliberately a LOWER bound heuristic: it exists to
+catch order-of-magnitude OOMs on CPU before they burn chip time, not to
+replace Mosaic's allocator. Anything statically unresolvable is skipped
+by the AST rule rather than guessed.
+"""
+from __future__ import annotations
+
+import math
+
+__all__ = ["VMEM_BUDGET_BYTES", "DTYPE_BYTES", "estimate_vmem_bytes",
+           "fits_vmem"]
+
+# v5e VMEM is 128 MB/core but Mosaic's per-kernel scoped-vmem budget is
+# ~16 MB (the chip error was "scoped vmem 24.2M > 16M").
+VMEM_BUDGET_BYTES = 16 * 1024 * 1024
+
+DTYPE_BYTES = {
+    "float64": 8, "int64": 8, "uint64": 8,
+    "float32": 4, "int32": 4, "uint32": 4,
+    "bfloat16": 2, "float16": 2, "int16": 2, "uint16": 2,
+    "int8": 1, "uint8": 1, "bool": 1,
+    "float8_e4m3fn": 1, "float8_e5m2": 1,
+}
+
+
+def _block_bytes(block):
+    shape, dtype = block
+    width = DTYPE_BYTES.get(str(dtype))
+    if width is None:
+        raise ValueError(f"unknown dtype {dtype!r}")
+    return math.prod(int(d) for d in shape) * width, \
+        math.prod(int(d) for d in shape)
+
+
+def estimate_vmem_bytes(in_blocks, out_blocks, scratch=(), depth=2,
+                        fp32_copies=2, extra_bytes=0):
+    """Estimated VMEM bytes for one pallas_call.
+
+    in_blocks/out_blocks/scratch: iterables of (shape, dtype_str) —
+    BLOCK shapes (per grid step), not array shapes.
+    """
+    total = 0
+    max_elems = 0
+    for block in in_blocks:
+        b, e = _block_bytes(block)
+        total += b * depth
+        max_elems = max(max_elems, e)
+    for block in out_blocks:
+        b, e = _block_bytes(block)
+        total += b * depth
+        max_elems = max(max_elems, e)
+    for block in scratch:
+        b, _ = _block_bytes(block)
+        total += b
+    total += fp32_copies * max_elems * 4
+    total += int(extra_bytes)
+    return total
+
+
+def fits_vmem(in_blocks, out_blocks, scratch=(), depth=2, fp32_copies=2,
+              extra_bytes=0, budget=VMEM_BUDGET_BYTES):
+    """(fits, estimated_bytes) against the scoped-vmem budget."""
+    est = estimate_vmem_bytes(in_blocks, out_blocks, scratch, depth,
+                              fp32_copies, extra_bytes)
+    return est <= budget, est

@@ -174,6 +174,11 @@ def _require_trace_or_world1(name, group):
     world-of-one groups legitimately no-op."""
     g = group or _default_group()
     if g.nranks > 1:
+        # promoted to a reportable diagnostic too (tpu-lint rule A5):
+        # FALLBACKS.md / to_static_report() show the rejection alongside
+        # the dy2static purity events
+        from ..analysis import purity as _purity
+        _purity.record_out_of_trace_collective(name, g.nranks, g.axis_name)
         raise RuntimeError(
             f"{name} on a {g.nranks}-rank group (axis="
             f"{g.axis_name!r}) outside a mesh-bound trace would silently "

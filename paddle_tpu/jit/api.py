@@ -74,15 +74,22 @@ def to_static_report(reset=False):
     `eager_fallbacks` holds the most recent entries (bounded);
     `eager_fallbacks_dropped` counts what aged out of the window."""
     from . import dy2static
+    from ..analysis import purity
     rep = {
         "eager_fallbacks": list(_fallback_registry),
         "eager_fallbacks_dropped": _fallback_dropped[0],
         "break_counters": dy2static.fallback_counters(),
+        # tpu-lint A5 runtime promotions (shared Diagnostic dicts):
+        # scan/while bodies that printed at trace time, loops kept eager
+        # because their bodies mutate non-carried state, out-of-trace
+        # collective rejections — see ANALYSIS.md
+        "purity_diagnostics": [d.to_dict() for d in purity.snapshot()],
     }
     if reset:
         _fallback_registry.clear()
         _fallback_dropped[0] = 0
         dy2static.reset_fallback_counters()
+        purity.reset()
     return rep
 
 

@@ -99,7 +99,12 @@ def _break(reason, msg):
     return DygraphToStaticBreak(msg)
 
 
-_SIDE_EFFECT_BUILTINS = frozenset({"print", "breakpoint", "input"})
+# Canonical vocabulary lives in analysis.purity (tpu-lint rule A5) so
+# the static linter and this converter can never drift; the names kept
+# here are aliases for the original private spellings.
+from ..analysis import purity as _purity  # noqa: E402 (stdlib-only module)
+
+_SIDE_EFFECT_BUILTINS = _purity.SIDE_EFFECT_BUILTINS
 
 
 def _global_loads_in_code(code):
@@ -129,6 +134,12 @@ def _warn_trace_time_side_effects(body_fn, kind):
     found = sorted(_global_loads_in_code(code) & _SIDE_EFFECT_BUILTINS)
     if found:
         import warnings
+        # promoted to a reportable diagnostic (tpu-lint A5): surfaces in
+        # jit.to_static_report()["purity_diagnostics"] and FALLBACKS.md
+        _purity.record_loop_side_effect(
+            found, kind, getattr(code, "co_filename", None),
+            getattr(code, "co_firstlineno", 0),
+            getattr(body_fn, "__name__", "<body>"))
         warnings.warn(
             f"loop body calling {', '.join(found)}() was compiled to a "
             f"{kind}: the call ran ONCE at trace time (printing tracer "
@@ -1008,11 +1019,7 @@ def _maybe_single_exit(fdef) -> bool:
     return True
 
 
-_MUTATOR_METHODS = {
-    "append", "extend", "insert", "remove", "clear", "sort", "reverse",
-    "discard", "update", "setdefault", "popitem", "appendleft",
-    "popleft", "pop",
-}
+_MUTATOR_METHODS = _purity.MUTATOR_METHODS
 
 
 def _has_uncarried_mutation(stmts, carried: Set[str]) -> bool:
@@ -1293,7 +1300,9 @@ class _Rewriter:
                     [ast.Expr(value=node.test)], set(carried)):
             # trace-once conversion would run the mutation once, not
             # per-iteration — plain python keeps eager semantics (the
-            # TEST is also per-iteration code: `while stack.pop():`)
+            # TEST is also per-iteration code: `while stack.pop():`).
+            # Promoted to a reportable diagnostic (tpu-lint A5).
+            _purity.record_loop_mutation(node.lineno, "while loop")
             return self._keep_plain(node, bound)
         # carried names are body-fn PARAMS — bound at body entry (flags
         # are pre-initialized to False; without this an if that only
@@ -1343,7 +1352,9 @@ class _Rewriter:
         carried = sorted(_assigned_names(body_src) - {tname})
         if _has_uncarried_mutation(body_src, set(carried) | {tname}):
             # see _rewrite_while: mutations of non-carried state must
-            # keep plain-python per-iteration semantics
+            # keep plain-python per-iteration semantics (recorded as a
+            # tpu-lint A5 diagnostic like the while case)
+            _purity.record_loop_mutation(node.lineno, "for loop")
             return self._keep_plain(node, bound)
         body = self.rewrite_body(
             body_src, set(bound) | {tname} | set(carried),
@@ -1413,20 +1424,31 @@ def _convert(fn):
         # silent state divergence (also covers the eager-fallback path,
         # which permanently runs the copy after a second graph break)
         return None
-    # single-exit lowering FIRST: ifs that return become rv-assigning
-    # ifs the rewriter below can convert (traced early returns
-    # otherwise always fall back to eager)
-    _maybe_single_exit(fdef)
-    ifexp = _IfExpLowerer()
-    ifexp.visit(fdef)
-    rw = _Rewriter()
-    arg_names = {a.arg for a in (fdef.args.posonlyargs + fdef.args.args
-                                 + fdef.args.kwonlyargs)}
-    if fdef.args.vararg:
-        arg_names.add(fdef.args.vararg.arg)
-    if fdef.args.kwarg:
-        arg_names.add(fdef.args.kwarg.arg)
-    fdef.body = rw.rewrite_body(fdef.body, set(arg_names))
+    # stamp the purity-diagnostic context (tpu-lint A5): rewrite-time
+    # declines map their AST-relative linenos back to the real file
+    try:
+        first_line = inspect.getsourcelines(func)[1]
+    except (OSError, TypeError):
+        first_line = 1
+    _purity.set_context(inspect.getsourcefile(func), first_line,
+                        func.__qualname__)
+    try:
+        # single-exit lowering FIRST: ifs that return become rv-assigning
+        # ifs the rewriter below can convert (traced early returns
+        # otherwise always fall back to eager)
+        _maybe_single_exit(fdef)
+        ifexp = _IfExpLowerer()
+        ifexp.visit(fdef)
+        rw = _Rewriter()
+        arg_names = {a.arg for a in (fdef.args.posonlyargs + fdef.args.args
+                                     + fdef.args.kwonlyargs)}
+        if fdef.args.vararg:
+            arg_names.add(fdef.args.vararg.arg)
+        if fdef.args.kwarg:
+            arg_names.add(fdef.args.kwarg.arg)
+        fdef.body = rw.rewrite_body(fdef.body, set(arg_names))
+    finally:
+        _purity.clear_context()
     if rw.count == 0 and ifexp.count == 0:
         return None
     ast.fix_missing_locations(tree)

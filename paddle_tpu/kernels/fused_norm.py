@@ -22,7 +22,7 @@ from jax.experimental import pallas as pl
 
 from .flash_attention import _I0, _interpret_mode
 
-__all__ = ["rms_norm_rows", "check_supported_rms"]
+__all__ = ["rms_norm_rows", "check_supported_rms", "pick_block_rows"]
 
 
 def check_supported_rms(shape, dtype):
@@ -50,17 +50,16 @@ def _kernel_plain(x_ref, w_ref, o_ref, *, eps):
     _kernel(x_ref, w_ref, o_ref, eps=eps, has_res=False)
 
 
-def rms_norm_rows(x, weight, residual=None, eps=1e-6, block_rows=256):
-    """rms_norm over the last dim of a 2-D (rows, H) array."""
-    r, h = x.shape
-    check_supported_rms(x.shape, x.dtype)
-    # VMEM guard (found on chip): the kernel computes in fp32, so a
-    # block holds ~4 f32 copies (x, x*x, y, out) plus Mosaic's
-    # double-buffered bf16 in/out tiles — block_rows=256 at H=4096
-    # hits "scoped vmem 24.2M > 16M". Shrink until the per-element
-    # estimate fits in half of VMEM; a residual adds its own
-    # double-buffered tile + fp32 upcast (~8 B/element more).
-    bytes_per_elem = 24 + (8 if residual is not None else 0)
+def pick_block_rows(r, h, has_residual=False, block_rows=256):
+    """The kernel's VMEM-guarded row-block pick (found on chip): the
+    kernel computes in fp32, so a block holds ~4 f32 copies (x, x*x, y,
+    out) plus Mosaic's double-buffered bf16 in/out tiles —
+    block_rows=256 at H=4096 hits "scoped vmem 24.2M > 16M". Shrink
+    until the per-element estimate fits in half of VMEM; a residual
+    adds its own double-buffered tile + fp32 upcast (~8 B/element
+    more). Exposed standalone so tests/test_tpu_lint.py can cross-check
+    the tpu-lint A3 estimator against this chip-validated rule."""
+    bytes_per_elem = 24 + (8 if has_residual else 0)
     while block_rows > 8 and block_rows * h * bytes_per_elem > 8 * 1024 * 1024:
         block_rows //= 2
     if block_rows * h * bytes_per_elem > 8 * 1024 * 1024:
@@ -78,8 +77,16 @@ def rms_norm_rows(x, weight, residual=None, eps=1e-6, block_rows=256):
                     f"pallas rms_norm: rows={r} not tileable (no "
                     f"divisor >= 8) and too large for a single VMEM "
                     f"block at H={h}")
-            block_rows = r
-            break
+            return r
+    return block_rows
+
+
+def rms_norm_rows(x, weight, residual=None, eps=1e-6, block_rows=256):
+    """rms_norm over the last dim of a 2-D (rows, H) array."""
+    r, h = x.shape
+    check_supported_rms(x.shape, x.dtype)
+    block_rows = pick_block_rows(r, h, has_residual=residual is not None,
+                                 block_rows=block_rows)
     grid = (r // block_rows,) if r % block_rows == 0 else (1,)
 
     # _I0, not a bare 0: the package enables x64, so literal ints in

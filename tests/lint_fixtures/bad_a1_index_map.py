@@ -1,0 +1,18 @@
+"""Known-bad A1: bare int literals and python // / % in index maps.
+
+This is fused_norm.py's row spec as it was BEFORE the chip run found
+the i64 legalization failure (the fix is the `_I0 = np.int32(0)` pin),
+plus the floor-division batch decode that recursed in Mosaic's convert
+fallback before flash_attention.py switched to jax.lax.div.
+"""
+from jax.experimental import pallas as pl
+
+H = 4
+
+
+def specs(block_rows, h, block_k):
+    row_spec = pl.BlockSpec((block_rows, h), lambda i: (i, 0))     # bad: 0
+    w_spec = pl.BlockSpec((h,), index_map=lambda i: (0,))          # bad: 0
+    kv_spec = pl.BlockSpec(
+        (1, block_k, h), lambda b, i, j: (b // H, j, b % H))       # bad: // %
+    return row_spec, w_spec, kv_spec

@@ -1,0 +1,13 @@
+"""Known-bad A2: literal block shapes whose last-two dims are neither
+(8, 128)-divisible (nor annotated as equal to the array dims). The
+round-1 lse out-spec crash was exactly a last-dim violation that
+interpret=True hid until real hardware."""
+from jax.experimental import pallas as pl
+
+_BAD_ROWS = 12
+
+
+def specs():
+    s1 = pl.BlockSpec((_BAD_ROWS, 100), lambda i: (i, i))   # both dims bad
+    s2 = pl.BlockSpec(block_shape=(8, 96), index_map=lambda i: (i, i))
+    return s1, s2

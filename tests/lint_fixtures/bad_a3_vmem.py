@@ -1,0 +1,28 @@
+"""Known-bad A3: the exact rms_norm configuration that OOM'd on chip —
+block (256, 4096) with fp32 compute ("scoped vmem 24.2M > 16M",
+round-4 notes). Double-buffered 4 MB in + 4 MB out blocks plus the fp32
+compute temporaries put one grid step at ~24 MB of scoped VMEM."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+_I0 = np.int32(0)
+_ROWS = 256
+_H = 4096
+
+
+def kernel(x_ref, o_ref):
+    x = x_ref[...].astype(jnp.float32)
+    var = jnp.mean(x * x, axis=-1, keepdims=True)
+    o_ref[...] = (x * jax.lax.rsqrt(var + 1e-6)).astype(o_ref.dtype)
+
+
+def run(x):
+    return pl.pallas_call(
+        kernel,
+        grid=(4096 // _ROWS,),
+        in_specs=[pl.BlockSpec((_ROWS, _H), lambda i: (i, _I0))],
+        out_specs=pl.BlockSpec((_ROWS, _H), lambda i: (i, _I0)),
+        out_shape=jax.ShapeDtypeStruct((4096, _H), jnp.float32),
+    )(x)

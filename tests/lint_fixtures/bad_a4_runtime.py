@@ -1,0 +1,35 @@
+"""Known-bad A4: interpret=True hardcoded in (what would be) shipping
+code, a device_time call past the 512-iteration wedge cap, and a
+static 4096-iteration fori_loop — the shape of the Mosaic loop that
+left the chip UNAVAILABLE for minutes in round 4."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from paddle_tpu.kernels.timing import device_time
+
+_I0 = np.int32(0)
+
+
+def kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def run(x, block):
+    return pl.pallas_call(
+        kernel,
+        grid=(x.shape[0] // block,),
+        in_specs=[pl.BlockSpec((block, x.shape[1]), lambda i: (i, _I0))],
+        out_specs=pl.BlockSpec((block, x.shape[1]), lambda i: (i, _I0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=True,                       # bad: ships interpret mode
+    )(x)
+
+
+def time_it(fn, x):
+    return device_time(fn, x, loop_cap=4096)  # bad: past the wedge cap
+
+
+def long_chain(x):
+    return jax.lax.fori_loop(0, 4096, lambda i, c: c * x + jnp.float32(1), x)

@@ -1,0 +1,39 @@
+"""Known-bad A5: side effects in traced control flow. A traced
+static.nn.cond executes BOTH branches and selects (round-3 notes), so
+the append and the log write run twice; a scan/while body is traced
+once, so the prints fire once with tracer reprs, not per iteration
+(ADVICE r5 #1)."""
+import jax
+from paddle_tpu import static
+
+log = []
+
+
+def route(pred, x, acc):
+    def true_fn():
+        acc.append(x)          # bad: runs for the false path too
+        return x + 1
+
+    def false_fn():
+        log.append("miss")     # bad: runs for the true path too
+        return x - 1
+
+    return static.nn.cond(pred, true_fn, false_fn)
+
+
+def cumsum_with_print(xs):
+    def body(c, x):
+        print("carry is", c)   # bad: fires once, at trace time
+        return c + x, c
+    return jax.lax.scan(body, 0.0, xs)
+
+
+def countdown(n):
+    def cond_fn(i):
+        return i > 0
+
+    def body_fn(i):
+        print(i)               # bad: fires once, at trace time
+        return i - 1
+
+    return jax.lax.while_loop(cond_fn, body_fn, n)

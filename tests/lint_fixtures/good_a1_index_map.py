@@ -1,0 +1,34 @@
+"""Known-good A1: the committed kernel idioms — np.int32 pins for
+constant index components (fused_norm.py `_I0`), jax.lax.div on pinned
+int32 for batch decode (flash_attention.py `bdiv`), and the
+wrapped-lambda qmap pattern from `_extra_in_specs`."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+_I0 = np.int32(0)
+H = 4
+
+
+def bdiv(b):
+    return jax.lax.div(b, jnp.asarray(H, jnp.int32))
+
+
+def qmap(idx):
+    def wrapped(b, j, i, _f=idx):
+        return _f(b, i, j)
+    return wrapped
+
+
+def specs(block_rows, h, block_q, fold):
+    row_spec = pl.BlockSpec((block_rows, h), lambda i: (i, _I0))
+    w_spec = pl.BlockSpec((h,), index_map=lambda i: (_I0,))
+    seg_spec = pl.BlockSpec(
+        (1, 2, block_q), qmap(lambda b, i, j: (bdiv(b), _I0, i)))
+    # closed-over python ints in arithmetic stay weakly-typed i32 —
+    # only literal RESULT components and // / % are the landmines
+    page_spec = pl.BlockSpec(
+        (1, 2, block_q),
+        lambda b, i, bt, f=fold: (bt[b, i * f + 1], _I0, _I0))
+    return row_spec, w_spec, seg_spec, page_spec

@@ -1,0 +1,22 @@
+"""Known-good A2: the committed tilings — (8, 128)-divisible literal
+blocks (paged_attention page layout at page_size=128, D=128), runtime-
+computed block shapes (flash's (1, block_q, D) — statically
+unresolvable, so the rule stays silent instead of guessing), and the
+documented escape hatch for a block that equals the array dim."""
+import numpy as np
+from jax.experimental import pallas as pl
+
+_I0 = np.int32(0)
+_STATS_LANES = 128
+PAGE = 128
+D = 128
+
+
+def specs(block_q, d, kvh):
+    page = pl.BlockSpec((1, kvh, PAGE, D), lambda b, i: (b, _I0, _I0, _I0))
+    stats = pl.BlockSpec((8, _STATS_LANES), lambda i: (i, _I0))
+    runtime = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, _I0))
+    # block spans the whole (length-5) trailing array axis: legal by the
+    # equals-array-dim clause, which only the author can see
+    whole_axis = pl.BlockSpec((8, 5), lambda i: (i, _I0))  # tpu-lint: blockspec-ok
+    return page, stats, runtime, whole_axis

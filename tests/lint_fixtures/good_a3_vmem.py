@@ -1,0 +1,28 @@
+"""Known-good A3: the committed rms_norm pick for H=4096 —
+`fused_norm.pick_block_rows(4096, 4096)` shrinks the row block to 64,
+which fits the scoped-VMEM budget with room for the fp32 compute
+temporaries (≈6 MB estimated)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+_I0 = np.int32(0)
+_ROWS = 64          # pick_block_rows(4096, 4096) == 64
+_H = 4096
+
+
+def kernel(x_ref, o_ref):
+    x = x_ref[...].astype(jnp.float32)
+    var = jnp.mean(x * x, axis=-1, keepdims=True)
+    o_ref[...] = (x * jax.lax.rsqrt(var + 1e-6)).astype(o_ref.dtype)
+
+
+def run(x):
+    return pl.pallas_call(
+        kernel,
+        grid=(4096 // _ROWS,),
+        in_specs=[pl.BlockSpec((_ROWS, _H), lambda i: (i, _I0))],
+        out_specs=pl.BlockSpec((_ROWS, _H), lambda i: (i, _I0)),
+        out_shape=jax.ShapeDtypeStruct((4096, _H), jnp.float32),
+    )(x)

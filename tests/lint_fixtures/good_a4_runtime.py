@@ -1,0 +1,38 @@
+"""Known-good A4: the committed idioms — interpret routed through the
+backend probe (flash_attention._interpret_mode), device_time at its
+default 512 cap, and fori_loop bounds derived from data shapes
+(sparse/nn/functional.py, ops/linalg.py patterns)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from paddle_tpu.kernels.flash_attention import _interpret_mode
+from paddle_tpu.kernels.timing import device_time
+
+_I0 = np.int32(0)
+
+
+def kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def run(x, block):
+    return pl.pallas_call(
+        kernel,
+        grid=(x.shape[0] // block,),
+        in_specs=[pl.BlockSpec((block, x.shape[1]), lambda i: (i, _I0))],
+        out_specs=pl.BlockSpec((block, x.shape[1]), lambda i: (i, _I0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=_interpret_mode(),
+    )(x)
+
+
+def time_it(fn, x):
+    return device_time(fn, x, iters=10, loop_cap=512)
+
+
+def data_bound_loop(perm, piv):
+    def body(i, p):
+        return p
+    return jax.lax.fori_loop(0, piv.shape[-1], body, perm)

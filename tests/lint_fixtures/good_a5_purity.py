@@ -1,0 +1,25 @@
+"""Known-good A5: pure traced control flow — cond branches that only
+compute, loop bodies whose per-iteration output goes through
+jax.debug.print (trace-aware), and side effects applied AFTER the
+select, outside the traced region."""
+import jax
+from paddle_tpu import static
+
+log = []
+
+
+def route(pred, x):
+    out = static.nn.cond(pred, lambda: x + 1, lambda: x - 1)
+    log.append("routed")       # outside the traced branches: fine
+    return out
+
+
+def cumsum(xs):
+    def body(c, x):
+        jax.debug.print("carry is {c}", c=c)
+        return c + x, c
+    return jax.lax.scan(body, 0.0, xs)
+
+
+def countdown(n):
+    return jax.lax.while_loop(lambda i: i > 0, lambda i: i - 1, n)

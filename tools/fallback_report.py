@@ -135,7 +135,39 @@ def build_all():
         moe_loss)
 
 
-def write_md(path):
+def _lint_section():
+    """FALLBACKS.md section for the dy2static purity diagnostics
+    (tpu-lint rule A5, shared Diagnostic type from paddle_tpu.analysis):
+    scan/while-lowered bodies that printed at trace time, loops kept
+    eager because their bodies mutate non-carried python state, and
+    out-of-trace collective rejections — recorded at runtime while the
+    ladder steps above compiled, reported next to the eager-fallback
+    counts they explain. See ANALYSIS.md for the rule catalog."""
+    lines = ["", "## dy2static purity diagnostics (tpu-lint A5, `--lint`)",
+             "",
+             "Runtime promotions of the purity checks: recorded while "
+             "the ladder train steps compiled (shared `Diagnostic` type "
+             "with `tools/tpu_lint.py`; catalog in ANALYSIS.md).", ""]
+    any_diag = False
+    for name, d in REPORTS.items():
+        diags = d["report"].get("purity_diagnostics", [])
+        if not diags:
+            continue
+        any_diag = True
+        lines.append(f"### {name}")
+        for dg in diags:
+            lines.append(
+                f"- `{dg['rule']}[{dg['slug']}]` {dg['path']}:{dg['line']} "
+                f"— {dg['message']}")
+        lines.append("")
+    if not any_diag:
+        lines.append("No purity diagnostics: every compiled ladder step "
+                     "ran without trace-time side effects, eager-kept "
+                     "mutating loops, or out-of-trace collectives.")
+    return lines
+
+
+def write_md(path, lint=False):
     lines = [
         "# FALLBACKS.md — the eager-fallback inventory "
         "(jit.to_static_report)", "",
@@ -173,6 +205,8 @@ def write_md(path):
                   "compile end-to-end. The break counters above are the "
                   "only dy2static activity (conversions that still "
                   "landed in a compiled form)."]
+    if lint:
+        lines += _lint_section()
     with open(path, "w") as f:
         f.write("\n".join(lines) + "\n")
     print(f"wrote {path}")
@@ -183,6 +217,12 @@ if __name__ == "__main__":
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "FALLBACKS.md"))
+    ap.add_argument("--lint", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="include the dy2static purity-diagnostic section "
+                         "(tpu-lint A5 runtime promotions; on by default "
+                         "so a plain regeneration keeps the committed "
+                         "FALLBACKS.md section — --no-lint to drop it)")
     args = ap.parse_args()
     build_all()
-    write_md(args.out)
+    write_md(args.out, lint=args.lint)
