@@ -24,7 +24,8 @@ __all__ = ["Job", "Plan", "FleetExecutor", "build_pipeline_plan",
            "ZeroBubbleRunner", "simulate_pipeline_makespan",
            "per_rank_schedule", "ThreadedFleetExecutor",
            "ThreadedZBVExecutor", "zbv_stage_of",
-           "build_zbv_rank_schedules"]
+           "build_zbv_rank_schedules", "zb_dispatch_tax_model",
+           "choose_pipeline_schedule"]
 
 
 class Job:
@@ -633,6 +634,73 @@ def build_zbv_rank_schedules(n_ranks, n_micro, t_f=1.0, t_b=1.0, t_w=1.0,
         if not progressed:
             raise RuntimeError("ZB-V schedule deadlock")
     return schedules, max(rank_free.values())
+
+
+def zb_dispatch_tax_model(n_stages, n_micro, t_f, t_b, t_w,
+                          overhead=0.0):
+    """Explicit win/lose model for ZB-H1 vs 1F1B at a given
+    (pp, micro, t_f/t_b/t_w) point — VERDICT r5 #6: the measured
+    BENCH_PIPELINE rows showed ZB sometimes LOSING, and the reason is
+    structural, not noise, so the selector needs a model, not a slogan.
+
+    Two opposing terms:
+
+    * **bubble saved** — the deferred W jobs fill 1F1B's cooldown
+      bubbles. Quantified by the dependency simulator at overhead 0:
+      `sim_1f1b - sim_zb` (can be NEGATIVE: with measured durations
+      where t_w > t_b, parking W after the B chain can LENGTHEN the
+      critical path — that is exactly what the measured (2,8)/(4,4)
+      rows show).
+    * **dispatch tax** — ZB dispatches ~`n_micro` extra W jobs per
+      rank; each job dispatch costs `overhead` seconds (host dispatch
+      + launch latency; BENCH_PIPELINE's 1-core wall columns put the
+      two-dispatch split at ~10% of a fused backward on this host).
+      Modeled exactly, not as a scalar correction: every job's duration
+      is inflated by `overhead` and the same dependency simulation is
+      re-run — 3 dispatches per micro per rank for ZB (F, B, W)
+      against 2 for 1F1B (F, fused B+W).
+
+    Returns a dict: predicted makespans (with the tax), the two terms,
+    extra_w_dispatches, and `verdict` ("ZB-H1" when it wins, else
+    "1F1B"). `simulate_pipeline_makespan` is the single source of the
+    dependency model — this function only composes it.
+    """
+    t_f, t_b, t_w = float(t_f), float(t_b), float(t_w)
+    h = float(overhead)
+    base_1f1b = simulate_pipeline_makespan(n_stages, n_micro, "1F1B",
+                                           t_f=t_f, t_b=t_b, t_w=t_w)
+    base_zb = simulate_pipeline_makespan(n_stages, n_micro, "ZB-H1",
+                                         t_f=t_f, t_b=t_b, t_w=t_w)
+    # overhead folds into each dispatched job: 1F1B's backward is ONE
+    # dispatch (fused b+w), so its tax rides the fused duration via t_w
+    pred_1f1b = simulate_pipeline_makespan(
+        n_stages, n_micro, "1F1B", t_f=t_f + h, t_b=t_b, t_w=t_w + h)
+    pred_zb = simulate_pipeline_makespan(
+        n_stages, n_micro, "ZB-H1", t_f=t_f + h, t_b=t_b + h,
+        t_w=t_w + h)
+    return {
+        "n_stages": int(n_stages), "n_micro": int(n_micro),
+        "t_f": t_f, "t_b": t_b, "t_w": t_w, "overhead": h,
+        "bubble_saved": base_1f1b - base_zb,
+        "extra_w_dispatches": int(n_stages) * int(n_micro),
+        "dispatch_tax": (pred_zb - base_zb) - (pred_1f1b - base_1f1b),
+        "predicted_1f1b": pred_1f1b,
+        "predicted_zb": pred_zb,
+        "verdict": "ZB-H1" if pred_zb < pred_1f1b else "1F1B",
+    }
+
+
+def choose_pipeline_schedule(n_stages, n_micro, t_f, t_b, t_w,
+                             overhead=0.0):
+    """Schedule selector gated on the dispatch-tax model: returns
+    "ZB-H1" only when the modeled bubble saving survives the modeled
+    per-dispatch overhead at this (pp, micro, durations) point —
+    otherwise 1F1B (whose fused backward pays one dispatch, not two).
+    Feed measured durations (`ThreadedFleetExecutor.measured_durations`
+    or BENCH_PIPELINE rows), not unit guesses: the unit-time model
+    over-predicts ZB wins on every measured row (BENCH_PIPELINE.md)."""
+    return zb_dispatch_tax_model(n_stages, n_micro, t_f, t_b, t_w,
+                                 overhead=overhead)["verdict"]
 
 
 def simulate_pipeline_makespan(n_stages, n_micro, schedule,

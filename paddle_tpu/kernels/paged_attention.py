@@ -46,8 +46,8 @@ from .flash_attention import _interpret_mode
 patch_pltpu()
 
 __all__ = ["paged_attention_decode", "paged_cache_write",
-           "paged_cache_write_range", "alloc_paged_cache",
-           "check_supported_paged", "paged_blockspecs"]
+           "paged_cache_write_range", "paged_cache_write_span",
+           "alloc_paged_cache", "check_supported_paged", "paged_blockspecs"]
 
 NEG_INF = np.float32(-1e30)
 _STATS_LANES = 128
@@ -297,6 +297,75 @@ def paged_cache_write_range(k_cache, v_cache, k_new, v_new, block_table,
     v_cache = jax.lax.scatter(
         v_cache, idx.reshape(S * KVH, 3),
         v_new.reshape(S * KVH, D).astype(v_cache.dtype), dnums,
+        indices_are_sorted=False, unique_indices=False)
+    return k_cache, v_cache
+
+
+def paged_cache_write_span(k_cache, v_cache, k_new, v_new, block_tables,
+                           lengths, starts):
+    """Scatter a BATCH of short spans' K/V into the paged cache — the
+    speculative-decoding VERIFY write: every sequence lands its
+    [last emitted token, draft_1..draft_K] K/V in one fused scatter.
+
+    k_new/v_new:   (B, S, KVH, D) — row b holds keys/values for token
+                   positions starts[b]..starts[b]+S-1 (positions past
+                   lengths[b] are bucket padding).
+    block_tables:  (B, max_pages) int32 — per-sequence page ids; slot j
+                   covers tokens [j*page_size, (j+1)*page_size).
+    lengths:       (B,) int32 — live tokens in each row's span (the
+                   verify step's 1 + draft_len); span positions >=
+                   lengths[b] route to page 0, the reserved pad page
+                   (the `paged_attention_decode` padding contract).
+    starts:        (B,) int32 — absolute position of k_new[b, 0]
+                   (seq_len - 1: the first input token overwrites its
+                   own slot idempotently, exactly like the decode-step
+                   write — a supervisor retry re-runs bit-identically).
+    Returns the updated (k_cache, v_cache).
+
+    Batched sibling of `paged_cache_write_range` (single-sequence
+    prefill span) and `paged_cache_write` (one token per sequence);
+    kept a pure-XLA scatter like both — a verify span moves at most
+    (K+1) tokens per sequence, not a bandwidth problem; the read path
+    stays the gathered-prefix attention / Pallas kernel.
+    """
+    num_pages, KVH, page_size, D = k_cache.shape
+    B, S = k_new.shape[:2]
+    P = block_tables.shape[1]
+    t = jnp.arange(S, dtype=jnp.int32)[None, :]                   # (1, S)
+    live = t < jnp.asarray(lengths, jnp.int32)[:, None]           # (B, S)
+    pos = t + jnp.asarray(starts, jnp.int32)[:, None]             # (B, S)
+    page_idx = jax.lax.div(pos, jnp.int32(page_size))
+    page_off = jax.lax.rem(pos, jnp.int32(page_size))
+    # dead positions may carry pos < 0 (padded batch rows start at -1)
+    # or past-the-table pages: clamp the gather index — the page id is
+    # forced to 0 by `live` anyway, and their offsets fall out of
+    # bounds (FILL_OR_DROP discards them)
+    safe_idx = jnp.clip(page_idx, 0, P - 1)
+    pages = jnp.where(
+        live,
+        jnp.take_along_axis(block_tables.astype(jnp.int32), safe_idx,
+                            axis=1),
+        0)
+    heads = jnp.arange(KVH, dtype=jnp.int32)
+    idx = jnp.stack([
+        jnp.broadcast_to(pages[:, :, None], (B, S, KVH)),
+        jnp.broadcast_to(heads[None, None, :], (B, S, KVH)),
+        jnp.broadcast_to(page_off[:, :, None], (B, S, KVH)),
+    ], axis=-1)
+    dnums = jax.lax.ScatterDimensionNumbers(
+        update_window_dims=(1,),
+        inserted_window_dims=(0, 1, 2),
+        scatter_dims_to_operand_dims=(0, 1, 2))
+    # dead positions collide on page 0 — duplicates allowed there (pad
+    # page contents are never read un-masked), so uniqueness must NOT
+    # be declared (same contract note as paged_cache_write)
+    k_cache = jax.lax.scatter(
+        k_cache, idx.reshape(B * S * KVH, 3),
+        k_new.reshape(B * S * KVH, D).astype(k_cache.dtype), dnums,
+        indices_are_sorted=False, unique_indices=False)
+    v_cache = jax.lax.scatter(
+        v_cache, idx.reshape(B * S * KVH, 3),
+        v_new.reshape(B * S * KVH, D).astype(v_cache.dtype), dnums,
         indices_are_sorted=False, unique_indices=False)
     return k_cache, v_cache
 

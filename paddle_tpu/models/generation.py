@@ -24,10 +24,12 @@ from ..core.tensor import Tensor
 __all__ = ["jit_generate"]
 
 
-def _sample_arr(logits, key, temperature, top_k, top_p):
-    """(B, V) logits -> (B,) int32 token ids, pure-array."""
-    if temperature <= 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+def _filter_logits(logits, temperature, top_k, top_p):
+    """Temperature + top-k + top-p (nucleus) filtering over the last
+    axis; leading axes are batched. Returns float32 filtered logits
+    (masked-out entries at -inf). Shared by `_sample_arr` and the
+    serving spec-decode verify program, whose rejection sampling needs
+    the filtered DISTRIBUTION, not just one draw."""
     lg = logits.astype(jnp.float32) / temperature
     V = lg.shape[-1]
     if top_k and top_k < V:
@@ -37,11 +39,23 @@ def _sample_arr(logits, key, temperature, top_k, top_p):
         sorted_lg = jnp.sort(lg, axis=-1)[..., ::-1]
         probs = jax.nn.softmax(sorted_lg, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
-        # smallest logit still inside the nucleus
+        # smallest logit still inside the nucleus. NOTE: this was
+        # jnp.max over the kept logits until ISSUE 5 — which reduces to
+        # the single argmax whenever top_p < 1 (the nucleus collapsed
+        # to one token); spec-decode rejection sampling consumes this
+        # distribution directly, which is how the bug surfaced
         keep = (cum - probs) < top_p
-        kth = jnp.max(jnp.where(keep, sorted_lg, -jnp.inf), axis=-1,
+        kth = jnp.min(jnp.where(keep, sorted_lg, jnp.inf), axis=-1,
                       keepdims=True)
         lg = jnp.where(lg < kth, -jnp.inf, lg)
+    return lg
+
+
+def _sample_arr(logits, key, temperature, top_k, top_p):
+    """(B, V) logits -> (B,) int32 token ids, pure-array."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    lg = _filter_logits(logits, temperature, top_k, top_p)
     return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
 
 

@@ -265,6 +265,92 @@ class LlamaAttention(nn.Layer):
         out = M.reshape(out, [b, s, self.n_heads * self.head_dim])
         return self.o_proj(out), k_cache, v_cache
 
+    def forward_paged_verify(self, x, cos_bs, sin_bs, k_cache, v_cache,
+                             block_tables, seq_lens, draft_lens):
+        """One speculative VERIFY step over the paged cache: each row
+        scores 1 + K tokens (the last emitted token plus K draft tokens)
+        against its own paged prefix in ONE launch — the multi-token
+        sibling of `forward_paged` (decode) built from the same pieces
+        as `forward_paged_prefill` (gathered-prefix attention), batched.
+
+        x (B, S, hidden): row b's tokens sit at absolute positions
+        seq_lens[b]-1 .. seq_lens[b]-1+S-1, of which the first
+        1 + draft_lens[b] are live (the rest is K-bucket padding);
+        cos_bs/sin_bs (B, S, D/2) are rope rows pre-gathered at those
+        positions; k/v_cache (num_pages, KVH, page, D); block_tables
+        (B, max_pages); seq_lens (B,) counts tokens through the FIRST
+        input token (the `forward_paged` convention — its position is
+        seq_lens-1). Writes all live positions' roped K/V via
+        `paged_cache_write_span` (idempotent for position seq_lens-1,
+        like the decode write), then attends over the gathered dense
+        view of each row's pages under the causal mask
+        kpos <= (seq_lens-1) + j. Returns (out, k_cache, v_cache).
+        """
+        from ..kernels.paged_attention import paged_cache_write_span
+        b, s, _ = x.shape
+        q = M.reshape(self.q_proj(x), [b, s, self.n_heads, self.head_dim])
+        k = M.reshape(self.k_proj(x), [b, s, self.n_kv, self.head_dim])
+        v = M.reshape(self.v_proj(x), [b, s, self.n_kv, self.head_dim])
+        q = apply_op("rope_span", apply_rotary_spans, q, cos_bs, sin_bs)
+        k = apply_op("rope_span", apply_rotary_spans, k, cos_bs, sin_bs)
+
+        def _write(kc, vc, kn, vn, bt, sl, dl):
+            return paged_cache_write_span(
+                kc, vc, kn, vn, bt,
+                dl.astype(jnp.int32) + 1,            # live span tokens
+                sl.astype(jnp.int32) - 1)            # first token's slot
+        k_cache, v_cache = apply_op("paged_cache_write_span", _write,
+                                    k_cache, v_cache, k, v,
+                                    block_tables, seq_lens, draft_lens)
+        n_kv, hd = self.n_kv, self.head_dim
+
+        def _gather(cache, bt):
+            g = jnp.take(cache, bt.astype(jnp.int32), axis=0)
+            g = jnp.swapaxes(g, 2, 3)          # (B, P, page, KVH, D)
+            return g.reshape(b, -1, n_kv, hd)  # (B, P*page, KVH, D)
+
+        kd = apply_op("paged_gather", _gather, k_cache, block_tables)
+        vd = apply_op("paged_gather", _gather, v_cache, block_tables)
+        if self.n_kv != self.n_heads:
+            rep = self.n_heads // self.n_kv
+            kd = apply_op("repeat_kv",
+                          lambda a: jnp.repeat(a, rep, axis=2), kd)
+            vd = apply_op("repeat_kv",
+                          lambda a: jnp.repeat(a, rep, axis=2), vd)
+        sk = int(kd.shape[1])
+
+        def _mask(sl):
+            # padded batch rows carry seq_len 0 -> qpos would be -1 and
+            # fully mask their first row (NaN softmax); clamp to 0 so
+            # dead rows stay finite — their outputs are discarded
+            qpos = jnp.maximum(
+                sl.astype(jnp.int32)[:, None] - 1
+                + jnp.arange(s, dtype=jnp.int32)[None, :], 0)   # (B, S)
+            kpos = jnp.arange(sk, dtype=jnp.int32)
+            return (kpos[None, None, :] <= qpos[:, :, None])[:, None]
+
+        mask = apply_op("verify_mask", _mask, seq_lens)
+        out = F.scaled_dot_product_attention(q, kd, vd, attn_mask=mask)
+        out = M.reshape(out, [b, s, self.n_heads * self.head_dim])
+        return self.o_proj(out), k_cache, v_cache
+
+
+def apply_rotary_spans(x, cos_bs, sin_bs):
+    """Rotary at PER-ROW PER-OFFSET positions: x (B, S, H, D),
+    cos_bs/sin_bs (B, S, D/2) gathered at each row's own span of
+    absolute positions (the speculative-decode verify step scores
+    1 + K tokens per sequence, each sequence at a different offset).
+    Same pair-view convention as `apply_rotary`."""
+    xr = x.reshape(*x.shape[:-1], x.shape[-1] // 2, 2)
+    x1 = xr[..., 0]
+    x2 = xr[..., 1]
+    c = cos_bs[:, :, None, :]
+    s = sin_bs[:, :, None, :]
+    o1 = x1 * c - x2 * s
+    o2 = x2 * c + x1 * s
+    out = jnp.stack([o1, o2], axis=-1)
+    return out.reshape(x.shape)
+
 
 def apply_rotary_positions(x, cos_b, sin_b):
     """Rotary at PER-ROW positions: x (B, 1, H, D), cos_b/sin_b (B, D/2)
@@ -331,6 +417,16 @@ class LlamaDecoderLayer(nn.Layer):
         attn, k_cache, v_cache = self.self_attn.forward_paged_prefill(
             h, cos_c, sin_c, k_cache, v_cache, block_table, cache_len,
             chunk_len)
+        x = x + attn
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x, k_cache, v_cache
+
+    def forward_paged_verify(self, x, cos_bs, sin_bs, k_cache, v_cache,
+                             block_tables, seq_lens, draft_lens):
+        h = self.input_layernorm(x)
+        attn, k_cache, v_cache = self.self_attn.forward_paged_verify(
+            h, cos_bs, sin_bs, k_cache, v_cache, block_tables, seq_lens,
+            draft_lens)
         x = x + attn
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x, k_cache, v_cache
@@ -433,6 +529,39 @@ class LlamaModel(nn.Layer):
             new_caches.append((kc, vc))
         return self.norm(x), new_caches
 
+    def forward_paged_verify(self, input_ids, paged_caches, block_tables,
+                             seq_lens, draft_lens):
+        """One speculative VERIFY step over per-layer paged KV caches.
+
+        input_ids (B, S) — row b holds [last emitted token,
+        draft_1..draft_{S-1}] at absolute positions seq_lens[b]-1
+        onward (first 1 + draft_lens[b] live, rest K-bucket padding);
+        seq_lens counts tokens through the first input token (the
+        `forward_paged_decode` convention). Returns
+        (hidden (B, S, H), new_caches)."""
+        s = input_ids.shape[1]
+
+        def _gather_rope(c, sl):
+            pos = (sl.astype(jnp.int32)[:, None] - 1
+                   + jnp.arange(s, dtype=jnp.int32)[None, :])    # (B, S)
+            # padded rows (seq_len 0) and padded span tails may run
+            # off the table; clip — those rows are masked/discarded
+            return jnp.take(c, jnp.clip(pos, 0, c.shape[0] - 1), axis=0)
+
+        cos_bs = apply_op("rope_gather", _gather_rope, self.rope_cos,
+                          seq_lens)
+        sin_bs = apply_op("rope_gather", _gather_rope, self.rope_sin,
+                          seq_lens)
+        x = self.embed_tokens(input_ids)
+        new_caches = []
+        for i, layer in enumerate(self.layers):
+            kc, vc = paged_caches[i]
+            x, kc, vc = layer.forward_paged_verify(
+                x, cos_bs, sin_bs, kc, vc, block_tables, seq_lens,
+                draft_lens)
+            new_caches.append((kc, vc))
+        return self.norm(x), new_caches
+
 
 def _recompute_layer(layer, x, cos, sin):
     """Activation checkpointing via jax.checkpoint over the layer's pure fn
@@ -530,6 +659,21 @@ class LlamaForCausalLM(nn.Layer):
         h_last = apply_op("chunk_last", _last, h, chunk_len)
         tied = self.model.embed_tokens.weight if self.lm_head is None else None
         logits = _head_and_loss(h_last, None, self.lm_head, tied)
+        return logits, caches
+
+    def forward_paged_verify(self, input_ids, paged_caches, block_tables,
+                             seq_lens, draft_lens):
+        """Serving speculative-verify step: paged-KV transformer over
+        1 + K tokens per row + LM head at EVERY position — the verify
+        consumer needs logits after each draft token (position j's
+        logits score draft j+1 and supply the correction/bonus token),
+        so unlike the chunk program the full (B, S, V) head is the
+        point, not waste (S = K+1 is small). Returns
+        (logits (B, S, V), new_caches)."""
+        h, caches = self.model.forward_paged_verify(
+            input_ids, paged_caches, block_tables, seq_lens, draft_lens)
+        tied = self.model.embed_tokens.weight if self.lm_head is None else None
+        logits = _head_and_loss(h, None, self.lm_head, tied)
         return logits, caches
 
     # -------------------------------------------------------- generation

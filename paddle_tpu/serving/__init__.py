@@ -6,7 +6,10 @@ Sarathi-style chunked prefill, compiled into a bounded grid of bucketed
 XLA programs over the chip-validated paged-attention kernels; a
 resilience layer (ISSUE 3) adds request deadlines/abort, bounded-queue
 admission control, supervised step retries with poison quarantine, and
-snapshot/resume across device failures.
+snapshot/resume across device failures; speculative decoding (ISSUE 5,
+`serving.spec`) drafts K candidate tokens per sequence (n-gram prompt
+lookup or a smaller draft model) and verifies them against the paged
+cache in one bucketed launch with KV rollback for rejected drafts.
 """
 from .engine import ServingEngine
 from .errors import (EngineFailure, EngineOverloaded, PoisonedComputation,
@@ -16,6 +19,7 @@ from .metrics import ServingMetrics
 from .radix_cache import RadixCache, RadixNode
 from .scheduler import (PrefillChunk, Request, RequestState, ScheduleStep,
                         Scheduler)
+from .spec import DraftModelProposer, NgramProposer, Proposer
 from .supervisor import RetryPolicy, StepSupervisor, classify_failure
 
 __all__ = ["ServingEngine", "BlockAllocator", "BlocksExhausted",
@@ -23,4 +27,5 @@ __all__ = ["ServingEngine", "BlockAllocator", "BlocksExhausted",
            "RadixNode", "PrefillChunk", "Request", "RequestState",
            "ScheduleStep", "Scheduler", "EngineFailure", "EngineOverloaded",
            "PoisonedComputation", "TransientDeviceError", "RetryPolicy",
-           "StepSupervisor", "classify_failure"]
+           "StepSupervisor", "classify_failure", "Proposer",
+           "NgramProposer", "DraftModelProposer"]

@@ -20,7 +20,17 @@ recompilation:
   * decode program, keyed by (batch bucket, block-table-width bucket):
     one batched step through `model.forward_paged_decode` — per-row rope
     positions, `paged_cache_write` of the current token, Pallas
-    `paged_attention_decode` over the block tables — plus sampling.
+    `paged_attention_decode` over the block tables — plus sampling;
+  * VERIFY program (speculative decoding, ISSUE 5), keyed by
+    ("verify", batch bucket, draft-length bucket, block-table bucket):
+    when a `Proposer` is configured, the decode launch is replaced by
+    `model.forward_paged_verify` — each row scores its last emitted
+    token plus up to K drafted tokens in ONE launch, acceptance is
+    resolved in-graph (greedy longest-prefix match, or exact one-hot
+    rejection sampling for temperature > 0), and rejected drafts' KV
+    pages roll back via `BlockAllocator.truncate_sequence`. K rides the
+    program key like B and P, so the compile bound stays the bucket
+    grid (`max_program_count`).
 
 Shape buckets pad up: a 19-token chunk runs in the 32-bucket, a decode
 batch of 5 in the 8-bucket. The recompile counter (metrics) is bounded
@@ -61,11 +71,11 @@ import numpy as np
 from ..core.autograd import no_grad
 from ..core.tensor import Tensor
 from ..jit.api import functional_call
-from ..models.generation import _sample_arr
+from ..models.generation import _filter_logits, _sample_arr
 from ..utils import faults
 from ..utils.nan_inf import poison_scope
 from .errors import EngineFailure, EngineOverloaded
-from .kv_cache import BlockAllocator, PAD_PAGE
+from .kv_cache import BlockAllocator, BlocksExhausted, PAD_PAGE
 from .metrics import ServingMetrics
 from .radix_cache import RadixCache
 from .scheduler import (Request, RequestState, Scheduler,
@@ -88,6 +98,14 @@ FAULT_CHUNK = faults.register_point("serving.engine.prefill_chunk")
 FAULT_DECODE = faults.register_point("serving.engine.decode_step")
 FAULT_NAN = faults.register_point("serving.engine.nan_logits")
 FAULT_STORM = faults.register_point("serving.engine.deadline_storm")
+# Speculative decoding (ISSUE 5): verify_step mirrors decode_step (fires
+# BEFORE the verify launch — an injected transient retries the identical
+# program); draft_storm replaces the proposer's drafts with the payload
+# (callable(reqs, k) -> drafts, or True for seeded garbage) — the
+# mismatch storm MUST be output-invariant under greedy acceptance, which
+# the soak asserts. nan_logits covers the verify path too.
+FAULT_VERIFY = faults.register_point("serving.engine.verify_step")
+FAULT_DRAFT = faults.register_point("serving.spec.draft_storm")
 
 
 def _bucket_for(value: int, buckets: List[int]) -> int:
@@ -111,9 +129,17 @@ class ServingEngine:
 
     model: a LlamaForCausalLM-protocol model — `forward_paged_prefill`
     for (chunked) prompt processing and `forward_paged_decode` for the
-    batched decode step, both over the engine-owned paged caches.
+    batched decode step, both over the engine-owned paged caches
+    (plus `forward_paged_verify` when speculative decoding is on).
     enable_prefix_cache turns the radix tree on (default); off, the
     engine behaves like PR 1 plus chunked prefill.
+
+    proposer (serving.spec.Proposer, optional) enables speculative
+    decoding: up to `spec_k` draft tokens per decoding request are
+    verified per step in one ("verify", B, K, P) launch; greedy output
+    is token-identical to plain decode (drafting only changes how many
+    launches it takes), and `spec_buckets` is the K axis of the
+    program grid.
     """
 
     def __init__(self, model, *, num_pages: int = 128, page_size: int = 16,
@@ -128,7 +154,9 @@ class ServingEngine:
                  max_queue_len: Optional[int] = None,
                  default_ttl_s: Optional[float] = None,
                  retry_policy: Optional[RetryPolicy] = None,
-                 clock=None):
+                 clock=None,
+                 proposer=None, spec_k: int = 4,
+                 spec_buckets: Optional[List[int]] = None):
         cfg = model.cfg
         self.model = model
         self.cfg = cfg
@@ -181,6 +209,24 @@ class ServingEngine:
         if self.prefill_buckets[-1] > self.max_seq_len:
             raise ValueError("prefill bucket exceeds max sequence length")
 
+        # --- speculative decoding (ISSUE 5) ---
+        # proposer drafts up to spec_k tokens per decoding request per
+        # step; the bucketed ("verify", B, K, P) program scores them in
+        # one launch. K rides the program-cache KEY (like B and P), so
+        # the compile count stays bounded by the grid — spec_buckets is
+        # the K axis of that grid.
+        self.proposer = proposer
+        self.spec_k = int(spec_k)
+        if proposer is not None and self.spec_k < 1:
+            raise ValueError("spec_k must be >= 1 with a proposer")
+        self.spec_buckets = sorted(
+            spec_buckets or _pow2_buckets(1, max(1, self.spec_k))) \
+            if proposer is not None else []
+        if self.spec_buckets and self.spec_buckets[-1] != self.spec_k:
+            raise ValueError(
+                f"largest spec bucket {self.spec_buckets[-1]} must equal "
+                f"spec_k {self.spec_k}")
+
         self.allocator = BlockAllocator(self.num_pages, self.page_size)
         self.radix = (RadixCache(self.allocator)
                       if enable_prefix_cache else None)
@@ -190,6 +236,10 @@ class ServingEngine:
             max_prompt_len=self.max_seq_len,
             prefix_cache=self.radix,
             max_queue_len=max_queue_len)
+        if proposer is not None:
+            # verify tokens draw from the same per-step token budget
+            # prefill chunks compete for (SERVING.md bucketing note)
+            self.scheduler.decode_token_cost = 1 + self.spec_k
         # --- resilience (ISSUE 3) ---
         # deadlines use an injectable clock (tests/soak pass a fake one;
         # the fault harness adds skew) so expiry stays deterministic
@@ -314,9 +364,16 @@ class ServingEngine:
         return len(self._programs)
 
     def max_program_count(self) -> int:
-        """The bucket-grid bound the recompile counter can never exceed."""
+        """The bucket-grid bound the recompile counter can never exceed.
+        With a proposer the ("verify", B, K, P) grid joins it: K is a
+        program-cache key axis exactly like B and P, so speculative
+        decoding multiplies the decode-side bound by len(spec_buckets)
+        instead of compiling per draft length (SERVING.md documents the
+        bound next to the PR-1 bucket-grid note)."""
         return ((len(self.prefill_buckets) + len(self.batch_buckets))
-                * len(self.pages_buckets))
+                * len(self.pages_buckets)
+                + (len(self.batch_buckets) * len(self.spec_buckets)
+                   * len(self.pages_buckets)))
 
     # ----------------------------------------------------- prefill chunks
     def _build_chunk(self, S: int, P: int):
@@ -461,6 +518,267 @@ class ServingEngine:
             rows = poison
         return [int(i) for i in rows if 0 <= int(i) < len(reqs)]
 
+    # ------------------------------------------- speculative verify (ISSUE 5)
+    def _build_verify(self, B: int, K: int, P: int):
+        """One speculative VERIFY launch: scores each row's
+        [last emitted token, draft_1..draft_K] in one pass over the
+        paged caches and resolves acceptance IN-GRAPH, so the host
+        fetches only (tokens, accepted counts, finiteness flags).
+
+        Acceptance implements rejection sampling for a DETERMINISTIC
+        (one-hot) proposal — both shipped proposers draft greedily:
+        * temperature == 0: longest prefix with argmax(prev logits) ==
+          draft, then the argmax correction/bonus token. Emitted tokens
+          are exactly the argmaxes plain decode would emit, which is
+          the greedy bit-identity contract.
+        * temperature > 0: draft d at position j accepts iff
+          u_j < p_j(d) (p = the SAME filtered/tempered distribution
+          `_sample_arr` uses); a rejected position samples the
+          renormalized remainder of p with d removed — exact residual
+          for a one-hot proposal, so the output distribution equals
+          plain sampled decode's. All randomness derives from the one
+          pre-drawn key, so StepSupervisor retries stay bit-identical.
+        """
+        S = K + 1
+        L = self.num_layers
+        model = self.model
+        temperature, top_k, top_p = self.temperature, self.top_k, self.top_p
+
+        def program(state, kcs, vcs, ids, bt, sl, dl, key):
+            st = {k: Tensor(v) for k, v in state.items()}
+            paged = [(Tensor(kcs[l]), Tensor(vcs[l])) for l in range(L)]
+            logits, caches = functional_call(
+                model, st, Tensor(ids), paged, Tensor(bt), Tensor(sl),
+                Tensor(dl), method="forward_paged_verify")
+            lg = logits._data                            # (B, S, V)
+            jpos = jnp.arange(S, dtype=jnp.int32)[None, :]
+            live_q = jpos <= dl[:, None]                 # (B, S)
+            # per-row finiteness over LIVE positions only (padding rows
+            # run on clamped positions; only real work may quarantine)
+            fin = jnp.all(jnp.isfinite(lg), axis=-1)
+            ok = jnp.all(jnp.where(live_q, fin, True), axis=-1)
+            drafts = ids[:, 1:]                          # (B, K)
+            # position j's logits score draft j+1: live iff j < dl
+            has_draft = jpos[:, :K] < dl[:, None]
+            idsn = jnp.concatenate(
+                [drafts, jnp.zeros((B, 1), ids.dtype)], axis=1)  # (B, S)
+            if temperature <= 0.0:
+                pred = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                acc = jnp.logical_and(pred[:, :K] == drafts, has_draft)
+                n_acc = jnp.sum(jnp.cumprod(acc.astype(jnp.int32),
+                                            axis=1), axis=1)
+                toks = jnp.where(jpos < n_acc[:, None], idsn, pred)
+            else:
+                p = jax.nn.softmax(
+                    _filter_logits(lg, temperature, top_k, top_p),
+                    axis=-1)
+                k_u, k_r = jax.random.split(key)
+                u = jax.random.uniform(k_u, (B, K))
+                p_draft = jnp.take_along_axis(
+                    p[:, :K], drafts[..., None].astype(jnp.int32),
+                    axis=-1)[..., 0]
+                acc = jnp.logical_and(u < p_draft, has_draft)
+                n_acc = jnp.sum(jnp.cumprod(acc.astype(jnp.int32),
+                                            axis=1), axis=1)
+                # residual at a draft position = p with the draft token
+                # zeroed + renormalized (the rejected position has
+                # p(d) < u <= 1, so the remainder has positive mass);
+                # the bonus position (j == dl) samples p itself
+                has_draft_s = jpos < dl[:, None]         # (B, S)
+                onehot = jax.nn.one_hot(idsn.astype(jnp.int32),
+                                        p.shape[-1], dtype=p.dtype)
+                res = p * (1.0 - jnp.where(has_draft_s[..., None],
+                                           onehot, 0.0))
+                res = res / jnp.maximum(
+                    jnp.sum(res, axis=-1, keepdims=True), 1e-30)
+                sampled = jax.random.categorical(
+                    k_r, jnp.log(res + 1e-30), axis=-1).astype(jnp.int32)
+                toks = jnp.where(jpos < n_acc[:, None], idsn, sampled)
+            return (toks, n_acc, ok, [c[0]._data for c in caches],
+                    [c[1]._data for c in caches])
+
+        return jax.jit(program, donate_argnums=self._donate)
+
+    def _extend_for_drafts(self, req: Request, draft: List[int]):
+        """Grow the request's sequence by up to len(draft) token slots
+        (the scheduler already reserved the verify input token's slot).
+        On pool exhaustion the reclamation ladder stops at its FIRST
+        rung — radix LRU eviction of zero-active-ref cached prefixes
+        (otherwise a long-lived server whose pool has filled with
+        donated prefixes, the normal steady state, would drop every
+        draft and silently lose the spec-decode win) — but NEVER
+        preempts: drafts are advisory, and evicting live work to make
+        room for speculation would invert the priority order. Degrades,
+        never fails: `append_token` is atomic, so a dry pool just
+        shortens the draft — zero drafts means the verify step
+        degenerates to plain decode. Returns (granted draft list, CoW
+        copies due)."""
+        base = req.seq.num_tokens
+        copies, granted = [], 0
+        for _ in draft:
+            try:
+                copies.extend(self.allocator.append_token(req.seq))
+            except BlocksExhausted:
+                if not self.scheduler._reclaim(1):
+                    break
+                try:
+                    copies.extend(self.allocator.append_token(req.seq))
+                except BlocksExhausted:
+                    break
+            granted += 1
+        if granted < len(draft):
+            self.metrics.on_spec_draft_oom(len(draft) - granted)
+        del draft[granted:]
+        assert req.seq.num_tokens == base + granted
+        return draft, copies
+
+    def _run_verify(self, reqs: List[Request], drafts: List[List[int]]):
+        """One supervised ("verify", B, K, P) launch. `reqs[i]`'s
+        sequence is already extended by len(drafts[i]); returns
+        (toks (B, K+1), n_acc (B,), oks (B,))."""
+        from .. import profiler
+        B = _bucket_for(len(reqs), self.batch_buckets)
+        K = _bucket_for(max((len(d) for d in drafts), default=0) or 1,
+                        self.spec_buckets)
+        max_pages = max(len(r.seq.pages) for r in reqs)
+        P = _bucket_for(max_pages, self.pages_buckets)
+        prog = self._get_program(("verify", B, K, P),
+                                 lambda: self._build_verify(B, K, P))
+        S = K + 1
+        ids = np.zeros((B, S), np.int32)
+        sl = np.zeros((B,), np.int32)
+        dl = np.zeros((B,), np.int32)
+        bt = np.full((B, P), PAD_PAGE, np.int32)
+        seqs = [r.seq for r in reqs]
+        bt[:len(reqs)] = self.allocator.block_table(seqs, P)
+        for i, (r, d) in enumerate(zip(reqs, drafts)):
+            ids[i, 0] = r.output_ids[-1]
+            ids[i, 1:1 + len(d)] = d
+            dl[i] = len(d)
+            # seq_lens counts through the FIRST input token (the
+            # forward_paged convention); the drafts extended num_tokens
+            # past it, so subtract them back out
+            sl[i] = r.seq.num_tokens - len(d)
+        key = self._next_key()    # drawn once: retries re-run identically
+        rids = [r.request_id for r in reqs]
+
+        def launch():
+            faults.fire(FAULT_VERIFY)
+            with profiler.RecordEvent("serving.verify_step"), \
+                    poison_scope(f"serving.verify_step[reqs={rids}]"), \
+                    no_grad():
+                return prog(
+                    self._state, self._k_caches, self._v_caches,
+                    jnp.asarray(ids), jnp.asarray(bt), jnp.asarray(sl),
+                    jnp.asarray(dl), key)
+
+        toks, n_acc, oks, self._k_caches, self._v_caches = \
+            self.supervisor.run(launch, label="verify_step")
+        oks = np.asarray(oks)[:len(reqs)].copy()
+        poison = faults.fire(FAULT_NAN)
+        if poison is not None:
+            for i in self._poison_rows(poison, reqs):
+                oks[i] = False
+        return (np.asarray(toks), np.asarray(n_acc).astype(int), oks)
+
+    def _spec_decode_step(self, decodes: List[Request], emitted):
+        """The speculative replacement for the plain decode launch:
+        propose -> extend KV -> ONE verify launch -> emit the accepted
+        prefix + correction/bonus -> roll rejected drafts' pages back.
+
+        Failure semantics mirror the decode step: transients retried by
+        the supervisor (the verify write is idempotent and the RNG key
+        pre-drawn); per-row poison quarantines alone; unattributed
+        poison rolls every draft back and isolates via solo PLAIN
+        decode launches (the degraded path already documented for
+        decode); anything else drains to a snapshot."""
+        # drafts are advisory and capped so the emitted tokens can never
+        # overshoot max_new_tokens: a request with r remaining tokens
+        # can use at most r - 1 accepted drafts (+1 correction/bonus)
+        proposals = self.proposer.propose(decodes, self.spec_k)
+        storm = faults.fire(FAULT_DRAFT)
+        if storm is not None:
+            proposals = (storm(decodes, self.spec_k) if callable(storm)
+                         else [[(i * 7 + j * 13 + 1) %
+                                max(2, self.cfg.vocab_size)
+                                for j in range(self.spec_k)]
+                               for i in range(len(decodes))])
+        drafts = []
+        for req, prop in zip(decodes, proposals):
+            cap = max(0, min(self.spec_k, req.remaining_new_tokens() - 1))
+            d = [int(t) for t in list(prop)[:cap]]
+            d, copies = self._extend_for_drafts(req, d)
+            if copies:
+                self._apply_copies(copies)
+            drafts.append(d)
+
+        isolated = False
+        try:
+            toks, n_accs, oks = self._run_verify(decodes, drafts)
+        except Exception as exc:   # noqa: BLE001
+            if classify_failure(exc) != POISON:
+                self._fail(exc)
+            # unattributed poison: drop every draft (their K/V is
+            # suspect) and isolate with solo plain-decode launches
+            for req, d in zip(decodes, drafts):
+                if d:
+                    self.allocator.truncate_sequence(
+                        req.seq, req.seq.num_tokens - len(d))
+            # the rolled-back drafts are real rollback work even though
+            # no verify step completed — count them without minting a
+            # phantom spec step
+            self.metrics.counters["spec_rollback_tokens"] += sum(
+                len(d) for d in drafts)
+            toks1, oks = self._isolate_poisoned(decodes)
+            toks = np.zeros((len(decodes), 2), np.int64)
+            toks[:, 0] = toks1
+            n_accs = np.zeros((len(decodes),), int)
+            drafts = [[] for _ in decodes]
+            isolated = True   # solo launches counted their own tokens
+
+        total_drafted = total_accepted = total_emitted = total_rb = 0
+        rows = 0
+        for i, req in enumerate(decodes):
+            d = drafts[i]
+            base = req.seq.num_tokens - len(d)   # tokens through input
+            if not oks[i]:
+                # quarantine frees the whole sequence (no donation) —
+                # rejected-draft pages go with it
+                self._quarantine(req)
+                continue
+            n_emit = 0
+            reason = None
+            for j in range(int(n_accs[i]) + 1):
+                reason = self._emit(req, int(toks[i, j]), emitted)
+                n_emit += 1
+                if reason is not None:
+                    break
+            # valid K/V: the input token + the accepted drafts actually
+            # CONSUMED (n_emit - 1 of them); everything past it rolls
+            # back so donation/resume never sees speculative garbage
+            valid = base + n_emit - 1
+            rolled = req.seq.num_tokens - valid
+            if rolled:
+                self.allocator.truncate_sequence(req.seq, valid)
+            req.num_computed = valid
+            total_drafted += len(d)
+            total_accepted += n_emit - 1
+            total_emitted += n_emit
+            total_rb += rolled
+            rows += 1
+            if reason is not None:
+                self.scheduler.finish(req, reason)
+                self._on_finished(req)
+        # decode_tokens counts tokens EMITTED by decode-side launches
+        # (1/request for plain decode) so tokens/s stays honest. The
+        # isolation path counted its own solo launches and verified
+        # nothing — recording a spec step for it would drag
+        # spec_tokens_per_step below its true value.
+        if not isolated:
+            self.metrics.on_decode(total_emitted)
+            self.metrics.on_spec_step(total_drafted, total_accepted,
+                                      total_emitted, total_rb, rows)
+
     # ---------------------------------------------------- CoW page copies
     def _apply_copies(self, copies):
         for src, dst in copies:
@@ -576,24 +894,10 @@ class ServingEngine:
             for req in decodes:
                 self._apply_copies(req.pending_copies)
                 req.pending_copies = []
-            try:
-                toks, oks = self._run_decode(decodes)
-            except Exception as exc:   # noqa: BLE001
-                if classify_failure(exc) == POISON:
-                    # unattributed poison (a FloatingPointError raised
-                    # by an eager/dispatch NaN hook instead of the
-                    # in-graph flags): isolate by running rows solo
-                    toks, oks = self._isolate_poisoned(decodes)
-                else:
-                    self._fail(exc)
-            for i, req in enumerate(decodes):
-                if not oks[i]:
-                    self._quarantine(req)
-                    continue
-                reason = self._emit(req, int(toks[i]), emitted)
-                if reason is not None:
-                    self.scheduler.finish(req, reason)
-                    self._on_finished(req)
+            if self.proposer is not None:
+                self._spec_decode_step(decodes, emitted)
+            else:
+                self._plain_decode_step(decodes, emitted)
 
         self.metrics.on_step()
         self.metrics.update_gauges(
@@ -606,6 +910,28 @@ class ServingEngine:
             radix_evicted_pages=(self.radix.num_evicted_pages
                                  if self.radix else None))
         return emitted
+
+    def _plain_decode_step(self, decodes: List[Request], emitted):
+        """One batched single-token decode launch + emission (the
+        non-speculative path, unchanged semantics)."""
+        try:
+            toks, oks = self._run_decode(decodes)
+        except Exception as exc:   # noqa: BLE001
+            if classify_failure(exc) == POISON:
+                # unattributed poison (a FloatingPointError raised
+                # by an eager/dispatch NaN hook instead of the
+                # in-graph flags): isolate by running rows solo
+                toks, oks = self._isolate_poisoned(decodes)
+            else:
+                self._fail(exc)
+        for i, req in enumerate(decodes):
+            if not oks[i]:
+                self._quarantine(req)
+                continue
+            reason = self._emit(req, int(toks[i]), emitted)
+            if reason is not None:
+                self.scheduler.finish(req, reason)
+                self._on_finished(req)
 
     def _isolate_poisoned(self, reqs: List[Request]):
         """Degraded mode for an UNATTRIBUTED poison failure of a decode
@@ -631,7 +957,12 @@ class ServingEngine:
         return toks, oks
 
     def _retain(self, req: Request):
-        """Terminal-request retention bookkeeping (bounded window)."""
+        """Terminal-request retention bookkeeping (bounded window).
+        Every terminal path funnels here, so it doubles as the
+        proposer's release hook (a KV-owning proposer frees its draft
+        pages for this request)."""
+        if self.proposer is not None:
+            self.proposer.on_finished(req)
         self._finished_order.append(req.request_id)
         while len(self._finished_order) > self.max_retained_finished:
             self.requests.pop(self._finished_order.pop(0), None)
@@ -751,4 +1082,6 @@ class ServingEngine:
         return out
 
     def shutdown(self):
+        if self.proposer is not None:
+            self.proposer.reset()
         self.metrics.unregister()

@@ -193,6 +193,35 @@ class BlockAllocator:
         seq.num_tokens = pos + 1
         return copies
 
+    def truncate_sequence(self, seq: KVSequence, num_tokens: int):
+        """Shrink `seq` to its first `num_tokens` tokens, releasing the
+        pages that covered only the dropped tail — the speculative-
+        decoding KV ROLLBACK: rejected draft tokens' pages return to
+        the free list; the page holding the last surviving token stays
+        (its dead tail slots are masked by seq_lens, the same contract
+        as any partially-filled page).
+
+        Invariants preserved by construction: releases go through
+        `_decref`, so a dropped page shared with a fork or held by the
+        radix tree (donated while this sequence still lived) merely
+        loses this sequence's ref — CoW bookkeeping and tree refs stay
+        exact, and `check_invariants` holds after any truncation.
+        `num_tokens=0` is legal (all pages released, sequence still
+        usable/growable — unlike `free_sequence` it is NOT terminal).
+        """
+        if seq.freed:
+            raise RuntimeError("truncate of a freed sequence")
+        num_tokens = int(num_tokens)
+        if not 0 <= num_tokens <= seq.num_tokens:
+            raise ValueError(
+                f"truncate to {num_tokens} outside [0, {seq.num_tokens}]")
+        keep = self.pages_needed(num_tokens)
+        dropped = seq.pages[keep:]
+        del seq.pages[keep:]
+        for pid in dropped:
+            self._decref(pid)
+        seq.num_tokens = num_tokens
+
     def fork_sequence(self, seq: KVSequence) -> KVSequence:
         """Prefix fork: the child shares every page (refcounts bumped);
         the first divergent append to a shared page triggers CoW."""

@@ -61,6 +61,14 @@ class ServingMetrics:
             "step_retries": 0,             # transient-failure re-launches
             "requests_quarantined": 0,     # poisoned (NaN) requests failed
             "engine_failures": 0,          # unrecoverable -> snapshot
+            # --- speculative decoding (ISSUE 5) ---
+            "spec_steps": 0,               # verify launches
+            "spec_verified_rows": 0,       # sequence-steps verified
+            "spec_drafted_tokens": 0,      # draft tokens scored
+            "spec_accepted_tokens": 0,     # drafts that survived verify
+            "spec_emitted_tokens": 0,      # tokens emitted by verify steps
+            "spec_rollback_tokens": 0,     # rejected-draft KV truncated
+            "spec_draft_oom_drops": 0,     # drafts dropped: pool pressure
         }
         self._registered = False
         self._t_start = time.perf_counter()
@@ -69,8 +77,23 @@ class ServingMetrics:
         # server doesn't keep a per-request entry forever
         self._ttft_sum = 0.0
         self._ttft_count = 0
-        self._ttft_samples: deque = deque(maxlen=PERCENTILE_WINDOW)
-        self._queue_wait_samples: deque = deque(maxlen=PERCENTILE_WINDOW)
+        # Named bounded reservoirs, AUTO-exposed by snapshot()/summary()
+        # as {name}_p50/_p90/_p99{suffix} — registering one here is all
+        # it takes to surface its percentiles, the same no-hand-
+        # maintained-key-list contract the counters dict gives new
+        # counters (a PR-3 lesson: drift between the metric store and
+        # the reporting path is a silent observability bug).
+        self._reservoirs: Dict[str, deque] = {}
+        self._reservoir_fmt: Dict[str, tuple] = {}   # name -> (scale,
+        #                                         suffix, round digits)
+        self._ttft_samples = self.add_reservoir("ttft", scale=1e3,
+                                                suffix="_ms")
+        self._queue_wait_samples = self.add_reservoir("queue_wait",
+                                                      scale=1e3,
+                                                      suffix="_ms")
+        # accepted tokens per verify step (the spec-decode win, per
+        # step): mean > 1 is the "speculation pays" signal
+        self._accepted_samples = self.add_reservoir("spec_accepted")
         # gauges updated by the engine each step
         self.queue_depth = 0
         self.running = 0
@@ -78,6 +101,22 @@ class ServingMetrics:
         self.kv_occupancy = 0.0
         self.cached_pages = 0
         self.radix_nodes = 0
+
+    # ---- reservoir registry ---------------------------------------------
+    def add_reservoir(self, name: str, scale: float = 1.0,
+                      suffix: str = "", digits: int = 3) -> deque:
+        """Register a bounded percentile reservoir. Returns the deque to
+        append raw samples to; snapshot() exposes
+        `{name}_p50{suffix}` / p90 / p99 (sample * scale) automatically."""
+        d = self._reservoirs.setdefault(
+            name, deque(maxlen=PERCENTILE_WINDOW))
+        self._reservoir_fmt[name] = (float(scale), suffix, int(digits))
+        return d
+
+    def reservoir_percentiles(self, name):
+        """{p50, p90, p99} raw-valued over one registered reservoir."""
+        return {f"p{q}": _percentile(self._reservoirs.get(name, ()), q)
+                for q in (50, 90, 99)}
 
     # ---- event hooks -----------------------------------------------------
     def on_add(self, request_id: int):
@@ -147,6 +186,27 @@ class ServingMetrics:
     def on_engine_failure(self):
         self.counters["engine_failures"] += 1
 
+    # ---- speculative decoding hooks (ISSUE 5) ---------------------------
+    def on_spec_step(self, drafted: int, accepted: int, emitted: int,
+                     rolled_back: int, rows: int):
+        """One verify launch: `drafted` draft tokens scored, `accepted`
+        of them kept, `emitted` tokens emitted in total (accepted + the
+        correction/bonus tokens), `rolled_back` rejected-draft tokens
+        truncated out of the paged cache, over `rows` verified
+        sequences (emitting rows — quarantined rows excluded), so the
+        tokens-per-step multiplier normalizes per SEQUENCE, not per
+        launch (a full batch would otherwise look like speculation)."""
+        self.counters["spec_steps"] += 1
+        self.counters["spec_verified_rows"] += rows
+        self.counters["spec_drafted_tokens"] += drafted
+        self.counters["spec_accepted_tokens"] += accepted
+        self.counters["spec_emitted_tokens"] += emitted
+        self.counters["spec_rollback_tokens"] += rolled_back
+        self._accepted_samples.append(accepted)
+
+    def on_spec_draft_oom(self, dropped: int):
+        self.counters["spec_draft_oom_drops"] += dropped
+
     def on_step(self):
         self.counters["engine_steps"] += 1
 
@@ -181,14 +241,32 @@ class ServingMetrics:
             return None
         return self.counters["prefix_hits"] / self.counters["admissions"]
 
+    def spec_acceptance_rate(self) -> Optional[float]:
+        """accepted / drafted over the engine's life (None before any
+        draft was scored)."""
+        if not self.counters["spec_drafted_tokens"]:
+            return None
+        return (self.counters["spec_accepted_tokens"]
+                / self.counters["spec_drafted_tokens"])
+
+    def spec_tokens_per_step(self) -> Optional[float]:
+        """Mean tokens emitted per SEQUENCE per verify launch — the
+        spec-decode throughput multiplier (1.0 = speculation never
+        paid; the paged-attention launch amortizes over this many
+        tokens per sequence)."""
+        if not self.counters["spec_verified_rows"]:
+            return None
+        return (self.counters["spec_emitted_tokens"]
+                / self.counters["spec_verified_rows"])
+
     def ttft_percentiles(self):
-        """{p50, p90, p99} seconds over the bounded TTFT window."""
-        return {f"p{q}": _percentile(self._ttft_samples, q)
-                for q in (50, 90, 99)}
+        """{p50, p90, p99} seconds over the bounded TTFT window —
+        a view over the registered reservoir, so this method and
+        snapshot() can never disagree."""
+        return self.reservoir_percentiles("ttft")
 
     def queue_wait_percentiles(self):
-        return {f"p{q}": _percentile(self._queue_wait_samples, q)
-                for q in (50, 90, 99)}
+        return self.reservoir_percentiles("queue_wait")
 
     def snapshot(self) -> dict:
         snap = dict(self.counters)
@@ -204,15 +282,26 @@ class ServingMetrics:
         hr = self.prefix_hit_rate()
         if hr is not None:
             snap["prefix_hit_rate"] = round(hr, 4)
+        ar = self.spec_acceptance_rate()
+        if ar is not None:
+            snap["spec_acceptance_rate"] = round(ar, 4)
+        tps = self.spec_tokens_per_step()
+        if tps is not None:
+            snap["spec_tokens_per_step"] = round(tps, 4)
         ttft = self.mean_ttft()
         if ttft is not None:
             snap["mean_ttft_ms"] = round(ttft * 1e3, 3)
-        for label, pct in (("ttft", self.ttft_percentiles()),
-                           ("queue_wait", self.queue_wait_percentiles())):
-            for q, v in pct.items():
+        # every registered reservoir surfaces its percentiles here — no
+        # hand-maintained key list to drift from the registry
+        for name, (scale, suffix, digits) in self._reservoir_fmt.items():
+            for q, v in self.reservoir_percentiles(name).items():
                 if v is not None:
-                    snap[f"{label}_{q}_ms"] = round(v * 1e3, 3)
+                    snap[f"{name}_{q}{suffix}"] = round(v * scale, digits)
         return snap
+
+    # the reference's Metric objects expose `summary()`; ours is the
+    # same auto-exposing view (counters dict + registered reservoirs)
+    summary = snapshot
 
     # ---- profiler integration -------------------------------------------
     def register(self):

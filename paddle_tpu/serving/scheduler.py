@@ -195,6 +195,13 @@ class Scheduler:
         # admitted once; shedding it would drop accepted work).
         self.max_queue_len = (None if max_queue_len is None
                               else int(max_queue_len))
+        # per-step token cost of one decoding request. Plain decode = 1;
+        # the spec-decode engine sets 1 + spec_k so the verify tokens
+        # (draft positions scored per sequence per step) are charged
+        # against the same budget prefill chunks draw from — otherwise
+        # speculative steps would silently blow the TTFT-vs-throughput
+        # contract the budget exists to enforce.
+        self.decode_token_cost = 1
         self.waiting: deque = deque()
         self.prefilling: List[Request] = []   # admitted, chunks pending
         self.running: List[Request] = []      # decoding, arrival order
@@ -320,7 +327,7 @@ class Scheduler:
                     if victim is req:
                         break
         decodes = [r for r in survivors if r in self.running]
-        budget = self.token_budget - len(decodes)
+        budget = self.token_budget - len(decodes) * self.decode_token_cost
 
         # 2. continue in-flight prefills FCFS: each gets at most one
         #    chunk per step, sized to the remaining budget.

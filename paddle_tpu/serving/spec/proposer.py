@@ -1,0 +1,97 @@
+"""Draft-token proposers for speculative decoding.
+
+A Proposer suggests up to K continuation tokens per decoding request
+each engine step; the engine's verify program scores them all in one
+launch and keeps the longest accepted prefix. Proposals are PURELY
+ADVISORY: a proposer can return fewer than K tokens (or none — the
+step then degrades to a plain one-token verify, which emits exactly
+what a decode step would), and nothing a proposer returns can change
+the emitted token stream under greedy acceptance — only how many
+launches it takes to produce it. That contract is what makes the
+draft-mismatch chaos storm in `tools/soak_serving.py` a safe no-op on
+outputs and lets `DraftModelProposer` draft greedily even when the
+target samples.
+
+Proposers see the engine's host-side request state only (token
+histories); KV-owning proposers (the draft model) manage their own
+pool and are told about terminal requests via `on_finished` so their
+pages reclaim.
+"""
+from __future__ import annotations
+
+from typing import List
+
+__all__ = ["Proposer", "NgramProposer"]
+
+
+class Proposer:
+    """Interface: `propose(reqs, k)` returns one draft list (<= k
+    tokens, possibly empty) per request, aligned with `reqs`."""
+
+    def propose(self, reqs, k: int) -> List[List[int]]:
+        raise NotImplementedError
+
+    def on_finished(self, req):
+        """Request reached a terminal state (finished, aborted,
+        expired, quarantined): release any per-request state."""
+
+    def reset(self):
+        """Drop all per-request state (engine drain/teardown)."""
+
+
+class NgramProposer(Proposer):
+    """Prompt-lookup drafting (Saxena's prompt-lookup decoding / vLLM's
+    ngram speculator): the draft for a request is read out of the
+    request's OWN token history — find the most recent earlier
+    occurrence of the current suffix n-gram and propose the tokens that
+    followed it. Zero extra weights, pure host logic, fully
+    CPU-testable; it shines exactly where decode throughput hurts most
+    (summarization, code editing, RAG — outputs that re-walk their
+    inputs).
+
+    `max_ngram`/`min_ngram` bound the suffix lengths tried (longest
+    first — a longer match is a stronger predictor). Among matches of
+    the chosen n-gram the scan runs most-recent-first and stops at the
+    first one whose continuation fills all k draft slots; when every
+    continuation is cut short by the history end (a suffix-overlapping
+    cycle like "a b a b a▸"), the longest one wins — recency breaks
+    ties. The scan is O(history) per request per step, noise beside a
+    compiled model launch.
+    """
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1):
+        if not 1 <= int(min_ngram) <= int(max_ngram):
+            raise ValueError(
+                f"need 1 <= min_ngram <= max_ngram, got "
+                f"{min_ngram}/{max_ngram}")
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+
+    def propose_for(self, tokens, k: int) -> List[int]:
+        """Draft up to `k` tokens continuing `tokens` by suffix lookup.
+        Returns [] when no suffix n-gram recurs earlier in the history."""
+        tokens = list(tokens)
+        n_hist = len(tokens)
+        if k <= 0 or n_hist < self.min_ngram + 1:
+            return []
+        for n in range(min(self.max_ngram, n_hist - 1),
+                       self.min_ngram - 1, -1):
+            suffix = tokens[n_hist - n:]
+            best: List[int] = []
+            # scan right-to-left: most recent prior occurrence first.
+            # cont begins AFTER the matched n-gram and may extend into
+            # the suffix region itself — exactly the self-repetition
+            # case ngram drafting exploits ("a b a b a b ..." cycles)
+            for start in range(n_hist - n - 1, -1, -1):
+                if tokens[start:start + n] == suffix:
+                    cont = tokens[start + n:start + n + k]
+                    if len(cont) == k:
+                        return [int(t) for t in cont]
+                    if len(cont) > len(best):
+                        best = cont
+            if best:
+                return [int(t) for t in best]
+        return []
+
+    def propose(self, reqs, k: int) -> List[List[int]]:
+        return [self.propose_for(r.resume_ids, k) for r in reqs]

@@ -587,3 +587,81 @@ def test_threaded_executor_emits_profiler_spans():
            if e["name"].startswith("pipe/")]
     assert len(evs) == 16          # 2 ranks x (4 F + 4 B)
     assert any(e["name"] == "pipe/F0@s0" for e in evs)
+
+
+# --------------------------------------------------- ZB dispatch-tax model
+def _bench_pipeline_zb_rows():
+    """Parse the ZB-H1 table of BENCH_PIPELINE.md: rows of
+    (pp, micro, wall_1f1b, wall_zb, t_f, t_b, t_w, sim_1f1b, sim_zb)."""
+    import os
+    import re
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_PIPELINE.md")
+    rows = []
+    with open(path) as f:
+        for line in f:
+            m = re.match(
+                r"\|\s*(\d+)\s*\|\s*(\d+)\s*\|\s*([\d.]+)\s*\|\s*([\d.]+)"
+                r"\s*\|\s*([\d.]+)/([\d.]+)/([\d.]+)\s*\|\s*([\d.]+)\s*"
+                r"\|\s*([\d.]+)\s*\|", line)
+            if m:
+                rows.append(tuple(
+                    int(g) if i < 2 else float(g)
+                    for i, g in enumerate(m.groups())))
+    return rows
+
+
+def test_zb_dispatch_tax_model_validates_measured_rows():
+    """VERDICT r5 #6 (carried twice): the explicit per-job win/lose
+    model — overhead x extra W dispatches vs bubble saved — checked
+    against EVERY measured ZB-H1 row in BENCH_PIPELINE.md. Two claims:
+    (a) fed each row's measured t_f/t_b/t_w, the model's ZB makespan
+    reproduces the committed sim(measured t) ZB column within 1%
+    (the 1F1B sim column used the 1F1B run's OWN fused-backward
+    durations, which the split-run t's cannot reconstruct — see the
+    BENCH_PIPELINE note); (b) at a dispatch overhead calibrated from
+    the table's own ~10%-split-tax observation, the model's verdicts
+    reproduce both measured pp=2 WALL outcomes — (2,4) ZB wins,
+    (2,8) ZB loses — which the tax-free simulator gets wrong."""
+    from paddle_tpu.distributed.fleet_executor import (
+        choose_pipeline_schedule, zb_dispatch_tax_model)
+    rows = _bench_pipeline_zb_rows()
+    assert len(rows) == 4, "BENCH_PIPELINE.md ZB-H1 table drifted"
+    for pp, mi, w1, wz, tf, tb, tw, s1, sz in rows:
+        m = zb_dispatch_tax_model(pp, mi, tf, tb, tw)
+        assert abs(m["predicted_zb"] - sz) / sz < 0.01, \
+            (pp, mi, m["predicted_zb"], sz)
+        assert m["extra_w_dispatches"] == pp * mi
+        # the two terms are real numbers; at overhead 0 there is no tax
+        assert m["dispatch_tax"] == 0.0
+
+    # (b) wall-verdict reproduction at a calibrated per-dispatch
+    # overhead. BENCH_PIPELINE: the two-dispatch split costs ~10% of a
+    # fused backward on this host -> h ~ 0.1 * (t_b + t_w) ~ 9 ms for
+    # the pp=2 rows. The pp=4 walls on a 1-core host are not schedule-
+    # discriminating (both schedules serialize to total work there).
+    h = 9.0
+    for pp, mi, w1, wz, tf, tb, tw, s1, sz in rows:
+        if pp != 2:
+            continue
+        measured = "ZB-H1" if wz < w1 else "1F1B"
+        m = zb_dispatch_tax_model(pp, mi, tf, tb, tw, overhead=h)
+        assert m["verdict"] == measured, (pp, mi, m, measured)
+        assert m["dispatch_tax"] > 0.0
+        assert choose_pipeline_schedule(pp, mi, tf, tb, tw,
+                                        overhead=h) == measured
+        # ... and the tax-free model misses the (2,8) loss
+        if measured == "1F1B":
+            assert zb_dispatch_tax_model(
+                pp, mi, tf, tb, tw)["verdict"] == "ZB-H1"
+
+
+def test_zb_dispatch_tax_model_limits():
+    """Model sanity at the extremes: zero overhead with deferrable W
+    favors ZB (the textbook case); overhead dwarfing the job times
+    favors 1F1B (every extra dispatch is pure loss)."""
+    from paddle_tpu.distributed.fleet_executor import (
+        choose_pipeline_schedule)
+    assert choose_pipeline_schedule(4, 8, 1.0, 1.0, 1.0) == "ZB-H1"
+    assert choose_pipeline_schedule(4, 8, 1.0, 1.0, 1.0,
+                                    overhead=5.0) == "1F1B"

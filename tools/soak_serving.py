@@ -1,10 +1,8 @@
-"""Randomized fault-injection soak for the serving engine (ISSUE 3).
+"""Randomized fault-injection soak for the serving engine (ISSUE 3 + 5).
 
-Runs the SAME seeded mixed workload twice on CPU — once clean, once
-with every registered fault point armed (allocator OOM, transient
-step exceptions on prefill and decode, NaN logits, deadline storms,
-radix donation failures) plus seeded client aborts — and asserts the
-resilience acceptance criteria:
+Runs the SAME seeded mixed workload four times on CPU — plain-decode
+clean and chaos, then SPECULATIVE-decode (NgramProposer, K=4) clean and
+chaos — and asserts the resilience acceptance criteria on each pair:
 
 * zero engine crashes (injected transients can never exhaust the retry
   budget by construction: times <= max_retries);
@@ -12,8 +10,15 @@ resilience acceptance criteria:
   drain;
 * greedy outputs of UNAFFECTED requests bit-identical to the clean run
   (affected = quarantined / expired / aborted / shed);
-* every armed fault point actually fired (a soak that injected nothing
-  proves nothing).
+* every fault point ARMED IN THAT PASS actually fired (a soak that
+  injected nothing proves nothing);
+* spec-decode extras (ISSUE 5): the spec-clean pass emits streams
+  bit-identical to the plain clean pass (speculation only changes how
+  many launches, never which tokens) with acceptance > 0, and the
+  spec-chaos pass layers a draft-mismatch STORM (garbage drafts — all
+  rejected, output-invariant by the acceptance rule), injected
+  rollback-OOM during draft extension, and NaN in verify logits on top
+  of the ISSUE-3 chaos.
 
 Deterministic end to end: workload, fault schedule, aborts and the
 deadline clock all derive from --seed; wall-clock never enters the
@@ -22,8 +27,9 @@ drain guard plus a hard step ceiling.
 
 Usage:  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
             python tools/soak_serving.py [--requests 200] [--seed 0]
-(or `make soak`). Exits 0 on success, 1 with a report on violation —
-this is a test harness, not bench.py; it is allowed to fail loudly.
+(or `make soak`; --no-spec skips the two spec passes). Exits 0 on
+success, 1 with a report on violation — this is a test harness, not
+bench.py; it is allowed to fail loudly.
 """
 from __future__ import annotations
 
@@ -48,15 +54,18 @@ import paddle_tpu as paddle                                  # noqa: E402
 from paddle_tpu.models.llama import (LlamaConfig,            # noqa: E402
                                      LlamaForCausalLM)
 from paddle_tpu.serving import (EngineOverloaded,            # noqa: E402
-                                RetryPolicy, ServingEngine,
-                                TransientDeviceError)
+                                NgramProposer, RetryPolicy,
+                                ServingEngine, TransientDeviceError)
 from paddle_tpu.utils import faults                          # noqa: E402
 
 # single-bucket grid: every run hits identical program shapes, so the
-# bit-identity comparison is exact (SERVING.md determinism contract)
+# bit-identity comparison is exact (SERVING.md determinism contract).
+# The spec passes pin a single K bucket too — a chaos-perturbed draft
+# length then changes dl DATA, never the verify program shape.
 ENGINE_KW = dict(num_pages=40, page_size=8, token_budget=48,
                  batch_buckets=[8], prefill_buckets=[32], pages_buckets=[8],
                  temperature=0.0, max_queue_len=32)
+SPEC_KW = dict(spec_k=4, spec_buckets=[4])
 TTL_S = 1000.0          # generous; only storm skew can expire anything
 ABORT_FRACTION = 0.04
 MAX_STEPS_FACTOR = 400  # hard ceiling: steps <= factor * num_requests
@@ -80,65 +89,95 @@ def make_workload(n, seed):
     shared = rng.randint(0, 128, (16,)).tolist()    # 2 full pages
     work = []
     for i in range(n):
-        if rng.random() < 0.3:                      # radix exercise
+        u = rng.random()
+        if u < 0.3:                                 # radix exercise
             p = shared + rng.randint(0, 128, (rng.randint(2, 8),)).tolist()
+        elif u < 0.55:                              # ngram exercise:
+            cyc = rng.randint(0, 128, (rng.randint(2, 4),)).tolist()
+            p = (cyc * 10)[:rng.randint(8, 24)]     # repetitive prompt
         else:
             p = rng.randint(0, 128, (rng.randint(4, 24),)).tolist()
         work.append((p, int(rng.randint(3, 10))))
     return work
 
 
-def run_workload(model, work, *, chaos, seed, report):
+def run_workload(model, work, *, chaos, seed, report, spec=False):
     """One full soak pass; returns ({idx: tokens}, affected_idx_set)."""
     rng = np.random.RandomState(seed + 1)
     abort_at = {i for i in range(len(work))
                 if rng.random() < ABORT_FRACTION} if chaos else set()
 
+    kw = dict(ENGINE_KW)
+    if spec:
+        kw.update(SPEC_KW, proposer=NgramProposer())
     eng = ServingEngine(
         model, clock=FakeClock(), default_ttl_s=TTL_S,
         retry_policy=RetryPolicy(max_retries=12, base_s=0.0,
                                  sleep=lambda s: None),
-        **ENGINE_KW)
+        **kw)
+    armed = set()
+
+    def arm(name, **kwargs):
+        faults.inject(name, **kwargs)
+        armed.add(name)
+
+    if chaos and spec:
+        # ISSUE 5 chaos: draft-mismatch storm (garbage drafts — the
+        # acceptance rule makes them output-invariant), rollback-OOM
+        # during draft extension (the alloc point fires inside
+        # append_token there too), NaN in verify logits, transient
+        # verify-step exceptions. decode_step is NOT armed: the spec
+        # engine replaces the decode launch with verify.
+        arm("serving.spec.draft_storm", payload=True, after=2, times=2)
+        arm("serving.spec.draft_storm", payload=True, prob=0.05,
+            times=10, seed=seed + 9)
+        arm("serving.engine.verify_step",
+            exc=TransientDeviceError("soak: UNAVAILABLE"),
+            after=4, times=1)
+        arm("serving.engine.verify_step",
+            exc=TransientDeviceError("soak: relay loss"),
+            prob=0.03, times=9, seed=seed + 10)
     if chaos:
         # Every point gets one DETERMINISTIC early spec (the "every
-        # registered point fired" assertion must not ride on a seeded
-        # coin) plus a seeded probabilistic spec for spread. Transient
-        # totals stay < max_retries(12), so retry exhaustion (and thus
+        # armed point fired" assertion must not ride on a seeded coin)
+        # plus a seeded probabilistic spec for spread. Transient totals
+        # stay < max_retries(12), so retry exhaustion (and thus
         # EngineFailure) is impossible by construction.
-        faults.inject("serving.engine.prefill_chunk",
-                      exc=TransientDeviceError("soak: UNAVAILABLE"),
-                      after=3, times=1)
-        faults.inject("serving.engine.prefill_chunk",
-                      exc=TransientDeviceError("soak: UNAVAILABLE"),
-                      prob=0.03, times=9, seed=seed + 2)
-        faults.inject("serving.engine.decode_step",
-                      exc=TransientDeviceError("soak: relay loss"),
-                      after=4, times=1)
-        faults.inject("serving.engine.decode_step",
-                      exc=TransientDeviceError("soak: relay loss"),
-                      prob=0.03, times=9, seed=seed + 3)
-        faults.inject("serving.kv.alloc_page", payload=True,
-                      after=5, times=2)
-        faults.inject("serving.kv.alloc_page", payload=True,
-                      prob=0.05, times=20, seed=seed + 4)
+        arm("serving.engine.prefill_chunk",
+            exc=TransientDeviceError("soak: UNAVAILABLE"),
+            after=3, times=1)
+        arm("serving.engine.prefill_chunk",
+            exc=TransientDeviceError("soak: UNAVAILABLE"),
+            prob=0.03, times=9, seed=seed + 2)
+        if not spec:
+            arm("serving.engine.decode_step",
+                exc=TransientDeviceError("soak: relay loss"),
+                after=4, times=1)
+            arm("serving.engine.decode_step",
+                exc=TransientDeviceError("soak: relay loss"),
+                prob=0.03, times=9, seed=seed + 3)
+        arm("serving.kv.alloc_page", payload=True,
+            after=5, times=2)
+        arm("serving.kv.alloc_page", payload=True,
+            prob=0.05, times=20, seed=seed + 4)
         nan_rng = np.random.RandomState(seed + 5)
-        faults.inject("serving.engine.nan_logits",
-                      payload=lambda reqs: [nan_rng.randint(len(reqs))],
-                      after=6, times=1)
-        faults.inject("serving.engine.nan_logits",
-                      payload=lambda reqs: [nan_rng.randint(len(reqs))],
-                      prob=0.02, times=3, seed=seed + 6)
+        arm("serving.engine.nan_logits",
+            payload=lambda reqs: [nan_rng.randint(len(reqs))],
+            after=6, times=1)
+        arm("serving.engine.nan_logits",
+            payload=lambda reqs: [nan_rng.randint(len(reqs))],
+            prob=0.02, times=3, seed=seed + 6)
         # the storm fires at boundary hits 11-12, whose combined 1200 s
         # of skew blows every pre-storm deadline (TTL 1000) — a burst
         # expiry wave mid-traffic
-        faults.inject("serving.engine.deadline_storm", payload=600.0,
-                      after=10, times=2)
-        faults.inject("serving.radix.insert",
-                      exc=RuntimeError("soak: donation failed"),
-                      after=2, times=1)
-        faults.inject("serving.radix.insert",
-                      exc=RuntimeError("soak: donation failed"),
-                      prob=0.05, times=7, seed=seed + 8)
+        arm("serving.engine.deadline_storm", payload=600.0,
+            after=10, times=2)
+        arm("serving.radix.insert",
+            exc=RuntimeError("soak: donation failed"),
+            after=2, times=1)
+        arm("serving.radix.insert",
+            exc=RuntimeError("soak: donation failed"),
+            prob=0.05, times=7, seed=seed + 8)
 
     idx_of = {}
     pending = list(enumerate(work))
@@ -190,25 +229,34 @@ def run_workload(model, work, *, chaos, seed, report):
         eng.allocator.check_invariants()
 
         snap = eng.metrics.snapshot()
-        report.update({
-            ("chaos" if chaos else "clean"): {
-                "steps": steps, "sheds": sheds,
-                "finish_reasons": reasons,
-                "affected": len(affected),
-                "preemptions": snap["requests_preempted"],
-                "step_retries": snap["step_retries"],
-                "quarantined": snap["requests_quarantined"],
-                "expired": snap["deadline_expired"],
-                "aborted": snap["requests_aborted"],
-                "prefix_hits": snap["prefix_hits"],
-            }})
+        label = ("spec_" if spec else "") + ("chaos" if chaos else "clean")
+        rep = {
+            "steps": steps, "sheds": sheds,
+            "finish_reasons": reasons,
+            "affected": len(affected),
+            "preemptions": snap["requests_preempted"],
+            "step_retries": snap["step_retries"],
+            "quarantined": snap["requests_quarantined"],
+            "expired": snap["deadline_expired"],
+            "aborted": snap["requests_aborted"],
+            "prefix_hits": snap["prefix_hits"],
+        }
+        if spec:
+            rep.update({
+                "spec_steps": snap["spec_steps"],
+                "spec_drafted": snap["spec_drafted_tokens"],
+                "spec_accepted": snap["spec_accepted_tokens"],
+                "spec_rollback": snap["spec_rollback_tokens"],
+                "spec_oom_drops": snap["spec_draft_oom_drops"],
+                "spec_tokens_per_step": snap.get("spec_tokens_per_step"),
+            })
+        report[label] = rep
         if chaos:
             fired = faults.fired_counts()
-            report["fired"] = fired
-            for pt in faults.points():
-                if pt.startswith("serving."):
-                    assert fired.get(pt, 0) >= 1, \
-                        f"armed fault point {pt} never fired"
+            report[f"fired_{label}"] = fired
+            for pt in sorted(armed):
+                assert fired.get(pt, 0) >= 1, \
+                    f"armed fault point {pt} never fired"
         return out, affected
     finally:
         faults.clear()
@@ -220,6 +268,8 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--requests", type=int, default=200)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--no-spec", action="store_true",
+                    help="skip the two speculative-decoding passes")
     args = ap.parse_args(argv)
 
     cfg = LlamaConfig(vocab_size=128, hidden_size=128,
@@ -245,8 +295,39 @@ def main(argv=None):
     # the chaos run must actually have exercised the failure paths
     ch = report["chaos"]
     assert ch["step_retries"] >= 1 and ch["quarantined"] >= 1, ch
-    report["wall_s"] = round(time.perf_counter() - t0, 2)
     report["unaffected_bit_identical"] = args.requests - len(affected)
+
+    if not args.no_spec:
+        # ---- speculative-decoding passes (ISSUE 5) -------------------
+        spec_clean, _ = run_workload(model, work, chaos=False,
+                                     seed=args.seed, report=report,
+                                     spec=True)
+        # speculation must not change ANY greedy token vs plain decode
+        # (same workload, same clock, no faults in either pass)
+        spec_div = [i for i in range(len(work))
+                    if spec_clean.get(i) != clean.get(i)]
+        assert not spec_div, \
+            f"spec decode changed greedy tokens: {spec_div[:10]}"
+        sc = report["spec_clean"]
+        assert sc["spec_accepted"] > 0 and sc["spec_steps"] > 0, sc
+        # ... and fewer decode-side launches did the same work
+        assert sc["spec_tokens_per_step"] > 1.0, sc
+
+        spec_chaos, spec_aff = run_workload(model, work, chaos=True,
+                                            seed=args.seed,
+                                            report=report, spec=True)
+        spec_div = [i for i in range(len(work))
+                    if i not in spec_aff
+                    and spec_chaos.get(i) != spec_clean.get(i)]
+        assert not spec_div, ("unaffected requests diverged under spec "
+                              f"chaos: {spec_div[:10]}")
+        sx = report["spec_chaos"]
+        assert sx["step_retries"] >= 1 and sx["quarantined"] >= 1, sx
+        assert sx["spec_rollback"] >= 1, sx
+        report["spec_unaffected_bit_identical"] = \
+            args.requests - len(spec_aff)
+
+    report["wall_s"] = round(time.perf_counter() - t0, 2)
     print(json.dumps(report))
     print("SOAK_SERVING_OK")
     return 0
