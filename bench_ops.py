@@ -283,10 +283,18 @@ def bench_fusion_pack(dev, quick):
 
 
 def bench_paged_decode(dev, quick):
+    """bf16 vs int8 KV pages (ISSUE 6): the decode kernel is
+    bandwidth-bound at the HBM roofline, so bytes/token IS tokens/s at
+    fixed HBM. Each page size gets a bf16 row, an int8 row (quantized
+    caches + per-slot scale pages, dequantize-in-kernel), a static
+    `int8_kv_bytes_ratio` decision row (bf16/int8 bytes per token —
+    the >= ~1.7x acceptance number; < 2.0 exactly because the fp32
+    scales ride along), and a measured `int8_decode_speedup_pct` row."""
     import jax
     import jax.numpy as jnp
     from paddle_tpu.kernels.paged_attention import (
-        alloc_paged_cache, paged_attention_decode)
+        alloc_paged_cache, paged_attention_decode, paged_page_bytes,
+        quantize_kv)
 
     if dev == "cpu":
         B, KVH, H, D = 2, 2, 4, 64
@@ -310,11 +318,39 @@ def bench_paged_decode(dev, quick):
         q = jnp.asarray(rng.randn(B, H, D), jnp.bfloat16)
         fn = jax.jit(lambda q, kc, vc, bt=bt, sl=sl: paged_attention_decode(
             q, kc, vc, bt, sl))
-        dt = _time_stats(fn, q, k_cache, v_cache)
-        kv_bytes = 2 * B * S * KVH * D * 2  # K and V, bf16
+        dt_bf = _time_stats(fn, q, k_cache, v_cache)
+        # bytes via the capacity math's single source (page_size=1 ==
+        # per-token bytes), so the bench can never drift from the
+        # engine's accounting if the scale layout changes
+        kv_bytes = B * S * paged_page_bytes(KVH, 1, D)        # bf16 K+V
         _record("paged_decode", f"pallas_page{page}",
-                f"b{B}s{S}kvh{KVH}h{H}d{D}", dt,
+                f"b{B}s{S}kvh{KVH}h{H}d{D}", dt_bf,
                 bytes_moved=kv_bytes, device_kind=dev)
+
+        # int8 image of the SAME cache contents (per-slot quantization)
+        kq, ks = quantize_kv(k_cache)
+        vq, vs = quantize_kv(v_cache)
+        fn_q = jax.jit(
+            lambda q, kc, vc, kss, vss, bt=bt, sl=sl:
+            paged_attention_decode(q, kc, vc, bt, sl,
+                                   k_scale=kss, v_scale=vss))
+        dt_i8 = _time_stats(fn_q, q, kq, vq, ks, vs)
+        kv_bytes_i8 = B * S * paged_page_bytes(KVH, 1, D, "int8")
+        _record("paged_decode", f"pallas_int8_page{page}",
+                f"b{B}s{S}kvh{KVH}h{H}d{D}", dt_i8,
+                bytes_moved=kv_bytes_i8, device_kind=dev)
+        RESULTS.append({
+            "bench": "paged_decode",
+            "variant": f"int8_kv_bytes_ratio_page{page}",
+            "value": round(kv_bytes / kv_bytes_i8, 3),
+            "device": dev})
+        dt_bf, dt_i8 = dt_bf[0], dt_i8[0]
+        if dt_bf > 0 and dt_i8 > 0:
+            RESULTS.append({
+                "bench": "paged_decode",
+                "variant": f"int8_decode_speedup_pct_page{page}",
+                "value": round(100 * (dt_bf - dt_i8) / dt_bf, 2),
+                "device": dev})
 
 
 def bench_int8_matmul(dev, quick):

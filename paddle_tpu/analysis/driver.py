@@ -7,7 +7,16 @@ Escape-hatch syntax (ANALYSIS.md):
     # tpu-lint: skip-file          skip the whole file
 A hatch comment counts for the line it sits on AND the next line, so it
 can ride above a flagged expression or at the end of it.
-"""
+
+Hint syntax (ISSUE 6 — refines a rule instead of suppressing it):
+    # tpu-lint-hint: key=value[; key=value]
+Hints attach to their line; rules look them up over a node's whole
+source span (`FileContext.hint_for`), so a hint can sit anywhere inside
+a multi-line pallas_call. Current consumer: A3's `vmem-dtypes` — a
+comma list naming each in_spec's TRUE element dtype (int8/int4
+quantized kernels would otherwise be budgeted at the out dtype's
+width, over- or under-estimating the blocks the estimator exists to
+check)."""
 from __future__ import annotations
 
 import ast
@@ -25,22 +34,40 @@ __all__ = ["FileContext", "lint_source", "lint_file", "lint_paths",
            "iter_python_files"]
 
 _HATCH_RE = re.compile(r"#\s*tpu-lint:\s*([A-Za-z0-9_,\- ]+)")
+_HINT_RE = re.compile(r"#\s*tpu-lint-hint:\s*(.+)")
 
 
-def _parse_hatches(source):
-    """line (1-based) -> set of tokens ('ok', '<slug>-ok', 'skip-file').
+def _parse_hint_value(raw):
+    """`key=value[; key=value]` -> {key: value} (empty when malformed)."""
+    kv = {}
+    for part in raw.split(";"):
+        if "=" not in part:
+            continue
+        k, v = part.split("=", 1)
+        if k.strip():
+            kv[k.strip().lower()] = v.strip()
+    return kv
 
-    Hatches are extracted from REAL comment tokens (tokenize), not a
-    substring scan of raw lines: a docstring or test string that merely
-    QUOTES the hatch syntax must not suppress anything — a regex over
-    lines silently skip-file'd any module documenting the syntax. On a
-    tokenize failure the file simply has no hatches (the conservative
-    direction: more findings, never fewer)."""
-    hatches = {}
+
+def _parse_directives(source):
+    """(hatches, hints): line (1-based) -> hatch-token set / hint dict,
+    both from ONE tokenize pass over the file's REAL comment tokens —
+    not a substring scan of raw lines: a docstring or test string that
+    merely QUOTES either syntax must not suppress or hint anything. On
+    a tokenize failure the file simply has no directives (for hatches
+    that is the conservative direction: more findings, never fewer;
+    losing a hint only falls back to the out-dtype estimate)."""
+    hatches, hints = {}, {}
     try:
         for tok in tokenize.generate_tokens(io.StringIO(source).readline):
             if tok.type != tokenize.COMMENT:
                 continue
+            m = _HINT_RE.search(tok.string)
+            if m:
+                kv = _parse_hint_value(m.group(1))
+                if kv:
+                    hints.setdefault(tok.start[0], {}).update(kv)
+                continue    # "tpu-lint-hint:" must not match _HATCH_RE
             m = _HATCH_RE.search(tok.string)
             if m:
                 toks = {t.strip().lower() for t in m.group(1).split(",")
@@ -49,8 +76,8 @@ def _parse_hatches(source):
                     hatches.setdefault(tok.start[0], set()).update(toks)
     except (tokenize.TokenError, IndentationError, SyntaxError,
             ValueError):
-        return {}
-    return hatches
+        return {}, {}
+    return hatches, hints
 
 
 @dataclass
@@ -63,10 +90,22 @@ class FileContext:
     consts: dict = field(default_factory=dict)
     functions: dict = field(default_factory=dict)
     hatches: dict = field(default_factory=dict)
+    hints: dict = field(default_factory=dict)
 
     @property
     def skip_file(self):
         return any("skip-file" in toks for toks in self.hatches.values())
+
+    def hint_for(self, node, key):
+        """The `# tpu-lint-hint: key=...` value attached to any line of
+        `node`'s source span (plus one line above, mirroring the hatch
+        window), or None."""
+        end = getattr(node, "end_lineno", None) or node.lineno
+        for line in range(node.lineno - 1, end + 1):
+            kv = self.hints.get(line)
+            if kv and key in kv:
+                return kv[key]
+        return None
 
     def suppressed(self, diag: Diagnostic):
         for line in (diag.line, diag.line - 1):
@@ -98,11 +137,12 @@ def lint_source(source, path="<string>", rules=None, is_test=None):
                            path=path, line=int(e.lineno or 0),
                            message=f"syntax error: {e.msg}")]
     lines = source.splitlines()
+    hatches, hints = _parse_directives(source)
     ctx = FileContext(
         path=path, source=source, tree=tree, lines=lines, is_test=is_test,
         consts=astutil.module_int_consts(tree),
         functions=astutil.local_functions(tree),
-        hatches=_parse_hatches(source))
+        hatches=hatches, hints=hints)
     if ctx.skip_file:
         return []
     out = []

@@ -140,6 +140,22 @@ def _scratch_blocks(call, ctx):
     return blocks
 
 
+def _in_dtypes(call, ctx, n):
+    """Per-in-spec dtypes from a `# tpu-lint-hint: vmem-dtypes=a,b,...`
+    comment anywhere inside the pallas_call's span — the quantized-
+    kernel refinement (ISSUE 6): int8/int4 weight blocks and fp32
+    scale buffers are budgeted at their TRUE widths instead of the out
+    dtype's. Ignored (conservative out-dtype path) when the list
+    doesn't match the spec count or names an unknown dtype."""
+    hint = getattr(ctx, "hint_for", lambda *_: None)(call, "vmem-dtypes")
+    if not hint:
+        return None
+    names = [t.strip().lower() for t in hint.split(",")]
+    if len(names) != n or not all(t in DTYPE_BYTES for t in names):
+        return None
+    return names
+
+
 @register_rule(
     "A3", ("vmem",), Severity.ERROR,
     "pallas_call block picks must fit the ~16 MB scoped-VMEM budget")
@@ -162,7 +178,9 @@ def check_vmem_budget(ctx):
         if scratch is None:
             continue
         dtype = _out_dtype(call, ctx)
-        fits, est = fits_vmem([(s, dtype) for s in in_shapes],
+        in_dts = _in_dtypes(call, ctx, len(in_shapes)) or \
+            [dtype] * len(in_shapes)
+        fits, est = fits_vmem(list(zip(in_shapes, in_dts)),
                               [(s, dtype) for s in out_shapes],
                               scratch)
         if not fits:

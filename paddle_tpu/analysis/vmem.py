@@ -40,6 +40,10 @@ DTYPE_BYTES = {
     "bfloat16": 2, "float16": 2, "int16": 2, "uint16": 2,
     "int8": 1, "uint8": 1, "bool": 1,
     "float8_e4m3fn": 1, "float8_e5m2": 1,
+    # sub-byte packed dtypes (quantized kernels, ISSUE 6): fractional
+    # widths are fine — _block_bytes rounds the BLOCK total up, which
+    # is what a packed layout actually costs
+    "int4": 0.5, "uint4": 0.5,
 }
 
 
@@ -48,8 +52,8 @@ def _block_bytes(block):
     width = DTYPE_BYTES.get(str(dtype))
     if width is None:
         raise ValueError(f"unknown dtype {dtype!r}")
-    return math.prod(int(d) for d in shape) * width, \
-        math.prod(int(d) for d in shape)
+    elems = math.prod(int(d) for d in shape)
+    return int(math.ceil(elems * width)), elems
 
 
 def estimate_vmem_bytes(in_blocks, out_blocks, scratch=(), depth=2,

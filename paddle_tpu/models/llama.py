@@ -79,6 +79,34 @@ def _rope_cache(head_dim, max_pos, theta, dtype=jnp.float32):
     return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
 
 
+def _split_kv_args(arrs, n_tail):
+    """Unpack a paged-cache apply_op arg list: (k, v[, k_scale,
+    v_scale], *tail) -> (k, v, k_scale|None, v_scale|None, tail). The
+    cache tuple's arity (2 full-width / 4 quantized, ISSUE 6) is the
+    only thing that varies, so every paged write/attend closure shares
+    this one splitter instead of forking per dtype."""
+    kc, vc = arrs[0], arrs[1]
+    scales = arrs[2:len(arrs) - n_tail]
+    ks, vs = scales if scales else (None, None)
+    return kc, vc, ks, vs, arrs[len(arrs) - n_tail:]
+
+
+def _gather_kv(cache, bt, n_kv, hd, b, scale=None, cdt=None):
+    """Gather a block table's pages into the dense (b, S, KVH, D) view
+    the prefill/verify attention consumes; int8 caches dequantize
+    during the gather (values * per-slot scales, cast to the compute
+    dtype). bt is (P,) for the single-sequence prefill path and (B, P)
+    for the batched verify path — the page->token transpose is the
+    same swap either way."""
+    idx = bt.astype(jnp.int32)
+    g = jnp.take(cache, idx, axis=0)
+    if scale is not None:
+        g = g.astype(jnp.float32) * jnp.take(scale, idx, axis=0)[..., None]
+    g = jnp.swapaxes(g, bt.ndim, bt.ndim + 1)   # (..., page, KVH, ...)
+    g = g.reshape(b, -1, n_kv, hd)
+    return g.astype(cdt) if scale is not None else g
+
+
 def apply_rotary(x, cos, sin):
     """x: (B, S, H, D). Rotates pairs (even, odd) — GPT-J/Llama interleaved
     convention. The pairs are addressed by VIEWING D as (D/2, 2) rather
@@ -170,15 +198,39 @@ class LlamaAttention(nn.Layer):
         out = self.o_proj(out)
         return (out, cache) if cache is not None else out
 
-    def forward_paged(self, x, cos_b, sin_b, k_cache, v_cache,
-                      block_tables, seq_lens):
+    def _gathered_dense(self, kv, block_tables, b, cdt):
+        """Dense (b, S, KVH, D) K/V views of a sequence's gathered pages
+        (the prefill/verify read path); quantized caches dequantize
+        during the gather. One implementation for both the (P,)
+        single-sequence and (B, P) batched block tables."""
+        n_kv, hd = self.n_kv, self.head_dim
+        if len(kv) == 4:
+            def _g(cache, scale, bt):
+                return _gather_kv(cache, bt, n_kv, hd, b,
+                                  scale=scale, cdt=cdt)
+            kd = apply_op("paged_gather_dequant", _g, kv[0], kv[2],
+                          block_tables)
+            vd = apply_op("paged_gather_dequant", _g, kv[1], kv[3],
+                          block_tables)
+        else:
+            def _g(cache, bt):
+                return _gather_kv(cache, bt, n_kv, hd, b)
+            kd = apply_op("paged_gather", _g, kv[0], block_tables)
+            vd = apply_op("paged_gather", _g, kv[1], block_tables)
+        return kd, vd
+
+    def forward_paged(self, x, cos_b, sin_b, kv, block_tables, seq_lens):
         """One decode step over the PAGED KV cache (serving engine path).
 
         x (B, 1, hidden); cos_b/sin_b (B, D/2) at each row's position;
-        k/v_cache (num_pages, KVH, page, D); block_tables (B, max_pages);
-        seq_lens (B,) INCLUDING the token being decoded. Writes the
-        current token's K/V at position seq_lens-1, then attends through
-        kernels.paged_attention_decode. Returns (out, k_cache, v_cache).
+        kv = (k_cache, v_cache) with caches (num_pages, KVH, page, D) —
+        or the QUANTIZED 4-tuple (k, v, k_scale, v_scale) with int8
+        value pages and (num_pages, KVH, page) fp32 per-slot scales
+        (ISSUE 6); block_tables (B, max_pages); seq_lens (B,) INCLUDING
+        the token being decoded. Writes the current token's K/V at
+        position seq_lens-1 (quantize-on-write for int8), then attends
+        through kernels.paged_attention_decode (dequantize-in-kernel).
+        Returns (out, kv) with the updated cache tuple.
         """
         from ..kernels.paged_attention import (paged_attention_decode,
                                                paged_cache_write)
@@ -189,24 +241,27 @@ class LlamaAttention(nn.Layer):
         q = apply_op("rope_pos", apply_rotary_positions, q, cos_b, sin_b)
         k = apply_op("rope_pos", apply_rotary_positions, k, cos_b, sin_b)
 
-        def _write(kc, vc, kn, vn, bt, sl):
+        def _write(*arrs):
+            kc, vc, ks, vs, (kn, vn, bt, sl) = _split_kv_args(arrs, 4)
             return paged_cache_write(kc, vc, kn[:, 0], vn[:, 0], bt,
-                                     sl.astype(jnp.int32) - 1)
+                                     sl.astype(jnp.int32) - 1,
+                                     k_scale=ks, v_scale=vs)
 
-        k_cache, v_cache = apply_op("paged_cache_write", _write,
-                                    k_cache, v_cache, k, v,
-                                    block_tables, seq_lens)
+        kv = apply_op("paged_cache_write", _write, *kv, k, v,
+                      block_tables, seq_lens)
 
-        def _attend(qq, kc, vc, bt, sl):
+        def _attend(qq, *arrs):
+            kc, vc, ks, vs, (bt, sl) = _split_kv_args(arrs, 2)
             return paged_attention_decode(
-                qq.reshape(b, self.n_heads, self.head_dim), kc, vc, bt, sl)
+                qq.reshape(b, self.n_heads, self.head_dim), kc, vc,
+                bt, sl, k_scale=ks, v_scale=vs)
 
-        out = apply_op("paged_attention_decode", _attend, q, k_cache,
-                       v_cache, block_tables, seq_lens)
+        out = apply_op("paged_attention_decode", _attend, q, *kv,
+                       block_tables, seq_lens)
         out = M.reshape(out, [b, s, self.n_heads * self.head_dim])
-        return self.o_proj(out), k_cache, v_cache
+        return self.o_proj(out), kv
 
-    def forward_paged_prefill(self, x, cos_c, sin_c, k_cache, v_cache,
+    def forward_paged_prefill(self, x, cos_c, sin_c, kv,
                               block_table, cache_len, chunk_len):
         """One CHUNK of prompt prefill over the paged cache (the chunked
         prefill / prefix-cache serving path).
@@ -215,14 +270,18 @@ class LlamaAttention(nn.Layer):
         cache_len..cache_len+S-1, of which only the first chunk_len are
         live (the rest is bucket padding); cos_c/sin_c (S, D/2) are the
         rope rows already gathered at those absolute positions;
+        kv = (k_cache, v_cache) or the quantized (k, v, k_scale,
+        v_scale) tuple (int8 pages + fp32 per-slot scales, ISSUE 6);
         block_table (P,) is the sequence's page ids (PAD_PAGE-padded).
-        Writes the chunk's roped K/V into the pages at offset cache_len,
-        then attends over the GATHERED dense view of the sequence's
-        pages — the cached prefix [0, cache_len) plus the chunk itself —
-        with a position mask kpos <= cache_len + i. Prefill is
-        compute-bound, so one XLA gather per layer is the right
-        capability-axis cost; a fused chunk-attention Pallas kernel is a
-        perf follow-up (BASELINE). Returns (out, k_cache, v_cache).
+        Writes the chunk's roped K/V into the pages at offset cache_len
+        (quantize-on-write for int8), then attends over the GATHERED
+        dense view of the sequence's pages — the cached prefix
+        [0, cache_len) plus the chunk itself, dequantized during the
+        gather on the int8 path — with a position mask
+        kpos <= cache_len + i. Prefill is compute-bound, so one XLA
+        gather per layer is the right capability-axis cost; a fused
+        chunk-attention Pallas kernel is a perf follow-up (BASELINE).
+        Returns (out, kv).
         """
         from ..kernels.paged_attention import paged_cache_write_range
         b, s, _ = x.shape
@@ -232,21 +291,14 @@ class LlamaAttention(nn.Layer):
         q = apply_op("rope", apply_rotary, q, cos_c, sin_c)
         k = apply_op("rope", apply_rotary, k, cos_c, sin_c)
 
-        def _write(kc, vc, kn, vn, bt, ln, st):
-            return paged_cache_write_range(kc, vc, kn[0], vn[0], bt, ln, st)
+        def _write(*arrs):
+            kc, vc, ks, vs, (kn, vn, bt, ln, st) = _split_kv_args(arrs, 5)
+            return paged_cache_write_range(kc, vc, kn[0], vn[0], bt,
+                                           ln, st, k_scale=ks, v_scale=vs)
 
-        k_cache, v_cache = apply_op("paged_cache_write_range", _write,
-                                    k_cache, v_cache, k, v, block_table,
-                                    chunk_len, cache_len)
-        n_kv, hd = self.n_kv, self.head_dim
-
-        def _gather(cache, bt):
-            g = jnp.take(cache, bt.astype(jnp.int32), axis=0)
-            g = jnp.swapaxes(g, 1, 2)          # (P, page, KVH, D)
-            return g.reshape(1, -1, n_kv, hd)  # (1, P*page, KVH, D)
-
-        kd = apply_op("paged_gather", _gather, k_cache, block_table)
-        vd = apply_op("paged_gather", _gather, v_cache, block_table)
+        kv = apply_op("paged_cache_write_range", _write, *kv, k, v,
+                      block_table, chunk_len, cache_len)
+        kd, vd = self._gathered_dense(kv, block_table, 1, q._data.dtype)
         if self.n_kv != self.n_heads:
             rep = self.n_heads // self.n_kv
             kd = apply_op("repeat_kv",
@@ -263,9 +315,9 @@ class LlamaAttention(nn.Layer):
         mask = apply_op("chunk_mask", _mask, cache_len)
         out = F.scaled_dot_product_attention(q, kd, vd, attn_mask=mask)
         out = M.reshape(out, [b, s, self.n_heads * self.head_dim])
-        return self.o_proj(out), k_cache, v_cache
+        return self.o_proj(out), kv
 
-    def forward_paged_verify(self, x, cos_bs, sin_bs, k_cache, v_cache,
+    def forward_paged_verify(self, x, cos_bs, sin_bs, kv,
                              block_tables, seq_lens, draft_lens):
         """One speculative VERIFY step over the paged cache: each row
         scores 1 + K tokens (the last emitted token plus K draft tokens)
@@ -280,11 +332,14 @@ class LlamaAttention(nn.Layer):
         positions; k/v_cache (num_pages, KVH, page, D); block_tables
         (B, max_pages); seq_lens (B,) counts tokens through the FIRST
         input token (the `forward_paged` convention — its position is
-        seq_lens-1). Writes all live positions' roped K/V via
+        seq_lens-1). kv = (k_cache, v_cache) or the quantized 4-tuple
+        (ISSUE 6). Writes all live positions' roped K/V via
         `paged_cache_write_span` (idempotent for position seq_lens-1,
-        like the decode write), then attends over the gathered dense
-        view of each row's pages under the causal mask
-        kpos <= (seq_lens-1) + j. Returns (out, k_cache, v_cache).
+        like the decode write — quantize-on-write is deterministic, so
+        retries and rollback-rewrites stay bit-identical), then attends
+        over the gathered dense view of each row's pages (dequantized
+        during the gather on the int8 path) under the causal mask
+        kpos <= (seq_lens-1) + j. Returns (out, kv).
         """
         from ..kernels.paged_attention import paged_cache_write_span
         b, s, _ = x.shape
@@ -294,23 +349,17 @@ class LlamaAttention(nn.Layer):
         q = apply_op("rope_span", apply_rotary_spans, q, cos_bs, sin_bs)
         k = apply_op("rope_span", apply_rotary_spans, k, cos_bs, sin_bs)
 
-        def _write(kc, vc, kn, vn, bt, sl, dl):
+        def _write(*arrs):
+            kc, vc, ks, vs, (kn, vn, bt, sl, dl) = _split_kv_args(arrs, 5)
             return paged_cache_write_span(
                 kc, vc, kn, vn, bt,
                 dl.astype(jnp.int32) + 1,            # live span tokens
-                sl.astype(jnp.int32) - 1)            # first token's slot
-        k_cache, v_cache = apply_op("paged_cache_write_span", _write,
-                                    k_cache, v_cache, k, v,
-                                    block_tables, seq_lens, draft_lens)
-        n_kv, hd = self.n_kv, self.head_dim
+                sl.astype(jnp.int32) - 1,            # first token's slot
+                k_scale=ks, v_scale=vs)
 
-        def _gather(cache, bt):
-            g = jnp.take(cache, bt.astype(jnp.int32), axis=0)
-            g = jnp.swapaxes(g, 2, 3)          # (B, P, page, KVH, D)
-            return g.reshape(b, -1, n_kv, hd)  # (B, P*page, KVH, D)
-
-        kd = apply_op("paged_gather", _gather, k_cache, block_tables)
-        vd = apply_op("paged_gather", _gather, v_cache, block_tables)
+        kv = apply_op("paged_cache_write_span", _write, *kv, k, v,
+                      block_tables, seq_lens, draft_lens)
+        kd, vd = self._gathered_dense(kv, block_tables, b, q._data.dtype)
         if self.n_kv != self.n_heads:
             rep = self.n_heads // self.n_kv
             kd = apply_op("repeat_kv",
@@ -332,7 +381,7 @@ class LlamaAttention(nn.Layer):
         mask = apply_op("verify_mask", _mask, seq_lens)
         out = F.scaled_dot_product_attention(q, kd, vd, attn_mask=mask)
         out = M.reshape(out, [b, s, self.n_heads * self.head_dim])
-        return self.o_proj(out), k_cache, v_cache
+        return self.o_proj(out), kv
 
 
 def apply_rotary_spans(x, cos_bs, sin_bs):
@@ -402,34 +451,31 @@ class LlamaDecoderLayer(nn.Layer):
         x = x + self.mlp(self.post_attention_layernorm(x))
         return (x, cache) if cache is not None else x
 
-    def forward_paged(self, x, cos_b, sin_b, k_cache, v_cache,
-                      block_tables, seq_lens):
+    def forward_paged(self, x, cos_b, sin_b, kv, block_tables, seq_lens):
         h = self.input_layernorm(x)
-        attn, k_cache, v_cache = self.self_attn.forward_paged(
-            h, cos_b, sin_b, k_cache, v_cache, block_tables, seq_lens)
+        attn, kv = self.self_attn.forward_paged(
+            h, cos_b, sin_b, kv, block_tables, seq_lens)
         x = x + attn
         x = x + self.mlp(self.post_attention_layernorm(x))
-        return x, k_cache, v_cache
+        return x, kv
 
-    def forward_paged_prefill(self, x, cos_c, sin_c, k_cache, v_cache,
+    def forward_paged_prefill(self, x, cos_c, sin_c, kv,
                               block_table, cache_len, chunk_len):
         h = self.input_layernorm(x)
-        attn, k_cache, v_cache = self.self_attn.forward_paged_prefill(
-            h, cos_c, sin_c, k_cache, v_cache, block_table, cache_len,
-            chunk_len)
+        attn, kv = self.self_attn.forward_paged_prefill(
+            h, cos_c, sin_c, kv, block_table, cache_len, chunk_len)
         x = x + attn
         x = x + self.mlp(self.post_attention_layernorm(x))
-        return x, k_cache, v_cache
+        return x, kv
 
-    def forward_paged_verify(self, x, cos_bs, sin_bs, k_cache, v_cache,
+    def forward_paged_verify(self, x, cos_bs, sin_bs, kv,
                              block_tables, seq_lens, draft_lens):
         h = self.input_layernorm(x)
-        attn, k_cache, v_cache = self.self_attn.forward_paged_verify(
-            h, cos_bs, sin_bs, k_cache, v_cache, block_tables, seq_lens,
-            draft_lens)
+        attn, kv = self.self_attn.forward_paged_verify(
+            h, cos_bs, sin_bs, kv, block_tables, seq_lens, draft_lens)
         x = x + attn
         x = x + self.mlp(self.post_attention_layernorm(x))
-        return x, k_cache, v_cache
+        return x, kv
 
 
 class LlamaModel(nn.Layer):
@@ -479,9 +525,11 @@ class LlamaModel(nn.Layer):
                              seq_lens):
         """One batched decode step over per-layer paged KV caches.
 
-        input_ids (B, 1); paged_caches: list of (k_cache, v_cache) per
-        layer; seq_lens counts the token being decoded (its position is
-        seq_lens-1). Returns (hidden (B, 1, H), new_caches)."""
+        input_ids (B, 1); paged_caches: list of per-layer cache tuples —
+        (k_cache, v_cache), or (k, v, k_scale, v_scale) for int8 KV
+        (ISSUE 6); seq_lens counts the token being decoded (its
+        position is seq_lens-1). Returns (hidden (B, 1, H),
+        new_caches) with the same tuple arity."""
         def _gather_rope(c, sl):
             return jnp.take(c, sl.astype(jnp.int32) - 1, axis=0)
 
@@ -492,10 +540,9 @@ class LlamaModel(nn.Layer):
         x = self.embed_tokens(input_ids)
         new_caches = []
         for i, layer in enumerate(self.layers):
-            kc, vc = paged_caches[i]
-            x, kc, vc = layer.forward_paged(x, cos_b, sin_b, kc, vc,
-                                            block_tables, seq_lens)
-            new_caches.append((kc, vc))
+            x, kv = layer.forward_paged(x, cos_b, sin_b, paged_caches[i],
+                                        block_tables, seq_lens)
+            new_caches.append(kv)
         return self.norm(x), new_caches
 
     def forward_paged_prefill(self, input_ids, paged_caches, block_table,
@@ -523,10 +570,10 @@ class LlamaModel(nn.Layer):
         x = self.embed_tokens(input_ids)
         new_caches = []
         for i, layer in enumerate(self.layers):
-            kc, vc = paged_caches[i]
-            x, kc, vc = layer.forward_paged_prefill(
-                x, cos_c, sin_c, kc, vc, block_table, cache_len, chunk_len)
-            new_caches.append((kc, vc))
+            x, kv = layer.forward_paged_prefill(
+                x, cos_c, sin_c, paged_caches[i], block_table, cache_len,
+                chunk_len)
+            new_caches.append(kv)
         return self.norm(x), new_caches
 
     def forward_paged_verify(self, input_ids, paged_caches, block_tables,
@@ -555,11 +602,10 @@ class LlamaModel(nn.Layer):
         x = self.embed_tokens(input_ids)
         new_caches = []
         for i, layer in enumerate(self.layers):
-            kc, vc = paged_caches[i]
-            x, kc, vc = layer.forward_paged_verify(
-                x, cos_bs, sin_bs, kc, vc, block_tables, seq_lens,
-                draft_lens)
-            new_caches.append((kc, vc))
+            x, kv = layer.forward_paged_verify(
+                x, cos_bs, sin_bs, paged_caches[i], block_tables,
+                seq_lens, draft_lens)
+            new_caches.append(kv)
         return self.norm(x), new_caches
 
 

@@ -25,7 +25,8 @@ from ...core.tensor import Tensor
 from ...ops.dispatch import apply_op
 
 __all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
-           "llm_int8_linear"]
+           "llm_int8_linear", "WeightOnlyLinear", "quantize_for_serving",
+           "SERVING_WQ_TARGETS"]
 
 
 def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
@@ -75,56 +76,15 @@ def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype="float32"):
 
 
 # ------------------------------------------------------ Pallas int8 matmul
-def _wint8_kernel(x_ref, w_ref, s_ref, o_ref, acc_ref, *, nk):
-    """acc[m, n] += x[m, k] @ dequant(w[k, n]); scale applied at flush."""
-    from jax.experimental import pallas as pl
-    ki = pl.program_id(2)
-
-    @pl.when(ki == 0)
-    def _init():
-        acc_ref[...] = jnp.zeros_like(acc_ref)
-
-    x = x_ref[...].astype(jnp.float32)
-    w = w_ref[...].astype(jnp.float32)             # int8 -> f32 in VMEM
-    acc_ref[...] += jax.lax.dot_general(
-        x, w, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-
-    @pl.when(ki == nk - 1)
-    def _flush():
-        o_ref[...] = (acc_ref[...] * s_ref[0][None, :]).astype(o_ref.dtype)
-
-
+# The kernel itself lives in kernels/quant_matmul.py (ISSUE 6): fused
+# dequant-matmul with VMEM-sized blocks picked against the tpu-lint A3
+# estimator, int32 index maps, and a legality-enumerable blockspec set.
+# This module keeps the custom_vjp wrapper (QAT trains THROUGH the
+# quantized forward) and the Tensor-level weight_only_linear API.
 def _wint8_matmul_pallas(x2d, qw, scale):
     """x2d (M, K) float; qw (K, N) int8; scale (N,) -> (M, N)."""
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-    from ...jax_compat import patch_pltpu
-    from ...kernels.flash_attention import _interpret_mode
-
-    patch_pltpu()
-
-    M, K = x2d.shape
-    N = qw.shape[1]
-    bm = M if M <= 256 else (256 if M % 256 == 0 else M)
-    bk = K if K <= 512 else (512 if K % 512 == 0 else K)
-    bn = N if N <= 512 else (512 if N % 512 == 0 else N)
-    nk = K // bk
-    grid = (M // bm, N // bn, nk)
-    return pl.pallas_call(
-        functools.partial(_wint8_kernel, nk=nk),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
-            pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)),
-            pl.BlockSpec((1, bn), lambda i, j, k: (np.int32(0), j)),
-        ],
-        out_specs=pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)),
-        out_shape=jax.ShapeDtypeStruct((M, N), x2d.dtype),
-        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
-        interpret=_interpret_mode(),
-    )(x2d, qw, scale[None, :])
+    from ...kernels.quant_matmul import quant_matmul
+    return quant_matmul(x2d, qw, scale)
 
 
 @jax.custom_vjp
@@ -151,18 +111,14 @@ _wint8_mm.defvjp(_wint8_mm_fwd, _wint8_mm_bwd)
 
 
 def _wint8_supported(M, K, N):
-    """Shapes whose block tiling stays VMEM-sized: every dim either fits
-    one bounded block or divides the target block exactly (a degenerate
-    whole-array block on a large unaligned dim would blow VMEM)."""
-    if K % 8 != 0 or N % 128 != 0 or M % 8 != 0:
+    """Shapes with a VMEM-legal Pallas tiling (kernels/quant_matmul's
+    estimator-driven pick); everything else takes the XLA composition.
+    K/N still need basic lane/sublane alignment even for whole-dim
+    blocks — the weight block's trailing dims are (K, N) then."""
+    from ...kernels.quant_matmul import quant_matmul_supported
+    if K % 8 != 0 or N % 128 != 0:
         return False
-    if M > 256 and M % 256 != 0:
-        return False
-    if K > 512 and K % 512 != 0:
-        return False
-    if N > 512 and N % 512 != 0:
-        return False
-    return True
+    return quant_matmul_supported(M, K, N)
 
 
 def weight_only_linear(x, weight, bias=None, weight_scale=None,
@@ -202,6 +158,96 @@ def llm_int8_linear(x, weight, bias=None, weight_scale=None, threshold=6.0):
     outlier split is a no-op on TPU where fp accumulate is used anyway).
     Parity: quantized_linear.py:276."""
     return weight_only_linear(x, weight, bias, weight_scale, "int8")
+
+
+# --------------------------------------------- serving weight conversion
+class WeightOnlyLinear:
+    """Inference linear with int8/int4 HBM-resident weights: qweight +
+    per-out-channel scale as PERSISTABLE BUFFERS (they must ride
+    state_dict so the serving engine's functional_call programs rebind
+    them), forward through `weight_only_linear` (the Pallas fused
+    dequant-matmul when the tiling is legal, XLA composition
+    otherwise). Built lazily as a real nn.Layer subclass (import-cycle:
+    nn.Layer imports are deferred exactly like QuantedLinear's)."""
+
+    def __new__(cls, *args, **kwargs):
+        return _weight_only_linear_cls()(*args, **kwargs)
+
+
+_WOL_CLS = None
+
+
+def _weight_only_linear_cls():
+    global _WOL_CLS
+    if _WOL_CLS is not None:
+        return _WOL_CLS
+    from ..layer.layers import Layer
+
+    class _WeightOnlyLinear(Layer):
+        def __init__(self, weight, bias=None, algo="weight_only_int8"):
+            super().__init__()
+            if algo not in ("weight_only_int8", "weight_only_int4"):
+                raise ValueError(f"unknown algo {algo!r}")
+            self.weight_dtype = "int8" if algo.endswith("int8") else "int4"
+            w = weight if isinstance(weight, Tensor) else Tensor(weight)
+            self.in_features, self.out_features = (int(w.shape[0]),
+                                                   int(w.shape[1]))
+            qw, scale = weight_quantize(w, algo=algo)
+            self.register_buffer("qweight", qw)
+            self.register_buffer("weight_scale", scale)
+            if bias is not None:
+                self.register_buffer(
+                    "bias", bias if isinstance(bias, Tensor)
+                    else Tensor(bias))
+            else:
+                self.bias = None
+
+        def forward(self, x):
+            b = self._buffers.get("bias")
+            return weight_only_linear(x, self.qweight, b,
+                                      self.weight_scale, self.weight_dtype)
+
+    _WeightOnlyLinear.__name__ = "WeightOnlyLinear"
+    _WOL_CLS = _WeightOnlyLinear
+    return _WOL_CLS
+
+
+# Decode-regime projections: the GEMMs that are weight-bandwidth-bound
+# at M = batch (MLP + LM head). Attention qkv/o are deliberately NOT on
+# the default list — their weights are a small fraction of the decode
+# bytes next to the KV read, and quantizing them buys accuracy risk for
+# little bandwidth (SERVING.md "Quantized KV & weights").
+SERVING_WQ_TARGETS = ("gate_proj", "up_proj", "down_proj", "lm_head")
+
+
+def quantize_for_serving(model, algo="weight_only_int8",
+                         targets=SERVING_WQ_TARGETS):
+    """Replace `targets`-named linear sublayers (matched by their leaf
+    attribute name, anywhere in the tree) with WeightOnlyLinear — IN
+    PLACE, weights quantized once at conversion. Returns the number of
+    layers converted. The serving engine's `wq=` config calls this
+    before snapshotting state, so the quantized buffers (int8 qweight +
+    fp scale) ride the compiled programs and the fused dequant-matmul
+    serves every decode/verify/prefill launch."""
+    from ..layer.layers import Layer
+    converted = 0
+    stack = [model]
+    while stack:
+        layer = stack.pop()
+        for name, sub in list(layer._sub_layers.items()):
+            if sub is None:
+                continue
+            w = getattr(sub, "weight", None)
+            if (name in targets and w is not None
+                    and len(getattr(w, "shape", ())) == 2
+                    and not isinstance(sub, _weight_only_linear_cls())):
+                bias = getattr(sub, "bias", None)
+                setattr(layer, name,
+                        WeightOnlyLinear(w, bias=bias, algo=algo))
+                converted += 1
+            elif isinstance(sub, Layer):
+                stack.append(sub)
+    return converted
 
 
 class Stub(object):

@@ -140,6 +140,30 @@ class ServingEngine:
     is token-identical to plain decode (drafting only changes how many
     launches it takes), and `spec_buckets` is the K axis of the
     program grid.
+
+    Quantized decode path (ISSUE 6):
+    * kv_dtype="int8" stores KV pages as int8 with fp32 per-slot
+      scales riding the SAME page ids (quantize-on-write inside the
+      compiled programs, dequantize-in-kernel/-gather on read) — the
+      page payload halves, so at a fixed `kv_pool_bytes` the pool
+      holds ~2x the pages (2D/(D+4) exactly; paged_page_bytes is the
+      math's single source). All page bookkeeping (CoW fork, radix
+      donation, truncate_sequence rollback, snapshot/resume) is
+      host-side and byte-level, so it is bit-identical across
+      kv_dtype — only the attention arithmetic changes, within the
+      documented rel-err budget.
+    * wq="int8" converts the model's decode-regime projections
+      (MLP gate/up/down + LM head) to int8 weights IN PLACE
+      (nn.quant.quantize_for_serving) before the state snapshot, so
+      every program serves them through the fused Pallas
+      dequant-matmul (kernels/quant_matmul.py). The conversion
+      mutates `model` — pass a model dedicated to this engine.
+    * kv_pool_bytes sizes num_pages from an HBM byte budget instead
+      of a page count (num_pages = budget // page_bytes) — the knob
+      the capacity-doubling acceptance test turns.
+    Both ride the program-cache keys, so engines with different quant
+    configs sharing a process never collide, and the compile bound
+    stays the bucket grid.
     """
 
     def __init__(self, model, *, num_pages: int = 128, page_size: int = 16,
@@ -156,14 +180,48 @@ class ServingEngine:
                  retry_policy: Optional[RetryPolicy] = None,
                  clock=None,
                  proposer=None, spec_k: int = 4,
-                 spec_buckets: Optional[List[int]] = None):
+                 spec_buckets: Optional[List[int]] = None,
+                 kv_dtype: Optional[str] = None,
+                 wq: Optional[str] = None,
+                 kv_pool_bytes: Optional[int] = None):
         cfg = model.cfg
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(f"kv_dtype must be None or 'int8', got "
+                             f"{kv_dtype!r}")
+        if wq not in (None, "int8", "int4"):
+            raise ValueError(f"wq must be None, 'int8' or 'int4', got "
+                             f"{wq!r}")
+        self.kv_dtype = kv_dtype
+        self.wq = wq
+        if wq is not None:
+            # IN PLACE, before the state snapshot below: the quantized
+            # buffers (int8 qweight + fp scale) replace the fp weights
+            # in state_dict, so every compiled program reads 1 byte per
+            # weight element through the fused dequant-matmul
+            from ..nn.quant import quantize_for_serving
+            self.num_wq_layers = quantize_for_serving(
+                model, algo=f"weight_only_{wq}")
+        else:
+            self.num_wq_layers = 0
         self.model = model
         self.cfg = cfg
         self.num_layers = cfg.num_hidden_layers
         self.num_kv = cfg.num_key_value_heads
         self.head_dim = cfg.hidden_size // cfg.num_attention_heads
         self.page_size = int(page_size)
+        from ..kernels.paged_attention import paged_page_bytes
+        wdtype = next(t._data.dtype for t in model.state_dict().values()
+                      if jnp.issubdtype(t._data.dtype, jnp.floating))
+        # bytes one page costs in THIS engine (int8 pages + scales, or
+        # the model dtype's full-width pages) — the capacity gauge and
+        # the kv_pool_bytes sizing below both hang off it
+        self.kv_page_bytes = paged_page_bytes(
+            cfg.num_key_value_heads, self.page_size, self.head_dim,
+            kv_dtype if kv_dtype is not None else str(wdtype))
+        if kv_pool_bytes is not None:
+            # size the pool from an HBM byte budget: the page count is
+            # what kv_dtype="int8" roughly doubles at fixed bytes
+            num_pages = max(2, int(kv_pool_bytes) // self.kv_page_bytes)
         self.num_pages = int(num_pages)
         self.temperature = float(temperature)
         self.top_k = int(top_k)
@@ -181,12 +239,13 @@ class ServingEngine:
         # fail at construction, not at the first decode launch: the
         # Pallas kernel's static constraints are model geometry
         from ..kernels.paged_attention import check_supported_paged
-        dtype = next(iter(self._state.values())).dtype
-        self._cache_dtype = dtype
+        dtype = next(a.dtype for a in self._state.values()
+                     if jnp.issubdtype(a.dtype, jnp.floating))
+        self._cache_dtype = jnp.int8 if kv_dtype == "int8" else dtype
         check_supported_paged(
             (1, cfg.num_attention_heads, self.head_dim),
             (self.num_pages, self.num_kv, self.page_size, self.head_dim),
-            dtype)
+            dtype, kv_dtype=kv_dtype)
 
         # longest sequence a request may ever reach (rope table and page
         # supply both bound it)
@@ -258,10 +317,30 @@ class ServingEngine:
             name=f"serving-{next(_engine_counter)}").register()
 
         shape = (self.num_pages, self.num_kv, self.page_size, self.head_dim)
-        self._k_caches = [jnp.zeros(shape, dtype)
+        self._k_caches = [jnp.zeros(shape, self._cache_dtype)
                           for _ in range(self.num_layers)]
-        self._v_caches = [jnp.zeros(shape, dtype)
+        self._v_caches = [jnp.zeros(shape, self._cache_dtype)
                           for _ in range(self.num_layers)]
+        if self.kv_dtype == "int8":
+            from ..kernels.paged_attention import KV_SCALE_DTYPE
+            self._k_scales = [jnp.zeros(shape[:3], KV_SCALE_DTYPE)
+                              for _ in range(self.num_layers)]
+            self._v_scales = [jnp.zeros(shape[:3], KV_SCALE_DTYPE)
+                              for _ in range(self.num_layers)]
+        else:
+            # empty pytrees: the compiled programs take the scale lists
+            # unconditionally so both kv_dtypes share one program shape
+            self._k_scales = []
+            self._v_scales = []
+        # bytes-moved accounting (ServingMetrics): one token's K+V
+        # across every layer, scales included
+        self.kv_bytes_per_token = (self.num_layers * self.kv_page_bytes
+                                   // self.page_size)
+        self.metrics.set_kv_info(
+            kv_dtype=self.kv_dtype or str(dtype),
+            page_bytes=self.kv_page_bytes,
+            pool_bytes=self.kv_page_bytes * self.num_pages,
+            bytes_per_token=self.kv_bytes_per_token)
 
         self.requests: Dict[int, Request] = {}
         self._finished_order: List[int] = []
@@ -272,8 +351,15 @@ class ServingEngine:
         self.num_evicted_finished = 0
         self._programs: Dict[tuple, object] = {}
         # caches only pay off donated on a real accelerator; CPU jit
-        # warns per call and keeps the copy anyway
-        self._donate = (1, 2) if jax.default_backend() == "tpu" else ()
+        # warns per call and keeps the copy anyway. Scale lists donate
+        # too (empty pytrees for full-width KV — a no-op there).
+        self._donate = (1, 2, 3, 4) if jax.default_backend() == "tpu" \
+            else ()
+        # quant config rides every program-cache key: two engines with
+        # different kv_dtype/wq in one process must never share a
+        # compiled program, and the bucket-grid compile bound is
+        # per-engine so the key suffix costs nothing
+        self._qkey = (self.kv_dtype or "kv_full", self.wq or "w_full")
 
     def _caches_alive(self) -> bool:
         """Retry gate for the donated-buffer hazard: on TPU the compiled
@@ -375,17 +461,46 @@ class ServingEngine:
                 + (len(self.batch_buckets) * len(self.spec_buckets)
                    * len(self.pages_buckets)))
 
+    # --------------------------------------------- paged-cache plumbing
+    @staticmethod
+    def _paged_views(kcs, vcs, kss, vss):
+        """Per-layer cache tuples for the model's forward_paged_* —
+        (k, v) for full-width KV, (k, v, k_scale, v_scale) for int8
+        (the model branches on tuple arity, ISSUE 6)."""
+        if kss:
+            return [(Tensor(kcs[l]), Tensor(vcs[l]),
+                     Tensor(kss[l]), Tensor(vss[l]))
+                    for l in range(len(kcs))]
+        return [(Tensor(kcs[l]), Tensor(vcs[l]))
+                for l in range(len(kcs))]
+
+    @staticmethod
+    def _split_views(caches):
+        """Inverse of _paged_views: four flat array lists (scale lists
+        empty for full-width KV) — the uniform program return shape."""
+        kcs = [c[0]._data for c in caches]
+        vcs = [c[1]._data for c in caches]
+        if caches and len(caches[0]) == 4:
+            return (kcs, vcs, [c[2]._data for c in caches],
+                    [c[3]._data for c in caches])
+        return kcs, vcs, [], []
+
+    def _store_caches(self, kcs, vcs, kss, vss):
+        self._k_caches, self._v_caches = kcs, vcs
+        self._k_scales, self._v_scales = kss, vss
+
     # ----------------------------------------------------- prefill chunks
     def _build_chunk(self, S: int, P: int):
         """One padded prompt CHUNK -> paged cache + sampled token (the
         token is only consumed when the chunk is the prompt's last)."""
-        L = self.num_layers
         model = self.model
         temperature, top_k, top_p = self.temperature, self.top_k, self.top_p
+        views, split = self._paged_views, self._split_views
 
-        def program(state, kcs, vcs, ids, cache_len, live, bt, key):
+        def program(state, kcs, vcs, kss, vss, ids, cache_len, live, bt,
+                    key):
             st = {k: Tensor(v) for k, v in state.items()}
-            paged = [(Tensor(kcs[l]), Tensor(vcs[l])) for l in range(L)]
+            paged = views(kcs, vcs, kss, vss)
             logits, caches = functional_call(
                 model, st, Tensor(ids), paged, Tensor(bt),
                 Tensor(cache_len), Tensor(live),
@@ -396,8 +511,7 @@ class ServingEngine:
             # into the chunk-end logits, so one reduction covers the step
             ok = jnp.all(jnp.isfinite(last))
             tok = _sample_arr(last[None], key, temperature, top_k, top_p)[0]
-            return (tok, ok, [c[0]._data for c in caches],
-                    [c[1]._data for c in caches])
+            return (tok, ok) + split(caches)
 
         return jax.jit(program, donate_argnums=self._donate)
 
@@ -409,7 +523,7 @@ class ServingEngine:
         P = _bucket_for(
             self.allocator.pages_needed(chunk.start + chunk.length),
             self.pages_buckets)
-        prog = self._get_program(("chunk", S, P),
+        prog = self._get_program(("chunk", S, P) + self._qkey,
                                  lambda: self._build_chunk(S, P))
         bt = np.full((P,), PAD_PAGE, np.int32)
         npages = min(len(req.seq.pages), P)
@@ -428,14 +542,21 @@ class ServingEngine:
                                  f"{req.request_id}]"), no_grad():
                 return prog(
                     self._state, self._k_caches, self._v_caches,
+                    self._k_scales, self._v_scales,
                     jnp.asarray(padded), jnp.int32(chunk.start),
                     jnp.int32(chunk.length), jnp.asarray(bt), key)
 
-        tok, ok, self._k_caches, self._v_caches = self.supervisor.run(
-            launch, label="prefill_chunk")
+        tok, ok, *caches = self.supervisor.run(launch,
+                                               label="prefill_chunk")
+        self._store_caches(*caches)
         if faults.fire(FAULT_NAN) is not None:
             ok = False
         self.metrics.on_prefill(chunk.length)
+        # the chunk wrote its own tokens' K/V and its attention gathered
+        # the whole live prefix (cached tokens + this chunk) per layer
+        self.metrics.on_kv_bytes(
+            written=chunk.length * self.kv_bytes_per_token,
+            read=(chunk.start + chunk.length) * self.kv_bytes_per_token)
         return tok, bool(ok)
 
     # ----------------------------------------------------------- decode
@@ -443,11 +564,11 @@ class ServingEngine:
         """One batched token step over the paged caches."""
         model = self.model
         temperature, top_k, top_p = self.temperature, self.top_k, self.top_p
+        views, split = self._paged_views, self._split_views
 
-        def program(state, kcs, vcs, ids, bt, sl, key):
+        def program(state, kcs, vcs, kss, vss, ids, bt, sl, key):
             st = {k: Tensor(v) for k, v in state.items()}
-            paged = [(Tensor(kcs[l]), Tensor(vcs[l]))
-                     for l in range(len(kcs))]
+            paged = views(kcs, vcs, kss, vss)
             logits, caches = functional_call(
                 model, st, Tensor(ids), paged, Tensor(bt), Tensor(sl),
                 method="forward_paged_decode")
@@ -457,8 +578,7 @@ class ServingEngine:
             # granularity ("fail one request, not the engine")
             ok = jnp.all(jnp.isfinite(rows), axis=-1)
             toks = _sample_arr(rows, key, temperature, top_k, top_p)
-            return (toks, ok, [c[0]._data for c in caches],
-                    [c[1]._data for c in caches])
+            return (toks, ok) + split(caches)
 
         return jax.jit(program, donate_argnums=self._donate)
 
@@ -467,7 +587,7 @@ class ServingEngine:
         B = _bucket_for(len(reqs), self.batch_buckets)
         max_pages = max(len(r.seq.pages) for r in reqs)
         P = _bucket_for(max_pages, self.pages_buckets)
-        prog = self._get_program(("decode", B, P),
+        prog = self._get_program(("decode", B, P) + self._qkey,
                                  lambda: self._build_decode(B, P))
         ids = np.zeros((B, 1), np.int32)
         sl = np.zeros((B,), np.int32)
@@ -487,11 +607,19 @@ class ServingEngine:
                     no_grad():
                 return prog(
                     self._state, self._k_caches, self._v_caches,
+                    self._k_scales, self._v_scales,
                     jnp.asarray(ids), jnp.asarray(bt), jnp.asarray(sl),
                     key)
 
-        toks, oks, self._k_caches, self._v_caches = self.supervisor.run(
-            launch, label="decode_step")
+        toks, oks, *caches = self.supervisor.run(launch,
+                                                 label="decode_step")
+        self._store_caches(*caches)
+        # bytes-moved accounting: this step wrote one token per live row
+        # and the attention kernel read every live token's K/V
+        self.metrics.on_kv_bytes(
+            written=len(reqs) * self.kv_bytes_per_token,
+            read=sum(r.seq.num_tokens for r in reqs)
+            * self.kv_bytes_per_token)
         oks = np.asarray(oks)[:len(reqs)].copy()
         poison = faults.fire(FAULT_NAN)
         if poison is not None:
@@ -540,13 +668,13 @@ class ServingEngine:
           pre-drawn key, so StepSupervisor retries stay bit-identical.
         """
         S = K + 1
-        L = self.num_layers
         model = self.model
         temperature, top_k, top_p = self.temperature, self.top_k, self.top_p
+        views, split = self._paged_views, self._split_views
 
-        def program(state, kcs, vcs, ids, bt, sl, dl, key):
+        def program(state, kcs, vcs, kss, vss, ids, bt, sl, dl, key):
             st = {k: Tensor(v) for k, v in state.items()}
-            paged = [(Tensor(kcs[l]), Tensor(vcs[l])) for l in range(L)]
+            paged = views(kcs, vcs, kss, vss)
             logits, caches = functional_call(
                 model, st, Tensor(ids), paged, Tensor(bt), Tensor(sl),
                 Tensor(dl), method="forward_paged_verify")
@@ -594,8 +722,7 @@ class ServingEngine:
                 sampled = jax.random.categorical(
                     k_r, jnp.log(res + 1e-30), axis=-1).astype(jnp.int32)
                 toks = jnp.where(jpos < n_acc[:, None], idsn, sampled)
-            return (toks, n_acc, ok, [c[0]._data for c in caches],
-                    [c[1]._data for c in caches])
+            return (toks, n_acc, ok) + split(caches)
 
         return jax.jit(program, donate_argnums=self._donate)
 
@@ -642,7 +769,7 @@ class ServingEngine:
                         self.spec_buckets)
         max_pages = max(len(r.seq.pages) for r in reqs)
         P = _bucket_for(max_pages, self.pages_buckets)
-        prog = self._get_program(("verify", B, K, P),
+        prog = self._get_program(("verify", B, K, P) + self._qkey,
                                  lambda: self._build_verify(B, K, P))
         S = K + 1
         ids = np.zeros((B, S), np.int32)
@@ -669,11 +796,18 @@ class ServingEngine:
                     no_grad():
                 return prog(
                     self._state, self._k_caches, self._v_caches,
+                    self._k_scales, self._v_scales,
                     jnp.asarray(ids), jnp.asarray(bt), jnp.asarray(sl),
                     jnp.asarray(dl), key)
 
-        toks, n_acc, oks, self._k_caches, self._v_caches = \
-            self.supervisor.run(launch, label="verify_step")
+        toks, n_acc, oks, *caches = self.supervisor.run(
+            launch, label="verify_step")
+        self._store_caches(*caches)
+        self.metrics.on_kv_bytes(
+            written=int(sum(1 + len(d) for d in drafts))
+            * self.kv_bytes_per_token,
+            read=sum(r.seq.num_tokens for r in reqs)
+            * self.kv_bytes_per_token)
         oks = np.asarray(oks)[:len(reqs)].copy()
         poison = faults.fire(FAULT_NAN)
         if poison is not None:
@@ -781,12 +915,21 @@ class ServingEngine:
 
     # ---------------------------------------------------- CoW page copies
     def _apply_copies(self, copies):
+        """Device-side CoW: copy a page's rows to a fresh page. For
+        int8 KV the per-slot scale rows are part of the page's identity
+        and copy WITH it — a fork that only copied values would
+        dequantize the new page with the old (soon divergent) scales."""
         for src, dst in copies:
             for l in range(self.num_layers):
                 self._k_caches[l] = self._k_caches[l].at[dst].set(
                     self._k_caches[l][src])
                 self._v_caches[l] = self._v_caches[l].at[dst].set(
                     self._v_caches[l][src])
+            for l in range(len(self._k_scales)):
+                self._k_scales[l] = self._k_scales[l].at[dst].set(
+                    self._k_scales[l][src])
+                self._v_scales[l] = self._v_scales[l].at[dst].set(
+                    self._v_scales[l][src])
 
     # ------------------------------------------------------------- step
     def _emit(self, req: Request, tok: int, emitted):

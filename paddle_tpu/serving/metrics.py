@@ -61,6 +61,13 @@ class ServingMetrics:
             "step_retries": 0,             # transient-failure re-launches
             "requests_quarantined": 0,     # poisoned (NaN) requests failed
             "engine_failures": 0,          # unrecoverable -> snapshot
+            # --- quantized KV / weights (ISSUE 6) ---
+            # device bytes the KV writes landed / the attention reads
+            # streamed (host-computed from token counts x bytes-per-
+            # token, scales included) — the capacity-per-chip evidence:
+            # at kv_dtype=int8 both drop ~2x for the same token traffic
+            "kv_bytes_written": 0,
+            "kv_bytes_read": 0,
             # --- speculative decoding (ISSUE 5) ---
             "spec_steps": 0,               # verify launches
             "spec_verified_rows": 0,       # sequence-steps verified
@@ -101,6 +108,11 @@ class ServingMetrics:
         self.kv_occupancy = 0.0
         self.cached_pages = 0
         self.radix_nodes = 0
+        # static KV-geometry gauges (set once at engine construction)
+        self.kv_dtype = None
+        self.kv_page_bytes = 0
+        self.kv_pool_bytes = 0
+        self.kv_bytes_per_token = 0
 
     # ---- reservoir registry ---------------------------------------------
     def add_reservoir(self, name: str, scale: float = 1.0,
@@ -156,6 +168,22 @@ class ServingMetrics:
 
     def on_decode(self, num_tokens: int):
         self.counters["decode_tokens"] += num_tokens
+
+    # ---- quantized KV / weights (ISSUE 6) --------------------------------
+    def set_kv_info(self, *, kv_dtype, page_bytes, pool_bytes,
+                    bytes_per_token):
+        """Static KV-pool geometry: dtype, bytes/page (scales included),
+        total pool bytes, and one token's all-layer K+V footprint —
+        page capacity at fixed HBM is pool_bytes / page_bytes, the
+        number kv_dtype=int8 roughly doubles."""
+        self.kv_dtype = str(kv_dtype)
+        self.kv_page_bytes = int(page_bytes)
+        self.kv_pool_bytes = int(pool_bytes)
+        self.kv_bytes_per_token = int(bytes_per_token)
+
+    def on_kv_bytes(self, written: int = 0, read: int = 0):
+        self.counters["kv_bytes_written"] += int(written)
+        self.counters["kv_bytes_read"] += int(read)
 
     def on_finish(self, request_id: int):
         self.counters["requests_finished"] += 1
@@ -279,6 +307,13 @@ class ServingMetrics:
             "radix_nodes": self.radix_nodes,
             "tokens_per_second": round(self.tokens_per_second(), 2),
         })
+        if self.kv_page_bytes:
+            snap.update({
+                "kv_dtype": self.kv_dtype,
+                "kv_page_bytes": self.kv_page_bytes,
+                "kv_pool_bytes": self.kv_pool_bytes,
+                "kv_bytes_per_token": self.kv_bytes_per_token,
+            })
         hr = self.prefix_hit_rate()
         if hr is not None:
             snap["prefix_hit_rate"] = round(hr, 4)

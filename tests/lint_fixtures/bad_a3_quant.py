@@ -1,0 +1,54 @@
+"""Known-bad A3 under a dtype hint (ISSUE 6): a (2048, 2048) int8
+weight block is ~42 MB of scoped VMEM even at its true 1-byte width
+(double-buffered DMA + the fp32 upcast temporaries the dequant
+materializes) — the hint refines the estimate, it must never amnesty
+an oversized quantized block."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_I0 = np.int32(0)
+_BM = 8
+_BK = 2048
+_BN = 2048
+
+
+def kernel(x_ref, w_ref, s_ref, o_ref, acc_ref, *, nk):
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    acc_ref[...] += jax.lax.dot_general(
+        x_ref[...].astype(jnp.float32), w_ref[...].astype(jnp.float32),
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _flush():
+        o_ref[...] = (acc_ref[...] * s_ref[0][None, :]).astype(o_ref.dtype)
+
+
+def run(x, qw, scale):
+    nk = qw.shape[0] // _BK
+    return pl.pallas_call(
+        functools.partial(kernel, nk=nk),
+        grid=(x.shape[0] // _BM, qw.shape[1] // _BN, nk),
+        in_specs=[
+            pl.BlockSpec((_BM, _BK), lambda i, j, k: (i, k)),
+            pl.BlockSpec((_BK, _BN), lambda i, j, k: (k, j)),
+            # block dim 1 equals the scale array's dim (the
+            # documented whole-array-dim case A2 cannot see)
+            pl.BlockSpec((1, _BN),  # tpu-lint: blockspec-ok
+                         lambda i, j, k: (_I0, j)),
+        ],
+        out_specs=pl.BlockSpec((_BM, _BN), lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((x.shape[0], qw.shape[1]),
+                                       jnp.float32),
+        scratch_shapes=[pltpu.VMEM((_BM, _BN), jnp.float32)],
+        # tpu-lint-hint: vmem-dtypes=float32,int8,float32
+    )(x, qw, scale)

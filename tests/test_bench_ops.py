@@ -107,6 +107,30 @@ def test_int8_decision_sweep_rows(bench_ops):
     assert decisions["int8_speedup_pct_m256"] < 0      # bf16 wins big-M
 
 
+def test_int8_kv_paged_rows(bench_ops):
+    """The paged-decode bench emits a bf16 row, an int8 row and the
+    bytes-ratio decision row per page size (ISSUE 6); the static ratio
+    must clear the >= ~1.7x acceptance bar (exactly 2D/(D+4) — the
+    fp32 scale rows are the gap to 2.0). Timing mocked; the kernels
+    themselves run for real in interpret mode."""
+    bench_ops._time_stats = lambda fn, *a, iters=10: (1e-3, 0.01)
+    bench_ops.bench_paged_decode("cpu", quick=True)
+    rows = [r for r in bench_ops.RESULTS if r["bench"] == "paged_decode"]
+    variants = {r["variant"] for r in rows}
+    assert {"pallas_page16", "pallas_int8_page16",
+            "int8_kv_bytes_ratio_page16",
+            "int8_decode_speedup_pct_page16"} <= variants
+    ratio = next(r["value"] for r in rows
+                 if r["variant"] == "int8_kv_bytes_ratio_page16")
+    D = 64                                   # the CPU bench's head_dim
+    assert ratio == pytest.approx(2 * D / (D + 4), abs=5e-3)
+    assert ratio >= 1.7
+    bf16 = next(r for r in rows if r["variant"] == "pallas_page16")
+    int8 = next(r for r in rows if r["variant"] == "pallas_int8_page16")
+    # same mocked time, int8 moves fewer bytes -> lower reported GB/s
+    assert int8["gbps"] < bf16["gbps"]
+
+
 def test_help_documents_median_spread_mode():
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     out = subprocess.run(

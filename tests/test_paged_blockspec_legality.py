@@ -25,16 +25,27 @@ SHAPES = [
 ]
 
 
+@pytest.mark.parametrize("quantized", [False, True],
+                         ids=["bf16", "int8"])
 @pytest.mark.parametrize("B,H,KVH,D,page,S", SHAPES)
-def test_paged_blockspecs_tpu_legal(B, H, KVH, D, page, S):
+def test_paged_blockspecs_tpu_legal(B, H, KVH, D, page, S, quantized):
     max_pages = S // page
     num_pages = B * max_pages
-    check_supported_paged((B, H, D), (num_pages, KVH, page, D), "bfloat16")
-    specs, scratch = paged_blockspecs(B, H, KVH, D, page, num_pages)
+    check_supported_paged((B, H, D), (num_pages, KVH, page, D), "bfloat16",
+                          kv_dtype="int8" if quantized else None)
+    specs, scratch = paged_blockspecs(B, H, KVH, D, page, num_pages,
+                                      quantized=quantized)
+    if quantized:
+        # the int8 path streams a scale page per value page: 2*fold
+        # extra specs, every one (1, KVH, page) over the page-major
+        # fp32 scale array
+        plain, _ = paged_blockspecs(B, H, KVH, D, page, num_pages)
+        assert len(specs) == len(plain) + 2 * ((len(plain) - 2) // 2)
+        assert ((1, KVH, page), (num_pages, KVH, page)) in specs
     for block, array in specs:
         assert mosaic_legal(block, array), (
             f"illegal block {block} for array {array} "
-            f"(H={H} KVH={KVH} D={D} page={page})")
+            f"(H={H} KVH={KVH} D={D} page={page} quant={quantized})")
     # scratch refs: the kernel sub-slices the lane dim (m_ref[h, :, :1]),
     # which Mosaic only supports from offset 0 on a 128-lane-aligned
     # buffer; the accumulator's lanes are the head_dim

@@ -33,6 +33,7 @@ BAD_FIXTURES = {
     "bad_a1_index_map.py": "A1",
     "bad_a2_blockspec.py": "A2",
     "bad_a3_vmem.py": "A3",
+    "bad_a3_quant.py": "A3",
     "bad_a4_runtime.py": "A4",
     "bad_a5_purity.py": "A5",
 }
@@ -40,6 +41,7 @@ GOOD_FIXTURES = [
     "good_a1_index_map.py",
     "good_a2_blockspec.py",
     "good_a3_vmem.py",
+    "good_a3_quant_hint.py",
     "good_a4_runtime.py",
     "good_a5_purity.py",
 ]
@@ -142,6 +144,54 @@ class TestVmemCrossCheck:
         fits, est = vmem.fits_vmem(ins, outs, scratch, extra_bytes=extra)
         assert not fits
         assert est > vmem.VMEM_BUDGET_BYTES
+
+    # ---- quantized element widths (ISSUE 6) -------------------------
+    def test_int8_and_int4_widths(self):
+        # an int8 block is budgeted at 1 B/elem, int4 at half that
+        # (packed), with the block total rounded UP
+        b8, e = vmem._block_bytes(((64, 128), "int8"))
+        assert (b8, e) == (64 * 128, 64 * 128)
+        b4, _ = vmem._block_bytes(((64, 128), "int4"))
+        assert b4 == 64 * 128 // 2
+        b4odd, _ = vmem._block_bytes(((1, 3), "int4"))
+        assert b4odd == 2          # ceil(1.5)
+
+    def test_quant_matmul_picks_fit_estimator(self):
+        # the kernel's own pick function IS the estimator (the A3
+        # discipline), so everything it accepts must fit — sweep the
+        # serving-relevant decode/verify/prefill shapes
+        from paddle_tpu.kernels.quant_matmul import (_blocks,
+                                                     pick_quant_blocks)
+        for M, K, N in [(1, 4096, 4096), (8, 4096, 11008),
+                        (256, 4096, 128256), (32, 8192, 8192)]:
+            picked = pick_quant_blocks(M, K, N)
+            assert picked is not None, (M, K, N)
+            ins, outs, scratch = _blocks(*picked, "float32")
+            fits, est = vmem.fits_vmem(ins, outs, scratch)
+            assert fits, (M, K, N, picked, est)
+
+    def test_scale_buffer_costs_are_counted(self):
+        # the fp32 scale row is tiny but must not be dropped: its bytes
+        # appear in the estimate
+        base = vmem.estimate_vmem_bytes([((8, 512), "int8")], [])
+        with_scale = vmem.estimate_vmem_bytes(
+            [((8, 512), "int8"), ((1, 512), "float32")], [])
+        assert with_scale == base + 2 * 512 * 4   # double-buffered
+
+
+def test_a3_dtype_hint_refines_in_spec_widths():
+    """The `# tpu-lint-hint: vmem-dtypes=...` comment budgets each
+    in_spec at its true width: the good quant fixture passes ONLY
+    because of the hint (stripping it false-positives at fp32 width),
+    and the hint never amnesties a genuinely oversized block (the bad
+    quant fixture stays flagged)."""
+    good = os.path.join(FIXDIR, "good_a3_quant_hint.py")
+    assert analysis.lint_file(good, is_test=False) == []
+    with open(good) as f:
+        src = f.read().replace("# tpu-lint-hint: vmem-dtypes="
+                               "float32,int8,float32", "")
+    diags = analysis.lint_source(src, path="nohint.py", is_test=False)
+    assert {d.rule for d in diags} == {"A3"}
 
 
 # -------------------------------------------------------- escape hatch
@@ -360,7 +410,7 @@ def test_this_test_file_is_actually_linted():
     from paddle_tpu.analysis import driver as adriver
     with open(os.path.abspath(__file__), encoding="utf-8") as f:
         src = f.read()
-    hatches = adriver._parse_hatches(src)
+    hatches, _hints = adriver._parse_directives(src)
     assert not any("skip-file" in toks for toks in hatches.values())
     assert analysis.lint_file(os.path.abspath(__file__)) == []
 

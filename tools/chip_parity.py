@@ -102,4 +102,42 @@ e = relerr(out, ref)
 assert e < 3e-2, f"paged parity {e}"
 print(f"PARITY paged decode rel_err={e:.4f} OK")
 
+# ---- int8-KV paged decode vs the SAME dense reference (ISSUE 6) -----
+# quantize the bf16 cache per (slot, head), run the quantized kernel
+# (int8 value pages + fp32 scale pages, dequantize-in-kernel), and
+# hold it to the int8 rel-err budget vs the full-precision reference —
+# the chip-blind wiring for the next relay window; the CPU interpret
+# run of the same code path is pinned by tests/test_serving_quant_kv.
+from paddle_tpu.kernels.paged_attention import quantize_kv
+kq, ks = quantize_kv(kc)
+vq, vs = quantize_kv(vc)
+out_q = paged_attention_decode(q1, kq, vq, tables, lens,
+                               k_scale=ks, v_scale=vs)
+e = relerr(out_q, ref)
+assert e < 3e-2, f"int8-KV paged parity {e}"
+print(f"PARITY paged decode int8-KV rel_err={e:.4f} OK")
+
+# ---- fused int8 dequant-matmul vs its XLA composition ----------------
+# same numerics by construction (fp32 accumulate, per-out-channel
+# scale at the flush) — on chip this catches Mosaic lowering bugs the
+# interpret-mode CPU tests cannot see; also budgeted against the
+# full-precision matmul it approximates (chip_serving measured 0.0065
+# for the old route; the fused kernel must hold the same 2e-2 budget).
+from paddle_tpu.kernels.quant_matmul import (dequant_matmul_xla,
+                                             quant_matmul)
+M, K, N = 64, 1024, 1024
+w = (rng.randn(K, N) * 0.02).astype(np.float32)
+absmax = np.maximum(np.abs(w).max(0), 1e-10)
+scale = jnp.asarray((absmax / 127.0).astype(np.float32))
+qw = jnp.asarray(np.clip(np.round(w / (absmax / 127.0)[None, :]),
+                         -127, 127).astype(np.int8))
+x = jnp.asarray(rng.randn(M, K).astype(np.float32))
+out_pl = quant_matmul(x, qw, scale)
+out_xla = dequant_matmul_xla(x, qw, scale)
+e = relerr(out_pl, out_xla)
+assert e < 1e-4, f"quant_matmul vs XLA composition {e}"
+e_full = relerr(out_pl, np.asarray(x) @ w)
+assert e_full < 2e-2, f"quant_matmul vs full precision {e_full}"
+print(f"PARITY quant_matmul xla={e:.6f} full={e_full:.4f} OK")
+
 print("CHIP_PARITY_ALL_OK")

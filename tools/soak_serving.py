@@ -18,7 +18,13 @@ chaos — and asserts the resilience acceptance criteria on each pair:
   spec-chaos pass layers a draft-mismatch STORM (garbage drafts — all
   rejected, output-invariant by the acceptance rule), injected
   rollback-OOM during draft extension, and NaN in verify logits on top
-  of the ISSUE-3 chaos.
+  of the ISSUE-3 chaos;
+* int8-KV extras (ISSUE 6): the same workload runs a clean + chaos
+  pair under kv_dtype="int8" (quantized pages + per-slot scales) —
+  unaffected requests must stay bit-identical WITHIN the int8 pair
+  (quantize-on-write is deterministic, so chaos may only change
+  affected requests, exactly like the full-precision pair), and every
+  page/refcount reclamation check holds on the quantized pool.
 
 Deterministic end to end: workload, fault schedule, aborts and the
 deadline clock all derive from --seed; wall-clock never enters the
@@ -101,13 +107,14 @@ def make_workload(n, seed):
     return work
 
 
-def run_workload(model, work, *, chaos, seed, report, spec=False):
+def run_workload(model, work, *, chaos, seed, report, spec=False,
+                 kv_dtype=None):
     """One full soak pass; returns ({idx: tokens}, affected_idx_set)."""
     rng = np.random.RandomState(seed + 1)
     abort_at = {i for i in range(len(work))
                 if rng.random() < ABORT_FRACTION} if chaos else set()
 
-    kw = dict(ENGINE_KW)
+    kw = dict(ENGINE_KW, kv_dtype=kv_dtype)
     if spec:
         kw.update(SPEC_KW, proposer=NgramProposer())
     eng = ServingEngine(
@@ -229,7 +236,8 @@ def run_workload(model, work, *, chaos, seed, report, spec=False):
         eng.allocator.check_invariants()
 
         snap = eng.metrics.snapshot()
-        label = ("spec_" if spec else "") + ("chaos" if chaos else "clean")
+        label = ("int8_" if kv_dtype == "int8" else "") \
+            + ("spec_" if spec else "") + ("chaos" if chaos else "clean")
         rep = {
             "steps": steps, "sheds": sheds,
             "finish_reasons": reasons,
@@ -270,6 +278,8 @@ def main(argv=None):
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--no-spec", action="store_true",
                     help="skip the two speculative-decoding passes")
+    ap.add_argument("--no-int8", action="store_true",
+                    help="skip the two int8-KV passes")
     args = ap.parse_args(argv)
 
     cfg = LlamaConfig(vocab_size=128, hidden_size=128,
@@ -326,6 +336,29 @@ def main(argv=None):
         assert sx["spec_rollback"] >= 1, sx
         report["spec_unaffected_bit_identical"] = \
             args.requests - len(spec_aff)
+
+    if not args.no_int8:
+        # ---- int8-KV passes (ISSUE 6) --------------------------------
+        # quantize-on-write is deterministic, so the int8 pair carries
+        # the SAME bit-identity contract as the full-precision pair:
+        # chaos may only change affected (quarantined/expired/aborted)
+        # requests. Cross-dtype token equality is NOT asserted — int8
+        # attention is allowed its documented rel-err budget.
+        i8_clean, _ = run_workload(model, work, chaos=False,
+                                   seed=args.seed, report=report,
+                                   kv_dtype="int8")
+        i8_chaos, i8_aff = run_workload(model, work, chaos=True,
+                                        seed=args.seed, report=report,
+                                        kv_dtype="int8")
+        i8_div = [i for i in range(len(work))
+                  if i not in i8_aff
+                  and i8_chaos.get(i) != i8_clean.get(i)]
+        assert not i8_div, ("unaffected requests diverged under int8 "
+                            f"chaos: {i8_div[:10]}")
+        ic = report["int8_chaos"]
+        assert ic["step_retries"] >= 1 and ic["quarantined"] >= 1, ic
+        report["int8_unaffected_bit_identical"] = \
+            args.requests - len(i8_aff)
 
     report["wall_s"] = round(time.perf_counter() - t0, 2)
     print(json.dumps(report))
