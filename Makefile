@@ -3,7 +3,7 @@
 # otherwise block every interpreter on the single TPU grant).
 TEST_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: test test-fast bench soak lint
+.PHONY: test test-fast bench soak soak-fleet lint
 
 # tpu-lint: static trace-safety analysis (ANALYSIS.md). AST-only — no
 # jax import, no TPU grant, ~1 s; gates `make test`.
@@ -25,6 +25,13 @@ bench:
 soak:
 	$(TEST_ENV) python tools/soak_serving.py --requests 200 --seed 0
 	$(TEST_ENV) python -m pytest tests/test_soak_serving.py -m slow -q
+
+# Multi-replica fleet chaos soak (ISSUE 7): seeded kill + stall of
+# replicas mid-stream; zero-loss / bit-identity / routing criteria.
+# CPU-only, minutes-bounded; excluded from tier-1 like `make soak`.
+soak-fleet:
+	$(TEST_ENV) python tools/soak_fleet.py --requests 120 --seed 0
+	$(TEST_ENV) python -m pytest tests/test_soak_fleet.py -m slow -q
 
 # Sanitizer builds of the native extension (parity: reference
 # SANITIZER_TYPE configure option). Runs the native test suite against an

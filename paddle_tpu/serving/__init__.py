@@ -9,11 +9,14 @@ admission control, supervised step retries with poison quarantine, and
 snapshot/resume across device failures; speculative decoding (ISSUE 5,
 `serving.spec`) drafts K candidate tokens per sequence (n-gram prompt
 lookup or a smaller draft model) and verifies them against the paged
-cache in one bucketed launch with KV rollback for rejected drafts.
+cache in one bucketed launch with KV rollback for rejected drafts; the
+fleet front-end (ISSUE 7, `serving.fleet`) multiplexes a streaming API
+over N in-process replicas with prefix-affinity routing, replica
+supervision, and zero-loss failover via snapshot live-migration.
 """
 from .engine import ServingEngine
 from .errors import (EngineFailure, EngineOverloaded, PoisonedComputation,
-                     TransientDeviceError)
+                     SnapshotVersionError, TransientDeviceError)
 from .kv_cache import BlockAllocator, BlocksExhausted, KVSequence, PAD_PAGE
 from .metrics import ServingMetrics
 from .radix_cache import RadixCache, RadixNode
@@ -21,11 +24,17 @@ from .scheduler import (PrefillChunk, Request, RequestState, ScheduleStep,
                         Scheduler)
 from .spec import DraftModelProposer, NgramProposer, Proposer
 from .supervisor import RetryPolicy, StepSupervisor, classify_failure
+from .fleet import (Fleet, FleetHandle, FleetServer, PrefixAffinityRouter,
+                    RandomRouter, Replica, ReplicaState, RoundRobinRouter,
+                    TokenStream)
 
 __all__ = ["ServingEngine", "BlockAllocator", "BlocksExhausted",
            "KVSequence", "PAD_PAGE", "ServingMetrics", "RadixCache",
            "RadixNode", "PrefillChunk", "Request", "RequestState",
            "ScheduleStep", "Scheduler", "EngineFailure", "EngineOverloaded",
-           "PoisonedComputation", "TransientDeviceError", "RetryPolicy",
+           "PoisonedComputation", "TransientDeviceError",
+           "SnapshotVersionError", "RetryPolicy",
            "StepSupervisor", "classify_failure", "Proposer",
-           "NgramProposer", "DraftModelProposer"]
+           "NgramProposer", "DraftModelProposer", "Fleet", "FleetHandle",
+           "FleetServer", "TokenStream", "Replica", "ReplicaState",
+           "PrefixAffinityRouter", "RandomRouter", "RoundRobinRouter"]

@@ -74,7 +74,8 @@ from ..jit.api import functional_call
 from ..models.generation import _filter_logits, _sample_arr
 from ..utils import faults
 from ..utils.nan_inf import poison_scope
-from .errors import EngineFailure, EngineOverloaded
+from .errors import (EngineFailure, EngineOverloaded,
+                     SnapshotVersionError)
 from .kv_cache import BlockAllocator, BlocksExhausted, PAD_PAGE
 from .metrics import ServingMetrics
 from .radix_cache import RadixCache
@@ -82,11 +83,24 @@ from .scheduler import (Request, RequestState, Scheduler,
                         bump_request_counter)
 from .supervisor import POISON, RetryPolicy, StepSupervisor, classify_failure
 
-__all__ = ["ServingEngine"]
+__all__ = ["ServingEngine", "SNAPSHOT_VERSION", "check_snapshot_version"]
 
 _engine_counter = itertools.count()
 
 SNAPSHOT_VERSION = 1
+
+
+def check_snapshot_version(snapshot: dict):
+    """Refuse a snapshot whose schema `version` stamp is not the one
+    this build writes. Used by `from_snapshot` AND by the fleet's live
+    migration — both must fail LOUD (typed) instead of resuming a
+    schema they would silently misread."""
+    found = snapshot.get("version")
+    if found != SNAPSHOT_VERSION:
+        raise SnapshotVersionError(
+            f"unsupported snapshot version {found!r} (this build "
+            f"writes {SNAPSHOT_VERSION})",
+            found=found, expected=SNAPSHOT_VERSION)
 
 # Fault-injection points (ISSUE 3; utils/faults.py). The step-exception
 # points fire BEFORE the compiled launch, so an injected transient
@@ -1148,6 +1162,86 @@ class ServingEngine:
                 "rng_key": np.asarray(self._key).tolist(),
                 "requests": recs}
 
+    def _restore_request(self, rec: dict) -> Request:
+        """Rebuild one snapshot request record into THIS engine under
+        its ORIGINAL id: generated tokens fold into the resume prompt
+        (the preemption recompute path), the remaining deadline is
+        re-anchored on this engine's clock, and the admission bound is
+        bypassed (restored work was already admitted once — shedding it
+        would drop accepted work)."""
+        req = Request(rec["prompt_ids"], rec["max_new_tokens"],
+                      rec.get("eos_token_id"),
+                      request_id=rec["request_id"])
+        if len(req.prompt_ids) + req.max_new_tokens > self.max_seq_len:
+            raise ValueError(
+                f"snapshot request {req.request_id} needs "
+                f"{len(req.prompt_ids) + req.max_new_tokens} tokens "
+                f"> resumed engine max_seq_len {self.max_seq_len}")
+        req.output_ids = [int(t) for t in rec.get("output_ids", [])]
+        req.num_preemptions = int(rec.get("num_preemptions", 0))
+        req.aborted = bool(rec.get("aborted", False))
+        rem = rec.get("deadline_remaining_s")
+        if rem is not None:
+            req.deadline = self._now() + float(rem)
+        self.scheduler.add_request(req, force=True)
+        self.requests[req.request_id] = req
+        # adopted, not added: a migrated request already counted as an
+        # arrival on its original engine, and fleet summaries merge
+        # counters across ALL replicas (dead ones included)
+        self.metrics.on_adopt(req.request_id)
+        return req
+
+    def adopt_requests(self, recs) -> List[int]:
+        """Live-migration intake: restore snapshot request records into
+        this RUNNING engine (the fleet re-lands a dead or draining
+        replica's work on survivors this way — `from_snapshot` minus
+        the fresh-engine construction). Requests keep their original
+        ids (unique process-wide: ids come from one global counter, and
+        the counter is bumped past restored ids for the cross-process
+        case). Greedy continuations are bit-identical to an
+        uninterrupted run under the same bucket grid; this engine's OWN
+        rng key stream serves any sampled continuation. Returns the
+        adopted request ids."""
+        if self.failed:
+            raise EngineFailure("engine has failed; resume from "
+                                "last_snapshot",
+                                snapshot=self.last_snapshot)
+        ids = []
+        for rec in recs:
+            ids.append(self._restore_request(rec).request_id)
+        if ids:
+            bump_request_counter(max(ids))
+        return ids
+
+    def vacate(self, reason: str = "migrated") -> int:
+        """Release every KV page this engine holds: cancel all
+        non-finished requests locally (no donation — the work is not
+        lost, it re-lands elsewhere via `adopt_requests`; no
+        abort/expired metrics for the same reason) and drop the radix
+        tree. Pure host bookkeeping, so it works on a FAILED engine —
+        the fleet calls this on a dead replica's pool and then asserts
+        full page/refcount reclamation. Returns pages freed."""
+        before = self.allocator.num_free
+        for req in list(self.requests.values()):
+            if req.state is not RequestState.FINISHED:
+                if self.scheduler.cancel(req, reason, donate=False):
+                    self._retain(req)
+        self.reset_prefix_cache()
+        # refresh the metric gauges NOW: a vacated (usually dead) engine
+        # never steps again, so without this its last mid-flight gauges
+        # would sit in every future fleet-merged summary as phantom
+        # queue depth / used pages
+        self.metrics.update_gauges(
+            queue_depth=self.scheduler.queue_depth,
+            running=len(self.scheduler.running),
+            kv_used_pages=self.allocator.num_used,
+            kv_occupancy=self.allocator.occupancy(),
+            cached_pages=self.radix.num_cached_pages if self.radix else 0,
+            radix_nodes=self.radix.num_nodes if self.radix else 0,
+            radix_evicted_pages=(self.radix.num_evicted_pages
+                                 if self.radix else None))
+        return self.allocator.num_free - before
+
     @classmethod
     def from_snapshot(cls, model, snapshot: dict, **engine_kw):
         """Build a fresh engine that resumes a drained one. Restored
@@ -1158,36 +1252,13 @@ class ServingEngine:
         given the same bucket grid; the sampled-path key stream is
         restored but its position reflects the resume's chunking, so
         sampled continuations are reproducible per snapshot, not
-        bit-equal to the uninterrupted run."""
-        if snapshot.get("version") != SNAPSHOT_VERSION:
-            raise ValueError(f"unsupported snapshot version "
-                             f"{snapshot.get('version')!r}")
+        bit-equal to the uninterrupted run. Raises the typed
+        `SnapshotVersionError` on a schema-version mismatch — resuming
+        a snapshot this build would misread must fail loud."""
+        check_snapshot_version(snapshot)
         eng = cls(model, **engine_kw)
         eng._key = jnp.asarray(np.asarray(snapshot["rng_key"], np.uint32))
-        max_id = -1
-        for rec in snapshot["requests"]:
-            req = Request(rec["prompt_ids"], rec["max_new_tokens"],
-                          rec.get("eos_token_id"),
-                          request_id=rec["request_id"])
-            if len(req.prompt_ids) + req.max_new_tokens > eng.max_seq_len:
-                raise ValueError(
-                    f"snapshot request {req.request_id} needs "
-                    f"{len(req.prompt_ids) + req.max_new_tokens} tokens "
-                    f"> resumed engine max_seq_len {eng.max_seq_len}")
-            req.output_ids = [int(t) for t in rec.get("output_ids", [])]
-            req.num_preemptions = int(rec.get("num_preemptions", 0))
-            req.aborted = bool(rec.get("aborted", False))
-            rem = rec.get("deadline_remaining_s")
-            if rem is not None:
-                req.deadline = eng._now() + float(rem)
-            # restored work was already admitted once: bypass the
-            # admission bound (shedding it would drop accepted work)
-            eng.scheduler.add_request(req, force=True)
-            eng.requests[req.request_id] = req
-            eng.metrics.on_add(req.request_id)
-            max_id = max(max_id, req.request_id)
-        if max_id >= 0:
-            bump_request_counter(max_id)
+        eng.adopt_requests(snapshot["requests"])
         return eng
 
     # --------------------------------------------------- prefix cache ops

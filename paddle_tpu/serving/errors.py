@@ -17,13 +17,22 @@ string-matching messages:
 * `EngineFailure` — the engine hit an unrecoverable error and drained
   to `snapshot` (see SERVING.md "Failure semantics"); a fresh engine
   resumes from it via `ServingEngine.from_snapshot`.
+* `SnapshotVersionError` — a snapshot's schema `version` stamp does not
+  match what this engine build writes. Resume and fleet migration must
+  fail LOUD on it: silently reinterpreting an old schema would resume
+  garbage (wrong deadlines, dropped tokens) instead of crashing.
+
+Fleet-level errors (replica supervision, routing, tenant fairness) live
+in `serving.fleet.errors` — they are failures of the layer ABOVE the
+engine.
 """
 from __future__ import annotations
 
 from typing import Optional
 
 __all__ = ["EngineOverloaded", "TransientDeviceError",
-           "PoisonedComputation", "EngineFailure"]
+           "PoisonedComputation", "EngineFailure",
+           "SnapshotVersionError"]
 
 
 class EngineOverloaded(RuntimeError):
@@ -47,6 +56,17 @@ class PoisonedComputation(FloatingPointError):
     def __init__(self, msg: str, request_ids=()):
         super().__init__(msg)
         self.request_ids = tuple(request_ids)
+
+
+class SnapshotVersionError(ValueError):
+    """Snapshot schema mismatch: refuse to resume/migrate it. Subclasses
+    ValueError so pre-existing callers that caught the untyped rejection
+    keep working; `found` / `expected` carry the version stamps."""
+
+    def __init__(self, msg: str, found=None, expected=None):
+        super().__init__(msg)
+        self.found = found
+        self.expected = expected
 
 
 class EngineFailure(RuntimeError):

@@ -1,0 +1,27 @@
+"""paddle_tpu.serving.fleet — multi-replica serving front-end (ISSUE 7).
+
+The layer above the engine: N in-process ServingEngine replicas behind
+one streaming API, with prefix-affinity routing (the PR-2 radix hit
+rate as a fleet property), SLO/tenant-aware admission riding the PR-3
+deadline + shed machinery, replica supervision (heartbeats, stall and
+consecutive-failure detection), and ZERO-LOSS failover — the PR-3
+snapshot turned into live migration, with tokens-so-far preserved and
+greedy output bit-identical to an uninterrupted run (SERVING.md
+"Fleet front-end").
+
+Sync core: `Fleet` (submit/step_all/run — what the chaos soak drives
+deterministically). Async shell: `FleetServer` (per-replica stepping
+tasks + `TokenStream` async iterators).
+"""
+from .errors import (NoHealthyReplica, ReplicaCrashed, SloUnattainable,
+                     TenantThrottled)
+from .fleet import Fleet, FleetHandle
+from .replica import Replica, ReplicaState
+from .router import (PrefixAffinityRouter, RandomRouter, RoundRobinRouter,
+                     Router)
+from .server import FleetServer, TokenStream
+
+__all__ = ["Fleet", "FleetHandle", "FleetServer", "TokenStream",
+           "Replica", "ReplicaState", "Router", "PrefixAffinityRouter",
+           "RandomRouter", "RoundRobinRouter", "NoHealthyReplica",
+           "TenantThrottled", "SloUnattainable", "ReplicaCrashed"]

@@ -1,0 +1,80 @@
+"""Request routing across replicas.
+
+The production policy is PREFIX AFFINITY (`PrefixAffinityRouter`):
+score every healthy replica by the longest prefix of the request its
+radix cache already holds (the read-only `match_len` probe — scoring
+must not perturb any replica's LRU order), and break ties by load
+(in-flight + queue depth), then by name for determinism. This makes the
+PR-2 radix hit rate a FLEET property: requests sharing a prompt prefix
+keep landing on the replica that already holds its KV, instead of
+re-prefetching the same prefix into every replica's cache (which is
+what random spraying does — the soak's routing criterion measures
+exactly that gap).
+
+`RandomRouter` (seeded) and `RoundRobinRouter` exist as baselines for
+that comparison and for workloads with no shared prefixes.
+
+Routers are pure functions of (tokens, candidate list) plus their own
+private state; the FLEET owns candidacy (health states, the route-race
+retry) — a router never sees a dead replica.
+"""
+from __future__ import annotations
+
+import random
+from typing import List
+
+from .errors import NoHealthyReplica
+from .replica import Replica
+
+__all__ = ["Router", "PrefixAffinityRouter", "RandomRouter",
+           "RoundRobinRouter"]
+
+
+class Router:
+    """Strategy interface: pick one replica from the candidates."""
+
+    def route(self, tokens, replicas: List[Replica]) -> Replica:
+        raise NotImplementedError
+
+    @staticmethod
+    def _require(replicas: List[Replica]):
+        if not replicas:
+            raise NoHealthyReplica("no healthy replica to route to")
+
+
+class PrefixAffinityRouter(Router):
+    """Longest cached prefix first; least load, then name, break ties.
+
+    With cold caches every score is 0, so the policy degrades to pure
+    least-loaded — affinity only concentrates traffic once there is an
+    actual prefix to be affine TO."""
+
+    def route(self, tokens, replicas: List[Replica]) -> Replica:
+        self._require(replicas)
+        tokens = list(tokens)
+        return min(replicas,
+                   key=lambda r: (-r.match_len(tokens), r.load, r.name))
+
+
+class RandomRouter(Router):
+    """Seeded uniform spray — the routing-criterion baseline."""
+
+    def __init__(self, seed: int = 0):
+        self._rng = random.Random(seed)
+
+    def route(self, tokens, replicas: List[Replica]) -> Replica:
+        self._require(replicas)
+        return replicas[self._rng.randrange(len(replicas))]
+
+
+class RoundRobinRouter(Router):
+    """Strict rotation over whoever is currently healthy."""
+
+    def __init__(self):
+        self._i = 0
+
+    def route(self, tokens, replicas: List[Replica]) -> Replica:
+        self._require(replicas)
+        r = replicas[self._i % len(replicas)]
+        self._i += 1
+        return r

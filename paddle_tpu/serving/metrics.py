@@ -41,6 +41,11 @@ class ServingMetrics:
         self.name = name
         self.counters: Dict[str, int] = {
             "requests_added": 0,
+            # snapshot-restored intake (fleet migration / from_snapshot)
+            # — kept separate from requests_added so fleet-merged
+            # counters (dead replicas included) don't double-count a
+            # migrated request as two arrivals
+            "requests_adopted": 0,
             "requests_finished": 0,
             "requests_preempted": 0,
             "prefill_tokens": 0,
@@ -134,6 +139,13 @@ class ServingMetrics:
     def on_add(self, request_id: int):
         self.counters["requests_added"] += 1
         self._arrive_t[request_id] = time.perf_counter()
+
+    def on_adopt(self, request_id: int):
+        """Snapshot-restored request entering this engine: counted as
+        adopted, not added, and with NO arrival stamp — its queue-wait/
+        TTFT windows belong to its original admission, not the
+        migration."""
+        self.counters["requests_adopted"] += 1
 
     def on_admission(self, request_id: int, cached_tokens: int,
                      resumed: bool = False):
@@ -307,7 +319,10 @@ class ServingMetrics:
             "radix_nodes": self.radix_nodes,
             "tokens_per_second": round(self.tokens_per_second(), 2),
         })
-        if self.kv_page_bytes:
+        # pool bytes gate the block (not page bytes): a heterogeneous
+        # fleet merge zeroes the per-page gauges as sentinels while the
+        # pooled bytes stay exact — they must still surface
+        if self.kv_page_bytes or self.kv_pool_bytes:
             snap.update({
                 "kv_dtype": self.kv_dtype,
                 "kv_page_bytes": self.kv_page_bytes,
@@ -337,6 +352,80 @@ class ServingMetrics:
     # the reference's Metric objects expose `summary()`; ours is the
     # same auto-exposing view (counters dict + registered reservoirs)
     summary = snapshot
+
+    # ---- cross-replica aggregation (fleet, ISSUE 7) ----------------------
+    @classmethod
+    def merge(cls, *metrics: "ServingMetrics",
+              name: str = "fleet") -> "ServingMetrics":
+        """Combine per-replica metrics into ONE summary: counters and
+        TTFT aggregates sum, every registered percentile reservoir
+        merges via a balanced NEWEST-first draw across replicas (still
+        bounded by the window — an overflowing union keeps each
+        replica's freshest samples instead of letting the last-merged
+        replica's window win), count-like gauges sum, and
+        kv_occupancy becomes the pooled used/total ratio. The result is
+        a live view's worth of state in a fresh UNREGISTERED instance
+        (register() it only if it should shadow a real engine in
+        Profiler.summary(), which a fleet summary should not).
+        tokens_per_second spans the earliest source's start time, so
+        the merged rate is fleet throughput, not a division by the
+        merge call's age."""
+        out = cls(name=name)
+        total_pages_used = 0
+        total_pages = 0.0
+        for m in metrics:
+            for k, v in m.counters.items():
+                out.counters[k] = out.counters.get(k, 0) + v
+            out._ttft_sum += m._ttft_sum
+            out._ttft_count += m._ttft_count
+            out._t_start = min(out._t_start, m._t_start)
+            out.queue_depth += m.queue_depth
+            out.running += m.running
+            out.kv_used_pages += m.kv_used_pages
+            out.cached_pages += m.cached_pages
+            out.radix_nodes += m.radix_nodes
+            out.kv_pool_bytes += m.kv_pool_bytes
+            # pool-weighted occupancy: per-replica page counts recovered
+            # from the byte geometry (pool / page bytes)
+            if m.kv_page_bytes:
+                pages = m.kv_pool_bytes / m.kv_page_bytes
+                total_pages += pages
+                total_pages_used += m.kv_used_pages
+        if total_pages:
+            out.kv_occupancy = total_pages_used / total_pages
+        # per-page geometry gauges are only meaningful when every
+        # source agrees — a heterogeneous fleet gets explicit sentinels
+        # instead of whichever replica happened to merge last (pooled
+        # kv_pool_bytes / occupancy above stay exact either way)
+        pbs = {m.kv_page_bytes for m in metrics if m.kv_page_bytes}
+        dts = {m.kv_dtype for m in metrics if m.kv_page_bytes}
+        bpts = {m.kv_bytes_per_token for m in metrics if m.kv_page_bytes}
+        out.kv_page_bytes = pbs.pop() if len(pbs) == 1 else 0
+        out.kv_dtype = dts.pop() if len(dts) == 1 \
+            else ("mixed" if dts else None)
+        out.kv_bytes_per_token = bpts.pop() if len(bpts) == 1 else 0
+        # reservoirs: per-name balanced newest-first draw — walk every
+        # source from its freshest sample backwards, round-robin, until
+        # the window fills; reversed so the merged deque stays
+        # oldest->newest like any live reservoir
+        fmts = {}
+        for m in metrics:
+            for rname in m._reservoirs:
+                fmts.setdefault(rname, m._reservoir_fmt[rname])
+        for rname, (scale, suffix, digits) in fmts.items():
+            srcs = [list(m._reservoirs[rname]) for m in metrics
+                    if rname in m._reservoirs]
+            picked = []
+            depth = 1
+            while len(picked) < PERCENTILE_WINDOW and \
+                    any(depth <= len(s) for s in srcs):
+                for s in srcs:
+                    if depth <= len(s) and len(picked) < PERCENTILE_WINDOW:
+                        picked.append(s[-depth])
+                depth += 1
+            out.add_reservoir(rname, scale=scale, suffix=suffix,
+                              digits=digits).extend(reversed(picked))
+        return out
 
     # ---- profiler integration -------------------------------------------
     def register(self):

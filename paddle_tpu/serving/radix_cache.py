@@ -93,6 +93,25 @@ class RadixCache:
         return tuple(tokens[:self.page_size])
 
     # ---- lookup ----------------------------------------------------------
+    def _walk_prefix(self, tokens):
+        """Shared edge-walk under both lookups: yield (child,
+        full_pages_matched_on_edge) down the longest cached prefix of
+        `tokens`, stopping at a missing child or a mid-edge divergence.
+        Pure traversal — bumping (or not) is the caller's policy, which
+        is the whole difference between `match` and `match_len`."""
+        tokens = tuple(tokens)
+        node = self.root
+        while len(tokens) >= self.page_size:
+            child = node.children.get(self._edge_key(tokens))
+            if child is None:
+                return
+            n = _lcp(child.key, tokens)
+            yield child, n // self.page_size
+            if n < len(child.key):
+                return                     # diverged (or ran out) mid-edge
+            node = child
+            tokens = tokens[n:]
+
     def match(self, tokens) -> Tuple[List[int], int]:
         """Longest cached block-aligned prefix of `tokens`.
 
@@ -103,22 +122,22 @@ class RadixCache:
         can evict — matched pages are also the freshest LRU entries, and
         `evict(protect=...)` exists for the admission retry path.
         """
-        tokens = tuple(tokens)
-        node = self.root
         pages: List[int] = []
-        while len(tokens) >= self.page_size:
-            child = node.children.get(self._edge_key(tokens))
-            if child is None:
-                break
-            n = _lcp(child.key, tokens)
-            full = n // self.page_size
+        for child, full in self._walk_prefix(tokens):
             pages.extend(child.pages[:full])
             self._bump(child)
-            if n < len(child.key):
-                break                      # diverged (or ran out) mid-edge
-            node = child
-            tokens = tokens[n:]
         return pages, len(pages) * self.page_size
+
+    def match_len(self, tokens) -> int:
+        """READ-ONLY longest-prefix probe: the token count `match()`
+        would report (same walk by construction), with NO LRU bump
+        (eviction order untouched) and no refcount change. The fleet
+        router scores every replica's cache with this on every
+        submission — a probe that bumped LRU entries would let routing
+        traffic (including for requests that land elsewhere) distort
+        each replica's eviction order."""
+        return sum(full for _, full in self._walk_prefix(tokens)) \
+            * self.page_size
 
     # ---- insertion (donation) -------------------------------------------
     def insert(self, tokens, pages) -> int:

@@ -19,10 +19,13 @@ Design rules (they make the soak harness deterministic):
   exit even when the body raises.
 
 Registered points (grep for `faults.register_point` /
-`faults.fire`): serving KV allocator OOM, engine prefill/decode step
-exceptions, NaN-logits poisoning, deadline storms, radix donation
-failure. `bench.py` uses the BENCH_FAULT_INJECT env var instead — its
-supervisor must stay importable without this package.
+`faults.fire`; full table with trigger semantics in SERVING.md "Fault
+injection points"): serving KV allocator OOM, engine
+prefill/decode/verify step exceptions, NaN-logits poisoning, deadline
+storms, draft storms, radix donation failure, and the fleet points
+(replica crash, stream stall, route race). `bench.py` uses the
+BENCH_FAULT_INJECT env var instead — its supervisor must stay
+importable without this package.
 """
 from __future__ import annotations
 

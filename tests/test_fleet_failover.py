@@ -1,0 +1,394 @@
+"""Fleet failover (ISSUE 7): zero-loss migration on crash / stall /
+drain, the engine-side adopt/vacate primitives, and the deadline/abort
+edge interplay satellites (expiry mid-migration; abort of a request
+whose replica just went unhealthy — pages freed exactly once in both).
+
+Determinism: every engine + the fleet share one manual FakeClock, and
+the bucket grid is pinned to one shape, so greedy token streams are
+comparable bit-for-bit across clean and failure runs (SERVING.md)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import Fleet, RequestState, ServingEngine
+from paddle_tpu.serving.fleet import ReplicaState
+from paddle_tpu.utils import faults
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = LlamaConfig(vocab_size=128, hidden_size=128,
+                      intermediate_size=256, num_hidden_layers=2,
+                      num_attention_heads=2, num_key_value_heads=1,
+                      max_position_embeddings=128)
+    paddle.seed(0)
+    return LlamaForCausalLM(cfg)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    faults.reset_counts()
+    yield
+    assert not faults.active(), "test leaked an armed fault spec"
+    faults.clear()
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+KW = dict(num_pages=64, page_size=8, token_budget=64,
+          batch_buckets=[8], prefill_buckets=[32], pages_buckets=[8],
+          temperature=0.0)
+
+
+def _fleet(model, n, clock=None, **fleet_kw):
+    clock = clock or FakeClock()
+    engines = [ServingEngine(model, clock=clock, **KW) for _ in range(n)]
+    return Fleet(engines, clock=clock, **fleet_kw), clock
+
+
+def _prompts(k, seed=11):
+    rng = np.random.RandomState(seed)
+    return [(rng.randint(0, 128, (rng.randint(4, 20),)).tolist(),
+             int(rng.randint(3, 9))) for _ in range(k)]
+
+
+def _clean_reference(model, prompts):
+    eng = ServingEngine(model, **KW)
+    rids = [eng.add_request(p, max_new_tokens=m) for p, m in prompts]
+    out = eng.run()
+    eng.shutdown()
+    return [out[r] for r in rids]
+
+
+def _assert_reclaimed(engine):
+    engine.reset_prefix_cache()
+    assert engine.allocator.num_used == 0, "KV pages leaked"
+    engine.allocator.check_invariants()
+
+
+# -------------------------------------------- engine adopt/vacate core
+def test_vacate_releases_everything(model):
+    eng = ServingEngine(model, **KW)
+    for p, m in _prompts(4):
+        eng.add_request(p, max_new_tokens=m)
+    for _ in range(3):
+        eng.step()                         # some in flight, some queued
+    assert eng.allocator.num_used > 0
+    eng.vacate()
+    assert eng.allocator.num_used == 0
+    eng.allocator.check_invariants()
+    # everything terminal: unfinished work marked "migrated", anything
+    # that finished on its own before the vacate keeps its real reason
+    assert all(r.state is RequestState.FINISHED for r in
+               eng.requests.values())
+    assert any(r.finish_reason == "migrated"
+               for r in eng.requests.values())
+    # vacate is not a failure: the engine keeps serving new work
+    rid = eng.add_request([1, 2, 3, 4], max_new_tokens=2)
+    assert len(eng.run()[rid]) == 2
+    eng.shutdown()
+
+
+def test_adopt_requests_resumes_bit_identically(model):
+    prompts = _prompts(3, seed=5)
+    ref = _clean_reference(model, prompts)
+    src = ServingEngine(model, **KW)
+    rids = [src.add_request(p, max_new_tokens=m) for p, m in prompts]
+    for _ in range(4):
+        src.step()                          # partial progress
+    snap = src.snapshot(reason="handoff")
+    src.vacate()
+    _assert_reclaimed(src)
+
+    dst = ServingEngine(model, **KW)
+    extra = dst.add_request([9, 8, 7, 6], max_new_tokens=3)
+    adopted = dst.adopt_requests(snap["requests"])
+    assert set(adopted) == {r for r in rids
+                            if any(rec["request_id"] == r
+                                   for rec in snap["requests"])}
+    out = dst.run()
+    for rid, want in zip(rids, ref):
+        # finished-before-snapshot requests stay on src; the rest
+        # complete on dst — both must match the uninterrupted run
+        holder = dst if rid in adopted else src
+        assert list(holder.requests[rid].output_ids) == want
+    assert adopted, "snapshot carried no live work"
+    assert len(out[extra]) == 3             # the host's own work survives
+    src.shutdown()
+    dst.shutdown()
+
+
+# ------------------------------------------------------ crash failover
+def test_crash_failover_bit_identical(model):
+    prompts = _prompts(6, seed=21)
+    ref = _clean_reference(model, prompts)
+    fleet, _ = _fleet(model, 3)
+    faults.inject("fleet.replica_crash", payload="replica-0",
+                  after=2, times=-1)
+    try:
+        handles = [fleet.submit(p, max_new_tokens=m) for p, m in prompts]
+        fleet.run()
+    finally:
+        faults.clear()
+    dead = fleet.replica("replica-0")
+    assert dead.state is ReplicaState.DEAD
+    assert fleet.counters["replica_deaths"] == 1
+    assert fleet.counters["requests_migrated"] >= 1
+    assert fleet.counters["requests_lost"] == 0
+    # zero loss, zero duplication: streams == uninterrupted run exactly
+    assert [h.tokens for h in handles] == ref
+    assert all(h.finish_reason in ("stop", "length") for h in handles)
+    # dead pool reclaimed fully
+    assert dead.engine.allocator.num_used == 0
+    dead.engine.allocator.check_invariants()
+    for r in fleet.replicas[1:]:
+        _assert_reclaimed(r.engine)
+    fleet.shutdown()
+
+
+def test_engine_failure_midstep_recovers_finished_tokens(model):
+    """A fatal error mid-step kills the emissions of requests that
+    FINISHED earlier in that same step — their tokens must be recovered
+    from the snapshot-excluded Request objects (catch-up), while the
+    rest migrate. Exactly-once: streams equal the clean run."""
+    fleet, _ = _fleet(model, 2)
+    # pre-load replica-1 (engine-level, fleet-invisible) so least-loaded
+    # routing puts BOTH fleet requests on replica-0
+    fleet.replica("replica-1").engine.add_request([50, 51, 52],
+                                                  max_new_tokens=1)
+    # A finishes at its first (only) chunk: max_new_tokens=1
+    ha = fleet.submit([1, 2, 3, 4, 5], max_new_tokens=1)
+    hb = fleet.submit([6, 7, 8, 9, 10, 11], max_new_tokens=4)
+    both = fleet._assign[ha.request_id]
+    assert both.name == "replica-0"
+    assert fleet._assign[hb.request_id] is both
+    # first chunk (A) runs; second chunk (B) raises a FATAL error
+    faults.inject("serving.engine.prefill_chunk",
+                  exc=RuntimeError("INVALID_ARGUMENT: boom"),
+                  after=1, times=1)
+    try:
+        fleet.run()
+    finally:
+        faults.clear()
+    assert both.state is ReplicaState.DEAD
+    assert ha.finished and ha.finish_reason == "length"
+    assert len(ha.tokens) == 1
+    assert fleet.counters["catchup_tokens"] >= 1
+    assert hb.finished and len(hb.tokens) == 4
+    # bit-identity of both vs a clean run
+    ref = _clean_reference(model, [([1, 2, 3, 4, 5], 1),
+                                   ([6, 7, 8, 9, 10, 11], 4)])
+    assert [ha.tokens, hb.tokens] == ref
+    assert both.engine.allocator.num_used == 0
+    fleet.shutdown()
+
+
+def test_vacated_engine_gauges_are_fresh(model):
+    """A vacated (dead) engine never steps again, so vacate() must
+    refresh the metric gauges — otherwise the fleet-merged summary
+    reports the dead replica's last mid-flight queue/pages forever."""
+    fleet, _ = _fleet(model, 2)
+    handles = [fleet.submit(p, max_new_tokens=m)
+               for p, m in _prompts(4, seed=9)]
+    for _ in range(2):
+        fleet.step_all()
+    victim = fleet._assign[handles[0].request_id]
+    assert victim.engine.metrics.kv_used_pages > 0   # mid-flight gauges
+    faults.inject("fleet.replica_crash", payload=victim.name, times=1)
+    try:
+        fleet.step_replica(victim)
+    finally:
+        faults.clear()
+    assert victim.engine.metrics.kv_used_pages == 0
+    assert victim.engine.metrics.queue_depth == 0
+    assert victim.engine.metrics.running == 0
+    survivors_used = sum(r.engine.metrics.kv_used_pages
+                         for r in fleet.replicas if r is not victim)
+    assert fleet.merged_metrics().kv_used_pages == survivors_used
+    fleet.run()
+    fleet.shutdown()
+
+
+def test_migration_to_too_small_survivor_is_lost_not_dropped(model):
+    """A survivor whose geometry cannot hold a migrated request refuses
+    it (adopt raises) — the fleet must finalize that request "lost"
+    and keep processing the rest, never silently drop parked work or
+    leak the exception into an unrelated caller."""
+    clock = FakeClock()
+    big = ServingEngine(model, clock=clock, **KW)
+    small_kw = dict(KW, num_pages=6)       # 5 usable pages = 40 tokens
+    small = ServingEngine(model, clock=clock, **small_kw)
+    fleet = Fleet([big, small], clock=clock)
+    # fits big only: 40 + 8 > small's 40-token capacity; least-loaded
+    # would pick either, so pre-load small to force big
+    small.add_request([1, 2, 3], max_new_tokens=1)
+    h_big = fleet.submit(list(range(40)), max_new_tokens=8)
+    h_ok = fleet.submit(list(range(50, 58)), max_new_tokens=3)
+    assert fleet._assign[h_big.request_id].engine is big
+    assert fleet._assign[h_ok.request_id].engine is big
+    faults.inject("fleet.replica_crash", payload="replica-0", times=1)
+    try:
+        fleet.step_replica(fleet.replicas[0])    # crash -> both parked
+    finally:
+        faults.clear()
+    fleet.run()
+    assert h_big.finished and h_big.finish_reason == "lost"
+    assert h_ok.finished and h_ok.finish_reason in ("stop", "length")
+    assert len(h_ok.tokens) == 3
+    assert fleet.counters["requests_lost"] == 1
+    assert fleet.counters["requests_migrated"] == 1
+    fleet.shutdown()
+
+
+def test_crash_with_no_survivors_finalizes_lost(model):
+    fleet, _ = _fleet(model, 1)
+    h = fleet.submit(list(range(1, 9)), max_new_tokens=4)
+    faults.inject("fleet.replica_crash", payload=True, after=1, times=-1)
+    try:
+        fleet.run()
+    finally:
+        faults.clear()
+    assert h.finished and h.finish_reason == "lost"
+    assert fleet.counters["requests_lost"] == 1
+    assert not fleet.has_work()
+    assert fleet.replicas[0].engine.allocator.num_used == 0
+    fleet.shutdown()
+
+
+# ------------------------------------------------------ stall detection
+def test_stall_detection_migrates(model):
+    prompts = _prompts(4, seed=33)
+    ref = _clean_reference(model, prompts)
+    fleet, clock = _fleet(model, 2, stall_timeout_s=0.5)
+    handles = [fleet.submit(p, max_new_tokens=m) for p, m in prompts]
+    stalled = fleet._assign[handles[0].request_id]
+    faults.inject("fleet.stream_stall", payload=stalled.name, times=-1)
+    try:
+        for _ in range(200):
+            clock.advance(0.1)
+            fleet.step_all()
+            if not fleet.has_work():
+                break
+    finally:
+        faults.clear()
+    assert not fleet.has_work()
+    assert stalled.state is ReplicaState.UNHEALTHY
+    assert fleet.counters["replica_stalls"] == 1
+    assert stalled.stalled_steps >= 1
+    assert [h.tokens for h in handles] == ref
+    assert stalled.engine.allocator.num_used == 0
+    fleet.shutdown()
+
+
+def test_consecutive_failures_evict(model):
+    fleet, _ = _fleet(model, 2, max_consecutive_failures=2)
+    h = fleet.submit(list(range(1, 9)), max_new_tokens=3)
+    r0 = fleet._assign[h.request_id]
+    faults.inject("fleet.replica_crash",
+                  exc=RuntimeError("weird host error"), times=2)
+    try:
+        fleet.step_replica(r0)              # failure 1: stays in rotation
+        assert r0.state is ReplicaState.HEALTHY
+        assert r0.consecutive_failures == 1
+        fleet.step_replica(r0)              # failure 2: evicted
+    finally:
+        faults.clear()
+    assert r0.state is ReplicaState.UNHEALTHY
+    fleet.run()
+    assert h.finished and len(h.tokens) == 3
+    assert r0.engine.allocator.num_used == 0
+    fleet.shutdown()
+
+
+# ---------------------------------------------------------------- drain
+def test_drain_is_zero_loss(model):
+    prompts = _prompts(5, seed=44)
+    ref = _clean_reference(model, prompts)
+    fleet, _ = _fleet(model, 2)
+    handles = [fleet.submit(p, max_new_tokens=m) for p, m in prompts]
+    for _ in range(3):
+        fleet.step_all()
+    n = fleet.drain("replica-0")
+    assert fleet.replica("replica-0").state is ReplicaState.DRAINED
+    assert fleet.counters["replica_drains"] == 1
+    if n:
+        assert fleet.counters["requests_migrated"] >= n
+    assert fleet.replica("replica-0").engine.allocator.num_used == 0
+    fleet.run()
+    assert [h.tokens for h in handles] == ref
+    # a drained replica is out of rotation for NEW work
+    h2 = fleet.submit([5, 4, 3, 2], max_new_tokens=2)
+    assert fleet._assign[h2.request_id].name == "replica-1"
+    fleet.run()
+    fleet.shutdown()
+
+
+# ----------------------- deadline/abort edge interplay (satellite)
+def test_deadline_expires_mid_migration(model):
+    """A request parked between its replica's death and re-landing
+    whose deadline lapses IN THE PARKED WINDOW: adopted with the parked
+    time charged against the deadline, expired at the target's first
+    boundary (before it allocates pages there). Pages freed exactly
+    once: the dead pool at evacuation, nothing on the target."""
+    fleet, clock = _fleet(model, 2)
+    h = fleet.submit(list(range(1, 13)), max_new_tokens=6, ttl_s=5.0)
+    src = fleet._assign[h.request_id]
+    dst = [r for r in fleet.replicas if r is not src][0]
+    fleet.step_replica(src)                  # some tokens in flight
+    faults.inject("fleet.replica_crash", payload=src.name, times=1)
+    try:
+        fleet.step_replica(src)              # crash -> parked
+    finally:
+        faults.clear()
+    assert src.state is ReplicaState.DEAD
+    assert any(rec["request_id"] == h.request_id
+               for _, rec in fleet._parked)
+    assert src.engine.allocator.num_used == 0     # freed exactly once...
+    clock.advance(10.0)                      # ...deadline lapses parked
+    fleet.run()
+    assert h.finished and h.finish_reason == "expired"
+    assert fleet.counters["requests_migrated"] == 1
+    assert dst.engine.metrics.counters["deadline_expired"] == 1
+    # the target never held pages for it (expired before admission)
+    _assert_reclaimed(dst.engine)
+    fleet.shutdown()
+
+
+def test_abort_of_request_on_just_unhealthy_replica(model):
+    """abort() landing in the dead-replica-to-survivor window: the flag
+    rides the parked snapshot record, the target honors it at its first
+    boundary. Pages freed exactly once on each side."""
+    fleet, _ = _fleet(model, 2)
+    h = fleet.submit(list(range(1, 13)), max_new_tokens=6)
+    src = fleet._assign[h.request_id]
+    dst = [r for r in fleet.replicas if r is not src][0]
+    fleet.step_replica(src)
+    got = list(h.tokens)
+    faults.inject("fleet.replica_crash", payload=src.name, times=1)
+    try:
+        fleet.step_replica(src)              # crash -> parked
+    finally:
+        faults.clear()
+    assert fleet._assign.get(h.request_id) is None   # mid-migration
+    assert fleet.abort(h.request_id) is True
+    fleet.run()
+    assert h.finished and h.finish_reason == "abort"
+    assert h.tokens == got                   # no token after the abort
+    assert dst.engine.metrics.counters["requests_aborted"] == 1
+    assert src.engine.allocator.num_used == 0
+    src.engine.allocator.check_invariants()
+    _assert_reclaimed(dst.engine)
+    # double-abort of a finished request: refused
+    assert fleet.abort(h.request_id) is False
+    fleet.shutdown()

@@ -1,0 +1,207 @@
+"""ServingMetrics.merge cross-replica aggregation (satellite: verified
+against a hand-computed merge) and the snapshot schema-version stamp
+(satellite: `SnapshotVersionError` — migration/resume fails loud on a
+version it would misread)."""
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import (Fleet, ServingEngine, ServingMetrics,
+                                SnapshotVersionError)
+from paddle_tpu.serving.engine import (SNAPSHOT_VERSION,
+                                       check_snapshot_version)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = LlamaConfig(vocab_size=128, hidden_size=128,
+                      intermediate_size=256, num_hidden_layers=2,
+                      num_attention_heads=2, num_key_value_heads=1,
+                      max_position_embeddings=128)
+    paddle.seed(0)
+    return LlamaForCausalLM(cfg)
+
+
+KW = dict(num_pages=64, page_size=8, token_budget=64,
+          batch_buckets=[8], prefill_buckets=[32], pages_buckets=[8],
+          temperature=0.0)
+
+
+# ------------------------------------------------------------ merge
+def test_merge_hand_computed():
+    a = ServingMetrics(name="a")
+    b = ServingMetrics(name="b")
+    a.counters["requests_added"] = 3
+    b.counters["requests_added"] = 5
+    a.counters["decode_tokens"] = 100
+    b.counters["decode_tokens"] = 40
+    a.counters["prefix_hits"] = 2
+    a.counters["admissions"] = 4
+    b.counters["admissions"] = 6
+    # reservoirs: percentiles of the UNION, hand-computed
+    a._ttft_samples.extend([0.010, 0.030])
+    b._ttft_samples.extend([0.020, 0.040])
+    a._queue_wait_samples.extend([0.001])
+    b._queue_wait_samples.extend([0.003, 0.005])
+    a._ttft_sum, a._ttft_count = 0.040, 2
+    b._ttft_sum, b._ttft_count = 0.060, 2
+
+    m = ServingMetrics.merge(a, b)
+    assert m.counters["requests_added"] == 8
+    assert m.counters["decode_tokens"] == 140
+    assert m.counters["admissions"] == 10
+    assert m.counters["prefix_hits"] == 2
+    # mean TTFT = (0.040 + 0.060) / 4
+    assert m.mean_ttft() == pytest.approx(0.025)
+    # union [0.010, 0.030, 0.020, 0.040]: nearest-rank p50 over the
+    # sorted union picks index round(0.5 * 3) = 2 -> 0.030
+    pct = m.reservoir_percentiles("ttft")
+    assert pct["p50"] == pytest.approx(0.030)
+    assert pct["p99"] == pytest.approx(0.040)
+    qw = m.reservoir_percentiles("queue_wait")
+    assert qw["p50"] == pytest.approx(0.003)
+    # fleet-wide hit rate derives from merged counters: 2 / 10
+    assert m.prefix_hit_rate() == pytest.approx(0.2)
+    # snapshot auto-exposes the merged reservoirs (ms-scaled)
+    snap = m.snapshot()
+    assert snap["ttft_p50_ms"] == pytest.approx(30.0)
+    assert snap["queue_wait_p50_ms"] == pytest.approx(3.0)
+    # the merge view is unregistered: no provider side effects to undo
+    assert not m._registered
+
+
+def test_merge_overflowing_reservoirs_stay_balanced():
+    """When the union of per-replica reservoirs overflows the window,
+    the merge keeps a balanced newest-first draw from EVERY replica —
+    not just whichever was merged last."""
+    from paddle_tpu.serving.metrics import PERCENTILE_WINDOW
+    a = ServingMetrics(name="a")
+    b = ServingMetrics(name="b")
+    a._ttft_samples.extend([1.0] * PERCENTILE_WINDOW)   # slow replica
+    b._ttft_samples.extend([3.0] * PERCENTILE_WINDOW)   # slower replica
+    m = ServingMetrics.merge(a, b)
+    merged = m._reservoirs["ttft"]
+    assert len(merged) == PERCENTILE_WINDOW
+    assert merged.count(1.0) == PERCENTILE_WINDOW // 2
+    assert merged.count(3.0) == PERCENTILE_WINDOW // 2
+    # median reflects both replicas, p99 the slow one
+    assert m.reservoir_percentiles("ttft")["p99"] == pytest.approx(3.0)
+
+
+def test_adopted_requests_do_not_double_count_arrivals(model):
+    """Fleet-merged counters include dead replicas, so a migrated
+    request must count as ONE arrival fleet-wide: `requests_added` on
+    its original engine, `requests_adopted` on the target."""
+    src = ServingEngine(model, **KW)
+    dst = ServingEngine(model, **KW)
+    src.add_request([1, 2, 3, 4, 5, 6, 7, 8], max_new_tokens=4)
+    src.step()
+    snap = src.snapshot(reason="handoff")
+    src.vacate()
+    dst.adopt_requests(snap["requests"])
+    dst.run()
+    m = ServingMetrics.merge(src.metrics, dst.metrics)
+    assert m.counters["requests_added"] == 1
+    assert m.counters["requests_adopted"] == 1
+    src.shutdown()
+    dst.shutdown()
+
+
+def test_merge_identity_and_gauges():
+    a = ServingMetrics(name="a")
+    a.counters["engine_steps"] = 7
+    a.queue_depth, a.running = 2, 3
+    a.set_kv_info(kv_dtype="bfloat16", page_bytes=1024,
+                  pool_bytes=64 * 1024, bytes_per_token=256)
+    a.kv_used_pages, a.kv_occupancy = 16, 0.25
+    b = ServingMetrics(name="b")
+    b.set_kv_info(kv_dtype="bfloat16", page_bytes=1024,
+                  pool_bytes=64 * 1024, bytes_per_token=256)
+    b.kv_used_pages, b.kv_occupancy = 48, 0.75
+    m = ServingMetrics.merge(a, b)
+    assert m.counters["engine_steps"] == 7
+    assert m.queue_depth == 2 and m.running == 3
+    assert m.kv_used_pages == 64
+    assert m.kv_pool_bytes == 2 * 64 * 1024
+    # pooled occupancy: 64 used of 128 total pages
+    assert m.kv_occupancy == pytest.approx(0.5)
+    # homogeneous geometry passes through verbatim
+    assert m.kv_page_bytes == 1024 and m.kv_dtype == "bfloat16"
+    # heterogeneous pools: pooled bytes stay exact, but the per-page
+    # gauges become sentinels instead of whichever merged last
+    c = ServingMetrics(name="c")
+    c.set_kv_info(kv_dtype="int8", page_bytes=512,
+                  pool_bytes=64 * 1024, bytes_per_token=128)
+    h = ServingMetrics.merge(a, c)
+    assert h.kv_pool_bytes == 2 * 64 * 1024
+    assert h.kv_page_bytes == 0 and h.kv_dtype == "mixed"
+    # the pooled bytes + mixed sentinel still SURFACE in the summary
+    hsnap = h.snapshot()
+    assert hsnap["kv_pool_bytes"] == 2 * 64 * 1024
+    assert hsnap["kv_dtype"] == "mixed"
+    # occupancy still pools true page counts: 64 + 128 total pages
+    c.kv_used_pages = 0
+    h2 = ServingMetrics.merge(a, c)
+    assert h2.kv_occupancy == pytest.approx(16 / 192)
+
+
+def test_fleet_summary_merges_replicas(model):
+    engines = [ServingEngine(model, **KW) for _ in range(2)]
+    fleet = Fleet(engines)
+    hs = [fleet.submit(list(range(1, 9)), max_new_tokens=3)
+          for _ in range(4)]
+    fleet.run()
+    summary = fleet.summary()
+    fleet.shutdown()
+    per = [e.metrics.counters for e in engines]
+    assert summary["requests_added"] == sum(c["requests_added"]
+                                            for c in per) == 4
+    assert summary["decode_tokens"] == sum(c["decode_tokens"]
+                                           for c in per)
+    assert summary["fleet_requests_submitted"] == 4
+    assert summary["fleet_requests_finished"] == 4
+    assert summary["replica_states"] == {"replica-0": "healthy",
+                                         "replica-1": "healthy"}
+    assert all(h.finished for h in hs)
+
+
+# ------------------------------------- snapshot version (satellite)
+def test_snapshot_is_stamped(model):
+    eng = ServingEngine(model, **KW)
+    eng.add_request([1, 2, 3, 4], max_new_tokens=2)
+    snap = eng.snapshot(reason="test")
+    assert snap["version"] == SNAPSHOT_VERSION
+    check_snapshot_version(snap)             # current stamp passes
+    eng.shutdown()
+
+
+@pytest.mark.parametrize("bad", [None, 0, SNAPSHOT_VERSION + 1, "1"])
+def test_from_snapshot_rejects_versions(model, bad):
+    eng = ServingEngine(model, **KW)
+    eng.add_request([1, 2, 3, 4], max_new_tokens=2)
+    snap = eng.snapshot(reason="test")
+    eng.shutdown()
+    snap["version"] = bad
+    with pytest.raises(SnapshotVersionError) as ei:
+        ServingEngine.from_snapshot(model, snap, **KW)
+    assert ei.value.found == bad
+    assert ei.value.expected == SNAPSHOT_VERSION
+    # typed AND backward compatible with the old untyped rejection
+    assert isinstance(ei.value, ValueError)
+    del snap["version"]
+    with pytest.raises(SnapshotVersionError):
+        ServingEngine.from_snapshot(model, snap, **KW)
+
+
+def test_fleet_evacuation_checks_version(model):
+    """Live migration refuses a mismatched snapshot the same way —
+    `_evacuate` funnels through the shared check."""
+    engines = [ServingEngine(model, **KW) for _ in range(2)]
+    fleet = Fleet(engines)
+    fleet.submit([1, 2, 3, 4, 5, 6], max_new_tokens=4)
+    bad = engines[0].snapshot(reason="tampered")
+    bad["version"] = 99
+    with pytest.raises(SnapshotVersionError):
+        fleet._evacuate(fleet.replicas[0], bad)
+    fleet.run()
+    fleet.shutdown()

@@ -1,0 +1,383 @@
+"""Fleet streaming API (ISSUE 7): the asyncio front-end — submit ->
+async token iterator (OpenAI-style deltas + one finish event), per-
+replica stepping loops, drain-during-stream, and the admission layer
+(per-tenant fairness, SLO targets -> deadline + shed machinery)."""
+import asyncio
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import (EngineOverloaded, Fleet, FleetServer,
+                                ServingEngine)
+from paddle_tpu.serving.fleet import (NoHealthyReplica, SloUnattainable,
+                                      TenantThrottled)
+from paddle_tpu.utils import faults
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = LlamaConfig(vocab_size=128, hidden_size=128,
+                      intermediate_size=256, num_hidden_layers=2,
+                      num_attention_heads=2, num_key_value_heads=1,
+                      max_position_embeddings=128)
+    paddle.seed(0)
+    return LlamaForCausalLM(cfg)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    faults.reset_counts()
+    yield
+    assert not faults.active(), "test leaked an armed fault spec"
+    faults.clear()
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        self.t += 1e-4            # ticks per observation, never stalls
+        return self.t
+
+
+KW = dict(num_pages=64, page_size=8, token_budget=64,
+          batch_buckets=[8], prefill_buckets=[32], pages_buckets=[8],
+          temperature=0.0)
+
+
+def _fleet(model, n, clock=None, **fleet_kw):
+    engines = [ServingEngine(model, clock=clock, **KW) for _ in range(n)]
+    return Fleet(engines, clock=clock, **fleet_kw)
+
+
+def _reference(model, prompts):
+    eng = ServingEngine(model, **KW)
+    rids = [eng.add_request(p, max_new_tokens=m) for p, m in prompts]
+    out = eng.run()
+    eng.shutdown()
+    return [out[r] for r in rids]
+
+
+# ------------------------------------------------------------ streaming
+def test_stream_event_shape(model):
+    fleet = _fleet(model, 2)
+
+    async def go():
+        async with FleetServer(fleet, idle_sleep_s=0.0) as server:
+            stream = await server.submit([1, 2, 3, 4, 5],
+                                         max_new_tokens=3)
+            return [ev async for ev in stream]
+
+    events = asyncio.run(go())
+    fleet.shutdown()
+    assert [e["type"] for e in events] == ["token"] * 3 + ["finish"]
+    assert [e["index"] for e in events[:3]] == [0, 1, 2]
+    assert events[-1]["finish_reason"] == "length"
+    assert events[-1]["num_tokens"] == 3
+    assert len({e["request_id"] for e in events}) == 1
+
+
+def test_concurrent_streams_match_reference(model):
+    rng = np.random.RandomState(3)
+    prompts = [(rng.randint(0, 128, (rng.randint(4, 16),)).tolist(),
+                int(rng.randint(2, 7))) for _ in range(8)]
+    ref = _reference(model, prompts)
+    fleet = _fleet(model, 3)
+
+    async def go():
+        async with FleetServer(fleet, idle_sleep_s=0.0) as server:
+            streams = [await server.submit(p, max_new_tokens=m)
+                       for p, m in prompts]
+            return await asyncio.gather(*[s.collect() for s in streams])
+
+    results = asyncio.run(go())
+    fleet.shutdown()
+    assert [toks for toks, _ in results] == ref
+    assert all(reason in ("stop", "length") for _, reason in results)
+
+
+def test_generate_and_late_stream_replay(model):
+    fleet = _fleet(model, 1)
+
+    async def go():
+        async with FleetServer(fleet, idle_sleep_s=0.0) as server:
+            toks, reason = await server.generate([2, 4, 6, 8],
+                                                 max_new_tokens=4)
+            # attach a stream AFTER completion: events replay in full
+            from paddle_tpu.serving import TokenStream
+            handle = fleet.handle(
+                next(iter(fleet._handles)))
+            replay = TokenStream(handle)
+            evs = [ev async for ev in replay]
+            return toks, reason, evs
+
+    toks, reason, evs = asyncio.run(go())
+    fleet.shutdown()
+    assert reason == "length" and len(toks) == 4
+    assert [e.get("token") for e in evs[:-1]] == toks
+    assert evs[-1]["type"] == "finish"
+
+
+def test_two_streams_on_one_handle_both_complete(model):
+    """A second TokenStream on the same handle must not detach the
+    first — every subscriber sees every event."""
+    from paddle_tpu.serving import TokenStream
+    fleet = _fleet(model, 1)
+
+    async def go():
+        async with FleetServer(fleet, idle_sleep_s=0.0) as server:
+            first = await server.submit([1, 2, 3, 4], max_new_tokens=3)
+            second = TokenStream(first.handle)
+            return await asyncio.gather(first.collect(),
+                                        second.collect())
+
+    (toks1, r1), (toks2, r2) = asyncio.run(go())
+    fleet.shutdown()
+    assert toks1 == toks2 and len(toks1) == 3
+    assert r1 == r2 == "length"
+
+
+def test_stream_close_wakes_blocked_consumer(model):
+    """close() from another task must release a consumer blocked in
+    __anext__ (synthetic finish event), and attaching a stream to an
+    already-finished handle must not pin a listener on it."""
+    from paddle_tpu.serving import TokenStream
+    fleet = _fleet(model, 1)
+
+    async def go():
+        async with FleetServer(fleet, idle_sleep_s=0.0) as server:
+            stream = await server.submit([1, 2, 3, 4],
+                                         max_new_tokens=30)
+
+            async def consume():
+                return [ev async for ev in stream]
+
+            task = asyncio.ensure_future(consume())
+            await asyncio.sleep(0)          # let it block in __anext__
+            stream.close()
+            events = await asyncio.wait_for(task, timeout=5)
+            assert events[-1]["finish_reason"] == "closed"
+            # the handle no longer references the closed stream's queue
+            assert stream._q.put_nowait not in stream.handle._listeners
+            await server.abort(stream.request_id)
+            while not stream.handle.finished:
+                await asyncio.sleep(0)
+            # late attach to a finished handle: replay only, no listener
+            late = TokenStream(stream.handle)
+            assert stream.handle._listeners == []
+            return await late.collect()
+
+    toks, reason = asyncio.run(go())
+    fleet.shutdown()
+    assert reason == "abort"
+    assert toks == stream_tokens_of(fleet)
+
+
+def stream_tokens_of(fleet):
+    h = next(iter(fleet._handles.values()))
+    return list(h.tokens)
+
+
+def test_drain_during_stream_is_seamless(model):
+    prompts = [(list(range(1, 12)), 8), (list(range(20, 28)), 6)]
+    ref = _reference(model, prompts)
+    fleet = _fleet(model, 2)
+
+    async def go():
+        async with FleetServer(fleet, idle_sleep_s=0.0) as server:
+            streams = [await server.submit(p, max_new_tokens=m)
+                       for p, m in prompts]
+            # let some tokens flow, then drain whatever replica holds
+            # the first stream
+            while not streams[0].handle.tokens:
+                await asyncio.sleep(0)
+            victim = fleet._assign[streams[0].request_id].name
+            moved = await server.drain(victim)
+            assert moved >= 1
+            return await asyncio.gather(*[s.collect() for s in streams])
+
+    results = asyncio.run(go())
+    assert [toks for toks, _ in results] == ref
+    assert fleet.counters["replica_drains"] == 1
+    assert fleet.counters["requests_migrated"] >= 1
+    fleet.shutdown()
+
+
+def test_abort_via_server(model):
+    fleet = _fleet(model, 2)
+
+    async def go():
+        async with FleetServer(fleet, idle_sleep_s=0.0) as server:
+            stream = await server.submit(list(range(1, 9)),
+                                         max_new_tokens=30)
+            while not stream.handle.tokens:
+                await asyncio.sleep(0)
+            assert await server.abort(stream.request_id)
+            return await stream.collect()
+
+    toks, reason = asyncio.run(go())
+    fleet.shutdown()
+    assert reason == "abort"
+    assert len(toks) < 30
+
+
+# ------------------------------------------------- admission: fairness
+def test_tenant_fairness_cap(model):
+    fleet = _fleet(model, 2, max_inflight_per_tenant=2)
+    fleet.submit([1, 2, 3], max_new_tokens=2, tenant="a")
+    fleet.submit([4, 5, 6], max_new_tokens=2, tenant="a")
+    with pytest.raises(TenantThrottled) as ei:
+        fleet.submit([7, 8, 9], max_new_tokens=2, tenant="a")
+    assert ei.value.tenant == "a" and ei.value.limit == 2
+    assert isinstance(ei.value, EngineOverloaded)   # uniform shed class
+    # another tenant is unaffected by a's cap
+    hb = fleet.submit([7, 8, 9], max_new_tokens=2, tenant="b")
+    fleet.run()
+    assert hb.finished
+    # a's slots free up once its requests finish
+    ha = fleet.submit([9, 9, 9], max_new_tokens=2, tenant="a")
+    fleet.run()
+    assert ha.finished
+    assert fleet.counters["tenant_throttled"] == 1
+    fleet.shutdown()
+
+
+# ------------------------------------------------- admission: SLO-aware
+def test_slo_targets_become_deadlines(model):
+    """TTFT/TPOT targets convert into the engine deadline machinery: a
+    request whose SLO the (fake-clock) engine cannot meet is expired by
+    the EXISTING deadline path, not a new mechanism."""
+    clock = FakeClock()
+    fleet = _fleet(model, 1, clock=clock)
+    h = fleet.submit(list(range(1, 9)), max_new_tokens=4,
+                     ttft_slo_s=1e-4, tpot_slo_s=1e-5)
+    fleet.run()
+    assert h.finish_reason == "expired"
+    # a generous SLO completes normally
+    h2 = fleet.submit(list(range(1, 9)), max_new_tokens=4,
+                      ttft_slo_s=1e3, tpot_slo_s=1e3)
+    fleet.run()
+    assert h2.finish_reason == "length"
+    # ttft-only sets NO lifetime bound: the TTFT budget must not
+    # expire a request mid-generation after its first token met it
+    h3 = fleet.submit(list(range(1, 9)), max_new_tokens=4,
+                      ttft_slo_s=1e-4)
+    fleet.run()
+    assert h3.finish_reason == "length"
+    fleet.shutdown()
+
+
+def test_slo_admission_shed(model):
+    fleet = _fleet(model, 2, est_ttft_per_queued_s=1.0)
+    # queue depth 1 everywhere -> estimated TTFT 1s > the 0.5s target
+    for r in fleet.replicas:
+        r.engine.add_request([1, 2, 3], max_new_tokens=1)
+    with pytest.raises(SloUnattainable) as ei:
+        fleet.submit([4, 5, 6], max_new_tokens=2, ttft_slo_s=0.5)
+    assert ei.value.est_ttft_s == 1.0
+    assert fleet.counters["slo_sheds"] == 1
+    # without a TTFT target the same submission is admitted
+    h = fleet.submit([4, 5, 6], max_new_tokens=2, tpot_slo_s=1e3)
+    fleet.run()
+    assert h.finished
+    fleet.shutdown()
+
+
+def test_slo_shed_scores_the_chosen_replica(model):
+    """The admission estimate must score the replica the request would
+    LAND on: a prefix-warm replica with a deep queue is excluded and
+    the request re-routes to one that can meet the target, instead of
+    passing on the fleet-minimum queue and then routing into the deep
+    one (accepted-to-expire)."""
+    fleet = _fleet(model, 2, est_ttft_per_queued_s=1.0)
+    shared = list(range(1, 17))
+    h0 = fleet.submit(shared + [20, 21], max_new_tokens=2)
+    fleet.run()
+    warm = [r for r in fleet.replicas if r.match_len(shared) > 0][0]
+    cold = [r for r in fleet.replicas if r is not warm][0]
+    for k in (0, 1):
+        warm.engine.add_request([60 + k], max_new_tokens=1)
+    # affinity would pick `warm` (queue 2 -> est 2.0 > 1.5): the SLO
+    # check must exclude it and land on `cold` (est 0.0), not shed
+    h = fleet.submit(shared + [30, 31], max_new_tokens=2,
+                     ttft_slo_s=1.5)
+    assert fleet._assign[h.request_id] is cold
+    assert fleet.counters["slo_sheds"] == 0
+    fleet.run()
+    assert h.finished and h0.finished
+    fleet.shutdown()
+
+
+def test_stall_detection_saturation_guard(model):
+    """Equally-stale heartbeats mean the stepping loop itself is slow,
+    not that a replica stalled: nobody is evicted until some OTHER
+    replica demonstrably progresses past the suspect."""
+    from paddle_tpu.serving.fleet import ReplicaState
+
+    class ManualClock:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clock = ManualClock()
+    engines = [ServingEngine(model, clock=clock, **KW) for _ in range(2)]
+    fleet = Fleet(engines, clock=clock, stall_timeout_s=0.5)
+    fleet.submit(list(range(1, 9)), max_new_tokens=4)
+    fleet.submit(list(range(20, 28)), max_new_tokens=4)
+    assert all(len(v) == 1 for v in fleet._by_replica.values())
+    clock.t += 10.0        # both heartbeats equally stale: saturation
+    fleet.check_health()
+    assert all(r.state is ReplicaState.HEALTHY for r in fleet.replicas)
+    # one replica progresses; the other is now demonstrably stuck
+    fleet.step_replica(fleet.replicas[0])
+    clock.t += 10.0
+    fleet.step_replica(fleet.replicas[0])
+    fleet.check_health()
+    assert fleet.replicas[0].state is ReplicaState.HEALTHY
+    assert fleet.replicas[1].state is ReplicaState.UNHEALTHY
+    fleet.run()
+    fleet.shutdown()
+
+
+def test_finished_handle_retention_is_bounded(model):
+    fleet = _fleet(model, 1, max_retained_handles=2)
+    handles = [fleet.submit([1 + i, 2, 3], max_new_tokens=1)
+               for i in range(4)]
+    fleet.run()
+    assert all(h.finished for h in handles)       # callers' refs live on
+    assert fleet.num_evicted_handles == 2
+    retained = [h for h in handles if h.request_id in fleet._handles]
+    assert len(retained) == 2
+    fleet.shutdown()
+
+
+def test_slo_and_ttl_are_exclusive(model):
+    fleet = _fleet(model, 1)
+    with pytest.raises(ValueError):
+        fleet.submit([1, 2, 3], max_new_tokens=2, ttft_slo_s=1.0,
+                     ttl_s=5.0)
+    fleet.shutdown()
+
+
+def test_overload_sheds_after_trying_every_replica(model):
+    fleet = _fleet(model, 2)
+    for r in fleet.replicas:
+        r.engine.scheduler.max_queue_len = 1
+        r.engine.add_request([1, 2, 3], max_new_tokens=1)
+    with pytest.raises(EngineOverloaded):
+        fleet.submit([4, 5, 6], max_new_tokens=1)
+    assert fleet.counters["requests_shed"] == 1
+    fleet.shutdown()
+
+
+def test_no_healthy_replica(model):
+    fleet = _fleet(model, 1)
+    fleet.drain("replica-0")
+    with pytest.raises(NoHealthyReplica):
+        fleet.submit([1, 2, 3], max_new_tokens=1)
+    fleet.shutdown()

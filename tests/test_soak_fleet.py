@@ -1,0 +1,25 @@
+"""Slow wrapper for the multi-replica fleet chaos soak (ISSUE 7
+acceptance). Excluded from tier-1 by the `slow` marker (pytest.ini
+addopts runs `-m "not slow"` by default); run it with `make soak-fleet`
+or `pytest tests/test_soak_fleet.py -m slow`."""
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+@pytest.mark.slow
+def test_soak_fleet_120_requests_kill_and_stall():
+    from tools import soak_fleet
+    assert soak_fleet.main(["--requests", "120", "--seed", "0"]) == 0
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [1, 2])
+def test_soak_fleet_other_seeds(seed):
+    from tools import soak_fleet
+    assert soak_fleet.main(["--requests", "60", "--seed", str(seed)]) == 0

@@ -1,0 +1,325 @@
+"""Multi-replica chaos soak for the fleet front-end (ISSUE 7).
+
+Runs the SAME seeded shared-prefix-heavy workload four times on CPU:
+
+* `single`  — one replica, prefix-affinity router, no faults: the
+  PR-2-style single-replica radix baseline the routing criterion is
+  measured against;
+* `clean`   — three replicas, prefix-affinity router, no faults: the
+  reference token streams;
+* `chaos`   — three replicas, prefix-affinity router, with a seeded
+  KILL of replica-0 mid-stream (`fleet.replica_crash`), a permanent
+  STALL of replica-1 (`fleet.stream_stall` -> stall detector), routing
+  races, injected allocator OOM, and transient step errors;
+* `random`  — three replicas, seeded RandomRouter, no faults: the
+  routing-criterion strawman.
+
+Acceptance assertions (ISSUE 7):
+
+* zero-loss failover: EVERY accepted request completes in the chaos
+  pass, with its token stream BIT-IDENTICAL to the clean pass (zero
+  lost requests, zero duplicated or reordered tokens — migration
+  preserves tokens-so-far and greedy continuation is deterministic
+  under the pinned bucket grid);
+* full page/refcount reclamation on every replica's pool — including
+  the killed and the stalled one (vacate at evacuation);
+* prefix-affinity routing measurably works: fleet-level radix hits in
+  `clean` >= the `single` baseline, and strictly > `random`;
+* every fault point armed in the chaos pass actually fired.
+
+Deterministic end to end: workload, fault schedule, stepping order and
+the shared engine/fleet clock all derive from --seed; wall-clock never
+enters any engine. Bounded runtime: hard step ceiling.
+
+Usage:  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+            python tools/soak_fleet.py [--requests 120] [--seed 0]
+(or `make soak-fleet`). Exits 0 on success, 1 with a report on
+violation — a test harness like soak_serving.py, allowed to fail loud.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# CPU pin BEFORE jax initializes (the hosting image's sitecustomize
+# force-registers a TPU platform; mirror tests/conftest.py)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax                                                   # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np                                           # noqa: E402
+
+import paddle_tpu as paddle                                  # noqa: E402
+from paddle_tpu.models.llama import (LlamaConfig,            # noqa: E402
+                                     LlamaForCausalLM)
+from paddle_tpu.serving import (EngineOverloaded,            # noqa: E402
+                                Fleet, PrefixAffinityRouter,
+                                RandomRouter, RetryPolicy,
+                                ServingEngine, TransientDeviceError)
+from paddle_tpu.utils import faults                          # noqa: E402
+
+# single-bucket grid: every pass hits identical program shapes, so the
+# bit-identity comparison across clean/chaos is exact (SERVING.md
+# determinism contract) — same discipline as soak_serving.py.
+ENGINE_KW = dict(num_pages=40, page_size=8, token_budget=48,
+                 batch_buckets=[8], prefill_buckets=[32], pages_buckets=[8],
+                 temperature=0.0, max_queue_len=32)
+STALL_TIMEOUT_S = 0.2   # ~200 clock ticks; detection within tens of steps
+MAX_STEPS_FACTOR = 400  # hard ceiling: steps <= factor * num_requests
+MAX_LIVE = 8            # client-side concurrency cap (see run_pass)
+WARMUP = 2              # bare-prefix warmup requests (make_workload)
+
+
+class FakeClock:
+    """Shared engine+fleet clock: a fixed tick per observation, so
+    heartbeat ages and deadlines are functions of call counts, never
+    host wall-clock."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        self.t += 1e-3
+        return self.t
+
+
+def make_workload(n, seed):
+    """Shared-prefix-heavy mix: two 2-page shared prefixes (the
+    affinity router should pin each to one replica) + random fill.
+    The first WARMUP requests carry each bare prefix — run_pass drains
+    them before the main traffic so the hit-rate comparison measures
+    ROUTING, not the admission race of a cold cache (two cold replicas
+    can each admit a shared-prefix request before either donates, a
+    concurrency artifact every router suffers equally)."""
+    rng = np.random.RandomState(seed)
+    prefix_a = rng.randint(0, 128, (16,)).tolist()
+    prefix_b = rng.randint(0, 128, (16,)).tolist()
+    work = [(list(prefix_a), 4), (list(prefix_b), 4)]
+    for _ in range(n):
+        u = rng.random()
+        if u < 0.30:
+            p = prefix_a + rng.randint(0, 128, (rng.randint(2, 8),)).tolist()
+        elif u < 0.55:
+            p = prefix_b + rng.randint(0, 128, (rng.randint(2, 8),)).tolist()
+        else:
+            p = rng.randint(0, 128, (rng.randint(4, 24),)).tolist()
+        work.append((p, int(rng.randint(3, 10))))
+    return work
+
+
+def run_pass(model, work, *, n_replicas, router, chaos, seed, report,
+             label):
+    """One full soak pass; returns {workload idx: token stream}."""
+    clock = FakeClock()
+    engines = [ServingEngine(
+        model, clock=clock,
+        retry_policy=RetryPolicy(max_retries=12, base_s=0.0,
+                                 sleep=lambda s: None),
+        **ENGINE_KW) for _ in range(n_replicas)]
+    fleet = Fleet(engines, router=router, clock=clock,
+                  stall_timeout_s=STALL_TIMEOUT_S)
+    armed = set()
+
+    def arm(name, **kwargs):
+        faults.inject(name, **kwargs)
+        armed.add(name)
+
+    if chaos:
+        # THE kill: replica-0 dies at its first step past the warmup
+        # window — mid-stream, with requests in every state. times=-1 +
+        # a name: other replicas consume firings and ignore them, the
+        # victim cannot miss.
+        arm("fleet.replica_crash", payload="replica-0", after=20,
+            times=-1)
+        # permanent stall of replica-1 a little later (hits accrue ~2
+        # per fleet step once replica-0 is dead): the heartbeat stops,
+        # the stall detector drains it around the wedge
+        arm("fleet.stream_stall", payload="replica-1", after=60,
+            times=-1)
+        # routing races: the chosen replica "goes unhealthy between
+        # scoring and submission"
+        arm("fleet.route_race", payload=True, after=5, times=3)
+        # engine-level noise underneath the fleet faults: transient
+        # launch errors (retried in place; totals < max_retries by
+        # construction) and allocator OOM (reclamation ladder)
+        arm("serving.engine.prefill_chunk",
+            exc=TransientDeviceError("soak: UNAVAILABLE"),
+            after=3, times=1)
+        arm("serving.engine.prefill_chunk",
+            exc=TransientDeviceError("soak: UNAVAILABLE"),
+            prob=0.02, times=9, seed=seed + 2)
+        arm("serving.engine.decode_step",
+            exc=TransientDeviceError("soak: relay loss"),
+            after=4, times=1)
+        arm("serving.engine.decode_step",
+            exc=TransientDeviceError("soak: relay loss"),
+            prob=0.02, times=9, seed=seed + 3)
+        arm("serving.kv.alloc_page", payload=True, after=5, times=2)
+        arm("serving.kv.alloc_page", payload=True,
+            prob=0.03, times=12, seed=seed + 4)
+
+    idx_of = {}
+    handles = []
+    pending = list(enumerate(work))
+    sheds = 0
+    steps = 0
+    max_steps = MAX_STEPS_FACTOR * max(1, len(work))
+    try:
+        # warmup wave: the bare-prefix requests drain first (and donate
+        # each prefix into exactly one replica's radix tree)
+        for _ in range(WARMUP):
+            i, (p, m) = pending.pop(0)
+            h = fleet.submit(p, max_new_tokens=m)
+            idx_of[h.request_id] = i
+            handles.append(h)
+        while fleet.has_work():
+            fleet.step_all()
+            steps += 1
+        while pending or fleet.has_work():
+            # fixed client-side concurrency (same offered load in every
+            # pass, whatever the replica count): the routing criterion
+            # compares hit rates, so the single-replica baseline and
+            # the fleet must see the same admission dynamics — without
+            # the cap the 3-replica fleet admits 3x faster and more
+            # shared-prefix requests arrive before the first donation
+            # (a cold-start artifact, not a routing property)
+            admitted = 0
+            while pending and admitted < 4 and \
+                    sum(1 for h in handles if not h.finished) < MAX_LIVE:
+                i, (p, m) = pending[0]
+                try:
+                    h = fleet.submit(p, max_new_tokens=m)
+                except EngineOverloaded:
+                    sheds += 1
+                    break
+                idx_of[h.request_id] = i
+                handles.append(h)
+                pending.pop(0)
+                admitted += 1
+            fleet.step_all()
+            steps += 1
+            if steps > max_steps:
+                raise AssertionError(
+                    f"[{label}] failed to drain after {steps} steps")
+
+        out = {}
+        reasons = {}
+        for rid, i in idx_of.items():
+            h = fleet.handle(rid)
+            assert h.finished, f"[{label}] request {i} never finished"
+            reasons[h.finish_reason] = reasons.get(h.finish_reason, 0) + 1
+            out[i] = list(h.tokens)
+
+        # ---- reclamation on EVERY pool (killed/stalled included) ----
+        for r in fleet.replicas:
+            if r.engine.radix is not None:
+                r.engine.radix.check_invariants()
+            r.engine.reset_prefix_cache()
+            assert r.engine.allocator.num_used == 0, \
+                f"[{label}] {r.name} leaked KV pages"
+            r.engine.allocator.check_invariants()
+
+        snap = fleet.merged_metrics().snapshot()
+        report[label] = {
+            "steps": steps, "sheds": sheds,
+            "finish_reasons": reasons,
+            "replica_states": {r.name: r.state.value
+                               for r in fleet.replicas},
+            "prefix_hits": snap["prefix_hits"],
+            "cached_tokens_served": snap["cached_tokens_served"],
+            "preemptions": snap["requests_preempted"],
+            "step_retries": snap["step_retries"],
+            "migrated": fleet.counters["requests_migrated"],
+            "catchup_tokens": fleet.counters["catchup_tokens"],
+            "lost": fleet.counters["requests_lost"],
+            "deaths": fleet.counters["replica_deaths"],
+            "stalls": fleet.counters["replica_stalls"],
+            "route_races": fleet.counters["route_races"],
+        }
+        if chaos:
+            fired = faults.fired_counts()
+            report[f"fired_{label}"] = fired
+            for pt in sorted(armed):
+                assert fired.get(pt, 0) >= 1, \
+                    f"[{label}] armed fault point {pt} never fired"
+        return out
+    finally:
+        faults.clear()
+        faults.reset_counts()
+        fleet.shutdown()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--requests", type=int, default=120)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    cfg = LlamaConfig(vocab_size=128, hidden_size=128,
+                      intermediate_size=256, num_hidden_layers=2,
+                      num_attention_heads=2, num_key_value_heads=1,
+                      max_position_embeddings=128)
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    work = make_workload(args.requests, args.seed)
+
+    report = {"requests": args.requests, "seed": args.seed}
+    t0 = time.perf_counter()
+    single = run_pass(model, work, n_replicas=1,
+                      router=PrefixAffinityRouter(), chaos=False,
+                      seed=args.seed, report=report, label="single")
+    clean = run_pass(model, work, n_replicas=3,
+                     router=PrefixAffinityRouter(), chaos=False,
+                     seed=args.seed, report=report, label="clean")
+    chaos = run_pass(model, work, n_replicas=3,
+                     router=PrefixAffinityRouter(), chaos=True,
+                     seed=args.seed, report=report, label="chaos")
+    rand = run_pass(model, work, n_replicas=3,
+                    router=RandomRouter(seed=args.seed + 7), chaos=False,
+                    seed=args.seed, report=report, label="random")
+
+    # ---- zero-loss failover: EVERY request bit-identical -------------
+    diverged = [i for i in range(len(work)) if chaos.get(i) != clean.get(i)]
+    assert not diverged, \
+        f"chaos streams diverged from the clean run: {diverged[:10]}"
+    assert report["chaos"]["lost"] == 0, report["chaos"]
+    assert report["chaos"]["deaths"] == 1, report["chaos"]
+    assert report["chaos"]["stalls"] == 1, report["chaos"]
+    assert report["chaos"]["migrated"] >= 1, report["chaos"]
+    report["bit_identical_requests"] = len(work)
+
+    # single-replica sanity: affinity fleet = single replica tokens too
+    # (the routing layer must never change WHAT is generated)
+    div1 = [i for i in range(len(work)) if single.get(i) != clean.get(i)]
+    assert not div1, f"fleet changed tokens vs single replica: {div1[:10]}"
+
+    # ---- the routing criterion ---------------------------------------
+    hits_single = report["single"]["prefix_hits"]
+    hits_aff = report["clean"]["prefix_hits"]
+    hits_rand = report["random"]["prefix_hits"]
+    assert hits_single > 0, report["single"]
+    assert hits_aff >= hits_single, \
+        f"affinity fleet hit rate fell below the single-replica " \
+        f"baseline: {hits_aff} < {hits_single}"
+    assert hits_aff > hits_rand, \
+        f"affinity routing did not beat random spray: " \
+        f"{hits_aff} <= {hits_rand}"
+
+    report["wall_s"] = round(time.perf_counter() - t0, 2)
+    print(json.dumps(report))
+    print("SOAK_FLEET_OK")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except AssertionError as e:
+        print(f"SOAK_FLEET_FAILED: {e}", file=sys.stderr)
+        sys.exit(1)
