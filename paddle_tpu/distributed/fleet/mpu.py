@@ -29,13 +29,41 @@ from ...framework.random import RNGState
 
 __all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
            "RowParallelLinear", "ParallelCrossEntropy", "RNGStatesTracker",
-           "get_rng_state_tracker", "mark_sharding", "current_mesh"]
+           "get_rng_state_tracker", "mark_sharding", "current_mesh",
+           "mesh_scope"]
 
 MODEL_AXIS = "model"
 
+# Explicit mesh overrides (innermost wins) consulted by current_mesh()
+# BEFORE the fleet singleton: a TP ServingEngine activates its own mesh
+# around program tracing without going through fleet.init (which owns
+# the process-global hybrid topology — a serving process may legally
+# host engines of different TP degrees side by side).
+_mesh_stack: list = []
+
+
+class mesh_scope:
+    """Context manager pinning current_mesh() to `mesh` for the scope's
+    duration. Nestable; `mesh_scope(None)` masks any outer mesh (the
+    constraints become no-ops inside)."""
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+
+    def __enter__(self):
+        _mesh_stack.append(self.mesh)
+        return self.mesh
+
+    def __exit__(self, *exc):
+        _mesh_stack.pop()
+        return False
+
 
 def current_mesh():
-    """The active hybrid mesh (set by fleet.init) or None."""
+    """The active hybrid mesh: the innermost `mesh_scope` override if
+    one is live, else the fleet.init singleton, else None."""
+    if _mesh_stack:
+        return _mesh_stack[-1]
     from . import fleet as fleet_mod
     hcg = fleet_mod._hcg
     return hcg.mesh if hcg is not None else None

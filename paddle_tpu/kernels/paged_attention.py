@@ -63,7 +63,8 @@ from .flash_attention import _interpret_mode
 
 patch_pltpu()
 
-__all__ = ["paged_attention_decode", "paged_cache_write",
+__all__ = ["paged_attention_decode", "paged_attention_decode_tp",
+           "paged_cache_write",
            "paged_cache_write_range", "paged_cache_write_span",
            "alloc_paged_cache", "check_supported_paged", "paged_blockspecs",
            "quantize_kv", "paged_page_bytes", "KV_SCALE_DTYPE"]
@@ -350,6 +351,91 @@ def paged_attention_decode(q, k_cache, v_cache, block_tables, seq_lens,
         interpret=_interpret_mode(),
     )(bt, sl, qg, *([k_cache] * fold), *([v_cache] * fold), *scale_args)
     return out.reshape(B, H, D)
+
+
+def paged_attention_decode_tp(q, k_cache, v_cache, block_tables, seq_lens,
+                              mesh, axis="model", sm_scale=None,
+                              fold_tokens=None, k_scale=None, v_scale=None,
+                              manual=None):
+    """Tensor-parallel decode attention: query heads and the KV pages'
+    head dim sharded over mesh axis `axis` (ISSUE 8).
+
+    Sharding layout — page IDS are global (the host-side
+    BlockAllocator/RadixCache never see the mesh), page CONTENTS are
+    head-sharded: q (B, H, D) splits H, the caches
+    (num_pages, KVH, page, D) and int8 scale pages (num_pages, KVH,
+    page) split KVH, block_tables/seq_lens are replicated. Each shard
+    attends its own KVH/tp kv heads against its own H/tp query heads
+    (G = H/KVH is shard-invariant), so NO collective is needed here —
+    the psum lives in the row-parallel o_proj that consumes the output.
+
+    Two lowerings, selected by `manual` (default: by backend):
+    * manual=True (TPU default): shard_map manual on `axis` only — the
+      partial-manual combination the pipeline already relies on
+      (CLAUDE.md: traces only under jit); each shard runs the real
+      Pallas kernel on its local head slice, so the kernel's measured
+      GB/s applies per chip unchanged.
+    * manual=False (CPU/test default): GSPMD sharding constraints
+      around the plain kernel call — the interpret-mode kernel is
+      ordinary traceable HLO, which this path partitions bit-exactly
+      (tests/_env_probes.py::gspmd_tp_mesh probes it; the CPU backend
+      rejects partial-manual shard_map outright, the same limitation
+      the pipeline tests skip on).
+    Both return (B, H, D) sharded on H over `axis`.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    B, H, D = q.shape
+    KVH = k_cache.shape[1]
+    tp = int(mesh.shape[axis])
+    if H % tp:
+        raise ValueError(f"H={H} not divisible by tp={tp}")
+    if KVH % tp:
+        raise ValueError(f"KVH={KVH} not divisible by tp={tp}")
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(D)
+    if manual is None:
+        manual = jax.default_backend() == "tpu"
+    quantized = k_scale is not None
+
+    def ns(spec):
+        return NamedSharding(mesh, spec)
+
+    q_spec = P(None, axis, None)
+    page_spec = P(None, axis, None, None)
+    scale_spec = P(None, axis, None)
+    if not manual:
+        cst = jax.lax.with_sharding_constraint
+        q = cst(q, ns(q_spec))
+        k_cache = cst(k_cache, ns(page_spec))
+        v_cache = cst(v_cache, ns(page_spec))
+        if quantized:
+            k_scale = cst(k_scale, ns(scale_spec))
+            v_scale = cst(v_scale, ns(scale_spec))
+        out = paged_attention_decode(
+            q, k_cache, v_cache, block_tables, seq_lens,
+            sm_scale=sm_scale, fold_tokens=fold_tokens,
+            k_scale=k_scale, v_scale=v_scale)
+        return cst(out, ns(q_spec))
+
+    try:
+        from jax import shard_map
+    except ImportError:
+        from ..jax_compat import shard_map
+
+    def local(qq, kc, vc, bt, sl, *scales):
+        ks, vs = scales if scales else (None, None)
+        return paged_attention_decode(
+            qq, kc, vc, bt, sl, sm_scale=sm_scale,
+            fold_tokens=fold_tokens, k_scale=ks, v_scale=vs)
+
+    in_specs = (q_spec, page_spec, page_spec, P(), P())
+    args = (q, k_cache, v_cache, block_tables, seq_lens)
+    if quantized:
+        in_specs = in_specs + (scale_spec, scale_spec)
+        args = args + (k_scale, v_scale)
+    f = shard_map(local, mesh=mesh, in_specs=in_specs, out_specs=q_spec,
+                  axis_names={axis}, check_vma=False)
+    return f(*args)
 
 
 _SCALE_DNUMS = jax.lax.ScatterDimensionNumbers(

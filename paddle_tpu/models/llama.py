@@ -27,7 +27,7 @@ from .. import nn
 from ..core.tensor import Tensor
 from ..distributed.fleet.mpu import (ColumnParallelLinear, RowParallelLinear,
                                      VocabParallelEmbedding, _constraint,
-                                     mark_sharding)
+                                     current_mesh, mark_sharding)
 from ..nn import functional as F
 from ..ops import manipulation as M
 from ..ops.dispatch import apply_op
@@ -217,6 +217,17 @@ class LlamaAttention(nn.Layer):
                 return _gather_kv(cache, bt, n_kv, hd, b)
             kd = apply_op("paged_gather", _g, kv[0], block_tables)
             vd = apply_op("paged_gather", _g, kv[1], block_tables)
+        mesh = current_mesh()
+        if mesh is not None and mesh.shape.get("model", 1) > 1:
+            # TP serving: keep the gathered dense view sharded on the
+            # kv-head axis (the caches' page contents are head-sharded,
+            # so the gather never needs to materialize other shards'
+            # heads)
+            spec = P(None, None, "model", None)
+            kd = apply_op("paged_gather_shard",
+                          lambda a: _constraint(a, spec), kd)
+            vd = apply_op("paged_gather_shard",
+                          lambda a: _constraint(a, spec), vd)
         return kd, vd
 
     def forward_paged(self, x, cos_b, sin_b, kv, block_tables, seq_lens):
@@ -252,6 +263,15 @@ class LlamaAttention(nn.Layer):
 
         def _attend(qq, *arrs):
             kc, vc, ks, vs, (bt, sl) = _split_kv_args(arrs, 2)
+            mesh = current_mesh()
+            if mesh is not None and mesh.shape.get("model", 1) > 1:
+                # TP serving (ISSUE 8): heads/KV pages sharded over
+                # 'model' — each shard attends its own head slice
+                from ..kernels.paged_attention import \
+                    paged_attention_decode_tp
+                return paged_attention_decode_tp(
+                    qq.reshape(b, self.n_heads, self.head_dim), kc, vc,
+                    bt, sl, mesh, k_scale=ks, v_scale=vs)
             return paged_attention_decode(
                 qq.reshape(b, self.n_heads, self.head_dim), kc, vc,
                 bt, sl, k_scale=ks, v_scale=vs)

@@ -193,6 +193,15 @@ def _weight_only_linear_cls():
             self.in_features, self.out_features = (int(w.shape[0]),
                                                    int(w.shape[1]))
             qw, scale = weight_quantize(w, algo=algo)
+            # TP serving (ISSUE 8): the quantized buffers inherit the
+            # source weight's mesh spec — qweight keeps the (in, out)
+            # layout (int4 packs along `in`, which both column- and
+            # row-parallel specs survive), the per-OUT-channel scale
+            # shards like the out dim
+            spec = getattr(w, "_spec", None)
+            if spec is not None:
+                qw._spec = spec
+                scale._spec = type(spec)(spec[-1])
             self.register_buffer("qweight", qw)
             self.register_buffer("weight_scale", scale)
             if bias is not None:

@@ -14,7 +14,8 @@ fleet front-end (ISSUE 7, `serving.fleet`) multiplexes a streaming API
 over N in-process replicas with prefix-affinity routing, replica
 supervision, and zero-loss failover via snapshot live-migration.
 """
-from .engine import ServingEngine
+from .engine import ServingEngine, tp_serving_mesh
+from .program_cache import ProgramCache
 from .errors import (EngineFailure, EngineOverloaded, PoisonedComputation,
                      SnapshotVersionError, TransientDeviceError)
 from .kv_cache import BlockAllocator, BlocksExhausted, KVSequence, PAD_PAGE
@@ -37,4 +38,5 @@ __all__ = ["ServingEngine", "BlockAllocator", "BlocksExhausted",
            "StepSupervisor", "classify_failure", "Proposer",
            "NgramProposer", "DraftModelProposer", "Fleet", "FleetHandle",
            "FleetServer", "TokenStream", "Replica", "ReplicaState",
-           "PrefixAffinityRouter", "RandomRouter", "RoundRobinRouter"]
+           "PrefixAffinityRouter", "RandomRouter", "RoundRobinRouter",
+           "tp_serving_mesh", "ProgramCache"]

@@ -78,12 +78,26 @@ from .errors import (EngineFailure, EngineOverloaded,
                      SnapshotVersionError)
 from .kv_cache import BlockAllocator, BlocksExhausted, PAD_PAGE
 from .metrics import ServingMetrics
+from .program_cache import ProgramCache
 from .radix_cache import RadixCache
 from .scheduler import (Request, RequestState, Scheduler,
                         bump_request_counter)
 from .supervisor import POISON, RetryPolicy, StepSupervisor, classify_failure
 
-__all__ = ["ServingEngine", "SNAPSHOT_VERSION", "check_snapshot_version"]
+__all__ = ["ServingEngine", "SNAPSHOT_VERSION", "check_snapshot_version",
+           "tp_serving_mesh"]
+
+
+def tp_serving_mesh(tp: int, devices=None):
+    """The hybrid [data, pipe, sharding, sep, model] mesh a TP serving
+    engine wants: model degree `tp` over the first `tp` devices (or an
+    explicit device list). Thin wrapper over fleet's build_mesh so the
+    axis names can never drift from the training stack's."""
+    import jax as _jax
+    from ..distributed.fleet.topology import build_mesh
+    if devices is None:
+        devices = _jax.devices()[:int(tp)]
+    return build_mesh(mp=int(tp), devices=devices)
 
 _engine_counter = itertools.count()
 
@@ -178,6 +192,21 @@ class ServingEngine:
     Both ride the program-cache keys, so engines with different quant
     configs sharing a process never collide, and the compile bound
     stays the bucket grid.
+
+    Tensor-parallel serving (ISSUE 8): pass `mesh` (a hybrid
+    [data, pipe, sharding, sep, model] jax Mesh with model degree tp)
+    to shard attention heads, the paged KV pool (page CONTENTS,
+    including int8 scale pages — page IDS stay global) and the
+    MLP/LM-head weights over 'model'. The scheduler, BlockAllocator
+    and RadixCache are host-side and rank-replicated, so every
+    paging/refcount/radix trace is bit-identical to the single-chip
+    engine by construction; all three program families compile under
+    jax.jit with GSPMD shardings (column-parallel QKV/gate-up,
+    row-parallel O/down with psum, paged attention per shard over its
+    own KVH/tp kv heads — kernels.paged_attention_decode_tp), and the
+    mesh shape rides the program-cache key. `kv_pool_bytes` stays a
+    PER-CHIP budget: head-sharded pages cost kv_page_bytes_shard per
+    chip, so capacity at fixed per-chip bytes scales ~x tp.
     """
 
     def __init__(self, model, *, num_pages: int = 128, page_size: int = 16,
@@ -197,7 +226,8 @@ class ServingEngine:
                  spec_buckets: Optional[List[int]] = None,
                  kv_dtype: Optional[str] = None,
                  wq: Optional[str] = None,
-                 kv_pool_bytes: Optional[int] = None):
+                 kv_pool_bytes: Optional[int] = None,
+                 mesh=None):
         cfg = model.cfg
         if kv_dtype not in (None, "int8"):
             raise ValueError(f"kv_dtype must be None or 'int8', got "
@@ -207,6 +237,27 @@ class ServingEngine:
                              f"{wq!r}")
         self.kv_dtype = kv_dtype
         self.wq = wq
+        # --- tensor parallelism (ISSUE 8) ---
+        # mesh: a hybrid [data, pipe, sharding, sep, model] jax Mesh (or
+        # any mesh with a 'model' axis). Attention heads, the paged KV
+        # pool's page CONTENTS (including int8 scale pages) and the
+        # MLP/LM-head weights shard over 'model'; the scheduler,
+        # BlockAllocator and RadixCache stay host-side and
+        # rank-replicated — page IDS are global, so every paging/
+        # refcount/radix decision is bit-identical to the single-chip
+        # engine by construction.
+        self.mesh = mesh
+        self.tp = (int(dict(mesh.shape).get("model", 1))
+                   if mesh is not None else 1)
+        if self.tp > 1:
+            if cfg.num_key_value_heads % self.tp:
+                raise ValueError(
+                    f"num_key_value_heads {cfg.num_key_value_heads} not "
+                    f"divisible by model-axis degree {self.tp}")
+            if cfg.num_attention_heads % self.tp:
+                raise ValueError(
+                    f"num_attention_heads {cfg.num_attention_heads} not "
+                    f"divisible by model-axis degree {self.tp}")
         if wq is not None:
             # IN PLACE, before the state snapshot below: the quantized
             # buffers (int8 qweight + fp scale) replace the fp weights
@@ -228,14 +279,26 @@ class ServingEngine:
                       if jnp.issubdtype(t._data.dtype, jnp.floating))
         # bytes one page costs in THIS engine (int8 pages + scales, or
         # the model dtype's full-width pages) — the capacity gauge and
-        # the kv_pool_bytes sizing below both hang off it
+        # the kv_pool_bytes sizing below both hang off it. Under TP a
+        # page's contents are head-sharded, so one chip pays only the
+        # per-SHARD bytes (KVH/tp heads) — both numbers come from the
+        # same paged_page_bytes source (linear in KVH, so
+        # shard * tp == global exactly)
+        self._kv_dtype_name = (kv_dtype if kv_dtype is not None
+                               else str(wdtype))
         self.kv_page_bytes = paged_page_bytes(
             cfg.num_key_value_heads, self.page_size, self.head_dim,
-            kv_dtype if kv_dtype is not None else str(wdtype))
+            self._kv_dtype_name)
+        self.kv_page_bytes_shard = paged_page_bytes(
+            cfg.num_key_value_heads // self.tp, self.page_size,
+            self.head_dim, self._kv_dtype_name)
         if kv_pool_bytes is not None:
-            # size the pool from an HBM byte budget: the page count is
-            # what kv_dtype="int8" roughly doubles at fixed bytes
-            num_pages = max(2, int(kv_pool_bytes) // self.kv_page_bytes)
+            # size the pool from a PER-CHIP HBM byte budget: the page
+            # count is what kv_dtype="int8" roughly doubles and TP
+            # multiplies by ~tp at fixed per-chip bytes (head-sharded
+            # pages cost kv_page_bytes_shard per chip)
+            num_pages = max(2, int(kv_pool_bytes)
+                            // self.kv_page_bytes_shard)
         self.num_pages = int(num_pages)
         self.temperature = float(temperature)
         self.top_k = int(top_k)
@@ -247,18 +310,30 @@ class ServingEngine:
         self._null_key = jax.random.PRNGKey(0)
 
         # serving weights are immutable: snapshot the flat {name: array}
-        # view once instead of re-walking state_dict() every step
-        self._state = {k: t._data for k, t in model.state_dict().items()}
+        # view once instead of re-walking state_dict() every step.
+        # Under TP each weight is device_put per its mark_sharding spec
+        # (column-parallel QKV/gate-up split the out dim, row-parallel
+        # O/down the in dim, the vocab embedding its vocab dim);
+        # spec-less buffers (rope tables, quant scales without an out
+        # shard) replicate. jit then reads the argument shardings — no
+        # per-weight constraints needed inside the programs.
+        self._state = {}
+        for k, t in model.state_dict().items():
+            self._state[k] = self._place(t._data,
+                                         getattr(t, "_spec", None))
 
         # fail at construction, not at the first decode launch: the
-        # Pallas kernel's static constraints are model geometry
+        # Pallas kernel's static constraints are model geometry — under
+        # TP the kernel sees the PER-SHARD geometry (H/tp query heads
+        # over KVH/tp kv heads), so that is what must be legal
         from ..kernels.paged_attention import check_supported_paged
         dtype = next(a.dtype for a in self._state.values()
                      if jnp.issubdtype(a.dtype, jnp.floating))
         self._cache_dtype = jnp.int8 if kv_dtype == "int8" else dtype
         check_supported_paged(
-            (1, cfg.num_attention_heads, self.head_dim),
-            (self.num_pages, self.num_kv, self.page_size, self.head_dim),
+            (1, cfg.num_attention_heads // self.tp, self.head_dim),
+            (self.num_pages, self.num_kv // self.tp, self.page_size,
+             self.head_dim),
             dtype, kv_dtype=kv_dtype)
 
         # longest sequence a request may ever reach (rope table and page
@@ -330,31 +405,44 @@ class ServingEngine:
         self.metrics = ServingMetrics(
             name=f"serving-{next(_engine_counter)}").register()
 
+        from jax.sharding import PartitionSpec as P
         shape = (self.num_pages, self.num_kv, self.page_size, self.head_dim)
-        self._k_caches = [jnp.zeros(shape, self._cache_dtype)
+        # page contents head-sharded over 'model' (page IDS stay
+        # global): one chip holds KVH/tp heads of every page
+        kv_spec = P(None, "model", None, None) if self.tp > 1 else None
+        sc_spec = P(None, "model", None) if self.tp > 1 else None
+        self._k_caches = [self._place(jnp.zeros(shape, self._cache_dtype),
+                                      kv_spec)
                           for _ in range(self.num_layers)]
-        self._v_caches = [jnp.zeros(shape, self._cache_dtype)
+        self._v_caches = [self._place(jnp.zeros(shape, self._cache_dtype),
+                                      kv_spec)
                           for _ in range(self.num_layers)]
         if self.kv_dtype == "int8":
             from ..kernels.paged_attention import KV_SCALE_DTYPE
-            self._k_scales = [jnp.zeros(shape[:3], KV_SCALE_DTYPE)
-                              for _ in range(self.num_layers)]
-            self._v_scales = [jnp.zeros(shape[:3], KV_SCALE_DTYPE)
-                              for _ in range(self.num_layers)]
+            self._k_scales = [self._place(
+                jnp.zeros(shape[:3], KV_SCALE_DTYPE), sc_spec)
+                for _ in range(self.num_layers)]
+            self._v_scales = [self._place(
+                jnp.zeros(shape[:3], KV_SCALE_DTYPE), sc_spec)
+                for _ in range(self.num_layers)]
         else:
             # empty pytrees: the compiled programs take the scale lists
             # unconditionally so both kv_dtypes share one program shape
             self._k_scales = []
             self._v_scales = []
         # bytes-moved accounting (ServingMetrics): one token's K+V
-        # across every layer, scales included
+        # across every layer, scales included — GLOBAL bytes (the sum
+        # over shards); per-chip traffic is this / tp
         self.kv_bytes_per_token = (self.num_layers * self.kv_page_bytes
                                    // self.page_size)
         self.metrics.set_kv_info(
             kv_dtype=self.kv_dtype or str(dtype),
             page_bytes=self.kv_page_bytes,
             pool_bytes=self.kv_page_bytes * self.num_pages,
-            bytes_per_token=self.kv_bytes_per_token)
+            bytes_per_token=self.kv_bytes_per_token,
+            tp_degree=self.tp,
+            page_bytes_shard=self.kv_page_bytes_shard,
+            pool_bytes_shard=self.kv_page_bytes_shard * self.num_pages)
 
         self.requests: Dict[int, Request] = {}
         self._finished_order: List[int] = []
@@ -363,17 +451,36 @@ class ServingEngine:
         # only the most recent `max_retained_finished` stay readable
         self.max_retained_finished = int(max_retained_finished)
         self.num_evicted_finished = 0
-        self._programs: Dict[tuple, object] = {}
+        # the unified ProgramCache (ISSUE 8): one keyed store for the
+        # chunk/decode/verify families with per-family bucket-grid
+        # bounds (whole-prompt prefill and chunked prefill are ONE
+        # family — the chunk program — so "prefill" compiles count
+        # under "chunk" by design; the draft-model proposer runs its
+        # own cache with its own families)
+        self.programs = ProgramCache(
+            on_compile=lambda: self.metrics.on_recompile())
+        self.programs.register_family(
+            "chunk", lambda: (len(self.prefill_buckets)
+                              * len(self.pages_buckets)))
+        self.programs.register_family(
+            "decode", lambda: (len(self.batch_buckets)
+                               * len(self.pages_buckets)))
+        self.programs.register_family(
+            "verify", lambda: (len(self.batch_buckets)
+                               * len(self.spec_buckets)
+                               * len(self.pages_buckets)))
         # caches only pay off donated on a real accelerator; CPU jit
         # warns per call and keeps the copy anyway. Scale lists donate
         # too (empty pytrees for full-width KV — a no-op there).
         self._donate = (1, 2, 3, 4) if jax.default_backend() == "tpu" \
             else ()
-        # quant config rides every program-cache key: two engines with
-        # different kv_dtype/wq in one process must never share a
-        # compiled program, and the bucket-grid compile bound is
-        # per-engine so the key suffix costs nothing
-        self._qkey = (self.kv_dtype or "kv_full", self.wq or "w_full")
+        # quant config AND the mesh shape ride every program-cache key:
+        # two engines with different kv_dtype/wq/TP degree in one
+        # process must never share a compiled program, and the
+        # bucket-grid compile bound is per-engine (one mesh shape per
+        # engine) so the key suffix costs nothing
+        self._qkey = (self.kv_dtype or "kv_full", self.wq or "w_full",
+                      ("tp", self.tp))
 
     def _caches_alive(self) -> bool:
         """Retry gate for the donated-buffer hazard: on TPU the compiled
@@ -446,34 +553,65 @@ class ServingEngine:
     def has_work(self) -> bool:
         return self.scheduler.has_work()
 
+    # ---------------------------------------------------- TP placement
+    def _place(self, arr, spec):
+        """device_put `arr` onto the engine mesh per `spec` (replicated
+        when spec is None); identity without a mesh. Specs whose rank
+        does not fit the array (a reshaped/stacked buffer) fall back to
+        replication — correctness never depends on placement, only
+        memory footprint does."""
+        if self.mesh is None:
+            return arr
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        try:
+            return jax.device_put(
+                arr, NamedSharding(self.mesh,
+                                   spec if spec is not None else P()))
+        except Exception:   # noqa: BLE001 — rank/divisibility mismatch
+            return jax.device_put(arr, NamedSharding(self.mesh, P()))
+
+    def _trace_scope(self):
+        """Context active around every program call: pins current_mesh()
+        to the engine mesh so the mpu layers' GSPMD constraints (and the
+        TP paged-attention route in models/llama.py) are live at trace
+        time — without requiring fleet.init's process-global topology.
+        A mesh-less engine pins mesh_scope(None), MASKING any ambient
+        fleet.init mesh: otherwise a training process with mp>1 would
+        leak its mesh into the serving trace and activate TP routing
+        this engine never opted into (or validated divisibility for)."""
+        from ..distributed.fleet.mpu import mesh_scope
+        return mesh_scope(self.mesh)
+
     # ------------------------------------------------------ program cache
     def _next_key(self):
         self._key, sub = jax.random.split(self._key)
         return sub
 
     def _get_program(self, key, builder):
-        prog = self._programs.get(key)
-        if prog is None:
-            prog = builder()
-            self._programs[key] = prog
-            self.metrics.on_recompile()
-        return prog
+        return self.programs.get(key, builder)
 
     @property
     def num_compiled_programs(self) -> int:
-        return len(self._programs)
+        """Total compiled programs (all families); per-family counts via
+        `program_counts()` (ISSUE 8)."""
+        return self.programs.num_programs
 
-    def max_program_count(self) -> int:
-        """The bucket-grid bound the recompile counter can never exceed.
+    def program_counts(self) -> Dict[str, int]:
+        """{family: programs compiled} for the chunk/decode/verify
+        families through the unified ProgramCache."""
+        return self.programs.counts()
+
+    def max_program_count(self, family: Optional[str] = None) -> int:
+        """The bucket-grid bound the recompile counter can never exceed
+        — one family's grid, or (default) the sum over all families.
         With a proposer the ("verify", B, K, P) grid joins it: K is a
         program-cache key axis exactly like B and P, so speculative
         decoding multiplies the decode-side bound by len(spec_buckets)
         instead of compiling per draft length (SERVING.md documents the
-        bound next to the PR-1 bucket-grid note)."""
-        return ((len(self.prefill_buckets) + len(self.batch_buckets))
-                * len(self.pages_buckets)
-                + (len(self.batch_buckets) * len(self.spec_buckets)
-                   * len(self.pages_buckets)))
+        bound next to the PR-1 bucket-grid note). The mesh shape also
+        rides every key, but an engine owns ONE mesh, so its bound is
+        the grid for that single mesh shape."""
+        return self.programs.max_count(family)
 
     # --------------------------------------------- paged-cache plumbing
     @staticmethod
@@ -553,7 +691,8 @@ class ServingEngine:
             faults.fire(FAULT_CHUNK)
             with profiler.RecordEvent("serving.prefill_chunk"), \
                     poison_scope(f"serving.prefill_chunk[req="
-                                 f"{req.request_id}]"), no_grad():
+                                 f"{req.request_id}]"), no_grad(), \
+                    self._trace_scope():
                 return prog(
                     self._state, self._k_caches, self._v_caches,
                     self._k_scales, self._v_scales,
@@ -618,7 +757,7 @@ class ServingEngine:
             faults.fire(FAULT_DECODE)
             with profiler.RecordEvent("serving.decode_step"), \
                     poison_scope(f"serving.decode_step[reqs={rids}]"), \
-                    no_grad():
+                    no_grad(), self._trace_scope():
                 return prog(
                     self._state, self._k_caches, self._v_caches,
                     self._k_scales, self._v_scales,
@@ -807,7 +946,7 @@ class ServingEngine:
             faults.fire(FAULT_VERIFY)
             with profiler.RecordEvent("serving.verify_step"), \
                     poison_scope(f"serving.verify_step[reqs={rids}]"), \
-                    no_grad():
+                    no_grad(), self._trace_scope():
                 return prog(
                     self._state, self._k_caches, self._v_caches,
                     self._k_scales, self._v_scales,

@@ -118,6 +118,12 @@ class ServingMetrics:
         self.kv_page_bytes = 0
         self.kv_pool_bytes = 0
         self.kv_bytes_per_token = 0
+        # per-shard geometry (ISSUE 8): with a TP mesh the page
+        # contents are head-sharded, so one chip pays page_bytes/tp
+        # per page; at tp=1 shard == global
+        self.kv_tp_degree = 0
+        self.kv_page_bytes_shard = 0
+        self.kv_pool_bytes_shard = 0
 
     # ---- reservoir registry ---------------------------------------------
     def add_reservoir(self, name: str, scale: float = 1.0,
@@ -183,15 +189,25 @@ class ServingMetrics:
 
     # ---- quantized KV / weights (ISSUE 6) --------------------------------
     def set_kv_info(self, *, kv_dtype, page_bytes, pool_bytes,
-                    bytes_per_token):
+                    bytes_per_token, tp_degree=1, page_bytes_shard=None,
+                    pool_bytes_shard=None):
         """Static KV-pool geometry: dtype, bytes/page (scales included),
         total pool bytes, and one token's all-layer K+V footprint —
         page capacity at fixed HBM is pool_bytes / page_bytes, the
-        number kv_dtype=int8 roughly doubles."""
+        number kv_dtype=int8 roughly doubles. page/pool bytes are
+        GLOBAL (summed over TP shards); the per-shard gauges (ISSUE 8)
+        record what ONE chip pays — pool_bytes_shard is the per-chip
+        `kv_pool_bytes` budget's echo, the number head-sharding holds
+        fixed while page capacity scales ~x tp."""
         self.kv_dtype = str(kv_dtype)
         self.kv_page_bytes = int(page_bytes)
         self.kv_pool_bytes = int(pool_bytes)
         self.kv_bytes_per_token = int(bytes_per_token)
+        self.kv_tp_degree = int(tp_degree)
+        self.kv_page_bytes_shard = int(
+            page_bytes if page_bytes_shard is None else page_bytes_shard)
+        self.kv_pool_bytes_shard = int(
+            pool_bytes if pool_bytes_shard is None else pool_bytes_shard)
 
     def on_kv_bytes(self, written: int = 0, read: int = 0):
         self.counters["kv_bytes_written"] += int(written)
@@ -328,6 +344,9 @@ class ServingMetrics:
                 "kv_page_bytes": self.kv_page_bytes,
                 "kv_pool_bytes": self.kv_pool_bytes,
                 "kv_bytes_per_token": self.kv_bytes_per_token,
+                "kv_tp_degree": self.kv_tp_degree,
+                "kv_page_bytes_shard": self.kv_page_bytes_shard,
+                "kv_pool_bytes_shard": self.kv_pool_bytes_shard,
             })
         hr = self.prefix_hit_rate()
         if hr is not None:
@@ -404,6 +423,18 @@ class ServingMetrics:
         out.kv_dtype = dts.pop() if len(dts) == 1 \
             else ("mixed" if dts else None)
         out.kv_bytes_per_token = bpts.pop() if len(bpts) == 1 else 0
+        # per-shard geometry (ISSUE 8): same singleton-or-sentinel rule
+        # — a fleet mixing TP degrees zeroes the per-shard gauges (and
+        # tp_degree) instead of letting the last-merged replica win,
+        # while the pooled kv_pool_bytes / occupancy above stay EXACT
+        # (both are computed from each replica's own GLOBAL geometry
+        # before the sentinel collapse, so mixed-TP pools sum true)
+        tps = {m.kv_tp_degree for m in metrics if m.kv_page_bytes}
+        pbss = {m.kv_page_bytes_shard for m in metrics if m.kv_page_bytes}
+        plss = {m.kv_pool_bytes_shard for m in metrics if m.kv_page_bytes}
+        out.kv_tp_degree = tps.pop() if len(tps) == 1 else 0
+        out.kv_page_bytes_shard = pbss.pop() if len(pbss) == 1 else 0
+        out.kv_pool_bytes_shard = plss.pop() if len(plss) == 1 else 0
         # reservoirs: per-name balanced newest-first draw — walk every
         # source from its freshest sample backwards, round-robin, until
         # the window fills; reversed so the merged deque stays

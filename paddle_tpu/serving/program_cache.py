@@ -1,0 +1,111 @@
+"""ProgramCache: the ONE owner of every bucketed compiled program.
+
+Until ISSUE 8 the prefill/chunk, decode, verify and draft-model program
+buckets lived in engine-local dicts with hand-maintained count bounds —
+tolerable for a (family, B, P) key space, but TP serving multiplies
+every key by the mesh shape and quantized serving already multiplied it
+by (kv_dtype, wq). This module centralizes the store so the
+TP x quant x spec key space has one owner:
+
+* keys are tuples whose FIRST element names the program family
+  ("chunk", "decode", "verify", ... — families are registered up front
+  with their bucket-grid bound);
+* `get(key, builder)` compiles on miss, reports the compile through the
+  `on_compile` hook (the engine wires it to
+  `ServingMetrics.on_recompile`), and ENFORCES the registered family
+  bound — exceeding it raises instead of silently recompiling forever,
+  because an unbounded program cache is exactly the bug the bucket grid
+  exists to prevent;
+* per-family counts (`counts()`) and bounds (`max_count(family)`)
+  replace the single flat number, so "which family is compiling?" is
+  answerable from metrics instead of a debugger.
+
+The bound callables are evaluated lazily (engines finalize their bucket
+lists after construction-time clamping), and the bound is the grid for
+ONE mesh shape — an engine owns one mesh, so its key space is
+`bucket grid x {its mesh shape}`; processes mixing TP degrees get one
+cache per engine and the global compile count stays the sum of the
+per-engine grids (the "mesh shapes actually used" bound in ISSUE 8).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+__all__ = ["ProgramCache"]
+
+
+class ProgramCache:
+    """Keyed store of compiled programs with per-family compile bounds.
+
+    on_compile: optional zero-arg hook fired once per compilation (cache
+    miss) — the engine's recompile counter.
+    """
+
+    def __init__(self, on_compile: Optional[Callable[[], None]] = None):
+        self._programs: Dict[tuple, object] = {}
+        self._bounds: Dict[str, Callable[[], int]] = {}
+        self._counts: Dict[str, int] = {}
+        self._on_compile = on_compile
+
+    def register_family(self, family: str, bound: Callable[[], int]):
+        """Declare a program family and its (lazily evaluated) compile
+        bound — the bucket-grid size for this family."""
+        self._bounds[family] = bound
+        self._counts.setdefault(family, 0)
+        return self
+
+    # ------------------------------------------------------------- access
+    def get(self, key: tuple, builder: Callable[[], object]):
+        """The program for `key` (key[0] = family), compiling via
+        `builder` on miss. Raises KeyError for an unregistered family
+        and RuntimeError when a compile would exceed the family bound —
+        a blown bound means a key axis leaked out of the bucket grid
+        (the unbounded-recompilation bug class), which must fail loud,
+        not page the on-call about mystery latency."""
+        prog = self._programs.get(key)
+        if prog is not None:
+            return prog
+        family = key[0]
+        if family not in self._bounds:
+            raise KeyError(f"unregistered program family {family!r} "
+                           f"(known: {sorted(self._bounds)})")
+        bound = int(self._bounds[family]())
+        if self._counts[family] + 1 > bound:
+            raise RuntimeError(
+                f"program family {family!r} would exceed its compile "
+                f"bound {bound} with key {key!r} — a key axis is not "
+                f"riding the bucket grid")
+        prog = builder()
+        self._programs[key] = prog
+        self._counts[family] += 1
+        if self._on_compile is not None:
+            self._on_compile()
+        return prog
+
+    # ------------------------------------------------------------ counts
+    @property
+    def num_programs(self) -> int:
+        return len(self._programs)
+
+    def counts(self) -> Dict[str, int]:
+        """{family: programs compiled} — every registered family
+        appears, compiled or not."""
+        return dict(self._counts)
+
+    def max_count(self, family: Optional[str] = None) -> int:
+        """The compile bound: one family's grid, or (default) the sum
+        over every registered family."""
+        if family is not None:
+            return int(self._bounds[family]())
+        return sum(int(b()) for b in self._bounds.values())
+
+    def keys(self):
+        """The live program keys (tests assert the key-suffix axes —
+        quant config, mesh shape — actually ride them)."""
+        return list(self._programs.keys())
+
+    def __len__(self):
+        return len(self._programs)
+
+    def __contains__(self, key):
+        return key in self._programs

@@ -99,7 +99,17 @@ class DraftModelProposer(Proposer):
                           for _ in range(self.num_layers)]
         self._v_caches = [jnp.zeros(shape, dtype)
                           for _ in range(self.num_layers)]
-        self._programs: Dict[tuple, object] = {}
+        # unified ProgramCache (ISSUE 8): the draft model's catch-up
+        # chunk + decode programs are their own families, bounded by
+        # the draft bucket grid exactly like the engine's
+        from ..program_cache import ProgramCache
+        self.programs = ProgramCache()
+        self.programs.register_family(
+            "draft_chunk", lambda: (len(self.prefill_buckets)
+                                    * len(self.pages_buckets)))
+        self.programs.register_family(
+            "draft_decode", lambda: (len(self.batch_buckets)
+                                     * len(self.pages_buckets)))
         self._donate = (1, 2) if jax.default_backend() == "tpu" else ()
         self._states: Dict[int, _DraftSeq] = {}
         # drafting turned itself off (see propose()): the engine keeps
@@ -114,18 +124,16 @@ class DraftModelProposer(Proposer):
     # ------------------------------------------------------------ programs
     @property
     def num_compiled_programs(self) -> int:
-        return len(self._programs)
+        return self.programs.num_programs
 
-    def max_program_count(self) -> int:
-        return ((len(self.prefill_buckets) + len(self.batch_buckets))
-                * len(self.pages_buckets))
+    def program_counts(self):
+        return self.programs.counts()
+
+    def max_program_count(self, family=None) -> int:
+        return self.programs.max_count(family)
 
     def _get_program(self, key, builder):
-        prog = self._programs.get(key)
-        if prog is None:
-            prog = builder()
-            self._programs[key] = prog
-        return prog
+        return self.programs.get(key, builder)
 
     def _build_chunk(self, S, P):
         """Catch-up chunk: write one span of ONE sequence's history into

@@ -25,6 +25,11 @@ Failure classes in this container (jax 0.4.37 CPU):
 * host_offload_remat — the offload-dots-to-host checkpoint policy
   outside jit raises "TransferToMemoryKind ... only be used inside
   jax.jit" on this jax version (gates recompute_offload).
+* gspmd_tp_mesh — whether the backend forms the hybrid mesh with
+  model degree > 1 and partitions a constrained jit through the
+  interpret-mode paged-attention kernel (gates the TP serving tests,
+  ISSUE 8 — note this is GSPMD auto-sharding, NOT the partial-manual
+  shard_map the pipeline needs; the two capabilities differ here).
 * banked_average_bitwise — whether this XLA CPU build rounds
   `((g+g+g)/3)*lr` bitwise-equal to `g*lr`; where it does not, the
   gradient-merge k-step-vs-single-step equality check differs by ~1 ulp
@@ -172,6 +177,50 @@ def host_offload_remat():
     except Exception as e:                                 # noqa: BLE001
         return False, (f"host-offload remat unusable outside jit on this "
                        f"jax ({str(e).splitlines()[0][:160]})")
+
+
+@functools.lru_cache(maxsize=None)
+def gspmd_tp_mesh():
+    """Can this backend form the hybrid GSPMD mesh with model degree
+    > 1 and partition a jitted program that routes through the
+    (interpret-mode) paged-attention kernel under sharding constraints?
+    This is exactly what TP serving (ISSUE 8) asks of the backend on
+    CPU — NOT partial-manual shard_map (that path is TPU-only; see
+    kernels.paged_attention.paged_attention_decode_tp). Single-process,
+    in-process probe: no subprocess needed."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    devs = jax.devices()
+    if len(devs) < 2:
+        return False, (f"model-axis sharding needs >= 2 devices, "
+                       f"have {len(devs)}")
+    try:
+        from paddle_tpu.kernels.paged_attention import \
+            paged_attention_decode
+        mesh = Mesh(np.asarray(devs[:2], dtype=object).reshape(
+            1, 1, 1, 1, 2), ("data", "pipe", "sharding", "sep", "model"))
+        B, KVH, H, D, page, npages = 1, 2, 4, 64, 8, 4
+        kc = jnp.zeros((npages, KVH, page, D), jnp.float32)
+        q = jnp.ones((B, H, D), jnp.float32)
+        bt = jnp.zeros((B, 2), jnp.int32)
+        sl = jnp.full((B,), 4, jnp.int32)
+
+        def f(q, kc, vc):
+            def cst(a, spec):
+                return jax.lax.with_sharding_constraint(
+                    a, NamedSharding(mesh, spec))
+            q = cst(q, P(None, "model", None))
+            kc = cst(kc, P(None, "model", None, None))
+            vc = cst(vc, P(None, "model", None, None))
+            return paged_attention_decode(q, kc, vc, bt, sl)
+
+        jax.block_until_ready(jax.jit(f)(q, kc, kc))
+        return True, "GSPMD model-axis mesh partitions the paged kernel"
+    except Exception as e:                                 # noqa: BLE001
+        return False, (f"GSPMD TP mesh unusable on this backend "
+                       f"({str(e).splitlines()[0][:160]})")
 
 
 @functools.lru_cache(maxsize=None)

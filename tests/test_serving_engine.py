@@ -79,9 +79,16 @@ def test_serving_engine_continuous_batching_acceptance(model):
     eng.allocator.check_invariants()
     assert eng.allocator.occupancy() == 0
 
-    # recompiles bounded by the bucket grid
+    # recompiles bounded by the bucket grid — flat count and the
+    # per-family view through the unified ProgramCache (ISSUE 8) agree
     assert eng.metrics.counters["recompiles"] == eng.num_compiled_programs
     assert eng.num_compiled_programs <= eng.max_program_count()
+    counts = eng.program_counts()
+    assert set(counts) == {"chunk", "decode", "verify"}
+    assert sum(counts.values()) == eng.num_compiled_programs
+    assert counts["verify"] == 0                  # no proposer configured
+    for fam, n in counts.items():
+        assert n <= eng.max_program_count(fam)
 
     # ---- exact match vs one-request-at-a-time ---------------------------
     single = ServingEngine(model, **ENGINE_KW)

@@ -293,8 +293,14 @@ def test_quant_configs_ride_program_keys_and_stay_bounded(model):
     eng = ServingEngine(model, kv_dtype="int8", **ENGINE_KW)
     _drain(eng, _workload(6, seed=9))
     assert eng.num_compiled_programs <= eng.max_program_count()
-    assert all(key[-2:] == ("int8", "w_full")
-               for key in eng._programs)
+    # per-family counts through the unified ProgramCache (ISSUE 8)
+    counts = eng.program_counts()
+    assert sum(counts.values()) == eng.num_compiled_programs
+    for fam, n in counts.items():
+        assert n <= eng.max_program_count(fam)
+    # quant config + mesh shape ride every key
+    assert all(key[-3:] == ("int8", "w_full", ("tp", 1))
+               for key in eng.programs.keys())
     eng.shutdown()
 
 
@@ -312,7 +318,8 @@ def test_wq_int8_engine_decodes_and_stays_bounded():
     outs = _drain(eng, work)
     assert [len(t) for t in outs] == [m for _, m in work]
     assert eng.num_compiled_programs <= eng.max_program_count()
-    assert all(key[-2:] == ("int8", "int8") for key in eng._programs)
+    assert all(key[-3:-1] == ("int8", "int8")
+               for key in eng.programs.keys())
     eng.reset_prefix_cache()
     assert eng.allocator.num_used == 0
     eng.shutdown()

@@ -158,9 +158,15 @@ def test_spec_greedy_identity_16_requests_mixed(model):
     # emitted = every decode-side token; the savings are real launches
     assert snap["spec_steps"] < sum(len(v) for v in out.values())
 
-    # bucket-grid compile bound (verify grid included)
+    # bucket-grid compile bound (verify grid included); the per-family
+    # ProgramCache view (ISSUE 8) shows verify programs actually
+    # compiled and bounded by their own grid
     assert eng.num_compiled_programs <= eng.max_program_count()
     assert eng.metrics.counters["recompiles"] == eng.num_compiled_programs
+    counts = eng.program_counts()
+    assert counts["verify"] >= 1
+    assert counts["verify"] <= eng.max_program_count("verify")
+    assert sum(counts.values()) == eng.num_compiled_programs
 
     eng.reset_prefix_cache()
     assert eng.allocator.num_used == 0

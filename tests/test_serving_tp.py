@@ -1,0 +1,381 @@
+"""Tensor-parallel serving acceptance (ISSUE 8): engines sharded over
+the hybrid mesh's 'model' axis produce greedy outputs token-identical
+to the single-device engine, with the prefix cache, speculative decode,
+int8 KV pages and int8 weight-only quant each exercised; host-side
+paging/refcount/free-list and radix traces are bit-identical by
+construction (page IDS are global — only page CONTENTS shard); KV
+capacity at a fixed PER-CHIP byte budget scales ~x TP through the
+single `paged_page_bytes` math source; and all program families key
+through the unified ProgramCache with the mesh shape in the key.
+
+Gated on the `gspmd_tp_mesh` capability probe (the 8-virtual-CPU-device
+backend must partition a constrained jit through the interpret-mode
+paged kernel — where it can't, these SKIP with the probe's reason
+instead of becoming memorized failures, the PR-3 pattern).
+
+Determinism note: TP changes the REDUCTION LAYOUT (row-parallel psum,
+sharded dots), so unlike the single-engine batching tests this is not
+bit-identity of the math — it is the f32 greedy-argmax identity the
+engine-vs-eager-generate test already relies on across differently
+rounded programs. The workloads below pin single bucket grids so shape
+effects stay out of the comparison.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import (NgramProposer, ProgramCache, ServingEngine,
+                                ServingMetrics, tp_serving_mesh)
+
+from _env_probes import gspmd_tp_mesh, skip_unless
+
+# One decoder layer: TP sharding coverage is per-layer-identical
+# (col-parallel qkv/gate-up, row-parallel o/down psum, vocab-parallel
+# embed/head all appear once per layer), and the tier-1 suite runs
+# within ~30s of its wall-clock budget — depth buys no TP coverage,
+# only compile seconds. heads=4/kv=4 so TP=4 divides; hidden=256 keeps
+# head_dim at the kernel-minimum 64.
+CFG = dict(vocab_size=128, hidden_size=256, intermediate_size=256,
+           num_hidden_layers=1, num_attention_heads=4,
+           num_key_value_heads=4, max_position_embeddings=128)
+
+ENGINE_KW = dict(num_pages=64, page_size=8, token_budget=32,
+                 batch_buckets=[8], prefill_buckets=[32], pages_buckets=[8],
+                 temperature=0.0)
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    return LlamaForCausalLM(LlamaConfig(**CFG))
+
+
+def _fresh_model():
+    paddle.seed(0)
+    return LlamaForCausalLM(LlamaConfig(**CFG))
+
+
+def _mixed_workload(n=16, seed=42):
+    """Mixed prompt lengths, several sharing a prefix (the radix tree
+    must serve hits identically at every TP degree)."""
+    rng = np.random.RandomState(seed)
+    shared = rng.randint(0, 128, (16,)).tolist()        # 2 full pages
+    work = []
+    for i in range(n):
+        m = int(rng.randint(3, 6))
+        if i % 3 == 0:
+            tail = rng.randint(0, 128, (rng.randint(2, 8),)).tolist()
+            work.append((shared + tail, m))
+        else:
+            p = rng.randint(0, 128, (rng.randint(2, 25),)).tolist()
+            work.append((p, m))
+    return work
+
+
+def _host_trace(eng, rid0):
+    """One step's host-side bookkeeping fingerprint: free list ORDER,
+    refcounts, per-request pages/state, radix occupancy. TP must not
+    perturb any of it — page ids are global and every paging decision
+    is host-side. Request ids come off a process-global counter, so
+    they are recorded relative to the run's first id (`rid0`)."""
+    alloc = eng.allocator
+    return (
+        tuple(alloc._free),
+        tuple(sorted(alloc._refs.items())),
+        eng.radix.num_cached_pages if eng.radix else -1,
+        eng.radix.num_nodes if eng.radix else -1,
+        tuple(sorted(
+            (rid - rid0, r.state.name,
+             tuple(r.seq.pages) if getattr(r, "seq", None) is not None
+             else (), tuple(r.output_ids))
+            for rid, r in eng.requests.items())),
+    )
+
+
+def _run_traced(model, mesh, work, **engine_kw):
+    """Drain `work`, returning (per-request outputs, per-step host
+    traces, engine snapshot extras)."""
+    eng = ServingEngine(model, mesh=mesh, **ENGINE_KW, **engine_kw)
+    rids = [eng.add_request(p, max_new_tokens=m) for p, m in work]
+    traces = [_host_trace(eng, rids[0])]
+    guard = 0
+    while eng.has_work():
+        eng.step()
+        traces.append(_host_trace(eng, rids[0]))
+        guard += 1
+        assert guard < 500
+    out = [list(eng.requests[r].output_ids) for r in rids]
+    keys = eng.programs.keys()
+    counts = eng.program_counts()
+    snap = eng.metrics.snapshot()
+    eng.reset_prefix_cache()
+    assert eng.allocator.num_used == 0
+    eng.allocator.check_invariants()
+    eng.shutdown()
+    return out, traces, keys, counts, snap
+
+
+@skip_unless(gspmd_tp_mesh)
+def test_tp_greedy_identity_and_bit_identical_host_traces(model):
+    """The ISSUE 8 acceptance core: TP=2 and TP=4 engines on the
+    8-virtual-device mesh reproduce the single-device engine's greedy
+    tokens for a 16-request mixed workload with prefix-cache hits, and
+    the paging/refcount/free-list/radix trace of EVERY step is
+    bit-identical to single-chip."""
+    work = _mixed_workload(16)
+    base_out, base_traces, _, base_counts, base_snap = _run_traced(
+        model, None, work)
+    assert base_snap["prefix_hits"] > 0          # radix actually served
+    for tp in (2, 4):
+        out, traces, keys, counts, snap = _run_traced(
+            model, tp_serving_mesh(tp), work)
+        assert out == base_out, f"TP={tp} changed greedy tokens"
+        assert traces == base_traces, f"TP={tp} perturbed host state"
+        # mesh shape rides every program key; families report through
+        # the unified ProgramCache and match the single-device engine
+        assert all(k[-1] == ("tp", tp) for k in keys)
+        assert counts == base_counts
+        assert snap["prefix_hits"] == base_snap["prefix_hits"]
+        assert snap["kv_tp_degree"] == tp
+        assert snap["kv_page_bytes_shard"] * tp == snap["kv_page_bytes"]
+
+
+@skip_unless(gspmd_tp_mesh)
+def test_tp_spec_decode_identity(model):
+    """Speculative decoding under TP: the ("verify", B, K, P) program
+    shards like decode; greedy output stays identical to the TP=1 spec
+    engine (which itself equals plain decode) and drafts are accepted."""
+    rng = np.random.RandomState(3)
+    cyc = rng.randint(0, 128, (5,)).tolist()
+    work = [((cyc * 6)[:24], 6) for _ in range(4)]
+    base_out, base_traces, *_ = _run_traced(
+        model, None, work,
+        proposer=NgramProposer(), spec_k=2, spec_buckets=[2])
+    # TP=2 here; TP=4 is exercised by the int8 test below and by the
+    # 16-request identity test — keeping one degree per feature keeps
+    # the tier-1 wall-clock honest
+    out, traces, _, counts, snap = _run_traced(
+        model, tp_serving_mesh(2), work,
+        proposer=NgramProposer(), spec_k=2, spec_buckets=[2])
+    assert out == base_out, "TP=2 changed spec-decode tokens"
+    assert traces == base_traces
+    assert counts["verify"] >= 1
+    assert snap["spec_accepted_tokens"] > 0
+
+
+@pytest.mark.slow
+@skip_unless(gspmd_tp_mesh)
+def test_tp_int8_kv_identity(model):
+    """int8 KV pages under TP: the scale pages shard with their value
+    pages (same page ids), and output matches the TP=1 int8 engine.
+
+    slow-marked (with the wq test below): tier-1 runs within ~30s of
+    its 870s wall-clock budget, and these two are secondary identity
+    VARIANTS — the TP identity/trace contract is tier-1 via the core
+    test, the int8-under-TP geometry is tier-1 via the capacity test,
+    and single-chip int8/wq identity is tier-1 in
+    test_serving_quant_kv. `make test` opts back in via its explicit
+    `-m slow` pass over this file (pytest.ini's addopts would
+    otherwise deselect slow everywhere)."""
+    work = _mixed_workload(4, seed=9)
+    base_out, base_traces, *_ = _run_traced(model, None, work,
+                                            kv_dtype="int8")
+    # TP=4: one shard per kv head, int8 scale pages sharded alongside
+    # (the spec test covers TP=2)
+    out, traces, _, _, snap = _run_traced(
+        model, tp_serving_mesh(4), work, kv_dtype="int8")
+    assert out == base_out, "TP=4 changed int8-KV tokens"
+    assert traces == base_traces
+    assert snap["kv_dtype"] == "int8"
+    assert snap["kv_page_bytes_shard"] * 4 == snap["kv_page_bytes"]
+
+
+@pytest.mark.slow
+@skip_unless(gspmd_tp_mesh)
+def test_tp_weight_only_quant_identity():
+    """wq="int8" under TP: the quantized MLP/LM-head buffers inherit
+    the TP specs (column-parallel qweight/scale split the out dim,
+    row-parallel the in dim) and the fused dequant path's output
+    matches the TP=1 quantized engine. Fresh models per engine — the
+    conversion mutates in place; quantization happens BEFORE placement,
+    so the int8 images are bit-identical across TP degrees."""
+    work = _mixed_workload(4, seed=11)
+    base_out, base_traces, *_ = _run_traced(_fresh_model(), None, work,
+                                            wq="int8")
+    m2 = _fresh_model()
+    out, traces, *_ = _run_traced(m2, tp_serving_mesh(2), work, wq="int8")
+    assert out == base_out
+    assert traces == base_traces
+    # the quantized buffers carry the TP specs the engine placed by
+    sd = m2.state_dict()
+    assert tuple(sd["lm_head.qweight"]._spec) == (None, "model")
+    assert tuple(sd["lm_head.weight_scale"]._spec) == ("model",)
+    down = "model.layers.0.mlp.down_proj"
+    assert tuple(sd[f"{down}.qweight"]._spec) == ("model", None)
+
+
+@skip_unless(gspmd_tp_mesh)
+def test_tp_kv_capacity_scales_with_tp(model):
+    """At a fixed PER-CHIP kv_pool_bytes budget, head-sharded pages
+    cost kv_page_bytes/tp per chip, so the page count scales exactly
+    x TP — asserted through the single paged_page_bytes math source,
+    for full-width and int8 pages."""
+    from paddle_tpu.kernels.paged_attention import paged_page_bytes
+    pool = 1 << 20
+    kvh, page, hd = (CFG["num_key_value_heads"], ENGINE_KW["page_size"],
+                     CFG["hidden_size"] // CFG["num_attention_heads"])
+    for kv_dtype in (None, "int8"):
+        dt = kv_dtype or "float32"
+        engines = {}
+        for tp in (1, 2, 4):
+            kw = dict(ENGINE_KW)
+            kw.pop("num_pages")
+            eng = ServingEngine(
+                model, mesh=tp_serving_mesh(tp) if tp > 1 else None,
+                kv_pool_bytes=pool, kv_dtype=kv_dtype, **kw)
+            engines[tp] = eng
+            pb_shard = paged_page_bytes(kvh // tp, page, hd, dt)
+            assert eng.kv_page_bytes_shard == pb_shard
+            assert eng.num_pages == pool // pb_shard
+            assert eng.kv_page_bytes == paged_page_bytes(kvh, page, hd, dt)
+            # per-chip pool stays within (budget, budget - one page]
+            assert pool - pb_shard < eng.num_pages * pb_shard <= pool
+        # the capacity multiplier is TP up to floor rounding of the
+        # per-chip division: pool//(pb/tp) lands in
+        # [tp * (pool//pb), tp * (pool//pb) + tp)
+        for tp in (2, 4):
+            lo = tp * engines[1].num_pages
+            assert lo <= engines[tp].num_pages < lo + tp
+        for eng in engines.values():
+            eng.shutdown()
+
+
+def test_program_cache_families_bounds_and_enforcement():
+    """ProgramCache unit contract: per-family counts, lazily evaluated
+    bounds, loud failure on an unregistered family or a blown bound."""
+    compiled = []
+    pc = ProgramCache(on_compile=lambda: compiled.append(1))
+    bound = [2]
+    pc.register_family("decode", lambda: bound[0])
+    assert pc.get(("decode", 8), lambda: "p1") == "p1"
+    assert pc.get(("decode", 8), lambda: "XX") == "p1"   # hit: no rebuild
+    assert pc.get(("decode", 16), lambda: "p2") == "p2"
+    assert len(compiled) == 2
+    assert pc.counts() == {"decode": 2}
+    assert pc.num_programs == 2 and len(pc) == 2
+    assert pc.max_count() == pc.max_count("decode") == 2
+    with pytest.raises(RuntimeError):                    # bound blown
+        pc.get(("decode", 32), lambda: "p3")
+    bound[0] = 3                                         # lazy bound
+    assert pc.get(("decode", 32), lambda: "p3") == "p3"
+    with pytest.raises(KeyError):
+        pc.get(("nope", 1), lambda: "x")
+    assert ("decode", 8) in pc and ("nope", 1) not in pc
+
+
+def test_engine_family_bounds_match_bucket_grids(model):
+    """The engine's per-family bounds are the bucket grids; the flat
+    max_program_count stays their sum (the pre-ISSUE-8 number)."""
+    eng = ServingEngine(model, **ENGINE_KW)
+    assert eng.max_program_count("chunk") == \
+        len(eng.prefill_buckets) * len(eng.pages_buckets)
+    assert eng.max_program_count("decode") == \
+        len(eng.batch_buckets) * len(eng.pages_buckets)
+    assert eng.max_program_count("verify") == 0          # no proposer
+    assert eng.max_program_count() == (
+        eng.max_program_count("chunk") + eng.max_program_count("decode"))
+    assert eng.program_counts() == {"chunk": 0, "decode": 0, "verify": 0}
+    eng.shutdown()
+
+
+def test_tp_engine_validates_head_divisibility():
+    """A mesh whose model degree does not divide the head counts must
+    fail at construction, not at the first launch."""
+    if len(__import__("jax").devices()) < 2:
+        pytest.skip("needs >= 2 devices to form a model-axis mesh")
+    paddle.seed(1)
+    cfg = LlamaConfig(vocab_size=64, hidden_size=192, intermediate_size=64,
+                      num_hidden_layers=1, num_attention_heads=3,
+                      num_key_value_heads=3, max_position_embeddings=64)
+    m = LlamaForCausalLM(cfg)
+    with pytest.raises(ValueError, match="not\\s+divisible"):
+        ServingEngine(m, mesh=tp_serving_mesh(2), num_pages=16,
+                      page_size=8, temperature=0.0)
+
+
+def test_meshless_engine_masks_ambient_fleet_mesh():
+    """A mesh-less engine must trace single-chip even when the process
+    has a live fleet.init mesh with model degree > 1: _trace_scope pins
+    mesh_scope(None), masking the ambient mesh — otherwise a training
+    process's TP mesh would leak into the serving trace and activate
+    TP routing the engine never opted into or validated (heads=3 is
+    indivisible by the ambient tp=2, so a leak raises mid-step)."""
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices to form the ambient mesh")
+    import paddle_tpu.distributed.fleet.fleet as fleet_mod
+    from paddle_tpu.distributed.fleet import mpu
+
+    class _HCG:
+        mesh = tp_serving_mesh(2)
+
+    saved = fleet_mod._hcg
+    fleet_mod._hcg = _HCG()
+    try:
+        assert mpu.current_mesh() is _HCG.mesh
+        paddle.seed(1)
+        cfg = LlamaConfig(vocab_size=64, hidden_size=192,
+                          intermediate_size=64, num_hidden_layers=1,
+                          num_attention_heads=3, num_key_value_heads=3,
+                          max_position_embeddings=64)
+        eng = ServingEngine(LlamaForCausalLM(cfg), num_pages=16,
+                            page_size=8, batch_buckets=[4],
+                            prefill_buckets=[16], pages_buckets=[2],
+                            temperature=0.0)
+        rid = eng.add_request([1, 2, 3, 4, 5], max_new_tokens=3)
+        out = eng.run()
+        assert len(out[rid]) == 3
+        eng.shutdown()
+    finally:
+        fleet_mod._hcg = saved
+
+
+def test_metrics_merge_mixed_tp_keeps_pooled_bytes_exact():
+    """PR-7 merge sentinel rules extended (ISSUE 8): a fleet mixing TP
+    degrees zeroes the per-shard gauges + tp_degree (singleton-or-
+    sentinel, like kv_page_bytes) while pooled bytes and occupancy
+    stay EXACT — both derive from each replica's own global geometry."""
+    a = ServingMetrics(name="tp1")
+    a.set_kv_info(kv_dtype="float32", page_bytes=1024, pool_bytes=64 * 1024,
+                  bytes_per_token=128, tp_degree=1, page_bytes_shard=1024,
+                  pool_bytes_shard=64 * 1024)
+    a.update_gauges(queue_depth=0, running=0, kv_used_pages=16,
+                    kv_occupancy=0.25, cached_pages=0, radix_nodes=0)
+    b = ServingMetrics(name="tp2")
+    b.set_kv_info(kv_dtype="float32", page_bytes=1024,
+                  pool_bytes=128 * 1024, bytes_per_token=128, tp_degree=2,
+                  page_bytes_shard=512, pool_bytes_shard=64 * 1024)
+    b.update_gauges(queue_depth=0, running=0, kv_used_pages=64,
+                    kv_occupancy=0.5, cached_pages=0, radix_nodes=0)
+    m = ServingMetrics.merge(a, b)
+    # pooled global bytes sum exactly; occupancy is pooled used/total
+    # over pages recovered from each replica's OWN page geometry
+    assert m.kv_pool_bytes == (64 + 128) * 1024
+    assert m.kv_occupancy == pytest.approx((16 + 64) / (64 + 128))
+    # homogeneous global page bytes survive; mixed per-shard gauges
+    # collapse to sentinels
+    assert m.kv_page_bytes == 1024
+    assert m.kv_tp_degree == 0
+    assert m.kv_page_bytes_shard == 0
+    assert m.kv_pool_bytes_shard == 64 * 1024   # same on both: survives
+    snap = m.snapshot()
+    assert snap["kv_pool_bytes"] == (64 + 128) * 1024
+    assert snap["kv_tp_degree"] == 0
+    # a homogeneous-TP merge keeps the per-shard geometry intact
+    c = ServingMetrics(name="tp2b")
+    c.set_kv_info(kv_dtype="float32", page_bytes=1024,
+                  pool_bytes=128 * 1024, bytes_per_token=128, tp_degree=2,
+                  page_bytes_shard=512, pool_bytes_shard=64 * 1024)
+    h = ServingMetrics.merge(b, c)
+    assert h.kv_tp_degree == 2 and h.kv_page_bytes_shard == 512
