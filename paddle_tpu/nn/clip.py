@@ -17,11 +17,17 @@ class ClipGradByGlobalNorm:
         self.clip_norm = float(clip_norm)
 
     def __call__(self, params_grads):
+        """Global-norm clip over fp32 UPCASTS of the raw gradients —
+        fully on-device and traceable (a leftover host-fetch `float()`
+        reduction here used to break the whole train step out of
+        to_static AND pay a per-step relay round trip). The scale is a
+        function of the gradients only: `moment_dtype`/`fused` narrow
+        optimizer STORAGE after clipping, so the clip sees identical
+        fp32 values whatever the accumulators store
+        (tests/test_fused_optimizer.py pins this)."""
         grads = [g for _, g in params_grads if g is not None]
         if not grads:
             return params_grads
-        sq = sum(float(jnp.sum(jnp.square(g._data.astype(jnp.float32)))) for g in grads)
-        # keep on-device: recompute functionally
         total = jnp.sqrt(jnp.asarray(
             sum(jnp.sum(jnp.square(g._data.astype(jnp.float32))) for g in grads)))
         scale = jnp.minimum(self.clip_norm / jnp.maximum(total, 1e-6), 1.0)

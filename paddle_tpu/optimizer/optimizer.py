@@ -21,6 +21,40 @@ flagship-MFU lever. Reference analog: the low-precision moments path of
 fused_adam / PaddleNLP's bf16 optimizer
 (paddle/phi/kernels/fusion/gpu/fused_adam_kernel.cu uses MT=fp32 compute
 over narrow stored moments the same way).
+
+Fused update (ISSUE 9): `AdamW(..., fused=True)` (or
+FLAGS_fused_optimizer=1 as the global default) packs every eligible
+parameter leaf into padded flat buckets (kernels/fused_optimizer.py —
+one (rows, 128) bucket per (param dtype, effective-lr, decay-on)
+group) and performs the whole AdamW update in ONE Pallas pass: one
+read and one write per state byte instead of XLA's per-leaf
+upcast/downcast round trips. Moments and fp32 master weights then LIVE
+in bucket form (accumulator slots "fused_m"/"fused_v"/"fused_master"
+keyed by bucket id — raw_state round-trips them through the to_static
+donated-buffer step unchanged), while `state_dict()` de-bucketizes to
+the canonical per-parameter `moment1_i`/`moment2_i`/`master_i` keys so
+checkpoints stay interchangeable with the unfused optimizer (and
+`set_state_dict` re-buckets lazily at the next step). Eligibility:
+fp32 parameters, or narrow parameters under multi_precision=True (a
+narrow parameter WITHOUT a master weight keeps the eager per-leaf
+path — fused compute is fp32 by contract and would silently change
+its numerics); amsgrad keeps the eager path too. Non-fused optimizers
+(SGD/Lamb/LBFGS/...) ignore the flag entirely.
+
+ZeRO-1 (same bucket layout): when the active fleet mesh has
+sharding_degree > 1, the fused path shards the moment and master
+buckets over the 'sharding' axis (GSPMD constraints, no shard_map) —
+each rank updates rows/degree of optimizer state and the replication
+constraint on the param bucket is the parameter all-gather. Per-chip
+optimizer-state bytes drop by the sharding degree; see BASELINE.md for
+the sizing math.
+
+Grad clip x narrow states: grad clip runs BEFORE any accumulator is
+touched, on fp32 upcasts of the raw gradients (nn/clip.py), so the
+clip scale is identical whatever `moment_dtype` or `fused` say —
+moments narrow only at storage, and with multi_precision=False the
+fp32 parameter IS the master value the clipped update applies to.
+tests/test_fused_optimizer.py pins both properties.
 """
 from __future__ import annotations
 
@@ -42,15 +76,23 @@ def _register_moment_flag():
     from ..utils.flags import define_flag
     define_flag("bf16_optimizer_states", False,
                 "store optimizer accumulators in bfloat16 (fp32 compute)")
+    define_flag("fused_optimizer", False,
+                "use the fused multi-tensor Pallas update for optimizers "
+                "that support it (AdamW)")
 
 
 _register_moment_flag()
+
+# accumulator slots that hold BUCKETED fused state (kernels/
+# fused_optimizer.py layouts) rather than per-parameter arrays;
+# state_dict() de-bucketizes them, raw_state() passes them through
+_FUSED_SLOTS = ("fused_m", "fused_v", "fused_master")
 
 
 class Optimizer:
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None, multi_precision=False,
-                 moment_dtype=None):
+                 moment_dtype=None, fused=None):
         if parameters is None:
             raise ValueError(
                 "paddle_tpu optimizers require an explicit parameter list "
@@ -80,6 +122,16 @@ class Optimizer:
                 moment_dtype = "bfloat16"
         self._moment_dtype = jnp.dtype(moment_dtype) \
             if moment_dtype is not None else None
+        if fused is None:
+            from ..utils.flags import flags
+            fused = bool(flags("fused_optimizer"))
+        # only optimizers that implement _fused_step (AdamW) ever act on
+        # this; for the rest the flag is inert by construction
+        self._fused = bool(fused)
+        # bucket bookkeeping (fused path): group key -> {uid, layout,
+        # sig, ...}; geometry is rebuilt deterministically from the
+        # parameter list, only the ARRAYS live in _accumulators
+        self._fused_buckets: Dict = {}
 
     # ------------------------------------------------------------------- lr
     def get_lr(self) -> float:
@@ -142,6 +194,11 @@ class Optimizer:
             params_grads = self._grad_clip(params_grads)
         lr = self.get_lr()
         self._step_count += 1
+        if self._fused and params_grads:
+            # returns the (p, g) pairs the fused path did NOT handle;
+            # base implementation handles nothing (flag inert for
+            # optimizers without a fused update)
+            params_grads = self._fused_step(params_grads, lr)
         for idx, p in enumerate(self._parameter_list):
             match = next((g for (pp, g) in params_grads if pp is p), None)
             if match is None:
@@ -151,6 +208,58 @@ class Optimizer:
             self._apply_one(idx, p, g, lr * lr_scale)
 
     minimize_step = step
+
+    def _fused_step(self, params_grads, lr):
+        """Fused multi-tensor hook: handle what you can, return the
+        rest for the per-parameter loop. Base: nothing is handled."""
+        return params_grads
+
+    # ----------------------------------------------- fused bucket plumbing
+    @staticmethod
+    def _fused_mesh():
+        """(mesh, degree) of the active 'sharding' axis, or (None, 1) —
+        degree > 1 turns the fused update into ZeRO-1."""
+        try:
+            from ..distributed.fleet import fleet as fleet_mod
+            mesh = getattr(getattr(fleet_mod, "_hcg", None), "mesh", None)
+        except Exception:
+            mesh = None
+        if mesh is None:
+            return None, 1
+        degree = dict(mesh.shape).get("sharding", 1)
+        return (mesh, degree) if degree > 1 else (None, 1)
+
+    def _fused_state_entries(self):
+        """Per-parameter view of every bucketed slot (for state_dict):
+        {canonical_key: array} by slicing the live buckets."""
+        from ..kernels.fused_optimizer import unpack_bucket
+        out = {}
+        for rec in self._fused_buckets.values():
+            uid, layout = rec["uid"], rec["layout"]
+            for slot, canon in (("fused_m", "moment1"),
+                                ("fused_v", "moment2"),
+                                ("fused_master", "master")):
+                bucket = self._accumulators.get(slot, {}).get(uid)
+                if bucket is None:
+                    continue
+                for arr, (idx, _, _, _) in zip(
+                        unpack_bucket(bucket, layout), layout.entries):
+                    out[f"{canon}_{idx}"] = arr
+        return out
+
+    def _drop_fused_buckets(self, debucketize=False):
+        """Forget bucketed storage — optionally writing it back to the
+        canonical per-parameter slots first (layout-change path)."""
+        if debucketize:
+            for key, arr in self._fused_state_entries().items():
+                name, idx = key.rsplit("_", 1)
+                if name == "master":
+                    self._master_weights[int(idx)] = arr
+                else:
+                    self._accumulators.setdefault(name, {})[int(idx)] = arr
+        for slot in _FUSED_SLOTS:
+            self._accumulators.pop(slot, None)
+        self._fused_buckets.clear()
 
     def _apply_one(self, idx: int, p: Tensor, g: jax.Array, lr: float):
         raise NotImplementedError
@@ -187,16 +296,31 @@ class Optimizer:
     def state_dict(self):
         out = {}
         for name, slot in self._accumulators.items():
+            if name in _FUSED_SLOTS:
+                continue    # exported in canonical per-parameter form below
             for idx, arr in slot.items():
                 out[f"{name}_{idx}"] = Tensor(arr)
         for idx, arr in self._master_weights.items():
             out[f"master_{idx}"] = Tensor(arr)
+        # bucketed fused state de-bucketizes to the same canonical keys
+        # the unfused optimizer writes, so checkpoints are
+        # interchangeable across fused=True/False
+        for key, arr in self._fused_state_entries().items():
+            out[key] = Tensor(arr)
         out["@step"] = self._step_count
         if isinstance(self._learning_rate, LRScheduler):
             out["LR_Scheduler"] = self._learning_rate.state_dict()
         return out
 
     def set_state_dict(self, state):
+        # canonical per-parameter entries rule: stale buckets would
+        # shadow them at the next fused step, so DEBUCKETIZE into the
+        # canonical slots first (a PARTIAL state dict must overwrite
+        # only the keys it carries, same as the unfused path — dropping
+        # the buckets outright would silently zero the rest), then let
+        # the incoming entries overwrite; the fused path re-buckets
+        # from the canonical slots lazily at the next step
+        self._drop_fused_buckets(debucketize=True)
         for key, v in state.items():
             if key == "@step":
                 self._step_count = int(v)
@@ -268,9 +392,10 @@ class Adam(Optimizer):
                  epsilon=1e-08, parameters=None, weight_decay=None,
                  grad_clip=None, lazy_mode=False, multi_precision=False,
                  use_multi_tensor=False, name=None, amsgrad=False,
-                 moment_dtype=None):
+                 moment_dtype=None, fused=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
-                         name, multi_precision, moment_dtype=moment_dtype)
+                         name, multi_precision, moment_dtype=moment_dtype,
+                         fused=fused)
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
         self._amsgrad = amsgrad
 
@@ -302,11 +427,11 @@ class AdamW(Adam):
                  epsilon=1e-08, parameters=None, weight_decay=0.01,
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
                  lazy_mode=False, multi_precision=False, name=None,
-                 amsgrad=False, moment_dtype=None):
+                 amsgrad=False, moment_dtype=None, fused=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          None, grad_clip, lazy_mode, multi_precision,
                          name=name, amsgrad=amsgrad,
-                         moment_dtype=moment_dtype)
+                         moment_dtype=moment_dtype, fused=fused)
         from ..regularizer import L1Decay, L2Decay
         if isinstance(weight_decay, L1Decay):
             # parity: reference AdamW rejects regularizer objects — a
@@ -343,6 +468,165 @@ class AdamW(Adam):
             self._set_acc("moment2_max", idx, vmax)
             vhat = vmax
         self._writeback(idx, p, m_w - lr * mhat / (jnp.sqrt(vhat) + self._eps))
+
+    # ------------------------------------------------------- fused update
+    def _fused_eligible(self, p) -> bool:
+        """Fused compute is fp32 by contract: fp32 parameters, or
+        narrow parameters whose fp32 truth is a master weight. A narrow
+        parameter WITHOUT a master runs its eager bf16/fp16 update
+        unchanged (fusing it would silently improve its numerics)."""
+        if p._data.dtype == jnp.float32:
+            return True
+        return self._multi_precision and \
+            p._data.dtype in (jnp.bfloat16, jnp.float16)
+
+    def _fused_step(self, params_grads, lr):
+        """Bucketed multi-tensor AdamW (kernels/fused_optimizer.py).
+
+        Groups eligible parameters by (dtype, effective-lr, decay-on),
+        packs each group into one padded (rows, 128) bucket, and runs
+        the whole update in one Pallas pass (one read + one write per
+        state byte). Moments/master weights persist IN bucket form
+        under the "fused_m"/"fused_v"/"fused_master" accumulator slots;
+        with an active 'sharding' mesh axis the update runs ZeRO-1
+        sharded. Returns the pairs the fused path does not cover
+        (narrow params without master, amsgrad)."""
+        if self._amsgrad:
+            return params_grads
+        from ..kernels.fused_optimizer import (
+            adamw_scalars, build_bucket_layout, fused_adamw_bucket,
+            fused_adamw_zero1, pack_bucket, unpack_bucket)
+
+        mesh, degree = self._fused_mesh()
+        idx_of = {id(p): i for i, p in enumerate(self._parameter_list)}
+        groups: Dict = {}
+        leftover = []
+        for p, g in params_grads:
+            if not self._fused_eligible(p):
+                leftover.append((p, g))
+                continue
+            idx = idx_of[id(p)]
+            lr_mult = float(getattr(p, "_lr_scale", 1.0))
+            if self._lr_ratio is not None:
+                lr_mult *= float(self._lr_ratio(p))
+            decay_on = self._wd != 0.0 and (
+                self._apply_decay_fn is None
+                or self._apply_decay_fn(p.name or f"param_{idx}"))
+            key = (str(p._data.dtype), lr_mult, bool(decay_on))
+            groups.setdefault(key, []).append((idx, p, g._data))
+
+        if not groups:
+            return leftover
+        ordered = sorted(groups.items(), key=lambda kv: kv[1][0][0])
+        # geometry guard: any layout drift de-bucketizes everything back
+        # to the canonical slots and rebuilds — moments survive the
+        # migration. Two triggers: (a) an existing group's sig changed
+        # (new/lost grads in it, dtype or sharding-degree change, uid
+        # shift from group reordering); (b) a whole group VANISHED —
+        # its bucket would otherwise linger under a uid a new group can
+        # be assigned, silently adopting or clobbering foreign moments
+        rebuild = bool(set(self._fused_buckets) - {k for k, _ in ordered})
+        for uid, (key, members) in enumerate(ordered):
+            sig = (uid, degree,
+                   tuple((idx, p._data.shape) for idx, p, _ in members))
+            rec = self._fused_buckets.get(key)
+            if rec is not None and rec["sig"] != sig:
+                rebuild = True
+        if rebuild:
+            self._drop_fused_buckets(debucketize=True)
+
+        for uid, (key, members) in enumerate(ordered):
+            param_dtype, lr_mult, decay_on = key
+            lr_eff = lr * lr_mult
+            rec = self._fused_buckets.get(key)
+            if rec is None:
+                layout = build_bucket_layout(
+                    [(idx, p._data.shape) for idx, p, _ in members],
+                    sharding_degree=degree)
+                sig = (uid, degree,
+                       tuple((idx, p._data.shape) for idx, p, _ in members))
+                rec = {"uid": uid, "layout": layout, "sig": sig}
+                self._fused_buckets[key] = rec
+            layout = rec["layout"]
+            has_master = jnp.dtype(param_dtype) != jnp.float32
+            mdtype = self._moment_dtype if self._moment_dtype is not None \
+                else jnp.float32
+            self._seed_fused_bucket(uid, layout, members, mdtype,
+                                    has_master, mesh)
+            g_bucket = pack_bucket([g for _, _, g in members], layout,
+                                   jnp.dtype(param_dtype))
+            if has_master:
+                w_bucket = self._accumulators["fused_master"][uid]
+            else:
+                w_bucket = pack_bucket([p._data for _, p, _ in members],
+                                       layout, jnp.float32)
+            m_bucket = self._accumulators["fused_m"][uid]
+            v_bucket = self._accumulators["fused_v"][uid]
+            scalars = adamw_scalars(lr_eff, self._beta1, self._beta2,
+                                    self._eps,
+                                    self._wd if decay_on else 0.0,
+                                    self._step_count)
+            if mesh is not None:
+                p_new, w_new, m_new, v_new = fused_adamw_zero1(
+                    g_bucket, w_bucket, m_bucket, v_bucket, scalars, mesh,
+                    param_dtype=jnp.dtype(param_dtype) if has_master
+                    else None)
+            else:
+                p_new, w_new, m_new, v_new = fused_adamw_bucket(
+                    g_bucket, w_bucket, m_bucket, v_bucket, scalars,
+                    param_dtype=jnp.dtype(param_dtype) if has_master
+                    else None)
+            self._accumulators["fused_m"][uid] = m_new
+            self._accumulators["fused_v"][uid] = v_new
+            if has_master:
+                self._accumulators["fused_master"][uid] = w_new
+            for arr, (_, p, _) in zip(unpack_bucket(p_new, layout), members):
+                p._data = arr
+        return leftover
+
+    def _seed_fused_bucket(self, uid, layout, members, mdtype,
+                           has_master, mesh):
+        """Materialize a group's m/v (+ master) buckets if absent —
+        from the canonical per-parameter slots when present (checkpoint
+        reload / migration from the eager path), else zeros / fp32
+        param casts, matching the eager accumulators' init exactly.
+        Consumed per-parameter entries are removed so state never
+        exists twice. Sharded placement happens at creation; once
+        placed, updates inherit the layout (no per-step device_put)."""
+        from ..kernels.fused_optimizer import pack_bucket, LANES
+        m_slot = self._accumulators.setdefault("fused_m", {})
+        v_slot = self._accumulators.setdefault("fused_v", {})
+        w_slot = self._accumulators.setdefault("fused_master", {})
+
+        def place(arr):
+            if mesh is None:
+                return arr
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            return jax.device_put(
+                arr, NamedSharding(mesh, P("sharding", None)))
+
+        shape = (layout.rows, LANES)
+        for slot, canon, dtype in ((m_slot, "moment1", mdtype),
+                                   (v_slot, "moment2", mdtype)):
+            cur = slot.get(uid)
+            if cur is not None and cur.shape == shape and cur.dtype == dtype:
+                continue
+            canon_slot = self._accumulators.get(canon, {})
+            parts = []
+            for idx, p, _ in members:
+                prev = canon_slot.pop(idx, None)
+                parts.append(jnp.zeros(p._data.shape, dtype) if prev is None
+                             else prev.astype(dtype))
+            slot[uid] = place(pack_bucket(parts, layout, dtype))
+        if has_master:
+            cur = w_slot.get(uid)
+            if cur is None or cur.shape != shape:
+                parts = []
+                for idx, p, _ in members:
+                    prev = self._master_weights.pop(idx, None)
+                    parts.append(p._data.astype(jnp.float32)
+                                 if prev is None else prev)
+                w_slot[uid] = place(pack_bucket(parts, layout, jnp.float32))
 
 
 class Adagrad(Optimizer):

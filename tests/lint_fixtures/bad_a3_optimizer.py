@@ -1,0 +1,50 @@
+"""Known-bad A3: a VMEM-oversized fused-optimizer block — 8192 rows of
+the same 4-in/4-out AdamW bucket streams ~45 MB of double-buffered
+blocks + fp32 compute temporaries through one grid step, far past the
+~16 MB scoped-vmem budget (the same failure shape as the rms
+block_rows=256 @ H=4096 chip OOM). `pick_block_rows_fused` halves this
+to 1024 (see good_a3_optimizer.py); shipping 8192 would only fail at
+Mosaic compile time on chip."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+_I0 = np.int32(0)
+_ROWS = 8192        # oversized: ~45 MB estimated for one grid step
+_LANES = 128
+
+
+def kernel(g_ref, w_ref, m_ref, v_ref, p_out, w_out, m_out, v_out):
+    g = g_ref[...].astype(jnp.float32)
+    w = w_ref[...] * (1.0 - 3e-4 * 0.01)
+    m = 0.9 * m_ref[...].astype(jnp.float32) + 0.1 * g
+    v = 0.999 * v_ref[...].astype(jnp.float32) + 0.001 * g * g
+    w = w - 3e-4 * m / (jnp.sqrt(v) + 1e-8)
+    p_out[...] = w.astype(jnp.bfloat16)
+    w_out[...] = w
+    m_out[...] = m.astype(jnp.bfloat16)
+    v_out[...] = v.astype(jnp.bfloat16)
+
+
+def run(g, w, m, v):
+    rows = g.shape[0]
+    # tpu-lint-hint: vmem-dtypes=bfloat16,float32,bfloat16,bfloat16
+    return pl.pallas_call(
+        kernel,
+        grid=(rows // _ROWS,),
+        in_specs=[pl.BlockSpec((_ROWS, _LANES), lambda i: (i, _I0)),
+                  pl.BlockSpec((_ROWS, _LANES), lambda i: (i, _I0)),
+                  pl.BlockSpec((_ROWS, _LANES), lambda i: (i, _I0)),
+                  pl.BlockSpec((_ROWS, _LANES), lambda i: (i, _I0))],
+        out_specs=[pl.BlockSpec((_ROWS, _LANES), lambda i: (i, _I0)),
+                   pl.BlockSpec((_ROWS, _LANES), lambda i: (i, _I0)),
+                   pl.BlockSpec((_ROWS, _LANES), lambda i: (i, _I0)),
+                   pl.BlockSpec((_ROWS, _LANES), lambda i: (i, _I0))],
+        out_shape=(
+            jax.ShapeDtypeStruct((rows, _LANES), jnp.bfloat16),
+            jax.ShapeDtypeStruct((rows, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((rows, _LANES), jnp.bfloat16),
+            jax.ShapeDtypeStruct((rows, _LANES), jnp.bfloat16),
+        ),
+    )(g, w, m, v)

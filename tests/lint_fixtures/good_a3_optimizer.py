@@ -1,0 +1,48 @@
+"""Known-good A3: the fused-optimizer bucket kernel's shipped pick —
+`fused_optimizer.pick_block_rows_fused` lands on 1024 rows for the
+flagship recipe (bf16 grads/moments, fp32 master), ~5.6 MB estimated
+with the per-in-spec dtype hint (true widths, not the bf16 out dtype
+for the fp32 master block)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+_I0 = np.int32(0)
+_ROWS = 1024        # pick_block_rows_fused(...) flagship pick
+_LANES = 128
+
+
+def kernel(g_ref, w_ref, m_ref, v_ref, p_out, w_out, m_out, v_out):
+    g = g_ref[...].astype(jnp.float32)
+    w = w_ref[...] * (1.0 - 3e-4 * 0.01)
+    m = 0.9 * m_ref[...].astype(jnp.float32) + 0.1 * g
+    v = 0.999 * v_ref[...].astype(jnp.float32) + 0.001 * g * g
+    w = w - 3e-4 * m / (jnp.sqrt(v) + 1e-8)
+    p_out[...] = w.astype(jnp.bfloat16)
+    w_out[...] = w
+    m_out[...] = m.astype(jnp.bfloat16)
+    v_out[...] = v.astype(jnp.bfloat16)
+
+
+def run(g, w, m, v):
+    rows = g.shape[0]
+    # tpu-lint-hint: vmem-dtypes=bfloat16,float32,bfloat16,bfloat16
+    return pl.pallas_call(
+        kernel,
+        grid=(rows // _ROWS,),
+        in_specs=[pl.BlockSpec((_ROWS, _LANES), lambda i: (i, _I0)),
+                  pl.BlockSpec((_ROWS, _LANES), lambda i: (i, _I0)),
+                  pl.BlockSpec((_ROWS, _LANES), lambda i: (i, _I0)),
+                  pl.BlockSpec((_ROWS, _LANES), lambda i: (i, _I0))],
+        out_specs=[pl.BlockSpec((_ROWS, _LANES), lambda i: (i, _I0)),
+                   pl.BlockSpec((_ROWS, _LANES), lambda i: (i, _I0)),
+                   pl.BlockSpec((_ROWS, _LANES), lambda i: (i, _I0)),
+                   pl.BlockSpec((_ROWS, _LANES), lambda i: (i, _I0))],
+        out_shape=(
+            jax.ShapeDtypeStruct((rows, _LANES), jnp.bfloat16),
+            jax.ShapeDtypeStruct((rows, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((rows, _LANES), jnp.bfloat16),
+            jax.ShapeDtypeStruct((rows, _LANES), jnp.bfloat16),
+        ),
+    )(g, w, m, v)

@@ -60,8 +60,8 @@ def test_pallas_failure_falls_back_to_xla(monkeypatch, capsys):
     attempt succeeds -> the result records what it recovered from."""
     calls = []
 
-    def fake_run(use_pallas, shrink):
-        calls.append((use_pallas, shrink))
+    def fake_run(use_pallas, shrink, fused_opt=False):
+        calls.append((use_pallas, shrink, fused_opt))
         if use_pallas:
             raise RuntimeError("Mosaic lowering exploded")
         return {"metric": "llama_pretrain_mfu", "value": 0.5,
@@ -73,12 +73,14 @@ def test_pallas_failure_falls_back_to_xla(monkeypatch, capsys):
     rec = _parse_single_json_line(capsys.readouterr().out)
     assert rec["value"] == 0.5
     assert "Mosaic lowering exploded" in rec["recovered_from"]
-    # chain order: pallas full -> xla full (stops at first success)
-    assert calls == [(True, 0), (False, 0)]
+    # chain order: pallas+fused -> pallas -> xla full (first success
+    # stops; the fused-optimizer attempt leads so a fused-kernel chip
+    # failure degrades to the measured round-4 configuration)
+    assert calls == [(True, 0, True), (True, 0, False), (False, 0, False)]
 
 
 def test_every_path_raising_emits_error_record(monkeypatch, capsys):
-    def fake_run(use_pallas, shrink):
+    def fake_run(use_pallas, shrink, fused_opt=False):
         raise RuntimeError(f"boom pallas={use_pallas} shrink={shrink}")
 
     monkeypatch.setattr(bench, "run", fake_run)
@@ -116,3 +118,22 @@ def test_fault_inject_spec_matching():
             del os.environ["BENCH_FAULT_INJECT"]
     # inert without the env var
     bench._maybe_inject_fault(0, {"use_pallas": True, "shrink": 0})
+
+
+def test_bench_fused_opt_env_gate(monkeypatch, capsys):
+    """BENCH_FUSED_OPT=0 drops the fused attempt entirely — the A/B
+    knob chip_hour's re-run uses to record the round-4 configuration
+    in the same window."""
+    calls = []
+
+    def fake_run(use_pallas, shrink, fused_opt=False):
+        calls.append(fused_opt)
+        return {"metric": "llama_pretrain_mfu", "value": 0.6,
+                "unit": "fraction_of_peak", "vs_baseline": 1.5}
+
+    monkeypatch.setattr(bench, "run", fake_run)
+    monkeypatch.setattr(bench, "_enable_compile_cache", lambda: None)
+    monkeypatch.setenv("BENCH_FUSED_OPT", "0")
+    bench.worker()
+    _parse_single_json_line(capsys.readouterr().out)
+    assert calls == [False]                  # non-fused attempt leads

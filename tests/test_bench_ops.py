@@ -200,3 +200,52 @@ def test_help_documents_median_spread_mode():
     help_text = out.stdout
     assert "median" in help_text and "--spread-pct" in help_text
     assert "--max-reruns" in help_text and "-k" in help_text
+
+
+def test_optimizer_update_rows_and_decisions(bench_ops):
+    """The ISSUE-9 optimizer bench: one bytes-true row per state recipe
+    (fp32 moments / bf16 moments / fused pallas), a projected-608M row
+    each, the static bf16 bytes ratio, and the fused-vs-XLA decision
+    row. Timing mocked so the contract is provable on CPU: with the
+    fused path measured faster, its GB/s must come out >= the unfused
+    row's (the acceptance bar for the chip window)."""
+    times = iter([3e-3,     # xla_fp32_moments
+                  2.2e-3,   # xla_bf16_moments
+                  2.0e-3])  # fused_pallas_bf16_moments
+
+    bench_ops._time_stats = lambda fn, *a, iters=10: (next(times), 0.01)
+    bench_ops.bench_optimizer_update("cpu", quick=True)
+    rows = [r for r in bench_ops.RESULTS if r["bench"] == "optimizer_update"]
+    timed = {r["variant"]: r for r in rows if "ms" in r}
+    assert set(timed) == {"xla_fp32_moments", "xla_bf16_moments",
+                          "fused_pallas_bf16_moments"}
+    decisions = {r["variant"]: r["value"] for r in rows if "value" in r}
+    # bytes-true: bf16 moments move 20 B/elem vs 28 B/elem fp32 (master
+    # recipe) -> static ratio 1.4 exactly
+    assert decisions["bf16_state_bytes_ratio"] == 1.4
+    # measured decision row: (2.2 - 2.0) / 2.2
+    assert decisions["fused_vs_xla_speedup_pct"] == pytest.approx(9.09,
+                                                                  abs=0.01)
+    # the fused row must report >= the unfused GB/s (same bytes, less
+    # time) — the bench_ops acceptance contract for this PR
+    assert timed["fused_pallas_bf16_moments"]["gbps"] >= \
+        timed["xla_bf16_moments"]["gbps"]
+    # projected flagship rows exist for every recipe and scale with GB/s
+    proj = {k: v for k, v in decisions.items()
+            if k.startswith("projected_608M_ms_")}
+    assert len(proj) == 3
+    assert proj["projected_608M_ms_fused_pallas_bf16_moments"] < \
+        proj["projected_608M_ms_xla_fp32_moments"]
+
+
+def test_optimizer_update_nan_sentinel_skips_decisions(bench_ops):
+    """A NaN draw must not fabricate speedup/projection rows."""
+    bench_ops._time_stats = \
+        lambda fn, *a, iters=10: (float("nan"), float("nan"))
+    bench_ops.bench_optimizer_update("cpu", quick=True)
+    rows = [r for r in bench_ops.RESULTS if r["bench"] == "optimizer_update"]
+    variants = {r["variant"] for r in rows}
+    assert "fused_vs_xla_speedup_pct" not in variants
+    assert not any(v.startswith("projected_608M") for v in variants)
+    # the static bytes ratio is timing-independent and stays
+    assert "bf16_state_bytes_ratio" in variants

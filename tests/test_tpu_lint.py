@@ -34,6 +34,7 @@ BAD_FIXTURES = {
     "bad_a2_blockspec.py": "A2",
     "bad_a3_vmem.py": "A3",
     "bad_a3_quant.py": "A3",
+    "bad_a3_optimizer.py": "A3",
     "bad_a4_runtime.py": "A4",
     "bad_a5_purity.py": "A5",
 }
@@ -42,6 +43,7 @@ GOOD_FIXTURES = [
     "good_a2_blockspec.py",
     "good_a3_vmem.py",
     "good_a3_quant_hint.py",
+    "good_a3_optimizer.py",
     "good_a4_runtime.py",
     "good_a5_purity.py",
 ]

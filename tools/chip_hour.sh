@@ -104,6 +104,44 @@ print("RMSNORM_CHIP_OK")
 EOF
 step rms_norm 600 /tmp/chip_rmsnorm.py
 
+cat > /tmp/chip_fused_opt.py <<'EOF'
+# Fused bucketed AdamW (ISSUE 9) on the real chip: Mosaic-compile the
+# kernel at the flagship recipe (bf16 grads, fp32 master, bf16
+# moments), check it against the identical XLA composition, and
+# device_time both so the fused-vs-XLA decision row lands with real
+# numbers (GB/s math mirrors bench_ops.bench_optimizer_update).
+import numpy as np, jax, jax.numpy as jnp
+from paddle_tpu.kernels.fused_optimizer import (
+    LANES, adamw_scalars, adamw_update_bytes, fused_adamw_bucket)
+from paddle_tpu.kernels.timing import device_time
+print("devices:", jax.devices())
+rows = 131072                      # 16.8M elems -> ~0.34 GB of state
+rng = np.random.RandomState(0)
+g = jnp.asarray(rng.randn(rows, LANES), jnp.bfloat16)
+w = jnp.asarray(rng.randn(rows, LANES), jnp.float32)
+m = jnp.zeros((rows, LANES), jnp.bfloat16)
+v = jnp.zeros((rows, LANES), jnp.bfloat16)
+s = adamw_scalars(3e-4, 0.9, 0.999, 1e-8, 0.01, 7)
+pl_fn = jax.jit(lambda g, w, m, v: fused_adamw_bucket(
+    g, w, m, v, s, param_dtype=jnp.bfloat16, use_pallas=True))
+xla_fn = jax.jit(lambda g, w, m, v: fused_adamw_bucket(
+    g, w, m, v, s, param_dtype=jnp.bfloat16, use_pallas=False))
+outs_pl = pl_fn(g, w, m, v)
+outs_x = xla_fn(g, w, m, v)
+err = max(float(jnp.abs(a.astype(jnp.float32) -
+                        b.astype(jnp.float32)).max())
+          for a, b in zip(outs_pl, outs_x))
+assert err < 1e-4, f"fused-vs-XLA mismatch {err}"
+nbytes = adamw_update_bytes(rows * LANES, param_width=2, moment_width=2,
+                            has_master=True)
+for name, fn in (("pallas", pl_fn), ("xla", xla_fn)):
+    dt = device_time(fn, g, w, m, v)
+    gbps = nbytes / dt / 1e9 if dt > 0 else float("nan")
+    print(f"FUSED_OPT {name} ms={dt * 1e3:.3f} GB/s={gbps:.1f}")
+print("FUSED_OPT_CHIP_OK")
+EOF
+step fused_opt 900 /tmp/chip_fused_opt.py
+
 # 2b. numeric parity on chip (kernels execute AND match XLA references)
 step parity 900 tools/chip_parity.py
 
@@ -118,6 +156,15 @@ step ladder 1800 tools/chip_ladder.py
 #    that; bench_ops failures are recorded like validation steps.
 timeout -s TERM -k 60 900 python bench.py || FAILED="$FAILED bench"
 step bench_ops 2700 bench_ops.py --write-md
+
+# 3b. flagship A/B re-run (ISSUE 9): the first bench line leads with
+#     the fused optimizer; this one pins BENCH_FUSED_OPT=0 so the SAME
+#     window also records the round-4 non-fused configuration. The
+#     fallback chain only degrades on exceptions — a fused config that
+#     runs but is slower can only be caught by comparing these two
+#     lines (the "optimizer" field labels each).
+BENCH_FUSED_OPT=0 timeout -s TERM -k 60 900 python bench.py \
+  || FAILED="$FAILED bench_nonfused"
 
 if [ -n "$FAILED" ]; then
   echo "CHIP_HOUR_FAILURES:$FAILED"

@@ -140,4 +140,55 @@ e_full = relerr(out_pl, np.asarray(x) @ w)
 assert e_full < 2e-2, f"quant_matmul vs full precision {e_full}"
 print(f"PARITY quant_matmul xla={e:.6f} full={e_full:.4f} OK")
 
+# ---- fused AdamW bucket kernel vs the jnp reference update (ISSUE 9) -
+# the flagship recipe: bf16 grads/params, fp32 master, bf16 moments.
+# Two checks on chip: (a) the Pallas kernel vs the identical XLA
+# composition (same _adamw_math expression — catches Mosaic lowering
+# bugs, moments must match bitwise, master within fp32 fusion noise),
+# (b) the kernel vs a hand-written jnp AdamW step (independent
+# expression, loose fp32 budget).
+from paddle_tpu.kernels.fused_optimizer import (adamw_scalars,
+                                                fused_adamw_bucket)
+rows = 4096
+gf = jnp.asarray(rng.randn(rows, 128), jnp.bfloat16)
+wf = jnp.asarray(rng.randn(rows, 128), jnp.float32)
+sc = adamw_scalars(3e-4, 0.9, 0.999, 1e-8, 0.01, 1)
+# bitwise moment check from ZERO-seeded moments (the step-1 shape):
+# with m = v = 0 there is no FMA-contraction ambiguity in the moment
+# chain, so Mosaic and XLA:TPU must agree bit-for-bit; from nonzero
+# moments a contracted `b1*m + omb1*g` can legally differ by 1 fp32
+# ulp and flip a bf16 storage bit — that case gets a tolerance below
+mz = jnp.zeros((rows, 128), jnp.bfloat16)
+vz = jnp.zeros((rows, 128), jnp.bfloat16)
+p_pl, w_pl, m_pl, v_pl = fused_adamw_bucket(
+    gf, wf, mz, vz, sc, param_dtype=jnp.bfloat16, use_pallas=True)
+p_x, w_x, m_x, v_x = fused_adamw_bucket(
+    gf, wf, mz, vz, sc, param_dtype=jnp.bfloat16, use_pallas=False)
+assert bool(jnp.all(m_pl == m_x)) and bool(jnp.all(v_pl == v_x)), \
+    "fused AdamW step-1 moment storage differs from the XLA composition"
+e = relerr(w_pl, w_x)
+assert e < 1e-5, f"fused AdamW master vs XLA composition {e}"
+# steady-state (nonzero moments): FMA-tolerant budgets, plus an
+# independent hand-written fp32 reference
+mf = jnp.asarray(rng.randn(rows, 128), jnp.bfloat16) * 0.01
+vf = jnp.abs(jnp.asarray(rng.randn(rows, 128), jnp.bfloat16)) * 0.01
+sc7 = adamw_scalars(3e-4, 0.9, 0.999, 1e-8, 0.01, 7)
+p_pl, w_pl, m_pl, v_pl = fused_adamw_bucket(
+    gf, wf, mf, vf, sc7, param_dtype=jnp.bfloat16, use_pallas=True)
+p_x, w_x, m_x, v_x = fused_adamw_bucket(
+    gf, wf, mf, vf, sc7, param_dtype=jnp.bfloat16, use_pallas=False)
+for nm, a, b, budget in [("m", m_pl, m_x, 1e-2), ("v", v_pl, v_x, 1e-2),
+                         ("w", w_pl, w_x, 1e-5)]:
+    es = relerr(a, b)
+    assert es < budget, f"fused AdamW steady-state {nm} parity {es}"
+g32 = gf.astype(jnp.float32)
+m32 = 0.9 * mf.astype(jnp.float32) + 0.1 * g32
+v32 = 0.999 * vf.astype(jnp.float32) + 0.001 * g32 * g32
+wd = wf * (1.0 - 3e-4 * 0.01)
+ref_w = wd - 3e-4 * (m32 / (1 - 0.9 ** 7)) / (
+    jnp.sqrt(v32 / (1 - 0.999 ** 7)) + 1e-8)
+e2 = relerr(w_pl, ref_w)
+assert e2 < 1e-4, f"fused AdamW vs hand reference {e2}"
+print(f"PARITY fused_adamw xla={e:.2e} ref={e2:.2e} OK")
+
 print("CHIP_PARITY_ALL_OK")
