@@ -28,6 +28,10 @@ bench:
 # excluded from tier-1 via the `slow` marker (pytest.ini addopts).
 soak:
 	$(TEST_ENV) python tools/soak_serving.py --requests 200 --seed 0
+	# trace-report smoke (ISSUE 10): re-read the trace the soak's
+	# traced pass exported (stdlib-only, but TEST_ENV anyway — every
+	# plain python start claims the TPU grant)
+	$(TEST_ENV) python tools/trace_report.py profiler_log/soak_trace.json
 	$(TEST_ENV) python -m pytest tests/test_soak_serving.py -m slow -q
 
 # Multi-replica fleet chaos soak (ISSUE 7): seeded kill + stall of
@@ -35,6 +39,9 @@ soak:
 # CPU-only, minutes-bounded; excluded from tier-1 like `make soak`.
 soak-fleet:
 	$(TEST_ENV) python tools/soak_fleet.py --requests 120 --seed 0
+	# trace-report smoke over the MERGED (host spans + request rows)
+	# chrome trace the traced chaos pass exported
+	$(TEST_ENV) python tools/trace_report.py profiler_log/soak_fleet_trace.json
 	$(TEST_ENV) python -m pytest tests/test_soak_fleet.py -m slow -q
 
 # Sanitizer builds of the native extension (parity: reference

@@ -29,7 +29,19 @@ __all__ = ["ProfilerState", "ProfilerTarget", "TracerEventType",
            "RecordEvent", "Profiler", "make_scheduler", "benchmark",
            "export_chrome_tracing", "load_profiler_result",
            "register_counter_provider", "unregister_counter_provider",
-           "counters"]
+           "counters", "default_log_dir", "host_events",
+           "PROFILER_LOG_DIR_ENV"]
+
+# Where chrome-trace exports land when no explicit log_dir is given:
+# the env var overrides, the default keeps everything in one gitignored
+# directory instead of littering the repo root / CWD.
+PROFILER_LOG_DIR_ENV = "PADDLE_TPU_PROFILER_DIR"
+
+
+def default_log_dir() -> str:
+    """The profiler's export directory: `Profiler(log_dir=...)` wins,
+    then $PADDLE_TPU_PROFILER_DIR, then ./profiler_log (gitignored)."""
+    return os.environ.get(PROFILER_LOG_DIR_ENV) or "./profiler_log"
 
 
 class ProfilerState(Enum):
@@ -77,6 +89,13 @@ class _HostTracer:
 
 
 _tracer = _HostTracer()
+
+
+def host_events() -> list:
+    """The recorded RecordEvent host spans (a copy) — the accessor the
+    serving RequestTracer merges into its chrome-trace export so host
+    work and request lifecycles share one timeline."""
+    return list(_tracer.events)
 
 # Counter providers: subsystems (e.g. serving.metrics) register a zero-arg
 # callable returning {counter: value}; Profiler.summary() appends the live
@@ -169,7 +188,7 @@ def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
 
 
 def _default_on_ready(prof):
-    path = prof.log_dir or "./profiler_log"
+    path = prof.log_dir or default_log_dir()
     os.makedirs(path, exist_ok=True)
     out = os.path.join(path, f"paddle_tpu_trace_{int(time.time())}.json")
     prof.export(out)
@@ -268,7 +287,7 @@ class Profiler:
                for t in self.targets):
             try:
                 import jax
-                d = self.log_dir or "./profiler_log"
+                d = self.log_dir or default_log_dir()
                 os.makedirs(d, exist_ok=True)
                 jax.profiler.start_trace(d)
                 self._device_tracing = True

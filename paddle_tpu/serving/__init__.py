@@ -25,6 +25,8 @@ from .scheduler import (PrefillChunk, Request, RequestState, ScheduleStep,
                         Scheduler)
 from .spec import DraftModelProposer, NgramProposer, Proposer
 from .supervisor import RetryPolicy, StepSupervisor, classify_failure
+from .trace import FlightRecorder, RequestTrace, RequestTracer
+from .exposition import render_prometheus
 from .fleet import (Fleet, FleetHandle, FleetServer, PrefixAffinityRouter,
                     RandomRouter, Replica, ReplicaState, RoundRobinRouter,
                     TokenStream)
@@ -39,4 +41,5 @@ __all__ = ["ServingEngine", "BlockAllocator", "BlocksExhausted",
            "NgramProposer", "DraftModelProposer", "Fleet", "FleetHandle",
            "FleetServer", "TokenStream", "Replica", "ReplicaState",
            "PrefixAffinityRouter", "RandomRouter", "RoundRobinRouter",
-           "tp_serving_mesh", "ProgramCache"]
+           "tp_serving_mesh", "ProgramCache", "RequestTracer",
+           "RequestTrace", "FlightRecorder", "render_prometheus"]

@@ -83,6 +83,7 @@ from .radix_cache import RadixCache
 from .scheduler import (Request, RequestState, Scheduler,
                         bump_request_counter)
 from .supervisor import POISON, RetryPolicy, StepSupervisor, classify_failure
+from .trace import FlightRecorder, RequestTracer
 
 __all__ = ["ServingEngine", "SNAPSHOT_VERSION", "check_snapshot_version",
            "tp_serving_mesh"]
@@ -227,7 +228,9 @@ class ServingEngine:
                  kv_dtype: Optional[str] = None,
                  wq: Optional[str] = None,
                  kv_pool_bytes: Optional[int] = None,
-                 mesh=None):
+                 mesh=None,
+                 trace=None, trace_ring: int = 512,
+                 flight_recorder_steps: int = 128):
         cfg = model.cfg
         if kv_dtype not in (None, "int8"):
             raise ValueError(f"kv_dtype must be None or 'int8', got "
@@ -396,7 +399,7 @@ class ServingEngine:
         self.default_ttl_s = default_ttl_s
         self.supervisor = StepSupervisor(
             policy=retry_policy,
-            on_retry=lambda label, n: self.metrics.on_step_retry(),
+            on_retry=self._on_step_retry,
             retryable=self._caches_alive)
         self.failed = False
         self.last_snapshot: Optional[dict] = None
@@ -404,6 +407,26 @@ class ServingEngine:
         # other in profiler.counters(), nor unregister each other
         self.metrics = ServingMetrics(
             name=f"serving-{next(_engine_counter)}").register()
+        # --- observability (ISSUE 10) ---
+        # Per-request tracing is OFF by default and free when off:
+        # every hook is guarded by ONE `self.tracer is None` check, so
+        # the default hot path allocates nothing trace-related.
+        # trace=True builds a private RequestTracer; a fleet passes the
+        # SAME RequestTracer instance to every replica so a migrated
+        # request keeps one trace across engines. The flight recorder
+        # is always on — one small dict per non-idle step, bounded ring
+        # — and rides every snapshot so postmortems carry context.
+        if trace is True:
+            self.tracer: Optional[RequestTracer] = RequestTracer(
+                max_completed=trace_ring)
+        elif trace:
+            self.tracer = trace
+        else:
+            self.tracer = None
+        self.recorder = FlightRecorder(flight_recorder_steps)
+        self._cur_rids = ()          # requests in the launch being run
+        self._step_ev = {"programs": []}
+        self._step_t0: Optional[float] = None
 
         from jax.sharding import PartitionSpec as P
         shape = (self.num_pages, self.num_kv, self.page_size, self.head_dim)
@@ -495,6 +518,78 @@ class ServingEngine:
         return not any(getattr(a, "is_deleted", lambda: False)()
                        for a in probe)
 
+    # ----------------------------------------- request tracing (ISSUE 10)
+    # Every hook no-ops on `self.tracer is None` — the ONE check the
+    # default (trace-off) hot path pays; nothing below it allocates.
+    def _on_step_retry(self, label: str, attempt: int):
+        self.metrics.on_step_retry()
+        if self.tracer is not None:
+            for rid in self._cur_rids:
+                self.tracer.mark(rid, "retry", label=label,
+                                 attempt=attempt,
+                                 engine=self.metrics.name)
+
+    def _tr_begin(self, req: Request):
+        if self.tracer is None:
+            return
+        self.tracer.begin(req.request_id, engine=self.metrics.name,
+                          prompt_len=len(req.prompt_ids),
+                          max_new_tokens=req.max_new_tokens)
+
+    def _tr_shed(self, req: Request):
+        """Admission shed: the trace begins and ends at the door —
+        sheds must be visible in the completed ring, not invisible."""
+        if self.tracer is None:
+            return
+        self.tracer.begin(req.request_id, engine=self.metrics.name,
+                          prompt_len=len(req.prompt_ids),
+                          max_new_tokens=req.max_new_tokens)
+        self.tracer.mark(req.request_id, "shed",
+                         engine=self.metrics.name,
+                         queue_depth=self.scheduler.queue_depth)
+        self.tracer.finish(req.request_id, "shed")
+
+    def _tr_admit(self, req: Request, resumed: bool):
+        if self.tracer is None:
+            return
+        tr = self.tracer.get(req.request_id)
+        if tr is None:
+            return
+        now = self.tracer.now_ns()
+        tr.span("queue_wait", tr.t_queue, now, resumed=resumed)
+        tr.mark("admitted", now, cached_tokens=req.cached_tokens,
+                resumed=resumed, engine=self.metrics.name)
+
+    def _tr_launch(self, rids, name: str, t0: int, **args):
+        """One span per PARTICIPATING request for a batched launch —
+        the per-request timeline view of shared device work. The args
+        are identical across the batch, so the record is built once
+        (`span_many`) — the traced decode hot path stays cheap."""
+        if self.tracer is None:
+            return
+        self.tracer.span_many(rids, name, t0, self.tracer.now_ns(),
+                              engine=self.metrics.name, **args)
+
+    def _tr_mark(self, rid: int, name: str, **args):
+        if self.tracer is None:
+            return
+        self.tracer.mark(rid, name, engine=self.metrics.name, **args)
+
+    def _tr_finish(self, rid: int, reason: str):
+        if self.tracer is None:
+            return
+        self.tracer.finish(rid, reason)
+
+    def _tr_preempt(self, req: Request):
+        if self.tracer is None:
+            return
+        tr = self.tracer.get(req.request_id)
+        if tr is None:
+            return
+        now = self.tracer.now_ns()
+        tr.mark("preempted", now, engine=self.metrics.name)
+        tr.t_queue = now     # the next admission's queue_wait anchor
+
     # ------------------------------------------------------------- intake
     def _now(self) -> float:
         return self._clock() + self._clock_skew
@@ -533,9 +628,11 @@ class ServingEngine:
             self.scheduler.add_request(req)
         except EngineOverloaded:
             self.metrics.on_shed()
+            self._tr_shed(req)
             raise
         self.requests[req.request_id] = req
         self.metrics.on_add(req.request_id)
+        self._tr_begin(req)
         return req.request_id
 
     def abort(self, request_id: int) -> bool:
@@ -699,8 +796,14 @@ class ServingEngine:
                     jnp.asarray(padded), jnp.int32(chunk.start),
                     jnp.int32(chunk.length), jnp.asarray(bt), key)
 
+        self._cur_rids = (req.request_id,)
+        self._step_ev["programs"].append(f"chunk:S{S}:P{P}")
+        t_tr = self.tracer.now_ns() if self.tracer is not None else 0
         tok, ok, *caches = self.supervisor.run(launch,
                                                label="prefill_chunk")
+        self._tr_launch((req.request_id,), "prefill_chunk", t_tr,
+                        start=chunk.start, length=chunk.length,
+                        bucket=[S, P], last=chunk.is_last)
         self._store_caches(*caches)
         if faults.fire(FAULT_NAN) is not None:
             ok = False
@@ -764,8 +867,13 @@ class ServingEngine:
                     jnp.asarray(ids), jnp.asarray(bt), jnp.asarray(sl),
                     key)
 
+        self._cur_rids = tuple(rids)
+        self._step_ev["programs"].append(f"decode:B{B}:P{P}")
+        t_tr = self.tracer.now_ns() if self.tracer is not None else 0
         toks, oks, *caches = self.supervisor.run(launch,
                                                  label="decode_step")
+        self._tr_launch(rids, "decode_step", t_tr, batch=len(reqs),
+                        bucket=[B, P])
         self._store_caches(*caches)
         # bytes-moved accounting: this step wrote one token per live row
         # and the attention kernel read every live token's K/V
@@ -953,8 +1061,18 @@ class ServingEngine:
                     jnp.asarray(ids), jnp.asarray(bt), jnp.asarray(sl),
                     jnp.asarray(dl), key)
 
+        self._cur_rids = tuple(rids)
+        self._step_ev["programs"].append(f"verify:B{B}:K{K}:P{P}")
+        t_tr = self.tracer.now_ns() if self.tracer is not None else 0
         toks, n_acc, oks, *caches = self.supervisor.run(
             launch, label="verify_step")
+        if self.tracer is not None:
+            t1 = self.tracer.now_ns()
+            for rid, d in zip(rids, drafts):
+                self.tracer.span(rid, "verify_step", t_tr, t1,
+                                 engine=self.metrics.name,
+                                 batch=len(reqs), drafted=len(d),
+                                 bucket=[B, K, P])
         self._store_caches(*caches)
         self.metrics.on_kv_bytes(
             written=int(sum(1 + len(d) for d in drafts))
@@ -1091,6 +1209,7 @@ class ServingEngine:
         req.output_ids.append(tok)
         if first:
             self.metrics.on_first_token(req.request_id)
+            self._tr_mark(req.request_id, "first_token")
         emitted.append((req.request_id, tok))
         if req.eos_token_id is not None and tok == req.eos_token_id:
             return "stop"
@@ -1113,10 +1232,12 @@ class ServingEngine:
             if req.aborted:
                 if self.scheduler.cancel(req, "abort"):
                     self.metrics.on_abort(req.request_id)
+                    self._tr_finish(req.request_id, "abort")
                     self._retain(req)
             elif req.deadline is not None and now >= req.deadline:
                 if self.scheduler.cancel(req, "expired"):
                     self.metrics.on_expire(req.request_id)
+                    self._tr_finish(req.request_id, "expired")
                     self._retain(req)
 
     def _quarantine(self, req: Request):
@@ -1125,12 +1246,29 @@ class ServingEngine:
         NaN K/V — the radix tree must never serve them)."""
         if self.scheduler.cancel(req, "quarantined", donate=False):
             self.metrics.on_quarantine(req.request_id)
+            self._tr_mark(req.request_id, "quarantined")
+            self._tr_finish(req.request_id, "quarantined")
             self._retain(req)
 
     def _fail(self, exc: BaseException):
         """Unrecoverable: drain to a serializable snapshot and raise
         EngineFailure. The engine refuses further work afterwards."""
         self.metrics.on_engine_failure()
+        # stamp the FAILING (partial) step into the flight recorder
+        # before the snapshot captures the ring — the postmortem's
+        # last record is the step that died, not merely the one before
+        self.recorder.record({
+            "step": int(self.metrics.counters["engine_steps"]) + 1,
+            "failed": repr(exc),
+            "programs": list(self._step_ev.get("programs", ())),
+            "t_wall_ms": (round((time.perf_counter()
+                                 - self._step_t0) * 1e3, 3)
+                          if self._step_t0 is not None else None),
+            "queue_depth": int(self.scheduler.queue_depth),
+            "running": len(self.scheduler.running),
+            "kv_used_pages": int(self.allocator.num_used),
+            "kv_occupancy": round(float(self.allocator.occupancy()), 4),
+        })
         self.last_snapshot = self.snapshot(reason=repr(exc))
         self.failed = True
         raise EngineFailure(
@@ -1152,10 +1290,21 @@ class ServingEngine:
             raise EngineFailure("engine has failed; resume from "
                                 "last_snapshot", snapshot=self.last_snapshot)
         emitted = []
+        # flight recorder (ISSUE 10): per-step accumulator + counter
+        # baseline for the deltas the step record reports
+        self._step_t0 = time.perf_counter()
+        self._step_ev = {"programs": []}
+        _c = self.metrics.counters
+        pre = {k: _c[k] for k in (
+            "prefill_tokens", "requests_preempted", "step_retries",
+            "requests_quarantined", "requests_aborted",
+            "deadline_expired", "prefix_hits", "spec_drafted_tokens",
+            "spec_accepted_tokens")}
         self._cancel_boundary()
         sched = self.scheduler.schedule()
         for req in sched.preempted:
             self.metrics.on_preempt()
+            self._tr_preempt(req)
 
         for chunk in sched.prefills:
             req = chunk.request
@@ -1165,6 +1314,7 @@ class ServingEngine:
                 self.metrics.on_admission(req.request_id,
                                           req.cached_tokens,
                                           resumed=req.num_preemptions > 0)
+                self._tr_admit(req, resumed=req.num_preemptions > 0)
             try:
                 tok, ok = self._run_chunk(chunk)
             except Exception as exc:   # noqa: BLE001
@@ -1205,7 +1355,57 @@ class ServingEngine:
             radix_nodes=self.radix.num_nodes if self.radix else 0,
             radix_evicted_pages=(self.radix.num_evicted_pages
                                  if self.radix else None))
+        self._record_step(pre, n_chunks=len(sched.prefills),
+                          n_decode=len(decodes), n_emitted=len(emitted))
         return emitted
+
+    def _record_step(self, pre: Dict[str, int], *, n_chunks: int,
+                     n_decode: int, n_emitted: int):
+        """Append this iteration's StepRecord to the flight recorder.
+        Idle steps (nothing scheduled, nothing cancelled) are skipped so
+        a quiet polling loop cannot evict the history that matters."""
+        c = self.metrics.counters
+        rec = {
+            "step": int(c["engine_steps"]),
+            "t_wall_ms": round((time.perf_counter()
+                                - self._step_t0) * 1e3, 3),
+            "programs": list(self._step_ev["programs"]),
+            "prefill_chunks": int(n_chunks),
+            "prefill_tokens": int(c["prefill_tokens"]
+                                  - pre["prefill_tokens"]),
+            "decode_batch": int(n_decode),
+            "tokens_out": int(n_emitted),
+            "preempted": int(c["requests_preempted"]
+                             - pre["requests_preempted"]),
+            "retries": int(c["step_retries"] - pre["step_retries"]),
+            "quarantined": int(c["requests_quarantined"]
+                               - pre["requests_quarantined"]),
+            "aborted": int(c["requests_aborted"]
+                           - pre["requests_aborted"]),
+            "expired": int(c["deadline_expired"]
+                           - pre["deadline_expired"]),
+            "prefix_hits": int(c["prefix_hits"] - pre["prefix_hits"]),
+            "spec_drafted": int(c["spec_drafted_tokens"]
+                                - pre["spec_drafted_tokens"]),
+            "spec_accepted": int(c["spec_accepted_tokens"]
+                                 - pre["spec_accepted_tokens"]),
+            "queue_depth": int(self.scheduler.queue_depth),
+            "running": len(self.scheduler.running),
+            "kv_used_pages": int(self.allocator.num_used),
+            "kv_occupancy": round(float(self.allocator.occupancy()), 4),
+            "cached_pages": int(self.radix.num_cached_pages
+                                if self.radix else 0),
+        }
+        if rec["programs"] or any(
+                rec[k] for k in ("prefill_chunks", "decode_batch",
+                                 "tokens_out", "preempted", "aborted",
+                                 "expired", "quarantined")):
+            self.recorder.record(rec)
+
+    def timeline(self) -> List[dict]:
+        """Flight-recorder view: the last N non-idle StepRecords,
+        oldest first (ISSUE 10). The same list rides every snapshot."""
+        return self.recorder.records()
 
     def _plain_decode_step(self, decodes: List[Request], emitted):
         """One batched single-token decode launch + emission (the
@@ -1266,6 +1466,7 @@ class ServingEngine:
 
     def _on_finished(self, req: Request):
         self.metrics.on_finish(req.request_id)
+        self._tr_finish(req.request_id, req.finish_reason or "stop")
         self._retain(req)
 
     # --------------------------------------------------- snapshot/resume
@@ -1299,7 +1500,13 @@ class ServingEngine:
         recs.sort(key=lambda r: r["request_id"])   # FCFS order on resume
         return {"version": SNAPSHOT_VERSION, "reason": str(reason),
                 "rng_key": np.asarray(self._key).tolist(),
-                "requests": recs}
+                "requests": recs,
+                # the engine's last N non-idle StepRecords ride every
+                # snapshot (ISSUE 10): an engine_failures postmortem
+                # reads the context straight out of the drain state.
+                # from_snapshot/adopt ignore the key, so the schema
+                # version is unchanged — old snapshots resume fine.
+                "flight_recorder": self.recorder.records()}
 
     def _restore_request(self, rec: dict) -> Request:
         """Rebuild one snapshot request record into THIS engine under
@@ -1328,6 +1535,18 @@ class ServingEngine:
         # arrival on its original engine, and fleet summaries merge
         # counters across ALL replicas (dead ones included)
         self.metrics.on_adopt(req.request_id)
+        if self.tracer is not None:
+            # with a fleet-shared tracer the migrated request's LIVE
+            # trace continues here (begin is idempotent); a fresh
+            # from_snapshot engine starts a new one at the adopt mark
+            tr = self.tracer.begin(req.request_id,
+                                   engine=self.metrics.name,
+                                   prompt_len=len(req.prompt_ids),
+                                   max_new_tokens=req.max_new_tokens)
+            now = self.tracer.now_ns()
+            tr.mark("adopt", now, engine=self.metrics.name,
+                    tokens_so_far=len(req.output_ids))
+            tr.t_queue = now      # re-queued on the adopting engine
         return req
 
     def adopt_requests(self, recs) -> List[int]:

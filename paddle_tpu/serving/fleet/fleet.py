@@ -88,7 +88,9 @@ class FleetHandle:
     handle); synchronous callers read `.tokens` after `Fleet.run()`."""
 
     __slots__ = ("request_id", "tenant", "tokens", "finished",
-                 "finish_reason", "migrations", "_listeners")
+                 "finish_reason", "migrations", "_listeners",
+                 "submit_t", "first_token_t", "finish_t",
+                 "ttft_slo_s", "tpot_slo_s")
 
     def __init__(self, request_id: int, tenant: str):
         self.request_id = int(request_id)
@@ -98,6 +100,14 @@ class FleetHandle:
         self.finish_reason: Optional[str] = None
         self.migrations = 0
         self._listeners: List = []     # callables(event dict)
+        # SLO-burn accounting (ISSUE 10): stamps on the FLEET clock +
+        # the targets the request was admitted under; _finalize turns
+        # observed-vs-target into the slo_*_violations counters
+        self.submit_t: Optional[float] = None
+        self.first_token_t: Optional[float] = None
+        self.finish_t: Optional[float] = None
+        self.ttft_slo_s: Optional[float] = None
+        self.tpot_slo_s: Optional[float] = None
 
     def subscribe(self, listener):
         """Attach an event callback; every attached listener sees every
@@ -213,6 +223,12 @@ class Fleet:
             "route_races": 0,
             "tenant_throttled": 0,
             "slo_sheds": 0,
+            # SLO burn (ISSUE 10): requests whose OBSERVED TTFT/TPOT
+            # missed the target they were admitted under — the
+            # admission shed above refuses hopeless work, these count
+            # accepted work that still burned its budget
+            "slo_ttft_violations": 0,
+            "slo_tpot_violations": 0,
         }
 
     # ---- lookups ---------------------------------------------------------
@@ -329,6 +345,18 @@ class Fleet:
                 continue
             break
         handle = FleetHandle(rid, tkey)
+        handle.submit_t = self._clock()
+        handle.ttft_slo_s = ttft_slo_s
+        handle.tpot_slo_s = tpot_slo_s
+        tracer = getattr(chosen.engine, "tracer", None)
+        if tracer is not None:
+            # the routing decision, with the scores it was made on —
+            # the read-only match_len probe re-runs only when tracing
+            tracer.mark(rid, "route", chosen=chosen.name,
+                        scores={c.name: {"match_len":
+                                         c.match_len(prompt_ids),
+                                         "load": c.load}
+                                for c in candidates})
         self._handles[rid] = handle
         self._assign_to(rid, chosen)
         self._tenant_live[tkey] = self._tenant_live.get(tkey, 0) + 1
@@ -367,11 +395,18 @@ class Fleet:
         handle = self._handles.get(rid)
         if handle is None or handle.finished:
             return
+        handle.finish_t = self._clock()
+        self._account_slo(handle)
         handle._finish(reason)
         self._tenant_live[handle.tenant] = max(
             0, self._tenant_live.get(handle.tenant, 1) - 1)
         if reason == "lost":
             self.counters["requests_lost"] += 1
+            tracer = self._tracer()
+            if tracer is not None:
+                # every other terminal reason finishes its trace on the
+                # owning engine; "lost" has no engine left to do it
+                tracer.finish(rid, "lost")
         else:
             self.counters["requests_finished"] += 1
         self._finished_order.append(rid)
@@ -379,13 +414,50 @@ class Fleet:
             self._handles.pop(self._finished_order.popleft(), None)
             self.num_evicted_handles += 1
 
+    def _tracer(self):
+        """The (shared) request tracer, when any replica's engine has
+        one. A fleet that traces passes ONE RequestTracer to every
+        engine — the first found is the fleet's."""
+        for r in self.replicas:
+            t = getattr(r.engine, "tracer", None)
+            if t is not None:
+                return t
+        return None
+
+    def _deliver(self, handle: FleetHandle, tok: int):
+        """Exactly-once delivery + the first-token SLO stamp (catch-up
+        and live emission both land here, so TTFT is observed whichever
+        path a migrated request's first token took)."""
+        handle._deliver(tok)
+        if handle.first_token_t is None:
+            handle.first_token_t = self._clock()
+
+    def _account_slo(self, handle: FleetHandle):
+        """Observed-vs-target SLO burn at finalize (ISSUE 10): a TTFT
+        target is violated when the first token came late (or never); a
+        TPOT target when the per-token rate after the first token ran
+        slower than admitted. Counted once per request, on the same
+        fleet clock the deadline machinery runs on."""
+        if handle.ttft_slo_s is not None and handle.submit_t is not None:
+            if handle.first_token_t is None or \
+                    handle.first_token_t - handle.submit_t \
+                    > handle.ttft_slo_s:
+                self.counters["slo_ttft_violations"] += 1
+        if handle.tpot_slo_s is not None and \
+                handle.first_token_t is not None and \
+                len(handle.tokens) > 1 and handle.finish_t is not None:
+            tpot = (handle.finish_t - handle.first_token_t) \
+                / (len(handle.tokens) - 1)
+            if tpot > handle.tpot_slo_s:
+                self.counters["slo_tpot_violations"] += 1
+
     def _catch_up(self, handle: FleetHandle, output_ids):
         """Deliver the suffix of `output_ids` the stream has not seen.
         Tokens delivered live are a prefix of the engine's output_ids
         by construction (emission appends in the same order), so the
         suffix rule is exactly-once delivery."""
         for tok in output_ids[len(handle.tokens):]:
-            handle._deliver(tok)
+            self._deliver(handle, tok)
             self.counters["catchup_tokens"] += 1
 
     # ---- stepping + supervision -----------------------------------------
@@ -423,7 +495,7 @@ class Fleet:
         for rid, tok in emitted:
             handle = self._handles.get(rid)
             if handle is not None:
-                handle._deliver(tok)
+                self._deliver(handle, tok)
         self._sweep_finished(replica)
         return emitted
 
@@ -502,11 +574,17 @@ class Fleet:
         check_snapshot_version(snapshot)
         recs = {rec["request_id"]: rec for rec in snapshot["requests"]}
         now = self._clock()
+        tracer = getattr(replica.engine, "tracer", None)
         for rid in list(self._by_replica.get(replica.name, ())):
             rec = recs.get(rid)
             if rec is not None:
                 self._unassign(rid)
                 self._parked.append((now, rec))
+                if tracer is not None:
+                    # migration PARK: the trace stays live (the work
+                    # re-lands; `adopt` marks the landing)
+                    tracer.mark(rid, "park", replica=replica.name,
+                                reason=str(snapshot.get("reason")))
                 continue
             req = replica.engine.requests.get(rid)
             if req is not None and req.state is RequestState.FINISHED \
@@ -621,6 +699,33 @@ class Fleet:
         snap["replica_states"] = {r.name: r.state.value
                                   for r in self.replicas}
         return snap
+
+    def prometheus_text(self, *, prefix: str = "paddle_serving") -> str:
+        """The fleet as one Prometheus scrape (ISSUE 10): the merged
+        engine metrics and fleet counters (from `summary()` — the
+        exposition derives from the same snapshot path, so they can
+        never disagree), then every replica's OWN engine metrics under
+        a `replica="<name>"` label (per-replica visibility is the point
+        of the labels; Prometheus aggregates in queries). TYPE lines
+        are emitted once, on the merged block."""
+        from ..exposition import (metric_name, prometheus_lines,
+                                  sanitize_label_value)
+        merged = self.merged_metrics()
+        counter_keys = set(merged.counters) | {
+            f"fleet_{k}" for k in self.counters}
+        lines = prometheus_lines(self.summary(),
+                                 counter_keys=counter_keys,
+                                 prefix=prefix)
+        for r in self.replicas:
+            lines.append(f'{metric_name(prefix, "replica_up")}'
+                         f'{{replica="{sanitize_label_value(r.name)}"}} '
+                         f'{int(r.state is ReplicaState.HEALTHY)}')
+            lines.extend(prometheus_lines(
+                r.engine.metrics.snapshot(),
+                counter_keys=set(r.engine.metrics.counters),
+                prefix=prefix, labels={"replica": r.name},
+                emit_type=False))
+        return "\n".join(lines) + "\n"
 
     def shutdown(self):
         for r in self.replicas:

@@ -188,3 +188,12 @@ class FleetServer:
         its own (the state flips out of HEALTHY) and in-flight work
         migrates with the zero-loss contract."""
         return self.fleet.drain(name)
+
+    # ---- observability (ISSUE 10) ----------------------------------------
+    def metrics_text(self, *, prefix: str = "paddle_serving") -> str:
+        """The Prometheus scrape body for this server — the exposition
+        hook a future HTTP transport mounts at /metrics (synchronous on
+        purpose: it reads host-side counters only, no engine step). One
+        call renders the merged fleet view plus per-replica labeled
+        series via `Fleet.prometheus_text`."""
+        return self.fleet.prometheus_text(prefix=prefix)

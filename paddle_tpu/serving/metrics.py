@@ -372,6 +372,23 @@ class ServingMetrics:
     # same auto-exposing view (counters dict + registered reservoirs)
     summary = snapshot
 
+    # ---- Prometheus exposition (ISSUE 10) --------------------------------
+    def prometheus_text(self, *, prefix: str = "paddle_serving",
+                        labels: Optional[dict] = None,
+                        emit_type: bool = True) -> str:
+        """This metrics object as Prometheus exposition text — DERIVED
+        from `snapshot()` (the renderer walks the live snapshot dict),
+        so the scrape can never disagree with it: every counter, gauge
+        and registered-reservoir percentile surfaces with no
+        hand-maintained name list. Keys in the counters dict are typed
+        `counter`, everything else `gauge`."""
+        from .exposition import prometheus_lines
+        lines = prometheus_lines(self.snapshot(),
+                                 counter_keys=set(self.counters),
+                                 prefix=prefix, labels=labels,
+                                 emit_type=emit_type)
+        return "\n".join(lines) + "\n" if lines else ""
+
     # ---- cross-replica aggregation (fleet, ISSUE 7) ----------------------
     @classmethod
     def merge(cls, *metrics: "ServingMetrics",

@@ -1,0 +1,308 @@
+"""End-to-end request tracing + engine flight recorder (ISSUE 10).
+
+Two host-side recorders over the serving stack, both deliberately NOT a
+second profiler — they reuse the profiler's clock and export format so
+one Perfetto load shows everything on a shared timeline:
+
+* **RequestTracer / RequestTrace** — one trace per request, carried from
+  Fleet admission through routing, prefill chunks, decode/verify
+  iterations, supervisor retries, quarantine and migration park/re-land.
+  Spans and marks are stamped with the SAME `time.perf_counter_ns`
+  clock `profiler.RecordEvent` uses, so `export()` merges the request
+  lifecycle rows with the profiler's host spans into ONE chrome-trace
+  JSON (`{"traceEvents": ...}`) that Perfetto opens directly: host work
+  (pid = this process) next to request rows (pid = `REQUEST_PID`, one
+  tid per request id). Completed traces live in a bounded ring —
+  a long-lived server never accumulates one entry per request ever
+  served (the `max_retained_finished` lesson, applied to traces).
+
+  Cheap-when-on, free-when-off: the engine holds `tracer=None` by
+  default and every call site is guarded by that one check, so the
+  default hot path allocates NOTHING trace-related (asserted by
+  tests/test_serving_trace.py). A fleet shares ONE tracer across its
+  replicas (pass the same instance to every engine) so a migrated
+  request's trace follows it across engines.
+
+* **FlightRecorder** — a bounded ring of per-iteration `StepRecord`
+  dicts (program launches with bucket keys, batch composition, tokens
+  in/out, pool occupancy, radix/spec stats, retry/quarantine counts,
+  step latency). Always on (one small dict per non-idle step),
+  queryable via `ServingEngine.timeline()`, and attached to every
+  engine snapshot — so an `engine_failures` postmortem ships the last
+  N steps of context with the drain state (the PR-3 snapshot).
+
+All record payloads are JSON-safe by construction (plain ints / floats
+/ strings / lists / dicts) — the snapshot contract requires it.
+"""
+from __future__ import annotations
+
+import json
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+__all__ = ["RequestTrace", "RequestTracer", "FlightRecorder",
+           "REQUEST_PID"]
+
+# chrome-trace pid for the per-request rows; the profiler's host spans
+# keep os.getpid(), so the two groups render as separate named
+# processes in Perfetto (metadata events label both)
+REQUEST_PID = 1
+
+
+def _json_safe(v):
+    """Coerce span/mark args to JSON-safe plain types (numpy ints from
+    token ids are the common offender)."""
+    if isinstance(v, bool) or v is None or isinstance(v, str):
+        return v
+    if isinstance(v, (int, float)):
+        return v
+    if isinstance(v, (list, tuple)):
+        return [_json_safe(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _json_safe(x) for k, x in v.items()}
+    # numpy scalars (and 0-d arrays): .item() preserves the value's
+    # kind — int(np.float32(0.37)) would silently truncate to 0
+    item = getattr(v, "item", None)
+    if item is not None:
+        try:
+            unwrapped = item()
+        except (TypeError, ValueError):
+            unwrapped = None
+        if isinstance(unwrapped, (bool, int, float, str)):
+            return unwrapped
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            return str(v)
+
+
+class RequestTrace:
+    """One request's lifecycle: spans (named intervals) + marks (named
+    instants), all in perf_counter nanoseconds."""
+
+    __slots__ = ("request_id", "meta", "spans", "marks", "t_begin",
+                 "t_end", "t_queue", "finish_reason")
+
+    def __init__(self, request_id: int, t_begin: int, **meta):
+        self.request_id = int(request_id)
+        self.meta = {k: _json_safe(v) for k, v in meta.items()}
+        self.spans: List[dict] = []
+        self.marks: List[dict] = []
+        self.t_begin = int(t_begin)
+        self.t_end: Optional[int] = None
+        # queue-wait anchor: reset at preemption / adoption so the next
+        # admission's queue_wait span measures THIS wait, not the
+        # request's whole life
+        self.t_queue = int(t_begin)
+        self.finish_reason: Optional[str] = None
+
+    def span(self, name: str, t0: int, t1: int, **args):
+        self.spans.append({"name": name, "t0": int(t0), "t1": int(t1),
+                           "args": {k: _json_safe(v)
+                                    for k, v in args.items()}})
+
+    def mark(self, name: str, t: int, **args):
+        self.marks.append({"name": name, "t": int(t),
+                           "args": {k: _json_safe(v)
+                                    for k, v in args.items()}})
+
+    # ---- views -----------------------------------------------------------
+    def span_names(self) -> List[str]:
+        return [s["name"] for s in self.spans]
+
+    def count_spans(self, name: str) -> int:
+        return sum(1 for s in self.spans if s["name"] == name)
+
+    def mark_names(self) -> List[str]:
+        return [m["name"] for m in self.marks]
+
+    def duration_ns(self) -> Optional[int]:
+        if self.t_end is None:
+            return None
+        return self.t_end - self.t_begin
+
+    def to_dict(self) -> dict:
+        return {"request_id": self.request_id, "meta": dict(self.meta),
+                "t_begin": self.t_begin, "t_end": self.t_end,
+                "finish_reason": self.finish_reason,
+                "spans": [dict(s) for s in self.spans],
+                "marks": [dict(m) for m in self.marks]}
+
+    def __repr__(self):
+        state = self.finish_reason if self.finish_reason else "live"
+        return (f"RequestTrace({self.request_id}, {state}, "
+                f"spans={len(self.spans)}, marks={len(self.marks)})")
+
+
+class RequestTracer:
+    """Registry of live + completed request traces.
+
+    `clock_ns` is injectable for deterministic tests but defaults to
+    `time.perf_counter_ns` — the SAME clock `profiler.RecordEvent`
+    stamps host spans with, which is what makes the merged export a
+    single honest timeline. Every method is a no-op for unknown request
+    ids, so call sites never need existence checks.
+    """
+
+    def __init__(self, max_completed: int = 512, clock_ns=None):
+        self._clock_ns = (clock_ns if clock_ns is not None
+                          else time.perf_counter_ns)
+        self.live: Dict[int, RequestTrace] = {}
+        self.completed: deque = deque(maxlen=int(max_completed))
+        self.num_started = 0
+        self.num_completed = 0
+
+    def now_ns(self) -> int:
+        return int(self._clock_ns())
+
+    # ---- lifecycle -------------------------------------------------------
+    def begin(self, request_id: int, **meta) -> RequestTrace:
+        """Start (or return the live) trace for `request_id`.
+        Idempotent on purpose: a migrated request re-`begin`s on its
+        target engine and must keep accumulating into ONE trace."""
+        tr = self.live.get(request_id)
+        if tr is None:
+            tr = RequestTrace(request_id, self.now_ns(), **meta)
+            self.live[request_id] = tr
+            self.num_started += 1
+        return tr
+
+    def get(self, request_id: int) -> Optional[RequestTrace]:
+        return self.live.get(request_id)
+
+    def span(self, request_id: int, name: str, t0: int, t1: int, **args):
+        tr = self.live.get(request_id)
+        if tr is not None:
+            tr.span(name, t0, t1, **args)
+
+    def span_many(self, request_ids, name: str, t0: int, t1: int,
+                  **args):
+        """One span on EVERY given request — the batched-launch hot
+        path. The args are identical across the batch by contract, so
+        they are sanitized once and the record dict is shared (export
+        paths copy before annotating; nothing mutates stored spans)."""
+        rec = {"name": name, "t0": int(t0), "t1": int(t1),
+               "args": {k: _json_safe(v) for k, v in args.items()}}
+        live = self.live
+        for rid in request_ids:
+            tr = live.get(rid)
+            if tr is not None:
+                tr.spans.append(rec)
+
+    def mark(self, request_id: int, name: str, **args):
+        tr = self.live.get(request_id)
+        if tr is not None:
+            tr.mark(name, self.now_ns(), **args)
+
+    def finish(self, request_id: int, reason: str):
+        """Move a live trace to the bounded completed ring (idempotent
+        — the fleet and the engine may both observe a terminal state)."""
+        tr = self.live.pop(request_id, None)
+        if tr is None:
+            return
+        tr.t_end = self.now_ns()
+        tr.finish_reason = str(reason)
+        self.completed.append(tr)
+        self.num_completed += 1
+
+    # ---- views -----------------------------------------------------------
+    def traces(self, include_live: bool = True) -> List[RequestTrace]:
+        out = list(self.completed)
+        if include_live:
+            out.extend(self.live.values())
+        return out
+
+    # ---- export ----------------------------------------------------------
+    def chrome_events(self) -> List[dict]:
+        """Request lifecycle rows as chrome-trace events (ts/dur in
+        microseconds, one tid per request id under REQUEST_PID)."""
+        events = [{"name": "process_name", "ph": "M", "pid": REQUEST_PID,
+                   "args": {"name": "serving requests"}}]
+        for tr in self.traces():
+            events.append({"name": "thread_name", "ph": "M",
+                           "pid": REQUEST_PID, "tid": tr.request_id,
+                           "args": {"name": f"req {tr.request_id}"}})
+            for s in tr.spans:
+                events.append({"name": s["name"], "ph": "X",
+                               "cat": "request", "ts": s["t0"] / 1e3,
+                               "dur": max(0.0, (s["t1"] - s["t0"]) / 1e3),
+                               "pid": REQUEST_PID, "tid": tr.request_id,
+                               "args": dict(s["args"],
+                                            request_id=tr.request_id)})
+            for m in tr.marks:
+                events.append({"name": m["name"], "ph": "i", "s": "t",
+                               "cat": "request", "ts": m["t"] / 1e3,
+                               "pid": REQUEST_PID, "tid": tr.request_id,
+                               "args": dict(m["args"],
+                                            request_id=tr.request_id)})
+        return events
+
+    def export(self, path: Optional[str] = None,
+               include_profiler: bool = True,
+               flight_recorder=None) -> dict:
+        """One merged chrome-trace document: request rows + (by
+        default) the profiler's RecordEvent host spans, on the shared
+        perf_counter clock. `flight_recorder` (a FlightRecorder or a
+        plain record list) rides along under its own key for
+        tools/trace_report.py. Writes JSON to `path` when given;
+        returns the document either way."""
+        events = self.chrome_events()
+        if include_profiler:
+            import os
+            from .. import profiler
+            host = profiler.host_events()
+            if host:
+                events.append({"name": "process_name", "ph": "M",
+                               "pid": os.getpid(),
+                               "args": {"name": "host spans"}})
+            for e in host:
+                events.append({"name": e["name"], "ph": "X",
+                               "cat": e["type"], "ts": e["ts"] / 1e3,
+                               "dur": e["dur"] / 1e3,
+                               "pid": os.getpid(), "tid": e["tid"]})
+        doc = {"displayTimeUnit": "ms", "traceEvents": events,
+               "requestTraces": [tr.to_dict() for tr in self.traces()]}
+        if flight_recorder is not None:
+            recs = (flight_recorder.records()
+                    if hasattr(flight_recorder, "records")
+                    else list(flight_recorder))
+            doc["flightRecorder"] = recs
+        if path is not None:
+            with open(path, "w") as f:
+                json.dump(doc, f)
+        return doc
+
+
+class FlightRecorder:
+    """Bounded ring of per-iteration engine step records.
+
+    A record is one JSON-safe dict per NON-IDLE engine step (recording
+    idle polling steps would let a quiet fleet loop evict the history
+    that matters). `records()` returns oldest-first; the engine's
+    snapshot embeds exactly this list so every postmortem carries the
+    last `maxlen` steps of context.
+    """
+
+    __slots__ = ("_ring", "num_recorded")
+
+    def __init__(self, max_steps: int = 128):
+        self._ring: deque = deque(maxlen=int(max_steps))
+        self.num_recorded = 0
+
+    @property
+    def maxlen(self) -> int:
+        return self._ring.maxlen
+
+    def record(self, rec: dict):
+        self._ring.append(rec)
+        self.num_recorded += 1
+
+    def records(self) -> List[dict]:
+        return [dict(r) for r in self._ring]
+
+    def __len__(self):
+        return len(self._ring)

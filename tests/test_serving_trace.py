@@ -1,0 +1,308 @@
+"""Request tracing + engine flight recorder (ISSUE 10).
+
+Covers the tentpole acceptance points: trace completeness over a mixed
+16-request workload (spans nest, chunk/decode span counts match the
+tokens actually emitted), flight-recorder ring bounds + automatic
+snapshot attachment on an injected decode exception, migration spans
+across a `fleet.replica_crash` kill, the merged chrome-trace export on
+the shared profiler clock, and the trace-off contract: ZERO trace
+allocations on the default hot path.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import (EngineFailure, EngineOverloaded, Fleet,
+                                FlightRecorder, PrefixAffinityRouter,
+                                RequestTracer, RetryPolicy, ServingEngine,
+                                TransientDeviceError)
+from paddle_tpu.serving import trace as trace_mod
+from paddle_tpu.utils import faults
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = LlamaConfig(vocab_size=128, hidden_size=128,
+                      intermediate_size=256, num_hidden_layers=2,
+                      num_attention_heads=2, num_key_value_heads=1,
+                      max_position_embeddings=128)
+    paddle.seed(0)
+    return LlamaForCausalLM(cfg)
+
+
+# single-bucket grid (the SERVING.md determinism discipline) + enough
+# pages that the mixed workload never preempts — span counts are then
+# exact functions of prompt/output lengths
+KW = dict(num_pages=64, page_size=8, token_budget=64,
+          batch_buckets=[8], prefill_buckets=[32], pages_buckets=[8],
+          temperature=0.0)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        self.t += 1e-3
+        return self.t
+
+
+def _mixed_workload(n=16, seed=0):
+    rng = np.random.RandomState(seed)
+    shared = rng.randint(0, 128, (16,)).tolist()
+    work = []
+    for i in range(n):
+        if i % 3 == 0:
+            p = shared + rng.randint(0, 128, (rng.randint(2, 6),)).tolist()
+        else:
+            p = rng.randint(0, 128, (rng.randint(3, 20),)).tolist()
+        work.append((p, int(rng.randint(2, 7))))
+    return work
+
+
+# ------------------------------------------------------ trace completeness
+def test_trace_completeness_mixed_16(model):
+    work = _mixed_workload(16)
+    eng = ServingEngine(model, trace=True, **KW)
+    rids = [eng.add_request(p, max_new_tokens=m) for p, m in work]
+    out = eng.run()
+    tracer = eng.tracer
+    assert not tracer.live, "every request's trace must complete"
+    traces = {t.request_id: t for t in tracer.traces()}
+    assert set(traces) == set(rids)
+    for rid, (prompt, _m) in zip(rids, work):
+        tr = traces[rid]
+        assert tr.finish_reason == "length"
+        # exactly one admission for this no-preemption workload
+        assert tr.count_spans("queue_wait") == 1
+        assert tr.mark_names().count("admitted") == 1
+        assert tr.mark_names().count("first_token") == 1
+        # chunk tokens + cached prefix cover the whole prompt
+        admitted = next(m for m in tr.marks if m["name"] == "admitted")
+        chunk_tokens = sum(s["args"]["length"] for s in tr.spans
+                           if s["name"] == "prefill_chunk")
+        assert chunk_tokens + admitted["args"]["cached_tokens"] \
+            == len(prompt)
+        # one decode span per token after the first (prefill samples it)
+        assert tr.count_spans("decode_step") == len(out[rid]) - 1
+        # spans nest: inside [t_begin, t_end], ordered, non-negative
+        assert tr.t_end is not None and tr.t_end >= tr.t_begin
+        for s in tr.spans:
+            assert tr.t_begin <= s["t0"] <= s["t1"] <= tr.t_end
+        # queue_wait ends where admission marks; launches follow it
+        qw = next(s for s in tr.spans if s["name"] == "queue_wait")
+        launches = [s for s in tr.spans
+                    if s["name"] in ("prefill_chunk", "decode_step")]
+        assert all(s["t0"] >= qw["t1"] for s in launches)
+    eng.shutdown()
+
+
+def test_trace_shed_and_abort(model):
+    eng = ServingEngine(model, trace=True, max_queue_len=2, **KW)
+    r0 = eng.add_request([1, 2, 3], max_new_tokens=4)
+    r1 = eng.add_request([4, 5, 6], max_new_tokens=4)
+    with pytest.raises(EngineOverloaded):
+        eng.add_request([7, 8, 9], max_new_tokens=4)
+    shed = [t for t in eng.tracer.completed if t.finish_reason == "shed"]
+    assert len(shed) == 1 and "shed" in shed[0].mark_names()
+    eng.step()
+    eng.abort(r1)
+    eng.run()
+    traces = {t.request_id: t for t in eng.tracer.traces()}
+    assert traces[r0].finish_reason == "length"
+    assert traces[r1].finish_reason == "abort"
+    eng.shutdown()
+
+
+def test_trace_retry_and_quarantine_marks(model):
+    eng = ServingEngine(
+        model, trace=True,
+        retry_policy=RetryPolicy(max_retries=4, base_s=0.0,
+                                 sleep=lambda s: None), **KW)
+    rid = eng.add_request([1, 2, 3, 4], max_new_tokens=4)
+    faults.inject("serving.engine.decode_step",
+                  exc=TransientDeviceError("test: UNAVAILABLE"),
+                  after=0, times=1)
+    faults.inject("serving.engine.nan_logits", payload=[0],
+                  after=1, times=1)
+    try:
+        eng.run()
+    finally:
+        faults.clear()
+        faults.reset_counts()
+    tr = {t.request_id: t for t in eng.tracer.traces()}[rid]
+    assert "retry" in tr.mark_names()
+    assert tr.finish_reason == "quarantined"
+    assert "quarantined" in tr.mark_names()
+    eng.shutdown()
+
+
+# ------------------------------------------------- trace-off = free
+def test_trace_off_zero_allocations(model, monkeypatch):
+    """The default engine must never construct a trace object: both
+    constructors are booby-trapped and a full workload runs clean."""
+    def boom(*a, **k):
+        raise AssertionError("trace allocation on the trace-off path")
+    monkeypatch.setattr(trace_mod.RequestTrace, "__init__", boom)
+    monkeypatch.setattr(trace_mod.RequestTracer, "__init__", boom)
+    eng = ServingEngine(model, **KW)
+    assert eng.tracer is None
+    for p, m in _mixed_workload(6):
+        eng.add_request(p, max_new_tokens=m)
+    out = eng.run()
+    assert all(len(v) >= 1 for v in out.values())
+    eng.shutdown()
+
+
+# ------------------------------------------------- flight recorder
+def test_flight_recorder_ring_bound(model):
+    eng = ServingEngine(model, flight_recorder_steps=6, **KW)
+    for p, m in _mixed_workload(8, seed=1):
+        eng.add_request(p, max_new_tokens=m)
+    eng.run()
+    tl = eng.timeline()
+    assert eng.recorder.maxlen == 6
+    assert len(tl) == 6, "ring must hold exactly the last N records"
+    assert eng.recorder.num_recorded > 6
+    steps = [r["step"] for r in tl]
+    assert steps == sorted(steps)
+    # the ring kept the NEWEST records
+    assert steps[-1] == eng.metrics.counters["engine_steps"]
+    for r in tl:
+        assert {"programs", "decode_batch", "tokens_out", "t_wall_ms",
+                "kv_occupancy", "queue_depth"} <= set(r)
+    eng.shutdown()
+
+
+def test_flight_recorder_snapshot_attach_on_decode_exception(model):
+    eng = ServingEngine(model, **KW)
+    eng.add_request([1, 2, 3, 4, 5], max_new_tokens=8)
+    # a FATAL (unclassified) decode failure -> drain to snapshot
+    faults.inject("serving.engine.decode_step",
+                  exc=RuntimeError("test: INTERNAL wedge"),
+                  after=0, times=1)
+    try:
+        with pytest.raises(EngineFailure) as ei:
+            eng.run()
+    finally:
+        faults.clear()
+        faults.reset_counts()
+    snap = ei.value.snapshot
+    recs = snap["flight_recorder"]
+    assert recs, "failure snapshot must carry the flight recorder"
+    json.dumps(snap)                       # JSON-safe end to end
+    # the last record is the failing step itself, flagged
+    assert "INTERNAL wedge" in str(recs[-1].get("failed"))
+    # prior records are the normal step history
+    assert any(r.get("programs") for r in recs)
+    eng.shutdown()
+
+
+def test_flight_recorder_skips_idle_steps(model):
+    eng = ServingEngine(model, **KW)
+    for _ in range(10):
+        eng.step()                         # idle polling
+    assert eng.timeline() == []
+    eng.shutdown()
+
+
+# ------------------------------------------------- migration tracing
+def test_migration_spans_across_replica_crash(model):
+    clock = FakeClock()
+    tracer = RequestTracer()
+    engines = [ServingEngine(model, clock=clock, trace=tracer, **KW)
+               for _ in range(2)]
+    fleet = Fleet(engines, router=PrefixAffinityRouter(), clock=clock)
+    handles = [fleet.submit([1 + i, 2, 3, 4, 5], max_new_tokens=6)
+               for i in range(4)]
+    # after=4: replica-0 completes its prefill step AND one decode step
+    # before the kill, so a migrated trace carries decode spans from
+    # BOTH engines (the cross-engine timeline the shared tracer buys)
+    faults.inject("fleet.replica_crash", payload="replica-0",
+                  after=4, times=-1)
+    try:
+        fleet.run()
+    finally:
+        faults.clear()
+        faults.reset_counts()
+    assert fleet.counters["requests_migrated"] >= 1
+    assert not tracer.live
+    migrated = [t for t in tracer.traces()
+                if "park" in t.mark_names()]
+    assert migrated, "the kill must leave park marks"
+    for tr in migrated:
+        marks = tr.mark_names()
+        # park happened, then the request re-landed and finished
+        assert marks.index("park") < marks.index("adopt")
+        assert tr.finish_reason == "length"
+        engines_seen = {s["args"].get("engine") for s in tr.spans
+                        if s["name"] == "decode_step"}
+        assert len(engines_seen) == 2, \
+            "decode spans must span both engines"
+        # routing decision recorded with per-replica scores
+        route = next(m for m in tr.marks if m["name"] == "route")
+        assert set(route["args"]["scores"]) == \
+            {"replica-0", "replica-1"}
+    # streams intact (the zero-loss contract was not perturbed)
+    assert all(h.finished and len(h.tokens) == 6 for h in handles)
+    fleet.shutdown()
+
+
+# ------------------------------------------------- merged export
+def test_merged_chrome_export_shared_clock(model, tmp_path):
+    eng = ServingEngine(model, trace=True, **KW)
+    prof = profiler.Profiler(targets=[profiler.ProfilerTarget.CPU],
+                             on_trace_ready=lambda p: None)
+    prof.start()
+    eng.add_request([1, 2, 3, 4], max_new_tokens=4)
+    eng.run()
+    prof.stop()
+    path = str(tmp_path / "merged.json")
+    eng.tracer.export(path, include_profiler=True,
+                      flight_recorder=eng.recorder)
+    with open(path) as f:
+        doc = json.load(f)
+    evs = doc["traceEvents"]
+    req = [e for e in evs if e.get("cat") == "request"
+           and e.get("ph") == "X"]
+    host = [e for e in evs if e.get("ph") == "X"
+            and e.get("cat") not in ("request", None)]
+    assert req and host
+    assert any(e["name"] == "serving.decode_step" for e in host)
+    # shared clock: the serving.decode_step HOST span and the request
+    # decode_step spans overlap on the same timebase
+    h0 = min(e["ts"] for e in host)
+    h1 = max(e["ts"] + e["dur"] for e in host)
+    r_decode = [e for e in req if e["name"] == "decode_step"]
+    assert all(h0 <= e["ts"] <= h1 for e in r_decode)
+    assert doc["requestTraces"] and doc["flightRecorder"]
+    eng.shutdown()
+
+
+def test_tracer_bounded_completed_ring():
+    tracer = RequestTracer(max_completed=4)
+    for rid in range(10):
+        tracer.begin(rid)
+        tracer.finish(rid, "stop")
+    assert len(tracer.completed) == 4
+    assert [t.request_id for t in tracer.completed] == [6, 7, 8, 9]
+    assert tracer.num_completed == 10
+    # unknown-id calls are no-ops, finish is idempotent
+    tracer.span(99, "x", 0, 1)
+    tracer.mark(99, "x")
+    tracer.finish(9, "again")
+    assert len(tracer.completed) == 4
+
+
+def test_flight_recorder_unit():
+    fr = FlightRecorder(max_steps=3)
+    for i in range(5):
+        fr.record({"step": i})
+    assert [r["step"] for r in fr.records()] == [2, 3, 4]
+    assert len(fr) == 3 and fr.num_recorded == 5

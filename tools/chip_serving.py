@@ -94,7 +94,17 @@ dt = time.perf_counter() - t0
 tps = 8 * N_STEPS / dt
 print(f"serving engine: batch=8 decode {dt / N_STEPS * 1e3:.2f} ms/step "
       f"SERVING_ENGINE_TOKS_PER_S {tps:.1f}")
-print("serving engine counters:", eng.metrics.snapshot())
+# the engine report goes out through the observability paths (ISSUE 10)
+# — the Prometheus exposition and the flight-recorder digest — so the
+# chip probe exercises the same renderers production scrapes use
+# (host-side only: chip-blind by construction)
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+import trace_report
+print("serving engine exposition:")
+print(eng.metrics.prometheus_text(), end="")
+print(trace_report.format_flight_recorder(eng.timeline()))
 assert eng.num_compiled_programs <= eng.max_program_count()
 
 # --- failure-mode probe (ISSUE 3): abort + TTL on the real chip -------
@@ -114,6 +124,7 @@ fail_keys = ("requests_aborted", "deadline_expired", "requests_shed",
              "step_retries", "requests_quarantined", "engine_failures")
 print("serving failure counters:",
       {k: snap[k] for k in fail_keys})
+print(trace_report.format_flight_recorder(eng.timeline()))
 assert snap["requests_aborted"] == 2 and snap["deadline_expired"] == 2
 assert snap["requests_quarantined"] == 0 and snap["engine_failures"] == 0
 eng.reset_prefix_cache()

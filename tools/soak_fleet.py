@@ -114,14 +114,18 @@ def make_workload(n, seed):
 
 
 def run_pass(model, work, *, n_replicas, router, chaos, seed, report,
-             label):
-    """One full soak pass; returns {workload idx: token stream}."""
+             label, trace=None, keep=None):
+    """One full soak pass; returns {workload idx: token stream}.
+    `trace` (one RequestTracer SHARED by every replica — the migration
+    contract) turns request tracing on; `keep` (a dict) receives the
+    per-replica flight-recorder timelines and the fleet's Prometheus
+    exposition before shutdown (ISSUE 10)."""
     clock = FakeClock()
     engines = [ServingEngine(
         model, clock=clock,
         retry_policy=RetryPolicy(max_retries=12, base_s=0.0,
                                  sleep=lambda s: None),
-        **ENGINE_KW) for _ in range(n_replicas)]
+        trace=trace, **ENGINE_KW) for _ in range(n_replicas)]
     fleet = Fleet(engines, router=router, clock=clock,
                   stall_timeout_s=STALL_TIMEOUT_S)
     armed = set()
@@ -248,6 +252,12 @@ def run_pass(model, work, *, n_replicas, router, chaos, seed, report,
             for pt in sorted(armed):
                 assert fired.get(pt, 0) >= 1, \
                     f"[{label}] armed fault point {pt} never fired"
+        if keep is not None:
+            keep["timelines"] = [
+                dict(rec, replica=r.name)
+                for r in fleet.replicas for rec in r.engine.timeline()]
+            keep["prometheus"] = fleet.prometheus_text()
+            keep["migrated"] = fleet.counters["requests_migrated"]
         return out
     finally:
         faults.clear()
@@ -259,6 +269,12 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--requests", type=int, default=120)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--trace-out",
+                    default=os.path.join("profiler_log",
+                                         "soak_fleet_trace.json"),
+                    help="where the traced chaos pass exports the "
+                         "MERGED chrome-trace JSON (profiler host "
+                         "spans + request lifecycles, ISSUE 10)")
     args = ap.parse_args(argv)
 
     cfg = LlamaConfig(vocab_size=128, hidden_size=128,
@@ -283,6 +299,42 @@ def main(argv=None):
     rand = run_pass(model, work, n_replicas=3,
                     router=RandomRouter(seed=args.seed + 7), chaos=False,
                     seed=args.seed, report=report, label="random")
+
+    # ---- traced chaos pass (ISSUE 10): the SAME kill/stall chaos with
+    # one fleet-shared RequestTracer + an active Profiler, exported as
+    # ONE merged chrome-trace JSON — profiler host spans and request
+    # lifecycle rows on the shared perf_counter clock (the acceptance
+    # artifact); migration park/adopt marks come from the kill.
+    from paddle_tpu import profiler
+    from paddle_tpu.serving import RequestTracer
+    tracer = RequestTracer(max_completed=4 * max(1, args.requests))
+    keep = {}
+    prof = profiler.Profiler(targets=[profiler.ProfilerTarget.CPU],
+                             on_trace_ready=lambda p: None)
+    prof.start()
+    try:
+        traced = run_pass(model, work, n_replicas=3,
+                          router=PrefixAffinityRouter(), chaos=True,
+                          seed=args.seed, report=report, label="traced",
+                          trace=tracer, keep=keep)
+    finally:
+        prof.stop()
+    tdiv = [i for i in range(len(work))
+            if traced.get(i) != clean.get(i)]
+    assert not tdiv, f"tracing perturbed chaos streams: {tdiv[:10]}"
+    migrated_traces = [t for t in tracer.traces()
+                       if "park" in t.mark_names()
+                       and "adopt" in t.mark_names()]
+    assert keep["migrated"] == 0 or migrated_traces, \
+        "migrations happened but no trace carries park+adopt marks"
+    os.makedirs(os.path.dirname(args.trace_out) or ".", exist_ok=True)
+    doc = tracer.export(args.trace_out, include_profiler=True,
+                        flight_recorder=keep["timelines"])
+    cats = {e.get("cat") for e in doc["traceEvents"]}
+    assert "request" in cats and len(cats - {"request", None}) >= 1, \
+        f"merged export missing host or request spans: {cats}"
+    report["trace_out"] = args.trace_out
+    report["traced_migration_traces"] = len(migrated_traces)
 
     # ---- zero-loss failover: EVERY request bit-identical -------------
     diverged = [i for i in range(len(work)) if chaos.get(i) != clean.get(i)]
@@ -313,6 +365,12 @@ def main(argv=None):
 
     report["wall_s"] = round(time.perf_counter() - t0, 2)
     print(json.dumps(report))
+    # ---- final report through the observability paths (ISSUE 10) -----
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import trace_report
+    print(trace_report.report(trace_report.load(args.trace_out)))
+    print("== fleet metrics exposition (traced chaos pass) ==")
+    print(keep.get("prometheus", ""), end="")
     print("SOAK_FLEET_OK")
     return 0
 

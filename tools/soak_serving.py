@@ -108,8 +108,14 @@ def make_workload(n, seed):
 
 
 def run_workload(model, work, *, chaos, seed, report, spec=False,
-                 kv_dtype=None):
-    """One full soak pass; returns ({idx: tokens}, affected_idx_set)."""
+                 kv_dtype=None, trace=None, label=None, keep=None):
+    """One full soak pass; returns ({idx: tokens}, affected_idx_set).
+    `trace` (a RequestTracer) turns per-request tracing on for the
+    pass (ISSUE 10 — the overhead measurement and the exported trace
+    the `make soak` trace-report smoke reads); `keep` (a dict) receives
+    the engine's flight-recorder timeline + Prometheus exposition
+    before shutdown so the final report prints through the
+    observability paths instead of an ad-hoc dict dump."""
     rng = np.random.RandomState(seed + 1)
     abort_at = {i for i in range(len(work))
                 if rng.random() < ABORT_FRACTION} if chaos else set()
@@ -121,7 +127,7 @@ def run_workload(model, work, *, chaos, seed, report, spec=False,
         model, clock=FakeClock(), default_ttl_s=TTL_S,
         retry_policy=RetryPolicy(max_retries=12, base_s=0.0,
                                  sleep=lambda s: None),
-        **kw)
+        trace=trace, **kw)
     armed = set()
 
     def arm(name, **kwargs):
@@ -236,8 +242,10 @@ def run_workload(model, work, *, chaos, seed, report, spec=False,
         eng.allocator.check_invariants()
 
         snap = eng.metrics.snapshot()
-        label = ("int8_" if kv_dtype == "int8" else "") \
-            + ("spec_" if spec else "") + ("chaos" if chaos else "clean")
+        if label is None:
+            label = ("int8_" if kv_dtype == "int8" else "") \
+                + ("spec_" if spec else "") \
+                + ("chaos" if chaos else "clean")
         rep = {
             "steps": steps, "sheds": sheds,
             "finish_reasons": reasons,
@@ -265,6 +273,9 @@ def run_workload(model, work, *, chaos, seed, report, spec=False,
             for pt in sorted(armed):
                 assert fired.get(pt, 0) >= 1, \
                     f"armed fault point {pt} never fired"
+        if keep is not None:
+            keep["timeline"] = eng.timeline()
+            keep["prometheus"] = eng.metrics.prometheus_text()
         return out, affected
     finally:
         faults.clear()
@@ -280,6 +291,11 @@ def main(argv=None):
                     help="skip the two speculative-decoding passes")
     ap.add_argument("--no-int8", action="store_true",
                     help="skip the two int8-KV passes")
+    ap.add_argument("--trace-out",
+                    default=os.path.join("profiler_log",
+                                         "soak_trace.json"),
+                    help="where the traced pass exports its merged "
+                         "chrome-trace JSON (ISSUE 10)")
     args = ap.parse_args(argv)
 
     cfg = LlamaConfig(vocab_size=128, hidden_size=128,
@@ -306,6 +322,95 @@ def main(argv=None):
     ch = report["chaos"]
     assert ch["step_retries"] >= 1 and ch["quarantined"] >= 1, ch
     report["unaffected_bit_identical"] = args.requests - len(affected)
+
+    # ---- tracing overhead + trace export (ISSUE 10) ------------------
+    # the SAME clean workload with per-request tracing ON: tokens must
+    # be bit-identical (observation must not perturb), and the step-
+    # loop time delta vs an untraced re-run IS the measured tracing
+    # cost (tracing off is the default — nothing to measure there).
+    # Methodology: every pass recompiles its programs (fresh engine ⇒
+    # fresh jit closures), and XLA compile variance on a shared CPU box
+    # (~±0.2 s) swamps the tracing signal in raw wall clock; single
+    # 40 ms GC/dispatch spikes likewise dominate a window SUM. So the
+    # arms are compared on the flight recorder's own per-step t_wall_ms
+    # over the steady-state window (the bounded ring drops the early
+    # compile-heavy steps), PAIRED by step number — both passes run the
+    # identical schedule — and the estimator is the median paired delta
+    # over the median untraced step: robust to load spikes in either
+    # arm. Three interleaved reps, deltas POOLED across reps before the
+    # median so slow load drift between passes cancels; per-rep medians
+    # are printed alongside as the spread evidence.
+    from paddle_tpu.serving import RequestTracer
+    estimates = []
+    all_deltas = []
+    all_base = []
+    tracer = None
+    keep = {}
+
+    def _step_ms(kp):
+        return {r["step"]: r["t_wall_ms"] for r in kp["timeline"]}
+
+    for rep in range(3):
+        kp_u = {}
+        warm, _ = run_workload(model, work, chaos=False, seed=args.seed,
+                               report=report, label=f"warm_clean_{rep}",
+                               keep=kp_u)
+        assert warm == clean, "untraced re-run must be bit-identical"
+        tracer = RequestTracer(max_completed=4 * max(1, args.requests))
+        keep = {}
+        traced, _ = run_workload(model, work, chaos=False,
+                                 seed=args.seed, report=report,
+                                 trace=tracer, label=f"traced_{rep}",
+                                 keep=keep)
+        div = [i for i in range(len(work))
+               if traced.get(i) != clean.get(i)]
+        assert not div, f"tracing changed greedy tokens: {div[:10]}"
+        by_u, by_t = _step_ms(kp_u), _step_ms(keep)
+        assert set(by_u) == set(by_t), "step windows diverged"
+        deltas = sorted(by_t[s] - by_u[s] for s in by_u)
+        base = sorted(by_u.values())
+        med_delta = deltas[len(deltas) // 2]
+        med_base = base[len(base) // 2]
+        estimates.append(med_delta / max(med_base, 1e-9))
+        all_deltas.extend(deltas)
+        all_base.extend(base)
+    all_deltas.sort()
+    all_base.sort()
+    med_base_ms = max(all_base[len(all_base) // 2], 1e-9)
+    overhead = all_deltas[len(all_deltas) // 2] / med_base_ms
+    report["trace_overhead"] = round(overhead, 4)
+    report["traced_requests"] = tracer.num_completed
+    # generous sanity bound only — wall-clock noise on a shared CPU box
+    # must not flake the soak; the measured number is the evidence
+    assert overhead < 0.5, \
+        f"tracing overhead {overhead:.1%} is far beyond budget"
+
+    # deterministic per-step cost bound: time EXACTLY what a traced
+    # decode step adds (2 now_ns + the shared batched `span_many`, the
+    # decode_step arg shape) against the median untraced step — the
+    # precise ≤5% gate the wall-clock estimate above corroborates but,
+    # on a shared box, cannot enforce without flaking
+    mb = RequestTracer()
+    rids = tuple(range(8))
+    for rid in rids:
+        mb.begin(rid, engine="microbench", prompt_len=16,
+                 max_new_tokens=8)
+    n_iter = 2000
+    t1 = time.perf_counter()
+    for _ in range(n_iter):
+        t_tr = mb.now_ns()
+        mb.span_many(rids, "decode_step", t_tr, mb.now_ns(),
+                     engine="microbench", batch=8, bucket=[8, 8])
+    per_step_ms = (time.perf_counter() - t1) * 1e3 / n_iter
+    for rid in range(8):       # keep the microbench traces bounded
+        mb.finish(rid, "stop")
+    overhead_step = per_step_ms / med_base_ms
+    report["trace_overhead_per_step"] = round(overhead_step, 4)
+    assert overhead_step < 0.05, \
+        f"per-step tracing cost {overhead_step:.2%} breaks the 5% budget"
+    os.makedirs(os.path.dirname(args.trace_out) or ".", exist_ok=True)
+    tracer.export(args.trace_out, flight_recorder=keep.get("timeline"))
+    report["trace_out"] = args.trace_out
 
     if not args.no_spec:
         # ---- speculative-decoding passes (ISSUE 5) -------------------
@@ -362,6 +467,19 @@ def main(argv=None):
 
     report["wall_s"] = round(time.perf_counter() - t0, 2)
     print(json.dumps(report))
+    # ---- final report through the observability paths (ISSUE 10) -----
+    # per-phase latency + flight-recorder digest from the traced pass,
+    # and the engine's Prometheus exposition — the same renderers
+    # production scrapes/postmortems use, exercised on every soak
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import trace_report
+    print(trace_report.report(trace_report.load(args.trace_out)))
+    print("== metrics exposition (traced clean pass) ==")
+    print(keep.get("prometheus", ""), end="")
+    print(f"trace_overhead={report['trace_overhead']:+.2%} "
+          f"(median paired per-step delta over the steady-state "
+          f"window; reps {['%+.2f%%' % (100 * e) for e in estimates]}) "
+          f"per_step_bound={report['trace_overhead_per_step']:.2%}")
     print("SOAK_SERVING_OK")
     return 0
 
