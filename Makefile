@@ -3,7 +3,7 @@
 # otherwise block every interpreter on the single TPU grant).
 TEST_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: test test-fast bench soak soak-fleet lint
+.PHONY: test test-fast bench soak soak-fleet lint train-report
 
 # tpu-lint: static trace-safety analysis (ANALYSIS.md). AST-only — no
 # jax import, no TPU grant, ~1 s; gates `make test`.
@@ -33,6 +33,13 @@ soak:
 	# plain python start claims the TPU grant)
 	$(TEST_ENV) python tools/trace_report.py profiler_log/soak_trace.json
 	$(TEST_ENV) python -m pytest tests/test_soak_serving.py -m slow -q
+
+# Training-observability smoke (ISSUE 11): run a tiny monitored CPU
+# training loop (--demo: trace + mid-run retrace), export the
+# TrainingMonitor document, and re-read it with the stdlib-only
+# reporter — OBSERVABILITY.md's end-to-end example.
+train-report:
+	$(TEST_ENV) python tools/train_report.py --demo profiler_log/train_trace.json
 
 # Multi-replica fleet chaos soak (ISSUE 7): seeded kill + stall of
 # replicas mid-stream; zero-loss / bit-identity / routing criteria.

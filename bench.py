@@ -186,6 +186,24 @@ def _run_inner(paddle, LlamaConfig, LlamaForCausalLM, jax, use_pallas, shrink,
     peak = peak_flops_per_chip(getattr(dev, "device_kind", dev.platform))
     mfu = flops / dt / peak
 
+    # XLA-derived accounting of the compiled step (ISSUE 11): re-lowers
+    # the cached program from recorded avals — with the persistent
+    # compilation cache on (worker enables it) the re-compile is a disk
+    # hit. AFTER timing by construction; null on any failure (the
+    # fallback chain stays exception-free). Reading caveat: Pallas
+    # custom calls count ZERO flops, so with pallas_flash the analytic
+    # number undercounts by ~attn_flops_share (profiler/cost.py).
+    analytic_flops = peak_hbm_bytes = analytic_mfu = None
+    try:
+        rep = step.cost_report()
+        progs = [p for p in rep["programs"] if "flops" in p]
+        if progs:
+            analytic_flops = float(progs[0]["flops"])
+            peak_hbm_bytes = int(progs[0]["peak_bytes"])
+            analytic_mfu = round(analytic_flops / dt / peak, 4)
+    except Exception:
+        pass
+
     return {
         "metric": "llama_pretrain_mfu",
         "value": round(mfu, 4),
@@ -201,6 +219,9 @@ def _run_inner(paddle, LlamaConfig, LlamaForCausalLM, jax, use_pallas, shrink,
         "optimizer": ("fused_adamw_bf16_states" if fused_opt and on_tpu
                       else "fused_adamw" if fused_opt else "adamw"),
         "attn_flops_share": round(attn_flops / flops, 4),
+        "analytic_flops": analytic_flops,
+        "peak_hbm_bytes": peak_hbm_bytes,
+        "analytic_mfu": analytic_mfu,
         "config": {"hidden": cfg.hidden_size, "layers": cfg.num_hidden_layers,
                    "batch": batch, "seq": seq},
     }

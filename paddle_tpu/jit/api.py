@@ -33,6 +33,7 @@ from __future__ import annotations
 import functools
 import os
 import pickle
+import time
 import warnings
 from typing import Any, Dict, List, Optional
 
@@ -42,6 +43,8 @@ import numpy as np
 
 from ..core.tensor import Tensor
 from ..framework import random as _random
+from .. import profiler as _profiler
+from ..profiler import compile_log as _compile_log
 
 __all__ = ["to_static", "not_to_static", "TracedFunction", "save", "load",
            "functional_call", "ignore_module", "to_static_report"]
@@ -84,12 +87,20 @@ def to_static_report(reset=False):
         # because their bodies mutate non-carried state, out-of-trace
         # collective rejections — see ANALYSIS.md
         "purity_diagnostics": [d.to_dict() for d in purity.snapshot()],
+        # compile-event timeline (ISSUE 11): every trace/retrace/AST
+        # rescue/eager fallback + serving ProgramCache compile, with
+        # durations — a compile storm is a counter, not a debugger hunt
+        "compile_events": _compile_log.events(),
+        "compile_counters": _compile_log.counters(),
+        "compile_seconds": _compile_log.duration_totals_s(),
+        "compile_events_dropped": _compile_log.dropped(),
     }
     if reset:
         _fallback_registry.clear()
         _fallback_dropped[0] = 0
         dy2static.reset_fallback_counters()
         purity.reset()
+        _compile_log.reset()
     return rep
 
 
@@ -134,6 +145,50 @@ class _EagerFallbackType:
 
 _EAGER_FALLBACK = _EagerFallbackType()
 
+class _CacheEntry:
+    """One guard key's compiled program + its accounting hooks:
+    `avals` (ShapeDtypeStructs of the LAST-compiled call's (state,
+    tensor) pytrees) lets `cost_report()` re-lower the program without
+    holding data; `sg_flags`/`grad_mode` pin the trace-time inputs the
+    closure reads off the instance and the ambient grad state (both are
+    guard key axes — re-lowering under the LAST call's values would
+    account a different program); `compile_ms` is the compiling call's
+    trace+compile+execute wall (logged to the compile-event ring).
+
+    One guard key can hold MORE than one XLA program: an optimizer that
+    creates accumulators lazily (AdamW moments on the first step) grows
+    the donated state pytree between call 1 and call 2, and jax.jit
+    recompiles underneath the guard cache. Calls keep being timed until
+    the jax-side program count stops growing (`stable`); each growth is
+    logged as a `retrace` (jax_internal) and refreshes `avals`, so
+    cost_report()/bench account the STEADY-STATE program, not the
+    run-once cold-start one, and the compile-event counters see every
+    real compile. After stabilization the hot path is back to two
+    attribute checks."""
+
+    __slots__ = ("jitted", "out_box", "avals", "fresh", "compile_ms",
+                 "sg_flags", "grad_mode", "stable", "n_programs")
+
+    def __init__(self, jitted, out_box):
+        self.jitted = jitted
+        self.out_box = out_box
+        self.avals = None
+        self.fresh = True
+        self.compile_ms = None
+        self.sg_flags = None
+        self.grad_mode = True
+        self.stable = False
+        self.n_programs = None
+
+    def jax_cache_size(self):
+        """jax-side compiled-program count for this jit wrapper (None
+        when the private probe is unavailable — accounting then
+        degrades to first-call-only, never breaks the call)."""
+        try:
+            return int(self.jitted._cache_size())
+        except Exception:
+            return None
+
 
 def _graph_break_errors():
     """Exception types that mean 'this python needs a value a tracer
@@ -171,6 +226,7 @@ class TracedFunction:
         self._input_spec = list(input_spec) if input_spec else None
         self._full_graph = bool(full_graph)
         self._fallback_count = 0   # observability: how many guard keys broke
+        self._compiled_count = 0   # programs ever compiled (trace + retraces)
         self.__wrapped__ = fn
         functools.update_wrapper(self, self._callable)
 
@@ -251,7 +307,7 @@ class TracedFunction:
         # accumulators in place — without it a training step holds two full
         # copies of the optimizer state (OOM for ~1B params on one chip).
         jitted = jax.jit(jittable, donate_argnums=(0,) if self._donate else ())
-        return jitted, out_treedef_box
+        return _CacheEntry(jitted, out_treedef_box)
 
     def __call__(self, *args, **kwargs):
         if not _to_static_enabled:
@@ -278,6 +334,54 @@ class TracedFunction:
         self._sg_flags = sg_flags
         if self._input_spec is not None:
             self._check_spec(tensor_arrays)
+        # Guard evaluation: when a Profiler is recording, the key build
+        # (closure/global fingerprints + the re-conversion check) gets
+        # its own host span (ISSUE 11) — guard time is real per-call
+        # work in closure-heavy loops and was invisible before.
+        prof = _profiler
+        if prof._tracer.enabled:
+            with prof.RecordEvent("to_static.guard"):
+                key = self._guard_key(treedef, static_leaves,
+                                      tensor_arrays, sg_flags)
+        else:
+            key = self._guard_key(treedef, static_leaves, tensor_arrays,
+                                  sg_flags)
+        entry = self._cache.get(key)
+        if entry is _EAGER_FALLBACK:       # guard hit on a broken graph
+            return self._callable(*args, **kwargs)
+        if entry is None:
+            entry = self._make_jitted(treedef, static_leaves, len(tensor_arrays))
+            self._cache[key] = entry
+        jitted, out_box = entry.jitted, entry.out_box
+        state = self._bundle.collect()
+        # time every call until the entry stabilizes: the first call is
+        # the trace+compile (a guard miss is only alertable if it
+        # carries its cost), and the next call(s) may recompile inside
+        # jax when lazily created optimizer state grows the pytree —
+        # see _CacheEntry. Steady state pays one attribute check.
+        t0 = None if entry.stable else time.perf_counter()
+        try:
+            out_arrays, new_state = jitted(state, tensor_arrays)
+        except _graph_break_errors() as e:
+            if self._full_graph:
+                raise RuntimeError(
+                    "to_static(full_graph=True): tracing hit data-dependent "
+                    "python control flow and graph-break fallback is "
+                    "disabled. Rewrite with lax.cond/where, or use "
+                    "full_graph=False to run this call eagerly. (parity: "
+                    "the reference AST dy2static mode errors here too)"
+                ) from e
+            return self._graph_break(key, state, e, args, kwargs)
+        if t0 is not None:
+            self._note_compiled(entry, state, tensor_arrays,
+                                time.perf_counter() - t0)
+        self._bundle.load(new_state)
+        self._clear_tracer_grads()
+        out_treedef = out_box[0]
+        out_leaves = [Tensor(a) if hasattr(a, "dtype") else a for a in out_arrays]
+        return jax.tree_util.tree_unflatten(out_treedef, out_leaves)
+
+    def _guard_key(self, treedef, static_leaves, tensor_arrays, sg_flags):
         # sg_flags is read by the traced closure, so it MUST be part of the
         # guard key: two calls with identical shapes but different
         # stop_gradient patterns need distinct compiled programs.
@@ -291,35 +395,121 @@ class TracedFunction:
         # lowerings choose forward-only structures under no_grad, so a
         # trace built in no_grad must not replay for a grad-enabled call
         from ..core import autograd as _autograd
-        key = (treedef, tuple(_hashable(l) for l in static_leaves),
-               tuple((tuple(a.shape), str(a.dtype)) for a in tensor_arrays),
-               tuple(sg_flags), closure_sig, self._globals_sig(),
-               _autograd.is_grad_enabled())
-        entry = self._cache.get(key)
-        if entry is _EAGER_FALLBACK:       # guard hit on a broken graph
-            return self._callable(*args, **kwargs)
-        if entry is None:
-            entry = self._make_jitted(treedef, static_leaves, len(tensor_arrays))
-            self._cache[key] = entry
-        jitted, out_box = entry
-        state = self._bundle.collect()
+        return (treedef, tuple(_hashable(l) for l in static_leaves),
+                tuple((tuple(a.shape), str(a.dtype)) for a in tensor_arrays),
+                tuple(sg_flags), closure_sig, self._globals_sig(),
+                _autograd.is_grad_enabled())
+
+    def _fn_name(self):
+        return getattr(self._callable, "__qualname__",
+                       getattr(self._callable, "__name__", "<fn>"))
+
+    def _note_compiled(self, entry, state, tensor_arrays, dt):
+        """A still-watched (fresh or not-yet-stable) call just
+        finished. Fresh: stamp the entry and log the trace/retrace.
+        Warm: if jax recompiled underneath the guard entry (lazily
+        created optimizer state grew the donated pytree — see
+        _CacheEntry), log it and refresh the entry to the NEW program;
+        otherwise mark the entry stable and stop timing calls."""
+        if not entry.fresh:
+            size = entry.jax_cache_size()
+            if size is None or size == entry.n_programs:
+                entry.stable = True       # steady state: stop timing
+                return
+            entry.n_programs = size
+            self._stamp_entry(entry, state, tensor_arrays, dt)
+            self._compiled_count += 1
+            _compile_log.log_event(
+                "retrace", name=self._fn_name(), duration_s=dt,
+                detail={"jax_internal": True,
+                        "programs": self._compiled_count,
+                        "cache_size": len(self._cache)})
+            return
+        entry.fresh = False
+        entry.n_programs = entry.jax_cache_size()
+        if entry.n_programs is None:
+            # no jax-side probe: degrade to first-call-only accounting
+            entry.stable = True
+        self._stamp_entry(entry, state, tensor_arrays, dt)
+        kind = "trace" if self._compiled_count == 0 else "retrace"
+        self._compiled_count += 1
+        _compile_log.log_event(
+            kind, name=self._fn_name(), duration_s=dt,
+            detail={"programs": self._compiled_count,
+                    "cache_size": len(self._cache)})
+
+    def _stamp_entry(self, entry, state, tensor_arrays, dt):
+        """Record the just-compiled call's accounting context on the
+        entry: wall time, trace-time sg_flags/grad mode, and the input
+        ShapeDtypeStructs cost_report() re-lowers from."""
+        entry.compile_ms = round(dt * 1e3, 3)
+        from ..core import autograd as _autograd
+        entry.sg_flags = tuple(self._sg_flags)
+        entry.grad_mode = _autograd.is_grad_enabled()
         try:
-            out_arrays, new_state = jitted(state, tensor_arrays)
-        except _graph_break_errors() as e:
-            if self._full_graph:
-                raise RuntimeError(
-                    "to_static(full_graph=True): tracing hit data-dependent "
-                    "python control flow and graph-break fallback is "
-                    "disabled. Rewrite with lax.cond/where, or use "
-                    "full_graph=False to run this call eagerly. (parity: "
-                    "the reference AST dy2static mode errors here too)"
-                ) from e
-            return self._graph_break(key, state, e, args, kwargs)
-        self._bundle.load(new_state)
-        self._clear_tracer_grads()
-        out_treedef = out_box[0]
-        out_leaves = [Tensor(a) if hasattr(a, "dtype") else a for a in out_arrays]
-        return jax.tree_util.tree_unflatten(out_treedef, out_leaves)
+            from ..profiler.cost import shape_structs
+            # .shape/.dtype stay readable on donated buffers, so the
+            # post-call capture is safe even with donate_state=True
+            entry.avals = (shape_structs(state),
+                           shape_structs(list(tensor_arrays)))
+        except Exception:
+            entry.avals = None
+
+    def cost_report(self) -> dict:
+        """Structured FLOPs / HBM-bytes / peak-memory accounting of
+        every compiled program in the guard cache (ISSUE 11), via XLA's
+        `cost_analysis()` / `memory_analysis()` (`profiler.cost` — see
+        its docstring for how to read flops/io_bytes/peak_bytes
+        honestly). Each program is re-lowered from the ShapeDtypeStructs
+        recorded at its last-COMPILED call (the steady-state program —
+        lazily created optimizer state makes the cold-start call 1 a
+        run-once program, see _CacheEntry) — no tensor data is touched,
+        and with the persistent compilation cache on the re-compile is
+        a disk hit. The re-trace runs the python function under abstract
+        values, so python-side counters (e.g. an optimizer step count)
+        advance by one: call between steps, not mid-step."""
+        from ..profiler import cost as _cost
+        programs = []
+        fallbacks = 0
+        for entry in self._cache.values():
+            if entry is _EAGER_FALLBACK:
+                fallbacks += 1
+                continue
+            if entry.avals is None:
+                continue
+            state_sds, arrays_sds = entry.avals
+            snap = self._bundle.collect()
+            # re-lower under the entry's OWN trace-time inputs: the
+            # functional closure reads self._sg_flags off the instance
+            # and the body may branch on ambient grad mode — both are
+            # guard-key axes, so the last call's values can describe a
+            # DIFFERENT program than this entry compiled
+            from ..core import autograd as _autograd
+            prev_flags = self._sg_flags
+            prev_grad = _autograd.is_grad_enabled()
+            if entry.sg_flags is not None:
+                self._sg_flags = list(entry.sg_flags)
+            try:
+                _autograd.set_grad_enabled(entry.grad_mode)
+                rec = _cost.lowered_cost(
+                    entry.jitted.lower(state_sds, arrays_sds)).to_dict()
+            except Exception as e:   # a cost report must never raise
+                rec = {"error": f"{type(e).__name__}: {e}"[:200]}
+            finally:
+                self._sg_flags = prev_flags
+                _autograd.set_grad_enabled(prev_grad)
+                # lowering traced the function: restore the concrete
+                # state the trace clobbered with tracers
+                self._bundle.load(snap)
+                self._clear_tracer_grads()
+            rec["compile_ms"] = entry.compile_ms
+            rec["input_shapes"] = [
+                list(s.shape) for s in arrays_sds if hasattr(s, "shape")]
+            programs.append(rec)
+        return {"function": self._fn_name(),
+                "num_programs": len(programs),
+                "eager_fallback_keys": fallbacks,
+                "programs": programs}
 
     def _track_value(self, key, name, v):
         """One signature entry for a guarded value (closure cell or
@@ -476,8 +666,14 @@ class TracedFunction:
         if not getattr(self, "_ast_tried", False):
             self._ast_tried = True
             from .dy2static import try_convert
+            t0 = time.perf_counter()
             converted = try_convert(self._callable)
             if converted is not None:
+                _compile_log.log_event(
+                    "ast_convert", name=self._fn_name(),
+                    duration_s=time.perf_counter() - t0,
+                    detail={"converted": str(getattr(
+                        converted, "_dy2static_converted", "?"))})
                 self._eager_callable = self._callable  # for later breaks
                 self._conv_closure_sig = self._closure_sig()
                 self._callable = converted
@@ -491,14 +687,17 @@ class TracedFunction:
                 return self.__call__(*args, **kwargs)
         self._cache[key] = _EAGER_FALLBACK
         self._fallback_count += 1
-        name = getattr(self._callable, "__qualname__",
-                       getattr(self._callable, "__name__", "<fn>"))
+        name = self._fn_name()
         first_line = str(err).strip().split("\n")[0]
         _record_fallback({
             "function": name,
             "error": type(err).__name__,
             "message": first_line[:200],
         })
+        _compile_log.log_event(
+            "eager_fallback", name=name,
+            detail={"error": type(err).__name__,
+                    "fallback_keys": self._fallback_count})
         warnings.warn(
             f"to_static: graph break in {name!r} "
             f"({type(err).__name__}: {first_line[:200]}). This call "

@@ -65,6 +65,9 @@ import jax.numpy as jnp
 
 from ..core.tensor import Tensor
 from ..core import autograd
+from .. import profiler as _profiler
+from ..profiler import monitor as _monitor
+from ..profiler.monitor import grad_global_norm
 from .lr import LRScheduler
 
 __all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad",
@@ -185,11 +188,34 @@ class Optimizer:
     # ------------------------------------------------------------------ step
     @autograd.no_grad
     def step(self):
+        # profiler span (ISSUE 11): optimizer time shows on the host
+        # timeline next to dispatch op spans — one attribute check when
+        # no Profiler records (the ops.dispatch pattern)
+        if _profiler._tracer.enabled:
+            with _profiler.RecordEvent(
+                    "optimizer.step", _profiler.TracerEventType.Optimization):
+                return self._step_impl()
+        return self._step_impl()
+
+    minimize_step = step
+
+    def _step_impl(self):
         params_grads = []
         for p in self._parameter_list:
             if p.stop_gradient or p._grad_buffer is None:
                 continue
             params_grads.append((p, Tensor(p._grad_buffer)))
+        # TrainingMonitor hook (ISSUE 11): the PRE-clip gradient global
+        # norm + lr, stashed lazily for the monitor's next step() fetch.
+        # With no monitor attached this is ONE module-global truthiness
+        # check — asserted allocation-free by the booby-trap test. Under
+        # a to_static trace grads are tracers and grad_global_norm
+        # returns None (the python-side hook must not leak tracers).
+        if _monitor._ACTIVE:
+            mon = _monitor._ACTIVE[-1]
+            gn = grad_global_norm(self._parameter_list) \
+                if mon.track_grad_norm else None
+            mon.note(lr=self.get_lr(), grad_norm=gn)
         if self._grad_clip is not None:
             params_grads = self._grad_clip(params_grads)
         lr = self.get_lr()
@@ -198,7 +224,13 @@ class Optimizer:
             # returns the (p, g) pairs the fused path did NOT handle;
             # base implementation handles nothing (flag inert for
             # optimizers without a fused update)
-            params_grads = self._fused_step(params_grads, lr)
+            if _profiler._tracer.enabled:
+                with _profiler.RecordEvent(
+                        "optimizer.fused_step",
+                        _profiler.TracerEventType.Optimization):
+                    params_grads = self._fused_step(params_grads, lr)
+            else:
+                params_grads = self._fused_step(params_grads, lr)
         for idx, p in enumerate(self._parameter_list):
             match = next((g for (pp, g) in params_grads if pp is p), None)
             if match is None:
@@ -206,8 +238,6 @@ class Optimizer:
             g = match._data
             lr_scale = getattr(p, "_lr_scale", 1.0)
             self._apply_one(idx, p, g, lr * lr_scale)
-
-    minimize_step = step
 
     def _fused_step(self, params_grads, lr):
         """Fused multi-tensor hook: handle what you can, return the

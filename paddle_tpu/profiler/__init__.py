@@ -471,6 +471,21 @@ class SummaryView(_enum.Enum):
     UDFView = 8
 
 
+# ---------------------------------------------------------------------------
+# training observability (ISSUE 11): cost accounting, compile-event log,
+# and the TrainingMonitor — submodules kept import-light (no jax at
+# module level) so loading the profiler never touches a backend.
+# ---------------------------------------------------------------------------
+from . import compile_log            # noqa: E402
+from . import cost                   # noqa: E402
+from . import exposition             # noqa: E402
+from .monitor import (TrainingMonitor, active_monitor,  # noqa: E402
+                      grad_global_norm)
+
+__all__ += ["TrainingMonitor", "active_monitor", "grad_global_norm",
+            "compile_log", "cost", "exposition"]
+
+
 def export_protobuf(profiler_result, path):
     """Serialize a profiler result (parity: profiler.export_protobuf —
     the reference dumps its own proto; this build writes the same JSON

@@ -25,13 +25,9 @@ __all__ = ["ServingMetrics"]
 PERCENTILE_WINDOW = 1024
 
 
-def _percentile(samples, q):
-    """Nearest-rank percentile over a small window (no numpy needed)."""
-    if not samples:
-        return None
-    s = sorted(samples)
-    idx = min(len(s) - 1, max(0, int(round(q / 100.0 * (len(s) - 1)))))
-    return s[idx]
+# the shared nearest-rank percentile rule (profiler/exposition.py) —
+# serving reservoirs and the TrainingMonitor latency ring must agree
+from ..profiler.exposition import percentile as _percentile  # noqa: E402
 
 
 class ServingMetrics:

@@ -26,12 +26,76 @@ ONE mesh shape — an engine owns one mesh, so its key space is
 `bucket grid x {its mesh shape}`; processes mixing TP degrees get one
 cache per engine and the global compile count stays the sum of the
 per-engine grids (the "mesh shapes actually used" bound in ISSUE 8).
+
+Observability (ISSUE 11): every stored program rides in a thin
+`_TrackedProgram` wrapper — its FIRST launch (the jit trace+compile)
+is timed and logged to the shared compile-event ring
+(`profiler.compile_log`, kind `program_compile`), and the launch args'
+ShapeDtypeStructs are recorded so `cost_table()` can re-lower each
+program for XLA cost/memory accounting (`profiler.cost`) without
+holding tensor data. Steady-state launches pay one attribute check.
 """
 from __future__ import annotations
 
+import time
 from typing import Callable, Dict, Optional
 
 __all__ = ["ProgramCache"]
+
+
+class _TrackedProgram:
+    """Callable wrapper over one compiled-program builder result: times
+    the first launch (= jit compile) and keeps abstract arg shapes for
+    later cost accounting. Transparent to call sites — engines only
+    ever `prog(*args)`."""
+
+    __slots__ = ("fn", "key", "first_call_ms", "arg_avals", "_cost")
+
+    def __init__(self, fn, key):
+        self.fn = fn
+        self.key = key
+        self.first_call_ms = None
+        self.arg_avals = None
+        self._cost = None
+
+    def __call__(self, *args):
+        if self.first_call_ms is None:
+            t0 = time.perf_counter()
+            out = self.fn(*args)
+            dt = time.perf_counter() - t0
+            self.first_call_ms = round(dt * 1e3, 3)
+            try:
+                from ..profiler.cost import shape_structs
+                self.arg_avals = shape_structs(list(args))
+            except Exception:
+                self.arg_avals = None
+            from ..profiler import compile_log
+            compile_log.log_event(
+                "program_compile", name=str(self.key[0]), duration_s=dt,
+                detail={"key": repr(self.key)[:120]})
+            return out
+        return self.fn(*args)
+
+    def cost_report(self) -> Optional[dict]:
+        """XLA cost/memory accounting of this program (lazy, cached):
+        re-lowers from the recorded arg avals — only possible for
+        jax.jit-built programs that have launched at least once."""
+        if self._cost is not None:
+            return self._cost
+        if self.arg_avals is None or not hasattr(self.fn, "lower"):
+            return None
+        try:
+            from ..profiler import cost as _cost
+            rec = _cost.lowered_cost(
+                self.fn.lower(*self.arg_avals)).to_dict()
+        except Exception as e:   # accounting must never break serving
+            # transient failures are NOT cached — the next call retries
+            rec = {"error": f"{type(e).__name__}: {e}"[:200]}
+            rec["compile_ms"] = self.first_call_ms
+            return rec
+        rec["compile_ms"] = self.first_call_ms
+        self._cost = rec
+        return rec
 
 
 class ProgramCache:
@@ -75,7 +139,7 @@ class ProgramCache:
                 f"program family {family!r} would exceed its compile "
                 f"bound {bound} with key {key!r} — a key axis is not "
                 f"riding the bucket grid")
-        prog = builder()
+        prog = _TrackedProgram(builder(), key)
         self._programs[key] = prog
         self._counts[family] += 1
         if self._on_compile is not None:
@@ -103,6 +167,37 @@ class ProgramCache:
         """The live program keys (tests assert the key-suffix axes —
         quant config, mesh shape — actually ride them)."""
         return list(self._programs.keys())
+
+    # ------------------------------------------------------- accounting
+    def compile_times_ms(self) -> Dict[tuple, Optional[float]]:
+        """{key: first-launch wall ms} — None for programs never
+        launched (built but not yet called)."""
+        return {k: p.first_call_ms for k, p in self._programs.items()}
+
+    def cost_table(self) -> Dict[tuple, Optional[dict]]:
+        """{key: XLA cost/memory dict} over every launched program
+        (ISSUE 11) — flops, bytes, peak_bytes per bucketed program, so
+        "which bucket family is paying for its HBM" is answerable from
+        metrics. Lazy: each program's accounting is computed once, on
+        the first cost_table() call after its first launch."""
+        return {k: p.cost_report() for k, p in self._programs.items()}
+
+    def family_costs(self) -> Dict[str, dict]:
+        """Per-family aggregate of cost_table(): program count, summed
+        flops, max peak_bytes — the capacity-planning view."""
+        out: Dict[str, dict] = {}
+        for key, rec in self.cost_table().items():
+            fam = out.setdefault(str(key[0]), {
+                "programs": 0, "accounted": 0, "flops": 0.0,
+                "max_peak_bytes": 0})
+            fam["programs"] += 1
+            if not rec or "error" in rec:
+                continue
+            fam["accounted"] += 1
+            fam["flops"] += rec.get("flops", 0.0)
+            fam["max_peak_bytes"] = max(fam["max_peak_bytes"],
+                                        rec.get("peak_bytes", 0))
+        return out
 
     def __len__(self):
         return len(self._programs)

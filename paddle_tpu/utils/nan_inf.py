@@ -25,9 +25,35 @@ import numpy as np
 from .flags import flags, set_flags
 
 __all__ = ["check_numerics", "enable_check_nan_inf", "check_nan_inf_enabled",
-           "maybe_check", "poison_scope", "current_poison_scope"]
+           "maybe_check", "poison_scope", "current_poison_scope",
+           "nan_stats", "reset_nan_stats", "nan_stats_generation"]
 
 _SCOPES: List[str] = []
+
+# Dispatch NaN-hook accounting (ISSUE 11): `checks` counts every tensor
+# the hook evaluated, `hits` every NaN/Inf detection (incremented BEFORE
+# the raise, so the count survives the exception). The TrainingMonitor
+# records per-step deltas; only touched when FLAGS_check_nan_inf is on,
+# so the default hot path stays untouched.
+_STATS = {"checks": 0, "hits": 0}
+_STATS_GEN = [0]
+
+
+def nan_stats():
+    """{checks, hits} since process start (or the last reset)."""
+    return dict(_STATS)
+
+
+def nan_stats_generation():
+    """Bumped by every reset — delta consumers (TrainingMonitor)
+    re-baseline on a generation change."""
+    return _STATS_GEN[0]
+
+
+def reset_nan_stats():
+    _STATS["checks"] = 0
+    _STATS["hits"] = 0
+    _STATS_GEN[0] += 1
 
 
 @contextmanager
@@ -57,8 +83,10 @@ def check_numerics(x, op_name="tensor", action="raise"):
     arr = x._data if hasattr(x, "_data") else x
     if not jnp.issubdtype(arr.dtype, jnp.floating):
         return x
+    _STATS["checks"] += 1
     bad = bool(jnp.any(~jnp.isfinite(arr)))
     if bad:
+        _STATS["hits"] += 1
         n_nan = int(jnp.sum(jnp.isnan(arr)))
         n_inf = int(jnp.sum(jnp.isinf(arr)))
         scope = current_poison_scope()

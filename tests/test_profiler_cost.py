@@ -1,0 +1,329 @@
+"""XLA cost/memory accounting + formula cross-checks (ISSUE 11).
+
+The load-bearing tests are the CROSS-CHECKS: the hand-maintained FLOPs
+formula in `bench.py::llama_step_flops` and the byte-accounting source
+`kernels/fused_optimizer.py::adamw_update_bytes` (the BASELINE.md sizing
+math) are compared against XLA's own `cost_analysis()` /
+`memory_analysis()` of the compiled programs — formula drift now fails a
+test instead of lying in a README. The flagship-config check (the exact
+bench.py CPU-lowering of the 0.8B model) is slow-marked; a small-config
+version of the same machinery stays tier-1.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bench
+import paddle_tpu as paddle
+from paddle_tpu.jit import functional_call
+from paddle_tpu.kernels import fused_optimizer as fo
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.nn.functional.flash_attention import sdp_kernel
+from paddle_tpu.profiler import cost
+
+
+# --------------------------------------------------------------- ProgramCost
+def test_program_cost_derived_fields():
+    c = cost.ProgramCost(flops=1e12, bytes_accessed=5e9,
+                         argument_bytes=3_000, output_bytes=1_000,
+                         temp_bytes=500, alias_bytes=200)
+    assert c.io_bytes == 4_000
+    assert c.peak_bytes == 3_000 + 1_000 + 500 - 200
+    assert c.mfu(1.0, peak_flops=2e12) == pytest.approx(0.5)
+    assert c.hbm_gbps(1.0) == pytest.approx(4_000 / 1e9)
+    d = c.to_dict()
+    assert d["io_bytes"] == 4_000 and d["peak_bytes"] == c.peak_bytes
+
+
+def test_program_cost_degenerate_time():
+    c = cost.ProgramCost(flops=1e12)
+    assert c.mfu(0.0, peak_flops=1e12) is None
+    assert c.hbm_gbps(-1.0) is None
+    assert cost.analytic_mfu(1e12, 0.0, peak_flops=1e12) is None
+
+
+def test_compiled_cost_degrades_to_zeros():
+    """A backend without analyses must yield zeros, never raise — a
+    cost report can't take down the program it describes."""
+    class Broken:
+        def cost_analysis(self):
+            raise NotImplementedError
+
+        def memory_analysis(self):
+            raise NotImplementedError
+
+    c = cost.compiled_cost(Broken())
+    assert c.flops == 0.0 and c.io_bytes == 0 and c.peak_bytes == 0
+
+
+def test_shape_structs_passthrough():
+    tree = {"a": jnp.zeros((4, 8), jnp.bfloat16), "b": 3, "c": None}
+    sds = cost.shape_structs(tree)
+    assert sds["a"].shape == (4, 8) and sds["a"].dtype == jnp.bfloat16
+    assert sds["b"] == 3 and sds["c"] is None
+
+
+def test_peak_flops_table_matches_bench():
+    """cost.py and bench.py carry the same peak table (bench must stay
+    import-light, so the table is duplicated — this pin is the sync)."""
+    for kind in ("v5 lite", "v5e", "v5p", "v4", "v6e", "trillium", "cpu",
+                 "something-unknown"):
+        assert cost.peak_flops_per_chip(kind) == \
+            bench.peak_flops_per_chip(kind), kind
+
+
+def test_jit_cost_matmul_exact():
+    """XLA counts 2*m*k*n for a matmul — the unit the hand formulas
+    assume (6N = 2N fwd + 4N bwd rests on this)."""
+    m = k = n = 256
+    c = cost.jit_cost(lambda a, b: a @ b,
+                      jax.ShapeDtypeStruct((m, k), jnp.float32),
+                      jax.ShapeDtypeStruct((k, n), jnp.float32))
+    assert c.flops == 2 * m * k * n
+    assert c.io_bytes == 4 * (m * k + k * n + m * n)
+
+
+# ------------------------------------------- AdamW bytes vs BASELINE formula
+# The fused-optimizer XLA composition (`use_pallas=False` — the SAME
+# `_adamw_math` the Pallas kernel wraps, pinned bit-identical by
+# tests/test_fused_optimizer.py) is the accountable stand-in for the
+# kernel: XLA's argument+output buffer sizes must reproduce
+# `adamw_update_bytes`, the single source BASELINE.md and bench_ops use.
+# Slack covers the 9-float scalar vector and constant pool, not arrays.
+_SCALAR_SLACK = 256
+
+
+@pytest.mark.parametrize("case", ["fp32", "bf16_master"])
+def test_adamw_io_bytes_vs_update_bytes(case):
+    rows, lanes = 4096, fo.LANES
+    n = rows * lanes
+    sc = fo.adamw_scalars(1e-3, 0.9, 0.999, 1e-8, 0.01, 3)
+    if case == "fp32":
+        # read g+w+m+v fp32, write w+m+v fp32 -> 28 B/elem
+        def upd(g, w, m, v):
+            return fo.fused_adamw_bucket(g, w, m, v, sc,
+                                         use_pallas=False)[1:]
+        sds = [jax.ShapeDtypeStruct((rows, lanes), jnp.float32)] * 4
+        expected = fo.adamw_update_bytes(n)
+    else:
+        # bf16 param/grad/moments + fp32 master -> 20 B/elem (the PR-9
+        # "28 -> 20 B/elem" claim, cross-checked here)
+        def upd(g, mst, m, v):
+            return fo.fused_adamw_bucket(g, mst, m, v, sc,
+                                         param_dtype="bfloat16",
+                                         use_pallas=False)
+        sds = [jax.ShapeDtypeStruct((rows, lanes), d)
+               for d in (jnp.bfloat16, jnp.float32, jnp.bfloat16,
+                         jnp.bfloat16)]
+        expected = fo.adamw_update_bytes(n, param_width=2, moment_width=2,
+                                         has_master=True, grad_width=2)
+    c = cost.jit_cost(upd, *sds, donate_argnums=(1, 2, 3))
+    assert expected <= c.io_bytes <= expected + _SCALAR_SLACK
+    # donation is visible to the accounting: the state buffers alias
+    assert c.alias_bytes > 0
+    # peak never exceeds undonated args+outputs+temps
+    assert c.peak_bytes < c.io_bytes + c.temp_bytes
+
+
+# ------------------------------------------------- model FLOPs vs bench.py
+def _xla_step_flops(cfg, batch, seq):
+    """FLOPs of loss+grads for one train step by XLA's count: lower
+    `value_and_grad` over a functional-call loss with the PURE-XLA sdpa
+    path (Pallas-interpret scan bodies are counted once, not per trip —
+    cost.py's docstring; the cross-check needs the exact path). Params
+    ride as ShapeDtypeStructs — nothing beyond the model itself is
+    materialized."""
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+
+    def loss_fn(params, ids, labels):
+        out = functional_call(
+            model, {k: paddle.Tensor(v) for k, v in params.items()},
+            paddle.Tensor(ids), labels=paddle.Tensor(labels))
+        return out._data
+
+    p_sds = cost.shape_structs(
+        {k: t._data for k, t in model.state_dict().items()})
+    ids_sd = jax.ShapeDtypeStruct((batch, seq), jnp.int64)
+    with sdp_kernel(enable_flash=False):
+        lowered = jax.jit(jax.value_and_grad(loss_fn)).lower(
+            p_sds, ids_sd, ids_sd)
+    ca = lowered.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0]
+    return float(ca["flops"])
+
+
+def test_llama_flops_formula_small_config():
+    """Tier-1 drift guard on the same machinery as the flagship check:
+    bench.py's CPU-fallback config."""
+    cfg = LlamaConfig(vocab_size=1024, hidden_size=128,
+                      intermediate_size=256, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=4,
+                      max_position_embeddings=256)
+    xla = _xla_step_flops(cfg, 2, 128)
+    hand, _, _ = bench.llama_step_flops(cfg, 2, 128)
+    assert abs(xla / hand - 1.0) < 0.05, (xla, hand)
+
+
+@pytest.mark.slow
+def test_llama_flops_formula_flagship_config():
+    """ISSUE 11 acceptance: analytic FLOPs within 5% of the hand
+    formula on the flagship (~0.8B) config, CPU lowering (measured
+    1.0022x at introduction). Slow-marked for the ~10 s model init,
+    runs under `make test`."""
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=1536,
+                      intermediate_size=4096, num_hidden_layers=18,
+                      num_attention_heads=12, num_key_value_heads=12,
+                      max_position_embeddings=2048)
+    xla = _xla_step_flops(cfg, 4, 2048)
+    hand, _, _ = bench.llama_step_flops(cfg, 4, 2048)
+    assert abs(xla / hand - 1.0) < 0.05, (xla, hand)
+    # and the analytic-MFU helper agrees with bench.py's arithmetic
+    dt = 1.0
+    peak = bench.peak_flops_per_chip("v5e")
+    assert cost.analytic_mfu(hand, dt, peak_flops=peak) == \
+        pytest.approx(hand / dt / peak)
+
+
+# --------------------------------------------------- TracedFunction report
+def test_cost_report_roundtrip_and_state_restore():
+    """cost_report() re-lowers every cached program from recorded avals
+    and must leave the live state bit-identical (the re-trace runs the
+    python under abstract values; the bundle snapshot restores it)."""
+    paddle.seed(0)
+    lin = paddle.nn.Linear(8, 8)
+    opt = paddle.optimizer.AdamW(parameters=lin.parameters(),
+                                 learning_rate=1e-3)
+
+    def train_step(x):
+        y = lin(x)
+        loss = (y * y).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    step = paddle.jit.to_static(train_step, state_objects=[lin, opt])
+
+    x = paddle.to_tensor(np.random.RandomState(0).rand(4, 8).astype("f"))
+    step(x)
+    before = {k: np.asarray(t._data).copy()
+              for k, t in lin.state_dict().items()}
+    rep = step.cost_report()
+    assert rep["num_programs"] == 1
+    prog = rep["programs"][0]
+    assert prog["flops"] > 0
+    assert prog["io_bytes"] > 0 and prog["peak_bytes"] > 0
+    assert prog["compile_ms"] is not None and prog["compile_ms"] > 0
+    assert [4, 8] in prog["input_shapes"]
+    # the report touched nothing
+    after = {k: np.asarray(t._data) for k, t in lin.state_dict().items()}
+    for k in before:
+        assert np.array_equal(before[k], after[k]), k
+    # and the step still runs (no tracer leakage into live state)
+    step(x)
+
+
+def test_cost_report_marks_fallback_keys():
+    @paddle.jit.to_static
+    def bad(x):
+        if float(x.sum()) > 0:   # concretization -> eager fallback
+            return x + 1
+        return x - 1
+
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        bad(paddle.to_tensor(np.ones((2, 2), np.float32)))
+    rep = bad.cost_report()
+    assert rep["eager_fallback_keys"] >= 1
+    assert rep["num_programs"] == 0
+
+
+def test_cost_report_uses_per_entry_sg_flags_and_grad_mode():
+    """A multi-program cache must account each entry under ITS OWN
+    trace-time stop_gradient flags and ambient grad mode (both guard-key
+    axes the functional closure reads off the instance) — not the last
+    call's. A stop_gradient=True input drops the backward+update, so the
+    two programs' flops differ by ~the backward; re-lowering both under
+    the LAST call's flags would report two identical rows."""
+    paddle.seed(0)
+    lin = paddle.nn.Linear(16, 16)
+    opt = paddle.optimizer.SGD(parameters=lin.parameters(),
+                               learning_rate=1e-3)
+
+    def train_step(x):
+        y = lin(x)
+        loss = (y * y).mean()
+        if not x.stop_gradient:
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return loss
+
+    step = paddle.jit.to_static(train_step, state_objects=[lin, opt])
+    rng = np.random.RandomState(0)
+    x_train = paddle.to_tensor(rng.rand(4, 16).astype("f"),
+                               stop_gradient=False)
+    x_eval = paddle.to_tensor(rng.rand(4, 16).astype("f"))
+    x_eval.stop_gradient = True
+    step(x_train)            # program A: fwd + bwd + update
+    step(x_eval)             # program B: fwd only (LAST call)
+    rep = step.cost_report()
+    assert rep["num_programs"] == 2
+    flops = sorted(p["flops"] for p in rep["programs"])
+    # fwd-only strictly cheaper than fwd+bwd+update; equal rows mean the
+    # report re-lowered both entries under one set of flags
+    assert flops[0] < flops[1], flops
+    # restoration: the next call must not see leaked flags/grad mode
+    from paddle_tpu.core import autograd
+    assert autograd.is_grad_enabled()
+    step(x_train)
+    assert step._fallback_count == 0
+
+
+def test_cost_report_accounts_steady_state_program_not_cold_start():
+    """AdamW creates its moments during call 1, growing the donated
+    state pytree — jax recompiles underneath the guard entry on call 2
+    and THAT program is the one every timed step runs. The entry must
+    log both compiles and refresh its avals so cost_report()/bench
+    account the steady-state program, not the run-once cold-start."""
+    from paddle_tpu.profiler import compile_log
+    compile_log.reset()
+    paddle.seed(0)
+    lin = paddle.nn.Linear(8, 8)
+    opt = paddle.optimizer.AdamW(parameters=lin.parameters(),
+                                 learning_rate=1e-3)
+
+    def train_step(x):
+        y = lin(x)
+        loss = (y * y).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    step = paddle.jit.to_static(train_step, state_objects=[lin, opt])
+    x = paddle.to_tensor(np.random.RandomState(0).rand(4, 8).astype("f"))
+    for _ in range(4):
+        step(x)
+    kinds = [(e["kind"], e.get("detail", {}).get("jax_internal", False))
+             for e in compile_log.events()]
+    assert kinds == [("trace", False), ("retrace", True)], kinds
+    entry = next(iter(step._cache.values()))
+    assert entry.stable and entry.n_programs == 2
+    # avals hold the steady-state structure: params + 2 moments + the
+    # AdamW step count et al., strictly more leaves than the cold call
+    state_sds, _ = entry.avals
+    n_state = len(jax.tree_util.tree_leaves(state_sds))
+    n_params = len(list(lin.parameters()))
+    assert n_state > n_params, (n_state, n_params)
+    rep = step.cost_report()
+    assert rep["num_programs"] == 1
+    assert rep["programs"][0]["flops"] > 0
+    # and the re-lowered steady-state program leaves live state intact
+    step(x)

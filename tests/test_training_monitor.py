@@ -1,0 +1,439 @@
+"""TrainingMonitor + compile-event log + optimizer spans (ISSUE 11).
+
+The load-bearing tests are the BOOBY-TRAP (a monitor-less training loop
+must never call into monitor machinery — the hot path is one
+module-global truthiness check), BIT-IDENTITY (the monitor observes, it
+never perturbs the trajectory), and the exposition DRIFT test over the
+new `paddle_training` metric names (same both-directions contract as
+serving). The <5% overhead assertion is slow-marked (paired-median
+timing on a shared CPU box needs repetitions).
+"""
+from __future__ import annotations
+
+import json
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.profiler as prof_mod
+from paddle_tpu.profiler import (Profiler, ProfilerState, RecordEvent,
+                                 TrainingMonitor, compile_log)
+from paddle_tpu.profiler import monitor as monitor_mod
+from paddle_tpu.profiler.exposition import (metric_name,
+                                            parse_exposition_names)
+from paddle_tpu.utils import nan_inf
+
+PREFIX = "paddle_training"
+
+
+@pytest.fixture(autouse=True)
+def _clean_logs():
+    compile_log.reset()
+    nan_inf.reset_nan_stats()
+    yield
+    compile_log.reset()
+    nan_inf.reset_nan_stats()
+    assert not monitor_mod._ACTIVE, "test leaked an active monitor"
+
+
+def _make_loop(seed=0, hidden=16):
+    paddle.seed(seed)
+    net = paddle.nn.Linear(hidden, hidden)
+    opt = paddle.optimizer.AdamW(parameters=net.parameters(),
+                                 learning_rate=1e-3)
+
+    def train_step(x):
+        y = net(x)
+        loss = (y * y).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    step = paddle.jit.to_static(train_step, state_objects=[net, opt])
+    x = paddle.to_tensor(
+        np.random.RandomState(seed).rand(4, hidden).astype("f"))
+    return net, opt, step, x
+
+
+# ------------------------------------------------------------ ring/counters
+def test_step_ring_and_counters():
+    net, opt, step, x = _make_loop()
+    mon = TrainingMonitor(max_steps=4, optimizer=opt).start().watch(step)
+    try:
+        for i in range(6):
+            mon.step(step(x), tokens=10)
+    finally:
+        mon.stop()
+    assert mon.counters["steps"] == 6
+    assert mon.counters["tokens"] == 60
+    recs = mon.records()
+    assert len(recs) == 4                       # bounded ring
+    assert recs[0]["step"] == 2 and recs[-1]["step"] == 5
+    assert all(r["loss"] is not None for r in recs)
+    assert all(r["dur_ms"] > 0 for r in recs)   # steps 2.. have latency
+    assert recs[0]["lr"] == pytest.approx(1e-3)
+    snap = mon.snapshot()
+    assert snap["ring_steps"] == 4
+    # retraces == 1: AdamW creates its moments during step 1, so the
+    # donated state pytree grows and jax recompiles underneath the
+    # guard entry on step 2 — a REAL compile the monitor must count
+    # (logged as a jax_internal retrace; steps 3+ are steady-state)
+    assert snap["traces"] == 1 and snap["retraces"] == 1
+    assert not any(e.get("detail", {}).get("jax_internal")
+                   for e in compile_log.events()[2:])
+    assert snap["step_latency_p50_ms"] > 0
+    assert snap["last_loss"] == recs[-1]["loss"]
+    assert snap["watched_programs"] == 1
+    assert snap["watched_fallbacks"] == 0
+
+
+def test_retrace_and_fallback_deltas_land_on_the_step():
+    net, opt, step, x = _make_loop()
+    mon = TrainingMonitor(optimizer=opt).start()
+    try:
+        mon.step(step(x))
+        rec1 = mon.records()[-1]
+        assert rec1["compile_events"] == {"trace": 1}
+        assert rec1["retraced"] is True
+        # shape change -> guard miss -> retrace, attributed to ITS step
+        x2 = paddle.to_tensor(np.random.RandomState(1).rand(8, 16)
+                              .astype("f"))
+        mon.step(step(x2))
+        rec2 = mon.records()[-1]
+        assert rec2["compile_events"] == {"retrace": 1}
+        # warm step: no compile events on the record at all
+        mon.step(step(x2))
+        assert "compile_events" not in mon.records()[-1]
+        assert mon.counters["traces"] == 1
+        assert mon.counters["retraces"] == 1
+    finally:
+        mon.stop()
+
+
+def test_eager_fallback_counted():
+    @paddle.jit.to_static
+    def bad(x):
+        return x + 1 if float(x.sum()) > 0 else x - 1
+
+    mon = TrainingMonitor().start()
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            bad(paddle.to_tensor(np.ones((2, 2), np.float32)))
+        mon.step()
+    finally:
+        mon.stop()
+    assert mon.counters["eager_fallbacks"] >= 1
+    kinds = {e["kind"] for e in compile_log.events()}
+    assert "eager_fallback" in kinds
+
+
+def test_nan_hook_hits_recorded():
+    mon = TrainingMonitor().start()
+    try:
+        nan_inf.enable_check_nan_inf(True)
+        try:
+            t = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+            with pytest.raises(FloatingPointError):
+                _ = t / paddle.to_tensor(np.zeros(2, np.float32))
+        finally:
+            nan_inf.enable_check_nan_inf(False)
+        rec = mon.step()
+    finally:
+        mon.stop()
+    assert mon.counters["nan_hits"] == 1
+    assert mon.counters["nan_checks"] >= 1
+    assert rec["nan_hits"] == 1
+
+
+# ------------------------------------------------------------- compile log
+def test_compile_log_events_and_report_surface():
+    to_static_report = paddle.jit.to_static_report
+    net, opt, step, x = _make_loop()
+    step(x)
+    evs = compile_log.events()
+    assert evs and evs[0]["kind"] == "trace"
+    assert evs[0]["duration_ms"] > 0
+    assert evs[0]["detail"]["programs"] == 1
+    rep = to_static_report()
+    assert rep["compile_counters"].get("trace") == 1
+    assert rep["compile_events"][0]["name"] == evs[0]["name"]
+    assert rep["compile_seconds"]["trace"] > 0
+    assert rep["compile_events_dropped"] == 0
+
+
+def test_compile_log_ring_bound_keeps_exact_counters(monkeypatch):
+    compile_log.reset()
+    monkeypatch.setattr(compile_log, "_events",
+                        type(compile_log._events)(maxlen=8))
+    for i in range(20):
+        compile_log.log_event("trace", name=f"f{i}", duration_s=0.001)
+    assert len(compile_log.events()) == 8
+    assert compile_log.counters()["trace"] == 20      # exact rate signal
+    assert compile_log.dropped() == 12
+    assert compile_log.duration_totals_s()["trace"] == pytest.approx(0.02)
+
+
+def test_program_cache_compile_events_and_cost_table():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.serving.program_cache import ProgramCache
+
+    cache = ProgramCache().register_family("decode", lambda: 4)
+    prog = cache.get(("decode", 8), lambda: jax.jit(lambda a: a * 2.0))
+    assert cache.compile_times_ms()[("decode", 8)] is None   # not launched
+    out = prog(jnp.ones((8, 4), jnp.float32))
+    assert float(out[0, 0]) == 2.0
+    t = cache.compile_times_ms()[("decode", 8)]
+    assert t is not None and t > 0
+    evs = [e for e in compile_log.events() if e["kind"] == "program_compile"]
+    assert len(evs) == 1 and evs[0]["name"] == "decode"
+    # steady-state launches don't log again
+    prog(jnp.ones((8, 4), jnp.float32))
+    assert len([e for e in compile_log.events()
+                if e["kind"] == "program_compile"]) == 1
+    # cost table re-lowers from recorded avals
+    table = cache.cost_table()
+    rec = table[("decode", 8)]
+    assert rec["flops"] > 0 and rec["io_bytes"] == 2 * 8 * 4 * 4
+    fam = cache.family_costs()["decode"]
+    assert fam["programs"] == 1 and fam["accounted"] == 1
+    assert fam["max_peak_bytes"] >= rec["peak_bytes"]
+
+
+# --------------------------------------------------------- profiler spans
+def test_optimizer_and_guard_spans_on_host_timeline():
+    net, opt, step, x = _make_loop()
+    events_box = []
+    p = Profiler(targets=[prof_mod.ProfilerTarget.CPU],
+                 scheduler=lambda s: ProfilerState.RECORD,
+                 on_trace_ready=lambda pr: events_box.append(pr.events))
+    p.start()
+    step(x)             # traces under the profiler: guard + optimizer spans
+    # eager optimizer step too (the fused/bucketed path rides the same
+    # RecordEvent — tests/test_fused_optimizer covers fused numerics)
+    y = net(paddle.to_tensor(np.ones((2, 16), np.float32)))
+    (y * y).mean().backward()
+    opt.step()
+    opt.clear_grad()
+    p.stop()
+    names = [e["name"] for e in events_box[-1]]
+    assert "to_static.guard" in names
+    assert "optimizer.step" in names
+    opt_ev = next(e for e in events_box[-1] if e["name"] == "optimizer.step")
+    assert opt_ev["type"] == "Optimization"
+
+
+# ------------------------------------------------- booby trap / identity
+def test_monitor_off_training_is_monitor_free(monkeypatch):
+    """With no monitor attached, a full train loop (trace + warm steps +
+    eager optimizer) must never construct a record, fetch a scalar, or
+    build a grad norm — every entry point is booby-trapped."""
+    def boom(*a, **k):
+        raise AssertionError("monitor machinery touched on the off path")
+
+    monkeypatch.setattr(TrainingMonitor, "note", boom)
+    monkeypatch.setattr(TrainingMonitor, "step", boom)
+    monkeypatch.setattr(monitor_mod, "_fetch_scalar", boom)
+    monkeypatch.setattr(monitor_mod, "grad_global_norm", boom)
+    # Optimizer.step binds grad_global_norm by name at import time
+    import paddle_tpu.optimizer.optimizer as opt_mod
+    monkeypatch.setattr(opt_mod, "grad_global_norm", boom)
+    net, opt, step, x = _make_loop()
+    for _ in range(3):
+        step(x)
+    y = net(paddle.to_tensor(np.ones((2, 16), np.float32)))
+    (y * y).mean().backward()
+    opt.step()
+    opt.clear_grad()
+
+
+def test_trajectory_bit_identical_monitor_on_vs_off():
+    def run(monitored):
+        net, opt, step, x = _make_loop(seed=7)
+        mon = None
+        if monitored:
+            mon = TrainingMonitor(optimizer=opt, detailed=True,
+                                  track_grad_norm=True).start().watch(step)
+        try:
+            for _ in range(5):
+                loss = step(x)
+                if mon is not None:
+                    mon.step(loss)
+        finally:
+            if mon is not None:
+                mon.stop()
+        return {k: np.asarray(t._data).copy()
+                for k, t in net.state_dict().items()}
+
+    off = run(False)
+    on = run(True)
+    for k in off:
+        assert np.array_equal(off[k], on[k]), k
+
+
+# -------------------------------------------------------------- exposition
+def _expected_names(snap: dict) -> set:
+    out = set()
+    for k, v in snap.items():
+        if v is None:
+            continue
+        name = metric_name(PREFIX, k)
+        if isinstance(v, str):
+            name += "_info"
+        out.add(name)
+    return out
+
+
+def test_exposition_drift_both_directions():
+    """Every snapshot key appears in the scrape and every scrape metric
+    maps back to a snapshot key — the serving drift contract, over the
+    TRAINING metric names."""
+    net, opt, step, x = _make_loop()
+    mon = TrainingMonitor(optimizer=opt).start().watch(step)
+    try:
+        for _ in range(3):
+            mon.step(step(x), tokens=8)
+    finally:
+        mon.stop()
+    snap = mon.snapshot()
+    text = mon.prometheus_text()
+    parsed = parse_exposition_names(text)
+    expected = _expected_names(snap)
+    assert expected - parsed == set(), "snapshot keys missing from scrape"
+    assert parsed - expected == set(), "scrape names with no snapshot key"
+    # counters typed as counters, gauges as gauges
+    assert "# TYPE paddle_training_steps counter" in text
+    assert "# TYPE paddle_training_step_latency_p50_ms gauge" in text
+    # labeled variant parses too
+    labeled = mon.prometheus_text(labels={"job": "train-0"})
+    assert 'job="train-0"' in labeled
+    assert parse_exposition_names(labeled) == parsed
+
+
+def test_register_exposes_through_profiler_counters():
+    mon = TrainingMonitor(name="train_test").register()
+    try:
+        mon.step()
+        assert prof_mod.counters()["train_test"]["steps"] == 1
+    finally:
+        mon.unregister()
+    assert "train_test" not in prof_mod.counters()
+
+
+# ------------------------------------------------------------------ export
+def test_export_merged_chrome_doc(tmp_path):
+    net, opt, step, x = _make_loop()
+    mon = TrainingMonitor(optimizer=opt, detailed=True).start().watch(step)
+    p = Profiler(targets=[prof_mod.ProfilerTarget.CPU],
+                 scheduler=lambda s: ProfilerState.RECORD,
+                 on_trace_ready=lambda pr: None)
+    p.start()
+    try:
+        for _ in range(3):
+            with RecordEvent("data_loading"):
+                pass
+            mon.step(step(x), tokens=8)
+    finally:
+        mon.stop()
+    path = tmp_path / "train_trace.json"
+    doc = mon.export(str(path))
+    p.stop()
+    on_disk = json.loads(path.read_text())
+    assert on_disk["trainingMonitor"]["snapshot"]["steps"] == 3
+    events = doc["traceEvents"]
+    step_spans = [e for e in events if e.get("name") == "train_step"]
+    assert len(step_spans) == 2            # steps 2..3 carry a duration
+    host = [e for e in events if e.get("name") == "data_loading"]
+    assert host, "profiler RecordEvent spans merged into the export"
+    # shared clock: host spans and step spans interleave on one timeline
+    ts = [e["ts"] for e in events if e.get("ph") == "X"]
+    assert min(ts) > 0 and max(ts) - min(ts) < 60e6   # same epoch, < 60 s
+    side = doc["trainingMonitor"]
+    assert side["compile_counters"]["trace"] == 1
+    assert [r["step"] for r in side["records"]] == [0, 1, 2]
+
+
+# ------------------------------------------------------------- overhead
+@pytest.mark.slow
+def test_monitor_overhead_under_5_percent():
+    """ISSUE 11 acceptance: monitor-on per-step cost < 5% of the step.
+    Paired same-iteration off/on timing, medians over 200 rounds (the
+    2-core CPU box is noisy; a paired median is the PR-10 soak's
+    methodology), best of 3 attempts."""
+    import statistics
+
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(256, 256), paddle.nn.ReLU(),
+                               paddle.nn.Linear(256, 64))
+    opt = paddle.optimizer.AdamW(parameters=net.parameters(),
+                                 learning_rate=1e-3)
+
+    def train_step(x):
+        y = net(x)
+        loss = (y * y).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    step = paddle.jit.to_static(train_step, state_objects=[net, opt])
+    x = paddle.to_tensor(np.random.RandomState(0).rand(32, 256).astype("f"))
+    mon = TrainingMonitor(optimizer=opt)
+
+    def one_off():
+        float(np.asarray(step(x)._data))    # the loop's own fetch-sync
+
+    def one_on():
+        mon.step(step(x))                   # monitor fetch IS the sync
+
+    for f in (one_off, one_on):
+        for _ in range(20):
+            f()
+    best = None
+    mon.start()
+    try:
+        for _attempt in range(3):
+            offs, ons = [], []
+            for _ in range(200):
+                t0 = time.perf_counter_ns()
+                one_off()
+                t1 = time.perf_counter_ns()
+                one_on()
+                t2 = time.perf_counter_ns()
+                offs.append(t1 - t0)
+                ons.append(t2 - t1)
+            ratio = statistics.median(ons) / statistics.median(offs)
+            best = ratio if best is None else min(best, ratio)
+            if best < 1.05:
+                break
+    finally:
+        mon.stop()
+    assert best < 1.05, f"monitor overhead {best:.3f}x"
+
+
+def test_monitor_deltas_survive_shared_log_reset():
+    """to_static_report(reset=True) / reset_nan_stats() clear the SHARED
+    sources mid-run: the monitor must re-baseline (count from zero), not
+    record negative per-step deltas — its counters are Prometheus
+    counters and must never go backwards."""
+    compile_log.reset()
+    with TrainingMonitor(max_steps=8) as mon:
+        compile_log.log_event("trace", name="f")
+        compile_log.log_event("retrace", name="f")
+        mon.step(1.0)
+        assert mon.counters["traces"] == 1
+        assert mon.counters["retraces"] == 1
+        # mid-run reset of both shared sources
+        paddle.jit.to_static_report(reset=True)
+        nan_inf.reset_nan_stats()
+        compile_log.log_event("trace", name="g")   # 1 event AFTER reset
+        rec = mon.step(0.5)
+        assert rec["compile_events"] == {"trace": 1}
+        assert mon.counters["traces"] == 2         # 1 + 1, not 1 - old
+        assert mon.counters["retraces"] == 1       # unchanged, not negative
+        assert all(v >= 0 for v in mon.counters.values())

@@ -153,8 +153,50 @@ step ladder 1800 tools/chip_ladder.py
 
 # 3. the real benchmark numbers. bench.py never exits non-zero by
 #    design, but timeout(1) itself exits 124/143 on a wedge — count
-#    that; bench_ops failures are recorded like validation steps.
-timeout -s TERM -k 60 900 python bench.py || FAILED="$FAILED bench"
+#    that; bench_ops failures are recorded like validation steps. The
+#    JSON line is kept for the COST_MFU comparison below.
+if timeout -s TERM -k 60 900 python bench.py > /tmp/bench_fused_line.json
+then :; else FAILED="$FAILED bench"; fi
+cat /tmp/bench_fused_line.json
+
+# 3a. COST_MFU (ISSUE 11, chip-blind staging): cost-analysis MFU vs the
+#     hand-formula MFU for the flagship config, from the bench line's
+#     analytic_flops (XLA cost_analysis of the compiled step). Reading
+#     rule (profiler/cost.py): Pallas custom calls count ZERO flops, so
+#     under pallas_flash the analytic number undercounts by about
+#     attn_flops_share; under xla_sdpa the two must agree within 5%.
+#     Stdlib-only (no second TPU claim) — records, never gates.
+cat > /tmp/chip_cost_mfu.py <<'EOF'
+import json, sys
+rec = None
+for line in open("/tmp/bench_fused_line.json"):
+    try:
+        d = json.loads(line)
+    except ValueError:
+        continue
+    if isinstance(d, dict) and "metric" in d and "error" not in d:
+        rec = d
+if rec is None:
+    print("COST_MFU_SKIP: no bench record"); sys.exit(0)
+measured, analytic = rec.get("value"), rec.get("analytic_mfu")
+share = rec.get("attn_flops_share", 0.0)
+if not measured or analytic is None:
+    print(f"COST_MFU_SKIP: analytic fields null ({rec.get('attention')})")
+    sys.exit(0)
+ratio = analytic / measured
+expect = 1.0 - share if rec.get("attention") == "pallas_flash" else 1.0
+print(f"COST_MFU measured={measured} analytic={analytic} "
+      f"ratio={ratio:.4f} expected~{expect:.4f} "
+      f"attention={rec.get('attention')} "
+      f"peak_hbm_gb={(rec.get('peak_hbm_bytes') or 0) / 1e9:.2f}")
+print("COST_MFU_OK" if abs(ratio - expect) < 0.05
+      else f"COST_MFU_DRIFT: |{ratio:.4f} - {expect:.4f}| >= 0.05")
+EOF
+# CPU-pinned + timeouted like every step: a bare python would claim
+# the (possibly leaked) TPU grant via sitecustomize and block forever
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  timeout -s TERM -k 10 120 python /tmp/chip_cost_mfu.py \
+  || FAILED="$FAILED cost_mfu"
 step bench_ops 2700 bench_ops.py --write-md
 
 # 3b. flagship A/B re-run (ISSUE 9): the first bench line leads with
