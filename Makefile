@@ -3,7 +3,7 @@
 # otherwise block every interpreter on the single TPU grant).
 TEST_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: test test-fast bench soak soak-fleet lint train-report
+.PHONY: test test-fast bench soak soak-fleet lint train-report dist-report
 
 # tpu-lint: static trace-safety analysis (ANALYSIS.md). AST-only — no
 # jax import, no TPU grant, ~1 s; gates `make test`.
@@ -40,6 +40,14 @@ soak:
 # reporter — OBSERVABILITY.md's end-to-end example.
 train-report:
 	$(TEST_ENV) python tools/train_report.py --demo profiler_log/train_trace.json
+
+# Distributed-observability smoke (ISSUE 12): run a tiny threaded ZB
+# pipeline, export one chrome-trace per rank (with a live comm_report
+# riding along), then merge them with the stdlib-only reporter — the
+# cross-process layout exercised in-process.
+dist-report:
+	$(TEST_ENV) python tools/dist_report.py --demo profiler_log \
+	  --out profiler_log/dist_merged.json
 
 # Multi-replica fleet chaos soak (ISSUE 7): seeded kill + stall of
 # replicas mid-stream; zero-loss / bit-identity / routing criteria.

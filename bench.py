@@ -204,6 +204,19 @@ def _run_inner(paddle, LlamaConfig, LlamaForCausalLM, jax, use_pallas, shrink,
     except Exception:
         pass
 
+    # Collective-traffic accounting of the same compiled step (ISSUE
+    # 12): payload bytes the step moves per mesh axis (zero / {} on a
+    # single chip — the honest answer). Same contract as the cost
+    # fields: AFTER timing, null on any failure, fallback chain and
+    # exit-0 untouched.
+    comm_bytes = comm_bytes_per_axis = None
+    try:
+        crep = step.comm_report()
+        comm_bytes = int(crep["payload_bytes"])
+        comm_bytes_per_axis = dict(crep["bytes_per_axis"])
+    except Exception:
+        pass
+
     return {
         "metric": "llama_pretrain_mfu",
         "value": round(mfu, 4),
@@ -222,6 +235,8 @@ def _run_inner(paddle, LlamaConfig, LlamaForCausalLM, jax, use_pallas, shrink,
         "analytic_flops": analytic_flops,
         "peak_hbm_bytes": peak_hbm_bytes,
         "analytic_mfu": analytic_mfu,
+        "comm_bytes": comm_bytes,
+        "comm_bytes_per_axis": comm_bytes_per_axis,
         "config": {"hidden": cfg.hidden_size, "layers": cfg.num_hidden_layers,
                    "batch": batch, "seq": seq},
     }

@@ -23,7 +23,7 @@ __all__ = [
     "SIDE_EFFECT_BUILTINS", "MUTATOR_METHODS", "side_effect_calls",
     "record", "drain", "snapshot", "reset", "set_context", "clear_context",
     "record_loop_side_effect", "record_loop_mutation",
-    "record_out_of_trace_collective",
+    "record_out_of_trace_collective", "record_spmd_rule_failure",
 ]
 
 # Pure-output builtins that are invisible to the mutation checks but run
@@ -158,6 +158,23 @@ def record_loop_mutation(rel_line, kind):
                  "not per iteration)"),
         hint="carry the state through the loop (reassign the name) or "
              "accept the eager fallback"))
+
+
+def record_spmd_rule_failure(op_name, error, traceback_text=None):
+    """An SPMD propagation rule raised (FLAGS_spmd_debug routing, ISSUE
+    12): the failure used to be a bare print() — machine-readable here
+    so `to_static_report()["purity_diagnostics"]` carries it. Advisory
+    by contract: the rule never breaks compute (GSPMD owns
+    correctness), this records WHICH rule is broken."""
+    msg = f"SPMD rule '{op_name}' failed: {error}"
+    if traceback_text:
+        msg += "\n" + str(traceback_text).rstrip()
+    record(Diagnostic(
+        rule="A5", slug="spmd-rule", severity=Severity.WARNING,
+        path="<runtime>", line=0, source="runtime", message=msg,
+        hint="the op fell back to GSPMD whole-program propagation; fix "
+             "or unregister the rule (rule_stats()['last_error'] keeps "
+             "the latest repr per op)"))
 
 
 def record_out_of_trace_collective(name, nranks, axis):

@@ -27,7 +27,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .spmd_rules import _RULES, infer_spmd
 
 __all__ = ["spmd_propagation", "propagation_mesh", "maybe_constrain",
-           "spec_of", "rule_stats", "reset_rule_stats"]
+           "spec_of", "rule_stats", "reset_rule_stats",
+           "rules_prometheus_text"]
 
 _STATE = {"mesh": None}
 
@@ -77,6 +78,18 @@ def reset_rule_stats():
         d.clear()
 
 
+def rules_prometheus_text(*, prefix: str = "paddle_spmd", labels=None,
+                          emit_type: bool = True) -> str:
+    """rule_stats() through the SHARED exposition renderer (ISSUE 12):
+    the hits/errors/skips dicts render one labelled line per op, so a
+    broken or never-matching rule is a scrape away; drift test asserts
+    the name bijection both ways like every other exposition."""
+    from ...profiler.exposition import prometheus_lines
+    lines = prometheus_lines(rule_stats(), prefix=prefix, labels=labels,
+                             emit_type=emit_type)
+    return "\n".join(lines) + "\n" if lines else ""
+
+
 def _bump(kind, name):
     _STATS[kind][name] = _STATS[kind].get(name, 0) + 1
 
@@ -95,6 +108,13 @@ def spmd_propagation(mesh):
     jmesh = getattr(mesh, "jax_mesh", mesh)
     if not isinstance(jmesh, Mesh):
         raise TypeError(f"spmd_propagation needs a Mesh, got {type(mesh)}")
+    if not _STATE.get("registered"):
+        # join Profiler.summary() like the comm counters (ISSUE 12) —
+        # registered on first activation, so rule-less processes never
+        # grow a provider
+        from ... import profiler as _profiler
+        _profiler.register_counter_provider("spmd_rules", rule_stats)
+        _STATE["registered"] = True
     prev = _STATE["mesh"]
     _STATE["mesh"] = jmesh
     try:
@@ -178,6 +198,12 @@ def maybe_constrain(name, in_tensors, out_tensors, kwargs):
         _bump("errors", name)
         _STATS["last_error"][name] = repr(e)
         if _flags("spmd_debug"):
+            # routed through the shared Diagnostics path (ISSUE 12):
+            # the failure lands machine-readable in
+            # to_static_report()["purity_diagnostics"] / FALLBACKS.md
+            # instead of being lost in stdout; counting stays
+            # unconditional as before
             import traceback
-            print(f"[spmd_debug] rule '{name}' failed: {e}")
-            traceback.print_exc()
+            from ...analysis import purity as _purity
+            _purity.record_spmd_rule_failure(
+                name, e, traceback.format_exc())

@@ -22,7 +22,98 @@ __all__ = ["Group", "new_group", "get_group", "all_reduce", "all_gather",
            "all_gather_object", "all_to_all", "all_to_all_single", "broadcast",
            "reduce", "scatter", "reduce_scatter", "send", "recv", "barrier",
            "ReduceOp", "is_available", "get_backend", "destroy_process_group",
-           "stream", "Task"]
+           "stream", "Task", "comm_stats", "reset_comm_stats",
+           "set_comm_stats_enabled", "comm_prometheus_text"]
+
+
+# ---------------------------------------------------------------------------
+# Runtime collective counters (ISSUE 12). One flat dict bump per
+# out-of-trace API call: `{prim}_calls`, `{prim}_bytes` (payload from the
+# arguments' shape x dtype — NEVER from buffer contents, so tracers count
+# too and the numeric path is untouched by construction; the booby-trap
+# test pins it), `{prim}_group_size` (largest group seen, a gauge).
+# Complements the compile-time IR walk (`profiler.comm`): that accounts
+# what a COMPILED program moves, this counts what the eager/host API was
+# ASKED to move — including the TCPStore mailbox send/recv path, which
+# never appears in any HLO.
+# ---------------------------------------------------------------------------
+_COMM_STATS: dict = {}
+_COMM_ENABLED = [True]
+_COMM_REGISTERED = [False]
+
+
+def _tensor_payload_bytes(*tensors) -> int:
+    """Payload bytes from shapes/dtypes only (works on tracers; never
+    touches data)."""
+    import math
+    total = 0
+    for t in tensors:
+        if t is None:
+            continue
+        d = getattr(t, "_data", t)
+        shape = getattr(d, "shape", None)
+        dtype = getattr(d, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        total += math.prod(shape) * jnp.dtype(dtype).itemsize
+    return int(total)
+
+
+def _bump_comm(prim: str, group, *tensors, nbytes=None):
+    if not _COMM_ENABLED[0]:
+        return
+    if nbytes is None:
+        nbytes = _tensor_payload_bytes(*tensors)
+    g = group or _default_group()
+    s = _COMM_STATS
+    s[f"{prim}_calls"] = s.get(f"{prim}_calls", 0) + 1
+    s[f"{prim}_bytes"] = s.get(f"{prim}_bytes", 0) + int(nbytes)
+    s[f"{prim}_group_size"] = max(s.get(f"{prim}_group_size", 0), g.nranks)
+    if not _COMM_REGISTERED[0]:
+        # join Profiler.summary() the ServingMetrics way — lazily, so a
+        # process that never issues a collective never grows a provider
+        from .. import profiler as _profiler
+        _profiler.register_counter_provider("distributed_comm", comm_stats)
+        _COMM_REGISTERED[0] = True
+
+
+def comm_stats() -> dict:
+    """Flat snapshot of the runtime collective counters (copy). Keys
+    exist only for primitives actually called — the exposition registry
+    contract (no hand-maintained name lists) surfaces new primitives
+    automatically."""
+    return dict(_COMM_STATS)
+
+
+def reset_comm_stats():
+    _COMM_STATS.clear()
+
+
+def set_comm_stats_enabled(enabled: bool) -> bool:
+    """Toggle the counters (default on — the cost is one dict bump per
+    call). Returns the previous setting. With counting off the recorder
+    is never invoked at all (booby-trap test), and on-vs-off training/
+    serving results are bit-identical either way: the counters read
+    only shapes and dtypes."""
+    prev = _COMM_ENABLED[0]
+    _COMM_ENABLED[0] = bool(enabled)
+    return prev
+
+
+def comm_prometheus_text(*, prefix: str = "paddle_comm",
+                         labels=None, emit_type: bool = True) -> str:
+    """comm_stats() through the SHARED exposition renderer
+    (`profiler.exposition`): `*_calls` / `*_bytes` typed counter,
+    `*_group_size` gauge; the drift test asserts the name bijection
+    both ways like the serving/training scrapes."""
+    from ..profiler.exposition import prometheus_lines
+    snap = comm_stats()
+    counter_keys = {k for k in snap
+                    if k.endswith("_calls") or k.endswith("_bytes")}
+    lines = prometheus_lines(snap, counter_keys=counter_keys,
+                             prefix=prefix, labels=labels,
+                             emit_type=emit_type)
+    return "\n".join(lines) + "\n" if lines else ""
 
 
 class Task:
@@ -189,6 +280,7 @@ def _require_trace_or_world1(name, group):
 
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """Parity: paddle.distributed.all_reduce (in-place on tensor)."""
+    _bump_comm("all_reduce", group, tensor)
     axis = _resolve_axis(group)
     if axis and _axis_in_trace(axis):
         fns = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
@@ -206,6 +298,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
 
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    _bump_comm("all_gather", group, tensor)
     ax = _resolve_axis(group)
     if ax and _axis_in_trace(ax):
         out = apply_op("all_gather",
@@ -223,12 +316,14 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
 
 
 def all_gather_object(object_list, obj, group=None):
+    _bump_comm("all_gather_object", group, nbytes=0)
     object_list.clear()
     object_list.append(obj)
     return object_list
 
 
 def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    _bump_comm("all_to_all", group, *in_tensor_list)
     ax = _resolve_axis(group)
     if ax and _axis_in_trace(ax):
         from ..ops.manipulation import stack, unbind
@@ -249,6 +344,7 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
 
 def all_to_all_single(out_tensor, in_tensor, in_split_sizes=None,
                       out_split_sizes=None, group=None, sync_op=True):
+    _bump_comm("all_to_all_single", group, in_tensor)
     ax = _resolve_axis(group)
     if ax and _axis_in_trace(ax):
         n = (group or _default_group()).nranks
@@ -265,6 +361,7 @@ def all_to_all_single(out_tensor, in_tensor, in_split_sizes=None,
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
+    _bump_comm("broadcast", group, tensor)
     # In-trace SPMD: all ranks compute identically; broadcast is a no-op on
     # replicated values. Cross-process eager: handled by checkpoint/init sync.
     return _task(sync_op, tensor)
@@ -275,10 +372,12 @@ def broadcast_object_list(object_list, src=0, group=None):
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # counted by the all_reduce it delegates to
     return all_reduce(tensor, op, group, sync_op)
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    _bump_comm("scatter", group, *(tensor_list or (tensor,)))
     ax = _resolve_axis(group)
     if ax and _axis_in_trace(ax):
         from ..ops.manipulation import stack
@@ -295,6 +394,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
 
 def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
                    sync_op=True):
+    _bump_comm("reduce_scatter", group, *tensor_list)
     ax = _resolve_axis(group)
     if ax and _axis_in_trace(ax):
         from ..ops.manipulation import stack
@@ -348,6 +448,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
     process to talk to and raise (in-graph collectives are the tool
     there)."""
     import jax
+    _bump_comm("send", group, tensor)
     if jax.process_count() <= 1:
         raise NotImplementedError(
             "send/recv needs a multi-process world (jax.process_count() "
@@ -390,6 +491,7 @@ def recv(tensor, src=0, group=None, sync_op=True):
     performs the blocking read; the mailbox key is deleted after a
     successful read so the store does not grow unboundedly."""
     import jax
+    _bump_comm("recv", group, tensor)
     if jax.process_count() <= 1:
         raise NotImplementedError(
             "send/recv needs a multi-process world (jax.process_count() "
@@ -417,6 +519,7 @@ def recv(tensor, src=0, group=None, sync_op=True):
 
 
 def barrier(group=None):
+    _bump_comm("barrier", group, nbytes=0)
     jnp.zeros(()).block_until_ready()
 
 

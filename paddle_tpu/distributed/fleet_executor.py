@@ -25,7 +25,11 @@ __all__ = ["Job", "Plan", "FleetExecutor", "build_pipeline_plan",
            "per_rank_schedule", "ThreadedFleetExecutor",
            "ThreadedZBVExecutor", "zbv_stage_of",
            "build_zbv_rank_schedules", "zb_dispatch_tax_model",
-           "choose_pipeline_schedule"]
+           "choose_pipeline_schedule", "PIPE_PID"]
+
+# chrome-trace pid for pipeline-rank tracks (serving request rows use 1,
+# training steps 2, profiler host spans os.getpid())
+PIPE_PID = 3
 
 
 class Job:
@@ -313,6 +317,8 @@ class _ThreadedPipelineBase:
         import time
 
         self.timeline = {}   # reentrant: drop any previous run's spans
+        self._key_rank = {}  # event key -> executing rank (for export)
+        self.last_makespan = None
         self.errors = []
         n = self._n_workers()
         events = {self._event_key(r, row): threading.Event()
@@ -343,6 +349,7 @@ class _ThreadedPipelineBase:
                         thunk()
                         t1 = time.perf_counter()
                     self.timeline[key] = (t0, t1)
+                    self._key_rank[key] = r
                     events[key].set()
             except BaseException as e:  # surface to the caller
                 self.errors.append(e)
@@ -365,7 +372,9 @@ class _ThreadedPipelineBase:
         if not self.timeline:
             raise RuntimeError("no jobs executed (empty schedule?)")
         spans = list(self.timeline.values())
-        return max(t1 for _, t1 in spans) - min(t0 for t0, _ in spans)
+        self.last_makespan = (max(t1 for _, t1 in spans)
+                              - min(t0 for t0, _ in spans))
+        return self.last_makespan
 
     def measured_durations(self):
         """Mean measured duration per job kind — feed these to the
@@ -380,6 +389,131 @@ class _ThreadedPipelineBase:
             if ds:
                 out[kind] = statistics.mean(ds)
         return out
+
+    # ---- timeline export (ISSUE 12) -------------------------------------
+    def chrome_events(self):
+        """The measured timeline as chrome-trace events: ONE TRACK PER
+        RANK (pid PIPE_PID, tid = rank), F/B/W job spans. Spans were
+        stamped with time.perf_counter(), which shares its monotonic
+        base with the perf_counter_ns clock `profiler.RecordEvent` and
+        the TrainingMonitor use — the export merges with every other
+        in-tree chrome trace on ONE timeline."""
+        if not self.timeline:
+            return []
+        evs = [{"name": "process_name", "ph": "M", "pid": PIPE_PID,
+                "args": {"name": "pipeline ranks"}}]
+        for r in range(self._n_workers()):
+            evs.append({"name": "thread_name", "ph": "M", "pid": PIPE_PID,
+                        "tid": r, "args": {"name": f"rank {r}"}})
+        for key, (t0, t1) in sorted(self.timeline.items(),
+                                    key=lambda kv: kv[1][0]):
+            kind, m, s = key
+            evs.append({"name": f"{kind}{m}", "ph": "X", "cat": "pipeline",
+                        "ts": t0 * 1e6, "dur": (t1 - t0) * 1e6,
+                        "pid": PIPE_PID,
+                        "tid": self._key_rank.get(key, s),
+                        "args": {"kind": kind, "micro": m, "stage": s}})
+        return evs
+
+    def bubble_report(self):
+        """Measured-vs-modeled bubble fractions for the last run():
+        measured = 1 - busy/(ranks x makespan) over the recorded spans;
+        simulated = the same ratio under the dependency model
+        (`simulate_pipeline_makespan` / `build_zbv_rank_schedules`) fed
+        the MEASURED mean durations — agreement is the evidence that
+        the model's bubble accounting describes this host (the
+        BENCH_PIPELINE methodology, now exported per run)."""
+        if not self.timeline:
+            raise RuntimeError("bubble_report() needs a completed run()")
+        spans = list(self.timeline.values())
+        makespan = max(t1 for _, t1 in spans) - min(t0 for t0, _ in spans)
+        busy = sum(t1 - t0 for t0, t1 in spans)
+        workers = self._n_workers()
+        durs = self.measured_durations()
+        counts = {}
+        for (kind, _, _) in self.timeline:
+            counts[kind] = counts.get(kind, 0) + 1
+        rep = {"workers": workers, "jobs": counts,
+               "makespan_s": makespan, "busy_s": busy,
+               "bubble_fraction": 1.0 - busy / (workers * makespan)
+               if makespan > 0 else None,
+               "measured_durations_s": durs,
+               "sim_makespan_s": None, "sim_bubble_fraction": None}
+        try:
+            sim = self._sim_makespan(durs)
+        except Exception:
+            sim = None
+        if sim:
+            sim_work = sum(counts.get(k, 0) * durs.get(k, 0.0)
+                           for k in counts)
+            rep["sim_makespan_s"] = sim
+            rep["sim_bubble_fraction"] = 1.0 - sim_work / (workers * sim)
+        return rep
+
+    def _sim_makespan(self, durs):   # pragma: no cover - subclass hook
+        raise NotImplementedError
+
+    def _schedule_name(self):        # pragma: no cover - subclass hook
+        raise NotImplementedError
+
+    def export_timeline(self, path=None, comm=None):
+        """One chrome-trace document for the last run(): per-rank job
+        tracks + the bubble digest (and an optional `comm` dict — e.g.
+        a `TracedFunction.comm_report()` — so the per-rank trace a
+        launched job writes carries its collective accounting too).
+        `rank` stamps the PROCESS rank (cross-process launches write
+        one file per process; tools/dist_report.py merges them)."""
+        import json
+        import os
+        import socket
+        from .env import get_rank
+        doc = {"displayTimeUnit": "ms",
+               "traceEvents": self.chrome_events(),
+               "rank": get_rank(),
+               # perf_counter bases are per-host: the merger uses this
+               # to FLAG cross-host merges instead of pretending one clock
+               "host": socket.gethostname(),
+               "pipeline": {"schedule": self._schedule_name(),
+                            **self.bubble_report()}}
+        if comm is not None:
+            doc["comm"] = comm
+        if path is not None:
+            os.makedirs(os.path.dirname(os.path.abspath(path)),
+                        exist_ok=True)
+            with open(path, "w") as f:
+                json.dump(doc, f)
+        return doc
+
+    def export_rank_timelines(self, log_dir=None, comm=None):
+        """One chrome-trace file PER RANK under `log_dir` (default:
+        $PADDLE_TPU_PROFILER_DIR, else ./profiler_log) — the layout a
+        cross-process launched job produces (each process exporting its
+        own view), so `make dist-report` / tools/dist_report.py merges
+        in-process and cross-process runs identically. Returns the
+        written paths."""
+        import json
+        import os
+        from .. import profiler as _profiler
+        from .env import get_rank
+        d = log_dir or _profiler.default_log_dir()
+        os.makedirs(d, exist_ok=True)
+        doc = self.export_timeline(comm=comm)
+        # global rank = process rank x local worker count + local rank:
+        # multi-process launches each exporting an n-worker view get
+        # disjoint file names instead of clobbering the overlap
+        base = int(get_rank()) * self._n_workers()
+        paths = []
+        for r in range(self._n_workers()):
+            rank_doc = dict(doc)
+            rank_doc["rank"] = base + r
+            rank_doc["traceEvents"] = [
+                e for e in doc["traceEvents"]
+                if e.get("ph") != "X" or e.get("tid") == r]
+            p = os.path.join(d, f"pipeline_rank{base + r}.json")
+            with open(p, "w") as f:
+                json.dump(rank_doc, f)
+            paths.append(p)
+        return paths
 
 
 class ThreadedFleetExecutor(_ThreadedPipelineBase):
@@ -431,6 +565,18 @@ class ThreadedFleetExecutor(_ThreadedPipelineBase):
     def _event_key(self, r, row):
         kind, m = row
         return (kind, m, r)
+
+    def _schedule_name(self):
+        return self.schedule
+
+    def _sim_makespan(self, durs):
+        # the model's non-ZB backward is the FUSED t_b + t_w; measured
+        # fused B spans already carry both, so t_w rides only under ZB
+        zb = self.schedule in ZB_SCHEDULES
+        return simulate_pipeline_makespan(
+            self.n_stages, self.n_micro, self.schedule,
+            t_f=durs["F"], t_b=durs["B"],
+            t_w=durs.get("W", 0.0) if zb else 0.0)
 
     def _prepare_job(self, r, row, ctx, wait):
         kind, m = row
@@ -519,6 +665,14 @@ class ThreadedZBVExecutor(_ThreadedPipelineBase):
     def _event_key(self, r, row):
         kind, m, c = row
         return (kind, m, zbv_stage_of(r, c, self.n_ranks))
+
+    def _schedule_name(self):
+        return "ZB-V" if self._split_w else "V-1F1B"
+
+    def _sim_makespan(self, durs):
+        return build_zbv_rank_schedules(
+            self.n_ranks, self.n_micro, t_f=durs["F"], t_b=durs["B"],
+            t_w=durs.get("W", 0.0), split_w=self._split_w)[1]
 
     def _prepare_job(self, r, row, ctx, wait):
         kind, m, c = row

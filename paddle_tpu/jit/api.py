@@ -455,20 +455,13 @@ class TracedFunction:
         except Exception:
             entry.avals = None
 
-    def cost_report(self) -> dict:
-        """Structured FLOPs / HBM-bytes / peak-memory accounting of
-        every compiled program in the guard cache (ISSUE 11), via XLA's
-        `cost_analysis()` / `memory_analysis()` (`profiler.cost` — see
-        its docstring for how to read flops/io_bytes/peak_bytes
-        honestly). Each program is re-lowered from the ShapeDtypeStructs
-        recorded at its last-COMPILED call (the steady-state program —
-        lazily created optimizer state makes the cold-start call 1 a
-        run-once program, see _CacheEntry) — no tensor data is touched,
-        and with the persistent compilation cache on the re-compile is
-        a disk hit. The re-trace runs the python function under abstract
-        values, so python-side counters (e.g. an optimizer step count)
-        advance by one: call between steps, not mid-step."""
-        from ..profiler import cost as _cost
+    def _account_programs(self, account):
+        """Shared re-lowering loop under cost_report()/comm_report():
+        re-lower every guard-cache program from the ShapeDtypeStructs
+        recorded at its last-COMPILED call and hand the Lowered to
+        `account` (which returns a dict). No tensor data is touched;
+        the live state/flags the re-trace clobbers are restored after
+        (asserted by test)."""
         programs = []
         fallbacks = 0
         for entry in self._cache.values():
@@ -491,9 +484,8 @@ class TracedFunction:
                 self._sg_flags = list(entry.sg_flags)
             try:
                 _autograd.set_grad_enabled(entry.grad_mode)
-                rec = _cost.lowered_cost(
-                    entry.jitted.lower(state_sds, arrays_sds)).to_dict()
-            except Exception as e:   # a cost report must never raise
+                rec = account(entry.jitted.lower(state_sds, arrays_sds))
+            except Exception as e:   # an accounting must never raise
                 rec = {"error": f"{type(e).__name__}: {e}"[:200]}
             finally:
                 self._sg_flags = prev_flags
@@ -510,6 +502,56 @@ class TracedFunction:
                 "num_programs": len(programs),
                 "eager_fallback_keys": fallbacks,
                 "programs": programs}
+
+    def cost_report(self) -> dict:
+        """Structured FLOPs / HBM-bytes / peak-memory accounting of
+        every compiled program in the guard cache (ISSUE 11), via XLA's
+        `cost_analysis()` / `memory_analysis()` (`profiler.cost` — see
+        its docstring for how to read flops/io_bytes/peak_bytes
+        honestly). Each program is re-lowered from the ShapeDtypeStructs
+        recorded at its last-COMPILED call (the steady-state program —
+        lazily created optimizer state makes the cold-start call 1 a
+        run-once program, see _CacheEntry) — no tensor data is touched,
+        and with the persistent compilation cache on the re-compile is
+        a disk hit. The re-trace runs the python function under abstract
+        values, so python-side counters (e.g. an optimizer step count)
+        advance by one: call between steps, not mid-step."""
+        from ..profiler import cost as _cost
+        return self._account_programs(
+            lambda lowered: _cost.lowered_cost(lowered).to_dict())
+
+    def comm_report(self, mesh=None) -> dict:
+        """Collective-traffic accounting of every compiled program in
+        the guard cache (ISSUE 12, beside cost_report): per-program op
+        counts and payload bytes per mesh axis from the post-SPMD HLO
+        (`profiler.comm` — read its docstring before quoting bytes:
+        logical payload, counted once per program, a LOWER bound under
+        manual-collective Pallas kernels). `mesh` defaults to the
+        ambient hybrid mesh (mesh_scope override, else the fleet.init
+        singleton). The top level carries the cross-program aggregate
+        (`payload_bytes` / `bytes_per_axis` / `op_counts`) so bench.py
+        and dryrun evidence lines can quote one dict. Same re-lowering
+        contract as cost_report (state restored, call between steps)."""
+        from ..profiler import comm as _comm
+        if mesh is None:
+            mesh = _comm._default_mesh()
+        rep = self._account_programs(
+            lambda lowered: _comm.lowered_comm(lowered, mesh=mesh).to_dict())
+        total = 0
+        per_axis: Dict[str, int] = {}
+        counts: Dict[str, int] = {}
+        for prog in rep["programs"]:
+            if "error" in prog:
+                continue
+            total += prog.get("payload_bytes", 0)
+            for ax, b in (prog.get("bytes_per_axis") or {}).items():
+                per_axis[ax] = per_axis.get(ax, 0) + b
+            for k, n in (prog.get("op_counts") or {}).items():
+                counts[k] = counts.get(k, 0) + n
+        rep["payload_bytes"] = total
+        rep["bytes_per_axis"] = per_axis
+        rep["op_counts"] = counts
+        return rep
 
     def _track_value(self, key, name, v):
         """One signature entry for a guarded value (closure cell or

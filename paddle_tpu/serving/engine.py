@@ -698,6 +698,13 @@ class ServingEngine:
         families through the unified ProgramCache."""
         return self.programs.counts()
 
+    def comm_table(self) -> Dict[tuple, Optional[dict]]:
+        """Per-program collective-traffic accounting (ISSUE 12), axis-
+        attributed over THIS engine's mesh — the TP row-parallel psum
+        on 'model' shows up on the decode rows. Compile-time-only cost,
+        like cost_table()."""
+        return self.programs.comm_table(mesh=self.mesh)
+
     def max_program_count(self, family: Optional[str] = None) -> int:
         """The bucket-grid bound the recompile counter can never exceed
         — one family's grid, or (default) the sum over all families.

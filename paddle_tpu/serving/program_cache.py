@@ -49,7 +49,8 @@ class _TrackedProgram:
     later cost accounting. Transparent to call sites — engines only
     ever `prog(*args)`."""
 
-    __slots__ = ("fn", "key", "first_call_ms", "arg_avals", "_cost")
+    __slots__ = ("fn", "key", "first_call_ms", "arg_avals", "_cost",
+                 "_comm")
 
     def __init__(self, fn, key):
         self.fn = fn
@@ -57,6 +58,7 @@ class _TrackedProgram:
         self.first_call_ms = None
         self.arg_avals = None
         self._cost = None
+        self._comm = {}
 
     def __call__(self, *args):
         if self.first_call_ms is None:
@@ -95,6 +97,35 @@ class _TrackedProgram:
             return rec
         rec["compile_ms"] = self.first_call_ms
         self._cost = rec
+        return rec
+
+    def comm_report(self, mesh=None) -> Optional[dict]:
+        """Collective-traffic accounting of this program (ISSUE 12):
+        op counts + payload bytes per mesh axis from the compiled HLO
+        (`profiler.comm`). A meshless call resolves the ambient hybrid
+        mesh FIRST — `lowered_comm` would fall back to it anyway, so
+        resolving up front keeps the cache key (the mesh-axes
+        signature) matched to the attribution actually performed; with
+        no mesh anywhere, ops stay unattributed under the None key."""
+        from ..profiler import comm as _comm
+        if mesh is None:
+            mesh = _comm._default_mesh()
+        try:
+            axes = tuple(getattr(mesh, "jax_mesh", mesh).axis_names) \
+                if mesh is not None else None
+        except Exception:
+            axes = None
+        if axes in self._comm:
+            return self._comm[axes]
+        if self.arg_avals is None or not hasattr(self.fn, "lower"):
+            return None
+        try:
+            rec = _comm.lowered_comm(
+                self.fn.lower(*self.arg_avals), mesh=mesh).to_dict()
+        except Exception as e:   # accounting must never break serving
+            # transient failures are NOT cached — the next call retries
+            return {"error": f"{type(e).__name__}: {e}"[:200]}
+        self._comm[axes] = rec
         return rec
 
 
@@ -181,6 +212,16 @@ class ProgramCache:
         metrics. Lazy: each program's accounting is computed once, on
         the first cost_table() call after its first launch."""
         return {k: p.cost_report() for k, p in self._programs.items()}
+
+    def comm_table(self, mesh=None) -> Dict[tuple, Optional[dict]]:
+        """{key: collective-traffic dict} over every launched program
+        (ISSUE 12) — op counts and payload bytes per mesh axis, so
+        "which bucketed program moves how much over 'model'" is
+        answerable from metrics (the TP row-parallel psum shows up on
+        the decode family's rows). Pass the engine's mesh for axis
+        attribution; `ServingEngine.comm_table()` does."""
+        return {k: p.comm_report(mesh=mesh)
+                for k, p in self._programs.items()}
 
     def family_costs(self) -> Dict[str, dict]:
         """Per-family aggregate of cost_table(): program count, summed

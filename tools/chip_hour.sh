@@ -142,13 +142,20 @@ print("FUSED_OPT_CHIP_OK")
 EOF
 step fused_opt 900 /tmp/chip_fused_opt.py
 
-# 2b. numeric parity on chip (kernels execute AND match XLA references)
+# 2b. COMM ladder (ISSUE 12): device_time a psum/all-gather ladder over
+#     the real mesh and report achieved GB/s against the bytes
+#     profiler/comm.py accounts for the SAME compiled programs
+#     (accounting-vs-hand-computed equality hard-asserts ON_TPU with
+#     >1 device; a single-chip grant reports the honest 0-byte note).
+step comm 900 tools/chip_comm.py
+
+# 2c. numeric parity on chip (kernels execute AND match XLA references)
 step parity 900 tools/chip_parity.py
 
-# 2c. serving path: compiled decode loop vs eager + int8 parity
+# 2d. serving path: compiled decode loop vs eager + int8 parity
 step serving 1200 tools/chip_serving.py
 
-# 2d. BASELINE config ladder: ResNet/ERNIE/DiT/Qwen2-MoE train steps
+# 2e. BASELINE config ladder: ResNet/ERNIE/DiT/Qwen2-MoE train steps
 step ladder 1800 tools/chip_ladder.py
 
 # 3. the real benchmark numbers. bench.py never exits non-zero by
