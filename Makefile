@@ -12,10 +12,12 @@ lint:
 
 test: lint
 	$(TEST_ENV) python -m pytest tests/ -x -q
-	# slow-marked TP serving identity variants (pytest.ini's addopts
-	# deselect them; the explicit -m opts back in — tier-1 stays lean,
-	# the full gate still proves int8/wq identity under TP)
-	$(TEST_ENV) python -m pytest tests/test_serving_tp.py -m slow -q
+	# slow-marked TP + multi-decode serving identity variants
+	# (pytest.ini's addopts deselect them; the explicit -m opts back
+	# in — tier-1 stays lean, the full gate still proves int8/wq
+	# identity under TP and int8/snapshot identity under decode_steps)
+	$(TEST_ENV) python -m pytest tests/test_serving_tp.py \
+		tests/test_serving_multi.py -m slow -q
 
 test-fast: lint
 	$(TEST_ENV) python -m pytest tests/ -x -q -m "not slow"

@@ -423,6 +423,130 @@ def bench_paged_decode_tp(dev, quick):
             "value": per_chip, "device": dev})
 
 
+def bench_multi_decode(dev, quick):
+    """Multi-step device-side decode (ISSUE 13): K decode iterations of
+    a small Llama inside ONE compiled launch (`forward_paged_decode_multi`
+    — in-graph sampling, per-step paged cache writes through the scan
+    carry) vs K single-step launches. Rows per K in {1, 4, 8, 16}:
+    wall ms, BYTES-TRUE KV GB/s (each step reads the then-current
+    prefix and writes one token — paged_page_bytes is the accounting
+    source, same as the engine's), derived tokens/s, and an
+    `amortization_pct` row = how much of K single-step launches the
+    K-step launch saves (host launch overhead + per-launch readback
+    amortized xK). A `default_k` decision row picks the measured-best
+    K for the next relay window's engine default."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.core.autograd import no_grad
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.jit.api import functional_call
+    from paddle_tpu.kernels.paged_attention import (alloc_paged_cache,
+                                                    paged_page_bytes)
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    if dev == "cpu":
+        B, S, page = 2, 48, 8
+        cfg = LlamaConfig(vocab_size=128, hidden_size=128,
+                          intermediate_size=256, num_hidden_layers=2,
+                          num_attention_heads=2, num_key_value_heads=1,
+                          max_position_embeddings=128)
+    else:
+        # quick halves the model depth and prefix length like the
+        # sibling benches — 4 multi-decode jit compiles are the cost
+        B, S, page = 8, (512 if quick else 1024), 128
+        cfg = LlamaConfig(vocab_size=8192, hidden_size=1024,
+                          intermediate_size=2816,
+                          num_hidden_layers=4 if quick else 8,
+                          num_attention_heads=16, num_key_value_heads=8,
+                          max_position_embeddings=4096)
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if dev != "cpu":
+        model.bfloat16()
+    state = {k: t._data for k, t in model.state_dict().items()}
+    wdtype = next(a.dtype for a in state.values()
+                  if jnp.issubdtype(a.dtype, jnp.floating))
+    D = cfg.hidden_size // cfg.num_attention_heads
+    KVH = cfg.num_key_value_heads
+    ks = (1, 4, 8, 16)
+    # room for S prefix tokens + the largest K per row, plus pad page 0
+    pages_per_seq = -(-(S + max(ks)) // page)
+    num_pages = B * pages_per_seq + 1
+    rng = np.random.RandomState(0)
+    caches = [tuple(jnp.asarray(rng.randn(*a.shape) * 0.1, a.dtype)
+                    for a in alloc_paged_cache(KVH, num_pages, page, D,
+                                               dtype=wdtype))
+              for _ in range(cfg.num_hidden_layers)]
+    flat0 = [a for kv in caches for a in kv]
+    arity = len(caches[0])
+    bt = jnp.asarray(
+        1 + np.arange(B * pages_per_seq, dtype=np.int32).reshape(
+            B, pages_per_seq))
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B,)), jnp.int32)
+    sl = jnp.full((B,), S, jnp.int32)
+    eos = jnp.full((B,), -1, jnp.int32)
+    key = jax.random.PRNGKey(0)
+    kv_tok = paged_page_bytes(KVH, 1, D, str(wdtype)) \
+        * cfg.num_hidden_layers
+
+    # device_time spreads *args as plain arrays: state and caches ride
+    # flattened positionally (a closure would bake ~100 MB of weights
+    # into the program as literals)
+    state_keys = sorted(state)
+    sargs = [state[k] for k in state_keys]
+
+    def make(K):
+        caps = jnp.full((B,), K, jnp.int32)
+
+        def prog(ids_a, sl_a, key_a, *rest):
+            sv, flat = rest[:len(state_keys)], rest[len(state_keys):]
+            st = {k: Tensor(v) for k, v in zip(state_keys, sv)}
+            pc = [tuple(Tensor(a)
+                        for a in flat[i * arity:(i + 1) * arity])
+                  for i in range(cfg.num_hidden_layers)]
+            with no_grad():
+                toks, n_emit, ok, _ = functional_call(
+                    model, st, Tensor(ids_a), pc, Tensor(bt),
+                    Tensor(sl_a), Tensor(caps), Tensor(eos), key_a,
+                    method="forward_paged_decode_multi", k_steps=K)
+            return toks._data, n_emit._data, ok._data
+
+        return jax.jit(prog)
+
+    shape = (f"b{B}s{S}l{cfg.num_hidden_layers}h{cfg.hidden_size}"
+             f"page{page}")
+    times = {}
+    for K in ks:
+        fn = make(K)
+        dt = _time_stats(fn, ids, sl, key, *sargs, *flat0)
+        # bytes-true per launch: step j reads B rows' (S + j)-token
+        # prefix and writes one token per row, scales included
+        nbytes = sum(B * (S + j) * kv_tok + B * kv_tok
+                     for j in range(K))
+        rec = _record("multi_decode", f"k{K}", shape, dt,
+                      bytes_moved=nbytes, device_kind=dev)
+        times[K] = dt[0]
+        if dt[0] > 0:
+            RESULTS.append({
+                "bench": "multi_decode", "variant": f"tok_s_k{K}",
+                "value": round(B * K / dt[0], 1), "device": dev})
+    if times.get(1, 0) > 0:
+        for K in ks[1:]:
+            if times.get(K, 0) > 0:
+                # launch-overhead amortization: K single-step launches
+                # vs one K-step launch
+                save = 100 * (K * times[1] - times[K]) / (K * times[1])
+                RESULTS.append({
+                    "bench": "multi_decode",
+                    "variant": f"amortization_pct_k{K}",
+                    "value": round(save, 2), "device": dev})
+        best = max((K for K in ks if times.get(K, 0) > 0),
+                   key=lambda K: B * K / times[K])
+        RESULTS.append({"bench": "multi_decode", "variant": "default_k",
+                        "value": best, "device": dev})
+
+
 def bench_int8_matmul(dev, quick):
     """The int8-vs-bf16 DECISION sweep (VERDICT r5 #7): weight-only
     int8 halves the weight traffic but pays a dequant; whether that
@@ -535,7 +659,8 @@ def bench_optimizer_update(dev, quick):
 
 
 BENCHES = [bench_flash_vs_sdpa, bench_fusion_pack, bench_paged_decode,
-           bench_paged_decode_tp, bench_int8_matmul, bench_optimizer_update]
+           bench_paged_decode_tp, bench_multi_decode, bench_int8_matmul,
+           bench_optimizer_update]
 
 
 def write_md(path="BENCH_OPS.md"):

@@ -26,6 +26,52 @@ def _calls(tree):
             yield n, name.split(".")[-1]
 
 
+def _resolve_bound(node, consts):
+    """Trip-count resolution for device-side loops: `resolve_int` plus
+    `min(...)` — a min over any resolvable operand is bounded by the
+    smallest of them, which is how the serving multi-decode loop
+    (ISSUE 13) makes its data-driven K lint-provably bounded:
+    `jnp.arange(min(int(k_steps), 512))` resolves to 512 even though
+    k_steps itself is a runtime value.
+
+    SOUND ONLY FOR UPPER endpoints (upper / length / arange stop): a
+    min() resolves to an upper BOUND on the runtime value. A loop's
+    LOWER endpoint must use plain resolve_int — an upper bound on `lo`
+    UNDERestimates the hi - lo trip count."""
+    if isinstance(node, ast.Call):
+        fname = (astutil.dotted_name(node.func) or "").split(".")[-1]
+        if fname == "min" and node.args and not node.keywords:
+            vals = [_resolve_bound(a, consts) for a in node.args]
+            vals = [v for v in vals if v is not None]
+            return min(vals) if vals else None
+    return astutil.resolve_int(node, consts)
+
+
+def _scan_trip(call, consts):
+    """Static trip count of a lax.scan call, when resolvable: the
+    `length=` kwarg, or an `arange(...)`-built xs (positional arg 2 or
+    the xs kwarg). None when data-driven/unresolvable — rules must
+    skip, not guess (package scans legitimately run data-length loops
+    under XLA; the wedge class is the STATICALLY-huge trip count)."""
+    length = astutil.get_arg(call, None, "length")
+    if length is not None:
+        return _resolve_bound(length, consts)
+    xs = astutil.get_arg(call, 2, "xs")
+    if isinstance(xs, ast.Call):
+        leaf = (astutil.dotted_name(xs.func) or "").split(".")[-1]
+        if leaf == "arange":
+            if len(xs.args) == 1:
+                return _resolve_bound(xs.args[0], consts)
+            if len(xs.args) == 2:
+                # lower endpoint: exact values only (resolve_int) — a
+                # min()-clamped lo would UNDERestimate hi - lo
+                lo = astutil.resolve_int(xs.args[0], consts)
+                hi = _resolve_bound(xs.args[1], consts)
+                if lo is not None and hi is not None:
+                    return hi - lo
+    return None
+
+
 @register_rule(
     "A4", ("interpret", "timing-cap"), Severity.ERROR,
     "interpret=True in non-test code / device loops over the 512-iter "
@@ -68,9 +114,11 @@ def check_runtime_safety(ctx):
         elif leaf == "fori_loop":
             lo = astutil.get_arg(call, 0, "lower")
             hi = astutil.get_arg(call, 1, "upper")
+            # lower endpoint: exact only — min()-clamp resolution is an
+            # upper bound, sound for `upper` but not for `lower`
             lo_v = astutil.resolve_int(lo, ctx.consts) if lo is not None \
                 else None
-            hi_v = astutil.resolve_int(hi, ctx.consts) if hi is not None \
+            hi_v = _resolve_bound(hi, ctx.consts) if hi is not None \
                 else None
             if lo_v is not None and hi_v is not None \
                     and hi_v - lo_v > WEDGE_CAP:
@@ -81,7 +129,31 @@ def check_runtime_safety(ctx):
                              "-iteration trip count: device-side loops "
                              f"past ~{WEDGE_CAP} iterations have wedged "
                              "the chip (UNAVAILABLE) over this transport"),
-                    hint="chunk the loop or derive the bound from data "
-                         "shapes; annotate `# tpu-lint: timing-cap-ok` "
+                    hint="chunk the loop, derive the bound from data "
+                         "shapes, or clamp it provably (min(n, "
+                         f"{WEDGE_CAP}) — the multi-decode idiom); "
+                         "annotate `# tpu-lint: timing-cap-ok` "
                          "if this cannot run device-side"))
+        elif leaf == "scan":
+            # the multi-step decode loop (ISSUE 13) is a lax.scan over
+            # the decode body: a bounded trip (K clamped by
+            # min(k, <=512) or a small static arange/length) passes; a
+            # STATICALLY oversized or uselessly-clamped one is the same
+            # wedge class as the fori_loop above. Data-driven lengths
+            # stay un-flagged — XLA scans over sequence lengths are
+            # normal; the hazard is the provably huge trip count.
+            trip = _scan_trip(call, ctx.consts)
+            if trip is not None and trip > WEDGE_CAP:
+                out.append(Diagnostic(
+                    rule="A4", slug="timing-cap", severity=Severity.ERROR,
+                    path=ctx.path, line=call.lineno, col=call.col_offset,
+                    message=(f"lax.scan with a static {trip}-iteration "
+                             "trip count: device-side loops past "
+                             f"~{WEDGE_CAP} iterations have wedged the "
+                             "chip (UNAVAILABLE) over this transport"),
+                    hint="chunk the loop or clamp the trip count "
+                         f"provably (min(k, {WEDGE_CAP}) — the "
+                         "multi-decode idiom); annotate "
+                         "`# tpu-lint: timing-cap-ok` if this cannot "
+                         "run device-side"))
     return out

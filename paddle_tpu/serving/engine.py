@@ -30,7 +30,17 @@ recompilation:
     rejection sampling for temperature > 0), and rejected drafts' KV
     pages roll back via `BlockAllocator.truncate_sequence`. K rides the
     program key like B and P, so the compile bound stays the bucket
-    grid (`max_program_count`).
+    grid (`max_program_count`);
+  * MULTI_DECODE program (multi-step decode, ISSUE 13), keyed by
+    ("multi_decode", batch bucket, steps bucket, block-table bucket):
+    with `decode_steps=K` (no proposer), the decode launch runs K
+    iterations of the decode body inside ONE compiled `lax.scan`
+    (`model.forward_paged_decode_multi`) — in-graph sampling on
+    per-step keys folded from one pre-drawn key, per-step paged cache
+    writes through the loop carry, and per-row EOS/step-cap/finiteness
+    masks that freeze completed rows — so each emitted token stops
+    paying the ~7 ms host round trip. K rides the program key exactly
+    like the verify program's.
 
 Shape buckets pad up: a 19-token chunk runs in the 32-bucket, a decode
 batch of 5 in the 8-bucket. The recompile counter (metrics) is bounded
@@ -102,6 +112,11 @@ def tp_serving_mesh(tp: int, devices=None):
 
 _engine_counter = itertools.count()
 
+# Injectable monotonic timer for the per-launch TPOT samples (ISSUE 13):
+# the drift tests monkeypatch this module attribute to pin launch
+# durations; everything else sees time.perf_counter.
+_perf_counter = time.perf_counter
+
 SNAPSHOT_VERSION = 1
 
 
@@ -135,6 +150,16 @@ FAULT_STORM = faults.register_point("serving.engine.deadline_storm")
 # the soak asserts. nan_logits covers the verify path too.
 FAULT_VERIFY = faults.register_point("serving.engine.verify_step")
 FAULT_DRAFT = faults.register_point("serving.spec.draft_storm")
+# Multi-step decode (ISSUE 13): mirrors decode_step — fires BEFORE the
+# launch, so an injected transient retries the identical K-step program.
+FAULT_MULTI = faults.register_point("serving.engine.multi_decode_step")
+
+# Ceiling on decode_steps (K): each launch runs K decode iterations in
+# one device-side scan, and device loops past ~512 iterations have
+# wedged the chip over this transport (the tpu-lint A4 wedge cap,
+# kernels/timing.py lesson). 64 leaves an order of magnitude of
+# headroom while still amortizing the ~7 ms host round trip ~64x.
+MAX_DECODE_STEPS = 64
 
 
 def _bucket_for(value: int, buckets: List[int]) -> int:
@@ -169,6 +194,20 @@ class ServingEngine:
     is token-identical to plain decode (drafting only changes how many
     launches it takes), and `spec_buckets` is the K axis of the
     program grid.
+
+    decode_steps=K (ISSUE 13) runs K decode iterations inside ONE
+    compiled ("multi_decode", B, K, P) launch — a device-side scan
+    over the decode body with in-graph sampling, per-step paged cache
+    writes, and per-row EOS/max-token/finiteness masks that freeze
+    completed rows — so each emitted token stops paying the ~7 ms
+    host round trip. Greedy output is token-identical to K=1 (the
+    per-step math is the same program body; rows are independent);
+    the scheduler admits/preempts at K-step boundaries and the decode
+    token budget is charged xK; abort/TTL take effect at the next
+    K-boundary with the launch's tokens delivered; NaN quarantine is
+    per LAUNCH (a poisoned row delivers none of the launch's tokens).
+    Mutually exclusive with `proposer` — both multiply tokens per
+    launch. `multi_buckets` is the K axis of the program grid.
 
     Quantized decode path (ISSUE 6):
     * kv_dtype="int8" stores KV pages as int8 with fp32 per-slot
@@ -225,6 +264,8 @@ class ServingEngine:
                  clock=None,
                  proposer=None, spec_k: int = 4,
                  spec_buckets: Optional[List[int]] = None,
+                 decode_steps: int = 1,
+                 multi_buckets: Optional[List[int]] = None,
                  kv_dtype: Optional[str] = None,
                  wq: Optional[str] = None,
                  kv_pool_bytes: Optional[int] = None,
@@ -378,6 +419,37 @@ class ServingEngine:
                 f"largest spec bucket {self.spec_buckets[-1]} must equal "
                 f"spec_k {self.spec_k}")
 
+        # --- multi-step decode (ISSUE 13) ---
+        # decode_steps=K runs K decode iterations inside ONE compiled
+        # ("multi_decode", B, K, P) launch (lax.scan over the decode
+        # body, in-graph sampling + per-row freeze masks) — the plain-
+        # decode counterpart of the verify program. K rides the
+        # program-cache key with multi_buckets as its grid axis, so the
+        # compile bound stays the bucket grid. Mutually exclusive with
+        # speculative decoding per launch: both multiply tokens per
+        # launch and would double-charge the token budget.
+        self.decode_steps = int(decode_steps)
+        if self.decode_steps < 1:
+            raise ValueError("decode_steps must be >= 1")
+        if self.decode_steps > MAX_DECODE_STEPS:
+            raise ValueError(
+                f"decode_steps {self.decode_steps} exceeds "
+                f"MAX_DECODE_STEPS {MAX_DECODE_STEPS} (device-side loop "
+                f"trip counts are capped well under the 512-iteration "
+                f"wedge cap — tpu-lint A4)")
+        if self.decode_steps > 1 and proposer is not None:
+            raise ValueError(
+                "decode_steps > 1 and a proposer are mutually exclusive: "
+                "speculative verify and plain multi-step decode both "
+                "multiply tokens per launch — pick one per engine")
+        self.multi_buckets = sorted(
+            multi_buckets or _pow2_buckets(1, self.decode_steps)) \
+            if self.decode_steps > 1 else []
+        if self.multi_buckets and self.multi_buckets[-1] != self.decode_steps:
+            raise ValueError(
+                f"largest multi bucket {self.multi_buckets[-1]} must "
+                f"equal decode_steps {self.decode_steps}")
+
         self.allocator = BlockAllocator(self.num_pages, self.page_size)
         self.radix = (RadixCache(self.allocator)
                       if enable_prefix_cache else None)
@@ -391,6 +463,11 @@ class ServingEngine:
             # verify tokens draw from the same per-step token budget
             # prefill chunks compete for (SERVING.md bucketing note)
             self.scheduler.decode_token_cost = 1 + self.spec_k
+        elif self.decode_steps > 1:
+            # each decoding request may emit up to K tokens per launch:
+            # charge the budget xK so admission/preemption decisions at
+            # K-step boundaries see the true per-launch token traffic
+            self.scheduler.decode_token_cost = self.decode_steps
         # --- resilience (ISSUE 3) ---
         # deadlines use an injectable clock (tests/soak pass a fake one;
         # the fault harness adds skew) so expiry stays deterministic
@@ -427,6 +504,7 @@ class ServingEngine:
         self._cur_rids = ()          # requests in the launch being run
         self._step_ev = {"programs": []}
         self._step_t0: Optional[float] = None
+        self._last_launch_s: Optional[float] = None
 
         from jax.sharding import PartitionSpec as P
         shape = (self.num_pages, self.num_kv, self.page_size, self.head_dim)
@@ -492,6 +570,10 @@ class ServingEngine:
             "verify", lambda: (len(self.batch_buckets)
                                * len(self.spec_buckets)
                                * len(self.pages_buckets)))
+        self.programs.register_family(
+            "multi_decode", lambda: (len(self.batch_buckets)
+                                     * len(self.multi_buckets)
+                                     * len(self.pages_buckets)))
         # caches only pay off donated on a real accelerator; CPU jit
         # warns per call and keeps the copy anyway. Scale lists donate
         # too (empty pytrees for full-width KV — a no-op there).
@@ -876,11 +958,15 @@ class ServingEngine:
 
         self._cur_rids = tuple(rids)
         self._step_ev["programs"].append(f"decode:B{B}:P{P}")
+        self._step_ev["decode_k"] = 1
         t_tr = self.tracer.now_ns() if self.tracer is not None else 0
+        t0 = _perf_counter()
         toks, oks, *caches = self.supervisor.run(launch,
                                                  label="decode_step")
+        toks = np.asarray(toks)        # host fetch = the honest sync
+        self._last_launch_s = _perf_counter() - t0
         self._tr_launch(rids, "decode_step", t_tr, batch=len(reqs),
-                        bucket=[B, P])
+                        bucket=[B, P], k=1)
         self._store_caches(*caches)
         # bytes-moved accounting: this step wrote one token per live row
         # and the attention kernel read every live token's K/V
@@ -897,7 +983,7 @@ class ServingEngine:
             # this step wrote the K/V of each row's input token
             r.num_computed = r.seq.num_tokens
         self.metrics.on_decode(len(reqs))
-        return np.asarray(toks), oks
+        return toks, oks
 
     @staticmethod
     def _poison_rows(poison, reqs) -> List[int]:
@@ -913,6 +999,195 @@ class ServingEngine:
         else:
             rows = poison
         return [int(i) for i in rows if 0 <= int(i) < len(reqs)]
+
+    # --------------------------------------- multi-step decode (ISSUE 13)
+    def _build_multi_decode(self, B: int, K: int, P: int):
+        """K decode iterations in ONE compiled launch: a device-side
+        scan over the decode body with in-graph sampling (per-step keys
+        folded from the one pre-drawn launch key), per-step paged cache
+        writes through the loop carry, and per-row freeze masks
+        (EOS / per-row step cap / non-finite logits). The host fetches
+        only (tokens (B, K), emitted counts, finiteness flags) — one
+        relay round trip buys up to K tokens per row."""
+        model = self.model
+        temperature, top_k, top_p = self.temperature, self.top_k, self.top_p
+        views, split = self._paged_views, self._split_views
+
+        def program(state, kcs, vcs, kss, vss, ids, bt, sl, caps, eos,
+                    key):
+            st = {k: Tensor(v) for k, v in state.items()}
+            paged = views(kcs, vcs, kss, vss)
+            toks, n_emit, ok, caches = functional_call(
+                model, st, Tensor(ids), paged, Tensor(bt), Tensor(sl),
+                Tensor(caps), Tensor(eos), key,
+                method="forward_paged_decode_multi", k_steps=K,
+                temperature=temperature, top_k=top_k, top_p=top_p)
+            return (toks._data, n_emit._data, ok._data) + split(caches)
+
+        return jax.jit(program, donate_argnums=self._donate)
+
+    def _run_multi_decode(self, reqs: List[Request], caps: List[int],
+                          K: int):
+        """One supervised ("multi_decode", B, K, P) launch. `reqs[i]`'s
+        sequence is already extended by caps[i] - 1 slots; returns
+        (toks (B, K), n_emit (B,), oks (B,), launch seconds)."""
+        from .. import profiler
+        B = _bucket_for(len(reqs), self.batch_buckets)
+        max_pages = max(len(r.seq.pages) for r in reqs)
+        P = _bucket_for(max_pages, self.pages_buckets)
+        prog = self._get_program(("multi_decode", B, K, P) + self._qkey,
+                                 lambda: self._build_multi_decode(B, K, P))
+        ids = np.zeros((B,), np.int32)
+        sl = np.zeros((B,), np.int32)
+        cp = np.zeros((B,), np.int32)
+        eos = np.full((B,), -1, np.int32)
+        bt = np.full((B, P), PAD_PAGE, np.int32)
+        seqs = [r.seq for r in reqs]
+        bt[:len(reqs)] = self.allocator.block_table(seqs, P)
+        for i, (r, c) in enumerate(zip(reqs, caps)):
+            ids[i] = r.output_ids[-1]
+            # seq_lens counts through the FIRST input token (the
+            # forward_paged convention); the extension slots grew
+            # num_tokens past it, so subtract them back out
+            sl[i] = r.seq.num_tokens - (c - 1)
+            cp[i] = c
+            if r.eos_token_id is not None:
+                eos[i] = r.eos_token_id
+        key = self._next_key()    # drawn once: retries re-run identically
+        rids = [r.request_id for r in reqs]
+
+        def launch():
+            faults.fire(FAULT_MULTI)
+            with profiler.RecordEvent("serving.multi_decode_step"), \
+                    poison_scope(f"serving.multi_decode_step[reqs="
+                                 f"{rids}]"), no_grad(), \
+                    self._trace_scope():
+                return prog(
+                    self._state, self._k_caches, self._v_caches,
+                    self._k_scales, self._v_scales,
+                    jnp.asarray(ids), jnp.asarray(bt), jnp.asarray(sl),
+                    jnp.asarray(cp), jnp.asarray(eos), key)
+
+        self._cur_rids = tuple(rids)
+        self._step_ev["programs"].append(f"multi_decode:B{B}:K{K}:P{P}")
+        self._step_ev["decode_k"] = K
+        t_tr = self.tracer.now_ns() if self.tracer is not None else 0
+        t0 = _perf_counter()
+        toks, n_emit, oks, *caches = self.supervisor.run(
+            launch, label="multi_decode_step")
+        # host fetch = the only honest sync over the relay: convert
+        # BEFORE stamping the launch time so TPOT covers device work
+        toks = np.asarray(toks)
+        n_emit = np.asarray(n_emit).astype(int)
+        oks = np.asarray(oks)[:len(reqs)].copy()
+        dt = _perf_counter() - t0
+        self._tr_launch(rids, "multi_decode_step", t_tr, batch=len(reqs),
+                        bucket=[B, K, P], k=K)
+        self._store_caches(*caches)
+        # bytes-moved accounting: every live row writes one token's K/V
+        # per step (frozen steps idempotently rewrite the last token),
+        # and each step's attention reads the row's then-current prefix
+        # (frozen rows re-read at their frozen length)
+        base_lens = sl[:len(reqs)].astype(int)
+        reads = sum(int(b0) * K + sum(min(j, int(e)) for j in range(K))
+                    for b0, e in zip(base_lens, n_emit[:len(reqs)]))
+        self.metrics.on_kv_bytes(
+            written=len(reqs) * K * self.kv_bytes_per_token,
+            read=reads * self.kv_bytes_per_token)
+        for r in reqs:
+            r.num_computed = r.seq.num_tokens
+        poison = faults.fire(FAULT_NAN)
+        if poison is not None:
+            for i in self._poison_rows(poison, reqs):
+                oks[i] = False
+        return toks, n_emit, oks, dt
+
+    def _multi_decode_step(self, decodes: List[Request], emitted):
+        """The multi-step replacement for the plain decode launch:
+        extend each sequence by up to K-1 slots -> ONE scan launch ->
+        emit each row's tokens up to its in-graph freeze point -> roll
+        unused slots back.
+
+        Failure semantics mirror the decode step: transients retried by
+        the supervisor (writes are idempotent, the RNG key pre-drawn);
+        a row whose per-launch finiteness flag is down is quarantined
+        alone and delivers NO token from the poisoned launch (per-LAUNCH
+        quarantine granularity — SERVING.md); unattributed poison rolls
+        the extension slots back and isolates via solo PLAIN decode
+        launches; anything else drains to a snapshot. Abort/TTL are
+        honored at the next K-boundary with this launch's tokens
+        delivered."""
+        caps = []
+        for req in decodes:
+            want = min(self.decode_steps, req.remaining_new_tokens())
+            granted, copies = self._extend_slots(req, want - 1)
+            if granted < want - 1:
+                self.metrics.counters["multi_decode_slot_shortfall"] += \
+                    (want - 1) - granted
+            if copies:
+                self._apply_copies(copies)
+            caps.append(1 + granted)
+        K = _bucket_for(max(caps), self.multi_buckets)
+        isolated = False
+        dt = None
+        try:
+            toks, n_emit, oks, dt = self._run_multi_decode(
+                decodes, caps, K)
+        except Exception as exc:   # noqa: BLE001
+            if classify_failure(exc) != POISON:
+                self._fail(exc)
+            # unattributed poison: drop the extension slots (their K/V
+            # is suspect) and isolate with solo plain-decode launches
+            for req, cap in zip(decodes, caps):
+                if cap > 1:
+                    self.allocator.truncate_sequence(
+                        req.seq, req.seq.num_tokens - (cap - 1))
+            toks1, oks = self._isolate_poisoned(decodes)
+            toks = np.full((len(decodes), 1), -1, np.int64)
+            toks[:, 0] = toks1
+            n_emit = np.ones((len(decodes),), int)
+            caps = [1] * len(decodes)
+            isolated = True
+        total_emitted = 0
+        for i, req in enumerate(decodes):
+            base = req.seq.num_tokens - (caps[i] - 1)  # through input tok
+            if not oks[i]:
+                # per-launch quarantine: pages (extension slots
+                # included) freed WITHOUT donation, no token delivered
+                self._quarantine(req)
+                continue
+            e = int(n_emit[i])
+            reason = None
+            n_done = 0
+            for j in range(e):
+                reason = self._emit(req, int(toks[i, j]), emitted)
+                n_done += 1
+                if reason is not None:
+                    break
+            # valid K/V: the input token + the emitted tokens actually
+            # CONSUMED as later in-graph inputs (n_done - 1 of them);
+            # unused extension slots roll back so donation/resume never
+            # sees past-freeze garbage
+            valid = base + max(n_done, 1) - 1
+            if req.seq.num_tokens > valid:
+                self.allocator.truncate_sequence(req.seq, valid)
+            req.num_computed = valid
+            total_emitted += n_done
+            if reason is not None:
+                self.scheduler.finish(req, reason)
+                self._on_finished(req)
+        if not isolated:
+            self.metrics.on_decode(total_emitted)
+            self.metrics.on_decode_launch(K, len(decodes), total_emitted,
+                                          dt)
+        else:
+            # the isolation path's solo launches counted decode_tokens
+            # inside _run_decode; record their row count too (one row
+            # per solo launch, k=1, no timing) or the
+            # tokens-per-launch ratio would keep a numerator with no
+            # denominator and read ABOVE its true value after any
+            # degraded event
+            self.metrics.on_decode_launch(1, len(decodes), 0, None)
 
     # ------------------------------------------- speculative verify (ISSUE 5)
     def _build_verify(self, B: int, K: int, P: int):
@@ -994,23 +1269,23 @@ class ServingEngine:
 
         return jax.jit(program, donate_argnums=self._donate)
 
-    def _extend_for_drafts(self, req: Request, draft: List[int]):
-        """Grow the request's sequence by up to len(draft) token slots
-        (the scheduler already reserved the verify input token's slot).
+    def _extend_slots(self, req: Request, want: int):
+        """Grow the request's sequence by up to `want` token slots (the
+        scheduler already reserved this launch's input-token slot).
         On pool exhaustion the reclamation ladder stops at its FIRST
         rung — radix LRU eviction of zero-active-ref cached prefixes
         (otherwise a long-lived server whose pool has filled with
         donated prefixes, the normal steady state, would drop every
-        draft and silently lose the spec-decode win) — but NEVER
-        preempts: drafts are advisory, and evicting live work to make
-        room for speculation would invert the priority order. Degrades,
-        never fails: `append_token` is atomic, so a dry pool just
-        shortens the draft — zero drafts means the verify step
-        degenerates to plain decode. Returns (granted draft list, CoW
-        copies due)."""
+        extra slot and silently lose the multi-token win) — but NEVER
+        preempts: the extra slots are advisory (draft tokens / extra
+        decode steps), and evicting live work to make room for them
+        would invert the priority order. Degrades, never fails:
+        `append_token` is atomic, so a dry pool just grants fewer
+        slots — zero means the launch degenerates to a single step.
+        Returns (granted, CoW copies due)."""
         base = req.seq.num_tokens
         copies, granted = [], 0
-        for _ in draft:
+        for _ in range(want):
             try:
                 copies.extend(self.allocator.append_token(req.seq))
             except BlocksExhausted:
@@ -1021,10 +1296,17 @@ class ServingEngine:
                 except BlocksExhausted:
                     break
             granted += 1
+        assert req.seq.num_tokens == base + granted
+        return granted, copies
+
+    def _extend_for_drafts(self, req: Request, draft: List[int]):
+        """Spec-decode slot extension: grow by up to len(draft) slots
+        via `_extend_slots`, shortening the draft to what the pool
+        granted. Returns (granted draft list, CoW copies due)."""
+        granted, copies = self._extend_slots(req, len(draft))
         if granted < len(draft):
             self.metrics.on_spec_draft_oom(len(draft) - granted)
         del draft[granted:]
-        assert req.seq.num_tokens == base + granted
         return draft, copies
 
     def _run_verify(self, reqs: List[Request], drafts: List[List[int]]):
@@ -1070,6 +1352,9 @@ class ServingEngine:
 
         self._cur_rids = tuple(rids)
         self._step_ev["programs"].append(f"verify:B{B}:K{K}:P{P}")
+        # tokens-per-launch context for the step record: a verify
+        # launch can emit up to K drafts + 1 correction/bonus per row
+        self._step_ev["decode_k"] = K + 1
         t_tr = self.tracer.now_ns() if self.tracer is not None else 0
         toks, n_acc, oks, *caches = self.supervisor.run(
             launch, label="verify_step")
@@ -1349,6 +1634,8 @@ class ServingEngine:
                 req.pending_copies = []
             if self.proposer is not None:
                 self._spec_decode_step(decodes, emitted)
+            elif self.decode_steps > 1:
+                self._multi_decode_step(decodes, emitted)
             else:
                 self._plain_decode_step(decodes, emitted)
 
@@ -1381,6 +1668,12 @@ class ServingEngine:
             "prefill_tokens": int(c["prefill_tokens"]
                                   - pre["prefill_tokens"]),
             "decode_batch": int(n_decode),
+            # tokens-per-launch context under coarser launches
+            # (ISSUE 13): K=1 for the plain decode program, the launch
+            # K bucket for multi-step decode, K+1 for a speculative
+            # verify launch, 0 for no decode-side launch this step
+            "decode_k": int(self._step_ev.get("decode_k", 0))
+            if n_decode else 0,
             "tokens_out": int(n_emitted),
             "preempted": int(c["requests_preempted"]
                              - pre["requests_preempted"]),
@@ -1417,6 +1710,7 @@ class ServingEngine:
     def _plain_decode_step(self, decodes: List[Request], emitted):
         """One batched single-token decode launch + emission (the
         non-speculative path, unchanged semantics)."""
+        degraded = False
         try:
             toks, oks = self._run_decode(decodes)
         except Exception as exc:   # noqa: BLE001
@@ -1425,8 +1719,10 @@ class ServingEngine:
                 # by an eager/dispatch NaN hook instead of the
                 # in-graph flags): isolate by running rows solo
                 toks, oks = self._isolate_poisoned(decodes)
+                degraded = True
             else:
                 self._fail(exc)
+        n0 = len(emitted)
         for i, req in enumerate(decodes):
             if not oks[i]:
                 self._quarantine(req)
@@ -1435,6 +1731,18 @@ class ServingEngine:
             if reason is not None:
                 self.scheduler.finish(req, reason)
                 self._on_finished(req)
+        if not degraded:
+            # TPOT sample: launch wall seconds / tokens emitted, so the
+            # per-token percentiles stay comparable across K (ISSUE 13)
+            self.metrics.on_decode_launch(1, len(decodes),
+                                          len(emitted) - n0,
+                                          self._last_launch_s)
+        else:
+            # solo isolation launches counted decode_tokens in
+            # _run_decode; keep the tokens-per-launch denominator
+            # honest (no TPOT sample — solo timings aren't a batch
+            # launch's)
+            self.metrics.on_decode_launch(1, len(decodes), 0, None)
 
     def _isolate_poisoned(self, reqs: List[Request]):
         """Degraded mode for an UNATTRIBUTED poison failure of a decode

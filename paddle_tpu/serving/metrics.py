@@ -77,6 +77,11 @@ class ServingMetrics:
             "spec_emitted_tokens": 0,      # tokens emitted by verify steps
             "spec_rollback_tokens": 0,     # rejected-draft KV truncated
             "spec_draft_oom_drops": 0,     # drafts dropped: pool pressure
+            # --- multi-step decode (ISSUE 13) ---
+            "decode_launches": 0,          # decode-side program launches
+            "decode_launch_steps": 0,      # K summed over those launches
+            "decode_launch_rows": 0,       # live rows summed over them
+            "multi_decode_slot_shortfall": 0,  # K-1 slots the pool denied
         }
         self._registered = False
         self._t_start = time.perf_counter()
@@ -102,6 +107,12 @@ class ServingMetrics:
         # accepted tokens per verify step (the spec-decode win, per
         # step): mean > 1 is the "speculation pays" signal
         self._accepted_samples = self.add_reservoir("spec_accepted")
+        # TPOT: launch wall seconds / tokens emitted by the launch, so
+        # the per-token percentiles stay comparable whether a launch
+        # emits 1 token (K=1) or K (multi-step decode, ISSUE 13) —
+        # coarser launches must not silently inflate the p99s
+        self._tpot_samples = self.add_reservoir("tpot", scale=1e3,
+                                                suffix="_ms")
         # gauges updated by the engine each step
         self.queue_depth = 0
         self.running = 0
@@ -182,6 +193,30 @@ class ServingMetrics:
 
     def on_decode(self, num_tokens: int):
         self.counters["decode_tokens"] += num_tokens
+
+    def on_decode_launch(self, k: int, rows: int, tokens: int,
+                         seconds: Optional[float] = None):
+        """One decode-side program launch (plain K=1 or multi-step K)
+        over `rows` live rows: `tokens` tokens were emitted in
+        `seconds` of launch wall time. The TPOT sample divides the
+        launch latency by the tokens it emitted — the per-token number
+        that stays comparable across K."""
+        self.counters["decode_launches"] += 1
+        self.counters["decode_launch_steps"] += int(k)
+        self.counters["decode_launch_rows"] += int(rows)
+        if seconds is not None and seconds > 0 and tokens > 0:
+            self._tpot_samples.append(seconds / tokens)
+
+    def tokens_per_launch(self) -> Optional[float]:
+        """Mean decode tokens emitted per ROW per decode-side launch
+        (None before any launch) — 1.0 for plain decode, approaching K
+        for multi-step decode at full batch (the >= 0.9 K acceptance
+        number; the tail of a draining workload pulls it down when
+        rows run out of remaining tokens mid-grid)."""
+        if not self.counters["decode_launch_rows"]:
+            return None
+        return (self.counters["decode_tokens"]
+                / self.counters["decode_launch_rows"])
 
     # ---- quantized KV / weights (ISSUE 6) --------------------------------
     def set_kv_info(self, *, kv_dtype, page_bytes, pool_bytes,
@@ -353,6 +388,9 @@ class ServingMetrics:
         tps = self.spec_tokens_per_step()
         if tps is not None:
             snap["spec_tokens_per_step"] = round(tps, 4)
+        tpl = self.tokens_per_launch()
+        if tpl is not None:
+            snap["decode_tokens_per_launch"] = round(tpl, 4)
         ttft = self.mean_ttft()
         if ttft is not None:
             snap["mean_ttft_ms"] = round(ttft * 1e3, 3)

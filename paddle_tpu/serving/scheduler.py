@@ -200,7 +200,11 @@ class Scheduler:
         # (draft positions scored per sequence per step) are charged
         # against the same budget prefill chunks draw from — otherwise
         # speculative steps would silently blow the TTFT-vs-throughput
-        # contract the budget exists to enforce.
+        # contract the budget exists to enforce. The multi-step decode
+        # engine (ISSUE 13) sets decode_steps for the same reason: one
+        # schedule() decision now covers a K-token launch, so admission
+        # and preemption at K-step boundaries must see the true
+        # per-launch token traffic.
         self.decode_token_cost = 1
         self.waiting: deque = deque()
         self.prefilling: List[Request] = []   # admitted, chunks pending

@@ -1,0 +1,29 @@
+"""Known-bad A4 (multi-step decode shape, ISSUE 13): device-side
+decode loops whose trip count is provably past the 512-iteration wedge
+cap — a statically oversized `lax.scan` (the 4096-iteration loop shape
+that left the chip UNAVAILABLE for minutes in round 4, now under scan
+instead of fori_loop), a scan `length=` whose min() clamp resolves past
+the cap, and a fori_loop whose clamp is uselessly large, so the
+"bound" proves nothing — the unbounded-in-spirit case: the trip count
+resolves, but to an unsafe value."""
+import jax
+import jax.numpy as jnp
+
+
+def decode_loop_oversized_scan(body, carry):
+    return jax.lax.scan(body, carry, jnp.arange(4096))  # bad: 4096 steps
+
+
+def decode_loop_oversized_span(body, carry):
+    # bad: two-arg arange, statically 4096 steps
+    return jax.lax.scan(body, carry, jnp.arange(0, 4096))
+
+
+def decode_loop_oversized_length(body, carry, k_steps):
+    # bad: the clamp resolves — to 4096, past the wedge cap
+    return jax.lax.scan(body, carry, None, length=min(k_steps, 4096))
+
+
+def decode_loop_useless_clamp(body, carry, k_steps):
+    # bad: min() against 65536 bounds nothing the chip survives
+    return jax.lax.fori_loop(0, min(int(k_steps), 65536), body, carry)

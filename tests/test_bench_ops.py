@@ -131,6 +131,42 @@ def test_int8_kv_paged_rows(bench_ops):
     assert int8["gbps"] < bf16["gbps"]
 
 
+def test_multi_decode_rows_and_default_k(bench_ops):
+    """The multi-step decode bench (ISSUE 13) emits a bytes-true row,
+    a tok/s row and an amortization row per K in {1, 4, 8, 16}, plus
+    the default_k decision row. Timing mocked with a fixed per-launch
+    overhead + per-step cost, so amortization and the K choice are
+    deterministic: overhead 1 ms / step 1 ms -> K=16 wins."""
+    times = {K: 1e-3 + K * 1e-3 for K in (1, 4, 8, 16)}
+    seen = []
+
+    def fake_stats(fn, *args, iters=10):
+        K = (1, 4, 8, 16)[len(seen)]
+        seen.append(K)
+        return times[K], 0.01
+
+    bench_ops._time_stats = fake_stats
+    bench_ops.bench_multi_decode("cpu", quick=True)
+    rows = [r for r in bench_ops.RESULTS if r["bench"] == "multi_decode"]
+    variants = {r["variant"] for r in rows}
+    assert {"k1", "k4", "k8", "k16", "tok_s_k1", "tok_s_k16",
+            "amortization_pct_k4", "amortization_pct_k16",
+            "default_k"} <= variants
+    vals = {r["variant"]: r.get("value") for r in rows if "value" in r}
+    # overhead 1 ms amortized: 4 launches @2ms -> one 5ms launch
+    assert vals["amortization_pct_k4"] == pytest.approx(
+        100 * (4 * 2e-3 - 5e-3) / (4 * 2e-3))
+    assert vals["default_k"] == 16           # best tok/s under the mock
+    # tok/s = B * K / dt with the CPU bench's B=2
+    assert vals["tok_s_k1"] == pytest.approx(2 * 1 / 2e-3, rel=1e-3)
+    # bytes-true: the K row's bytes grow superlinearly in K (prefix
+    # grows per step), so bandwidth at equal per-step time grows with
+    # K (hbm_frac carries 4 decimals; gbps rounds to 1)
+    k1 = next(r for r in rows if r["variant"] == "k1")
+    k16 = next(r for r in rows if r["variant"] == "k16")
+    assert k16["hbm_frac"] > k1["hbm_frac"]
+
+
 def test_tp_paged_rows_bytes_per_chip(bench_ops):
     """The sharded paged-decode bench (ISSUE 8) emits one row per TP
     degree with BYTES-TRUE per-chip traffic — global KV bytes / tp

@@ -84,9 +84,10 @@ def test_serving_engine_continuous_batching_acceptance(model):
     assert eng.metrics.counters["recompiles"] == eng.num_compiled_programs
     assert eng.num_compiled_programs <= eng.max_program_count()
     counts = eng.program_counts()
-    assert set(counts) == {"chunk", "decode", "verify"}
+    assert set(counts) == {"chunk", "decode", "verify", "multi_decode"}
     assert sum(counts.values()) == eng.num_compiled_programs
     assert counts["verify"] == 0                  # no proposer configured
+    assert counts["multi_decode"] == 0            # decode_steps=1
     for fam, n in counts.items():
         assert n <= eng.max_program_count(fam)
 

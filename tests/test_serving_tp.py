@@ -285,9 +285,11 @@ def test_engine_family_bounds_match_bucket_grids(model):
     assert eng.max_program_count("decode") == \
         len(eng.batch_buckets) * len(eng.pages_buckets)
     assert eng.max_program_count("verify") == 0          # no proposer
+    assert eng.max_program_count("multi_decode") == 0    # decode_steps=1
     assert eng.max_program_count() == (
         eng.max_program_count("chunk") + eng.max_program_count("decode"))
-    assert eng.program_counts() == {"chunk": 0, "decode": 0, "verify": 0}
+    assert eng.program_counts() == {"chunk": 0, "decode": 0, "verify": 0,
+                                    "multi_decode": 0}
     eng.shutdown()
 
 
