@@ -3,7 +3,7 @@
 # otherwise block every interpreter on the single TPU grant).
 TEST_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: test test-fast bench soak soak-fleet lint train-report dist-report
+.PHONY: test test-fast bench soak soak-fleet soak-fleet-proc lint train-report dist-report
 
 # tpu-lint: static trace-safety analysis (ANALYSIS.md). AST-only — no
 # jax import, no TPU grant, ~1 s; gates `make test`.
@@ -18,6 +18,18 @@ test: lint
 	# identity under TP and int8/snapshot identity under decode_steps)
 	$(TEST_ENV) python -m pytest tests/test_serving_tp.py \
 		tests/test_serving_multi.py -m slow -q
+	# slow-marked cross-process/compile-cache/http secondary variants
+	# (ISSUE 14; tier-1 keeps the probe-gated lifecycle + the named
+	# integrity paths, the full gate runs the rest)
+	$(TEST_ENV) python -m pytest tests/test_fleet_proc.py \
+		tests/test_compile_cache.py tests/test_fleet_http.py \
+		-m slow -q
+	# tier-1 870s budget (PR 14): the heavy convergence/zoo smoke and
+	# the routing-criterion mini-soak moved behind the slow marker —
+	# the full gate still runs every one of them here
+	$(TEST_ENV) python -m pytest tests/test_dit.py \
+		tests/test_vision_zoo.py tests/test_loop_grad.py \
+		tests/test_fleet_router.py -m slow -q
 
 test-fast: lint
 	$(TEST_ENV) python -m pytest tests/ -x -q -m "not slow"
@@ -60,6 +72,14 @@ soak-fleet:
 	# chrome trace the traced chaos pass exported
 	$(TEST_ENV) python tools/trace_report.py profiler_log/soak_fleet_trace.json
 	$(TEST_ENV) python -m pytest tests/test_soak_fleet.py -m slow -q
+
+# Cross-process fleet chaos soak (ISSUE 14): real worker processes over
+# the TCPStore mailbox — seeded kill -9 mid-stream, a permanently wedged
+# worker, a slow-heartbeat worker, wire drop/duplicate, the cold-vs-warm
+# compile-cache bench (>= 5x) and a rolling restart. 3 seeds.
+soak-fleet-proc:
+	$(TEST_ENV) python tools/soak_fleet.py --procs --requests 30 --seed 0
+	$(TEST_ENV) python -m pytest tests/test_soak_fleet_proc.py -m slow -q
 
 # Sanitizer builds of the native extension (parity: reference
 # SANITIZER_TYPE configure option). Runs the native test suite against an

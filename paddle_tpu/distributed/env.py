@@ -13,7 +13,8 @@ import os
 import jax
 
 __all__ = ["get_rank", "get_world_size", "init_parallel_env",
-           "is_initialized", "ParallelEnv", "create_store", "barrier_store"]
+           "is_initialized", "ParallelEnv", "create_store",
+           "release_store", "barrier_store"]
 
 _initialized = [False]
 _store = [None]    # default store (first created)
@@ -65,6 +66,21 @@ def create_store(endpoint=None, rank=None, timeout_ms=120000):
     if _store[0] is None:
         _store[0] = store
     return store
+
+
+def release_store(endpoint):
+    """Drop `endpoint` from the process-wide registry so the native
+    store can close when its last reference dies (the cross-process
+    fleet binds one ephemeral-port store per supervisor — a long-lived
+    process must be able to release them; ISSUE 14). Returns whether
+    an entry was removed. The default-store slot moves to any other
+    registered store."""
+    store = _stores.pop(endpoint, None)
+    if store is None:
+        return False
+    if _store[0] is store:
+        _store[0] = next(iter(_stores.values()), None)
+    return True
 
 
 class _StoreProxy:

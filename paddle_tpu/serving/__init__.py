@@ -12,10 +12,16 @@ lookup or a smaller draft model) and verifies them against the paged
 cache in one bucketed launch with KV rollback for rejected drafts; the
 fleet front-end (ISSUE 7, `serving.fleet`) multiplexes a streaming API
 over N in-process replicas with prefix-affinity routing, replica
-supervision, and zero-loss failover via snapshot live-migration.
+supervision, and zero-loss failover via snapshot live-migration; the
+cross-process tier (ISSUE 14) moves replicas into worker processes
+over a framed TCPStore mailbox (`ProcessFleet`/`worker.py`/
+`transport.py`) with crash-proof restart through heartbeat-shipped
+snapshots and a persistent AOT compile cache
+(`serving.compile_cache`), fronted by HTTP/SSE (`HttpFrontend`).
 """
 from .engine import ServingEngine, tp_serving_mesh
 from .program_cache import ProgramCache
+from .compile_cache import CompileCache
 from .errors import (EngineFailure, EngineOverloaded, PoisonedComputation,
                      SnapshotVersionError, TransientDeviceError)
 from .kv_cache import BlockAllocator, BlocksExhausted, KVSequence, PAD_PAGE
@@ -27,9 +33,10 @@ from .spec import DraftModelProposer, NgramProposer, Proposer
 from .supervisor import RetryPolicy, StepSupervisor, classify_failure
 from .trace import FlightRecorder, RequestTrace, RequestTracer
 from .exposition import render_prometheus
-from .fleet import (Fleet, FleetHandle, FleetServer, PrefixAffinityRouter,
-                    RandomRouter, Replica, ReplicaState, RoundRobinRouter,
-                    TokenStream)
+from .fleet import (Channel, Fleet, FleetHandle, FleetServer, HttpFrontend,
+                    PrefixAffinityRouter, ProcessFleet, RandomRouter,
+                    Replica, ReplicaState, RoundRobinRouter, TokenStream,
+                    TransportError, WorkerProc, WorkerState)
 
 __all__ = ["ServingEngine", "BlockAllocator", "BlocksExhausted",
            "KVSequence", "PAD_PAGE", "ServingMetrics", "RadixCache",
@@ -42,4 +49,6 @@ __all__ = ["ServingEngine", "BlockAllocator", "BlocksExhausted",
            "FleetServer", "TokenStream", "Replica", "ReplicaState",
            "PrefixAffinityRouter", "RandomRouter", "RoundRobinRouter",
            "tp_serving_mesh", "ProgramCache", "RequestTracer",
-           "RequestTrace", "FlightRecorder", "render_prometheus"]
+           "RequestTrace", "FlightRecorder", "render_prometheus",
+           "CompileCache", "Channel", "TransportError", "HttpFrontend",
+           "ProcessFleet", "WorkerProc", "WorkerState"]

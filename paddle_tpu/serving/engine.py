@@ -95,8 +95,8 @@ from .scheduler import (Request, RequestState, Scheduler,
 from .supervisor import POISON, RetryPolicy, StepSupervisor, classify_failure
 from .trace import FlightRecorder, RequestTracer
 
-__all__ = ["ServingEngine", "SNAPSHOT_VERSION", "check_snapshot_version",
-           "tp_serving_mesh"]
+__all__ = ["ServingEngine", "SNAPSHOT_VERSION", "SNAPSHOT_MINOR",
+           "check_snapshot_version", "tp_serving_mesh"]
 
 
 def tp_serving_mesh(tp: int, devices=None):
@@ -118,19 +118,39 @@ _engine_counter = itertools.count()
 _perf_counter = time.perf_counter
 
 SNAPSHOT_VERSION = 1
+# Forward-compat MINOR (ISSUE 14): bumped when a build ADDS snapshot
+# fields that older builds can safely ignore. A rolling restart mixes
+# worker versions, so adoption must accept a same-major snapshot from
+# a NEWER minor — unknown extra top-level keys warn-and-ignore instead
+# of failing; only a MAJOR mismatch (a schema this build would
+# misread) stays the loud, typed refusal.
+SNAPSHOT_MINOR = 1
+_SNAPSHOT_KNOWN_KEYS = frozenset(
+    {"version", "minor", "reason", "rng_key", "requests",
+     "flight_recorder"})
 
 
 def check_snapshot_version(snapshot: dict):
     """Refuse a snapshot whose schema `version` stamp is not the one
     this build writes. Used by `from_snapshot` AND by the fleet's live
     migration — both must fail LOUD (typed) instead of resuming a
-    schema they would silently misread."""
+    schema they would silently misread. Same-major snapshots from a
+    NEWER minor (extra fields) are accepted with a warning — the
+    rolling-restart mixed-version case."""
     found = snapshot.get("version")
     if found != SNAPSHOT_VERSION:
         raise SnapshotVersionError(
             f"unsupported snapshot version {found!r} (this build "
             f"writes {SNAPSHOT_VERSION})",
             found=found, expected=SNAPSHOT_VERSION)
+    minor = snapshot.get("minor", 0)
+    extra = sorted(set(snapshot) - _SNAPSHOT_KNOWN_KEYS)
+    if extra or (isinstance(minor, int) and minor > SNAPSHOT_MINOR):
+        import warnings
+        warnings.warn(
+            f"snapshot from a newer same-major build (minor {minor!r} "
+            f"vs {SNAPSHOT_MINOR}); ignoring unknown keys {extra}",
+            RuntimeWarning, stacklevel=2)
 
 # Fault-injection points (ISSUE 3; utils/faults.py). The step-exception
 # points fire BEFORE the compiled launch, so an injected transient
@@ -270,6 +290,7 @@ class ServingEngine:
                  wq: Optional[str] = None,
                  kv_pool_bytes: Optional[int] = None,
                  mesh=None,
+                 compile_cache=None,
                  trace=None, trace_ring: int = 512,
                  flight_recorder_steps: int = 128):
         cfg = model.cfg
@@ -587,6 +608,23 @@ class ServingEngine:
         self._qkey = (self.kv_dtype or "kv_full", self.wq or "w_full",
                       ("tp", self.tp))
 
+        # --- persistent compile cache (ISSUE 14) ---
+        # compile_cache: a directory path (a CompileCache is built over
+        # it, fingerprinted with THIS engine's model/pool geometry) or
+        # a ready CompileCache instance (caller owns the fingerprint —
+        # sharing one instance across engines also shares its
+        # counters). Misses in the ProgramCache then consult disk
+        # before building, and `save_compile_cache()` persists every
+        # launched program so a restarted worker skips the bucket-grid
+        # compile storm.
+        if compile_cache is not None:
+            from .compile_cache import CompileCache
+            if not isinstance(compile_cache, CompileCache):
+                compile_cache = CompileCache(
+                    str(compile_cache), extra=self._geometry_signature())
+            self.programs.disk = compile_cache
+        self._sync_compile_cache_counters()
+
     def _caches_alive(self) -> bool:
         """Retry gate for the donated-buffer hazard: on TPU the compiled
         programs donate the K/V caches (`donate_argnums`), and a launch
@@ -766,8 +804,52 @@ class ServingEngine:
         self._key, sub = jax.random.split(self._key)
         return sub
 
+    def _geometry_signature(self) -> str:
+        """Model/engine-geometry signature for the compile-cache
+        fingerprint: an executable is only reusable when every array
+        SHAPE it was lowered against matches, so the weight-state
+        shapes/dtypes and the KV-pool geometry define validity (weight
+        VALUES are call-time arguments, not baked in)."""
+        import hashlib
+        state = ";".join(f"{k}:{tuple(a.shape)}:{a.dtype}"
+                         for k, a in sorted(self._state.items()))
+        sig = (f"{type(self.model).__name__}|{state}|"
+               f"pages={self.num_pages}x{self.page_size}|"
+               f"layers={self.num_layers}")
+        return hashlib.sha256(sig.encode()).hexdigest()[:16]
+
+    @property
+    def compile_cache(self):
+        """The persistent CompileCache (None when not configured)."""
+        return self.programs.disk
+
+    def _sync_compile_cache_counters(self):
+        """Mirror the CompileCache counters into the auto-exposed
+        metrics counters (the Prometheus drift-test registry): the
+        keys exist on every engine, zeroed when the cache is off."""
+        cc = self.programs.disk
+        if cc is not None:
+            for k in ("hits", "misses", "rejects"):
+                self.metrics.counters[f"compile_cache_{k}"] = \
+                    cc.counters[k]
+
+    def save_compile_cache(self) -> int:
+        """Persist every launched program to the compile cache (no-op
+        without one). Re-lowers AOT per new entry — a drain/shutdown-
+        time cost; returns entries written. Workers call this on
+        drain/SIGTERM so their successor reaches first-token without
+        the compile storm (ISSUE 14)."""
+        cc = self.programs.disk
+        if cc is None:
+            return 0
+        written = cc.save_all(self.programs)
+        self._sync_compile_cache_counters()
+        return written
+
     def _get_program(self, key, builder):
-        return self.programs.get(key, builder)
+        prog = self.programs.get(key, builder)
+        self._sync_compile_cache_counters()
+        return prog
 
     @property
     def num_compiled_programs(self) -> int:
@@ -1785,7 +1867,8 @@ class ServingEngine:
         self._retain(req)
 
     # --------------------------------------------------- snapshot/resume
-    def snapshot(self, reason: str = "requested") -> dict:
+    def snapshot(self, reason: str = "requested", *,
+                 include_recorder: bool = True) -> dict:
         """Serializable drain state: every non-finished request (queued,
         mid-prefill, decoding, preempted) with its prompt, tokens
         generated so far, and remaining deadline. Device state (KV
@@ -1793,7 +1876,11 @@ class ServingEngine:
         anyway; a resumed request re-prefills prompt+generated exactly
         like a preemption resume, so greedy outputs stay bit-identical
         under the same bucket grid. JSON-roundtrip-safe by construction
-        (plain ints/floats/lists only)."""
+        (plain ints/floats/lists only). `include_recorder=False` drops
+        the flight-recorder ring — the cross-process worker's
+        heartbeats ship a snapshot ~20x/s and the supervisor only reads
+        the request records, so the postmortem payload stays on the
+        drain/failure snapshots where it is read."""
         now = self._now()
         recs = []
         for req in self.requests.values():
@@ -1813,15 +1900,18 @@ class ServingEngine:
                     else float(req.deadline - now)),
             })
         recs.sort(key=lambda r: r["request_id"])   # FCFS order on resume
-        return {"version": SNAPSHOT_VERSION, "reason": str(reason),
+        snap = {"version": SNAPSHOT_VERSION, "minor": SNAPSHOT_MINOR,
+                "reason": str(reason),
                 "rng_key": np.asarray(self._key).tolist(),
-                "requests": recs,
-                # the engine's last N non-idle StepRecords ride every
-                # snapshot (ISSUE 10): an engine_failures postmortem
-                # reads the context straight out of the drain state.
-                # from_snapshot/adopt ignore the key, so the schema
-                # version is unchanged — old snapshots resume fine.
-                "flight_recorder": self.recorder.records()}
+                "requests": recs}
+        if include_recorder:
+            # the engine's last N non-idle StepRecords ride every
+            # snapshot (ISSUE 10): an engine_failures postmortem
+            # reads the context straight out of the drain state.
+            # from_snapshot/adopt ignore the key, so the schema
+            # version is unchanged — old snapshots resume fine.
+            snap["flight_recorder"] = self.recorder.records()
+        return snap
 
     def _restore_request(self, rec: dict) -> Request:
         """Rebuild one snapshot request record into THIS engine under

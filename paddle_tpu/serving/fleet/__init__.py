@@ -11,17 +11,30 @@ greedy output bit-identical to an uninterrupted run (SERVING.md
 
 Sync core: `Fleet` (submit/step_all/run — what the chaos soak drives
 deterministically). Async shell: `FleetServer` (per-replica stepping
-tasks + `TokenStream` async iterators).
+tasks + `TokenStream` async iterators), fronted over the wire by
+`HttpFrontend` (HTTP/SSE, ISSUE 14).
+
+Cross-process tier (ISSUE 14): `ProcessFleet` supervises replica
+WORKER PROCESSES (worker.py) over the framed TCPStore mailbox
+(transport.py) — process-isolated failure domains, crash-proof
+restart via heartbeat-shipped snapshots, and rolling restarts that
+skip the compile storm through the persistent
+`serving.compile_cache.CompileCache`.
 """
 from .errors import (NoHealthyReplica, ReplicaCrashed, SloUnattainable,
                      TenantThrottled)
 from .fleet import Fleet, FleetHandle
+from .http import HttpFrontend
+from .procfleet import ProcessFleet, WorkerProc, WorkerState
 from .replica import Replica, ReplicaState
 from .router import (PrefixAffinityRouter, RandomRouter, RoundRobinRouter,
                      Router)
 from .server import FleetServer, TokenStream
+from .transport import Channel, TransportError
 
 __all__ = ["Fleet", "FleetHandle", "FleetServer", "TokenStream",
            "Replica", "ReplicaState", "Router", "PrefixAffinityRouter",
            "RandomRouter", "RoundRobinRouter", "NoHealthyReplica",
-           "TenantThrottled", "SloUnattainable", "ReplicaCrashed"]
+           "TenantThrottled", "SloUnattainable", "ReplicaCrashed",
+           "HttpFrontend", "ProcessFleet", "WorkerProc", "WorkerState",
+           "Channel", "TransportError"]

@@ -1,0 +1,329 @@
+"""Framed, versioned mailbox transport for the cross-process fleet
+(ISSUE 14).
+
+The wire is the native TCPStore that already carries `dist.send/recv`
+p2p (round 4, `PADDLE_P2P_STORE`): one process binds the store, every
+peer connects as a client, and a **Channel** between two peers is a
+pair of sequence-numbered key streams inside it —
+
+    ptw/<session>/<src)>(dst>/head   monotonically allocated via add()
+    ptw/<session>/<src)>(dst>/<seq>  one framed message per key
+
+Ordered, at-most-once-per-seq delivery falls out of the store: send()
+allocates the next seq with an atomic add and writes the frame; recv()
+polls its next expected seq (capped exponential backoff between polls,
+per-call timeout), deletes the key it consumed, and advances. Nothing
+here blocks without a deadline.
+
+**Framing.** Every message is one frame:
+
+    MAGIC "PTW1" | u8 version | u32 body_len | u32 crc32(body) | body
+
+with a JSON body (the envelope: type/src/dst/seq/payload). A frame that
+fails the magic, version, length, or checksum raises a typed
+`TransportError` — version/framing mismatches are FATAL (a rolling
+restart mixing incompatible builds must fail loud), connect/timeout
+losses are TRANSIENT. The error carries `failure_class`, which the
+engine supervisor's `classify_failure` (PR 3) consults first, so
+transport failures route through the same transient/poison/fatal
+machinery as device launches.
+
+**Fault points** (armed by the soak; table in SERVING.md):
+
+* `transport.drop`      — recv reads a frame and DISCARDS it, as if the
+  network ate the message (recovery = the heartbeat snapshot path);
+* `transport.duplicate` — recv delivers the same message twice (the
+  exactly-once token funnel must dedup — asserted over the wire);
+* `transport.stall`     — the channel wedges for this call: recv reads
+  nothing even when messages are pending, send silently writes nothing
+  (returns -1). Armed with times=-1 it models a permanently wedged
+  endpoint — from outside, indistinguishable from a hung process: no
+  heartbeats out, no commands in, until the supervisor's hard-stall
+  ladder kills and adopts. Finite specs consume firings at BOTH sites.
+
+This module is importable without jax: the store object is injected
+(ducked-typed set/get/add/delete_key), and `bind_store`/`connect_store`
+import the native extension lazily.
+"""
+from __future__ import annotations
+
+import json
+import struct
+import time
+import zlib
+from typing import Any, Dict, List, Optional
+
+from ...utils import faults
+
+__all__ = ["TransportError", "Channel", "encode_frame", "decode_frame",
+           "bind_store", "connect_store", "free_port",
+           "TRANSPORT_VERSION", "FAULT_DROP", "FAULT_DUPLICATE",
+           "FAULT_STALL"]
+
+MAGIC = b"PTW1"
+TRANSPORT_VERSION = 1
+_HEADER = struct.Struct(">4sBII")          # magic, version, len, crc32
+
+# sentinel: a seq was consumed without yielding a message
+_CONSUMED = object()
+
+# Registered here (the module every transport endpoint imports), fired
+# at the RECV site so drop/duplicate/stall model the network without
+# corrupting the seq stream: a dropped frame is consumed-and-discarded,
+# a duplicate is delivered twice, a stall reads nothing this call.
+FAULT_DROP = faults.register_point("transport.drop")
+FAULT_DUPLICATE = faults.register_point("transport.duplicate")
+FAULT_STALL = faults.register_point("transport.stall")
+
+
+class TransportError(RuntimeError):
+    """A transport failure with an explicit supervisor classification:
+    `failure_class` is "transient" (connect/timeout/store loss —
+    retry/backoff is sane) or "fatal" (framing/version mismatch —
+    retrying re-reads the same garbage). `classify_failure` consults
+    the attribute before any of its own heuristics."""
+
+    def __init__(self, msg: str, failure_class: str = "transient"):
+        super().__init__(msg)
+        self.failure_class = failure_class
+
+
+def encode_frame(msg: dict) -> bytes:
+    body = json.dumps(msg, separators=(",", ":")).encode("utf-8")
+    return _HEADER.pack(MAGIC, TRANSPORT_VERSION, len(body),
+                        zlib.crc32(body)) + body
+
+
+def decode_frame(data: bytes) -> dict:
+    """Decode one frame; every rejection is typed and names what broke
+    (the compile-cache loader shares this fail-loud-but-classified
+    discipline). Truncated/corrupt frames are TRANSIENT (a half-written
+    store value may be re-sent); a version mismatch is FATAL."""
+    if len(data) < _HEADER.size:
+        raise TransportError(
+            f"short frame: {len(data)} < header {_HEADER.size}")
+    magic, version, body_len, crc = _HEADER.unpack_from(data)
+    if magic != MAGIC:
+        raise TransportError(f"bad frame magic {magic!r}",
+                             failure_class="fatal")
+    if version != TRANSPORT_VERSION:
+        raise TransportError(
+            f"transport version {version} != {TRANSPORT_VERSION} "
+            f"(mixed incompatible builds in one fleet)",
+            failure_class="fatal")
+    body = data[_HEADER.size:]
+    if len(body) != body_len:
+        raise TransportError(
+            f"frame length {len(body)} != declared {body_len}")
+    if zlib.crc32(body) != crc:
+        raise TransportError("frame checksum mismatch")
+    try:
+        return json.loads(body.decode("utf-8"))
+    except Exception as e:                                # noqa: BLE001
+        raise TransportError(f"frame body undecodable: {e}") from e
+
+
+def free_port() -> int:
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def bind_store(endpoint: str):
+    """Create/bind the master TCPStore at `endpoint` (host side).
+    Lazy native import — this module stays importable without jax."""
+    from ...distributed.env import create_store
+    return create_store(endpoint, rank=0)
+
+
+def connect_store(endpoint: str, timeout_ms: int = 120000):
+    """Connect to an existing store as a client (worker side)."""
+    from ...distributed.env import create_store
+    return create_store(endpoint, rank=1, timeout_ms=timeout_ms)
+
+
+class Channel:
+    """One directed pair of mailbox streams between `me` and `peer`.
+
+    send(type, **payload) frames and writes one message on the
+    me->peer stream. recv(timeout_s) returns the next message from the
+    peer->me stream (None on timeout); recv_all() drains everything
+    currently available without sleeping. Store losses surface as
+    transient `TransportError`s after `max_attempts` capped-backoff
+    retries of the failing store call."""
+
+    def __init__(self, store, me: str, peer: str, *,
+                 session: str = "s0", poll_s: float = 5e-4,
+                 poll_cap_s: float = 0.02, max_attempts: int = 5,
+                 backoff_s: float = 0.01, sleep=None):
+        self.store = store
+        self.me = str(me)
+        self.peer = str(peer)
+        self.session = str(session)
+        self.poll_s = float(poll_s)
+        self.poll_cap_s = float(poll_cap_s)
+        self.max_attempts = int(max_attempts)
+        self.backoff_s = float(backoff_s)
+        self._sleep = sleep if sleep is not None else time.sleep
+        self._next_recv = 1                 # next expected peer seq
+        self._pending: List[dict] = []      # duplicate-fault replays
+        # seq-hole repair: a sender that died (or exhausted its set()
+        # retries) between allocating a seq and writing its frame
+        # leaves a PERMANENT hole — the reader would poll it forever
+        # while later messages pile up behind. When the peer's head
+        # counter is past our cursor but the key stays absent for
+        # `hole_timeout_s`, the seq is skipped and counted (equivalent
+        # to a dropped frame; the snapshot/recent-finished machinery
+        # heals the content).
+        self.hole_timeout_s = 2.0
+        self._hole_first_miss: Optional[float] = None
+        self.counters: Dict[str, int] = {
+            "sent": 0, "received": 0, "dropped": 0, "duplicated": 0,
+            "stalls": 0, "undecodable": 0, "store_retries": 0,
+            "holes_skipped": 0}
+
+    # ---- key naming ------------------------------------------------------
+    def _key(self, src: str, dst: str, seq: int) -> str:
+        return f"ptw/{self.session}/{src}>{dst}/{seq}"
+
+    def _head(self, src: str, dst: str) -> str:
+        return f"ptw/{self.session}/{src}>{dst}/head"
+
+    # ---- guarded store IO ------------------------------------------------
+    def _store_call(self, what: str, fn, *args):
+        """One store operation with capped exponential backoff over
+        connection-class failures; exhaustion raises the TRANSIENT
+        TransportError the supervisor machinery retries/classifies."""
+        last = None
+        for attempt in range(self.max_attempts):
+            try:
+                return fn(*args)
+            except Exception as e:                        # noqa: BLE001
+                last = e
+                self.counters["store_retries"] += 1
+                self._sleep(min(1.0, self.backoff_s * (2 ** attempt)))
+        raise TransportError(
+            f"store {what} failed after {self.max_attempts} attempts: "
+            f"{last}") from last
+
+    # ---- send/recv -------------------------------------------------------
+    def send(self, type: str, **payload) -> int:
+        """Frame and write one message; returns its sequence number
+        (-1 when an armed `transport.stall` wedged the write — the
+        message is silently lost, exactly like a hung sender)."""
+        if faults.fire(FAULT_STALL) is not None:
+            self.counters["stalls"] += 1
+            return -1
+        seq = int(self._store_call(
+            "add", self.store.add, self._head(self.me, self.peer), 1))
+        msg = {"type": str(type), "src": self.me, "dst": self.peer,
+               "seq": seq, "payload": payload}
+        self._store_call("set", self.store.set,
+                         self._key(self.me, self.peer, seq),
+                         encode_frame(msg))
+        self.counters["sent"] += 1
+        return seq
+
+    def _read_next(self):
+        """Non-blocking: the next pending message, None when the
+        stream is empty (or stalled), or `_CONSUMED` when a seq was
+        consumed without yielding a message (dropped by fault, or a
+        corrupt frame skipped) — readers keep draining past those."""
+        if self._pending:
+            return self._pending.pop(0)
+        if faults.fire(FAULT_STALL) is not None:
+            self.counters["stalls"] += 1
+            return None
+        key = self._key(self.peer, self.me, self._next_recv)
+        data = self._store_call("get", self.store.get, key, False)
+        if data is None:
+            head = int(self._store_call(
+                "head", self.store.add,
+                self._head(self.peer, self.me), 0))
+            if head < self._next_recv:
+                self._hole_first_miss = None    # truly nothing sent yet
+                return None
+            # the peer allocated this seq but its frame is missing: a
+            # hole until proven otherwise (the write may simply be in
+            # flight — give it hole_timeout_s)
+            now = time.monotonic()
+            if self._hole_first_miss is None:
+                self._hole_first_miss = now
+                return None
+            if now - self._hole_first_miss < self.hole_timeout_s:
+                return None
+            self.counters["holes_skipped"] += 1
+            self._hole_first_miss = None
+            self._next_recv += 1
+            return _CONSUMED
+        self._hole_first_miss = None
+        self._next_recv += 1
+        try:
+            self._store_call("delete", self.store.delete_key, key)
+        except TransportError:
+            pass   # losing the delete only leaves a stale key behind
+        try:
+            msg = decode_frame(bytes(data))
+        except TransportError as e:
+            if e.failure_class == "fatal":
+                raise
+            self.counters["undecodable"] += 1
+            return _CONSUMED     # corrupt frame: count and skip it
+        if faults.fire(FAULT_DROP) is not None:
+            self.counters["dropped"] += 1
+            return _CONSUMED
+        if faults.fire(FAULT_DUPLICATE) is not None:
+            self.counters["duplicated"] += 1
+            self._pending.append(dict(msg))
+        self.counters["received"] += 1
+        return msg
+
+    def recv(self, timeout_s: float = 0.0) -> Optional[dict]:
+        """Next message, waiting up to `timeout_s` (0 = one poll).
+        Returns None on timeout — callers own their liveness policy."""
+        deadline = time.monotonic() + float(timeout_s)
+        delay = self.poll_s
+        while True:
+            msg = self._read_next()
+            if msg is _CONSUMED:
+                continue            # a seq was eaten; look again now
+            if msg is not None:
+                return msg
+            if time.monotonic() >= deadline:
+                return None
+            self._sleep(delay)
+            delay = min(self.poll_cap_s, delay * 2)
+
+    def recv_all(self, limit: int = 1024) -> List[dict]:
+        """Drain every currently-available message (bounded)."""
+        out = []
+        n = 0
+        while n < limit:
+            msg = self._read_next()
+            if msg is None:
+                break
+            n += 1
+            if msg is not _CONSUMED:
+                out.append(msg)
+        return out
+
+    def purge(self):
+        """Best-effort deletion of every outstanding frame + both head
+        keys of this channel (shutdown hygiene: frames a dead peer
+        never consumed would otherwise sit in the store for its
+        lifetime). Never raises — the store may already be gone."""
+        for src, dst, start in ((self.me, self.peer, 1),
+                                (self.peer, self.me, self._next_recv)):
+            try:
+                head = int(self.store.add(self._head(src, dst), 0))
+                for seq in range(start, head + 1):
+                    try:
+                        self.store.delete_key(self._key(src, dst, seq))
+                    except Exception:                     # noqa: BLE001
+                        pass
+                self.store.delete_key(self._head(src, dst))
+            except Exception:                             # noqa: BLE001
+                pass

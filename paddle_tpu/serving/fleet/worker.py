@@ -1,0 +1,374 @@
+"""Replica worker process (ISSUE 14): one ServingEngine behind the
+framed mailbox channel.
+
+`python -m paddle_tpu.serving.fleet.worker --spec <spec.json>` hosts a
+single engine and speaks the transport protocol with the supervising
+`ProcessFleet` (procfleet.py). The worker is the unit of failure the
+cross-process fleet shrinks the blast radius to: a segfault, OOM-kill
+or wedged device loop takes down ONE worker process, and the
+supervisor adopts its in-flight requests from the last shipped
+incremental snapshot with exactly-once token delivery.
+
+Protocol (all messages framed/versioned by transport.py; host->worker
+then worker->host):
+
+    adopt {recs}        -> adopted {rids} | reject {rids, error}
+    abort {rid}         -> (honored at the next engine boundary)
+    ping {}             -> pong {}
+    stats {reset_prefix_cache?} -> stats {kv_used_pages, *_ok, ...}
+    drain {} / SIGTERM  -> snapshot {final=true}, bye {}; exit 0
+    shutdown {}         -> bye {}; exit 0 (no snapshot: discard work)
+
+    ready {pid, geometry}        once, after the engine is built
+    events {ev: [[rid,idx,tok]]} after every engine step that emitted
+    finish {rid, reason, output_ids}
+    heartbeat {t, steps, load, counters, fired, snapshot}
+    failed {snapshot}            EngineFailure; exit 3
+
+Intake is `adopt_requests` (not `add_request`): the SUPERVISOR owns
+request ids (they must be unique fleet-wide and survive migration), so
+a fresh submit is just the adoption of a record with no output yet.
+Token events carry the request-stream INDEX, so the supervisor's
+exactly-once funnel can discard duplicated deliveries and re-order
+around dropped ones; after a crash-adoption the successor re-emits the
+overlap deterministically (greedy + same bucket grid) and the funnel
+drops it by index.
+
+Heartbeats ride an incremental snapshot (every non-finished request's
+prompt + tokens so far) — that snapshot is what survives a kill -9.
+The interval is spec-configurable (`heartbeat_interval_s`), and the
+loop clock is injectable for in-process tests (`WorkerLoop(clock=...)`).
+
+On SIGTERM the worker drains to a JSON snapshot on disk
+(`snapshot_path`), ships it as the final snapshot message, persists
+the compile cache (so its successor skips the bucket-grid compile
+storm), and exits 0.
+
+Fault point `worker.kill9` (registered here, fired once per loop
+iteration): an armed payload SIGKILLs the worker's own process — the
+un-graceful death the chaos soak proves zero-loss against. Module
+import stays jax-free; jax/engine imports happen inside `run_worker`.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from ...utils import faults
+from .transport import Channel, connect_store
+
+__all__ = ["run_worker", "WorkerLoop", "build_model", "FAULT_KILL9"]
+
+# Fires at the TOP of every worker loop iteration (an engine-boundary,
+# so the last shipped heartbeat snapshot is consistent): any payload ->
+# os.kill(getpid(), SIGKILL). The process cannot report the firing; the
+# supervisor proves it by the -SIGKILL returncode.
+FAULT_KILL9 = faults.register_point("worker.kill9")
+
+
+def build_model(model_spec: dict):
+    """Model from a JSON-safe spec: {"kind": "llama", "config": {...},
+    "seed": 0} via the registry, or {"factory": "pkg.mod:fn",
+    "kwargs": {...}} for anything else. Every worker (and the
+    supervisor's in-process baseline) building from the SAME spec gets
+    bit-identical weights — `paddle.seed` before construction — which
+    is what makes cross-process migration greedy-bit-identical."""
+    import paddle_tpu as paddle
+    seed = int(model_spec.get("seed", 0))
+    paddle.seed(seed)
+    if "factory" in model_spec:
+        import importlib
+        mod, _, fn = model_spec["factory"].partition(":")
+        factory = getattr(importlib.import_module(mod), fn)
+        return factory(**model_spec.get("kwargs", {}))
+    kind = model_spec.get("kind", "llama")
+    if kind == "llama":
+        from ...models.llama import LlamaConfig, LlamaForCausalLM
+        return LlamaForCausalLM(LlamaConfig(**model_spec["config"]))
+    if kind == "qwen2_moe":
+        from ...models.qwen2_moe import (Qwen2MoeConfig,
+                                         Qwen2MoeForCausalLM)
+        return Qwen2MoeForCausalLM(Qwen2MoeConfig(**model_spec["config"]))
+    raise ValueError(f"unknown model kind {kind!r}")
+
+
+def _arm_faults(specs: List[dict]):
+    """Arm fault points inside THIS worker process from JSON specs
+    ({"point", "payload"/"exc_transient", "times", "after", "prob",
+    "seed"}) — the registry is per-process, so chaos that must land in
+    a worker (kill9, a wedged transport) is armed here, not in the
+    supervisor."""
+    for fs in specs or []:
+        kw = {k: fs[k] for k in ("times", "after", "prob", "seed")
+              if k in fs}
+        if fs.get("exc_transient"):
+            from ...serving.errors import TransientDeviceError
+            kw["exc"] = TransientDeviceError(str(fs["exc_transient"]))
+        else:
+            kw["payload"] = fs.get("payload", True)
+        faults.inject(fs["point"], **kw)
+
+
+class WorkerLoop:
+    """The worker's engine-driving loop, factored for in-process tests
+    (`run_worker` wires a real store/process around it). One iteration:
+    fire kill9, drain channel messages, step the engine when it has
+    work, ship emissions/finishes, heartbeat on the (injectable)
+    clock."""
+
+    def __init__(self, engine, channel: Channel, *,
+                 heartbeat_interval_s: float = 0.05, clock=None,
+                 snapshot_path: Optional[str] = None):
+        self.engine = engine
+        self.chan = channel
+        self.hb_interval = float(heartbeat_interval_s)
+        self.clock = clock if clock is not None else time.monotonic
+        self.snapshot_path = snapshot_path
+        self.live: set = set()               # rids being generated
+        self.sent_counts: Dict[int, int] = {}   # rid -> next event index
+        # last finished requests, re-shipped with every heartbeat: a
+        # finish frame lost on the wire (transport.drop/stall) would
+        # otherwise strand its handle live forever on the supervisor —
+        # re-delivery is idempotent there (finalize checks finished)
+        self.recent_finished: deque = deque(maxlen=64)
+        self.steps = 0
+        self.heartbeats = 0
+        self.draining = False
+        self.shutdown = False
+        self._last_beat = -1e9
+
+    # ---- message handling ------------------------------------------------
+    def handle(self, msg: dict):
+        mtype = msg.get("type")
+        payload = msg.get("payload", {})
+        if mtype == "adopt":
+            # one rec at a time: a batch adopt that failed mid-way
+            # would leave the already-restored records running in this
+            # engine while the supervisor re-lands them elsewhere —
+            # the same request generating on two workers at once.
+            # Per-rec adoption gives exact partial-success semantics:
+            # only the records that actually failed are rejected.
+            adopted, failed, last_err = [], [], ""
+            for rec in payload.get("recs", []):
+                rid = int(rec["request_id"])
+                try:
+                    self.engine.adopt_requests([rec])
+                except Exception as e:                    # noqa: BLE001
+                    failed.append(rid)
+                    last_err = f"{type(e).__name__}: {e}"[:300]
+                    continue
+                self.live.add(rid)
+                # the supervisor already holds rec's tokens: events
+                # index from there, so re-emitted overlap after a
+                # crash-adoption dedups by index at the funnel
+                self.sent_counts[rid] = len(rec.get("output_ids", []))
+                adopted.append(rid)
+            if adopted:
+                self.chan.send("adopted", rids=adopted)
+            if failed:
+                self.chan.send("reject", rids=failed, error=last_err)
+        elif mtype == "abort":
+            self.engine.abort(int(payload["rid"]))
+        elif mtype == "ping":
+            self.chan.send("pong")
+        elif mtype == "stats":
+            # reclamation probe (the soak's full-reclamation check):
+            # optionally drop the prefix cache, then report pool state
+            # + invariant results
+            eng = self.engine
+            out = {}
+            if eng.radix is not None:
+                try:
+                    eng.radix.check_invariants()
+                    out["radix_ok"] = True
+                except Exception as e:                    # noqa: BLE001
+                    out["radix_ok"] = False
+                    out["radix_err"] = str(e)[:200]
+            if payload.get("reset_prefix_cache"):
+                eng.reset_prefix_cache()
+            try:
+                eng.allocator.check_invariants()
+                out["allocator_ok"] = True
+            except Exception as e:                        # noqa: BLE001
+                out["allocator_ok"] = False
+                out["allocator_err"] = str(e)[:200]
+            out["kv_used_pages"] = int(eng.allocator.num_used)
+            out["queue_depth"] = int(eng.scheduler.queue_depth)
+            out["num_compiled_programs"] = eng.num_compiled_programs
+            self.chan.send("stats", **out)
+        elif mtype == "drain":
+            self.draining = True
+        elif mtype == "shutdown":
+            self.shutdown = True
+
+    # ---- emission shipping -----------------------------------------------
+    def _ship(self, emitted):
+        from ..scheduler import RequestState
+        if emitted:
+            ev = []
+            for rid, tok in emitted:
+                idx = self.sent_counts.get(rid, 0)
+                self.sent_counts[rid] = idx + 1
+                ev.append([int(rid), int(idx), int(tok)])
+            self.chan.send("events", ev=ev)
+        for rid in sorted(self.live):
+            req = self.engine.requests.get(rid)
+            if req is None or req.state is RequestState.FINISHED:
+                self.live.discard(rid)
+                self.sent_counts.pop(rid, None)
+                fin = {"rid": int(rid),
+                       "reason": (req.finish_reason if req is not None
+                                  else "lost"),
+                       "output_ids": ([int(t) for t in req.output_ids]
+                                      if req is not None else [])}
+                self.recent_finished.append(fin)
+                self.chan.send("finish", **fin)
+
+    def heartbeat(self, force: bool = False):
+        now = self.clock()
+        if not force and now - self._last_beat < self.hb_interval:
+            return False
+        self._last_beat = now
+        self.heartbeats += 1
+        s = self.engine.scheduler
+        self.chan.send(
+            "heartbeat", t=float(now), steps=self.steps,
+            load=int(s.num_in_flight + s.queue_depth),
+            counters=self.engine.metrics.snapshot(),
+            fired=faults.fired_counts(),
+            # no flight recorder on the 20 Hz path: the supervisor only
+            # reads the request records; postmortem context rides the
+            # drain/failure snapshots
+            snapshot=self.engine.snapshot(reason="heartbeat",
+                                          include_recorder=False),
+            recent_finished=list(self.recent_finished))
+        return True
+
+    # ---- lifecycle -------------------------------------------------------
+    def drain_to_snapshot(self) -> dict:
+        """Graceful exit: snapshot everything non-finished, write it to
+        disk (the SIGTERM contract), persist the compile cache, ship
+        the final snapshot + bye."""
+        snap = self.engine.snapshot(reason="drain")
+        if self.snapshot_path:
+            try:
+                os.makedirs(os.path.dirname(self.snapshot_path)
+                            or ".", exist_ok=True)
+                with open(self.snapshot_path, "w") as f:
+                    json.dump(snap, f)
+            except OSError:
+                pass        # disk trouble must not block the handoff
+        # ship the handoff FIRST: save_compile_cache re-lowers AOT per
+        # new entry (seconds each on a cold cache) and a worker cannot
+        # heartbeat mid-save — the supervisor must already hold the
+        # final snapshot if its hard-stall ladder loses patience
+        self.chan.send("snapshot", final=True, snapshot=snap)
+        saved = 0
+        try:
+            saved = self.engine.save_compile_cache()
+        except Exception:                                 # noqa: BLE001
+            pass            # cache persistence is best-effort
+        self.chan.send("bye", fired=faults.fired_counts(),
+                       cache_saved=saved)
+        return snap
+
+    def step_once(self) -> bool:
+        """One loop iteration; returns True while the loop should
+        continue."""
+        if faults.fire(FAULT_KILL9) is not None:
+            os.kill(os.getpid(), signal.SIGKILL)
+        for msg in self.chan.recv_all():
+            self.handle(msg)
+        if self.shutdown:
+            self.chan.send("bye", fired=faults.fired_counts())
+            return False
+        if self.draining:
+            self.drain_to_snapshot()
+            return False
+        if self.engine.has_work():
+            emitted = self.engine.step()
+            self.steps += 1
+            self._ship(emitted)
+        else:
+            time.sleep(2e-3)
+        self.heartbeat()
+        return True
+
+
+def run_worker(spec: dict) -> int:
+    """Worker process entry: build engine + channel from `spec`, then
+    loop until drained/shut down. Returns the exit code."""
+    import jax
+    jax.config.update("jax_platforms", spec.get("platform", "cpu"))
+    from ..engine import ServingEngine
+    from ..errors import EngineFailure
+
+    model = build_model(spec["model"])
+    engine_kw = dict(spec.get("engine", {}))
+    if spec.get("compile_cache_dir"):
+        engine_kw["compile_cache"] = spec["compile_cache_dir"]
+    engine = ServingEngine(model, **engine_kw)
+
+    store = connect_store(spec["endpoint"],
+                          timeout_ms=int(spec.get("connect_timeout_ms",
+                                                  60000)))
+    chan = Channel(store, me=spec["name"], peer="host",
+                   session=spec.get("session", "s0"))
+    _arm_faults(spec.get("faults"))
+    loop = WorkerLoop(
+        engine, chan,
+        heartbeat_interval_s=float(spec.get("heartbeat_interval_s",
+                                            0.05)),
+        snapshot_path=spec.get("snapshot_path"))
+
+    # SIGTERM = deliberate eviction (rolling restart / scale-down):
+    # flip to draining so the NEXT boundary snapshots and exits — the
+    # handler itself must not touch the engine mid-step
+    signal.signal(signal.SIGTERM, lambda *_: setattr(loop, "draining",
+                                                     True))
+
+    chan.send("ready", pid=os.getpid(),
+              geometry={"max_seq_len": engine.max_seq_len,
+                        "num_pages": engine.num_pages,
+                        "compile_cache": bool(engine.compile_cache)})
+    loop.heartbeat(force=True)
+    try:
+        while loop.step_once():
+            pass
+    except EngineFailure as exc:
+        chan.send("failed",
+                  snapshot=(exc.snapshot
+                            if exc.snapshot is not None
+                            else engine.last_snapshot))
+        return 3
+    except Exception as exc:                              # noqa: BLE001
+        # anything else is a worker bug: ship what we know and die loud
+        try:
+            chan.send("failed",
+                      snapshot=engine.snapshot(
+                          reason=f"worker crash: {exc!r}"[:200]))
+        except Exception:                                 # noqa: BLE001
+            pass
+        return 4
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="paddle_tpu serving fleet worker process")
+    ap.add_argument("--spec", required=True,
+                    help="path to the worker spec JSON")
+    args = ap.parse_args(argv)
+    with open(args.spec) as f:
+        spec = json.load(f)
+    return run_worker(spec)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

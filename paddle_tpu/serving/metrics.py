@@ -82,6 +82,14 @@ class ServingMetrics:
             "decode_launch_steps": 0,      # K summed over those launches
             "decode_launch_rows": 0,       # live rows summed over them
             "multi_decode_slot_shortfall": 0,  # K-1 slots the pool denied
+            # --- persistent compile cache (ISSUE 14) ---
+            # mirrors of the engine's CompileCache counters (zero with
+            # the cache off): hits skipped a trace+compile entirely;
+            # rejects are corrupt/stale/mismatched entries that
+            # degraded to recompile (counted, never crashing)
+            "compile_cache_hits": 0,
+            "compile_cache_misses": 0,
+            "compile_cache_rejects": 0,
         }
         self._registered = False
         self._t_start = time.perf_counter()

@@ -47,23 +47,54 @@ class _TrackedProgram:
     """Callable wrapper over one compiled-program builder result: times
     the first launch (= jit compile) and keeps abstract arg shapes for
     later cost accounting. Transparent to call sites — engines only
-    ever `prog(*args)`."""
+    ever `prog(*args)`.
+
+    Disk-loaded programs (ISSUE 14) ride the same wrapper with
+    `from_disk=True` and a `fallback` builder: a deserialized
+    executable that fails its FIRST call (a stale entry whose damage
+    the checksums could not see — e.g. an aval-shape drift) is
+    replaced by a fresh build in place, counted as a cache reject —
+    the persistent cache degrades to recompile, never to a crashed
+    worker."""
 
     __slots__ = ("fn", "key", "first_call_ms", "arg_avals", "_cost",
-                 "_comm")
+                 "_comm", "from_disk", "fallback", "on_reject")
 
-    def __init__(self, fn, key):
+    def __init__(self, fn, key, *, from_disk=False, fallback=None,
+                 on_reject=None):
         self.fn = fn
         self.key = key
         self.first_call_ms = None
         self.arg_avals = None
         self._cost = None
         self._comm = {}
+        self.from_disk = from_disk
+        self.fallback = fallback
+        self.on_reject = on_reject
 
     def __call__(self, *args):
         if self.first_call_ms is None:
             t0 = time.perf_counter()
-            out = self.fn(*args)
+            if self.from_disk and self.fallback is not None:
+                try:
+                    out = self.fn(*args)
+                except Exception as exc:                  # noqa: BLE001
+                    # Only a FATAL failure indicts the ENTRY (stale
+                    # avals, foreign executable). Transient device
+                    # errors and poison must propagate to the engine's
+                    # supervisor — its retry path owns the donated-
+                    # buffer hazard, and a perfectly good entry must
+                    # not be rejected for the device's flakiness.
+                    from .supervisor import FATAL, classify_failure
+                    if classify_failure(exc) != FATAL:
+                        raise
+                    self.fn = self.fallback()
+                    self.from_disk = False
+                    if self.on_reject is not None:
+                        self.on_reject()
+                    out = self.fn(*args)
+            else:
+                out = self.fn(*args)
             dt = time.perf_counter() - t0
             self.first_call_ms = round(dt * 1e3, 3)
             try:
@@ -74,7 +105,8 @@ class _TrackedProgram:
             from ..profiler import compile_log
             compile_log.log_event(
                 "program_compile", name=str(self.key[0]), duration_s=dt,
-                detail={"key": repr(self.key)[:120]})
+                detail={"key": repr(self.key)[:120],
+                        "from_disk": self.from_disk})
             return out
         return self.fn(*args)
 
@@ -136,11 +168,16 @@ class ProgramCache:
     miss) — the engine's recompile counter.
     """
 
-    def __init__(self, on_compile: Optional[Callable[[], None]] = None):
+    def __init__(self, on_compile: Optional[Callable[[], None]] = None,
+                 disk=None):
         self._programs: Dict[tuple, object] = {}
         self._bounds: Dict[str, Callable[[], int]] = {}
         self._counts: Dict[str, int] = {}
         self._on_compile = on_compile
+        # optional persistent CompileCache (ISSUE 14): consulted on
+        # every miss BEFORE the builder; set post-construction by the
+        # engine (`self.programs.disk = CompileCache(...)`)
+        self.disk = disk
 
     def register_family(self, family: str, bound: Callable[[], int]):
         """Declare a program family and its (lazily evaluated) compile
@@ -170,11 +207,31 @@ class ProgramCache:
                 f"program family {family!r} would exceed its compile "
                 f"bound {bound} with key {key!r} — a key axis is not "
                 f"riding the bucket grid")
-        prog = _TrackedProgram(builder(), key)
+        loaded = self.disk.load(key) if self.disk is not None else None
+        if loaded is not None:
+            # disk hit: the deserialized executable skips trace AND
+            # compile; `builder` stays attached as the first-call
+            # fallback, and a fallback rebuild counts a disk reject.
+            # NOT a compile for on_compile/metrics purposes — the
+            # recompiles counter keeps meaning "XLA compiled here".
+            def _reject():
+                # hits stays MONOTONIC (it is exposed as a Prometheus
+                # counter; a decrement would read as a counter reset):
+                # net useful hits = hits - rejects
+                self.disk.counters["rejects"] += 1
+                # a checksummed-but-unrunnable entry: mark it so the
+                # next save_all REWRITES it from the fresh build
+                self.disk.rejected_keys.add(key)
+                if self._on_compile is not None:
+                    self._on_compile()   # the fallback IS a compile
+            prog = _TrackedProgram(loaded, key, from_disk=True,
+                                   fallback=builder, on_reject=_reject)
+        else:
+            prog = _TrackedProgram(builder(), key)
+            if self._on_compile is not None:
+                self._on_compile()
         self._programs[key] = prog
         self._counts[family] += 1
-        if self._on_compile is not None:
-            self._on_compile()
         return prog
 
     # ------------------------------------------------------------ counts

@@ -50,7 +50,14 @@ _TRANSIENT_MARKERS = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED",
 
 def classify_failure(exc: BaseException) -> str:
     """Sort an exception from a compiled-step launch into
-    transient / poison / fatal."""
+    transient / poison / fatal. An exception that carries its own
+    `failure_class` attribute (the fleet transport's typed
+    `TransportError`, ISSUE 14) is believed verbatim — the raiser
+    knows whether a retry can help better than a message heuristic
+    does — as long as it names one of the three bins."""
+    own = getattr(exc, "failure_class", None)
+    if own in (TRANSIENT, POISON, FATAL):
+        return own
     if isinstance(exc, (PoisonedComputation, FloatingPointError)):
         return POISON
     if isinstance(exc, TransientDeviceError):

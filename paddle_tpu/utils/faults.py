@@ -22,10 +22,15 @@ Registered points (grep for `faults.register_point` /
 `faults.fire`; full table with trigger semantics in SERVING.md "Fault
 injection points"): serving KV allocator OOM, engine
 prefill/decode/verify step exceptions, NaN-logits poisoning, deadline
-storms, draft storms, radix donation failure, and the fleet points
-(replica crash, stream stall, route race). `bench.py` uses the
-BENCH_FAULT_INJECT env var instead — its supervisor must stay
-importable without this package.
+storms, draft storms, radix donation failure, the fleet points
+(replica crash, stream stall, route race), and the cross-process tier
+(ISSUE 14): `transport.drop` / `transport.duplicate` /
+`transport.stall` on the mailbox channel, `worker.kill9` (SIGKILL of
+the worker's own process; armed INSIDE the worker via its spec — the
+registry is per-process), and `cache.corrupt_entry` on the persistent
+compile cache's read path. `bench.py` uses the BENCH_FAULT_INJECT env
+var instead — its supervisor must stay importable without this
+package.
 """
 from __future__ import annotations
 

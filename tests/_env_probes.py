@@ -224,6 +224,39 @@ def gspmd_tp_mesh():
 
 
 @functools.lru_cache(maxsize=None)
+def subprocess_workers():
+    """Can this environment spawn python subprocesses and bind the
+    native TCPStore loopback mailbox — the substrate of the
+    cross-process fleet (ISSUE 14)? Light probe: a trivial child
+    process + one store set/get; the heavyweight jax-importing worker
+    is only ever spawned by tests this gates."""
+    try:
+        from paddle_tpu._native import TCPStore
+    except Exception as e:                                 # noqa: BLE001
+        return False, f"native TCPStore unavailable ({str(e)[:120]})"
+    try:
+        store = TCPStore("127.0.0.1", _free_port(), is_master=True,
+                         timeout_ms=5000)
+        store.set("probe", b"x")
+        if bytes(store.get("probe")) != b"x":
+            return False, "TCPStore loopback roundtrip corrupted"
+        del store
+    except Exception as e:                                 # noqa: BLE001
+        return False, f"TCPStore loopback failed ({str(e)[:120]})"
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)   # never touch the TPU grant
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", "print('SPAWN_OK')"], env=env,
+            capture_output=True, timeout=_PROBE_TIMEOUT_S, text=True)
+    except Exception as e:                                 # noqa: BLE001
+        return False, f"python subprocess spawn failed ({e})"
+    if out.returncode != 0 or "SPAWN_OK" not in out.stdout:
+        return False, "python subprocess spawn failed"
+    return True, "subprocess + TCPStore loopback work"
+
+
+@functools.lru_cache(maxsize=None)
 def banked_average_bitwise():
     """Does this XLA CPU build round a k-step banked-average update
     bitwise-identically to the direct update? (The gradient-merge test

@@ -58,6 +58,7 @@ def test_unconditional_variant():
                                 cfg.image_size)
 
 
+@pytest.mark.slow   # tier-1 870s budget (PR 14): heavy convergence/smoke kept for `make test`
 def test_train_loss_decreases(cfg):
     paddle.seed(0)
     m = DiT(cfg)

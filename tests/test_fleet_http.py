@@ -1,0 +1,176 @@
+"""HTTP/SSE front door over FleetServer (ISSUE 14): SSE token-delta
+streaming, the non-streaming JSON mode, /metrics (the existing
+Prometheus body), /healthz from replica heartbeats, and error
+mapping — all over a real loopback socket.
+
+Tier-1 budget note: the end-to-end test carries the coverage; the
+unhealthy-503 / shed-429 variants are slow-marked (each pays its own
+engine compiles) and run via `make test` / `make soak-fleet-proc`."""
+import asyncio
+import json
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import (Fleet, FleetServer, HttpFrontend,
+                                ServingEngine)
+
+KW = dict(num_pages=40, page_size=8, token_budget=48, batch_buckets=[8],
+          prefill_buckets=[32], pages_buckets=[8], temperature=0.0,
+          max_queue_len=16)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = LlamaConfig(vocab_size=128, hidden_size=128,
+                      intermediate_size=256, num_hidden_layers=2,
+                      num_attention_heads=2, num_key_value_heads=1,
+                      max_position_embeddings=128)
+    paddle.seed(0)
+    return LlamaForCausalLM(cfg)
+
+
+async def _request(port, method, path, body=None):
+    """One raw HTTP/1.1 exchange; returns (status, headers, body)."""
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    payload = b"" if body is None else json.dumps(body).encode()
+    writer.write(
+        f"{method} {path} HTTP/1.1\r\nHost: x\r\n"
+        f"Content-Length: {len(payload)}\r\n\r\n".encode() + payload)
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    head, _, rest = raw.partition(b"\r\n\r\n")
+    lines = head.decode().split("\r\n")
+    status = int(lines[0].split(" ")[1])
+    headers = dict(ln.split(": ", 1) for ln in lines[1:] if ": " in ln)
+    return status, headers, rest
+
+
+def _sse_events(body: bytes):
+    out = []
+    for chunk in body.decode().split("\n\n"):
+        if chunk.startswith("data: "):
+            data = chunk[len("data: "):]
+            out.append(data if data == "[DONE]" else json.loads(data))
+    return out
+
+
+def test_http_frontend_end_to_end(model):
+    async def scenario():
+        engines = [ServingEngine(model, **KW) for _ in range(2)]
+        fleet = Fleet(engines)
+        results = {}
+        async with FleetServer(fleet) as server:
+            async with HttpFrontend(server, port=0) as front:
+                port = front.port
+                # healthz while healthy
+                st, _, body = await _request(port, "GET", "/healthz")
+                results["healthz"] = (st, json.loads(body))
+                # streaming completion (SSE)
+                st, hdr, body = await _request(
+                    port, "POST", "/v1/completions",
+                    {"prompt_ids": [1, 2, 3, 4, 5],
+                     "max_new_tokens": 6})
+                results["sse"] = (st, hdr, _sse_events(body))
+                # non-streaming completion
+                st, _, body = await _request(
+                    port, "POST", "/v1/completions",
+                    {"prompt_ids": [1, 2, 3, 4, 5],
+                     "max_new_tokens": 6, "stream": False})
+                results["json"] = (st, json.loads(body))
+                # metrics = the fleet's Prometheus body
+                st, hdr, body = await _request(port, "GET", "/metrics")
+                results["metrics"] = (st, hdr, body.decode())
+                # 404 + 400
+                st, _, _ = await _request(port, "GET", "/nope")
+                results["notfound"] = st
+                st, _, _ = await _request(port, "POST",
+                                          "/v1/completions",
+                                          {"wrong": True})
+                results["bad"] = st
+        fleet.shutdown()
+        return results
+
+    r = asyncio.run(scenario())
+    st, health = r["healthz"]
+    assert st == 200 and health["status"] == "ok"
+    assert set(health["replicas"]) == {"replica-0", "replica-1"}
+    assert all("heartbeat_age_s" in v
+               for v in health["replicas"].values())
+
+    st, hdr, events = r["sse"]
+    assert st == 200
+    assert hdr["Content-Type"].startswith("text/event-stream")
+    assert events[-1] == "[DONE]"
+    assert events[-2]["type"] == "finish"
+    toks = [e["token"] for e in events[:-2]]
+    assert all(e["type"] == "token" for e in events[:-2])
+    assert [e["index"] for e in events[:-2]] == list(range(len(toks)))
+    assert len(toks) == 6
+
+    st, doc = r["json"]
+    assert st == 200
+    # same prompt, same grid: the non-streaming call must match the
+    # streamed tokens exactly (the determinism contract)
+    assert doc["tokens"] == toks
+    assert doc["finish_reason"] in ("length", "stop")
+
+    st, hdr, text = r["metrics"]
+    assert st == 200
+    assert hdr["Content-Type"].startswith("text/plain")
+    assert "# TYPE paddle_serving_requests_added counter" in text
+    assert 'replica="replica-0"' in text
+
+    assert r["notfound"] == 404
+    assert r["bad"] == 400
+
+
+@pytest.mark.slow
+def test_healthz_unavailable_when_no_replica_healthy(model):
+    async def scenario():
+        from paddle_tpu.serving.fleet.replica import ReplicaState
+        engines = [ServingEngine(model, **KW)]
+        fleet = Fleet(engines)
+        async with FleetServer(fleet) as server:
+            async with HttpFrontend(server, port=0) as front:
+                fleet.replicas[0].state = ReplicaState.UNHEALTHY
+                st, _, body = await _request(front.port, "GET",
+                                             "/healthz")
+        fleet.shutdown()
+        return st, json.loads(body)
+
+    st, doc = asyncio.run(scenario())
+    assert st == 503
+    assert doc["status"] == "unavailable"
+
+
+@pytest.mark.slow
+def test_shed_maps_to_429(model):
+    """Admission sheds surface as HTTP 429 with the typed error name."""
+    async def scenario():
+        engines = [ServingEngine(model, **KW)]
+        fleet = Fleet(engines, max_inflight_per_tenant=1)
+        async with FleetServer(fleet) as server:
+            async with HttpFrontend(server, port=0) as front:
+                st1, _, _ = await _request(
+                    front.port, "POST", "/v1/completions",
+                    {"prompt_ids": [1, 2, 3], "max_new_tokens": 40,
+                     "stream": False, "tenant": "t1"})
+                # the first request finished (collect drained it), so
+                # submit two overlapping streams instead: open one SSE
+                # without reading it to completion is racy — use the
+                # tenant cap with a long request via the sync fleet
+                fleet.submit([4, 5, 6], max_new_tokens=30, tenant="t2")
+                st2, _, body = await _request(
+                    front.port, "POST", "/v1/completions",
+                    {"prompt_ids": [7, 8, 9], "max_new_tokens": 4,
+                     "stream": False, "tenant": "t2"})
+        fleet.shutdown()
+        return st1, st2, body
+
+    st1, st2, body = asyncio.run(scenario())
+    assert st1 == 200
+    assert st2 == 429
+    assert json.loads(body)["error"] == "TenantThrottled"

@@ -205,3 +205,44 @@ def test_fleet_evacuation_checks_version(model):
         fleet._evacuate(fleet.replicas[0], bad)
     fleet.run()
     fleet.shutdown()
+
+
+# ------------------- forward-compat minor (ISSUE 14 satellite) -------------
+def test_snapshot_carries_minor_and_newer_minor_warns_not_fails(model):
+    """A rolling restart mixes worker builds: a same-major snapshot
+    from a NEWER minor (extra fields this build does not know) must
+    adopt with a warning, not fail — only a MAJOR mismatch refuses."""
+    import warnings
+    from paddle_tpu.serving.engine import SNAPSHOT_MINOR
+    eng = ServingEngine(model, **KW)
+    eng.add_request([1, 2, 3, 4, 5], max_new_tokens=3)
+    snap = eng.snapshot(reason="test")
+    eng.shutdown()
+    assert snap["minor"] == SNAPSHOT_MINOR
+    # pretend a newer worker wrote it: bumped minor + unknown EXTRA keys
+    snap["minor"] = SNAPSHOT_MINOR + 3
+    snap["page_payload_manifest"] = {"pages": [1, 2]}    # unknown
+    snap["requests"][0]["speculative_state"] = "x"       # unknown (rec)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        resumed = ServingEngine.from_snapshot(model, snap, **KW)
+    assert any("newer same-major" in str(x.message) and
+               "page_payload_manifest" in str(x.message) for x in w)
+    out = resumed.run()
+    assert len(out[snap["requests"][0]["request_id"]]) == 3
+    resumed.shutdown()
+
+
+def test_snapshot_old_without_minor_still_resumes(model):
+    """Backward direction: a snapshot from BEFORE the minor field
+    existed (no `minor` key) resumes silently."""
+    import warnings
+    eng = ServingEngine(model, **KW)
+    eng.add_request([1, 2, 3, 4], max_new_tokens=2)
+    snap = eng.snapshot(reason="test")
+    eng.shutdown()
+    del snap["minor"]
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        check_snapshot_version(snap)
+    assert not w

@@ -186,6 +186,7 @@ def _hit_stats(model, n_replicas, router, waves):
     return snap["prefix_hits"], snap["cached_tokens_served"]
 
 
+@pytest.mark.slow   # tier-1 870s budget (PR 14): the soak asserts this criterion too
 def test_prefix_affinity_beats_random_routing(model):
     """The acceptance criterion in miniature: on a shared-prefix
     workload the fleet-level radix hit rate under prefix-affinity

@@ -1,0 +1,177 @@
+"""Transport layer of the cross-process fleet (ISSUE 14): framing,
+versioning, the mailbox channel over a real loopback TCPStore, fault
+points, and the TransportError -> classify_failure contract."""
+import pytest
+
+from paddle_tpu.serving.fleet import transport
+from paddle_tpu.serving.fleet.transport import (Channel, TransportError,
+                                                decode_frame,
+                                                encode_frame)
+from paddle_tpu.serving.supervisor import (FATAL, TRANSIENT,
+                                           classify_failure)
+from paddle_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    faults.reset_counts()
+    yield
+    faults.clear()
+    faults.reset_counts()
+
+
+# ---------------------------------------------------------------- framing
+def test_frame_roundtrip():
+    msg = {"type": "events", "src": "w0", "dst": "host", "seq": 3,
+           "payload": {"ev": [[1, 0, 42]]}}
+    assert decode_frame(encode_frame(msg)) == msg
+
+
+def test_frame_rejects_are_typed_and_classified():
+    frame = encode_frame({"a": 1})
+    # short
+    with pytest.raises(TransportError) as e:
+        decode_frame(frame[:5])
+    assert e.value.failure_class == "transient"
+    # bad magic -> fatal
+    with pytest.raises(TransportError) as e:
+        decode_frame(b"XXXX" + frame[4:])
+    assert e.value.failure_class == "fatal"
+    # version mismatch -> fatal (mixed builds must fail loud)
+    bad = bytearray(frame)
+    bad[4] = transport.TRANSPORT_VERSION + 1
+    with pytest.raises(TransportError) as e:
+        decode_frame(bytes(bad))
+    assert e.value.failure_class == "fatal"
+    # flipped body byte -> checksum reject (transient: re-send heals)
+    corrupt = bytearray(frame)
+    corrupt[-1] ^= 0xFF
+    with pytest.raises(TransportError) as e:
+        decode_frame(bytes(corrupt))
+    assert e.value.failure_class == "transient"
+    # truncated body
+    with pytest.raises(TransportError):
+        decode_frame(frame[:-2])
+
+
+def test_transport_error_routes_through_classify_failure():
+    """PR-3 contract: the supervisor machinery believes the error's
+    own failure_class, so transport failures retry (transient) or fail
+    loud (fatal) without string heuristics."""
+    assert classify_failure(TransportError("lost")) == TRANSIENT
+    assert classify_failure(
+        TransportError("bad version", failure_class="fatal")) == FATAL
+    # nonsense classes fall back to the usual heuristics
+    weird = TransportError("whatever")
+    weird.failure_class = "nonsense"
+    assert classify_failure(weird) == FATAL
+
+
+# ---------------------------------------------------------------- channel
+@pytest.fixture(scope="module")
+def store():
+    from paddle_tpu.serving.fleet.transport import bind_store, free_port
+    return bind_store(f"127.0.0.1:{free_port()}")
+
+
+def _pair(store, session):
+    a = Channel(store, me="host", peer="w0", session=session)
+    b = Channel(store, me="w0", peer="host", session=session)
+    return a, b
+
+
+def test_channel_ordered_delivery(store):
+    a, b = _pair(store, "t_order")
+    for i in range(5):
+        a.send("ping", i=i)
+    got = b.recv_all()
+    assert [m["payload"]["i"] for m in got] == list(range(5))
+    assert all(m["type"] == "ping" for m in got)
+    assert b.recv(timeout_s=0.0) is None      # drained
+    # the reply direction is independent
+    b.send("pong")
+    assert a.recv(timeout_s=1.0)["type"] == "pong"
+
+
+def test_channel_recv_timeout_returns_none(store):
+    a, _ = _pair(store, "t_timeout")
+    assert a.recv(timeout_s=0.02) is None
+
+
+def test_channel_drop_duplicate_stall_faults(store):
+    a, b = _pair(store, "t_faults")
+    # duplicate: delivered twice, back to back
+    with faults.injected("transport.duplicate", payload=True, times=1):
+        a.send("x", n=1)
+        got = b.recv_all()
+    assert [m["payload"]["n"] for m in got] == [1, 1]
+    assert b.counters["duplicated"] == 1
+    # drop: consumed and discarded — the seq stream stays contiguous
+    with faults.injected("transport.drop", payload=True, times=1):
+        a.send("x", n=2)
+        a.send("x", n=3)
+        got = b.recv_all()
+    assert [m["payload"]["n"] for m in got] == [3]
+    assert b.counters["dropped"] == 1
+    # stall: nothing read this call even though a message is pending
+    a.send("x", n=4)
+    with faults.injected("transport.stall", payload=True, times=1):
+        assert b.recv(timeout_s=0.0) is None
+    assert b.counters["stalls"] == 1
+    assert b.recv(timeout_s=1.0)["payload"]["n"] == 4
+    fired = faults.fired_counts()
+    assert fired["transport.drop"] == 1
+    assert fired["transport.duplicate"] == 1
+    assert fired["transport.stall"] == 1
+
+
+def test_channel_store_failure_backoff_and_typed_raise():
+    class DeadStore:
+        calls = 0
+
+        def add(self, key, delta):
+            DeadStore.calls += 1
+            raise ConnectionError("connection reset")
+
+    sleeps = []
+    ch = Channel(DeadStore(), me="a", peer="b", max_attempts=3,
+                 backoff_s=0.01, sleep=sleeps.append)
+    with pytest.raises(TransportError) as e:
+        ch.send("ping")
+    assert e.value.failure_class == "transient"
+    assert classify_failure(e.value) == TRANSIENT
+    assert DeadStore.calls == 3
+    # capped exponential backoff between attempts
+    assert sleeps == [0.01, 0.02, 0.04]
+    assert ch.counters["store_retries"] == 3
+
+
+def test_seq_hole_is_skipped_after_timeout(store):
+    """A sender that died between allocating a seq (add) and writing
+    its frame (set) leaves a permanent hole; the reader must skip it
+    after hole_timeout_s instead of wedging forever — later messages
+    (written at higher seqs) still flow."""
+    a, b = _pair(store, "t_hole")
+    b.hole_timeout_s = 0.05
+    # simulate the torn send: seq allocated, frame never written
+    store.add("ptw/t_hole/host>w0/head", 1)
+    a.send("x", n=2)                  # lands at seq 2, behind the hole
+    assert b.recv(timeout_s=0.02) is None     # within the grace window
+    got = b.recv(timeout_s=1.0)
+    assert got["payload"]["n"] == 2
+    assert b.counters["holes_skipped"] == 1
+
+
+def test_corrupt_frame_on_wire_is_skipped_not_fatal(store):
+    """A corrupt store value (not a version mismatch) is counted and
+    skipped; later messages still flow."""
+    a, b = _pair(store, "t_corrupt")
+    seq = a.send("x", n=1)
+    raw = bytearray(store.get(f"ptw/t_corrupt/host>w0/{seq}"))
+    raw[-1] ^= 0xFF
+    store.set(f"ptw/t_corrupt/host>w0/{seq}", bytes(raw))
+    a.send("x", n=2)
+    got = b.recv_all()
+    assert [m["payload"]["n"] for m in got] == [2]
+    assert b.counters["undecodable"] == 1

@@ -217,6 +217,7 @@ def test_scan_iter_break_masks_early_exit():
                                rtol=1e-6)
 
 
+@pytest.mark.slow   # tier-1 870s budget (PR 14): heavy convergence/smoke kept for `make test`
 def test_nested_scan_loops_never_lose_closure_grads():
     """An outer long loop containing an inner long loop whose body reads
     a parameter only under a predicate that is False at outer iteration

@@ -123,6 +123,7 @@ def test_greedy_identity_vs_k1_int8_kv():
                for k in eng.programs.keys())
 
 
+@pytest.mark.slow   # tier-1 870s budget (PR 14): joins this module's make-test slow set
 def test_tokens_per_launch_at_full_batch(model):
     """Full batch, uniform lengths, no EOS: every row emits its cap
     each launch, so tokens per row-launch >= 0.9 K."""

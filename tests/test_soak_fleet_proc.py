@@ -1,0 +1,27 @@
+"""Slow wrapper for the CROSS-PROCESS fleet chaos soak (ISSUE 14
+acceptance): seeded kill -9 mid-stream, a permanently wedged worker, a
+slow-heartbeat worker under load, wire drop/duplicate, the >= 5x
+cold-vs-warm compile-cache bench, and a rolling restart — 3 seeds, all
+streams bit-identical to the in-process reference, zero lost/
+duplicated. Excluded from tier-1 by the `slow` marker; run with
+`make soak-fleet-proc` or `pytest tests/test_soak_fleet_proc.py -m
+slow`. Gated on the subprocess capability probe."""
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from _env_probes import skip_unless, subprocess_workers
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@skip_unless(subprocess_workers)
+def test_soak_fleet_proc_seeds(seed):
+    from tools import soak_fleet
+    assert soak_fleet.main(["--procs", "--requests", "30",
+                            "--seed", str(seed)]) == 0

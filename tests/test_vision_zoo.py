@@ -15,6 +15,8 @@ def img():
         np.random.RandomState(0).randn(1, 3, 64, 64).astype(np.float32))
 
 
+@pytest.mark.slow   # tier-1 870s budget (PR 14): full zoo forward
+# smoke kept for `make test`
 @pytest.mark.parametrize("ctor,params_M", [
     (lambda: M.alexnet(num_classes=5), 57.0),
     (lambda: M.vgg11(num_classes=5), 128.8),
@@ -37,6 +39,7 @@ def test_model_forward_and_params(ctor, params_M, img):
     assert abs(n - params_M) / params_M < 0.25, f"param count {n}M"
 
 
+@pytest.mark.slow   # tier-1 870s budget (PR 14): heavy convergence/smoke kept for `make test`
 def test_mobilenet_trains():
     paddle.seed(0)
     m = M.mobilenet_v1(scale=0.25, num_classes=3)
@@ -54,6 +57,7 @@ def test_mobilenet_trains():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow   # tier-1 870s budget (PR 14): heavy convergence/smoke kept for `make test`
 def test_unet_train_and_ddim_sample():
     from paddle_tpu.models.unet import unet_tiny, GaussianDiffusion
     paddle.seed(0)
@@ -79,6 +83,7 @@ def test_unet_train_and_ddim_sample():
     assert np.isfinite(np.asarray(img._data)).all()
 
 
+@pytest.mark.slow   # tier-1 870s budget (PR 14): heavy convergence/smoke kept for `make test`
 def test_unet_to_static_compiles():
     from paddle_tpu.models.unet import unet_tiny
     paddle.seed(0)

@@ -35,6 +35,29 @@ Usage:  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
             python tools/soak_fleet.py [--requests 120] [--seed 0]
 (or `make soak-fleet`). Exits 0 on success, 1 with a report on
 violation — a test harness like soak_serving.py, allowed to fail loud.
+
+`--procs` (ISSUE 14, `make soak-fleet-proc`) runs the CROSS-PROCESS
+chaos ladder instead: real worker processes behind the TCPStore
+mailbox —
+
+* in-process reference pass (also warms the shared compile cache) and
+  the cold-vs-warm compile-cache bench (warm cold-start-to-first-token
+  must be >= 5x faster than cold compile; a corrupted entry degrades
+  to a counted recompile mid-bench);
+* clean 3-worker cross-process pass — streams BIT-IDENTICAL to the
+  in-process reference;
+* chaos pass: seeded kill -9 of w0 mid-stream (worker.kill9, proven
+  by the -SIGKILL returncode), a PERMANENTLY wedged w1
+  (transport.stall times=-1 worker-side: no heartbeats out, no
+  commands in -> the hard-stall ladder kills + adopts), w2 a
+  slow-heartbeat worker under load (visible SUSPECT gaps, survives)
+  that also absorbs a finite transport.stall (reported via heartbeat
+  fired counts), with transport.drop / transport.duplicate armed
+  host-side on the event streams. All requests complete bit-identical,
+  zero lost, zero funnel conflicts, full reclamation on survivors;
+* rolling restart: drain -> respawn -> adopt with exactly-once
+  delivery, the successor warm-starting from the disk cache (zero
+  recompiles), heartbeat gaps visible in the Prometheus text.
 """
 from __future__ import annotations
 
@@ -265,10 +288,371 @@ def run_pass(model, work, *, n_replicas, router, chaos, seed, report,
         fleet.shutdown()
 
 
+# ===================== cross-process ladder (ISSUE 14) =====================
+
+CFG_DICT = dict(vocab_size=128, hidden_size=128, intermediate_size=256,
+                num_hidden_layers=2, num_attention_heads=2,
+                num_key_value_heads=1, max_position_embeddings=128)
+PROC_SUSPECT_S = 0.5
+PROC_DEAD_S = 6.0
+
+
+def _drive_engine(eng, work):
+    """Drain `work` through one in-process engine with client-side
+    pacing (the queue is bounded); returns {workload idx: stream}."""
+    from paddle_tpu.serving import EngineOverloaded as _EO
+    out, rid_of = {}, {}
+    pending = list(enumerate(work))
+    while pending or eng.has_work():
+        while pending:
+            i, (p, m) = pending[0]
+            try:
+                rid_of[eng.add_request(p, max_new_tokens=m)] = i
+            except _EO:
+                break
+            pending.pop(0)
+        for rid, tok in eng.step():
+            out.setdefault(rid_of[rid], []).append(int(tok))
+    return out
+
+
+def _first_token_s(model, cache_dir):
+    """Cold-start-to-first-token: fresh engine on `cache_dir`, one
+    request, stepped to its first emission. Returns (seconds, the
+    engine's CompileCache counters); the engine itself is drained and
+    shut down here."""
+    from paddle_tpu.serving import ServingEngine
+    t0 = time.perf_counter()
+    eng = ServingEngine(model, compile_cache=cache_dir, **ENGINE_KW)
+    eng.add_request(list(range(1, 9)), max_new_tokens=2)
+    emitted = []
+    while not emitted:
+        emitted = eng.step()
+    dt = time.perf_counter() - t0
+    eng.run()    # drain the tail so the engine ends clean
+    cc = dict(eng.compile_cache.counters)
+    eng.shutdown()
+    eng.metrics.unregister()
+    return dt, cc
+
+
+def run_proc_pass(work, ref, ccdir, *, chaos, seed, report, label):
+    """One cross-process pass over `work`; asserts bit-identity against
+    the in-process reference streams and (chaos) the full fault
+    ladder."""
+    from paddle_tpu.serving import EngineOverloaded, ProcessFleet
+    from paddle_tpu.serving.fleet.errors import NoHealthyReplica
+    from paddle_tpu.serving.fleet.procfleet import WorkerState
+
+    base = {"model": {"kind": "llama", "config": CFG_DICT, "seed": 0},
+            "engine": ENGINE_KW, "heartbeat_interval_s": 0.05,
+            "compile_cache_dir": ccdir}
+    specs = {f"w{i}": dict(base) for i in range(3)}
+    if chaos:
+        # w0: seeded kill -9 mid-stream (proven by returncode -9)
+        specs["w0"]["faults"] = [
+            {"point": "worker.kill9", "after": 25, "times": 1}]
+        # w1: permanently wedged transport — no heartbeats out, no
+        # commands in; the hard-stall ladder must kill + adopt
+        specs["w1"]["faults"] = [
+            {"point": "transport.stall", "after": 40, "times": -1}]
+        # w2: slow heartbeats under load (SUSPECT gaps, survives) + a
+        # finite stall it recovers from and REPORTS (fired counts ride
+        # its later heartbeats — the in-soak firing proof)
+        specs["w2"]["heartbeat_interval_s"] = 1.0
+        specs["w2"]["faults"] = [
+            {"point": "transport.stall", "after": 60, "times": 3}]
+    pf = ProcessFleet(specs, suspect_after_s=PROC_SUSPECT_S,
+                      dead_after_s=PROC_DEAD_S,
+                      max_inflight_per_worker=8,
+                      stderr_dir=os.path.join("profiler_log",
+                                              "soak_proc_workers"))
+    armed_host = set()
+    try:
+        t0 = time.monotonic()
+        while not all(w.ready for w in pf.workers.values()):
+            pf.pump()
+            if time.monotonic() - t0 > 120:
+                raise AssertionError(f"[{label}] workers never ready")
+            time.sleep(0.01)
+        if chaos:
+            # host-side wire damage on the worker->host streams: drops
+            # heal through heartbeat snapshots, duplicates must die in
+            # the exactly-once funnel
+            faults.inject("transport.drop", payload=True, prob=0.02,
+                          times=8, seed=seed + 11)
+            faults.inject("transport.duplicate", payload=True,
+                          prob=0.03, times=10, seed=seed + 12)
+            armed_host |= {"transport.drop", "transport.duplicate"}
+
+        idx_of = {}
+        pending = list(enumerate(work))
+        max_gap = {n: 0.0 for n in pf.workers}
+        t0 = time.monotonic()
+        while pending or pf.has_work():
+            submitted = 0
+            while pending and submitted < 4:
+                i, (p, m) = pending[0]
+                try:
+                    h = pf.submit(p, max_new_tokens=m)
+                except (EngineOverloaded, NoHealthyReplica):
+                    break   # backpressure / mid-failover: retry later
+                idx_of[h.request_id] = i
+                pending.pop(0)
+                submitted += 1
+            pf.pump()
+            for n in pf.workers:
+                g = pf.heartbeat_gap_s(n)
+                if g is not None and \
+                        pf.workers[n].state not in (WorkerState.DEAD,
+                                                    WorkerState.STOPPED):
+                    max_gap[n] = max(max_gap[n], g)
+            if time.monotonic() - t0 > 600:
+                raise AssertionError(
+                    f"[{label}] failed to drain after 600s; "
+                    f"{pf.summary()}")
+            time.sleep(2e-3)
+
+        streams = {}
+        for rid, i in idx_of.items():
+            h = pf.handles[rid]
+            assert h.finished, f"[{label}] request {i} never finished"
+            assert h.finish_reason in ("stop", "length"), \
+                f"[{label}] request {i} ended {h.finish_reason!r}"
+            streams[i] = list(h.tokens)
+        diverged = [i for i in streams if streams[i] != ref.get(i)]
+        assert not diverged, \
+            f"[{label}] cross-process streams diverged from the " \
+            f"in-process reference: {diverged[:10]}"
+        assert pf.counters["requests_lost"] == 0, pf.summary()
+        assert pf.counters["funnel_conflicts"] == 0, pf.summary()
+
+        # let the suspicion ladder RESOLVE every suspect (a wedged
+        # worker must reach DEAD via the hard-stall timeout before the
+        # reclamation sweep asks it anything)
+        t0 = time.monotonic()
+        while any(w.state is WorkerState.SUSPECT
+                  for w in pf.workers.values()):
+            pf.pump()
+            if time.monotonic() - t0 > PROC_DEAD_S * 3:
+                break
+            time.sleep(0.01)
+
+        # ---- full reclamation on every SURVIVING worker --------------
+        for name, w in pf.workers.items():
+            if w.state is not WorkerState.HEALTHY:
+                continue
+            st = pf.request_stats(name, reset_prefix_cache=True)
+            assert st is not None, f"[{label}] no stats from {name}"
+            assert st.get("radix_ok", True) and st["allocator_ok"], st
+            assert st["kv_used_pages"] == 0, \
+                f"[{label}] {name} leaked KV pages: {st}"
+
+        report[label] = {
+            "streams": len(streams),
+            "max_heartbeat_gap_s": {n: round(g, 3)
+                                    for n, g in max_gap.items()},
+            "worker_states": {n: w.state.value
+                              for n, w in pf.workers.items()},
+            **{k: v for k, v in pf.counters.items() if v},
+        }
+        if chaos:
+            host_fired = faults.fired_counts()
+            worker_fired = pf.fired_counts()
+            report[f"fired_{label}"] = {"host": host_fired,
+                                        "worker": worker_fired}
+            # every armed fault PROVEN fired:
+            for pt in sorted(armed_host):
+                assert host_fired.get(pt, 0) >= 1, \
+                    f"[{label}] host-armed {pt} never fired"
+            # kill9: the process really died by SIGKILL, mid-workload
+            assert pf.workers["w0"].poll() == -9, \
+                f"[{label}] w0 rc {pf.workers['w0'].poll()}"
+            assert pf.counters["worker_kill9_observed"] >= 1
+            # the wedged worker was hard-stalled out and its work moved
+            assert pf.counters["worker_hard_stalls"] >= 1, pf.summary()
+            assert pf.workers["w1"].state is WorkerState.DEAD
+            assert pf.counters["requests_migrated"] >= 1, pf.summary()
+            # w2 recovered from its finite stall and REPORTED it
+            assert worker_fired.get("transport.stall", 0) >= 1, \
+                f"[{label}] worker-side transport.stall unreported: " \
+                f"{worker_fired}"
+            # slow-heartbeat worker: visible gaps, still alive
+            assert max_gap["w2"] > PROC_SUSPECT_S, max_gap
+            assert pf.workers["w2"].state not in (WorkerState.DEAD,
+                                                  WorkerState.STOPPED)
+            # duplicates died in the funnel (asserted zero-conflict
+            # above); count what the funnel absorbed
+            report[label]["funnel_duplicates"] = \
+                pf.counters["funnel_duplicates"]
+        # heartbeat-gap visibility in the Prometheus text
+        text = pf.prometheus_text()
+        assert "worker_heartbeat_gap_seconds" in text
+        report[f"prometheus_{label}_lines"] = text.count("\n")
+        return streams, pf
+    finally:
+        faults.clear()
+        faults.reset_counts()
+        pf.shutdown()
+
+
+def run_proc_ladder(args):
+    """The --procs entry: reference + bench + clean + chaos + rolling
+    restart. Returns the report dict (raises AssertionError on any
+    violation)."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.serving.fleet.procfleet import WorkerState
+
+    report = {"requests": args.requests, "seed": args.seed,
+              "mode": "procs"}
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig(**CFG_DICT))
+    work = make_workload(args.requests, args.seed)
+    ccdir = tempfile.mkdtemp(prefix="soak_ptcc_")
+    try:
+        # ---- in-process reference (warms the shared cache) -----------
+        ref_eng = ServingEngine(model, compile_cache=ccdir, **ENGINE_KW)
+        try:
+            ref = _drive_engine(ref_eng, work)
+            saved = ref_eng.save_compile_cache()
+        finally:
+            ref_eng.shutdown()
+        assert saved >= 2, f"compile cache saved only {saved} entries"
+        report["cache_entries_saved"] = saved
+
+        # ---- cold-vs-warm compile-cache bench ------------------------
+        cold_dir = tempfile.mkdtemp(prefix="soak_ptcc_cold_")
+        try:
+            t_cold, _ = _first_token_s(model, cold_dir)
+        finally:
+            shutil.rmtree(cold_dir, ignore_errors=True)
+        # a corrupted entry must degrade to a counted recompile,
+        # mid-bench, without crashing the engine
+        faults.inject("cache.corrupt_entry", payload=True, times=1)
+        t_warm, warm_cc = _first_token_s(model, ccdir)
+        corrupt_fired = faults.fired_counts().get("cache.corrupt_entry",
+                                                  0)
+        faults.clear()
+        faults.reset_counts()
+        assert corrupt_fired >= 1, "cache.corrupt_entry never fired"
+        assert warm_cc["rejects"] >= 1
+        # second warm engine, undamaged: the actual warm number
+        t_warm2, _ = _first_token_s(model, ccdir)
+        t_warm = min(t_warm, t_warm2)
+        speedup = t_cold / t_warm
+        report["compile_cache_bench"] = {
+            "cold_first_token_s": round(t_cold, 3),
+            "warm_first_token_s": round(t_warm, 3),
+            "speedup": round(speedup, 2),
+            "corrupt_entry_rejects": warm_cc["rejects"]}
+        assert speedup >= 5.0, \
+            f"warm cold-start-to-first-token only {speedup:.1f}x " \
+            f"faster than cold compile (need >= 5x)"
+
+        # ---- clean + chaos cross-process passes ----------------------
+        run_proc_pass(work, ref, ccdir, chaos=False, seed=args.seed,
+                      report=report, label="proc_clean")
+        run_proc_pass(work, ref, ccdir, chaos=True, seed=args.seed,
+                      report=report, label="proc_chaos")
+
+        # ---- rolling restart: drain -> respawn -> adopt --------------
+        from paddle_tpu.serving import ProcessFleet
+        base = {"model": {"kind": "llama", "config": CFG_DICT,
+                          "seed": 0},
+                "engine": ENGINE_KW, "heartbeat_interval_s": 0.05,
+                "compile_cache_dir": ccdir}
+        pf = ProcessFleet({"w0": dict(base), "w1": dict(base)},
+                          suspect_after_s=PROC_SUSPECT_S,
+                          dead_after_s=30.0,
+                          stderr_dir=os.path.join(
+                              "profiler_log", "soak_proc_workers"))
+        try:
+            t0 = time.monotonic()
+            while not all(w.ready for w in pf.workers.values()):
+                pf.pump()
+                assert time.monotonic() - t0 < 120
+                time.sleep(0.01)
+            long_work = [(p, 24) for p, _ in work[:8]]
+            handles = []
+            for p, m in long_work:
+                handles.append(pf.submit(p, max_new_tokens=m))
+            # first tokens, then restart w0 under load
+            t0 = time.monotonic()
+            while not all(h.tokens for h in handles):
+                pf.pump()
+                assert time.monotonic() - t0 < 120
+                time.sleep(5e-3)
+            pf.rolling_restart("w0")
+            res = pf.run(timeout_s=300)
+            # per-request streams are batch-invariant (the SERVING.md
+            # determinism contract), so ONE warm reference engine
+            # serves all 8 expected streams
+            solo = ServingEngine(model, compile_cache=ccdir,
+                                 **ENGINE_KW)
+            try:
+                rids = [solo.add_request(p, max_new_tokens=m)
+                        for p, m in long_work]
+                solo_out = solo.run()
+            finally:
+                solo.shutdown()
+            for i, h in enumerate(handles):
+                assert res[h.request_id] == solo_out[rids[i]], \
+                    f"rolling restart diverged request {i}"
+            assert pf.counters["requests_lost"] == 0
+            assert pf.counters["funnel_conflicts"] == 0
+            assert pf.counters["worker_drains"] == 1
+            assert pf.counters["worker_restarts"] == 1
+            # successor warm-starts from disk: route it fresh traffic
+            # (the migrated work may have landed on the other worker),
+            # then its heartbeat counters must show disk hits and ZERO
+            # XLA compiles — the no-compile-storm restart criterion
+            t0 = time.monotonic()
+            while not pf.workers["w0"].ready:
+                pf.pump()
+                assert time.monotonic() - t0 < 120, \
+                    "respawned successor never became ready"
+                time.sleep(0.01)
+            for p, _ in work[8:12]:
+                pf.submit(p, max_new_tokens=6)
+            pf.run(timeout_s=120)
+            t0 = time.monotonic()
+            while (pf.workers["w0"].last_beat is None or
+                   pf.workers["w0"].last_beat["counters"]
+                   ["engine_steps"] == 0):
+                pf.pump()
+                assert time.monotonic() - t0 < 60, \
+                    "successor never stepped"
+                time.sleep(5e-3)
+            wc = pf.workers["w0"].last_beat["counters"]
+            assert wc["recompiles"] == 0, wc
+            assert wc["compile_cache_hits"] >= 1, wc
+            assert pf.counters["requests_lost"] == 0
+            text = pf.prometheus_text()
+            assert 'worker_heartbeat_gap_seconds{worker="w0"}' in text
+            assert 'paddle_serving_worker_generation{worker="w0"} 1' \
+                in text
+            report["rolling_restart"] = {
+                "streams": len(handles),
+                "migrated": pf.counters["requests_migrated"],
+                "successor_cache_hits": wc["compile_cache_hits"],
+            }
+        finally:
+            pf.shutdown()
+        return report
+    finally:
+        shutil.rmtree(ccdir, ignore_errors=True)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--requests", type=int, default=120)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--procs", action="store_true",
+                    help="run the cross-process chaos ladder "
+                         "(ISSUE 14) instead of the in-process soak")
     ap.add_argument("--trace-out",
                     default=os.path.join("profiler_log",
                                          "soak_fleet_trace.json"),
@@ -277,10 +661,15 @@ def main(argv=None):
                          "spans + request lifecycles, ISSUE 10)")
     args = ap.parse_args(argv)
 
-    cfg = LlamaConfig(vocab_size=128, hidden_size=128,
-                      intermediate_size=256, num_hidden_layers=2,
-                      num_attention_heads=2, num_key_value_heads=1,
-                      max_position_embeddings=128)
+    if args.procs:
+        t0 = time.perf_counter()
+        report = run_proc_ladder(args)
+        report["wall_s"] = round(time.perf_counter() - t0, 2)
+        print(json.dumps(report))
+        print("SOAK_FLEET_PROC_OK")
+        return 0
+
+    cfg = LlamaConfig(**CFG_DICT)
     paddle.seed(0)
     model = LlamaForCausalLM(cfg)
     work = make_workload(args.requests, args.seed)
