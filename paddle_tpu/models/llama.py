@@ -125,6 +125,16 @@ def apply_rotary(x, cos, sin):
     return out.reshape(x.shape)
 
 
+def _lora(name, x, y):
+    """Multi-LoRA serving hook (ISSUE 15): adds the active launch
+    scope's per-row adapter delta to a projection output. With no
+    scope active (training, lora-less serving) it returns `y`
+    UNTOUCHED — the traced graph is exactly what it always was; the
+    cost is one thread-local read per projection per trace."""
+    from ..serving.lora.runtime import apply_lora
+    return apply_lora(name, x, y)
+
+
 class LlamaAttention(nn.Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
@@ -246,9 +256,12 @@ class LlamaAttention(nn.Layer):
         from ..kernels.paged_attention import (paged_attention_decode,
                                                paged_cache_write)
         b, s, _ = x.shape
-        q = M.reshape(self.q_proj(x), [b, s, self.n_heads, self.head_dim])
-        k = M.reshape(self.k_proj(x), [b, s, self.n_kv, self.head_dim])
-        v = M.reshape(self.v_proj(x), [b, s, self.n_kv, self.head_dim])
+        q = M.reshape(_lora("q_proj", x, self.q_proj(x)),
+                      [b, s, self.n_heads, self.head_dim])
+        k = M.reshape(_lora("k_proj", x, self.k_proj(x)),
+                      [b, s, self.n_kv, self.head_dim])
+        v = M.reshape(_lora("v_proj", x, self.v_proj(x)),
+                      [b, s, self.n_kv, self.head_dim])
         q = apply_op("rope_pos", apply_rotary_positions, q, cos_b, sin_b)
         k = apply_op("rope_pos", apply_rotary_positions, k, cos_b, sin_b)
 
@@ -279,7 +292,7 @@ class LlamaAttention(nn.Layer):
         out = apply_op("paged_attention_decode", _attend, q, *kv,
                        block_tables, seq_lens)
         out = M.reshape(out, [b, s, self.n_heads * self.head_dim])
-        return self.o_proj(out), kv
+        return _lora("o_proj", out, self.o_proj(out)), kv
 
     def forward_paged_prefill(self, x, cos_c, sin_c, kv,
                               block_table, cache_len, chunk_len):
@@ -305,9 +318,12 @@ class LlamaAttention(nn.Layer):
         """
         from ..kernels.paged_attention import paged_cache_write_range
         b, s, _ = x.shape
-        q = M.reshape(self.q_proj(x), [b, s, self.n_heads, self.head_dim])
-        k = M.reshape(self.k_proj(x), [b, s, self.n_kv, self.head_dim])
-        v = M.reshape(self.v_proj(x), [b, s, self.n_kv, self.head_dim])
+        q = M.reshape(_lora("q_proj", x, self.q_proj(x)),
+                      [b, s, self.n_heads, self.head_dim])
+        k = M.reshape(_lora("k_proj", x, self.k_proj(x)),
+                      [b, s, self.n_kv, self.head_dim])
+        v = M.reshape(_lora("v_proj", x, self.v_proj(x)),
+                      [b, s, self.n_kv, self.head_dim])
         q = apply_op("rope", apply_rotary, q, cos_c, sin_c)
         k = apply_op("rope", apply_rotary, k, cos_c, sin_c)
 
@@ -335,7 +351,7 @@ class LlamaAttention(nn.Layer):
         mask = apply_op("chunk_mask", _mask, cache_len)
         out = F.scaled_dot_product_attention(q, kd, vd, attn_mask=mask)
         out = M.reshape(out, [b, s, self.n_heads * self.head_dim])
-        return self.o_proj(out), kv
+        return _lora("o_proj", out, self.o_proj(out)), kv
 
     def forward_paged_verify(self, x, cos_bs, sin_bs, kv,
                              block_tables, seq_lens, draft_lens):
@@ -448,7 +464,9 @@ class LlamaMLP(nn.Layer):
                                            input_is_parallel=True)
 
     def forward(self, x):
-        return self.down_proj(F.swiglu(self.gate_proj(x), self.up_proj(x)))
+        h = F.swiglu(_lora("gate_proj", x, self.gate_proj(x)),
+                     _lora("up_proj", x, self.up_proj(x)))
+        return _lora("down_proj", h, self.down_proj(h))
 
 
 class LlamaDecoderLayer(nn.Layer):

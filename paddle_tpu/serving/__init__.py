@@ -17,7 +17,11 @@ cross-process tier (ISSUE 14) moves replicas into worker processes
 over a framed TCPStore mailbox (`ProcessFleet`/`worker.py`/
 `transport.py`) with crash-proof restart through heartbeat-shipped
 snapshots and a persistent AOT compile cache
-(`serving.compile_cache`), fronted by HTTP/SSE (`HttpFrontend`).
+(`serving.compile_cache`), fronted by HTTP/SSE (`HttpFrontend`);
+multi-LoRA serving (ISSUE 15, `serving.lora`) serves N adapters per
+engine — paged adapter-weight storage under the BlockAllocator
+discipline, a batched heterogeneous segment-bmm delta kernel, and the
+adapter id threaded through radix keys, snapshots and fleet routing.
 """
 from .engine import ServingEngine, tp_serving_mesh
 from .program_cache import ProgramCache
@@ -29,6 +33,8 @@ from .metrics import ServingMetrics
 from .radix_cache import RadixCache, RadixNode
 from .scheduler import (PrefillChunk, Request, RequestState, ScheduleStep,
                         Scheduler)
+from .lora import (AdapterBusy, AdapterError, AdapterLoadError,
+                   AdapterNotLoaded, AdapterRegistry, LoRAAdapter)
 from .spec import DraftModelProposer, NgramProposer, Proposer
 from .supervisor import RetryPolicy, StepSupervisor, classify_failure
 from .trace import FlightRecorder, RequestTrace, RequestTracer
@@ -51,4 +57,6 @@ __all__ = ["ServingEngine", "BlockAllocator", "BlocksExhausted",
            "tp_serving_mesh", "ProgramCache", "RequestTracer",
            "RequestTrace", "FlightRecorder", "render_prometheus",
            "CompileCache", "Channel", "TransportError", "HttpFrontend",
-           "ProcessFleet", "WorkerProc", "WorkerState"]
+           "ProcessFleet", "WorkerProc", "WorkerState",
+           "AdapterRegistry", "LoRAAdapter", "AdapterError",
+           "AdapterNotLoaded", "AdapterLoadError", "AdapterBusy"]

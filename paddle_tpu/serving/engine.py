@@ -86,6 +86,7 @@ from ..utils import faults
 from ..utils.nan_inf import poison_scope
 from .errors import (EngineFailure, EngineOverloaded,
                      SnapshotVersionError)
+from .lora.adapter import AdapterNotLoaded
 from .kv_cache import BlockAllocator, BlocksExhausted, PAD_PAGE
 from .metrics import ServingMetrics
 from .program_cache import ProgramCache
@@ -124,7 +125,10 @@ SNAPSHOT_VERSION = 1
 # a NEWER minor — unknown extra top-level keys warn-and-ignore instead
 # of failing; only a MAJOR mismatch (a schema this build would
 # misread) stays the loud, typed refusal.
-SNAPSHOT_MINOR = 1
+# minor 2 (ISSUE 15): request records carry an "adapter" field; a
+# lora-aware adopter REQUIRES the adapter loaded (typed refusal — never
+# wrong-adapter), while pre-lora builds ignore the key.
+SNAPSHOT_MINOR = 2
 _SNAPSHOT_KNOWN_KEYS = frozenset(
     {"version", "minor", "reason", "rng_key", "requests",
      "flight_recorder"})
@@ -253,6 +257,21 @@ class ServingEngine:
     configs sharing a process never collide, and the compile bound
     stays the bucket grid.
 
+    Multi-LoRA serving (ISSUE 15): pass `lora` (a
+    serving.lora.AdapterRegistry built for this model's dims) and tag
+    requests with `add_request(adapter=...)`. Adapter A/B factors live
+    PAGED in the registry's device pools (BlockAllocator discipline,
+    LRU eviction of idle adapters, live-request refcount pinning);
+    every program takes the pools/page-tables/per-row slot ids as
+    call-time INPUTS, gathers the fixed-shape slot stacks in-graph and
+    applies each row's own delta through the masked segment-bmm kernel
+    (kernels/lora_matmul.py) — rows of one launch may mix adapters,
+    load/unload never recompiles, and only the static layout signature
+    rides the program key. The radix key is adapter-namespaced
+    (prefixes never cross adapters) and snapshots carry the adapter
+    (adoption requires it loaded — typed refusal otherwise). Mutually
+    exclusive with `proposer` and `mesh` (documented in SERVING.md).
+
     Tensor-parallel serving (ISSUE 8): pass `mesh` (a hybrid
     [data, pipe, sharding, sep, model] jax Mesh with model degree tp)
     to shard attention heads, the paged KV pool (page CONTENTS,
@@ -290,6 +309,7 @@ class ServingEngine:
                  wq: Optional[str] = None,
                  kv_pool_bytes: Optional[int] = None,
                  mesh=None,
+                 lora=None,
                  compile_cache=None,
                  trace=None, trace_ring: int = 512,
                  flight_recorder_steps: int = 128):
@@ -471,6 +491,27 @@ class ServingEngine:
                 f"largest multi bucket {self.multi_buckets[-1]} must "
                 f"equal decode_steps {self.decode_steps}")
 
+        # --- multi-LoRA adapter serving (ISSUE 15) ---
+        # lora: an AdapterRegistry (serving.lora). Requests carry an
+        # adapter NAME (`add_request(adapter=...)`); each launch passes
+        # the registry's paged pools + page tables + per-row slot ids
+        # as program INPUTS and the programs gather/apply each row's
+        # own adapter delta in-graph — rows of one launch may mix
+        # adapters, and load/unload/evict never recompiles (only the
+        # static layout signature rides the program key, below).
+        self.lora = lora
+        if lora is not None:
+            if proposer is not None:
+                raise ValueError(
+                    "lora and a proposer are mutually exclusive: the "
+                    "verify program has no adapter path (pick one per "
+                    "engine)")
+            if mesh is not None:
+                raise ValueError(
+                    "lora under tensor parallelism is not supported "
+                    "yet: the adapter pools/stacks carry no sharding "
+                    "specs (run lora engines at tp=1)")
+
         self.allocator = BlockAllocator(self.num_pages, self.page_size)
         self.radix = (RadixCache(self.allocator)
                       if enable_prefix_cache else None)
@@ -505,6 +546,10 @@ class ServingEngine:
         # other in profiler.counters(), nor unregister each other
         self.metrics = ServingMetrics(
             name=f"serving-{next(_engine_counter)}").register()
+        if self.lora is not None:
+            # registry lifecycle counters land in THIS engine's
+            # auto-exposed metrics (loads done before attach carry in)
+            self.lora.bind_counters(self.metrics.counters)
         # --- observability (ISSUE 10) ---
         # Per-request tracing is OFF by default and free when off:
         # every hook is guarded by ONE `self.tracer is None` check, so
@@ -607,6 +652,11 @@ class ServingEngine:
         # engine) so the key suffix costs nothing
         self._qkey = (self.kv_dtype or "kv_full", self.wq or "w_full",
                       ("tp", self.tp))
+        if self.lora is not None:
+            # the STATIC lora layout (slots x rank buckets x page
+            # geometry) rides every program key; adapter ids never do
+            # — loading/unloading adapters can never grow the grid
+            self._qkey = self._qkey + (self.lora.signature(),)
 
         # --- persistent compile cache (ISSUE 14) ---
         # compile_cache: a directory path (a CompileCache is built over
@@ -717,16 +767,37 @@ class ServingEngine:
     def add_request(self, prompt_ids, max_new_tokens: int = 32,
                     eos_token_id: Optional[int] = None,
                     ttl_s: Optional[float] = None,
-                    deadline: Optional[float] = None) -> int:
+                    deadline: Optional[float] = None,
+                    adapter: Optional[str] = None) -> int:
         """Queue one request. `ttl_s` (or an absolute engine-clock
         `deadline`) bounds its total lifetime: past it, the request is
         cancelled at the next iteration boundary whatever its state.
         Raises `EngineOverloaded` when the bounded waiting queue is full
-        (admission control — shed at the door, never grow unbounded)."""
+        (admission control — shed at the door, never grow unbounded).
+
+        `adapter` (ISSUE 15) names a LoRA adapter the registry must
+        CURRENTLY hold — unknown/unloaded adapters shed typed
+        (`AdapterNotLoaded`) at the door, never serve base weights by
+        accident. An admitted request pins its adapter (registry
+        refcount) until it reaches a terminal state, so LRU eviction
+        can never take the weights out from under live work."""
         if self.failed:
             raise EngineFailure("engine has failed; resume from "
                                 "last_snapshot", snapshot=self.last_snapshot)
-        req = Request(prompt_ids, max_new_tokens, eos_token_id)
+        if adapter is not None:
+            if self.lora is None:
+                raise AdapterNotLoaded(
+                    f"request names adapter {adapter!r} but this engine "
+                    f"has no adapter registry (lora=None)",
+                    adapter=adapter)
+            if not self.lora.has(adapter):
+                self.metrics.counters["adapter_rejects"] += 1
+                raise AdapterNotLoaded(
+                    f"adapter {adapter!r} is not loaded "
+                    f"(loaded: {self.lora.adapter_names()})",
+                    adapter=adapter)
+        req = Request(prompt_ids, max_new_tokens, eos_token_id,
+                      adapter=adapter)
         if len(req.prompt_ids) + req.max_new_tokens > self.max_seq_len:
             raise ValueError(
                 f"prompt {len(req.prompt_ids)} + max_new_tokens "
@@ -750,6 +821,11 @@ class ServingEngine:
             self.metrics.on_shed()
             self._tr_shed(req)
             raise
+        if adapter is not None:
+            self.lora.acquire(adapter)     # pinned until terminal
+            # versioned radix namespace: a reload of the same name
+            # must never match KV cached under the replaced weights
+            req.adapter_key = self.lora.namespace_of(adapter)
         self.requests[req.request_id] = req
         self.metrics.on_add(req.request_id)
         self._tr_begin(req)
@@ -798,6 +874,48 @@ class ServingEngine:
         this engine never opted into (or validated divisibility for)."""
         from ..distributed.fleet.mpu import mesh_scope
         return mesh_scope(self.mesh)
+
+    # ------------------------------------- multi-LoRA plumbing (ISSUE 15)
+    def load_adapter(self, adapter, quant: Optional[str] = None) -> int:
+        """Load a LoRAAdapter into the registry at runtime (no
+        recompile — only page/table VALUES change). Returns the global
+        launch slot. quant="int8" stores the payload quantized."""
+        if self.lora is None:
+            raise AdapterNotLoaded("engine has no adapter registry "
+                                   "(construct with lora=...)")
+        return self.lora.load(adapter, quant=quant)
+
+    def unload_adapter(self, name: str):
+        """Unload an IDLE adapter (typed AdapterBusy while live
+        requests still pin it)."""
+        if self.lora is None:
+            raise AdapterNotLoaded("engine has no adapter registry "
+                                   "(construct with lora=...)")
+        self.lora.unload(name)
+
+    def _lora_launch_args(self, reqs, B: int) -> tuple:
+        """Per-launch lora program inputs: (row_slots (B,), *registry
+        flat args) — empty when lora is off, so lora-less launch sites
+        splat nothing. Padded batch rows map to global slot 0 (every
+        bucket's null adapter -> exact zero delta)."""
+        if self.lora is None:
+            return ()
+        rows = np.zeros((B,), np.int32)
+        for i, r in enumerate(reqs):
+            if r.adapter is not None:
+                rows[i] = self.lora.slot_of(r.adapter)
+        return (jnp.asarray(rows),) + self.lora.flat_args()
+
+    def _lora_trace_scope(self, largs):
+        """Scope entered INSIDE a traced program body, around the model
+        call: builds the launch LoRAContext from the traced lora args
+        and activates the projection hooks. Null context when off."""
+        if self.lora is None or not largs:
+            import contextlib
+            return contextlib.nullcontext()
+        from .lora.runtime import build_context, lora_scope
+        return lora_scope(build_context(self.lora.layout, largs[1:],
+                                        largs[0]))
 
     # ------------------------------------------------------ program cache
     def _next_key(self):
@@ -916,15 +1034,17 @@ class ServingEngine:
         model = self.model
         temperature, top_k, top_p = self.temperature, self.top_k, self.top_p
         views, split = self._paged_views, self._split_views
+        lora_open = self._lora_trace_scope
 
         def program(state, kcs, vcs, kss, vss, ids, cache_len, live, bt,
-                    key):
+                    key, *largs):
             st = {k: Tensor(v) for k, v in state.items()}
             paged = views(kcs, vcs, kss, vss)
-            logits, caches = functional_call(
-                model, st, Tensor(ids), paged, Tensor(bt),
-                Tensor(cache_len), Tensor(live),
-                method="forward_paged_prefill")
+            with lora_open(largs):
+                logits, caches = functional_call(
+                    model, st, Tensor(ids), paged, Tensor(bt),
+                    Tensor(cache_len), Tensor(live),
+                    method="forward_paged_prefill")
             last = logits._data[0, 0]   # head ran at the chunk end only
             # in-graph NaN detection (the jit counterpart of the eager
             # dispatch NaN hook): NaN/Inf anywhere in the network flows
@@ -954,6 +1074,7 @@ class ServingEngine:
         # transient-failure retry re-runs the identical program (bit-
         # identical token) instead of burning a new key per attempt
         key = self._next_key() if chunk.is_last else self._null_key
+        largs = self._lora_launch_args([req], 1)
 
         def launch():
             faults.fire(FAULT_CHUNK)
@@ -965,7 +1086,8 @@ class ServingEngine:
                     self._state, self._k_caches, self._v_caches,
                     self._k_scales, self._v_scales,
                     jnp.asarray(padded), jnp.int32(chunk.start),
-                    jnp.int32(chunk.length), jnp.asarray(bt), key)
+                    jnp.int32(chunk.length), jnp.asarray(bt), key,
+                    *largs)
 
         self._cur_rids = (req.request_id,)
         self._step_ev["programs"].append(f"chunk:S{S}:P{P}")
@@ -992,13 +1114,15 @@ class ServingEngine:
         model = self.model
         temperature, top_k, top_p = self.temperature, self.top_k, self.top_p
         views, split = self._paged_views, self._split_views
+        lora_open = self._lora_trace_scope
 
-        def program(state, kcs, vcs, kss, vss, ids, bt, sl, key):
+        def program(state, kcs, vcs, kss, vss, ids, bt, sl, key, *largs):
             st = {k: Tensor(v) for k, v in state.items()}
             paged = views(kcs, vcs, kss, vss)
-            logits, caches = functional_call(
-                model, st, Tensor(ids), paged, Tensor(bt), Tensor(sl),
-                method="forward_paged_decode")
+            with lora_open(largs):
+                logits, caches = functional_call(
+                    model, st, Tensor(ids), paged, Tensor(bt), Tensor(sl),
+                    method="forward_paged_decode")
             rows = logits._data[:, 0, :]
             # per-row finiteness: rows are independent (SERVING.md), so a
             # poisoned request flags ONLY its own row — the quarantine
@@ -1026,6 +1150,10 @@ class ServingEngine:
             sl[i] = r.seq.num_tokens
         key = self._next_key()    # drawn once: retries re-run identically
         rids = [r.request_id for r in reqs]
+        largs = self._lora_launch_args(reqs, B)
+        if self.lora is not None:
+            self.metrics.on_adapter_mix(
+                len({r.adapter for r in reqs if r.adapter is not None}))
 
         def launch():
             faults.fire(FAULT_DECODE)
@@ -1036,7 +1164,7 @@ class ServingEngine:
                     self._state, self._k_caches, self._v_caches,
                     self._k_scales, self._v_scales,
                     jnp.asarray(ids), jnp.asarray(bt), jnp.asarray(sl),
-                    key)
+                    key, *largs)
 
         self._cur_rids = tuple(rids)
         self._step_ev["programs"].append(f"decode:B{B}:P{P}")
@@ -1094,16 +1222,21 @@ class ServingEngine:
         model = self.model
         temperature, top_k, top_p = self.temperature, self.top_k, self.top_p
         views, split = self._paged_views, self._split_views
+        lora_open = self._lora_trace_scope
 
         def program(state, kcs, vcs, kss, vss, ids, bt, sl, caps, eos,
-                    key):
+                    key, *largs):
             st = {k: Tensor(v) for k, v in state.items()}
             paged = views(kcs, vcs, kss, vss)
-            toks, n_emit, ok, caches = functional_call(
-                model, st, Tensor(ids), paged, Tensor(bt), Tensor(sl),
-                Tensor(caps), Tensor(eos), key,
-                method="forward_paged_decode_multi", k_steps=K,
-                temperature=temperature, top_k=top_k, top_p=top_p)
+            # the scope spans the whole scan trace: the gathered slot
+            # stacks become loop constants, so the paged gather runs
+            # once per LAUNCH, not once per decode step
+            with lora_open(largs):
+                toks, n_emit, ok, caches = functional_call(
+                    model, st, Tensor(ids), paged, Tensor(bt), Tensor(sl),
+                    Tensor(caps), Tensor(eos), key,
+                    method="forward_paged_decode_multi", k_steps=K,
+                    temperature=temperature, top_k=top_k, top_p=top_p)
             return (toks._data, n_emit._data, ok._data) + split(caches)
 
         return jax.jit(program, donate_argnums=self._donate)
@@ -1137,6 +1270,10 @@ class ServingEngine:
                 eos[i] = r.eos_token_id
         key = self._next_key()    # drawn once: retries re-run identically
         rids = [r.request_id for r in reqs]
+        largs = self._lora_launch_args(reqs, B)
+        if self.lora is not None:
+            self.metrics.on_adapter_mix(
+                len({r.adapter for r in reqs if r.adapter is not None}))
 
         def launch():
             faults.fire(FAULT_MULTI)
@@ -1148,7 +1285,7 @@ class ServingEngine:
                     self._state, self._k_caches, self._v_caches,
                     self._k_scales, self._v_scales,
                     jnp.asarray(ids), jnp.asarray(bt), jnp.asarray(sl),
-                    jnp.asarray(cp), jnp.asarray(eos), key)
+                    jnp.asarray(cp), jnp.asarray(eos), key, *largs)
 
         self._cur_rids = tuple(rids)
         self._step_ev["programs"].append(f"multi_decode:B{B}:K{K}:P{P}")
@@ -1853,9 +1990,13 @@ class ServingEngine:
         """Terminal-request retention bookkeeping (bounded window).
         Every terminal path funnels here, so it doubles as the
         proposer's release hook (a KV-owning proposer frees its draft
-        pages for this request)."""
+        pages for this request) and the adapter-refcount release
+        (ISSUE 15: a terminal request unpins its adapter, making it
+        eviction-eligible again once idle)."""
         if self.proposer is not None:
             self.proposer.on_finished(req)
+        if self.lora is not None and req.adapter is not None:
+            self.lora.release(req.adapter)
         self._finished_order.append(req.request_id)
         while len(self._finished_order) > self.max_retained_finished:
             self.requests.pop(self._finished_order.pop(0), None)
@@ -1898,6 +2039,10 @@ class ServingEngine:
                 "deadline_remaining_s": (
                     None if req.deadline is None
                     else float(req.deadline - now)),
+                # ISSUE 15 (snapshot minor 2): the adapter rides the
+                # record so failover re-lands the request WITH its
+                # adapter (or refuses typed) — never wrong-adapter
+                "adapter": req.adapter,
             })
         recs.sort(key=lambda r: r["request_id"])   # FCFS order on resume
         snap = {"version": SNAPSHOT_VERSION, "minor": SNAPSHOT_MINOR,
@@ -1919,10 +2064,21 @@ class ServingEngine:
         (the preemption recompute path), the remaining deadline is
         re-anchored on this engine's clock, and the admission bound is
         bypassed (restored work was already admitted once — shedding it
-        would drop accepted work)."""
+        would drop accepted work). An adapter'd record REQUIRES its
+        adapter loaded here (typed AdapterNotLoaded otherwise): a
+        migrated request must re-land with the adapter or not at all —
+        the fleet parks it typed, never serves the wrong weights."""
+        adapter = rec.get("adapter")
+        if adapter is not None and (self.lora is None
+                                    or not self.lora.has(adapter)):
+            self.metrics.counters["adapter_rejects"] += 1
+            raise AdapterNotLoaded(
+                f"snapshot request {rec['request_id']} needs adapter "
+                f"{adapter!r}, which this engine does not hold",
+                adapter=adapter)
         req = Request(rec["prompt_ids"], rec["max_new_tokens"],
                       rec.get("eos_token_id"),
-                      request_id=rec["request_id"])
+                      request_id=rec["request_id"], adapter=adapter)
         if len(req.prompt_ids) + req.max_new_tokens > self.max_seq_len:
             raise ValueError(
                 f"snapshot request {req.request_id} needs "
@@ -1935,6 +2091,11 @@ class ServingEngine:
         if rem is not None:
             req.deadline = self._now() + float(rem)
         self.scheduler.add_request(req, force=True)
+        if adapter is not None:
+            self.lora.acquire(adapter)     # pinned until terminal
+            # THIS engine's load generation namespaces the radix key —
+            # the adopting registry's weights are what will serve it
+            req.adapter_key = self.lora.namespace_of(adapter)
         self.requests[req.request_id] = req
         # adopted, not added: a migrated request already counted as an
         # arrival on its original engine, and fleet summaries merge

@@ -46,6 +46,7 @@ from typing import Dict, List, Optional, Set, Tuple
 from ...utils import faults
 from ..engine import check_snapshot_version
 from ..errors import EngineFailure, EngineOverloaded
+from ..lora.adapter import AdapterNotLoaded
 from ..metrics import ServingMetrics
 from ..scheduler import RequestState
 from .errors import (NoHealthyReplica, ReplicaCrashed, SloUnattainable,
@@ -229,6 +230,11 @@ class Fleet:
             # accepted work that still burned its budget
             "slo_ttft_violations": 0,
             "slo_tpot_violations": 0,
+            # ISSUE 15: parked adapter'd requests that could not re-land
+            # because NO survivor held their adapter — kept parked
+            # (typed), re-tried each parked sweep, never served with
+            # the wrong weights and never silently lost
+            "adapter_parks": 0,
         }
 
     # ---- lookups ---------------------------------------------------------
@@ -255,6 +261,7 @@ class Fleet:
     def submit(self, prompt_ids, max_new_tokens: int = 32, *,
                eos_token_id: Optional[int] = None,
                tenant: Optional[str] = None,
+               adapter: Optional[str] = None,
                ttl_s: Optional[float] = None,
                deadline: Optional[float] = None,
                ttft_slo_s: Optional[float] = None,
@@ -271,7 +278,13 @@ class Fleet:
         whole lifetime, which only the per-token rate can size). Sheds are typed: `TenantThrottled` (fairness cap),
         `SloUnattainable` (TTFT target hopeless at current load),
         `EngineOverloaded` (every candidate's queue full),
-        `NoHealthyReplica` (nobody in rotation)."""
+        `NoHealthyReplica` (nobody in rotation), `AdapterNotLoaded`
+        (ISSUE 15: no candidate replica holds the named adapter —
+        routing prefers adapter-holding replicas, and an adapter'd
+        request sheds typed rather than ever serving other weights;
+        per-adapter fairness rides the existing `tenant` cap — pass
+        the adapter (or its owner) as the tenant to cap its live
+        share)."""
         self._process_parked()
         tkey = tenant if tenant is not None else _DEFAULT_TENANT
         if self.max_inflight_per_tenant is not None and \
@@ -302,8 +315,10 @@ class Fleet:
             raise NoHealthyReplica("no healthy replica to accept work")
         prompt_ids = [int(t) for t in prompt_ids]
         est_floor = None
+        overloaded_holder = None
         while True:
-            chosen = self.router.route(prompt_ids, candidates)
+            chosen = self.router.route(prompt_ids, candidates,
+                                       adapter=adapter)
             if ttft_slo_s is not None and self.est_ttft_per_queued_s:
                 # the SLO check scores the replica the request would
                 # ACTUALLY land on — scoring the fleet minimum would
@@ -336,11 +351,23 @@ class Fleet:
                 rid = chosen.engine.add_request(
                     prompt_ids, max_new_tokens=max_new_tokens,
                     eos_token_id=eos_token_id, ttl_s=ttl_s,
-                    deadline=deadline)
-            except EngineOverloaded:
+                    deadline=deadline, adapter=adapter)
+            except (EngineOverloaded, AdapterNotLoaded) as exc:
+                # typed per-candidate refusal (queue full, or the
+                # chosen replica does not hold the adapter): try the
+                # rest. When everyone refuses, surface the MOST
+                # ACTIONABLE shed: an overload from a replica that DOES
+                # hold the adapter outranks "adapter not loaded"
+                # elsewhere — a retryable 429, not a spurious 404
+                # claiming the adapter is missing from the fleet.
+                if isinstance(exc, EngineOverloaded):
+                    overloaded_holder = exc
                 candidates = [c for c in candidates if c is not chosen]
                 if not candidates:
                     self.counters["requests_shed"] += 1
+                    if isinstance(exc, AdapterNotLoaded) and \
+                            overloaded_holder is not None:
+                        raise overloaded_holder from exc
                     raise
                 continue
             break
@@ -354,7 +381,8 @@ class Fleet:
             # the read-only match_len probe re-runs only when tracing
             tracer.mark(rid, "route", chosen=chosen.name,
                         scores={c.name: {"match_len":
-                                         c.match_len(prompt_ids),
+                                         c.match_len(prompt_ids,
+                                                     adapter=adapter),
                                          "load": c.load}
                                 for c in candidates})
         self._handles[rid] = handle
@@ -630,21 +658,44 @@ class Fleet:
             # max_seq_len). Try every healthy candidate; only when all
             # refuse is the request finalized "lost" — never silently
             # vanished, never an exception up through an unrelated
-            # caller's submit()/step loop.
+            # caller's submit()/step loop. Exception (ISSUE 15): an
+            # adapter'd record every survivor refused FOR THE ADAPTER
+            # stays PARKED (typed, counted) — it re-lands the moment
+            # some replica loads the adapter, and is never served with
+            # the wrong weights nor finalized lost while survivors
+            # exist.
             candidates = list(healthy)
             target = None
+            adapter_refusals = other_refusals = 0
             while candidates:
                 pick = self.router.route(
-                    rec["prompt_ids"] + rec["output_ids"], candidates)
+                    rec["prompt_ids"] + rec["output_ids"], candidates,
+                    adapter=rec.get("adapter"))
                 try:
                     pick.engine.adopt_requests([rec])
+                except AdapterNotLoaded:
+                    adapter_refusals += 1
+                    candidates = [c for c in candidates if c is not pick]
+                    continue
                 except Exception:                     # noqa: BLE001
+                    other_refusals += 1
                     candidates = [c for c in candidates if c is not pick]
                     continue
                 target = pick
                 break
             if target is None:
-                self._finalize(rid, "lost")
+                if adapter_refusals and not other_refusals:
+                    rem = rec.get("deadline_remaining_s")
+                    if rem is not None and rem <= 0:
+                        # its TTL lapsed while waiting for the adapter:
+                        # expire (the terminal an adopter would apply)
+                        # instead of parking a dead request forever
+                        self._finalize(rid, "expired")
+                    else:
+                        self.counters["adapter_parks"] += 1
+                        self._parked.append((self._clock(), rec))
+                else:
+                    self._finalize(rid, "lost")
                 continue
             self._assign_to(rid, target)
             handle.migrations += 1

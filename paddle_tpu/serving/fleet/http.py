@@ -10,15 +10,17 @@ Routes:
 
 * ``POST /v1/completions`` — body ``{"prompt_ids": [...],
   "max_new_tokens": N, "stream": true|false, "eos_token_id": ...,
-  "ttl_s": ..., "tenant": ..., "ttft_slo_s": ..., "tpot_slo_s": ...}``.
+  "ttl_s": ..., "tenant": ..., "adapter": ...,
+  "ttft_slo_s": ..., "tpot_slo_s": ...}``.
   With ``stream`` (default true) the response is Server-Sent Events:
   one ``data: {token event}`` per token delta from the existing
   `TokenStream`, then one ``data: {finish event}``, then ``data:
   [DONE]`` — the OpenAI-style shape at token-id level. Without it, one
   JSON body ``{"request_id", "tokens", "finish_reason"}``. Typed
   admission sheds map to status codes: 429 (`EngineOverloaded` /
-  tenant throttle / SLO shed), 503 (`NoHealthyReplica`), 400 for bad
-  payloads.
+  tenant throttle / SLO shed), 503 (`NoHealthyReplica`),
+  404 (`AdapterNotLoaded` — the named LoRA adapter is on no healthy
+  replica, ISSUE 15), 400 for bad payloads.
 * ``GET /metrics`` — the existing `FleetServer.metrics_text()`
   Prometheus body (merged fleet + per-replica labels).
 * ``GET /healthz`` — JSON from replica heartbeats: per-replica state +
@@ -187,13 +189,14 @@ class HttpFrontend:
 
     async def _completions(self, body: bytes, writer):
         from ..errors import EngineOverloaded
+        from ..lora.adapter import AdapterNotLoaded
         from .errors import NoHealthyReplica
         try:
             req = json.loads(body.decode("utf-8") or "{}")
             prompt_ids = [int(t) for t in req["prompt_ids"]]
             kw = {}
             for k in ("max_new_tokens", "eos_token_id", "ttl_s",
-                      "tenant", "ttft_slo_s", "tpot_slo_s"):
+                      "tenant", "adapter", "ttft_slo_s", "tpot_slo_s"):
                 if req.get(k) is not None:
                     kw[k] = req[k]
             stream_mode = bool(req.get("stream", True))
@@ -205,6 +208,15 @@ class HttpFrontend:
             return
         try:
             stream = await self.server.submit(prompt_ids, **kw)
+        except AdapterNotLoaded as e:
+            # ISSUE 15: the named LoRA adapter is loaded on no healthy
+            # replica — a resource the fleet does not currently hold
+            self.counters["sheds"] += 1
+            writer.write(_http_response(
+                404, "Not Found",
+                json.dumps({"error": type(e).__name__,
+                            "detail": str(e)}).encode()))
+            return
         except EngineOverloaded as e:
             self.counters["sheds"] += 1
             writer.write(_http_response(

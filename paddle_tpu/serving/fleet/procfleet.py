@@ -267,16 +267,33 @@ class ProcessFleet:
     # ---- admission -------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int = 32, *,
                eos_token_id: Optional[int] = None,
-               ttl_s: Optional[float] = None):
+               ttl_s: Optional[float] = None,
+               adapter: Optional[str] = None):
         """Route one request to the least-loaded ready worker; returns
         its FleetHandle. The full record is retained host-side — it is
         the migration payload of last resort when a worker dies before
-        ever shipping a snapshot."""
+        ever shipping a snapshot.
+
+        `adapter` (ISSUE 15) rides the request record: the worker's
+        engine adopts it only with the adapter loaded (typed reject ->
+        the existing park/exclude/re-land machinery finds a holder).
+        Placement prefers workers whose SPEC declares the adapter in
+        its `lora` block (factory-built registries are invisible
+        host-side, so spec-less candidates stay eligible and the
+        reject path remains the arbiter)."""
         from .errors import NoHealthyReplica
         from ..errors import EngineOverloaded
         candidates = self._healthy()
         if not candidates:
             raise NoHealthyReplica("no ready worker to accept work")
+        if adapter is not None:
+            declared = [w for w in candidates
+                        if any(ad.get("name") == adapter
+                               for ad in (self._base_specs.get(
+                                   w.name, {}).get("lora", {})
+                                   .get("adapters", ())))]
+            if declared:
+                candidates = declared
 
         def load_of(w):
             return w.reported_load + len(self._assigned_to(w.name))
@@ -298,6 +315,7 @@ class ProcessFleet:
                "eos_token_id": (None if eos_token_id is None
                                 else int(eos_token_id)),
                "num_preemptions": 0, "aborted": False,
+               "adapter": adapter,
                "deadline_remaining_s": (None if ttl_s is None
                                         else float(ttl_s))}
         handle = self._handle_cls()(rid, "_default")

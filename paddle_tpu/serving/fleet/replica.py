@@ -76,13 +76,35 @@ class Replica:
         s = self.engine.scheduler
         return s.num_in_flight + s.queue_depth
 
-    def match_len(self, tokens) -> int:
+    def match_len(self, tokens, adapter=None) -> int:
         """Read-only longest-cached-prefix probe of THIS replica's radix
         tree (0 with the prefix cache off) — the router's primary
         score. Must never perturb the cache: `RadixCache.match_len`
-        skips the LRU bump by contract."""
+        skips the LRU bump by contract. `adapter` namespaces the probe
+        key exactly like the scheduler's match (ISSUE 15), so the score
+        reflects what admission would actually reuse."""
         radix = self.engine.radix
-        return 0 if radix is None else radix.match_len(tokens)
+        if radix is None:
+            return 0
+        key = adapter
+        if adapter is not None:
+            lora = getattr(self.engine, "lora", None)
+            if lora is None or not lora.has(adapter):
+                return 0           # nothing cached under an unheld adapter
+            # the engine namespaces by (name, load-generation) — probe
+            # with the same key admission would match with
+            key = lora.namespace_of(adapter)
+        from ..scheduler import adapter_prefix_key
+        return radix.match_len(adapter_prefix_key(list(tokens), key))
+
+    def has_adapter(self, adapter) -> bool:
+        """True when this replica's registry currently holds `adapter`
+        (trivially True for base-model traffic) — the adapter-affinity
+        router's primary score (ISSUE 15)."""
+        if adapter is None:
+            return True
+        lora = getattr(self.engine, "lora", None)
+        return lora is not None and lora.has(adapter)
 
     # ---- the stepping loop body -----------------------------------------
     def _targets_me(self, payload) -> bool:

@@ -31,9 +31,13 @@ __all__ = ["Router", "PrefixAffinityRouter", "RandomRouter",
 
 
 class Router:
-    """Strategy interface: pick one replica from the candidates."""
+    """Strategy interface: pick one replica from the candidates.
+    `adapter` (ISSUE 15) is the request's LoRA adapter name (None for
+    base-model traffic) — policies may use it for placement; the
+    baselines ignore it."""
 
-    def route(self, tokens, replicas: List[Replica]) -> Replica:
+    def route(self, tokens, replicas: List[Replica],
+              adapter=None) -> Replica:
         raise NotImplementedError
 
     @staticmethod
@@ -43,17 +47,27 @@ class Router:
 
 
 class PrefixAffinityRouter(Router):
-    """Longest cached prefix first; least load, then name, break ties.
+    """Loaded-adapter match first, longest cached prefix second; least
+    load, then name, break ties.
 
-    With cold caches every score is 0, so the policy degrades to pure
-    least-loaded — affinity only concentrates traffic once there is an
-    actual prefix to be affine TO."""
+    The adapter score dominates (ISSUE 15): landing an adapter'd
+    request on a replica that already HOLDS the adapter avoids a
+    load/evict churn (or a typed refusal) entirely, and prefix
+    affinity is worthless across adapters anyway — the radix key is
+    adapter-namespaced, so only same-adapter replicas can have a
+    matching prefix to begin with. The prefix probe uses the same
+    namespaced key the scheduler matches with (read-only, no LRU
+    perturbation). With cold caches and base-model traffic every score
+    is 0 and the policy degrades to pure least-loaded."""
 
-    def route(self, tokens, replicas: List[Replica]) -> Replica:
+    def route(self, tokens, replicas: List[Replica],
+              adapter=None) -> Replica:
         self._require(replicas)
         tokens = list(tokens)
         return min(replicas,
-                   key=lambda r: (-r.match_len(tokens), r.load, r.name))
+                   key=lambda r: (-int(r.has_adapter(adapter)),
+                                  -r.match_len(tokens, adapter=adapter),
+                                  r.load, r.name))
 
 
 class RandomRouter(Router):
@@ -62,7 +76,8 @@ class RandomRouter(Router):
     def __init__(self, seed: int = 0):
         self._rng = random.Random(seed)
 
-    def route(self, tokens, replicas: List[Replica]) -> Replica:
+    def route(self, tokens, replicas: List[Replica],
+              adapter=None) -> Replica:
         self._require(replicas)
         return replicas[self._rng.randrange(len(replicas))]
 
@@ -73,7 +88,8 @@ class RoundRobinRouter(Router):
     def __init__(self):
         self._i = 0
 
-    def route(self, tokens, replicas: List[Replica]) -> Replica:
+    def route(self, tokens, replicas: List[Replica],
+              adapter=None) -> Replica:
         self._require(replicas)
         r = replicas[self._i % len(replicas)]
         self._i += 1

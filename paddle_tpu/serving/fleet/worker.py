@@ -63,7 +63,8 @@ from typing import Dict, List, Optional
 from ...utils import faults
 from .transport import Channel, connect_store
 
-__all__ = ["run_worker", "WorkerLoop", "build_model", "FAULT_KILL9"]
+__all__ = ["run_worker", "WorkerLoop", "build_model", "build_engine",
+           "build_lora_registry", "FAULT_KILL9"]
 
 # Fires at the TOP of every worker loop iteration (an engine-boundary,
 # so the last shipped heartbeat snapshot is consistent): any payload ->
@@ -301,19 +302,56 @@ class WorkerLoop:
         return True
 
 
+def build_lora_registry(model, lora_spec: dict):
+    """AdapterRegistry from a JSON-safe spec (ISSUE 15):
+    {"rank_buckets": [8], "slots": 8, "adapters": [{"name", "rank",
+    "seed", "quant"}]} loads seed-deterministic adapters — every worker
+    building from the SAME spec holds bit-identical adapter weights
+    (the `build_model` discipline), which is what makes cross-process
+    migration of adapter'd requests greedy-bit-identical — or
+    {"factory": "pkg.mod:fn", "kwargs": {...}} for real checkpoints."""
+    if "factory" in lora_spec:
+        import importlib
+        mod, _, fn = lora_spec["factory"].partition(":")
+        return getattr(importlib.import_module(mod), fn)(
+            model, **lora_spec.get("kwargs", {}))
+    from ..lora import AdapterRegistry, LoRAAdapter
+    from ..lora.store import llama_lora_dims
+    dims = llama_lora_dims(model.cfg)
+    reg = AdapterRegistry(
+        dims,
+        rank_buckets=tuple(lora_spec.get("rank_buckets", (8,))),
+        slots=int(lora_spec.get("slots", 8)))
+    for ad in lora_spec.get("adapters", ()):
+        reg.load(LoRAAdapter.random(ad["name"],
+                                    int(ad.get("rank", 8)), dims,
+                                    seed=int(ad.get("seed", 0))),
+                 quant=ad.get("quant"))
+    return reg
+
+
+def build_engine(spec: dict):
+    """(model, engine) from a worker spec — factored from `run_worker`
+    so the spec plumbing (incl. the ISSUE-15 `lora` block) is testable
+    in-process."""
+    from ..engine import ServingEngine
+    model = build_model(spec["model"])
+    engine_kw = dict(spec.get("engine", {}))
+    if spec.get("compile_cache_dir"):
+        engine_kw["compile_cache"] = spec["compile_cache_dir"]
+    if spec.get("lora"):
+        engine_kw["lora"] = build_lora_registry(model, spec["lora"])
+    return model, ServingEngine(model, **engine_kw)
+
+
 def run_worker(spec: dict) -> int:
     """Worker process entry: build engine + channel from `spec`, then
     loop until drained/shut down. Returns the exit code."""
     import jax
     jax.config.update("jax_platforms", spec.get("platform", "cpu"))
-    from ..engine import ServingEngine
     from ..errors import EngineFailure
 
-    model = build_model(spec["model"])
-    engine_kw = dict(spec.get("engine", {}))
-    if spec.get("compile_cache_dir"):
-        engine_kw["compile_cache"] = spec["compile_cache_dir"]
-    engine = ServingEngine(model, **engine_kw)
+    model, engine = build_engine(spec)
 
     store = connect_store(spec["endpoint"],
                           timeout_ms=int(spec.get("connect_timeout_ms",

@@ -1,0 +1,26 @@
+"""paddle_tpu.serving.lora — multi-LoRA adapter serving (ISSUE 15).
+
+Serve N fine-tuned variants of one base model in a single
+ServingEngine, S-LoRA/Punica style: adapter weights live PAGED in a
+device pool managed with the BlockAllocator's refcount/free-list
+discipline (`store.AdapterRegistry`), every compiled program gathers
+the loaded adapters' A/B pages in-graph into fixed-shape per-rank-
+bucket slot stacks, and one batched heterogeneous segment matmul
+(`kernels/lora_matmul.py`) applies each row's OWN adapter delta —
+rows of one launch may carry different adapters, and the program grid
+never grows per adapter (the stack/slot geometry rides the program
+key, individual adapter ids never do).
+
+The runtime half (`runtime.py`) threads the launch's adapter context
+through the model's projection hooks via a trace-time scope — zero
+cost when no scope is active (the training path and lora-less engines
+trace exactly the graphs they always did).
+"""
+from .adapter import (AdapterBusy, AdapterError, AdapterLoadError,
+                      AdapterNotLoaded, LoRAAdapter)
+from .store import AdapterRegistry, LoRALayout
+from .runtime import lora_scope, current_lora, apply_lora
+
+__all__ = ["LoRAAdapter", "AdapterRegistry", "LoRALayout",
+           "AdapterError", "AdapterNotLoaded", "AdapterLoadError",
+           "AdapterBusy", "lora_scope", "current_lora", "apply_lora"]

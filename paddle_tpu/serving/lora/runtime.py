@@ -1,0 +1,149 @@
+"""Trace-time LoRA threading: scope, in-graph paged gather, delta op.
+
+The engine's lora-enabled programs take the registry's `flat_args()`
+(pools, page tables, scales) plus the launch's per-row slot ids as
+ordinary jit arguments, build a `LoRAContext` from them INSIDE the
+traced program body, and enter `lora_scope(ctx)` around the model
+call. The model's projection hooks (`apply_lora`, called from
+models/llama.py) read the ambient scope: with none active they return
+the projection output UNTOUCHED — the training path and lora-less
+engines trace exactly the graphs they always did, at the cost of one
+thread-local read per projection per trace.
+
+In-graph gather (the paged read path): for each rank bucket, the slot
+stacks A (S, H, R) / B (S, R, N) materialize from the pools via
+`pool[page_table]` — the same gathered-view idea the chunk program
+uses for the paged KV prefix. Quantized slots dequantize during the
+gather (per-column scales) and the two pools SUM: an adapter lives in
+exactly one pool while the other's table rows hold the all-zero PAD
+page, so the sum adds an exact 0.0 and bit-identity across
+fp32/int8/mixed layouts of OTHER slots holds by construction. Per-slot
+alpha/rank scaling folds into the B stack once, here, so the Pallas
+kernel and the XLA fallback compute the identical x @ A @ (B*scale).
+
+Delta dispatch: single-token rows (decode, multi-decode scan steps) go
+through the masked segment-bmm Pallas kernel when the tiling is legal
+(`kernels/lora_matmul.py`); multi-token rows (prefill chunks) and
+untileable shapes take the XLA gathered-bmv. Rows whose slot falls
+outside a bucket map to that bucket's null slot 0 (all zeros), so the
+per-bucket sum needs no extra masking.
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+import numpy as np
+
+from ...ops.dispatch import apply_op
+
+__all__ = ["lora_scope", "current_lora", "apply_lora", "LoRAContext",
+           "build_context"]
+
+_ACTIVE = threading.local()
+
+
+def current_lora():
+    """The active LoRAContext, or None (the one check the default
+    trace path pays)."""
+    return getattr(_ACTIVE, "ctx", None)
+
+
+@contextmanager
+def lora_scope(ctx):
+    prev = getattr(_ACTIVE, "ctx", None)
+    _ACTIVE.ctx = ctx
+    try:
+        yield ctx
+    finally:
+        _ACTIVE.ctx = prev
+
+
+class LoRAContext:
+    """One launch's adapter view: per-bucket per-module (A, B) stacks
+    (B pre-scaled) + the per-row global slot ids."""
+
+    def __init__(self, layout, stacks, row_slots):
+        self.layout = layout
+        self.stacks = stacks          # {bucket: {module: (A, B)}}
+        self.row_slots = row_slots    # (B,) int32 global slot ids
+
+    def delta(self, module, x):
+        """(b, t, h) x -> (b, t, out) fp32 delta, summed over the rank
+        buckets (a row lives in exactly one; others hit null slot 0)."""
+        import jax.numpy as jnp
+        from ...kernels.lora_matmul import (lora_matmul,
+                                            lora_matmul_supported,
+                                            lora_matmul_xla)
+        lay = self.layout
+        b, t, h = x.shape
+        x2 = x.reshape(b * t, h)
+        slots = self.row_slots.astype(jnp.int32)
+        if t > 1:
+            slots = jnp.repeat(slots, t)
+        total = None
+        for bi, r in enumerate(lay.rank_buckets):
+            a_stack, b_stack = self.stacks[r][module]
+            local = slots - np.int32(bi * lay.slots)
+            in_bucket = jnp.logical_and(local >= 0, local < lay.slots)
+            local = jnp.where(in_bucket, local, 0)
+            n_out = b_stack.shape[2]
+            if t == 1 and lora_matmul_supported(b, h, r, n_out, x2.dtype):
+                d = lora_matmul(x2, local, a_stack, b_stack)
+            else:
+                d = lora_matmul_xla(x2, local, a_stack, b_stack)
+            total = d if total is None else total + d
+        return total.reshape(b, t, -1)
+
+
+def build_context(layout, flat_args, row_slots):
+    """Unflatten a registry `flat_args()` tuple (traced) + per-row slot
+    ids into a LoRAContext: gather every bucket's slot payloads from
+    the paged pools, slice/reshape per module, dequantize int8 slots,
+    fold the per-slot scaling into B."""
+    import jax.numpy as jnp
+    pool_f, pool_q = flat_args[0], flat_args[1]
+    stacks = {}
+    idx = 2
+    for r in layout.rank_buckets:
+        table_f, table_q, scales, scaling = flat_args[idx:idx + 4]
+        idx += 4
+        # (slots, pages, page_elems) -> (slots, pages * page_elems):
+        # the flat payload view _pack wrote, PAD rows exact zeros
+        pay_f = jnp.take(pool_f, table_f, axis=0).reshape(
+            layout.slots, -1)
+        pay_q = jnp.take(pool_q, table_q, axis=0).reshape(
+            layout.slots, -1)
+        per_mod = {}
+        for m, (di, do) in layout.dims.items():
+            a0, a1 = layout.offsets[r][m]
+            b0, b1 = layout.offsets[r][m + "#B"]
+            s0, s1 = layout.scale_offsets[r][m]
+            t0, t1 = layout.scale_offsets[r][m + "#B"]
+            a_f = pay_f[:, a0:a1].reshape(layout.slots, di, r)
+            b_f = pay_f[:, b0:b1].reshape(layout.slots, r, do)
+            a_q = pay_q[:, a0:a1].reshape(
+                layout.slots, di, r).astype(jnp.float32) \
+                * scales[:, s0:s1][:, None, :]
+            b_q = pay_q[:, b0:b1].reshape(
+                layout.slots, r, do).astype(jnp.float32) \
+                * scales[:, t0:t1][:, None, :]
+            a = a_f + a_q                       # one pool is exact zeros
+            bmat = (b_f + b_q) * scaling[:, None, None]
+            per_mod[m] = (a, bmat)
+        stacks[r] = per_mod
+    return LoRAContext(layout, stacks, row_slots)
+
+
+def apply_lora(module: str, x, y):
+    """Projection hook (called from models/llama.py): y + delta when a
+    scope is active and targets `module`; y itself otherwise. x is the
+    projection INPUT, y its output (Tensors)."""
+    ctx = current_lora()
+    if ctx is None or module not in ctx.layout.dims:
+        return y
+
+    def _add(xa, ya):
+        return ya + ctx.delta(module, xa).astype(ya.dtype)
+
+    return apply_op("lora_delta", _add, x, y)

@@ -1,0 +1,490 @@
+"""Paged adapter-weight store + registry (ISSUE 15).
+
+S-LoRA's memory insight, mapped onto this tree's own machinery: adapter
+weights are just more device pages. `AdapterRegistry` owns a flat
+device pool `(num_pages, page_size * 128)` managed by the SAME
+`BlockAllocator` discipline the KV cache uses — ref-counted pages, a
+FIFO free list, all-or-nothing allocation, page 0 reserved as the
+all-zero PAD page — and packs each adapter's padded A/B factors for
+every target module into a fixed per-rank-bucket number of pages.
+
+Slot discipline (the determinism backbone): every rank bucket has a
+FIXED number of launch slots (slot 0 = the null adapter, all zeros —
+the PAD-page idea again). Loading assigns a free slot; unloading frees
+it; LRU eviction of IDLE adapters (zero live request refs) makes room.
+Compiled programs take the (pool, page-table, scales) arrays as
+call-time INPUTS and gather each slot's pages in-graph, so:
+
+* program shapes depend only on the (slots, rank-bucket, page) layout
+  — `signature()` rides the ProgramCache key; adapter ids never do,
+  and load/unload/evict NEVER recompiles;
+* a row's delta reads only its own slot's gathered values, so
+  per-adapter outputs are bit-identical between a solo engine and a
+  mixed-adapter engine with the same layout (the masked segment-bmm
+  adds exact 0.0 for every other slot).
+
+Per-adapter int8 (`load(..., quant="int8")`) stores the payload in a
+separate int8 pool (its own allocator — one page discipline each)
+through the existing `nn.quant.weight_quantize` path, with per-column
+fp32 scales in a dense per-bucket host array; the in-graph gather
+dequantizes and the two pools SUM (an adapter lives in exactly one, the
+other contributes the PAD page's exact zeros).
+
+Fault points (`utils/faults.py`, table in SERVING.md):
+`serving.lora.load_fail` makes `load` raise the typed AdapterLoadError
+(mid-stream load failures shed typed, never poison co-batched rows);
+`serving.lora.evict_race` makes the LRU evictor ATTEMPT a busy
+(live-ref) victim — the refcount guard must refuse it, counted in
+`lora_evict_refusals` (a mid-flight request can never lose its
+weights).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...utils import faults
+from ..kv_cache import BlockAllocator, BlocksExhausted
+from .adapter import (AdapterBusy, AdapterLoadError, AdapterNotLoaded,
+                      LoRAAdapter)
+
+__all__ = ["LoRALayout", "AdapterRegistry", "llama_lora_dims",
+           "FAULT_LOAD", "FAULT_EVICT"]
+
+FAULT_LOAD = faults.register_point("serving.lora.load_fail")
+FAULT_EVICT = faults.register_point("serving.lora.evict_race")
+
+LANES = 128          # payload lane width: one allocator "token" = 128 elems
+_DEFAULT_TARGETS = ("q_proj", "k_proj", "v_proj", "o_proj",
+                    "gate_proj", "up_proj", "down_proj")
+
+
+def llama_lora_dims(cfg, targets=_DEFAULT_TARGETS) -> Dict[str, Tuple[int, int]]:
+    """{module: (in_dim, out_dim)} for a Llama-family config — the
+    attention q/k/v/o + MLP gate/up/down projections ISSUE 15 targets."""
+    h = cfg.hidden_size
+    i = cfg.intermediate_size
+    hd = h // cfg.num_attention_heads
+    kv = cfg.num_key_value_heads * hd
+    all_dims = {"q_proj": (h, h), "k_proj": (h, kv), "v_proj": (h, kv),
+                "o_proj": (h, h), "gate_proj": (h, i), "up_proj": (h, i),
+                "down_proj": (i, h)}
+    unknown = [t for t in targets if t not in all_dims]
+    if unknown:
+        raise ValueError(f"unknown LoRA targets {unknown}")
+    return {t: all_dims[t] for t in targets}
+
+
+class LoRALayout:
+    """Static payload geometry: per rank-bucket module offsets into the
+    flat paged payload, page counts, and scale-row offsets. Everything
+    here is shape-only — it defines program signatures and rides the
+    ProgramCache key via `signature()`."""
+
+    def __init__(self, dims: Dict[str, Tuple[int, int]],
+                 rank_buckets=(8,), slots: int = 8, page_size: int = 8):
+        if slots < 2:
+            raise ValueError("need >= 2 slots (slot 0 is the null adapter)")
+        self.dims = dict(dims)
+        self.targets = tuple(dims)
+        self.rank_buckets = tuple(sorted(int(r) for r in rank_buckets))
+        if len(set(self.rank_buckets)) != len(self.rank_buckets):
+            raise ValueError("duplicate rank buckets")
+        self.slots = int(slots)
+        self.page_size = int(page_size)
+        self.page_elems = self.page_size * LANES
+        # per-bucket payload layout: [A_m0 | B_m0 | A_m1 | B_m1 | ...]
+        self.offsets: Dict[int, Dict[str, Tuple[int, int]]] = {}
+        self.scale_offsets: Dict[int, Dict[str, Tuple[int, int]]] = {}
+        self.payload_elems: Dict[int, int] = {}
+        self.scale_elems: Dict[int, int] = {}
+        self.pages_per_adapter: Dict[int, int] = {}
+        for r in self.rank_buckets:
+            off, soff = 0, 0
+            offs, soffs = {}, {}
+            for m, (di, do) in self.dims.items():
+                offs[m] = (off, off + di * r)            # A span
+                off += di * r
+                offs[m + "#B"] = (off, off + r * do)     # B span
+                off += r * do
+                soffs[m] = (soff, soff + r)              # A scales (r,)
+                soff += r
+                soffs[m + "#B"] = (soff, soff + do)      # B scales (do,)
+                soff += do
+            self.offsets[r] = offs
+            self.scale_offsets[r] = soffs
+            self.payload_elems[r] = off
+            self.scale_elems[r] = soff
+            tokens = -(-off // LANES)
+            self.pages_per_adapter[r] = -(-tokens // self.page_size)
+
+    def bucket_for(self, rank: int) -> int:
+        for r in self.rank_buckets:
+            if rank <= r:
+                return r
+        raise AdapterLoadError(
+            f"rank {rank} exceeds largest rank bucket "
+            f"{self.rank_buckets[-1]}")
+
+    def payload_tokens(self, bucket: int) -> int:
+        return -(-self.payload_elems[bucket] // LANES)
+
+    def global_slot(self, bucket: int, local: int) -> int:
+        return self.rank_buckets.index(bucket) * self.slots + local
+
+    def signature(self) -> tuple:
+        """Static shape identity for ProgramCache keys — adapters load
+        and unload without ever changing it."""
+        return ("lora", self.slots, self.rank_buckets, self.page_size,
+                tuple(sorted((m, d) for m, d in self.dims.items())))
+
+
+class _Entry:
+    __slots__ = ("name", "rank", "bucket", "local", "quant", "seq",
+                 "scaling", "refs", "last_use", "gen")
+
+    def __init__(self, name, rank, bucket, local, quant, seq, scaling,
+                 gen):
+        self.name = name
+        self.rank = rank
+        self.bucket = bucket
+        self.local = local
+        self.quant = quant
+        self.seq = seq
+        self.scaling = float(scaling)
+        self.refs = 0
+        self.last_use = 0
+        # monotonic LOAD generation: the radix-namespace version. A
+        # replace/reload under the same NAME gets a new gen, so cached
+        # KV donated under the old weights can never match a request
+        # served with the new ones (stale-prefix poisoning).
+        self.gen = gen
+
+
+class AdapterRegistry:
+    """Runtime adapter store for ONE engine: paged device pools +
+    per-bucket slot tables, LRU eviction of idle adapters, live-request
+    refcounts. All mutation is host-side bookkeeping plus device
+    `.at[pages].set` page writes — never a recompile."""
+
+    def __init__(self, dims: Dict[str, Tuple[int, int]], *,
+                 rank_buckets=(8,), slots: int = 8, page_size: int = 8,
+                 num_pages: Optional[int] = None,
+                 num_quant_pages: Optional[int] = None,
+                 counters: Optional[dict] = None):
+        import jax.numpy as jnp
+        self.layout = LoRALayout(dims, rank_buckets=rank_buckets,
+                                 slots=slots, page_size=page_size)
+        lay = self.layout
+        # default pool sizing: every slot of every bucket can be
+        # resident at once (pressure/eviction tests pass smaller pools)
+        full = sum((lay.slots - 1) * lay.pages_per_adapter[r]
+                   for r in lay.rank_buckets) + 1
+        self.num_pages = int(num_pages) if num_pages is not None else full
+        self.num_quant_pages = (int(num_quant_pages)
+                                if num_quant_pages is not None else full)
+        self.allocator = BlockAllocator(self.num_pages, lay.page_size)
+        self.quant_allocator = BlockAllocator(self.num_quant_pages,
+                                              lay.page_size)
+        # page 0 of each pool is the PAD page and stays all-zero: a
+        # freed/never-loaded slot's table gathers exact zeros
+        self.pool = jnp.zeros((self.num_pages, lay.page_elems),
+                              jnp.float32)
+        self.quant_pool = jnp.zeros((self.num_quant_pages,
+                                     lay.page_elems), jnp.int8)
+        # host-side per-bucket launch tables (tiny; jnp-converted per
+        # launch by flat_args)
+        self._tables_f = {r: np.zeros((lay.slots,
+                                       lay.pages_per_adapter[r]),
+                                      np.int32)
+                          for r in lay.rank_buckets}
+        self._tables_q = {r: np.zeros((lay.slots,
+                                       lay.pages_per_adapter[r]),
+                                      np.int32)
+                          for r in lay.rank_buckets}
+        self._scales = {r: np.zeros((lay.slots, lay.scale_elems[r]),
+                                    np.float32)
+                        for r in lay.rank_buckets}
+        self._scaling = {r: np.zeros((lay.slots,), np.float32)
+                         for r in lay.rank_buckets}
+        self._free_slots = {r: list(range(1, lay.slots))
+                            for r in lay.rank_buckets}
+        self.entries: Dict[str, _Entry] = {}
+        self._tick = 0
+        self._load_gen = 0
+        self.counters = counters if counters is not None else {}
+
+    @classmethod
+    def for_model(cls, model, *, targets=_DEFAULT_TARGETS, **kw):
+        return cls(llama_lora_dims(model.cfg, targets), **kw)
+
+    # ------------------------------------------------------------ helpers
+    def _count(self, key: str, n: int = 1):
+        self.counters[key] = self.counters.get(key, 0) + n
+
+    def bind_counters(self, counters: dict):
+        """Re-home the registry counters into an engine's metrics
+        counters dict (existing counts carry over)."""
+        for k, v in self.counters.items():
+            counters[k] = counters.get(k, 0) + v
+        self.counters = counters
+
+    def _touch(self, entry: _Entry):
+        self._tick += 1
+        entry.last_use = self._tick
+
+    # ------------------------------------------------------------ queries
+    def has(self, name: str) -> bool:
+        return name in self.entries
+
+    def adapter_names(self) -> List[str]:
+        return sorted(self.entries)
+
+    def slot_of(self, name: str) -> int:
+        """Global launch-slot id of a LOADED adapter (0 is the null
+        adapter and never names a real one)."""
+        e = self.entries.get(name)
+        if e is None:
+            raise AdapterNotLoaded(f"adapter {name!r} is not loaded",
+                                   adapter=name)
+        return self.layout.global_slot(e.bucket, e.local)
+
+    def refs_of(self, name: str) -> int:
+        e = self.entries.get(name)
+        return 0 if e is None else e.refs
+
+    def namespace_of(self, name: str):
+        """(name, load-generation) — the radix-cache namespace token
+        for requests served under this adapter. The generation changes
+        on every (re)load, so prefixes cached under REPLACED weights of
+        the same name can never be served again (they age out of the
+        tree via LRU)."""
+        e = self.entries.get(name)
+        if e is None:
+            raise AdapterNotLoaded(f"adapter {name!r} is not loaded",
+                                   adapter=name)
+        return (e.name, e.gen)
+
+    # ------------------------------------------------------------ refs
+    def acquire(self, name: str):
+        """Pin `name` for one live request: a pinned adapter can never
+        be evicted (slot + pages stay put until release)."""
+        e = self.entries.get(name)
+        if e is None:
+            raise AdapterNotLoaded(f"adapter {name!r} is not loaded",
+                                   adapter=name)
+        e.refs += 1
+        self._touch(e)
+
+    def release(self, name: str):
+        e = self.entries.get(name)
+        if e is None:       # unloaded out from under a ref is a bug
+            raise AdapterNotLoaded(f"release of unknown adapter {name!r}",
+                                   adapter=name)
+        if e.refs <= 0:
+            raise RuntimeError(f"double release of adapter {name!r}")
+        e.refs -= 1
+
+    # ------------------------------------------------------------ load
+    def load(self, adapter: LoRAAdapter, quant: Optional[str] = None):
+        """Place `adapter` into a slot + pool pages; returns its global
+        slot id. Evicts LRU IDLE adapters on slot/page pressure; raises
+        the typed `AdapterLoadError` when nothing evictable remains (or
+        the `serving.lora.load_fail` fault fires), `AdapterBusy` never
+        — busy adapters are simply not eviction candidates."""
+        if faults.fire(FAULT_LOAD) is not None:
+            self._count("adapter_load_failures")
+            raise AdapterLoadError(
+                f"injected load failure for {adapter.name!r}",
+                adapter=adapter.name)
+        if quant not in (None, "int8"):
+            raise ValueError(f"quant must be None or 'int8', got {quant!r}")
+        if adapter.name in self.entries:
+            self.unload(adapter.name)      # replace (refuses if busy)
+        lay = self.layout
+        for m, (a, b) in adapter.weights.items():
+            if m not in lay.dims:
+                raise AdapterLoadError(
+                    f"adapter {adapter.name!r} targets {m!r} which is "
+                    f"not in the registry layout {lay.targets}",
+                    adapter=adapter.name)
+            di, do = lay.dims[m]
+            if a.shape[0] != di or b.shape[1] != do:
+                raise AdapterLoadError(
+                    f"adapter {adapter.name!r} module {m!r}: "
+                    f"A {a.shape} / B {b.shape} vs layout ({di}, {do})",
+                    adapter=adapter.name)
+        bucket = lay.bucket_for(adapter.rank)
+        if not self._free_slots[bucket] and \
+                not self._evict_lru(bucket=bucket):
+            self._count("adapter_load_failures")
+            raise AdapterLoadError(
+                f"no free slot in rank bucket {bucket} and nothing "
+                f"idle to evict", adapter=adapter.name)
+        alloc = self.quant_allocator if quant == "int8" else self.allocator
+        tokens = lay.payload_tokens(bucket)
+        while True:
+            try:
+                seq = alloc.alloc_sequence(tokens)
+                break
+            except BlocksExhausted:
+                if not self._evict_lru(pool=alloc):
+                    self._count("adapter_load_failures")
+                    raise AdapterLoadError(
+                        f"adapter pool exhausted loading "
+                        f"{adapter.name!r} ({tokens} tokens needed) and "
+                        f"nothing idle to evict", adapter=adapter.name)
+        local = self._free_slots[bucket].pop(0)
+        payload, scales = self._pack(adapter, bucket, quant)
+        self._write_pages(seq.pages, payload, quant)
+        table = self._tables_q if quant == "int8" else self._tables_f
+        table[bucket][local, :len(seq.pages)] = seq.pages
+        self._scales[bucket][local] = scales
+        self._scaling[bucket][local] = adapter.scaling
+        self._load_gen += 1
+        entry = _Entry(adapter.name, adapter.rank, bucket, local, quant,
+                       seq, adapter.scaling, self._load_gen)
+        self.entries[adapter.name] = entry
+        self._touch(entry)
+        self._count("adapters_loaded")
+        return lay.global_slot(bucket, local)
+
+    def _pack(self, adapter: LoRAAdapter, bucket: int,
+              quant: Optional[str]):
+        """Flat payload (pages * page_elems,) + dense scale row for one
+        adapter: A/B padded to the bucket rank (zero columns/rows — an
+        exact no-op on the delta), int8 quantized per out-channel via
+        the existing nn.quant path."""
+        lay = self.layout
+        r = bucket
+        n_pages = lay.pages_per_adapter[r]
+        dtype = np.int8 if quant == "int8" else np.float32
+        payload = np.zeros((n_pages * lay.page_elems,), dtype)
+        scales = np.zeros((lay.scale_elems[r],), np.float32)
+        for m, (di, do) in lay.dims.items():
+            got = adapter.weights.get(m)
+            if got is None:
+                continue                  # module not targeted: zeros
+            a, b = got
+            ap = np.zeros((di, r), np.float32)
+            ap[:, :adapter.rank] = a
+            bp = np.zeros((r, do), np.float32)
+            bp[:adapter.rank, :] = b
+            if quant == "int8":
+                aq, asc = _quantize_int8(ap)
+                bq, bsc = _quantize_int8(bp)
+                o0, o1 = lay.offsets[r][m]
+                payload[o0:o1] = aq.ravel()
+                o0, o1 = lay.offsets[r][m + "#B"]
+                payload[o0:o1] = bq.ravel()
+                s0, s1 = lay.scale_offsets[r][m]
+                scales[s0:s1] = asc
+                s0, s1 = lay.scale_offsets[r][m + "#B"]
+                scales[s0:s1] = bsc
+            else:
+                o0, o1 = lay.offsets[r][m]
+                payload[o0:o1] = ap.ravel()
+                o0, o1 = lay.offsets[r][m + "#B"]
+                payload[o0:o1] = bp.ravel()
+        return payload, scales
+
+    def _write_pages(self, pages: List[int], payload: np.ndarray,
+                     quant: Optional[str]):
+        import jax.numpy as jnp
+        lay = self.layout
+        chunks = payload.reshape(len(pages), lay.page_elems)
+        idx = jnp.asarray(np.asarray(pages, np.int32))
+        if quant == "int8":
+            self.quant_pool = self.quant_pool.at[idx].set(
+                jnp.asarray(chunks))
+        else:
+            self.pool = self.pool.at[idx].set(jnp.asarray(chunks))
+
+    # ------------------------------------------------------------ unload
+    def unload(self, name: str):
+        """Explicit unload; refuses (typed AdapterBusy) while live
+        requests still pin the adapter."""
+        e = self.entries.get(name)
+        if e is None:
+            raise AdapterNotLoaded(f"adapter {name!r} is not loaded",
+                                   adapter=name)
+        if e.refs > 0:
+            raise AdapterBusy(
+                f"adapter {name!r} has {e.refs} live request refs",
+                adapter=name, refs=e.refs)
+        self._drop(e)
+        self._count("adapters_unloaded")
+
+    def _drop(self, e: _Entry):
+        alloc = self.quant_allocator if e.quant == "int8" \
+            else self.allocator
+        alloc.free_sequence(e.seq)
+        table = self._tables_q if e.quant == "int8" else self._tables_f
+        table[e.bucket][e.local, :] = 0        # gather the PAD page
+        self._scales[e.bucket][e.local, :] = 0.0
+        self._scaling[e.bucket][e.local] = 0.0
+        self._free_slots[e.bucket].append(e.local)
+        self._free_slots[e.bucket].sort()
+        del self.entries[e.name]
+
+    def _evict_lru(self, bucket: Optional[int] = None, pool=None) -> bool:
+        """Evict ONE least-recently-used IDLE adapter (optionally
+        restricted to a bucket or a pool's allocator). The
+        `serving.lora.evict_race` fault makes this attempt a BUSY
+        victim first — the refcount guard refuses it (counted), which
+        is the whole point of the guard."""
+        if faults.fire(FAULT_EVICT) is not None:
+            busy = [e for e in self.entries.values() if e.refs > 0]
+            if busy:
+                self._count("lora_evict_refusals")
+        cands = [e for e in self.entries.values() if e.refs == 0]
+        if bucket is not None:
+            cands = [e for e in cands if e.bucket == bucket]
+        if pool is not None:
+            want_q = pool is self.quant_allocator
+            cands = [e for e in cands if (e.quant == "int8") == want_q]
+        if not cands:
+            return False
+        victim = min(cands, key=lambda e: e.last_use)
+        self._drop(victim)
+        self._count("adapters_evicted")
+        return True
+
+    # ------------------------------------------------------------ launch
+    def flat_args(self) -> tuple:
+        """The launch-input tuple every lora-enabled program takes:
+        (pool_f32, pool_int8) + per rank bucket
+        (table_f32, table_int8, scales, scaling). Pools are live device
+        arrays; the per-bucket tables are tiny host arrays converted
+        here. Shapes are layout-static — only VALUES change across
+        load/unload, so the ProgramCache key never moves."""
+        import jax.numpy as jnp
+        out = [self.pool, self.quant_pool]
+        for r in self.layout.rank_buckets:
+            out.extend([jnp.asarray(self._tables_f[r]),
+                        jnp.asarray(self._tables_q[r]),
+                        jnp.asarray(self._scales[r]),
+                        jnp.asarray(self._scaling[r])])
+        return tuple(out)
+
+    def signature(self) -> tuple:
+        return self.layout.signature() + (self.num_pages,
+                                          self.num_quant_pages)
+
+    def check_invariants(self):
+        self.allocator.check_invariants()
+        self.quant_allocator.check_invariants()
+        for e in self.entries.values():
+            assert e.refs >= 0
+            assert e.local not in self._free_slots[e.bucket]
+
+
+def _quantize_int8(w: np.ndarray):
+    """(in, out) fp32 -> (int8, per-out-channel fp32 scale), the same
+    math as nn.quant.weight_quantize('weight_only_int8') — kept in
+    numpy so packing a payload never touches the dispatch/AMP stack."""
+    absmax = np.maximum(np.abs(w).max(axis=0), 1e-10)
+    scale = (absmax / 127.0).astype(np.float32)
+    q = np.clip(np.round(w / scale[None, :]), -127, 127).astype(np.int8)
+    return q, scale

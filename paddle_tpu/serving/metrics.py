@@ -82,6 +82,20 @@ class ServingMetrics:
             "decode_launch_steps": 0,      # K summed over those launches
             "decode_launch_rows": 0,       # live rows summed over them
             "multi_decode_slot_shortfall": 0,  # K-1 slots the pool denied
+            # --- multi-LoRA serving (ISSUE 15) ---
+            # registry lifecycle (AdapterRegistry.bind_counters homes
+            # them here): loads, explicit unloads, LRU evictions of
+            # idle adapters, typed load failures (incl. the injected
+            # serving.lora.load_fail fault), evict-race guard refusals
+            # (a busy adapter picked for eviction and refused), and
+            # requests rejected at the door for naming an unloaded
+            # adapter
+            "adapters_loaded": 0,
+            "adapters_unloaded": 0,
+            "adapters_evicted": 0,
+            "adapter_load_failures": 0,
+            "lora_evict_refusals": 0,
+            "adapter_rejects": 0,
             # --- persistent compile cache (ISSUE 14) ---
             # mirrors of the engine's CompileCache counters (zero with
             # the cache off): hits skipped a trace+compile entirely;
@@ -121,6 +135,11 @@ class ServingMetrics:
         # coarser launches must not silently inflate the p99s
         self._tpot_samples = self.add_reservoir("tpot", scale=1e3,
                                                 suffix="_ms")
+        # distinct adapters per decode-side launch (ISSUE 15): the
+        # per-launch adapter-mix histogram — p50 > 1 means launches
+        # really are heterogeneous (the segment kernel's whole point)
+        self._adapter_mix_samples = self.add_reservoir("adapter_mix",
+                                                       digits=2)
         # gauges updated by the engine each step
         self.queue_depth = 0
         self.running = 0
@@ -214,6 +233,11 @@ class ServingMetrics:
         self.counters["decode_launch_rows"] += int(rows)
         if seconds is not None and seconds > 0 and tokens > 0:
             self._tpot_samples.append(seconds / tokens)
+
+    def on_adapter_mix(self, distinct: int):
+        """Distinct adapters (null/base excluded) in one decode-side
+        launch — the mixed-batch heterogeneity histogram (ISSUE 15)."""
+        self._adapter_mix_samples.append(int(distinct))
 
     def tokens_per_launch(self) -> Optional[float]:
         """Mean decode tokens emitted per ROW per decode-side launch

@@ -51,7 +51,20 @@ from typing import List, Optional
 from .kv_cache import BlockAllocator, BlocksExhausted
 
 __all__ = ["RequestState", "Request", "PrefillChunk", "ScheduleStep",
-           "Scheduler"]
+           "Scheduler", "adapter_prefix_key"]
+
+
+def adapter_prefix_key(ids, adapter):
+    """Radix-cache key for a (possibly adapter'd) token sequence
+    (ISSUE 15): a request served under a LoRA adapter namespaces every
+    token with the adapter id, so identical token prefixes under
+    different adapters (or adapter vs base) can NEVER share cached KV
+    pages — their K/V differ by the adapter delta. Length-preserving,
+    so all page-alignment math is untouched; the tree compares tokens
+    by equality only, so tuple tokens slot straight in."""
+    if adapter is None:
+        return ids
+    return [(adapter, t) for t in ids]
 
 
 class RequestState(enum.Enum):
@@ -81,7 +94,8 @@ class Request:
 
     def __init__(self, prompt_ids, max_new_tokens: int,
                  eos_token_id: Optional[int] = None,
-                 request_id: Optional[int] = None):
+                 request_id: Optional[int] = None,
+                 adapter: Optional[str] = None):
         self.request_id = (next(_req_counter) if request_id is None
                            else request_id)
         self.prompt_ids = [int(t) for t in prompt_ids]
@@ -91,6 +105,14 @@ class Request:
         if self.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         self.eos_token_id = eos_token_id
+        # LoRA adapter name (ISSUE 15; None = base model). Rides the
+        # launch slot mapping and snapshots. `adapter_key` is the
+        # radix-namespace token: the ENGINE overrides it with the
+        # registry's (name, load-generation) so prefixes cached under
+        # replaced weights of the same name can never match — the bare
+        # name is only the registry-less default.
+        self.adapter = adapter
+        self.adapter_key = adapter
         self.state = RequestState.WAITING
         self.output_ids: List[int] = []
         self.seq = None                 # KVSequence while holding pages
@@ -255,7 +277,12 @@ class Scheduler:
         then re-matches its own prefix instead of recomputing it)."""
         if self.prefix_cache is None or req.seq is None:
             return
-        ids = req.prompt_ids + req.output_ids
+        # adapter-namespaced key (ISSUE 15): an adapter'd request's KV
+        # holds the adapter delta — it must never serve another
+        # adapter's (or the base model's, or a RELOADED same-name
+        # adapter's) identical token prefix
+        ids = adapter_prefix_key(req.prompt_ids + req.output_ids,
+                                 req.adapter_key)
         n = min(req.num_computed, len(ids), req.seq.num_tokens)
         ps = self.allocator.page_size
         full = (n // ps) * ps
@@ -365,7 +392,8 @@ class Scheduler:
             n = len(ids)
             mpages, m = [], 0
             if self.prefix_cache is not None:
-                mpages, m = self.prefix_cache.match(ids)
+                mpages, m = self.prefix_cache.match(
+                    adapter_prefix_key(ids, req.adapter_key))
                 if m >= n:
                     # full hit: the LAST token must still run through
                     # the model to produce the next-token logits

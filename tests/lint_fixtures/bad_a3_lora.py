@@ -1,0 +1,72 @@
+"""Known-bad A3 for the LoRA segment-bmm (ISSUE 15): an oversized
+"rank" of 512 is no longer low-rank — the (1, 2048, 512) A block and
+(1, 512, 2048) B block are ~4 MB each, double-buffered, plus the fp32
+compute temporaries: ~25 MB of scoped VMEM. The `vmem-dtypes` hint
+refines the widths; it must never amnesty an oversized block (the
+kernel's MAX_KERNEL_RANK guard exists because of exactly this)."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_I0 = np.int32(0)
+_B = 8
+_BK = 2048
+_BN = 2048
+_R = 512
+
+
+def kernel(x_ref, a_ref, b_ref, ids_ref, o_ref, acc_ref, *, nk):
+    ki = pl.program_id(2)
+    si = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    acc_ref[...] += jax.lax.dot_general(
+        x_ref[...].astype(jnp.float32), a_ref[0],
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _flush():
+        mask = (ids_ref[0] == si).astype(jnp.float32)
+        contrib = jax.lax.dot_general(
+            acc_ref[...], b_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * mask[:, None]
+
+        @pl.when(si == 0)
+        def _first():
+            o_ref[...] = contrib
+
+        @pl.when(si > 0)
+        def _rest():
+            o_ref[...] += contrib
+
+
+def run(x, a_stack, b_stack, ids):
+    nk = x.shape[1] // _BK
+    grid = (b_stack.shape[2] // _BN, a_stack.shape[0], nk)
+    return pl.pallas_call(
+        functools.partial(kernel, nk=nk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_B, _BK), lambda j, s, k: (_I0, k)),
+            pl.BlockSpec((1, _BK, _R), lambda j, s, k: (s, k, _I0)),
+            pl.BlockSpec((1, _R, _BN), lambda j, s, k: (s, _I0, j)),
+            # block dims equal the (1, B) array dims (the documented
+            # whole-array-dim case A2 cannot see)
+            pl.BlockSpec((1, _B),  # tpu-lint: blockspec-ok
+                         lambda j, s, k: (_I0, _I0)),
+        ],
+        out_specs=pl.BlockSpec((_B, _BN), lambda j, s, k: (_I0, j)),
+        out_shape=jax.ShapeDtypeStruct((x.shape[0], b_stack.shape[2]),
+                                       jnp.float32),
+        scratch_shapes=[pltpu.VMEM((_B, _R), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+        # tpu-lint-hint: vmem-dtypes=float32,float32,float32,int32
+    )(x, a_stack, b_stack, ids)

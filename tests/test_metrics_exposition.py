@@ -51,6 +51,7 @@ def populated_metrics(tp_degree=1) -> ServingMetrics:
     m.on_decode(4)
     m.on_finish(1)
     m.on_spec_step(4, 2, 3, 2, 1)
+    m.on_adapter_mix(2)
     m.set_kv_info(kv_dtype="int8", page_bytes=1024, pool_bytes=65536,
                   bytes_per_token=128, tp_degree=tp_degree,
                   page_bytes_shard=1024 // tp_degree,
@@ -68,8 +69,19 @@ def test_snapshot_exposition_bijection():
     # reservoirs actually surfaced (percentile keys present)
     assert any(k.startswith("ttft_p") for k in snap)
     assert any(k.startswith("spec_accepted_p") for k in snap)
+    # multi-LoRA additions (ISSUE 15) ride the same registries in both
+    # directions: the adapter counters land in the counters dict (typed
+    # counter in the scrape) and the per-launch mix histogram is a
+    # registered reservoir (percentiles in snapshot AND scrape)
+    for key in ("adapters_loaded", "adapters_evicted",
+                "adapter_load_failures", "lora_evict_refusals",
+                "adapter_rejects"):
+        assert key in m.counters and key in snap
+    assert snap["adapter_mix_p50"] == 2
     text = m.prometheus_text()
     assert parse_exposition_names(text) == expected_names(snap)
+    assert f"# TYPE {PREFIX}_adapters_loaded counter" in text
+    assert f"{PREFIX}_adapter_mix_p50 2" in text
 
 
 def test_drift_new_counter_and_reservoir_auto_surface():

@@ -1,0 +1,487 @@
+"""Multi-LoRA adapter serving (ISSUE 15): registry, engine, radix
+isolation, faults, snapshot/failover.
+
+The acceptance contracts pinned here, CPU/f32 greedy:
+
+* a 16-request MIXED-adapter workload (3 adapters + base rows, shared
+  prefixes within each adapter -> real radix hits) emits per-adapter
+  outputs BIT-IDENTICAL to a solo engine loaded with only that
+  adapter; the int8-KV variant holds the same identity within its own
+  pair; multi-step decode (K=4) is bit-identical to K=1 under
+  adapters;
+* radix-cache isolation: identical token prefixes under different
+  adapters never share pages (namespaced keys — cross-adapter
+  admissions are cache MISSES; same-adapter admissions still hit);
+* the paged adapter store: load/unload/replace, LRU eviction of IDLE
+  adapters only, live-ref pinning (AdapterBusy), page-pressure
+  eviction, rank buckets, int8 payloads, registry invariants;
+* loading/unloading NEVER recompiles (program count pinned across
+  churn) and the static lora layout rides every program key;
+* snapshot/adopt carry the adapter: a resumed engine WITH the adapter
+  completes bit-identically; one WITHOUT refuses typed
+  (AdapterNotLoaded) — never wrong-adapter;
+* fault points: serving.lora.load_fail sheds typed; the
+  serving.lora.evict_race guard refuses busy victims (counted);
+* fleet: adapter-affinity routing lands on holding replicas; a
+  failover of an adapter'd in-flight request re-lands only on a
+  holder, else parks typed (`adapter_parks`) and completes once some
+  replica loads the adapter.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import (AdapterBusy, AdapterLoadError,
+                                AdapterNotLoaded, AdapterRegistry, Fleet,
+                                LoRAAdapter, PrefixAffinityRouter,
+                                ServingEngine)
+from paddle_tpu.serving.lora.store import llama_lora_dims
+from paddle_tpu.utils import faults
+
+CFG = LlamaConfig(vocab_size=128, hidden_size=128, intermediate_size=256,
+                  num_hidden_layers=2, num_attention_heads=2,
+                  num_key_value_heads=1, max_position_embeddings=128)
+DIMS = llama_lora_dims(CFG)
+# single-bucket program grid: identity comparisons hit identical shapes
+ENGINE_KW = dict(num_pages=64, page_size=8, token_budget=48,
+                 batch_buckets=[8], prefill_buckets=[8, 16, 32],
+                 pages_buckets=[2, 4, 8], temperature=0.0)
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    return LlamaForCausalLM(CFG)
+
+
+def _adapter(name, rank=4):
+    """Name-deterministic weights: solo and mixed registries must hold
+    the SAME adapter for the identity comparisons."""
+    return LoRAAdapter.random(name, rank, DIMS,
+                              seed=100 + sum(map(ord, name)))
+
+
+def make_registry(names=("a1", "a2", "a3"), quant=(), **reg_kw):
+    reg_kw.setdefault("rank_buckets", (8,))
+    reg_kw.setdefault("slots", 4)
+    reg = AdapterRegistry(DIMS, **reg_kw)
+    for n in names:
+        reg.load(_adapter(n), quant="int8" if n in quant else None)
+    return reg
+
+
+def _mixed_workload(n=16, seed=7):
+    """3 adapters + base rows, with a shared per-adapter prefix block
+    so same-adapter admissions produce real radix hits."""
+    rng = np.random.RandomState(seed)
+    adapters = ["a1", "a2", "a3", None]
+    heads = {a: rng.randint(0, 128, (16,)).tolist() for a in adapters}
+    work = []
+    for i in range(n):
+        a = adapters[i % len(adapters)]
+        # shared heads INTERLEAVED through the arrival order: later
+        # same-adapter admissions hit the earlier ones' donated pages
+        p = heads[a] + rng.randint(0, 128, (rng.randint(2, 8),)).tolist() \
+            if i % 2 == 0 else \
+            rng.randint(0, 128, (rng.randint(4, 20),)).tolist()
+        work.append((p, int(rng.randint(3, 10)), a))
+    return work
+
+
+def _run(eng, work):
+    rids = [eng.add_request(p, max_new_tokens=m, adapter=a)
+            for p, m, a in work]
+    out = eng.run()
+    eng.shutdown()
+    return [out[r] for r in rids]
+
+
+# ------------------------------------------------------------ acceptance
+def test_mixed_adapter_bit_identity_vs_solo(model):
+    """THE acceptance gate: each adapter's rows from the 16-request
+    mixed engine == a solo engine loaded with only that adapter; base
+    rows == a lora engine with no adapter'd traffic. Prefix hits
+    really happened, the program bound held, no adapter id leaked
+    into a program key."""
+    work = _mixed_workload()
+    eng = ServingEngine(model, lora=make_registry(), **ENGINE_KW)
+    mixed = _run(eng, work)
+    assert eng.metrics.counters["prefix_hits"] > 0
+    snap = eng.metrics.snapshot()
+    assert snap.get("adapter_mix_p90", 0) >= 2     # launches really mixed
+    for fam, n in eng.program_counts().items():
+        assert n <= eng.max_program_count(fam)
+    for key in eng.programs.keys():
+        assert not any("a1" in str(part) for part in key), key
+
+    for name in ("a1", "a2", "a3", None):
+        solo = ServingEngine(
+            model, lora=make_registry((name,) if name else ("a1",)),
+            **ENGINE_KW)
+        sub = [(p, m, a) for p, m, a in work if a == name]
+        got = _run(solo, sub)
+        want = [o for o, (_, _, a) in zip(mixed, work) if a == name]
+        assert got == want, f"adapter {name!r} diverged from solo"
+
+
+@pytest.mark.slow   # tier-1 870s budget: the core mixed identity above
+def test_identity_int8_kv_pair(model):
+    """The int8-KV variant of the identity (quantize-on-write is
+    deterministic): mixed int8-KV engine == solo int8-KV engine for
+    the compared adapter."""
+    work = _mixed_workload(8)
+    mixed = _run(ServingEngine(model, lora=make_registry(),
+                               kv_dtype="int8", **ENGINE_KW), work)
+    solo = _run(ServingEngine(model, lora=make_registry(("a2",)),
+                              kv_dtype="int8", **ENGINE_KW),
+                [w for w in work if w[2] == "a2"])
+    want = [o for o, w in zip(mixed, work) if w[2] == "a2"]
+    assert solo == want
+
+
+@pytest.mark.slow   # tier-1 870s budget: stays in the make-test set
+def test_identity_multi_decode_k4(model):
+    work = _mixed_workload(6)
+    out1 = _run(ServingEngine(model, lora=make_registry(), **ENGINE_KW),
+                work)
+    eng4 = ServingEngine(model, lora=make_registry(), decode_steps=4,
+                         **ENGINE_KW)
+    out4 = _run(eng4, work)
+    assert out4 == out1
+
+
+@pytest.mark.slow   # tier-1 870s budget: stays in the make-test set
+def test_int8_adapter_close_to_fp32(model):
+    """Per-adapter int8 payloads serve real tokens; the delta is an
+    approximation so only token-level agreement is sampled, not
+    asserted bit-exact — the contract is it RUNS through the same
+    paged/gather path and stays within the quant error budget."""
+    work = [w for w in _mixed_workload(8) if w[2] == "a1"]
+    out_fp = _run(ServingEngine(model, lora=make_registry(("a1",)),
+                                **ENGINE_KW), work)
+    out_q = _run(ServingEngine(model,
+                               lora=make_registry(("a1",), quant=("a1",)),
+                               **ENGINE_KW), work)
+    assert len(out_q) == len(out_fp)
+    assert all(len(a) == len(b) for a, b in zip(out_q, out_fp))
+
+
+# ------------------------------------------------------- radix isolation
+def test_radix_never_crosses_adapters(model):
+    """Identical token prefixes under different adapters are cache
+    MISSES; under the same adapter they still HIT. (The acceptance
+    'identical prefixes never share pages' — namespaced keys make a
+    cross-adapter share impossible at the key level.)"""
+    rng = np.random.RandomState(3)
+    prompt = rng.randint(0, 128, (24,)).tolist()     # 3 full pages
+    eng = ServingEngine(model, lora=make_registry(("a1", "a2")),
+                        **ENGINE_KW)
+    r1 = eng.add_request(prompt, max_new_tokens=3, adapter="a1")
+    eng.run()
+    assert eng.requests[r1].cached_tokens == 0
+    # same tokens, different adapter: MUST miss
+    r2 = eng.add_request(prompt, max_new_tokens=3, adapter="a2")
+    eng.run()
+    assert eng.requests[r2].cached_tokens == 0
+    # same tokens, same adapter: hits its own donated prefix
+    r3 = eng.add_request(prompt, max_new_tokens=3, adapter="a1")
+    eng.run()
+    assert eng.requests[r3].cached_tokens > 0
+    # base-model traffic never matches an adapter's pages either
+    r4 = eng.add_request(prompt, max_new_tokens=3)
+    eng.run()
+    assert eng.requests[r4].cached_tokens == 0
+    eng.reset_prefix_cache()
+    assert eng.allocator.num_used == 0
+    eng.allocator.check_invariants()
+    eng.shutdown()
+
+
+def test_reload_same_name_never_serves_stale_prefix(model):
+    """Replacing an adapter's weights under the SAME name must not let
+    the radix cache serve KV computed with the old weights: the
+    namespace carries the registry's load generation, so the post-
+    reload admission MISSES, recomputes under the new weights (token-
+    identical to a fresh engine holding only them), and re-donates
+    under the new generation (the third request hits again)."""
+    rng = np.random.RandomState(11)
+    prompt = rng.randint(0, 128, (24,)).tolist()     # 3 full pages
+    eng = ServingEngine(model, lora=make_registry(("a1",)), **ENGINE_KW)
+    r1 = eng.add_request(prompt, max_new_tokens=4, adapter="a1")
+    eng.run()
+    old_out = eng.requests[r1].output_ids
+    new_weights = LoRAAdapter.random("a1", 4, DIMS, seed=999, scale=0.2)
+    eng.load_adapter(new_weights)                    # replace in place
+    r2 = eng.add_request(prompt, max_new_tokens=4, adapter="a1")
+    eng.run()
+    assert eng.requests[r2].cached_tokens == 0       # stale gen: MISS
+    new_out = eng.requests[r2].output_ids
+    assert new_out != old_out                        # new weights bite
+    # reference: a fresh engine that only ever held the new weights
+    reg2 = AdapterRegistry(DIMS, rank_buckets=(8,), slots=4)
+    reg2.load(new_weights)
+    ref = _run(ServingEngine(model, lora=reg2, **ENGINE_KW),
+               [(prompt, 4, "a1")])[0]
+    assert new_out == ref
+    # same generation still hits its own donated prefix
+    r3 = eng.add_request(prompt, max_new_tokens=4, adapter="a1")
+    eng.run()
+    assert eng.requests[r3].cached_tokens > 0
+    assert eng.requests[r3].output_ids == new_out
+    eng.shutdown()
+
+
+# ------------------------------------------------------------- registry
+def test_registry_lifecycle_and_pinning(model):
+    eng = ServingEngine(model, lora=make_registry(("a1",)), **ENGINE_KW)
+    reg = eng.lora
+    rid = eng.add_request([1, 2, 3, 4], max_new_tokens=4, adapter="a1")
+    assert reg.refs_of("a1") == 1
+    with pytest.raises(AdapterBusy):
+        eng.unload_adapter("a1")
+    eng.run()
+    assert reg.refs_of("a1") == 0
+    assert len(eng.requests[rid].output_ids) == 4
+    eng.unload_adapter("a1")
+    assert not reg.has("a1")
+    with pytest.raises(AdapterNotLoaded):
+        eng.add_request([1, 2, 3], max_new_tokens=2, adapter="a1")
+    assert eng.metrics.counters["adapter_rejects"] == 1
+    # runtime load through the engine works mid-life, no recompile
+    n_progs = eng.num_compiled_programs
+    eng.load_adapter(LoRAAdapter.random("a9", 4, DIMS, seed=9))
+    rid2 = eng.add_request([1, 2, 3, 4], max_new_tokens=4, adapter="a9")
+    eng.run()
+    assert len(eng.requests[rid2].output_ids) == 4
+    assert eng.num_compiled_programs == n_progs
+    reg.check_invariants()
+    eng.shutdown()
+
+
+def test_lru_eviction_only_takes_idle(model):
+    """slots=2 -> one usable slot per bucket: loading a2 while a1 is
+    pinned fails typed; once a1 is idle the SAME load evicts it."""
+    reg = make_registry((), slots=2)
+    reg.load(LoRAAdapter.random("a1", 4, DIMS, seed=1))
+    eng = ServingEngine(model, lora=reg, **ENGINE_KW)
+    rid = eng.add_request([5, 6, 7], max_new_tokens=3, adapter="a1")
+    with pytest.raises(AdapterLoadError):
+        eng.load_adapter(LoRAAdapter.random("a2", 4, DIMS, seed=2))
+    eng.run()
+    assert len(eng.requests[rid].output_ids) == 3
+    eng.load_adapter(LoRAAdapter.random("a2", 4, DIMS, seed=2))
+    assert eng.metrics.counters["adapters_evicted"] == 1
+    assert not reg.has("a1") and reg.has("a2")
+    reg.check_invariants()
+    eng.shutdown()
+
+
+def test_page_pressure_eviction_and_invariants():
+    lay_probe = AdapterRegistry(DIMS, rank_buckets=(8,), slots=4)
+    per = lay_probe.layout.pages_per_adapter[8]
+    # room for exactly two resident adapters' pages (+pad page 0)
+    reg = AdapterRegistry(DIMS, rank_buckets=(8,), slots=4,
+                          num_pages=2 * per + 1)
+    for i, n in enumerate(("a1", "a2")):
+        reg.load(LoRAAdapter.random(n, 4, DIMS, seed=i))
+    assert reg.allocator.num_free == 0
+    reg.load(LoRAAdapter.random("a3", 4, DIMS, seed=3))   # evicts LRU a1
+    assert not reg.has("a1") and reg.has("a3")
+    assert reg.counters["adapters_evicted"] == 1
+    reg.check_invariants()
+    # nothing idle -> typed failure
+    reg.acquire("a2")
+    reg.acquire("a3")
+    with pytest.raises(AdapterLoadError):
+        reg.load(LoRAAdapter.random("a4", 4, DIMS, seed=4))
+    reg.release("a2")
+    reg.release("a3")
+
+
+def test_rank_buckets_and_validation():
+    reg = AdapterRegistry(DIMS, rank_buckets=(8, 16), slots=3)
+    s_lo = reg.load(LoRAAdapter.random("lo", 4, DIMS, seed=1))
+    s_hi = reg.load(LoRAAdapter.random("hi", 16, DIMS, seed=2))
+    assert s_lo < reg.layout.slots <= s_hi      # bucket-major slot ids
+    with pytest.raises(AdapterLoadError):
+        reg.load(LoRAAdapter.random("xl", 32, DIMS, seed=3))
+    with pytest.raises(AdapterLoadError):
+        reg.load(LoRAAdapter("shape", 4,
+                             {"q_proj": (np.zeros((7, 4), np.float32),
+                                         np.zeros((4, 128), np.float32))}))
+    # replace reloads in place
+    reg.load(LoRAAdapter.random("lo", 8, DIMS, seed=4))
+    assert reg.counters["adapters_loaded"] == 3
+    assert reg.counters["adapters_unloaded"] == 1
+    reg.check_invariants()
+
+
+# ------------------------------------------------------------- faults
+def test_load_fail_fault_sheds_typed(model):
+    reg = make_registry(("a1",))
+    with faults.injected("serving.lora.load_fail", payload=True):
+        with pytest.raises(AdapterLoadError):
+            reg.load(LoRAAdapter.random("a2", 4, DIMS, seed=2))
+    assert reg.counters["adapter_load_failures"] == 1
+    assert reg.has("a1") and not reg.has("a2")
+    reg.check_invariants()
+
+
+def test_evict_race_guard_refuses_busy(model):
+    reg = make_registry((), slots=2)
+    reg.load(LoRAAdapter.random("a1", 4, DIMS, seed=1))
+    reg.acquire("a1")
+    with faults.injected("serving.lora.evict_race", payload=True):
+        with pytest.raises(AdapterLoadError):
+            reg.load(LoRAAdapter.random("a2", 4, DIMS, seed=2))
+    assert reg.counters["lora_evict_refusals"] == 1
+    assert reg.has("a1")        # the busy adapter survived the race
+    reg.release("a1")
+    reg.check_invariants()
+
+
+# ------------------------------------------------------ snapshot/adopt
+def test_snapshot_resume_carries_adapter(model):
+    work = _mixed_workload(6)
+    clean = _run(ServingEngine(model, lora=make_registry(), **ENGINE_KW),
+                 work)
+    eng = ServingEngine(model, lora=make_registry(), **ENGINE_KW)
+    rids = [eng.add_request(p, max_new_tokens=m, adapter=a)
+            for p, m, a in work]
+    for _ in range(2):
+        eng.step()
+    snap = eng.snapshot()
+    assert any(r.get("adapter") for r in snap["requests"])
+    # resume WITH the adapters -> bit-identical completion
+    eng2 = ServingEngine.from_snapshot(model, snap,
+                                       lora=make_registry(), **ENGINE_KW)
+    eng2.run()
+    # restored requests fold pre-snapshot tokens into output_ids, so
+    # the full stream lives on the request objects
+    assert [eng2.requests[r].output_ids for r in rids] == clean
+    eng2.shutdown()
+    # resume WITHOUT the adapters -> typed refusal
+    with pytest.raises(AdapterNotLoaded):
+        ServingEngine.from_snapshot(model, snap, **ENGINE_KW)
+    eng.shutdown()
+
+
+def test_worker_spec_lora_plumbing():
+    """The PR-14 worker-spec path (ISSUE 15): a JSON-safe `lora` block
+    builds the registry inside the worker process; two engines built
+    from the SAME spec hold bit-identical adapters, so an adapter'd
+    snapshot record migrates losslessly between them."""
+    from paddle_tpu.serving.fleet.worker import build_engine
+    spec = {"model": {"kind": "llama", "seed": 0, "config": dict(
+                vocab_size=128, hidden_size=128, intermediate_size=256,
+                num_hidden_layers=2, num_attention_heads=2,
+                num_key_value_heads=1, max_position_embeddings=128)},
+            "engine": dict(ENGINE_KW),
+            "lora": {"rank_buckets": [8], "slots": 4,
+                     "adapters": [{"name": "w1", "rank": 4, "seed": 7}]}}
+    _, e1 = build_engine(spec)
+    _, e2 = build_engine(spec)
+    rid = e1.add_request([3, 1, 4, 1, 5], max_new_tokens=6, adapter="w1")
+    for _ in range(3):
+        e1.step()
+    snap = e1.snapshot(reason="migrate")
+    e2.adopt_requests(snap["requests"])
+    e2.run()
+    done = e2.requests[rid].output_ids
+    # reference: the uninterrupted run on a third same-spec engine
+    _, e3 = build_engine(spec)
+    r3 = e3.add_request([3, 1, 4, 1, 5], max_new_tokens=6, adapter="w1")
+    e3.run()
+    assert done == e3.requests[r3].output_ids
+    for e in (e1, e2, e3):
+        e.shutdown()
+
+
+# ------------------------------------------------------------- fleet
+def _fleet(model, regs, **kw):
+    engines = [ServingEngine(model, lora=r, **ENGINE_KW) for r in regs]
+    return Fleet(engines, router=PrefixAffinityRouter(), **kw), engines
+
+
+def test_fleet_adapter_affinity_routing(model):
+    fleet, engines = _fleet(model, [make_registry(("a1",)),
+                                    make_registry(("a2",))])
+    h1 = fleet.submit([1, 2, 3, 4], max_new_tokens=3, adapter="a1")
+    h2 = fleet.submit([1, 2, 3, 4], max_new_tokens=3, adapter="a2")
+    assert fleet._assign[h1.request_id].name == "replica-0"
+    assert fleet._assign[h2.request_id].name == "replica-1"
+    # nobody holds a3: typed shed, not a wrong-adapter landing
+    with pytest.raises(AdapterNotLoaded):
+        fleet.submit([1, 2, 3], max_new_tokens=2, adapter="a3")
+    assert fleet.counters["requests_shed"] == 1
+    fleet.run()
+    assert len(h1.tokens) == 3 and len(h2.tokens) == 3
+    fleet.shutdown()
+
+
+def test_fleet_overloaded_holder_outranks_adapter_miss(model):
+    """When the only replica HOLDING the adapter refuses for queue
+    pressure, the surfaced shed must be the retryable EngineOverloaded
+    — not AdapterNotLoaded from replicas that never held it (the
+    HTTP tier maps these to 429 vs 404)."""
+    from paddle_tpu.serving import EngineOverloaded
+    e0 = ServingEngine(model, lora=make_registry(("a1",)),
+                       max_queue_len=0, **ENGINE_KW)
+    e1 = ServingEngine(model, lora=make_registry(("a2",)), **ENGINE_KW)
+    fleet = Fleet([e0, e1], router=PrefixAffinityRouter())
+    with pytest.raises(EngineOverloaded):
+        fleet.submit([1, 2, 3, 4], max_new_tokens=2, adapter="a1")
+    # nobody holds a3 at all: the typed adapter miss still surfaces
+    with pytest.raises(AdapterNotLoaded):
+        fleet.submit([1, 2, 3, 4], max_new_tokens=2, adapter="a3")
+    fleet.shutdown()
+
+
+def test_fleet_failover_reland_or_typed_park(model):
+    """Kill the replica serving an adapter'd request mid-stream:
+    with another HOLDER alive it re-lands and completes bit-identical
+    to an undisturbed run; with no holder it parks typed (never lost,
+    never wrong-adapter) and completes once a survivor loads the
+    adapter."""
+    rng = np.random.RandomState(5)
+    prompt = rng.randint(0, 128, (12,)).tolist()
+    # clean reference (single engine)
+    ref = _run(ServingEngine(model, lora=make_registry(("a1",)),
+                             **ENGINE_KW), [(prompt, 6, "a1")])[0]
+
+    # --- holder alive: re-land + bit-identical completion
+    fleet, _ = _fleet(model, [make_registry(("a1",)),
+                              make_registry(("a1",))])
+    h = fleet.submit(prompt, max_new_tokens=6, adapter="a1")
+    target = fleet._assign[h.request_id].name
+    for _ in range(3):
+        fleet.step_all()
+    faults.inject("fleet.replica_crash", payload=target, times=-1)
+    try:
+        fleet.run()
+    finally:
+        faults.clear()
+    assert list(h.tokens) == ref
+    assert h.migrations == 1
+    fleet.shutdown()
+
+    # --- no holder: typed park, then re-land after a late load
+    fleet2, engines2 = _fleet(model, [make_registry(("a1",)),
+                                      make_registry(("a2",))])
+    h2 = fleet2.submit(prompt, max_new_tokens=6, adapter="a1")
+    for _ in range(3):
+        fleet2.step_all()
+    faults.inject("fleet.replica_crash", payload="replica-0", times=-1)
+    try:
+        for _ in range(4):
+            fleet2.step_all()
+    finally:
+        faults.clear()
+    assert fleet2.counters["adapter_parks"] >= 1
+    assert not h2.finished                   # parked, not lost
+    assert fleet2.counters["requests_lost"] == 0
+    engines2[1].load_adapter(_adapter("a1"))
+    fleet2.run()
+    assert list(h2.tokens) == ref
+    fleet2.shutdown()

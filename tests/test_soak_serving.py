@@ -25,3 +25,13 @@ def test_soak_200_requests_all_faults():
 def test_soak_other_seeds(seed):
     from tools import soak_serving
     assert soak_serving.main(["--requests", "60", "--seed", str(seed)]) == 0
+
+
+@pytest.mark.slow
+def test_soak_lora_chaos_pass():
+    """ISSUE 15: the multi-LoRA clean + chaos pair — mid-stream adapter
+    load failure sheds typed, the evict-race guard refuses pinned
+    victims, co-batched rows stay bit-identical."""
+    from tools import soak_serving
+    assert soak_serving.main(["--requests", "40", "--seed", "0",
+                              "--lora", "--no-spec", "--no-int8"]) == 0

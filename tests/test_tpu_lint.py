@@ -35,6 +35,7 @@ BAD_FIXTURES = {
     "bad_a3_vmem.py": "A3",
     "bad_a3_quant.py": "A3",
     "bad_a3_optimizer.py": "A3",
+    "bad_a3_lora.py": "A3",
     "bad_a4_runtime.py": "A4",
     "bad_a4_decode_loop.py": "A4",
     "bad_a5_purity.py": "A5",
@@ -45,6 +46,7 @@ GOOD_FIXTURES = [
     "good_a3_vmem.py",
     "good_a3_quant_hint.py",
     "good_a3_optimizer.py",
+    "good_a3_lora.py",
     "good_a4_runtime.py",
     "good_a4_decode_loop.py",
     "good_a5_purity.py",

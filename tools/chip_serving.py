@@ -438,4 +438,99 @@ if tp_degrees[1:]:
 else:
     print(f"TP_SERVING_CHIP_SKIPPED: {len(jax.devices())} device(s) — "
           "single-chip grant; TP probe needs a multi-chip window")
+
+# --- multi-LoRA serving probe (ISSUE 15) -------------------------------
+# N-adapter tok/s vs the single-adapter baseline over the same
+# 8-request workload: every decode launch mixes adapters (the masked
+# segment-bmm streams each loaded adapter's A/B once per launch), so
+# the ladder measures what serving N adapters costs over serving one —
+# the >= 0.7x acceptance bar. Timing is fetch-synced by construction
+# (step() host-fetches tokens). Per-adapter identity vs a solo engine
+# is a CHIP gate (ON_TPU — this probe's model is bf16 and CPU rounds
+# near-tie logits differently; the f32 CPU identity contract is pinned
+# by tests/test_serving_lora.py).
+from paddle_tpu.serving import AdapterRegistry, LoRAAdapter
+from paddle_tpu.serving.lora.store import llama_lora_dims
+
+LORA_DIMS = llama_lora_dims(cfg)
+LORA_PROMPTS = [rng.randint(0, cfg.vocab_size, (12,)).tolist()
+                for _ in range(8)]
+
+
+def _lora_adapter(i):
+    return LoRAAdapter.random(f"ad{i}", 8, LORA_DIMS, seed=500 + i)
+
+
+def run_lora_probe(n_adapters):
+    import paddle_tpu as _p
+    _p.seed(0)
+    lmodel = LlamaForCausalLM(cfg)
+    lmodel.bfloat16()
+    reg = AdapterRegistry(LORA_DIMS, rank_buckets=(8,),
+                          slots=max(2, n_adapters + 1))
+    for i in range(n_adapters):
+        reg.load(_lora_adapter(i))
+    eng = ServingEngine(lmodel, lora=reg, num_pages=256, page_size=16,
+                        batch_buckets=[8], prefill_buckets=[16, 128],
+                        pages_buckets=[8], temperature=0.0)
+    t0 = time.perf_counter()
+    rids = [eng.add_request(p, max_new_tokens=32,
+                            adapter=f"ad{j % n_adapters}")
+            for j, p in enumerate(LORA_PROMPTS)]
+    out = eng.run()
+    wall = time.perf_counter() - t0
+    snap = eng.metrics.snapshot()
+    assert eng.num_compiled_programs <= eng.max_program_count()
+    eng.reset_prefix_cache()
+    assert eng.allocator.num_used == 0
+    reg.check_invariants()
+    eng.shutdown()
+    toks = {j: out[r] for j, r in enumerate(rids)}
+    return toks, sum(len(t) for t in toks.values()) / wall, snap
+
+
+lora_outs, lora_base_tps, _ = run_lora_probe(1)
+print(f"lora baseline: 1 adapter {lora_base_tps:.1f} tok/s")
+lora_na_outs = {}
+for NA in (4, 8):
+    la_outs, la_tps, la_snap = run_lora_probe(NA)
+    lora_na_outs[NA] = la_outs
+    print(f"LORA_CHIP n_adapters={NA} tok_s={la_tps:.1f} "
+          f"vs_solo={100 * la_tps / lora_base_tps:.1f}% "
+          f"adapter_mix_p50={la_snap.get('adapter_mix_p50')} "
+          f"loaded={la_snap.get('adapters_loaded')}")
+    if ON_TPU:
+        # the >= 0.7x acceptance bar is a CHIP number (off-relay CPU
+        # wall times are harness evidence only)
+        assert la_tps >= 0.7 * lora_base_tps, (la_tps, lora_base_tps)
+
+# per-adapter identity: mixed engine rows == a solo engine running the
+# SAME rows with only that adapter loaded (hard gate ON_TPU only)
+import paddle_tpu as _p
+_p.seed(0)
+_solo_model = LlamaForCausalLM(cfg)
+_solo_model.bfloat16()
+_solo_reg = AdapterRegistry(LORA_DIMS, rank_buckets=(8,), slots=2)
+_solo_reg.load(_lora_adapter(0))
+_solo_eng = ServingEngine(_solo_model, lora=_solo_reg, num_pages=256,
+                          page_size=16, batch_buckets=[8],
+                          prefill_buckets=[16, 128], pages_buckets=[8],
+                          temperature=0.0)
+_mix4 = lora_na_outs[4]
+_solo_rids = [_solo_eng.add_request(p, max_new_tokens=32, adapter="ad0")
+              for j, p in enumerate(LORA_PROMPTS) if j % 4 == 0]
+_solo_out = _solo_eng.run()
+_solo_eng.shutdown()
+solo_toks = [_solo_out[r] for r in _solo_rids]
+mix_toks = [_mix4[j] for j in range(len(LORA_PROMPTS)) if j % 4 == 0]
+if ON_TPU:
+    assert solo_toks == mix_toks, "mixed engine changed adapter-0 tokens"
+    print("LORA_IDENTITY_CHIP_OK")
+elif solo_toks != mix_toks:
+    m = sum(a == b for so, mo in zip(solo_toks, mix_toks)
+            for a, b in zip(so, mo))
+    t = sum(len(v) for v in solo_toks)
+    print(f"LORA_CPU_REPORT_ONLY match={m}/{t} (hard gate runs on TPU)")
+print("LORA_CHIP_OK")
+
 print("CHIP_SERVING_ALL_OK")

@@ -31,9 +31,18 @@ deadline clock all derive from --seed; wall-clock never enters the
 engine (FakeClock + storm skew only). Bounded runtime: the engine's own
 drain guard plus a hard step ceiling.
 
+* multi-LoRA extras (ISSUE 15, `--lora`): the workload spread over 3
+  resident adapters + base rows runs a clean/chaos pair — a 4th "hot"
+  adapter's MID-STREAM load fails typed under chaos (its tail of the
+  workload sheds `AdapterNotLoaded` at the door, never serves wrong
+  weights), the `serving.lora.evict_race` guard refuses evicting a
+  pinned adapter, and every co-batched row of the OTHER adapters stays
+  bit-identical to the clean lora pass.
+
 Usage:  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
             python tools/soak_serving.py [--requests 200] [--seed 0]
-(or `make soak`; --no-spec skips the two spec passes). Exits 0 on
+(or `make soak`; --no-spec skips the two spec passes, --lora adds the
+multi-LoRA pair). Exits 0 on
 success, 1 with a report on violation — this is a test harness, not
 bench.py; it is allowed to fail loudly.
 """
@@ -283,12 +292,188 @@ def run_workload(model, work, *, chaos, seed, report, spec=False,
         eng.shutdown()
 
 
+def run_lora_pass(model, work, *, chaos, seed, report):
+    """Multi-LoRA pass (ISSUE 15): the same seeded workload spread over
+    3 resident adapters (+ base rows), with a 4th "hot" adapter loaded
+    MID-STREAM and the tail of the workload targeted at it.
+
+    Chaos layer: `serving.lora.load_fail` makes the mid-stream load
+    fail typed — every hot-adapter request then sheds typed
+    (AdapterNotLoaded) at the door, and the co-batched rows of the
+    OTHER adapters must stay bit-identical to the clean lora pass;
+    `serving.lora.evict_race` is armed across a forced slot-pressure
+    load while the resident adapters are pinned by live requests — the
+    refcount guard must refuse (counted), never evict live weights.
+    Plus the usual transient/NaN chaos so adapter'd rows exercise
+    retry and per-row quarantine. Returns ({idx: tokens}, affected)."""
+    from paddle_tpu.serving import (AdapterLoadError, AdapterNotLoaded,
+                                    AdapterRegistry, LoRAAdapter)
+    from paddle_tpu.serving.lora.store import llama_lora_dims
+    dims = llama_lora_dims(model.cfg)
+
+    def mk_adapter(name, seed_off):
+        return LoRAAdapter.random(name, 4, dims, seed=700 + seed_off)
+
+    # slots=5 -> 4 usable: ad0..ad2 + hot fill the bucket, so the
+    # evict-race load below MUST attempt an eviction
+    reg = AdapterRegistry(dims, rank_buckets=(8,), slots=5)
+    for i in range(3):
+        reg.load(mk_adapter(f"ad{i}", i))
+    adapters = [None if i % 5 == 4 else f"ad{i % 3}"
+                for i in range(len(work))]
+    hot_from = max(1, len(work) - max(4, len(work) // 8))
+    for i in range(hot_from, len(work)):
+        adapters[i] = "hot"
+
+    eng = ServingEngine(
+        model, clock=FakeClock(), default_ttl_s=TTL_S,
+        retry_policy=RetryPolicy(max_retries=12, base_s=0.0,
+                                 sleep=lambda s: None),
+        lora=reg, **ENGINE_KW)
+    armed = set()
+
+    def arm(name, **kwargs):
+        faults.inject(name, **kwargs)
+        armed.add(name)
+
+    if chaos:
+        # the lora points are armed IN the loop, immediately before
+        # the load they target — arming order, not luck, decides which
+        # load fails
+        arm("serving.engine.decode_step",
+            exc=TransientDeviceError("soak: relay loss"),
+            after=4, times=1)
+        nan_rng = np.random.RandomState(seed + 5)
+        arm("serving.engine.nan_logits",
+            payload=lambda reqs: [nan_rng.randint(len(reqs))],
+            after=6, times=1)
+
+    idx_of = {}
+    pending = list(enumerate(work))
+    out = {}
+    affected = set()
+    steps = 0
+    hot_loaded = False
+    hot_attempted = False
+    evict_race_done = False
+    max_steps = MAX_STEPS_FACTOR * max(1, len(work))
+    try:
+        while pending or eng.has_work():
+            admitted = 0
+            while pending and admitted < 4:
+                i, (p, m) = pending[0]
+                if i >= hot_from and not hot_attempted:
+                    break            # hot tail waits for the load
+                try:
+                    rid = eng.add_request(p, max_new_tokens=m,
+                                          adapter=adapters[i])
+                except EngineOverloaded:
+                    break
+                except AdapterNotLoaded:
+                    # typed shed at the door (hot load failed): the
+                    # request is affected; co-batched rows must not be
+                    affected.add(i)
+                    out[i] = []
+                    pending.pop(0)
+                    continue
+                idx_of[rid] = i
+                pending.pop(0)
+                admitted += 1
+            if pending and pending[0][0] >= hot_from and \
+                    not hot_attempted:
+                # mid-stream: the hot adapter loads only once its tail
+                # of the workload reaches the head of the queue; under
+                # chaos the load fails typed and the tail sheds typed
+                hot_attempted = True
+                if chaos:
+                    arm("serving.lora.load_fail", payload=True, times=1)
+                try:
+                    eng.load_adapter(mk_adapter("hot", 9))
+                    hot_loaded = True
+                except AdapterLoadError:
+                    hot_loaded = False
+            if chaos and not evict_race_done and \
+                    len(eng.scheduler.running) >= 2:
+                # forced slot pressure while the residents are pinned
+                # by live requests: "spare" fills the bucket's last
+                # slot, "spare2" then needs an eviction — the armed
+                # race makes the evictor ATTEMPT a pinned victim; the
+                # refcount guard must refuse it (counted) and take the
+                # idle "spare" instead
+                evict_race_done = True
+                try:
+                    eng.load_adapter(mk_adapter("spare", 11))
+                except AdapterLoadError:
+                    pass
+                arm("serving.lora.evict_race", payload=True, times=1)
+                try:
+                    eng.load_adapter(mk_adapter("spare2", 12))
+                except AdapterLoadError:
+                    pass
+            for rid, tok in eng.step():
+                out.setdefault(idx_of[rid], []).append(tok)
+            steps += 1
+            if steps > max_steps:
+                raise AssertionError(
+                    f"lora soak failed to drain after {steps} steps")
+
+        reasons = {}
+        for rid, i in idx_of.items():
+            req = eng.requests.get(rid)
+            assert req is not None, f"request {rid} evicted mid-soak"
+            reasons[req.finish_reason] = reasons.get(
+                req.finish_reason, 0) + 1
+            if req.finish_reason in ("quarantined", "expired", "abort"):
+                affected.add(i)
+            out[i] = list(req.output_ids)
+
+        # every adapter unpinned at drain; reclamation exact
+        for name in reg.adapter_names():
+            assert reg.refs_of(name) == 0, (name, reg.refs_of(name))
+        reg.check_invariants()
+        eng.reset_prefix_cache()
+        assert eng.allocator.num_used == 0, "KV pages leaked"
+        eng.allocator.check_invariants()
+
+        snap = eng.metrics.snapshot()
+        label = "lora_chaos" if chaos else "lora_clean"
+        report[label] = {
+            "steps": steps, "hot_loaded": hot_loaded,
+            "finish_reasons": reasons, "affected": len(affected),
+            "adapters_loaded": snap["adapters_loaded"],
+            "adapters_evicted": snap["adapters_evicted"],
+            "adapter_rejects": snap["adapter_rejects"],
+            "adapter_load_failures": snap["adapter_load_failures"],
+            "lora_evict_refusals": snap["lora_evict_refusals"],
+            "step_retries": snap["step_retries"],
+            "quarantined": snap["requests_quarantined"],
+            "prefix_hits": snap["prefix_hits"],
+            "adapter_mix_p50": snap.get("adapter_mix_p50"),
+        }
+        if chaos:
+            fired = faults.fired_counts()
+            report[f"fired_{label}"] = fired
+            for pt in sorted(armed):
+                assert fired.get(pt, 0) >= 1, \
+                    f"armed fault point {pt} never fired"
+        return out, affected
+    finally:
+        faults.clear()
+        faults.reset_counts()
+        eng.shutdown()
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--requests", type=int, default=200)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--no-spec", action="store_true",
                     help="skip the two speculative-decoding passes")
+    ap.add_argument("--lora", action="store_true",
+                    help="add the multi-LoRA clean + chaos passes "
+                         "(ISSUE 15: mid-stream adapter load failure "
+                         "sheds typed, evict-race guard, co-batched "
+                         "bit-identity)")
     ap.add_argument("--no-int8", action="store_true",
                     help="skip the two int8-KV passes")
     ap.add_argument("--trace-out",
@@ -464,6 +649,31 @@ def main(argv=None):
         assert ic["step_retries"] >= 1 and ic["quarantined"] >= 1, ic
         report["int8_unaffected_bit_identical"] = \
             args.requests - len(i8_aff)
+
+    if args.lora:
+        # ---- multi-LoRA passes (ISSUE 15) ----------------------------
+        lora_clean, lc_aff = run_lora_pass(model, work, chaos=False,
+                                           seed=args.seed, report=report)
+        assert not lc_aff and report["lora_clean"]["hot_loaded"], \
+            report["lora_clean"]
+        assert report["lora_clean"]["prefix_hits"] >= 1
+        lora_chaos, lora_aff = run_lora_pass(model, work, chaos=True,
+                                             seed=args.seed,
+                                             report=report)
+        lx = report["lora_chaos"]
+        # the mid-stream load failure really shed the hot tail typed...
+        assert not lx["hot_loaded"] and lx["adapter_load_failures"] >= 1
+        assert lx["adapter_rejects"] >= 1 and len(lora_aff) >= 1, lx
+        # ...the evict-race guard refused the pinned victim...
+        assert lx["lora_evict_refusals"] >= 1, lx
+        # ...and no co-batched row of any OTHER adapter moved a bit
+        lora_div = [i for i in range(len(work))
+                    if i not in lora_aff
+                    and lora_chaos.get(i) != lora_clean.get(i)]
+        assert not lora_div, ("unaffected requests diverged under lora "
+                              f"chaos: {lora_div[:10]}")
+        report["lora_unaffected_bit_identical"] = \
+            args.requests - len(lora_aff)
 
     report["wall_s"] = round(time.perf_counter() - t0, 2)
     print(json.dumps(report))
