@@ -37,12 +37,13 @@ test-fast: lint
 bench:
 	python bench.py
 
-# Randomized fault-injection soak of the serving engine (ISSUE 3 + 15):
-# the 200-request acceptance run (multi-LoRA clean+chaos passes
-# included via --lora) + extra seeds. CPU-only, minutes-bounded;
-# excluded from tier-1 via the `slow` marker (pytest.ini addopts).
+# Randomized fault-injection soak of the serving engine (ISSUE 3 + 15
+# + 17): the 200-request acceptance run (multi-LoRA clean+chaos passes
+# via --lora, tiered-KV spill off/clean/chaos via --spill) + extra
+# seeds. CPU-only, minutes-bounded; excluded from tier-1 via the
+# `slow` marker (pytest.ini addopts).
 soak:
-	$(TEST_ENV) python tools/soak_serving.py --requests 200 --seed 0 --lora
+	$(TEST_ENV) python tools/soak_serving.py --requests 200 --seed 0 --lora --spill
 	# trace-report smoke (ISSUE 10): re-read the trace the soak's
 	# traced pass exported (stdlib-only, but TEST_ENV anyway — every
 	# plain python start claims the TPU grant)

@@ -87,7 +87,10 @@ from ..utils.nan_inf import poison_scope
 from .errors import (EngineFailure, EngineOverloaded,
                      SnapshotVersionError)
 from .lora.adapter import AdapterNotLoaded
-from .kv_cache import BlockAllocator, BlocksExhausted, PAD_PAGE
+from .kv_cache import (BlockAllocator, BlocksExhausted, HostPageCorrupt,
+                       HostPageLost, HostPagesExhausted, HostPageSlow,
+                       HostPageStore, PAD_PAGE, decode_page_payload,
+                       encode_page_payload)
 from .metrics import ServingMetrics
 from .program_cache import ProgramCache
 from .radix_cache import RadixCache
@@ -202,6 +205,87 @@ def _pow2_buckets(lo: int, hi: int) -> List[int]:
     return out
 
 
+class _HostSpillBridge:
+    """RadixCache.spill implementation over ONE engine's device caches
+    and its HostPageStore (protocol: RadixCache.__init__). The tree
+    stays device-blind; all array traffic funnels through here.
+
+    demote() gathers each device page's rows across every layer into
+    one encoded payload (a real device->host fetch per array — the
+    eviction path already tolerates host latency); promote() decodes
+    every payload FIRST (a corrupt page must fail before any device
+    page is claimed), then allocates device pages and enqueues per-
+    layer `.at[pid].set(...)` scatters WITHOUT a host sync — jax
+    dispatch is async, so the copies overlap the prefill launch the
+    scheduler is about to build, and the device stream orders them
+    before any kernel that reads the pages (the "in-flight" residency
+    window is exactly this enqueued-not-fetched state).
+    """
+
+    def __init__(self, engine: "ServingEngine"):
+        self.eng = engine
+
+    def host_free(self) -> int:
+        return self.eng.host_store.num_free
+
+    def holds(self, hid: int) -> bool:
+        return self.eng.host_store.holds(hid)
+
+    def demote(self, pids):
+        """Device pages -> host payloads. Returns the host ids, or None
+        when the host pool ran out mid-batch (partial puts roll back, so
+        a refused demotion leaks nothing — the caller drops instead)."""
+        store = self.eng.host_store
+        hids = []
+        try:
+            for pid in pids:
+                hids.append(store.put(
+                    self.eng._gather_page_payload(pid)))
+        except HostPagesExhausted:
+            for hid in hids:
+                store.decref(hid)
+            return None
+        return hids
+
+    def promote(self, hids):
+        """Host payloads -> fresh device pages (refcount 1 each — the
+        tree ref). Returns None when the device pool is dry (recompute
+        beats evicting for a maybe-hit); HostPageError kinds propagate
+        AFTER the fault counter bump, with no device page claimed."""
+        eng = self.eng
+        c = eng.metrics.counters
+        payloads = []
+        try:
+            for hid in hids:
+                payloads.append(
+                    decode_page_payload(eng.host_store.get(hid)))
+        except HostPageSlow:
+            c["host_spill_slow"] += 1
+            raise
+        except HostPageCorrupt:
+            c["host_spill_corrupt"] += 1
+            raise
+        except HostPageLost:
+            c["host_spill_lost"] += 1
+            raise
+        try:
+            pids = eng.allocator._alloc_pages(len(hids))
+        except BlocksExhausted:
+            return None
+        for pid, arrays in zip(pids, payloads):
+            eng._scatter_page_payload(pid, arrays)
+        return pids
+
+    def release(self, hids):
+        """Drop the tree's host refs. Tolerates ids the store forgot
+        after a host_spill.lost fault — the lost slot is already free,
+        and a decref there would double-free a reused slot."""
+        store = self.eng.host_store
+        for hid in hids:
+            if store.holds(hid):
+                store.decref(hid)
+
+
 class ServingEngine:
     """Continuous-batching engine over a causal LM with paged-KV decode.
 
@@ -308,6 +392,7 @@ class ServingEngine:
                  kv_dtype: Optional[str] = None,
                  wq: Optional[str] = None,
                  kv_pool_bytes: Optional[int] = None,
+                 host_spill_pages: int = 0,
                  mesh=None,
                  lora=None,
                  compile_cache=None,
@@ -610,6 +695,38 @@ class ServingEngine:
             tp_degree=self.tp,
             page_bytes_shard=self.kv_page_bytes_shard,
             pool_bytes_shard=self.kv_page_bytes_shard * self.num_pages)
+
+        # --- tiered KV: host-RAM spill tier (ISSUE 17) ---
+        # host_spill_pages > 0 puts a HostPageStore under the radix
+        # cache: LRU eviction DEMOTES pages (values + int8 scale rows)
+        # to host payloads instead of freeing them, and a later match
+        # PROMOTES them back with an async host->device copy overlapped
+        # with the prefill launch. 0 (the default) is bit-for-bit the
+        # pre-spill engine. One host page carries a radix page's K+V
+        # across EVERY layer (scales included): num_layers x
+        # kv_page_bytes — the whole per-layer stack is the demote unit.
+        self.host_spill_pages = int(host_spill_pages)
+        if self.host_spill_pages < 0:
+            raise ValueError("host_spill_pages must be >= 0")
+        if self.host_spill_pages and self.tp > 1:
+            raise ValueError(
+                "host spill under tensor parallelism is not supported "
+                "yet: page gathers would fetch every shard through the "
+                "host (run spill engines at tp=1)")
+        if self.host_spill_pages and self.radix is None:
+            raise ValueError(
+                "host_spill_pages needs the radix cache: the spill tier "
+                "lives UNDER it (enable_prefix_cache=True)")
+        self.host_page_bytes = self.num_layers * self.kv_page_bytes
+        if self.host_spill_pages:
+            self.host_store: Optional[HostPageStore] = HostPageStore(
+                self.host_spill_pages)
+            self.radix.set_spill(_HostSpillBridge(self))
+            self.metrics.set_host_info(
+                pool_pages=self.host_spill_pages,
+                page_bytes=self.host_page_bytes)
+        else:
+            self.host_store = None
 
         self.requests: Dict[int, Request] = {}
         self._finished_order: List[int] = []
@@ -1713,6 +1830,68 @@ class ServingEngine:
                 self._v_scales[l] = self._v_scales[l].at[dst].set(
                     self._v_scales[l][src])
 
+    # ------------------------------------- tiered KV page I/O (ISSUE 17)
+    def _gather_page_payload(self, pid: int) -> bytes:
+        """One device page's bytes as an encoded payload: k row, v row
+        per layer, then the int8 scale rows when the cache is
+        quantized. A real device->host fetch per array (np.asarray is
+        the only honest sync over the relay). The byte round trip is
+        exact — np.asarray and .at[].set move raw rows, so a promoted
+        page is bit-identical to the page that was demoted."""
+        arrays = []
+        for l in range(self.num_layers):
+            arrays.append(np.asarray(self._k_caches[l][pid]))
+            arrays.append(np.asarray(self._v_caches[l][pid]))
+        for l in range(len(self._k_scales)):
+            arrays.append(np.asarray(self._k_scales[l][pid]))
+            arrays.append(np.asarray(self._v_scales[l][pid]))
+        return encode_page_payload(arrays)
+
+    def _scatter_page_payload(self, pid: int, arrays) -> None:
+        """Inverse of `_gather_page_payload` onto device page `pid`:
+        enqueues the per-layer `.at[pid].set(...)` writes and returns
+        WITHOUT a host sync — the copies overlap whatever launch comes
+        next, and the device stream orders them before any kernel that
+        reads the page. Raises HostPageCorrupt on an array-count
+        mismatch (a decoded payload from a different engine geometry
+        must never partially land)."""
+        expect = 2 * (self.num_layers + len(self._k_scales))
+        if len(arrays) != expect:
+            raise HostPageCorrupt(
+                f"page payload has {len(arrays)} arrays; this engine "
+                f"needs {expect}")
+        it = iter(arrays)
+        for l in range(self.num_layers):
+            self._k_caches[l] = self._k_caches[l].at[pid].set(
+                jnp.asarray(next(it)))
+            self._v_caches[l] = self._v_caches[l].at[pid].set(
+                jnp.asarray(next(it)))
+        for l in range(len(self._k_scales)):
+            self._k_scales[l] = self._k_scales[l].at[pid].set(
+                jnp.asarray(next(it)))
+            self._v_scales[l] = self._v_scales[l].at[pid].set(
+                jnp.asarray(next(it)))
+
+    def _spill_gauges(self) -> dict:
+        """update_gauges kwargs for the radix eviction rungs and the
+        host spill tier — empty fields stay None-untouched, so a
+        cache-off or spill-off engine never zeroes counters it does
+        not own. Called at BOTH gauge sites (step and vacate)."""
+        out = {}
+        if self.radix is not None:
+            out.update(
+                radix_evict_demoted=self.radix.num_evict_demoted,
+                radix_evict_dropped=self.radix.num_evict_dropped)
+        if self.host_store is not None:
+            out.update(
+                host_pages_used=self.host_store.num_used,
+                host_occupancy=self.host_store.occupancy(),
+                kv_pages_demoted=self.radix.num_demoted_pages,
+                kv_pages_promoted=self.radix.num_promoted_pages,
+                host_prefix_hits=self.radix.num_host_hits,
+                host_pages_dropped=self.radix.num_host_dropped_pages)
+        return out
+
     # ------------------------------------------------------------- step
     def _emit(self, req: Request, tok: int, emitted):
         """Record one generated token + run the finish checks."""
@@ -1867,7 +2046,8 @@ class ServingEngine:
             cached_pages=self.radix.num_cached_pages if self.radix else 0,
             radix_nodes=self.radix.num_nodes if self.radix else 0,
             radix_evicted_pages=(self.radix.num_evicted_pages
-                                 if self.radix else None))
+                                 if self.radix else None),
+            **self._spill_gauges())
         self._record_step(pre, n_chunks=len(sched.prefills),
                           n_decode=len(decodes), n_emitted=len(emitted))
         return emitted
@@ -2163,7 +2343,8 @@ class ServingEngine:
             cached_pages=self.radix.num_cached_pages if self.radix else 0,
             radix_nodes=self.radix.num_nodes if self.radix else 0,
             radix_evicted_pages=(self.radix.num_evicted_pages
-                                 if self.radix else None))
+                                 if self.radix else None),
+            **self._spill_gauges())
         return self.allocator.num_free - before
 
     @classmethod
@@ -2194,6 +2375,65 @@ class ServingEngine:
         if self.radix is None:
             return 0
         return self.radix.clear()
+
+    # -------------------------------- fleet prefix sharing (ISSUE 17)
+    def export_prefix(self, tokens) -> tuple:
+        """Fleet KV pull, DONOR side: the longest DEVICE-resident
+        cached prefix of `tokens` as (num_tokens, [payload bytes, one
+        per page]). The payloads are the same CRC-protected codec the
+        spill tier demotes with, so they chunk straight into PR-14
+        mailbox frames. promote_budget=0 pins the walk to the device
+        tier — a pull must never charge this engine's own prefill
+        budget or its device pool for a sibling's benefit. The LRU bump
+        is deliberate: a pulled prefix is hot."""
+        if self.radix is None:
+            return 0, []
+        pages, m = self.radix.match(tokens, promote_budget=0)
+        if not pages:
+            return 0, []
+        payloads = [self._gather_page_payload(pid) for pid in pages]
+        self.metrics.counters["kv_pages_exported"] += len(payloads)
+        return m, payloads
+
+    def adopt_prefix(self, tokens, payloads) -> int:
+        """Fleet KV pull, RECEIVER side: land a sibling's exported
+        prefix pages in this engine's caches and donate them to the
+        radix tree (so the next admission matches them like any local
+        prefix). Degrades to 0 — never raises — on a corrupt payload,
+        a dry device pool, or a span the tree already holds: a failed
+        pull just means the prefix recomputes, exactly the spill tier's
+        fallback contract. Returns pages newly adopted."""
+        if self.radix is None or not payloads:
+            return 0
+        n = min(len(payloads) * self.page_size,
+                (len(tokens) // self.page_size) * self.page_size)
+        payloads = payloads[:n // self.page_size]
+        if not payloads:
+            return 0
+        try:
+            arrays = [decode_page_payload(p) for p in payloads]
+        except HostPageCorrupt:
+            self.metrics.counters["host_spill_corrupt"] += 1
+            return 0
+        try:
+            pids = self.allocator._alloc_pages(len(arrays))
+        except BlocksExhausted:
+            return 0
+        try:
+            for pid, arrs in zip(pids, arrays):
+                self._scatter_page_payload(pid, arrs)
+        except HostPageCorrupt:
+            self.metrics.counters["host_spill_corrupt"] += 1
+            for pid in pids:
+                self.allocator._decref(pid)
+            return 0
+        adopted = self.radix.insert(tuple(tokens[:n]), pids)
+        # the tree took its own refs on the pages it adopted; drop the
+        # intake refs — duplicate pages (spans already cached) free here
+        for pid in pids:
+            self.allocator._decref(pid)
+        self.metrics.counters["kv_pages_adopted"] += adopted
+        return adopted
 
     # ------------------------------------------------------- convenience
     def stream(self):

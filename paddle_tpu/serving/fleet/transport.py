@@ -47,6 +47,7 @@ import the native extension lazily.
 """
 from __future__ import annotations
 
+import base64
 import json
 import struct
 import time
@@ -57,12 +58,19 @@ from ...utils import faults
 
 __all__ = ["TransportError", "Channel", "encode_frame", "decode_frame",
            "bind_store", "connect_store", "free_port",
-           "TRANSPORT_VERSION", "FAULT_DROP", "FAULT_DUPLICATE",
+           "TRANSPORT_VERSION", "FRAME_CAP", "chunk_payloads",
+           "join_payloads", "FAULT_DROP", "FAULT_DUPLICATE",
            "FAULT_STALL"]
 
 MAGIC = b"PTW1"
 TRANSPORT_VERSION = 1
 _HEADER = struct.Struct(">4sBII")          # magic, version, len, crc32
+
+# Largest JSON body one frame may carry. Store values ride a single
+# set(); a KV page payload (num_layers x kv_page_bytes, megabytes at
+# real geometry) must be split across frames BELOW this, not shipped as
+# one giant value that stalls every other mailbox key behind it.
+FRAME_CAP = 256 * 1024
 
 # sentinel: a seq was consumed without yielding a message
 _CONSUMED = object()
@@ -121,6 +129,62 @@ def decode_frame(data: bytes) -> dict:
         return json.loads(body.decode("utf-8"))
     except Exception as e:                                # noqa: BLE001
         raise TransportError(f"frame body undecodable: {e}") from e
+
+
+def chunk_payloads(payloads: List[bytes],
+                   cap: int = FRAME_CAP) -> List[dict]:
+    """Binary KV page payloads (the spill tier's CRC'd codec, ISSUE 17)
+    -> JSON-safe chunk dicts, each frame body under `cap`. A chunk is
+    {"idx": page, "part": j, "parts": n, "data": base64} — idx/part/
+    parts let `join_payloads` reassemble each page independently and
+    detect gaps, so a relayed stream may interleave pulls freely."""
+    # base64 grows 3 -> 4; leave slack for the JSON envelope around it
+    raw_cap = max(1, (int(cap) * 3) // 4 - 512)
+    chunks = []
+    for idx, blob in enumerate(payloads):
+        blob = bytes(blob)
+        parts = max(1, -(-len(blob) // raw_cap))
+        for part in range(parts):
+            piece = blob[part * raw_cap:(part + 1) * raw_cap]
+            chunks.append({
+                "idx": idx, "part": part, "parts": parts,
+                "data": base64.b64encode(piece).decode("ascii")})
+    return chunks
+
+
+def join_payloads(chunks: List[dict]) -> List[bytes]:
+    """Reassemble `chunk_payloads` output (any order). Missing pages or
+    parts, duplicate parts, or inconsistent part counts raise a
+    TRANSIENT TransportError — a re-pull heals; byte-level corruption
+    is the payload codec's CRC to catch, not ours."""
+    pages: Dict[int, Dict[int, bytes]] = {}
+    declared: Dict[int, int] = {}
+    for ch in chunks:
+        try:
+            idx, part = int(ch["idx"]), int(ch["part"])
+            parts = int(ch["parts"])
+            data = base64.b64decode(ch["data"], validate=True)
+        except Exception as e:                            # noqa: BLE001
+            raise TransportError(f"undecodable kv chunk: {e}") from e
+        if declared.setdefault(idx, parts) != parts:
+            raise TransportError(
+                f"kv page {idx}: inconsistent part counts "
+                f"{declared[idx]} != {parts}")
+        if part in pages.setdefault(idx, {}):
+            raise TransportError(f"kv page {idx}: duplicate part {part}")
+        pages[idx][part] = data
+    if set(pages) != set(range(len(pages))):
+        raise TransportError(
+            f"kv pull missing pages: have {sorted(pages)}")
+    out = []
+    for idx in range(len(pages)):
+        if set(pages[idx]) != set(range(declared[idx])):
+            raise TransportError(
+                f"kv page {idx}: missing parts "
+                f"{sorted(set(range(declared[idx])) - set(pages[idx]))}")
+        out.append(b"".join(pages[idx][p]
+                            for p in range(declared[idx])))
+    return out
 
 
 def free_port() -> int:

@@ -18,6 +18,17 @@ then worker->host):
     stats {reset_prefix_cache?} -> stats {kv_used_pages, *_ok, ...}
     drain {} / SIGTERM  -> snapshot {final=true}, bye {}; exit 0
     shutdown {}         -> bye {}; exit 0 (no snapshot: discard work)
+    kv_pull {pull_id, tokens}      -> kv_prefix {pull_id, tokens,
+                                       num_pages, num_chunks} then one
+                                       kv_page {pull_id, idx, part,
+                                       parts, data} per chunk (ISSUE
+                                       17: cached-prefix payloads
+                                       chunked under FRAME_CAP)
+    kv_prefix/kv_page (incoming)   -> kv_adopted {pull_id,
+                                       adopted_pages[, error]} once the
+                                       stream completes (same types the
+                                       donor emits — the supervisor
+                                       relays frames verbatim)
 
     ready {pid, geometry}        once, after the engine is built
     events {ev: [[rid,idx,tok]]} after every engine step that emitted
@@ -61,7 +72,8 @@ from collections import deque
 from typing import Dict, List, Optional
 
 from ...utils import faults
-from .transport import Channel, connect_store
+from .transport import (Channel, chunk_payloads, connect_store,
+                        join_payloads)
 
 __all__ = ["run_worker", "WorkerLoop", "build_model", "build_engine",
            "build_lora_registry", "FAULT_KILL9"]
@@ -138,6 +150,10 @@ class WorkerLoop:
         # otherwise strand its handle live forever on the supervisor —
         # re-delivery is idempotent there (finalize checks finished)
         self.recent_finished: deque = deque(maxlen=64)
+        # in-flight cross-worker prefix pulls, RECEIVER side (ISSUE 17):
+        # pull_id -> {tokens, num_chunks, chunks} until the stream
+        # completes and the pages adopt
+        self._kv_intake: Dict = {}
         self.steps = 0
         self.heartbeats = 0
         self.draining = False
@@ -203,10 +219,64 @@ class WorkerLoop:
             out["queue_depth"] = int(eng.scheduler.queue_depth)
             out["num_compiled_programs"] = eng.num_compiled_programs
             self.chan.send("stats", **out)
+        elif mtype == "kv_pull":
+            # cross-worker prefix pull, DONOR side (ISSUE 17): the
+            # longest device-resident cached prefix of `tokens` as the
+            # spill codec's CRC'd page payloads, chunked under the
+            # frame cap. The response (kv_prefix header + kv_page
+            # stream) uses the SAME message types the receiver side
+            # adopts from, so a supervisor routes pulls by relaying
+            # frames verbatim between its worker channels.
+            tokens = [int(t) for t in payload.get("tokens", [])]
+            pull_id = payload.get("pull_id", 0)
+            n, payloads = self.engine.export_prefix(tokens)
+            chunks = chunk_payloads(payloads)
+            self.chan.send("kv_prefix", pull_id=pull_id,
+                           tokens=tokens[:n], num_pages=len(payloads),
+                           num_chunks=len(chunks))
+            for ch in chunks:
+                self.chan.send("kv_page", pull_id=pull_id, **ch)
+        elif mtype == "kv_prefix":
+            # RECEIVER side: open the intake buffer (an empty pull —
+            # the donor held nothing — completes immediately)
+            pull_id = payload.get("pull_id", 0)
+            self._kv_intake[pull_id] = {
+                "tokens": [int(t) for t in payload.get("tokens", [])],
+                "num_chunks": int(payload.get("num_chunks", 0)),
+                "chunks": []}
+            self._maybe_adopt_pull(pull_id)
+        elif mtype == "kv_page":
+            buf = self._kv_intake.get(payload.get("pull_id", 0))
+            if buf is not None:
+                buf["chunks"].append(
+                    {k: payload[k]
+                     for k in ("idx", "part", "parts", "data")})
+                self._maybe_adopt_pull(payload.get("pull_id", 0))
         elif mtype == "drain":
             self.draining = True
         elif mtype == "shutdown":
             self.shutdown = True
+
+    def _maybe_adopt_pull(self, pull_id):
+        """Adopt a completed kv pull stream into the local engine. A
+        bad pull (reassembly gap, corrupt payload, dry pool) reports
+        adopted_pages=0 — the prefix just recomputes locally, the
+        spill tier's usual fallback; it must never kill the worker."""
+        buf = self._kv_intake.get(pull_id)
+        if buf is None or len(buf["chunks"]) < buf["num_chunks"]:
+            return
+        del self._kv_intake[pull_id]
+        err = None
+        adopted = 0
+        try:
+            payloads = join_payloads(buf["chunks"])
+            adopted = self.engine.adopt_prefix(buf["tokens"], payloads)
+        except Exception as e:                            # noqa: BLE001
+            err = f"{type(e).__name__}: {e}"[:200]
+        out = {"pull_id": pull_id, "adopted_pages": int(adopted)}
+        if err:
+            out["error"] = err
+        self.chan.send("kv_adopted", **out)
 
     # ---- emission shipping -----------------------------------------------
     def _ship(self, emitted):

@@ -14,6 +14,8 @@ seq_lens). The allocator therefore never hands out page 0.
 """
 from __future__ import annotations
 
+import struct
+import zlib
 from collections import deque
 from typing import Dict, List, Tuple
 
@@ -21,7 +23,10 @@ import numpy as np
 
 from ..utils import faults
 
-__all__ = ["BlockAllocator", "KVSequence", "BlocksExhausted", "PAD_PAGE"]
+__all__ = ["BlockAllocator", "KVSequence", "BlocksExhausted", "PAD_PAGE",
+           "HostPageStore", "HostPagesExhausted", "HostPageError",
+           "HostPageCorrupt", "HostPageSlow", "HostPageLost",
+           "encode_page_payload", "decode_page_payload"]
 
 PAD_PAGE = 0
 
@@ -30,6 +35,14 @@ PAD_PAGE = 0
 # through its reclamation ladder (radix LRU eviction, then
 # preempt-by-eviction), never crash or leak.
 FAULT_ALLOC = faults.register_point("serving.kv.alloc_page")
+
+# Fault-injection points (ISSUE 17): the host spill tier's read path.
+# Each degrades a promotion into recompute-from-radix-prefix — the
+# engine's outputs must stay bit-identical in all three cases, only the
+# cached-token accounting changes.
+FAULT_HOST_CORRUPT = faults.register_point("host_spill.corrupt")
+FAULT_HOST_SLOW = faults.register_point("host_spill.slow")
+FAULT_HOST_LOST = faults.register_point("host_spill.lost")
 
 
 class BlocksExhausted(Exception):
@@ -267,3 +280,201 @@ class BlockAllocator:
         assert all(r > 0 for r in self._refs.values())
         assert PAD_PAGE not in free and PAD_PAGE not in held
         assert len(free) + len(held) == self.num_pages - 1
+
+
+# ---------------------------------------------------------------------------
+# Host spill tier (ISSUE 17): pinned host-RAM pages under the radix cache.
+# ---------------------------------------------------------------------------
+
+class HostPagesExhausted(Exception):
+    """No free host page — the radix cache falls back to dropping."""
+
+
+class HostPageError(Exception):
+    """A host page read failed; promotion degrades to recompute."""
+
+
+class HostPageCorrupt(HostPageError):
+    """Payload failed its CRC — the stored bytes are untrustworthy."""
+
+
+class HostPageSlow(HostPageError):
+    """The host read missed its deadline; the page itself is intact."""
+
+
+class HostPageLost(HostPageError):
+    """The backing host buffer is gone (e.g. reclaimed by the OS)."""
+
+
+# Page-payload wire format. One payload carries ONE radix page's KV bytes
+# across every layer (k row, v row, plus the int8 scale rows when the
+# cache is quantized). The same bytes are the demote/promote unit AND the
+# PR-14 mailbox frame body for cross-worker prefix pulls, so corruption
+# detection must be real: the header carries a CRC32 of the body and
+# decode refuses anything that does not check out.
+PAYLOAD_MAGIC = b"KVPG"
+PAYLOAD_VERSION = 1
+_PAYLOAD_HEADER = struct.Struct(">4sBHI")   # magic, version, n_arrays, crc
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # bfloat16 etc. live in ml_dtypes (a jax dependency), not numpy
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def encode_page_payload(arrays) -> bytes:
+    """Serialize a list of ndarrays (one page's per-layer rows) into a
+    self-describing CRC-protected byte string."""
+    parts: List[bytes] = []
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        dt = str(a.dtype).encode("ascii")
+        parts.append(struct.pack(">B", len(dt)))
+        parts.append(dt)
+        parts.append(struct.pack(">B", a.ndim))
+        parts.append(struct.pack(f">{a.ndim}I", *a.shape))
+        raw = a.tobytes()
+        parts.append(struct.pack(">I", len(raw)))
+        parts.append(raw)
+    body = b"".join(parts)
+    head = _PAYLOAD_HEADER.pack(PAYLOAD_MAGIC, PAYLOAD_VERSION,
+                                len(arrays), zlib.crc32(body) & 0xFFFFFFFF)
+    return head + body
+
+
+def decode_page_payload(buf: bytes) -> List[np.ndarray]:
+    """Inverse of encode_page_payload. Raises HostPageCorrupt on any
+    structural or CRC mismatch — a corrupt page must never reach the
+    device arrays."""
+    if len(buf) < _PAYLOAD_HEADER.size:
+        raise HostPageCorrupt("payload truncated before header")
+    magic, version, n_arrays, crc = _PAYLOAD_HEADER.unpack_from(buf)
+    if magic != PAYLOAD_MAGIC or version != PAYLOAD_VERSION:
+        raise HostPageCorrupt(f"bad payload header {magic!r} v{version}")
+    body = buf[_PAYLOAD_HEADER.size:]
+    if zlib.crc32(body) & 0xFFFFFFFF != crc:
+        raise HostPageCorrupt("payload CRC mismatch")
+    arrays: List[np.ndarray] = []
+    off = 0
+    try:
+        for _ in range(n_arrays):
+            (dlen,) = struct.unpack_from(">B", body, off); off += 1
+            dtype = _np_dtype(body[off:off + dlen].decode("ascii"))
+            off += dlen
+            (ndim,) = struct.unpack_from(">B", body, off); off += 1
+            shape = struct.unpack_from(f">{ndim}I", body, off)
+            off += 4 * ndim
+            (nbytes,) = struct.unpack_from(">I", body, off); off += 4
+            raw = body[off:off + nbytes]
+            off += nbytes
+            if len(raw) != nbytes:
+                raise HostPageCorrupt("payload truncated inside array")
+            arrays.append(np.frombuffer(raw, dtype).reshape(shape).copy())
+    except (struct.error, ValueError) as e:
+        raise HostPageCorrupt(f"payload structure invalid: {e}") from None
+    if off != len(body):
+        raise HostPageCorrupt(f"{len(body) - off} trailing payload bytes")
+    return arrays
+
+
+class HostPageStore:
+    """Ref-counted host-RAM page pool: the spill tier's analogue of
+    BlockAllocator, holding encoded page payloads instead of device
+    rows. Ids are dense ints over `num_pages` slots with the same
+    free-list/refcount discipline (no pad page — host ids never reach
+    a device block table).
+
+    The read path (`get`) is where the host_spill fault points live:
+    `lost` fires before the lookup (the buffer is gone — the store
+    forgets it too, so recovery matches reality), `slow` models a
+    deadline miss on an intact page, and `corrupt` flips a body byte so
+    decode_page_payload's CRC check — not the injection site — is what
+    detects it.
+    """
+
+    def __init__(self, num_pages: int):
+        if num_pages <= 0:
+            raise ValueError("host spill pool needs >= 1 page")
+        self.num_pages = num_pages
+        self._free = deque(range(num_pages))
+        self._refs: Dict[int, int] = {}
+        self._payloads: Dict[int, bytes] = {}
+        self.bytes_stored = 0
+
+    # ---- page ops --------------------------------------------------------
+    def put(self, payload: bytes) -> int:
+        if not self._free:
+            raise HostPagesExhausted(
+                f"all {self.num_pages} host pages in use")
+        hid = self._free.popleft()
+        self._refs[hid] = 1
+        self._payloads[hid] = bytes(payload)
+        self.bytes_stored += len(payload)
+        return hid
+
+    def get(self, hid: int) -> bytes:
+        if faults.fire(FAULT_HOST_LOST) is not None:
+            self._forget(hid)
+            raise HostPageLost(f"host page {hid} backing buffer gone")
+        if self._refs.get(hid, 0) <= 0:
+            raise KeyError(f"host page {hid} not held")
+        if faults.fire(FAULT_HOST_SLOW) is not None:
+            raise HostPageSlow(f"host page {hid} read missed deadline")
+        payload = self._payloads[hid]
+        if faults.fire(FAULT_HOST_CORRUPT) is not None:
+            payload = payload[:-1] + bytes([payload[-1] ^ 0xFF])
+        return payload
+
+    def _forget(self, hid: int):
+        """Lost-page recovery: drop the slot entirely regardless of
+        refcount (the holder's decref path is bypassed — the caller
+        drops its radix node instead)."""
+        if hid in self._refs:
+            del self._refs[hid]
+            self.bytes_stored -= len(self._payloads.pop(hid))
+            self._free.append(hid)
+
+    def incref(self, hid: int):
+        self._refs[hid] += 1
+
+    def decref(self, hid: int):
+        r = self._refs.get(hid)
+        if r is None or r <= 0:
+            raise RuntimeError(f"double free of host page {hid}")
+        if r == 1:
+            del self._refs[hid]
+            self.bytes_stored -= len(self._payloads.pop(hid))
+            self._free.append(hid)
+        else:
+            self._refs[hid] = r - 1
+
+    def holds(self, hid: int) -> bool:
+        """True iff the store still holds `hid` (a lost-fault recovery
+        may have forgotten it out from under its holders)."""
+        return self._refs.get(hid, 0) > 0
+
+    # ---- occupancy -------------------------------------------------------
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_used(self) -> int:
+        return self.num_pages - len(self._free)
+
+    def occupancy(self) -> float:
+        return self.num_used / float(self.num_pages)
+
+    def check_invariants(self):
+        free = set(self._free)
+        held = set(self._refs)
+        assert not (free & held), f"host pages free AND held: {free & held}"
+        assert all(r > 0 for r in self._refs.values())
+        assert held == set(self._payloads), "payloads out of sync with refs"
+        assert len(free) + len(held) == self.num_pages
+        assert self.bytes_stored == \
+            sum(len(p) for p in self._payloads.values())

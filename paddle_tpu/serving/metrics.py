@@ -96,6 +96,24 @@ class ServingMetrics:
             "adapter_load_failures": 0,
             "lora_evict_refusals": 0,
             "adapter_rejects": 0,
+            # --- tiered KV: host-RAM spill (ISSUE 17) ---
+            # demotion/promotion traffic (radix-synced at the gauge
+            # sites, like radix_evicted_pages), the eviction rung taken
+            # (demote-to-host vs drop — the spill tier's auditability
+            # counters), host-tier hits/drops, fleet prefix pulls, and
+            # the three host_spill fault outcomes (bridge-incremented
+            # where the degradation happens)
+            "kv_pages_demoted": 0,         # device pages spilled to host
+            "kv_pages_promoted": 0,        # host pages copied back
+            "host_prefix_hits": 0,         # matches that promoted a span
+            "host_pages_dropped": 0,       # host-tier LRU/cascade drops
+            "radix_evict_demoted": 0,      # eviction rung: demoted
+            "radix_evict_dropped": 0,      # eviction rung: dropped
+            "kv_pages_exported": 0,        # fleet pull, donor side
+            "kv_pages_adopted": 0,         # fleet pull, receiver side
+            "host_spill_corrupt": 0,       # CRC reject -> recompute
+            "host_spill_slow": 0,          # deadline miss -> retry later
+            "host_spill_lost": 0,          # buffer gone -> recompute
             # --- persistent compile cache (ISSUE 14) ---
             # mirrors of the engine's CompileCache counters (zero with
             # the cache off): hits skipped a trace+compile entirely;
@@ -158,6 +176,15 @@ class ServingMetrics:
         self.kv_tp_degree = 0
         self.kv_page_bytes_shard = 0
         self.kv_pool_bytes_shard = 0
+        # host spill tier (ISSUE 17): pool geometry set once at engine
+        # construction (set_host_info), occupancy updated per step.
+        # host_pool_pages == 0 means no spill tier — the snapshot block
+        # is gated on it, so spill-off engines expose nothing new.
+        self.host_pool_pages = 0
+        self.host_page_bytes = 0
+        self.host_pool_bytes = 0
+        self.host_pages_used = 0
+        self.host_occupancy = 0.0
 
     # ---- reservoir registry ---------------------------------------------
     def add_reservoir(self, name: str, scale: float = 1.0,
@@ -272,6 +299,18 @@ class ServingMetrics:
         self.kv_pool_bytes_shard = int(
             pool_bytes if pool_bytes_shard is None else pool_bytes_shard)
 
+    def set_host_info(self, *, pool_pages, page_bytes):
+        """Static host-spill-pool geometry (ISSUE 17): slot count and
+        the bytes ONE host page carries — a radix page's K+V across
+        every layer, scale rows included (num_layers x kv_page_bytes),
+        because the demote unit is the whole per-layer stack for one
+        device page. pool_pages > 0 is also the snapshot gate for the
+        host block, the same role kv_pool_bytes plays for the KV
+        geometry block."""
+        self.host_pool_pages = int(pool_pages)
+        self.host_page_bytes = int(page_bytes)
+        self.host_pool_bytes = int(pool_pages) * int(page_bytes)
+
     def on_kv_bytes(self, written: int = 0, read: int = 0):
         self.counters["kv_bytes_written"] += int(written)
         self.counters["kv_bytes_read"] += int(read)
@@ -334,7 +373,15 @@ class ServingMetrics:
 
     def update_gauges(self, *, queue_depth, running, kv_used_pages,
                       kv_occupancy, cached_pages=0, radix_nodes=0,
-                      radix_evicted_pages=None):
+                      radix_evicted_pages=None,
+                      host_pages_used=None, host_occupancy=None,
+                      radix_evict_demoted=None, radix_evict_dropped=None,
+                      kv_pages_demoted=None, kv_pages_promoted=None,
+                      host_prefix_hits=None, host_pages_dropped=None):
+        """None for an optional field means "leave it untouched" — the
+        engine passes its radix/spill sync kwargs only when the
+        corresponding subsystem exists, so a cache-off or spill-off
+        engine can never zero a counter it does not own."""
         self.queue_depth = queue_depth
         self.running = running
         self.kv_used_pages = kv_used_pages
@@ -343,6 +390,20 @@ class ServingMetrics:
         self.radix_nodes = radix_nodes
         if radix_evicted_pages is not None:
             self.counters["radix_evicted_pages"] = radix_evicted_pages
+        if host_pages_used is not None:
+            self.host_pages_used = host_pages_used
+        if host_occupancy is not None:
+            self.host_occupancy = host_occupancy
+        # radix-owned counters synced by assignment (idempotent), the
+        # radix_evicted_pages pattern
+        for key, val in (("radix_evict_demoted", radix_evict_demoted),
+                         ("radix_evict_dropped", radix_evict_dropped),
+                         ("kv_pages_demoted", kv_pages_demoted),
+                         ("kv_pages_promoted", kv_pages_promoted),
+                         ("host_prefix_hits", host_prefix_hits),
+                         ("host_pages_dropped", host_pages_dropped)):
+            if val is not None:
+                self.counters[key] = val
 
     # ---- derived ---------------------------------------------------------
     def tokens_per_second(self) -> float:
@@ -410,6 +471,17 @@ class ServingMetrics:
                 "kv_tp_degree": self.kv_tp_degree,
                 "kv_page_bytes_shard": self.kv_page_bytes_shard,
                 "kv_pool_bytes_shard": self.kv_pool_bytes_shard,
+            })
+        # host spill tier (ISSUE 17): gated on a configured pool —
+        # merged summaries keep the block when ANY replica spills
+        # (pool pages sum; page bytes may sentinel to 0 when mixed)
+        if self.host_pool_pages:
+            snap.update({
+                "host_pool_pages": self.host_pool_pages,
+                "host_page_bytes": self.host_page_bytes,
+                "host_pool_bytes": self.host_pool_bytes,
+                "host_pages_used": self.host_pages_used,
+                "host_occupancy": round(self.host_occupancy, 4),
             })
         hr = self.prefix_hit_rate()
         if hr is not None:
@@ -518,6 +590,20 @@ class ServingMetrics:
         out.kv_tp_degree = tps.pop() if len(tps) == 1 else 0
         out.kv_page_bytes_shard = pbss.pop() if len(pbss) == 1 else 0
         out.kv_pool_bytes_shard = plss.pop() if len(plss) == 1 else 0
+        # host spill tier (ISSUE 17): pooled slots/bytes/usage sum EXACT
+        # across the replicas that spill (spill-off replicas contribute
+        # zeros); occupancy is the pooled used/total ratio; per-page
+        # bytes follow the singleton-or-sentinel rule — a heterogeneous
+        # fleet (mixed layer counts or kv dtypes) zeroes the gauge
+        # instead of letting the last-merged replica win
+        out.host_pool_pages = sum(m.host_pool_pages for m in metrics)
+        out.host_pool_bytes = sum(m.host_pool_bytes for m in metrics)
+        out.host_pages_used = sum(m.host_pages_used for m in metrics)
+        if out.host_pool_pages:
+            out.host_occupancy = (out.host_pages_used
+                                  / out.host_pool_pages)
+        hpbs = {m.host_page_bytes for m in metrics if m.host_pool_pages}
+        out.host_page_bytes = hpbs.pop() if len(hpbs) == 1 else 0
         # reservoirs: per-name balanced newest-first draw — walk every
         # source from its freshest sample backwards, round-robin, until
         # the window fills; reversed so the merged deque stays

@@ -392,8 +392,19 @@ class Scheduler:
             n = len(ids)
             mpages, m = [], 0
             if self.prefix_cache is not None:
+                # host-tier promotion (ISSUE 17) is scheduled against
+                # the same chunked-prefill budget a recompute of those
+                # tokens would draw — one token is held back so the
+                # admitted request can always take a non-empty first
+                # chunk in this step
+                promoted_before = getattr(self.prefix_cache,
+                                          "num_promoted_pages", 0)
                 mpages, m = self.prefix_cache.match(
-                    adapter_prefix_key(ids, req.adapter_key))
+                    adapter_prefix_key(ids, req.adapter_key),
+                    promote_budget=budget - 1)
+                budget -= (getattr(self.prefix_cache,
+                                   "num_promoted_pages", promoted_before)
+                           - promoted_before) * self.allocator.page_size
                 if m >= n:
                     # full hit: the LAST token must still run through
                     # the model to produce the next-token logits

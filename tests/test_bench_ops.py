@@ -320,6 +320,53 @@ def test_optimizer_update_rows_and_decisions(bench_ops):
         proj["projected_608M_ms_xla_fp32_moments"]
 
 
+def test_kv_spill_rows_and_promote_decision(bench_ops):
+    """The ISSUE-17 promotion bench: one bytes-true host->device row
+    per page in {64, 128} x {bf16, int8} (int8 rides its fp32 scale
+    rows, so its payload is smaller but not half) plus the
+    promote_vs_recompute projection row. Timing mocked at a fixed
+    0.1 ms (coarse enough that the 1-decimal GB/s rounding keeps the
+    payload-size ordering visible) — but each promote closure executes
+    ONCE inside the mock so the codec round trip and the .at[].set
+    commit really run (the bench_paged_decode_tp convention): the
+    fetched element must be nonzero (the page landed) and the decode
+    must not raise."""
+    def fake_stats(fn, *args, iters=10, timer=None):
+        assert timer is bench_ops._host_time     # transfer-path timer
+        val = fn()                               # real execution
+        assert float(val) != 0.0
+        return (1e-4, 0.01)
+
+    bench_ops._time_stats = fake_stats
+    bench_ops.bench_kv_spill("cpu", quick=True)
+    rows = [r for r in bench_ops.RESULTS if r["bench"] == "kv_spill"]
+    variants = {r["variant"] for r in rows}
+    for page in (64, 128):
+        for dtype in ("bf16", "int8"):
+            assert f"promote_{dtype}_page{page}" in variants, variants
+    by = {r["variant"]: r for r in rows if "ms" in r}
+    # bytes-true: CPU geometry L=2, KVH=2, D=64; bf16 payload =
+    # 2L * page*KVH*D * 2B, int8 adds (page, KVH) fp32 scales per array
+    bf = by["promote_bf16_page128"]
+    i8 = by["promote_int8_page128"]
+    assert bf["gbps"] == pytest.approx(
+        4 * 128 * 2 * 64 * 2 / 1e-4 / 1e9, abs=0.06)
+    assert i8["gbps"] < bf["gbps"]               # int8 moves fewer bytes
+    assert by["promote_bf16_page64"]["gbps"] < bf["gbps"]  # same mock dt
+    # decision row: 7B page bytes / measured rate vs 40%-MFU recompute
+    # of 128 tokens on the cpu 1 TFLOP peak — 4.48 s / 12.8 ms = 350.0
+    dec = next(r for r in rows if r["variant"] == "promote_vs_recompute")
+    assert dec["value"] == pytest.approx(350.0, abs=0.01)
+
+
+def test_kv_spill_nan_sentinel_skips_decision(bench_ops):
+    bench_ops._time_stats = \
+        lambda fn, *a, iters=10, timer=None: (float("nan"), float("nan"))
+    bench_ops.bench_kv_spill("cpu", quick=True)
+    rows = [r for r in bench_ops.RESULTS if r["bench"] == "kv_spill"]
+    assert rows and not any("value" in r for r in rows)
+
+
 def test_optimizer_update_nan_sentinel_skips_decisions(bench_ops):
     """A NaN draw must not fabricate speedup/projection rows."""
     bench_ops._time_stats = \

@@ -1,0 +1,196 @@
+"""Cross-worker KV prefix pulls (ISSUE 17): the spill tier's payload
+codec as a PR-14 mailbox frame type.
+
+Layers under test: `chunk_payloads`/`join_payloads` (page payloads
+base64-chunked so every frame stays under FRAME_CAP, reassembly
+validates gaps/duplicates), and the worker protocol — `kv_pull` on the
+donor answers with a `kv_prefix` header + `kv_page` stream the
+RECEIVER worker adopts from verbatim (the supervisor relays frames
+without looking inside), replying `kv_adopted`. A corrupt chunk must
+degrade to adopted_pages=0 via the codec's CRC — never kill a worker.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import ServingEngine
+from paddle_tpu.serving.fleet.transport import (Channel, FRAME_CAP,
+                                                TransportError,
+                                                bind_store,
+                                                chunk_payloads,
+                                                encode_frame, free_port,
+                                                join_payloads)
+from paddle_tpu.serving.fleet.worker import WorkerLoop
+
+
+# ------------------------------------------------------------- chunking
+def test_chunk_join_roundtrip_and_cap():
+    rng = np.random.RandomState(3)
+    payloads = [bytes(rng.randint(0, 256, (n,)).astype(np.uint8))
+                for n in (0, 1, 700, 5000, 12345)]
+    cap = 2048
+    chunks = chunk_payloads(payloads, cap=cap)
+    # multi-part pages exist and EVERY framed chunk stays under cap
+    assert max(c["parts"] for c in chunks) > 1
+    for c in chunks:
+        frame = encode_frame({"type": "kv_page", "src": "w0",
+                              "dst": "host", "seq": 1,
+                              "payload": dict(c, pull_id=1)})
+        assert len(frame) <= cap
+    # reassembly is order-independent and byte-exact
+    shuffled = [chunks[i] for i in rng.permutation(len(chunks))]
+    assert join_payloads(shuffled) == payloads
+    # default cap: one real-sized page payload stays a single part
+    assert all(c["parts"] == 1
+               for c in chunk_payloads([b"x" * 65536]))
+    assert chunk_payloads([]) == []
+    assert join_payloads([]) == []
+
+
+def test_join_rejects_gaps_duplicates_inconsistency():
+    payloads = [b"a" * 5000, b"b" * 5000]
+    chunks = chunk_payloads(payloads, cap=2048)
+    with pytest.raises(TransportError):
+        join_payloads(chunks[:-1])              # missing part
+    with pytest.raises(TransportError):
+        join_payloads(chunks + [chunks[0]])     # duplicate part
+    bad = [dict(c) for c in chunks]
+    bad[0]["parts"] = 99                        # inconsistent count
+    with pytest.raises(TransportError):
+        join_payloads(bad)
+    only_page_1 = [c for c in chunks if c["idx"] == 1]
+    with pytest.raises(TransportError):
+        join_payloads(only_page_1)              # page 0 missing
+    with pytest.raises(TransportError):
+        join_payloads([dict(chunks[0], data="!!not base64!!")])
+    # every rejection is the TRANSIENT class (re-pull heals)
+    try:
+        join_payloads(chunks[:-1])
+    except TransportError as e:
+        assert e.failure_class == "transient"
+
+
+# ------------------------------------------------- worker pull protocol
+@pytest.fixture(scope="module")
+def model():
+    cfg = LlamaConfig(vocab_size=128, hidden_size=128,
+                      intermediate_size=256, num_hidden_layers=2,
+                      num_attention_heads=2, num_key_value_heads=1,
+                      max_position_embeddings=128)
+    paddle.seed(0)
+    return LlamaForCausalLM(cfg)
+
+
+KW = dict(num_pages=16, page_size=8, token_budget=64,
+          batch_buckets=[4], prefill_buckets=[64], pages_buckets=[8],
+          temperature=0.0)
+
+
+@pytest.fixture(scope="module")
+def store():
+    return bind_store(f"127.0.0.1:{free_port()}")
+
+
+def _worker(model, store, name, session, **extra):
+    eng = ServingEngine(model, **dict(KW, **extra))
+    chan = Channel(store, me=name, peer="host", session=session)
+    host_side = Channel(store, me="host", peer=name, session=session)
+    return eng, WorkerLoop(eng, chan), host_side
+
+
+def test_worker_kv_pull_adopts_on_sibling(model, store):
+    rng = np.random.RandomState(11)
+    shared = rng.randint(0, 128, (24,)).tolist()
+    prompt = shared + rng.randint(0, 128, (4,)).tolist()
+
+    eng0, loop0, host0 = _worker(model, store, "w0", "kvpull")
+    eng1, loop1, host1 = _worker(model, store, "w1", "kvpull")
+    try:
+        # populate the donor's radix with the shared prefix
+        rid0 = eng0.add_request(prompt, max_new_tokens=6)
+        baseline = eng0.run()[rid0]
+
+        loop0.handle({"type": "kv_pull",
+                      "payload": {"pull_id": 7, "tokens": shared}})
+        frames = host0.recv_all()
+        assert frames[0]["type"] == "kv_prefix"
+        hdr = frames[0]["payload"]
+        assert hdr["pull_id"] == 7
+        assert hdr["num_pages"] == len(shared) // KW["page_size"]
+        assert [f["type"] for f in frames[1:]] == \
+            ["kv_page"] * hdr["num_chunks"]
+        assert eng0.metrics.counters["kv_pages_exported"] == \
+            hdr["num_pages"]
+
+        # the supervisor relays the stream VERBATIM to the receiver
+        for fr in frames:
+            loop1.handle(fr)
+        reply = host1.recv_all()
+        assert [r["type"] for r in reply] == ["kv_adopted"]
+        assert reply[0]["payload"] == {"pull_id": 7,
+                                       "adopted_pages": hdr["num_pages"]}
+        assert eng1.metrics.counters["kv_pages_adopted"] == \
+            hdr["num_pages"]
+        assert not loop1._kv_intake               # buffer drained
+
+        # the adopted pages SERVE: same prompt on the sibling hits the
+        # prefix and generates the identical greedy stream — wrong
+        # bytes in any payload would diverge the tokens here
+        rid1 = eng1.add_request(prompt, max_new_tokens=6)
+        out1 = eng1.run()[rid1]
+        assert out1 == baseline
+        snap = eng1.metrics.snapshot()
+        assert snap["prefix_hits"] == 1
+        assert snap["cached_tokens_served"] >= \
+            hdr["num_pages"] * KW["page_size"]
+    finally:
+        eng0.shutdown()
+        eng1.shutdown()
+
+
+def test_worker_kv_pull_empty_and_corrupt_degrade(model, store):
+    rng = np.random.RandomState(12)
+    tokens = rng.randint(0, 128, (24,)).tolist()
+    eng0, loop0, host0 = _worker(model, store, "w2", "kvpull2")
+    eng1, loop1, host1 = _worker(model, store, "w3", "kvpull2")
+    try:
+        # donor caches nothing -> empty pull completes immediately
+        loop0.handle({"type": "kv_pull",
+                      "payload": {"pull_id": 1, "tokens": tokens}})
+        frames = host0.recv_all()
+        assert [f["type"] for f in frames] == ["kv_prefix"]
+        assert frames[0]["payload"]["num_chunks"] == 0
+        loop1.handle(frames[0])
+        reply = host1.recv_all()
+        assert reply[0]["type"] == "kv_adopted"
+        assert reply[0]["payload"]["adopted_pages"] == 0
+
+        # now a real pull whose LAST chunk is corrupted in flight: the
+        # codec CRC rejects it, the receiver reports 0 and lives on
+        rid = eng0.add_request(tokens + [1, 2], max_new_tokens=4)
+        eng0.run()
+        loop0.handle({"type": "kv_pull",
+                      "payload": {"pull_id": 2, "tokens": tokens}})
+        frames = host0.recv_all()
+        assert frames[0]["payload"]["num_pages"] >= 1
+        import base64
+        tampered = frames[-1]
+        raw = bytearray(base64.b64decode(
+            tampered["payload"]["data"]))
+        raw[-1] ^= 0xFF
+        tampered["payload"]["data"] = \
+            base64.b64encode(bytes(raw)).decode("ascii")
+        for fr in frames:
+            loop1.handle(fr)
+        reply = host1.recv_all()
+        assert reply[0]["type"] == "kv_adopted"
+        assert reply[0]["payload"]["adopted_pages"] == 0
+        assert eng1.metrics.counters["host_spill_corrupt"] == 1
+        assert eng1.metrics.counters["kv_pages_adopted"] == 0
+        # nothing leaked on the failed adoption
+        assert eng1.allocator.num_used == 0
+        eng1.allocator.check_invariants()
+    finally:
+        eng0.shutdown()
+        eng1.shutdown()

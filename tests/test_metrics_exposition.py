@@ -56,9 +56,16 @@ def populated_metrics(tp_degree=1) -> ServingMetrics:
                   bytes_per_token=128, tp_degree=tp_degree,
                   page_bytes_shard=1024 // tp_degree,
                   pool_bytes_shard=65536 // tp_degree)
+    # tiered-KV host spill (ISSUE 17): geometry + the full sync-kwarg
+    # set, so the drift bijection covers every new host/rung name
+    m.set_host_info(pool_pages=8, page_bytes=2048)
     m.update_gauges(queue_depth=2, running=1, kv_used_pages=5,
                     kv_occupancy=0.25, cached_pages=3, radix_nodes=2,
-                    radix_evicted_pages=1)
+                    radix_evicted_pages=1,
+                    host_pages_used=3, host_occupancy=0.375,
+                    radix_evict_demoted=4, radix_evict_dropped=1,
+                    kv_pages_demoted=6, kv_pages_promoted=5,
+                    host_prefix_hits=2, host_pages_dropped=1)
     return m
 
 
@@ -82,6 +89,28 @@ def test_snapshot_exposition_bijection():
     assert parse_exposition_names(text) == expected_names(snap)
     assert f"# TYPE {PREFIX}_adapters_loaded counter" in text
     assert f"{PREFIX}_adapter_mix_p50 2" in text
+    # tiered-KV (ISSUE 17) names ride the same registries: the host
+    # pool block is snapshot-gated on set_host_info, the rung/traffic
+    # counters live in the counters dict (typed counter in the scrape)
+    for key in ("host_pool_pages", "host_page_bytes", "host_pool_bytes",
+                "host_pages_used", "host_occupancy"):
+        assert key in snap
+    for key in ("kv_pages_demoted", "kv_pages_promoted",
+                "host_prefix_hits", "host_pages_dropped",
+                "radix_evict_demoted", "radix_evict_dropped",
+                "kv_pages_exported", "kv_pages_adopted",
+                "host_spill_corrupt", "host_spill_slow",
+                "host_spill_lost"):
+        assert key in m.counters and key in snap
+    assert f"# TYPE {PREFIX}_kv_pages_demoted counter" in text
+    assert f"{PREFIX}_host_pool_pages 8" in text
+    # spill-off engines expose NO host block (the pool_pages gate)
+    off = ServingMetrics(name="off")
+    off_snap = off.snapshot()
+    assert not any(k.startswith("host_") for k in off_snap
+                   if k not in off.counters)
+    assert parse_exposition_names(off.prometheus_text()) \
+        == expected_names(off_snap)
 
 
 def test_drift_new_counter_and_reservoir_auto_surface():
@@ -120,6 +149,36 @@ def test_mixed_tp_merge_sentinels_round_trip():
     assert names == expected_names(snap)
     assert f'{PREFIX}_kv_dtype_info{{kv_dtype="mixed"}} 1' in text
     assert f"{PREFIX}_kv_tp_degree 0" in text
+
+
+def test_mixed_host_merge_pools_and_sentinels():
+    """ISSUE 17 merge rules for a heterogeneous fleet: pooled host
+    slots/bytes/usage sum exactly (spill-off replicas contribute
+    zeros), occupancy re-derives from the pooled ratio, and the
+    per-page gauge follows the PR-8 singleton-or-sentinel rule —
+    all of it must survive the scrape."""
+    a = populated_metrics()                # 8 pages x 2048 B, 3 used
+    b = populated_metrics()
+    b.set_host_info(pool_pages=4, page_bytes=4096)   # different geometry
+    b.update_gauges(queue_depth=0, running=0, kv_used_pages=0,
+                    kv_occupancy=0.0, host_pages_used=1,
+                    host_occupancy=0.25)
+    off = ServingMetrics(name="off")       # spill-off replica
+    m = ServingMetrics.merge(a, b, off)
+    snap = m.snapshot()
+    assert snap["host_pool_pages"] == 12
+    assert snap["host_pool_bytes"] == 8 * 2048 + 4 * 4096
+    assert snap["host_pages_used"] == 4
+    assert snap["host_occupancy"] == round(4 / 12, 4)
+    assert snap["host_page_bytes"] == 0    # mixed geometry -> sentinel
+    text = m.prometheus_text()
+    assert parse_exposition_names(text) == expected_names(snap)
+    assert f"{PREFIX}_host_page_bytes 0" in text
+    assert f"{PREFIX}_host_pool_pages 12" in text
+    # homogeneous-geometry merge keeps the singleton (off replicas are
+    # excluded from the set, so they cannot force the sentinel)
+    h = ServingMetrics.merge(a, populated_metrics(), off)
+    assert h.snapshot()["host_page_bytes"] == 2048
 
 
 # ------------------------------------------------------------- format

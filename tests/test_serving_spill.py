@@ -1,0 +1,422 @@
+"""Tiered KV: host-RAM radix-cache spill acceptance (ISSUE 17).
+
+Three layers of coverage:
+  * unit — the CRC-protected page-payload codec and the HostPageStore's
+    refcount/free-list discipline, including the three `host_spill`
+    fault points (corrupt is detected by the CODEC's CRC, not by the
+    injection site);
+  * radix — demote-before-drop eviction rungs, budgeted promotion and
+    the per-fault degradation policy (slow keeps the node, corrupt/lost
+    drop the subtree), over a real HostPageStore and a device-free fake
+    bridge;
+  * engine — the acceptance criteria: a 16-request shared-prefix
+    workload through a DEVICE POOL TOO SMALL TO HOLD THE WORKING SET is
+    bit-identical with the spill tier on vs off (plain, int8-KV and
+    multi-step-decode variants), the cached-token rate at fixed device
+    pool bytes rises ABOVE the HBM-only ceiling, every host_spill fault
+    degrades to recompute with identical outputs, and BOTH pools
+    reclaim fully at drain.
+
+Determinism note (SERVING.md): spill on/off cannot change program
+shapes — promotion only changes where matched pages' bytes come from,
+and the byte round trip through the codec is exact — so the pinned
+single-bucket grids below make the comparison bit-exact.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import ServingEngine
+from paddle_tpu.serving.kv_cache import (
+    BlockAllocator, BlocksExhausted, HostPageCorrupt, HostPageLost,
+    HostPagesExhausted, HostPageSlow, HostPageStore, decode_page_payload,
+    encode_page_payload)
+from paddle_tpu.serving.radix_cache import RadixCache
+from paddle_tpu.utils import faults
+
+
+# --------------------------------------------------------------- codec
+
+def test_payload_codec_round_trip_bit_exact():
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    arrays = [
+        np.asarray(jnp.asarray(rng.randn(1, 8, 16), np.float32)
+                   .astype(jnp.bfloat16)),          # bf16 via ml_dtypes
+        rng.randint(-128, 128, (1, 8, 16)).astype(np.int8),
+        rng.randn(1, 8).astype(np.float32),
+    ]
+    out = decode_page_payload(encode_page_payload(arrays))
+    assert len(out) == len(arrays)
+    for a, b in zip(arrays, out):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        assert a.tobytes() == b.tobytes()           # bit-exact
+
+
+def test_payload_codec_rejects_corruption():
+    buf = encode_page_payload([np.arange(32, dtype=np.float32)])
+    # any single flipped body byte must fail the CRC
+    bad = buf[:-1] + bytes([buf[-1] ^ 0xFF])
+    with pytest.raises(HostPageCorrupt):
+        decode_page_payload(bad)
+    with pytest.raises(HostPageCorrupt):
+        decode_page_payload(buf[:10])               # truncated header
+    with pytest.raises(HostPageCorrupt):
+        decode_page_payload(b"NOPE" + buf[4:])      # bad magic
+
+
+# ---------------------------------------------------------- host store
+
+def test_host_page_store_discipline():
+    st = HostPageStore(3)
+    a = st.put(b"aaaa")
+    b = st.put(b"bb")
+    assert st.num_used == 2 and st.num_free == 1
+    assert st.bytes_stored == 6
+    assert st.get(a) == b"aaaa" and st.holds(b)
+    st.incref(a)
+    st.decref(a)
+    assert st.holds(a)                              # still one ref
+    st.decref(a)
+    assert not st.holds(a) and st.num_free == 2
+    with pytest.raises(RuntimeError):
+        st.decref(a)                                # double free
+    with pytest.raises(KeyError):
+        st.get(a)                                   # freed slot
+    st.put(b"c")
+    st.put(b"d")
+    with pytest.raises(HostPagesExhausted):
+        st.put(b"e")
+    st.check_invariants()
+
+
+def test_host_store_fault_points():
+    st = HostPageStore(2)
+    hid = st.put(b"payload")
+    with faults.injected("host_spill.slow", payload=True):
+        with pytest.raises(HostPageSlow):
+            st.get(hid)
+    assert st.get(hid) == b"payload"                # intact after slow
+    with faults.injected("host_spill.corrupt", payload=True):
+        corrupted = st.get(hid)
+    # the CODEC detects corruption, not the injection site
+    assert corrupted != b"payload"
+    assert st.get(hid) == b"payload"                # store bytes intact
+    with faults.injected("host_spill.lost", payload=True):
+        with pytest.raises(HostPageLost):
+            st.get(hid)
+    # lost => the store forgot the slot entirely (refcount bypassed)
+    assert not st.holds(hid) and st.num_free == 2
+    st.check_invariants()
+
+
+# ------------------------------------------------------- radix + bridge
+
+class _FakeBridge:
+    """Device-free RadixCache.spill: payloads are just marker bytes, so
+    the radix-side residency/rung/budget logic tests run without an
+    engine. Mirrors _HostSpillBridge's contract exactly (including the
+    release-tolerates-forgotten-ids rule)."""
+
+    def __init__(self, allocator, host_pages):
+        self.alloc = allocator
+        self.store = HostPageStore(host_pages)
+
+    def host_free(self):
+        return self.store.num_free
+
+    def holds(self, hid):
+        return self.store.holds(hid)
+
+    def demote(self, pids):
+        hids = []
+        try:
+            for pid in pids:
+                hids.append(self.store.put(b"page:%d" % pid))
+        except HostPagesExhausted:
+            for hid in hids:
+                self.store.decref(hid)
+            return None
+        return hids
+
+    def promote(self, hids):
+        for hid in hids:
+            decode_err = self.store.get(hid)     # fault points fire here
+            del decode_err
+        try:
+            return self.alloc._alloc_pages(len(hids))
+        except BlocksExhausted:
+            return None
+
+    def release(self, hids):
+        for hid in hids:
+            if self.store.holds(hid):
+                self.store.decref(hid)
+
+
+def _cached_tree(alloc, cache, tokens):
+    """Donate `tokens` (page-aligned) through a throwaway sequence."""
+    seq = alloc.alloc_sequence_with_prefix(len(tokens), [])
+    cache.insert(tokens, list(seq.pages))
+    alloc.free_sequence(seq)
+
+
+def test_radix_demote_rung_then_promote():
+    alloc = BlockAllocator(num_pages=9, page_size=8)
+    cache = RadixCache(alloc)
+    bridge = _FakeBridge(alloc, host_pages=8)
+    cache.set_spill(bridge)
+    _cached_tree(alloc, cache, tuple(range(32)))         # 4 pages
+    assert cache.num_cached_pages == 4
+
+    # demote rung: pages leave the device but the prefix survives
+    freed = cache.evict(4)
+    assert freed == 4
+    assert cache.num_evict_demoted == 1 and cache.num_evict_dropped == 0
+    assert cache.num_cached_pages == 0 and cache.num_host_pages == 4
+    assert bridge.store.num_used == 4 and alloc.num_used == 0
+    cache.check_invariants()
+
+    # budget too small: the match stops at the last device token
+    pages, m = cache.match(tuple(range(32)), promote_budget=16)
+    assert (pages, m) == ([], 0)
+    assert cache.num_host_pages == 4                     # still spilled
+
+    # budget covers the node: promotion restores device residency
+    pages, m = cache.match(tuple(range(32)), promote_budget=32)
+    assert m == 32 and len(pages) == 4
+    assert cache.num_host_hits == 1
+    assert cache.num_promoted_pages == 4
+    assert cache.num_host_pages == 0 and bridge.store.num_used == 0
+    assert alloc.num_used == 4                           # the tree refs
+    cache.check_invariants()
+
+
+def test_radix_drop_rung_when_host_pool_full():
+    alloc = BlockAllocator(num_pages=9, page_size=8)
+    cache = RadixCache(alloc)
+    cache.set_spill(_FakeBridge(alloc, host_pages=1))    # too small
+    _cached_tree(alloc, cache, tuple(range(16)))         # 2-page node
+    freed = cache.evict(2)
+    assert freed == 2
+    assert cache.num_evict_demoted == 0 and cache.num_evict_dropped == 1
+    assert cache.num_host_pages == 0 and cache.num_nodes == 0
+    cache.check_invariants()
+
+
+def test_radix_promotion_fault_policy():
+    def spilled():
+        alloc = BlockAllocator(num_pages=9, page_size=8)
+        cache = RadixCache(alloc)
+        cache.set_spill(_FakeBridge(alloc, host_pages=8))
+        _cached_tree(alloc, cache, tuple(range(32)))
+        cache.evict(4)
+        return alloc, cache
+
+    # slow: the node is kept — a later match retries and succeeds
+    alloc, cache = spilled()
+    with faults.injected("host_spill.slow", payload=True):
+        pages, m = cache.match(tuple(range(32)))
+    assert m == 0 and cache.num_host_pages == 4
+    pages, m = cache.match(tuple(range(32)))
+    assert m == 32
+    cache.check_invariants()
+
+    # lost: node + subtree drop, store already forgot the id — the
+    # release path must tolerate that without a double free
+    alloc, cache = spilled()
+    with faults.injected("host_spill.lost", payload=True):
+        pages, m = cache.match(tuple(range(32)))
+    assert m == 0 and cache.num_nodes == 0
+    assert cache.num_host_pages == 0
+    cache.check_invariants()
+
+    faults.clear()
+
+
+def test_radix_insert_readopts_host_span():
+    """A donor walking over a host-resident span repairs residency for
+    free: the tree adopts the donor's device pages and releases the
+    host copies — no host->device copy."""
+    alloc = BlockAllocator(num_pages=17, page_size=8)
+    cache = RadixCache(alloc)
+    bridge = _FakeBridge(alloc, host_pages=8)
+    cache.set_spill(bridge)
+    toks = tuple(range(32))
+    _cached_tree(alloc, cache, toks)
+    cache.evict(4)
+    assert cache.num_host_pages == 4
+    # donor recomputed the same prefix (the engine's recompute path)
+    seq = alloc.alloc_sequence_with_prefix(32, [])
+    adopted = cache.insert(toks, list(seq.pages))
+    assert adopted == 4
+    assert cache.num_host_pages == 0 and bridge.store.num_used == 0
+    assert cache.num_cached_pages == 4
+    alloc.free_sequence(seq)
+    cache.check_invariants()
+    assert alloc.num_used == 4                           # tree refs only
+
+
+# ------------------------------------------------------------- engines
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = LlamaConfig(vocab_size=128, hidden_size=128,
+                      intermediate_size=256, num_hidden_layers=2,
+                      num_attention_heads=2, num_key_value_heads=1,
+                      max_position_embeddings=128)
+    paddle.seed(0)
+    return LlamaForCausalLM(cfg)
+
+
+# a device pool too small for the working set (16 usable pages vs a
+# shared 3-page prefix + 8 distinct 3-page tails + decode growth), one
+# bucket per axis so spill on/off compare bit-exactly
+SPILL_KW = dict(num_pages=16, page_size=8, token_budget=64,
+                batch_buckets=[4], prefill_buckets=[64],
+                pages_buckets=[8], temperature=0.0, max_batch_size=4)
+
+VARIANTS = {
+    "plain": {},
+    "int8_kv": {"kv_dtype": "int8"},
+    "multi_decode": {"decode_steps": 4},
+}
+
+
+def _workload():
+    rng = np.random.RandomState(7)
+    shared = rng.randint(0, 128, (24,)).tolist()         # 3 full pages
+    tails = [rng.randint(0, 128, (24,)).tolist() for _ in range(8)]
+    return shared, tails
+
+
+def _run_spill_workload(model, host_pages, **extra):
+    """16 requests (8 shared-prefix prompts, two passes — pass 2 is
+    where demoted tails promote back), submitted one at a time so the
+    tiny pool forces eviction between them. Returns (outputs keyed by
+    (pass, tail index), metrics snapshot)."""
+    eng = ServingEngine(model, host_spill_pages=host_pages,
+                        **{**SPILL_KW, **extra})
+    shared, tails = _workload()
+    out = {}
+    for p in range(2):
+        for i, t in enumerate(tails):
+            rid = eng.add_request(shared + t, max_new_tokens=4)
+            res = eng.run()
+            out[(p, i)] = res[rid]
+    snap = eng.metrics.snapshot()
+    eng.radix.check_invariants()
+    eng.allocator.check_invariants()
+    if eng.host_store is not None:
+        eng.host_store.check_invariants()
+    # full reclamation on BOTH pools at drain
+    assert eng.allocator.num_used == eng.radix.num_cached_pages
+    eng.reset_prefix_cache()
+    assert eng.allocator.num_used == 0
+    if eng.host_store is not None:
+        assert eng.host_store.num_used == 0
+    eng.shutdown()
+    return out, snap
+
+
+@pytest.mark.parametrize("variant", sorted(VARIANTS))
+def test_spill_bit_identity(model, variant):
+    """ISSUE 17 acceptance: bit-identical greedy outputs with spill
+    forced on via a tiny device pool, across the plain, int8-KV and
+    multi-step-decode engine variants."""
+    extra = VARIANTS[variant]
+    out_off, snap_off = _run_spill_workload(model, 0, **extra)
+    out_on, snap_on = _run_spill_workload(model, 64, **extra)
+    assert out_off == out_on
+    # the comparison is only meaningful if the spill tier actually ran
+    assert snap_on["kv_pages_demoted"] > 0
+    assert snap_on["kv_pages_promoted"] > 0
+    assert snap_on["host_prefix_hits"] > 0
+    # eviction rung counters (satellite 5): spill-off evictions all
+    # DROP; spill-on evictions all demote (the host pool is big enough)
+    assert snap_off["radix_evict_dropped"] > 0
+    assert snap_off["radix_evict_demoted"] == 0
+    assert snap_on["radix_evict_demoted"] > 0
+    assert snap_on["radix_evict_dropped"] == 0
+
+
+def test_spill_raises_cached_token_rate_above_hbm_ceiling(model):
+    """ISSUE 17 acceptance: at FIXED device-pool bytes, the host tier
+    serves more cached tokens (and skips more prefill work) than the
+    HBM-only engine can — capacity becomes throughput."""
+    out_off, snap_off = _run_spill_workload(model, 0)
+    out_on, snap_on = _run_spill_workload(model, 64)
+    assert out_off == out_on
+    assert snap_on["cached_tokens_served"] > snap_off["cached_tokens_served"]
+    assert snap_on["prefill_tokens"] < snap_off["prefill_tokens"]
+    # the win is exactly the skipped recompute: both engines emitted
+    # the same tokens, so served + prefilled is conserved
+    assert (snap_on["cached_tokens_served"] + snap_on["prefill_tokens"]
+            == snap_off["cached_tokens_served"]
+            + snap_off["prefill_tokens"])
+
+
+@pytest.mark.parametrize("point", ["host_spill.corrupt",
+                                   "host_spill.slow",
+                                   "host_spill.lost"])
+def test_spill_faults_degrade_to_recompute(model, point):
+    """Every host_spill fault degrades a promotion to recompute with
+    bit-identical outputs, counts itself, and leaks nothing."""
+    out_base, _ = _run_spill_workload(model, 0)
+    with faults.injected(point, payload=True):
+        out_faulted, snap = _run_spill_workload(model, 64)
+    assert out_faulted == out_base
+    key = point.replace("host_spill.", "host_spill_")
+    assert snap[key] == 1
+    faults.clear()
+
+
+def test_spill_off_engine_rejects_bad_config(model):
+    with pytest.raises(ValueError):
+        ServingEngine(model, host_spill_pages=-1, **SPILL_KW)
+    with pytest.raises(ValueError):
+        ServingEngine(model, host_spill_pages=8,
+                      enable_prefix_cache=False, **SPILL_KW)
+
+
+# ------------------------------------------------- fleet prefix pull
+
+def test_export_adopt_prefix_between_engines(model):
+    """The demote/promote payload codec doubles as the cross-worker
+    prefix-pull unit: a sibling engine adopts an exported prefix and
+    serves it as a local cache hit, with identical greedy output."""
+    shared, tails = _workload()
+    prompt = shared + tails[0]
+    kw = dict(SPILL_KW, num_pages=32)
+
+    donor = ServingEngine(model, **kw)
+    rid = donor.add_request(prompt, max_new_tokens=4)
+    base = donor.run()[rid]
+    n, payloads = donor.export_prefix(prompt)
+    assert n == (len(prompt) // 8) * 8 and len(payloads) == n // 8
+    assert donor.metrics.counters["kv_pages_exported"] == len(payloads)
+
+    sibling = ServingEngine(model, **kw)
+    adopted = sibling.adopt_prefix(prompt[:n], payloads)
+    assert adopted == len(payloads)
+    assert sibling.metrics.counters["kv_pages_adopted"] == adopted
+    sibling.radix.check_invariants()
+    # tree holds exactly the adopted pages; intake refs were dropped
+    assert sibling.allocator.num_used == adopted
+
+    rid2 = sibling.add_request(prompt, max_new_tokens=4)
+    out = sibling.run()[rid2]
+    assert out == base                         # pulled prefix, same tokens
+    assert sibling.metrics.counters["cached_tokens_served"] > 0
+    assert sibling.metrics.counters["prefix_hits"] == 1
+
+    # a corrupt payload degrades to "no pull", never a crash
+    bad = payloads[0][:-1] + bytes([payloads[0][-1] ^ 0xFF])
+    third = ServingEngine(model, **kw)
+    assert third.adopt_prefix(prompt[:n], [bad] + payloads[1:]) == 0
+    assert third.metrics.counters["host_spill_corrupt"] == 1
+    assert third.allocator.num_used == 0
+    for eng in (donor, sibling, third):
+        eng.reset_prefix_cache()
+        eng.shutdown()

@@ -28,6 +28,17 @@ def test_soak_other_seeds(seed):
 
 
 @pytest.mark.slow
+def test_soak_spill_passes():
+    """ISSUE 17: the tiered-KV triple — spill off/clean/chaos on the
+    spill-pressure workload; host faults degrade to recompute
+    bit-identically, both pools reclaim, the clean spill pass beats
+    the HBM-only cached-token ceiling."""
+    from tools import soak_serving
+    assert soak_serving.main(["--requests", "40", "--seed", "0",
+                              "--spill", "--no-spec", "--no-int8"]) == 0
+
+
+@pytest.mark.slow
 def test_soak_lora_chaos_pass():
     """ISSUE 15: the multi-LoRA clean + chaos pair — mid-stream adapter
     load failure sheds typed, the evict-race guard refuses pinned

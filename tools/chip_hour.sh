@@ -152,8 +152,12 @@ step comm 900 tools/chip_comm.py
 # 2c. numeric parity on chip (kernels execute AND match XLA references)
 step parity 900 tools/chip_parity.py
 
-# 2d. serving path: compiled decode loop vs eager + int8 parity
-step serving 1200 tools/chip_serving.py
+# 2d. serving path: compiled decode loop vs eager + int8 parity +
+#     spec/multi-step/TP/LoRA probes + the tiered-KV spill probe
+#     (ISSUE 17: forced-spill cached-token rate vs HBM-only, identity
+#     hard-gated, first real-relay run of the promotion host->device
+#     copy)
+step serving 1500 tools/chip_serving.py
 
 # 2e. BASELINE config ladder: ResNet/ERNIE/DiT/Qwen2-MoE train steps
 step ladder 1800 tools/chip_ladder.py

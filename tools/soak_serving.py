@@ -31,6 +31,16 @@ deadline clock all derive from --seed; wall-clock never enters the
 engine (FakeClock + storm skew only). Bounded runtime: the engine's own
 drain guard plus a hard step ceiling.
 
+* tiered-KV extras (ISSUE 17, `--spill`): a spill-pressure workload
+  (six shared prefixes thrashing a shrunken device pool) runs three
+  ways — host tier off, on, and on with every `host_spill.*` read
+  fault armed. The tier must be token-invisible both times (spill
+  on == off for EVERY request; faults degrade to recompute with NO
+  affected requests), both pools must reclaim to zero at drain, every
+  armed fault point must fire, and the clean spill pass must serve
+  MORE cached tokens than the HBM-only ceiling at the same device
+  pool (the perf_opt acceptance).
+
 * multi-LoRA extras (ISSUE 15, `--lora`): the workload spread over 3
   resident adapters + base rows runs a clean/chaos pair — a 4th "hot"
   adapter's MID-STREAM load fails typed under chaos (its tail of the
@@ -42,7 +52,7 @@ drain guard plus a hard step ceiling.
 Usage:  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
             python tools/soak_serving.py [--requests 200] [--seed 0]
 (or `make soak`; --no-spec skips the two spec passes, --lora adds the
-multi-LoRA pair). Exits 0 on
+multi-LoRA pair, --spill the tiered-KV triple). Exits 0 on
 success, 1 with a report on violation — this is a test harness, not
 bench.py; it is allowed to fail loudly.
 """
@@ -116,20 +126,46 @@ def make_workload(n, seed):
     return work
 
 
+def make_spill_workload(n, seed):
+    """Spill-pressure variant (ISSUE 17): six shared 24-token (3-page)
+    prefixes revisited round-robin with short random tails. The spill
+    passes' shrunken device pool cannot hold all six prefixes plus the
+    running tails at once, so the revisits force demote -> host ->
+    promote cycles on every lap — exactly the traffic the host tier
+    exists for, and a steady stream of store reads for the armed
+    host_spill.* specs to hit."""
+    rng = np.random.RandomState(seed + 17)
+    prefixes = [rng.randint(0, 128, (24,)).tolist() for _ in range(6)]
+    work = []
+    for i in range(n):
+        p = prefixes[i % len(prefixes)] + \
+            rng.randint(0, 128, (rng.randint(2, 8),)).tolist()
+        work.append((p, int(rng.randint(3, 8))))
+    return work
+
+
 def run_workload(model, work, *, chaos, seed, report, spec=False,
-                 kv_dtype=None, trace=None, label=None, keep=None):
+                 kv_dtype=None, trace=None, label=None, keep=None,
+                 extra_kw=None, spill_chaos=False):
     """One full soak pass; returns ({idx: tokens}, affected_idx_set).
     `trace` (a RequestTracer) turns per-request tracing on for the
     pass (ISSUE 10 — the overhead measurement and the exported trace
     the `make soak` trace-report smoke reads); `keep` (a dict) receives
     the engine's flight-recorder timeline + Prometheus exposition
     before shutdown so the final report prints through the
-    observability paths instead of an ad-hoc dict dump."""
+    observability paths instead of an ad-hoc dict dump. `extra_kw`
+    overrides engine kwargs (the spill passes shrink the device pool
+    and attach the host tier); `spill_chaos` arms the three
+    `host_spill.*` read-path faults INSTEAD of the engine chaos set —
+    they must degrade to recompute with NO affected requests, so they
+    get their own switch rather than riding `chaos`."""
     rng = np.random.RandomState(seed + 1)
     abort_at = {i for i in range(len(work))
                 if rng.random() < ABORT_FRACTION} if chaos else set()
 
     kw = dict(ENGINE_KW, kv_dtype=kv_dtype)
+    if extra_kw:
+        kw.update(extra_kw)
     if spec:
         kw.update(SPEC_KW, proposer=NgramProposer())
     eng = ServingEngine(
@@ -200,6 +236,23 @@ def run_workload(model, work, *, chaos, seed, report, spec=False,
         arm("serving.radix.insert",
             exc=RuntimeError("soak: donation failed"),
             prob=0.05, times=7, seed=seed + 8)
+    if spill_chaos:
+        # ISSUE 17 chaos: every host-tier read-path fault. corrupt =
+        # CRC reject at decode (node dropped, recompute); slow =
+        # deadline miss (node kept on host, recompute now, retry
+        # later); lost = backing buffer gone (slot forgotten under its
+        # holders, node dropped, recompute). One deterministic early
+        # spec per point + a seeded coin for spread, same convention
+        # as the engine chaos set.
+        arm("host_spill.corrupt", payload=True, after=1, times=1)
+        arm("host_spill.corrupt", payload=True,
+            prob=0.04, times=6, seed=seed + 11)
+        arm("host_spill.slow", payload=True, after=3, times=1)
+        arm("host_spill.slow", payload=True,
+            prob=0.04, times=6, seed=seed + 12)
+        arm("host_spill.lost", payload=True, after=5, times=1)
+        arm("host_spill.lost", payload=True,
+            prob=0.03, times=4, seed=seed + 13)
 
     idx_of = {}
     pending = list(enumerate(work))
@@ -249,6 +302,11 @@ def run_workload(model, work, *, chaos, seed, report, spec=False,
         eng.reset_prefix_cache()
         assert eng.allocator.num_used == 0, "KV pages leaked"
         eng.allocator.check_invariants()
+        if getattr(eng, "host_store", None) is not None:
+            # BOTH pools must come back empty (ISSUE 17 reclamation):
+            # radix.clear() released every host tree ref too
+            assert eng.host_store.num_used == 0, "host pages leaked"
+            eng.host_store.check_invariants()
 
         snap = eng.metrics.snapshot()
         if label is None:
@@ -275,8 +333,21 @@ def run_workload(model, work, *, chaos, seed, report, spec=False,
                 "spec_oom_drops": snap["spec_draft_oom_drops"],
                 "spec_tokens_per_step": snap.get("spec_tokens_per_step"),
             })
+        if getattr(eng, "host_store", None) is not None:
+            rep.update({
+                "cached_tokens": snap["cached_tokens_served"],
+                "kv_pages_demoted": snap["kv_pages_demoted"],
+                "kv_pages_promoted": snap["kv_pages_promoted"],
+                "host_prefix_hits": snap["host_prefix_hits"],
+                "host_pages_dropped": snap["host_pages_dropped"],
+                "spill_faults": [snap["host_spill_corrupt"],
+                                 snap["host_spill_slow"],
+                                 snap["host_spill_lost"]],
+            })
+        elif extra_kw is not None:
+            rep["cached_tokens"] = snap["cached_tokens_served"]
         report[label] = rep
-        if chaos:
+        if chaos or spill_chaos:
             fired = faults.fired_counts()
             report[f"fired_{label}"] = fired
             for pt in sorted(armed):
@@ -476,6 +547,12 @@ def main(argv=None):
                          "bit-identity)")
     ap.add_argument("--no-int8", action="store_true",
                     help="skip the two int8-KV passes")
+    ap.add_argument("--spill", action="store_true",
+                    help="add the tiered-KV passes (ISSUE 17: spill "
+                         "off/clean/chaos on a spill-pressure workload "
+                         "— host_spill.* faults degrade to recompute "
+                         "bit-identically, both pools reclaim, cached-"
+                         "token rate beats the HBM-only ceiling)")
     ap.add_argument("--trace-out",
                     default=os.path.join("profiler_log",
                                          "soak_trace.json"),
@@ -674,6 +751,51 @@ def main(argv=None):
                               f"chaos: {lora_div[:10]}")
         report["lora_unaffected_bit_identical"] = \
             args.requests - len(lora_aff)
+
+    if args.spill:
+        # ---- tiered-KV spill passes (ISSUE 17) -----------------------
+        # a spill-pressure workload on a shrunken device pool, three
+        # ways: host tier off (the HBM-only ceiling), on (clean), and
+        # on with every host_spill.* read fault armed
+        swork = make_spill_workload(args.requests, args.seed)
+        off_kw = dict(num_pages=24)
+        on_kw = dict(num_pages=24, host_spill_pages=32)
+        s_off, _ = run_workload(model, swork, chaos=False,
+                                seed=args.seed, report=report,
+                                extra_kw=off_kw, label="spill_off")
+        s_clean, _ = run_workload(model, swork, chaos=False,
+                                  seed=args.seed, report=report,
+                                  extra_kw=on_kw, label="spill_clean")
+        # the tier is invisible in the tokens (EVERY request — no
+        # faults in either pass) ...
+        s_div = [i for i in range(len(swork))
+                 if s_clean.get(i) != s_off.get(i)]
+        assert not s_div, \
+            f"spill tier changed greedy tokens: {s_div[:10]}"
+        sc = report["spill_clean"]
+        assert sc["kv_pages_demoted"] > 0 and \
+            sc["kv_pages_promoted"] > 0 and \
+            sc["host_prefix_hits"] >= 1, sc
+        # ... while serving MORE cached tokens at the same device pool
+        # (the perf_opt acceptance: host capacity raises the hit rate
+        # above the HBM-only ceiling)
+        assert sc["cached_tokens"] > \
+            report["spill_off"]["cached_tokens"], \
+            (sc["cached_tokens"], report["spill_off"]["cached_tokens"])
+        s_chaos, s_aff = run_workload(model, swork, chaos=False,
+                                      seed=args.seed, report=report,
+                                      extra_kw=on_kw, spill_chaos=True,
+                                      label="spill_chaos")
+        # all three read faults degrade to recompute: NOTHING is
+        # affected and EVERY token matches the clean spill pass
+        assert not s_aff, s_aff
+        s_div = [i for i in range(len(swork))
+                 if s_chaos.get(i) != s_clean.get(i)]
+        assert not s_div, \
+            f"spill faults changed greedy tokens: {s_div[:10]}"
+        sx = report["spill_chaos"]
+        assert all(c >= 1 for c in sx["spill_faults"]), sx
+        report["spill_bit_identical"] = args.requests
 
     report["wall_s"] = round(time.perf_counter() - t0, 2)
     print(json.dumps(report))
