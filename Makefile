@@ -3,7 +3,7 @@
 # otherwise block every interpreter on the single TPU grant).
 TEST_ENV = env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
 
-.PHONY: test test-fast bench soak soak-fleet soak-fleet-proc lint train-report dist-report
+.PHONY: test test-fast bench soak soak-fleet soak-fleet-proc soak-disagg lint train-report dist-report
 
 # tpu-lint: static trace-safety analysis (ANALYSIS.md). AST-only — no
 # jax import, no TPU grant, ~1 s; gates `make test`.
@@ -82,6 +82,16 @@ soak-fleet:
 soak-fleet-proc:
 	$(TEST_ENV) python tools/soak_fleet.py --procs --requests 30 --seed 0
 	$(TEST_ENV) python -m pytest tests/test_soak_fleet_proc.py -m slow -q
+
+# Disaggregated prefill/decode chaos soak (ISSUE 18): role-split fleet
+# with mid-flight KV handoff — prefill kill -9 with the kv_page stream
+# half shipped, decode death mid-adopt, relay stalls with capped-backoff
+# re-pulls, role-starved co-location fallback, the decode-TPOT
+# comparison against chunked-prefill co-location, and the int8-KV
+# variant. 3 chaos seeds inside the ladder.
+soak-disagg:
+	$(TEST_ENV) python tools/soak_fleet.py --disagg --requests 64 --seed 0
+	$(TEST_ENV) python -m pytest tests/test_soak_fleet_disagg.py -m slow -q
 
 # Sanitizer builds of the native extension (parity: reference
 # SANITIZER_TYPE configure option). Runs the native test suite against an

@@ -85,7 +85,7 @@ from ..models.generation import _filter_logits, _sample_arr
 from ..utils import faults
 from ..utils.nan_inf import poison_scope
 from .errors import (EngineFailure, EngineOverloaded,
-                     SnapshotVersionError)
+                     SnapshotVersionError, check_feature_conflicts)
 from .lora.adapter import AdapterNotLoaded
 from .kv_cache import (BlockAllocator, BlocksExhausted, HostPageCorrupt,
                        HostPageLost, HostPagesExhausted, HostPageSlow,
@@ -131,7 +131,11 @@ SNAPSHOT_VERSION = 1
 # minor 2 (ISSUE 15): request records carry an "adapter" field; a
 # lora-aware adopter REQUIRES the adapter loaded (typed refusal — never
 # wrong-adapter), while pre-lora builds ignore the key.
-SNAPSHOT_MINOR = 2
+# minor 3 (ISSUE 18): request records carry a "colocate" flag — a
+# supervisor-pinned request that a prefill-role engine must decode
+# locally instead of handing off (role-starved fallback); role-less
+# builds ignore it.
+SNAPSHOT_MINOR = 3
 _SNAPSHOT_KNOWN_KEYS = frozenset(
     {"version", "minor", "reason", "rng_key", "requests",
      "flight_recorder"})
@@ -395,6 +399,7 @@ class ServingEngine:
                  host_spill_pages: int = 0,
                  mesh=None,
                  lora=None,
+                 role: str = "both",
                  compile_cache=None,
                  trace=None, trace_ring: int = 512,
                  flight_recorder_steps: int = 128):
@@ -407,6 +412,20 @@ class ServingEngine:
                              f"{wq!r}")
         self.kv_dtype = kv_dtype
         self.wq = wq
+        # --- disaggregated serving role (ISSUE 18) ---
+        # "both" (default) is the co-located engine. "prefill": every
+        # request that completes its prefill finishes with reason
+        # "handoff" instead of entering the decode batch — its
+        # block-aligned pages sit donated in the radix tree for the
+        # fleet's kv_pull, and `handoff_prefix_len` on the request
+        # records the span; requests adopted with a "colocate" pin
+        # decode locally anyway (role-starved fallback). "decode" is a
+        # routing tag only — the engine behaves exactly like "both"
+        # (it must re-prefill prompt tails and failed handoffs).
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(f"role must be 'both', 'prefill' or "
+                             f"'decode', got {role!r}")
+        self.role = role
         # --- tensor parallelism (ISSUE 8) ---
         # mesh: a hybrid [data, pipe, sharding, sep, model] jax Mesh (or
         # any mesh with a 'model' axis). Attention heads, the paged KV
@@ -428,6 +447,26 @@ class ServingEngine:
                 raise ValueError(
                     f"num_attention_heads {cfg.num_attention_heads} not "
                     f"divisible by model-axis degree {self.tp}")
+        # the central capability table (serving/errors.py, ROADMAP item
+        # 4): every pairwise feature conflict is ONE check against ONE
+        # table — the scattered per-feature raises this replaces could
+        # (and did) drift apart as features landed in different PRs
+        active = set()
+        if proposer is not None:
+            active.add("proposer")
+        if int(decode_steps) > 1:
+            active.add("multi_step_decode")
+        if lora is not None:
+            active.add("lora")
+        if self.tp > 1:
+            active.add("tensor_parallel")
+        if int(host_spill_pages) > 0:
+            active.add("host_spill")
+        if not enable_prefix_cache:
+            active.add("no_prefix_cache")
+        if role == "prefill":
+            active.add("prefill_role")
+        check_feature_conflicts(active)
         if wq is not None:
             # IN PLACE, before the state snapshot below: the quantized
             # buffers (int8 qweight + fp scale) replace the fp weights
@@ -563,11 +602,8 @@ class ServingEngine:
                 f"MAX_DECODE_STEPS {MAX_DECODE_STEPS} (device-side loop "
                 f"trip counts are capped well under the 512-iteration "
                 f"wedge cap — tpu-lint A4)")
-        if self.decode_steps > 1 and proposer is not None:
-            raise ValueError(
-                "decode_steps > 1 and a proposer are mutually exclusive: "
-                "speculative verify and plain multi-step decode both "
-                "multiply tokens per launch — pick one per engine")
+        # decode_steps x proposer conflicts via the capability table
+        # (checked above — serving/errors.py FEATURE_CONFLICTS)
         self.multi_buckets = sorted(
             multi_buckets or _pow2_buckets(1, self.decode_steps)) \
             if self.decode_steps > 1 else []
@@ -585,17 +621,8 @@ class ServingEngine:
         # adapters, and load/unload/evict never recompiles (only the
         # static layout signature rides the program key, below).
         self.lora = lora
-        if lora is not None:
-            if proposer is not None:
-                raise ValueError(
-                    "lora and a proposer are mutually exclusive: the "
-                    "verify program has no adapter path (pick one per "
-                    "engine)")
-            if mesh is not None:
-                raise ValueError(
-                    "lora under tensor parallelism is not supported "
-                    "yet: the adapter pools/stacks carry no sharding "
-                    "specs (run lora engines at tp=1)")
+        # lora x proposer / lora x tensor_parallel conflicts via the
+        # capability table (checked above)
 
         self.allocator = BlockAllocator(self.num_pages, self.page_size)
         self.radix = (RadixCache(self.allocator)
@@ -708,15 +735,8 @@ class ServingEngine:
         self.host_spill_pages = int(host_spill_pages)
         if self.host_spill_pages < 0:
             raise ValueError("host_spill_pages must be >= 0")
-        if self.host_spill_pages and self.tp > 1:
-            raise ValueError(
-                "host spill under tensor parallelism is not supported "
-                "yet: page gathers would fetch every shard through the "
-                "host (run spill engines at tp=1)")
-        if self.host_spill_pages and self.radix is None:
-            raise ValueError(
-                "host_spill_pages needs the radix cache: the spill tier "
-                "lives UNDER it (enable_prefix_cache=True)")
+        # host_spill x tensor_parallel / x no_prefix_cache conflicts
+        # via the capability table (checked above)
         self.host_page_bytes = self.num_layers * self.kv_page_bytes
         if self.host_spill_pages:
             self.host_store: Optional[HostPageStore] = HostPageStore(
@@ -2021,6 +2041,18 @@ class ServingEngine:
                 if reason is not None:
                     self.scheduler.finish(req, reason)
                     self._on_finished(req)
+                elif self.role == "prefill" and not req.colocate:
+                    # disaggregated prefill (ISSUE 18): the request's
+                    # block-aligned pages donate to the radix tree and
+                    # the request finishes "handoff" instead of joining
+                    # the decode batch — the fleet pulls the pages to a
+                    # decode-role worker via export_prefix. The first
+                    # token was already emitted above, so the decode
+                    # side resumes from index 1 with zero token loss.
+                    req.handoff_prefix_len = \
+                        self.scheduler.finish_handoff(req)
+                    self.metrics.counters["prefill_handoffs"] += 1
+                    self._on_finished(req)
                 else:
                     self.scheduler.on_prefilled(req)
 
@@ -2223,6 +2255,10 @@ class ServingEngine:
                 # record so failover re-lands the request WITH its
                 # adapter (or refuses typed) — never wrong-adapter
                 "adapter": req.adapter,
+                # ISSUE 18 (snapshot minor 3): a supervisor-pinned
+                # colocate flag survives migration — a role-starved
+                # fallback must stay decodable wherever it re-lands
+                "colocate": bool(req.colocate),
             })
         recs.sort(key=lambda r: r["request_id"])   # FCFS order on resume
         snap = {"version": SNAPSHOT_VERSION, "minor": SNAPSHOT_MINOR,
@@ -2267,6 +2303,7 @@ class ServingEngine:
         req.output_ids = [int(t) for t in rec.get("output_ids", [])]
         req.num_preemptions = int(rec.get("num_preemptions", 0))
         req.aborted = bool(rec.get("aborted", False))
+        req.colocate = bool(rec.get("colocate", False))
         rem = rec.get("deadline_remaining_s")
         if rem is not None:
             req.deadline = self._now() + float(rem)
@@ -2434,6 +2471,39 @@ class ServingEngine:
             self.allocator._decref(pid)
         self.metrics.counters["kv_pages_adopted"] += adopted
         return adopted
+
+    def release_prefix(self, tokens, *, drop: bool = False) -> int:
+        """Release-after-handoff page accounting (ISSUE 18): once this
+        engine's pages for `tokens` were shipped to AND adopted by a
+        decode-role sibling, the local copy stops earning its pool
+        space on its own merits. Default: DEMOTE the cached span to
+        coldest LRU rank — it stays matchable (a shared prompt prefix
+        keeps serving future admissions, and a later match re-heats
+        it), but it is the FIRST eviction victim under pressure, so a
+        prefill-role pool can never fill with spans that already live
+        on decode workers. `drop=True` frees the deepest childless
+        nodes of the span outright (strict accounting — tests assert
+        exact reclamation with it). Returns pages demoted/freed."""
+        if self.radix is None:
+            return 0
+        chain = [child for child, _ in self.radix._walk_prefix(tokens)]
+        released = 0
+        if drop:
+            before = self.allocator.num_free
+            for node in reversed(chain):
+                # only childless device-resident tails: dropping an
+                # interior node would orphan descendants reachable by
+                # other requests' prefixes
+                if node.children or node.host_pages:
+                    break
+                self.radix._drop_node(node)
+            released = self.allocator.num_free - before
+        else:
+            for node in chain:
+                node.last_use = 0       # coldest: first eviction victim
+                released += len(node.pages)
+        self.metrics.counters["kv_pages_released"] += released
+        return released
 
     # ------------------------------------------------------- convenience
     def stream(self):

@@ -22,6 +22,11 @@ string-matching messages:
   fail LOUD on it: silently reinterpreting an old schema would resume
   garbage (wrong deadlines, dropped tokens) instead of crashing.
 
+* `UnsupportedFeature` — a feature COMBINATION this build refuses by
+  policy (see `FEATURE_CONFLICTS`, the central capability table).
+  Subclasses ValueError so pre-existing callers catching the untyped
+  constructor refusals keep working.
+
 Fleet-level errors (replica supervision, routing, tenant fairness) live
 in `serving.fleet.errors` — they are failures of the layer ABOVE the
 engine.
@@ -32,7 +37,8 @@ from typing import Optional
 
 __all__ = ["EngineOverloaded", "TransientDeviceError",
            "PoisonedComputation", "EngineFailure",
-           "SnapshotVersionError"]
+           "SnapshotVersionError", "UnsupportedFeature",
+           "FEATURE_CONFLICTS", "check_feature_conflicts"]
 
 
 class EngineOverloaded(RuntimeError):
@@ -67,6 +73,79 @@ class SnapshotVersionError(ValueError):
         super().__init__(msg)
         self.found = found
         self.expected = expected
+
+
+class UnsupportedFeature(ValueError):
+    """A feature combination this build refuses (capability table hit).
+    `features` carries the conflicting pair so callers/routers can
+    branch on WHAT conflicted instead of string-matching the reason."""
+
+    def __init__(self, msg: str, features=()):
+        super().__init__(msg)
+        self.features = tuple(sorted(features))
+
+
+# The central capability table (ROADMAP item 4): every pairwise feature
+# conflict the engine refuses, in ONE place, as
+# {frozenset({feature_a, feature_b}): reason}. Feature names are the
+# vocabulary `ServingEngine.__init__` derives from its kwargs:
+#
+#   proposer          speculative decoding (serving.spec)
+#   multi_step_decode decode_steps > 1 (ISSUE 13)
+#   lora              multi-LoRA adapter serving (ISSUE 15)
+#   tensor_parallel   mesh with model-axis degree > 1 (ISSUE 8)
+#   host_spill        host_spill_pages > 0 (ISSUE 17)
+#   no_prefix_cache   enable_prefix_cache=False
+#   prefill_role      role="prefill" (ISSUE 18 disaggregation)
+#
+# Adding a conflict = adding a row; the engine's single
+# `check_feature_conflicts(active)` call enforces all of them. Reasons
+# keep the historical phrasing ("mutually exclusive", "not supported
+# yet") — callers match on those strings.
+FEATURE_CONFLICTS = {
+    frozenset({"multi_step_decode", "proposer"}):
+        "decode_steps > 1 and a proposer are mutually exclusive: "
+        "speculative verify and plain multi-step decode both multiply "
+        "tokens per launch — pick one per engine",
+    frozenset({"lora", "proposer"}):
+        "lora and a proposer are mutually exclusive: the verify "
+        "program has no adapter path (pick one per engine)",
+    frozenset({"lora", "tensor_parallel"}):
+        "lora under tensor parallelism is not supported yet: the "
+        "adapter pools/stacks carry no sharding specs (run lora "
+        "engines at tp=1)",
+    frozenset({"host_spill", "tensor_parallel"}):
+        "host spill under tensor parallelism is not supported yet: "
+        "page gathers would fetch every shard through the host (run "
+        "spill engines at tp=1)",
+    frozenset({"host_spill", "no_prefix_cache"}):
+        "host_spill_pages needs the radix cache: the spill tier lives "
+        "UNDER it (enable_prefix_cache=True)",
+    frozenset({"prefill_role", "proposer"}):
+        "a prefill-role engine and a proposer are mutually exclusive: "
+        "speculative decoding only pays on the decode side, which a "
+        "prefill-role engine hands off before reaching",
+    frozenset({"prefill_role", "multi_step_decode"}):
+        "a prefill-role engine and decode_steps > 1 are mutually "
+        "exclusive: multi-step decode only pays on the decode side, "
+        "which a prefill-role engine hands off before reaching",
+    frozenset({"prefill_role", "no_prefix_cache"}):
+        "a prefill-role engine needs the radix cache: handoff ships "
+        "the prefilled KV out of the donated radix prefix "
+        "(enable_prefix_cache=True)",
+}
+
+
+def check_feature_conflicts(active) -> None:
+    """Raise the typed `UnsupportedFeature` for the first capability-
+    table row fully contained in `active` (a set of feature names).
+    Rows are checked in a deterministic order so the same kwargs always
+    produce the same refusal."""
+    active = frozenset(active)
+    for pair in sorted(FEATURE_CONFLICTS, key=sorted):
+        if pair <= active:
+            raise UnsupportedFeature(FEATURE_CONFLICTS[pair],
+                                     features=pair)
 
 
 class EngineFailure(RuntimeError):

@@ -91,7 +91,7 @@ class FleetHandle:
     __slots__ = ("request_id", "tenant", "tokens", "finished",
                  "finish_reason", "migrations", "_listeners",
                  "submit_t", "first_token_t", "finish_t",
-                 "ttft_slo_s", "tpot_slo_s")
+                 "ttft_slo_s", "tpot_slo_s", "token_ts")
 
     def __init__(self, request_id: int, tenant: str):
         self.request_id = int(request_id)
@@ -109,6 +109,12 @@ class FleetHandle:
         self.finish_t: Optional[float] = None
         self.ttft_slo_s: Optional[float] = None
         self.tpot_slo_s: Optional[float] = None
+        # per-token delivery stamps on the fleet clock (ISSUE 18):
+        # inter-token gaps after the first token are the decode TPOT
+        # samples the disagg soak compares against co-location.
+        # Catch-up bursts land many tokens on one stamp — TPOT readers
+        # must use clean (migration-free) passes.
+        self.token_ts: List[float] = []
 
     def subscribe(self, listener):
         """Attach an event callback; every attached listener sees every

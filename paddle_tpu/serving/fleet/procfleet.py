@@ -43,6 +43,38 @@ scrubbed unless the spec says otherwise — on real chips the
 one-TPU-process rule means per-process device grants, which is
 deployment plumbing, not this module's business.
 
+**Disaggregated prefill/decode (ISSUE 18).** A worker spec may carry
+`role`: "prefill" / "decode" / "both" (default). Role-aware routing
+(`router.role_candidates`) sends fresh submits to prefill-capable
+workers and re-lands already-prefilled records on decode-capable ones,
+FALLING BACK to whoever is healthy when a role is starved. A
+prefill-role engine finishes each request with reason "handoff" after
+its last prefill chunk + first token; the worker ships `prefill_done
+{rid, output_ids, prefix_len}` and the supervisor drives the KV
+handoff as a per-request state machine keyed by pull_id:
+
+    PULLING    kv_pull sent to the donor (prefill worker)
+    STREAMING  donor's kv_prefix seen; kv_page frames relayed verbatim
+               to the chosen decode worker as they arrive
+    ADOPT_WAIT every frame relayed; waiting on the target's kv_adopted
+    BACKOFF    a phase deadline passed; capped exponential backoff,
+               then re-issue under a fresh pull_id
+
+Every phase has a deadline (`handoff_timeout_s`, reset on progress)
+and every failure degrades instead of shedding: donor death parks the
+request through the normal evacuation path (it stays ASSIGNED to the
+donor until placement, so the existing machinery covers it); target
+death re-routes to a survivor; attempts exhausted -> the target adopts
+the record WITHOUT pages and re-prefills from its own radix/weights
+(bit-identical — the same determinism contract migration relies on);
+no decode-capable worker at all -> the record re-lands co-located on
+the donor with `colocate=True` (its radix still holds the prefix, so
+the re-prefill is a cache hit). After a confirmed adoption the donor
+gets `kv_release` so the shipped prefix becomes its coldest eviction
+victim. Fault point `fleet.handoff_stall` (registered here, fired at
+the kv_page relay) discards a relayed frame so the stream wedges and
+the phase timeout must recover.
+
 Module import stays jax-free (FleetHandle/event shapes import lazily):
 the supervisor side can run in a process that never touches jax.
 """
@@ -58,9 +90,18 @@ import time
 import uuid
 from typing import Dict, List, Optional, Tuple
 
+from ...utils import faults
+from .router import role_candidates
 from .transport import Channel, TransportError, bind_store, free_port
 
-__all__ = ["ProcessFleet", "WorkerProc", "WorkerState"]
+__all__ = ["ProcessFleet", "WorkerProc", "WorkerState",
+           "FAULT_HANDOFF_STALL"]
+
+# Fired at the supervisor's kv_page relay site: any payload -> the
+# frame is NOT relayed, so the decode worker's intake never completes
+# and the handoff wedges mid-stream — the phase timeout must notice,
+# abort the intake, and recover (backoff re-pull or pageless adopt).
+FAULT_HANDOFF_STALL = faults.register_point("fleet.handoff_stall")
 
 
 class WorkerState(enum.Enum):
@@ -83,6 +124,15 @@ class WorkerProc:
         session = f"{spec.get('session_base', 's0')}/{name}/g{generation}"
         self.spec["session"] = session
         self.spec["name"] = name
+        # fleet role (ISSUE 18): "prefill" / "decode" / "both". The
+        # spec's top-level role is mirrored into the engine kwargs so
+        # a prefill worker's ENGINE also runs in handoff mode.
+        self.role = str(spec.get("role")
+                        or spec.get("engine", {}).get("role", "both"))
+        if self.role != "both":
+            eng = dict(self.spec.get("engine", {}))
+            eng.setdefault("role", self.role)
+            self.spec["engine"] = eng
         self.chan = Channel(store, me="host", peer=name, session=session)
         self.state = WorkerState.SPAWNING
         self.pid: Optional[int] = None
@@ -184,6 +234,9 @@ class ProcessFleet:
                  dead_after_s: float = 8.0,
                  lost_after_s: float = 30.0,
                  max_inflight_per_worker: Optional[int] = None,
+                 handoff_timeout_s: float = 5.0,
+                 handoff_max_attempts: int = 2,
+                 handoff_backoff_s: float = 0.25,
                  clock=None, python: Optional[str] = None,
                  stderr_dir: Optional[str] = None):
         self.endpoint = endpoint or f"127.0.0.1:{free_port()}"
@@ -193,6 +246,9 @@ class ProcessFleet:
         self.dead_after_s = float(dead_after_s)
         self.lost_after_s = float(lost_after_s)
         self.max_inflight_per_worker = max_inflight_per_worker
+        self.handoff_timeout_s = float(handoff_timeout_s)
+        self.handoff_max_attempts = int(handoff_max_attempts)
+        self.handoff_backoff_s = float(handoff_backoff_s)
         self._clock = clock if clock is not None else time.monotonic
         self._python = python
         self.stderr_dir = stderr_dir
@@ -216,6 +272,20 @@ class ProcessFleet:
         # refusal): never re-land it there — with every healthy worker
         # excluded the request is finalized "lost", not looped forever
         self._excluded: Dict[int, set] = {}
+        # ---- KV handoff state machine (ISSUE 18) ----
+        # pull_id -> {rid, donor, target, phase, deadline, attempts,
+        #             tokens, num_chunks, relayed, rec}; the request
+        # stays ASSIGNED to the donor until placement so the normal
+        # evacuation machinery parks it if the donor dies mid-stream
+        self._handoffs: Dict[str, dict] = {}
+        self._handoff_by_rid: Dict[int, str] = {}
+        # rid -> worker names whose prefill_done was already acted on:
+        # the donor re-ships it with heartbeats (healing a dropped
+        # frame) and keeps doing so after a colocate fallback placed
+        # the request back on it — without this, every heartbeat would
+        # restart the handoff of a request that is already decoding
+        self._handoff_done_seen: Dict[int, set] = {}
+        self._pull_counter = 0
         self.counters: Dict[str, int] = {
             "requests_submitted": 0,
             "requests_finished": 0,
@@ -234,6 +304,15 @@ class ProcessFleet:
             "worker_rejects": 0,
             "heartbeats": 0,
             "transport_errors": 0,
+            # disaggregated prefill/decode (ISSUE 18)
+            "handoffs_started": 0,      # prefill_done acted on
+            "handoffs_completed": 0,    # target adopted shipped pages
+            "handoffs_refetched": 0,    # placed WITHOUT pages: target
+                                        # (or donor) re-prefilled
+            "handoffs_colocated": 0,    # role-starved fallback to the
+                                        # donor (colocate=True)
+            "handoff_stalls": 0,        # phase deadlines that fired
+            "kv_pages_shipped": 0,      # pages the targets adopted
         }
 
     # ---- plumbing --------------------------------------------------------
@@ -286,6 +365,9 @@ class ProcessFleet:
         candidates = self._healthy()
         if not candidates:
             raise NoHealthyReplica("no ready worker to accept work")
+        # fresh work starts in its prefill phase: prefer prefill-
+        # capable workers, falling back to anyone healthy (ISSUE 18)
+        candidates = role_candidates(candidates, "prefill")
         if adapter is not None:
             declared = [w for w in candidates
                         if any(ad.get("name") == adapter
@@ -315,7 +397,7 @@ class ProcessFleet:
                "eos_token_id": (None if eos_token_id is None
                                 else int(eos_token_id)),
                "num_preemptions": 0, "aborted": False,
-               "adapter": adapter,
+               "adapter": adapter, "colocate": False,
                "deadline_remaining_s": (None if ttl_s is None
                                         else float(ttl_s))}
         handle = self._handle_cls()(rid, "_default")
@@ -382,8 +464,10 @@ class ProcessFleet:
     # ---- exactly-once funnel ---------------------------------------------
     def _deliver(self, handle, tok: int):
         handle._deliver(tok)
+        now = self._clock()
         if handle.first_token_t is None:
-            handle.first_token_t = self._clock()
+            handle.first_token_t = now
+        handle.token_ts.append(now)
         self.counters["tokens_delivered"] += 1
 
     def _funnel(self, rid: int, idx: int, tok: int):
@@ -432,12 +516,301 @@ class ProcessFleet:
         self._pending.pop(rid, None)
         self._deadline_at.pop(rid, None)
         self._excluded.pop(rid, None)
+        self._handoff_done_seen.pop(rid, None)
+        pid = self._handoff_by_rid.pop(rid, None)
+        if pid is not None:
+            self._drop_handoff(self._handoffs.get(pid))
         if handle is None or handle.finished:
             return
         handle.finish_t = self._clock()
         handle._finish(reason)
         self.counters["requests_lost" if reason == "lost"
                       else "requests_finished"] += 1
+
+    # ---- KV handoff state machine (ISSUE 18) -----------------------------
+    def _live_worker(self, name: Optional[str]) -> Optional[WorkerProc]:
+        w = self.workers.get(name)
+        if w is None or w.state in (WorkerState.DEAD,
+                                    WorkerState.STOPPED):
+            return None
+        return w
+
+    def _decode_target(self, rid: int,
+                       exclude=()) -> Optional[WorkerProc]:
+        """Least-loaded healthy decode-CAPABLE worker for `rid`, or
+        None. Strict (no role fallback): the caller owns the degraded
+        path (colocate on the donor), which is cheaper than landing
+        decode work on a foreign prefill worker with a cold cache."""
+        cands = [w for w in self._healthy()
+                 if w.role in ("decode", "both")
+                 and w.name not in exclude
+                 and w.name not in self._excluded.get(rid, ())]
+        if not cands:
+            return None
+        return min(cands, key=lambda w: (w.reported_load
+                                         + len(self._assigned_to(w.name)),
+                                         w.name))
+
+    def _handoff_rec(self, rid: int) -> dict:
+        """A placement-ready record for `rid`: resume point = the
+        funnel-verified tokens, deadline recharged for time already
+        spent (the `_park` discipline)."""
+        handle = self.handles[rid]
+        rec = dict(self._records[rid])
+        rec["output_ids"] = [int(t) for t in handle.tokens]
+        dl = self._deadline_at.get(rid)
+        if dl is not None:
+            rec["deadline_remaining_s"] = float(dl - self._clock())
+        return rec
+
+    def _on_prefill_done(self, worker: WorkerProc, payload: dict):
+        """A prefill-role worker finished a request with reason
+        "handoff": start (or ignore a re-delivery of) its KV handoff."""
+        rid = int(payload.get("rid", -1))
+        handle = self.handles.get(rid)
+        if handle is None or handle.finished:
+            return
+        if self._assign.get(rid) != worker.name:
+            return      # stale frame from a previous landing
+        seen = self._handoff_done_seen.setdefault(rid, set())
+        if worker.name in seen:
+            return      # heartbeat re-delivery: already acted on
+        seen.add(worker.name)
+        self._catch_up(handle, payload.get("output_ids", []))
+        self.counters["handoffs_started"] += 1
+        rec = self._handoff_rec(rid)
+        prefix_len = int(payload.get("prefix_len", 0))
+        tokens = (rec["prompt_ids"] + rec["output_ids"])[:prefix_len]
+        target = self._decode_target(rid, exclude={worker.name})
+        if target is None:
+            # role-starved: degrade to co-located execution on the
+            # donor — its radix still holds the prefix, so the
+            # re-prefill is a cache hit, not shed work
+            self.counters["handoffs_colocated"] += 1
+            rec["colocate"] = True
+            self._records[rid]["colocate"] = True
+            self._send_adopt(worker, [rec])
+            return
+        if not tokens or rec.get("adapter"):
+            # nothing pullable (zero donated pages, or an adapter'd
+            # request whose radix key the raw-token pull cannot
+            # match): place pageless, the target re-prefills
+            self.counters["handoffs_refetched"] += 1
+            self._send_adopt(target, [rec])
+            handle.migrations += 1
+            return
+        self._start_pull(rid, worker.name, target.name, tokens, rec)
+
+    def _start_pull(self, rid: int, donor: str, target: str,
+                    tokens, rec: dict, attempts: int = 1) -> dict:
+        self._pull_counter += 1
+        pull_id = f"ho{self._pull_counter}"
+        entry = {"pull_id": pull_id, "rid": rid, "donor": donor,
+                 "target": target, "phase": "pulling",
+                 "deadline": self._clock() + self.handoff_timeout_s,
+                 "retry_at": 0.0, "attempts": int(attempts),
+                 "tokens": [int(t) for t in tokens],
+                 "num_chunks": None, "relayed": 0, "rec": rec}
+        self._handoffs[pull_id] = entry
+        self._handoff_by_rid[rid] = pull_id
+        try:
+            self.workers[donor].chan.send("kv_pull", pull_id=pull_id,
+                                          tokens=entry["tokens"])
+        except TransportError:
+            self.counters["transport_errors"] += 1
+            # keep the entry: the phase deadline drives the retry
+        return entry
+
+    def _drop_handoff(self, entry: Optional[dict], *,
+                      abort_target: bool = True):
+        """Forget a handoff; optionally tell the target to drop its
+        intake buffer (host-side dicts only — no pages allocate before
+        adoption, so nothing can leak either way)."""
+        if entry is None:
+            return
+        self._handoffs.pop(entry["pull_id"], None)
+        if self._handoff_by_rid.get(entry["rid"]) == entry["pull_id"]:
+            self._handoff_by_rid.pop(entry["rid"], None)
+        if abort_target:
+            target = self._live_worker(entry["target"])
+            if target is not None:
+                try:
+                    target.chan.send("kv_abort",
+                                     pull_id=entry["pull_id"])
+                except TransportError:
+                    self.counters["transport_errors"] += 1
+
+    def _relay_to_target(self, entry: dict, msg: dict) -> bool:
+        target = self._live_worker(entry["target"])
+        if target is None:
+            return False
+        try:
+            target.chan.relay(msg)
+            return True
+        except TransportError:
+            self.counters["transport_errors"] += 1
+            return False
+
+    def _on_handoff_frame(self, worker: WorkerProc, mtype: str,
+                          msg: dict):
+        payload = msg.get("payload", {})
+        entry = self._handoffs.get(payload.get("pull_id"))
+        if entry is None:
+            return          # late frame of an aborted/finished pull
+        if entry["phase"] == "backoff":
+            return          # stream already written off; retry pending
+        if mtype in ("kv_prefix", "kv_page"):
+            if worker.name != entry["donor"]:
+                return
+            if mtype == "kv_prefix":
+                entry["num_chunks"] = int(payload.get("num_chunks", 0))
+                matched = [int(t) for t in payload.get("tokens", [])]
+                if matched:
+                    entry["tokens"] = matched
+                self._relay_to_target(entry, msg)
+            else:
+                if faults.fire(FAULT_HANDOFF_STALL) is not None:
+                    return      # frame eaten: the stream wedges and
+                                # the phase deadline must recover
+                self._relay_to_target(entry, msg)
+                entry["relayed"] += 1
+            # progress re-arms the phase deadline
+            entry["deadline"] = self._clock() + self.handoff_timeout_s
+            if entry["num_chunks"] is not None:
+                entry["phase"] = ("adopt_wait"
+                                  if entry["relayed"] >= entry["num_chunks"]
+                                  else "streaming")
+        elif mtype == "kv_adopted":
+            if worker.name != entry["target"]:
+                return
+            adopted = int(payload.get("adopted_pages", 0))
+            self.counters["kv_pages_shipped"] += adopted
+            if adopted > 0:
+                self.counters["handoffs_completed"] += 1
+                # phase 4, prefill-side release (fire-and-forget): the
+                # shipped prefix becomes the donor's coldest eviction
+                # victim instead of squatting on its pool
+                donor = self._live_worker(entry["donor"])
+                if donor is not None:
+                    try:
+                        donor.chan.send("kv_release",
+                                        tokens=entry["tokens"])
+                    except TransportError:
+                        self.counters["transport_errors"] += 1
+            else:
+                # the target adopted nothing (dry pool / reassembly
+                # gap / CRC): it re-prefills from its own state
+                self.counters["handoffs_refetched"] += 1
+            self._place_handoff(entry)
+
+    def _place_handoff(self, entry: dict):
+        """Adopt the request on its decode target (pull resolved —
+        with pages or without). Post-placement failures are the
+        standard machinery's business: the rid is assigned to the
+        target from here on."""
+        self._drop_handoff(entry, abort_target=False)
+        rid = entry["rid"]
+        handle = self.handles.get(rid)
+        if handle is None or handle.finished:
+            return
+        rec = self._handoff_rec(rid)
+        rec["colocate"] = entry["rec"].get("colocate", False)
+        target = self._live_worker(entry["target"])
+        if target is None or not target.ready:
+            self._assign.pop(rid, None)
+            self._park(rid, rec)
+            return
+        self._send_adopt(target, [rec])
+        handle.migrations += 1
+
+    def _check_handoffs(self):
+        """Drive every in-flight handoff's deadlines and failure
+        transitions (one pump iteration's worth)."""
+        now = self._clock()
+        for entry in list(self._handoffs.values()):
+            rid = entry["rid"]
+            handle = self.handles.get(rid)
+            if handle is None or handle.finished:
+                self._drop_handoff(entry)
+                continue
+            if self._assign.get(rid) != entry["donor"]:
+                # the donor died and evacuation parked the rid under
+                # us: the park/re-land machinery owns it now (role-
+                # aware; the decode side re-prefills — a refetch)
+                self.counters["handoffs_refetched"] += 1
+                self._drop_handoff(entry)
+                continue
+            if self._live_worker(entry["target"]) is None:
+                # target died pre-placement: re-route to a survivor
+                self._drop_handoff(entry, abort_target=False)
+                self._reroute(entry, now)
+                continue
+            if entry["phase"] == "backoff":
+                if now >= entry["retry_at"]:
+                    self._drop_handoff(entry, abort_target=False)
+                    self._reroute(entry, now)
+                continue
+            if now < entry["deadline"]:
+                continue
+            # a phase wedged (stalled stream, lost pull, mute target):
+            # abort the target's intake, then capped backoff + re-pull
+            # while attempts remain, else give the pages up
+            self.counters["handoff_stalls"] += 1
+            target = self._live_worker(entry["target"])
+            if target is not None:
+                try:
+                    target.chan.send("kv_abort",
+                                     pull_id=entry["pull_id"])
+                except TransportError:
+                    self.counters["transport_errors"] += 1
+            if entry["attempts"] < self.handoff_max_attempts:
+                # re-key NOW so straggler frames of the written-off
+                # stream can't resurrect the entry; send after backoff
+                self._handoffs.pop(entry["pull_id"], None)
+                self._pull_counter += 1
+                entry["pull_id"] = f"ho{self._pull_counter}"
+                self._handoffs[entry["pull_id"]] = entry
+                self._handoff_by_rid[rid] = entry["pull_id"]
+                entry["phase"] = "backoff"
+                entry["retry_at"] = now + self.handoff_backoff_s * (
+                    2 ** (entry["attempts"] - 1))
+                entry["num_chunks"] = None
+                entry["relayed"] = 0
+            else:
+                self.counters["handoffs_refetched"] += 1
+                self._place_handoff(entry)
+
+    def _reroute(self, entry: dict, now: float):
+        """Continue a handoff whose stream was written off (backoff
+        expiry or target death): fresh pull to a fresh target, pageless
+        placement when attempts are spent, colocate when role-starved."""
+        rid = entry["rid"]
+        handle = self.handles.get(rid)
+        if handle is None or handle.finished:
+            return
+        donor = self._live_worker(entry["donor"])
+        target = self._decode_target(rid, exclude={entry["donor"]})
+        rec = self._handoff_rec(rid)
+        rec["colocate"] = entry["rec"].get("colocate", False)
+        if target is None:
+            if donor is not None:
+                self.counters["handoffs_colocated"] += 1
+                rec["colocate"] = True
+                self._records[rid]["colocate"] = True
+                self._send_adopt(donor, [rec])
+            # donor dead too: leave the rid assigned — the donor's
+            # evacuation parks it and the normal machinery re-lands
+            return
+        if donor is None or entry["attempts"] >= self.handoff_max_attempts:
+            # no donor to pull from (or attempts spent): pageless
+            # placement, the target re-prefills bit-identically
+            self.counters["handoffs_refetched"] += 1
+            self._send_adopt(target, [rec])
+            handle.migrations += 1
+            return
+        self._start_pull(rid, entry["donor"], target.name,
+                         entry["tokens"], rec,
+                         attempts=entry["attempts"] + 1)
 
     # ---- message processing ----------------------------------------------
     def _process(self, worker: WorkerProc, msg: dict):
@@ -482,6 +855,11 @@ class ProcessFleet:
                 if handle is not None and not handle.finished:
                     self._catch_up(handle, fin.get("output_ids", []))
                     self._finalize(rid, fin.get("reason", "stop"))
+            # ... and re-shipped handoff records heal dropped
+            # prefill_done frames (idempotent per donor via
+            # _handoff_done_seen)
+            for ho in payload.get("recent_handoffs", []):
+                self._on_prefill_done(worker, ho)
             self.counters["heartbeats"] += 1
         elif mtype == "events":
             worker.last_beat_host_t = self._clock()
@@ -493,6 +871,12 @@ class ProcessFleet:
             if handle is not None and not handle.finished:
                 self._catch_up(handle, payload.get("output_ids", []))
             self._finalize(rid, payload.get("reason", "stop"))
+        elif mtype == "prefill_done":
+            worker.last_beat_host_t = self._clock()
+            self._on_prefill_done(worker, payload)
+        elif mtype in ("kv_prefix", "kv_page", "kv_adopted"):
+            worker.last_beat_host_t = self._clock()
+            self._on_handoff_frame(worker, mtype, msg)
         elif mtype == "adopted":
             worker.last_beat_host_t = self._clock()
         elif mtype == "stats":
@@ -618,8 +1002,20 @@ class ProcessFleet:
             if not candidates:
                 self._finalize(rid, "lost")
                 continue
+            # role-aware re-landing (ISSUE 18): a record with output
+            # is past its prefill phase and belongs on a decode-
+            # capable worker; a fresh one belongs on prefill-capable.
+            # role_candidates falls back to everyone when starved —
+            # landing decode work on a prefill-role worker then
+            # requires colocate, or its engine would hand it off again
+            phase = "decode" if rec["output_ids"] else "prefill"
+            candidates = role_candidates(candidates, phase)
             target = min(candidates, key=lambda w: (w.reported_load
                          + len(self._assigned_to(w.name))))
+            if phase == "decode" and target.role == "prefill":
+                rec["colocate"] = True
+                if rid in self._records:
+                    self._records[rid]["colocate"] = True
             if not self._send_adopt(target, [rec]):
                 continue     # parked again; retried next pump
             handle.migrations += 1
@@ -645,6 +1041,7 @@ class ProcessFleet:
                 self._process(worker, msg)
                 n += 1
         self._check_liveness()
+        self._check_handoffs()
         self._process_parked()
         return n
 
@@ -808,6 +1205,8 @@ class ProcessFleet:
         snap = {f"fleet_{k}": v for k, v in self.counters.items()}
         snap["worker_states"] = {w.name: w.state.value
                                  for w in self.workers.values()}
+        snap["worker_roles"] = {w.name: w.role
+                                for w in self.workers.values()}
         return snap
 
     def prometheus_text(self, *, prefix: str = "paddle_serving") -> str:
@@ -840,6 +1239,13 @@ class ProcessFleet:
             lines.append(
                 f'{metric_name(prefix, "worker_generation")}{lab} '
                 f'{w.generation}')
+            # role as an info-style series (value 1, role in the
+            # label): adding a label to the existing series would
+            # break every scrape joining on {worker=...} alone
+            lines.append(
+                f'{metric_name(prefix, "worker_role")}'
+                f'{{worker="{sanitize_label_value(w.name)}",'
+                f'role="{sanitize_label_value(w.role)}"}} 1')
             if w.last_beat:
                 counters = w.last_beat.get("counters", {})
                 lines.extend(prometheus_lines(

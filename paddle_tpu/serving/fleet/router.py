@@ -27,7 +27,30 @@ from .errors import NoHealthyReplica
 from .replica import Replica
 
 __all__ = ["Router", "PrefixAffinityRouter", "RandomRouter",
-           "RoundRobinRouter"]
+           "RoundRobinRouter", "role_candidates"]
+
+# Which worker roles may serve each phase of a request's life
+# (ISSUE 18 disaggregation). "both" workers serve either phase; a
+# co-located fleet (all roles "both") matches every filter, so the
+# helper is a no-op there.
+_PHASE_ROLES = {
+    "prefill": ("prefill", "both"),
+    "decode": ("decode", "both"),
+}
+
+
+def role_candidates(candidates, phase: str):
+    """Filter `candidates` (anything with a `.role` attribute) down to
+    the ones whose role may serve `phase` ("prefill" or "decode").
+
+    Role-aware routing FALLS BACK rather than sheds: when no candidate
+    matches the phase (role-starved fleet — e.g. every decode worker is
+    dead), the full candidate list is returned and the caller degrades
+    to co-located execution on whatever is healthy."""
+    want = _PHASE_ROLES[phase]
+    matched = [c for c in candidates
+               if getattr(c, "role", "both") in want]
+    return matched or list(candidates)
 
 
 class Router:

@@ -291,6 +291,14 @@ class Channel:
         self.counters["sent"] += 1
         return seq
 
+    def relay(self, msg: dict) -> int:
+        """Re-send a RECEIVED message (same type + payload) down this
+        channel verbatim — the supervisor forwarding a donor's
+        `kv_prefix`/`kv_page` stream to the adopting decode worker
+        (ISSUE 18). A fresh seq on this stream is allocated; src/dst
+        are rewritten to this channel's endpoints."""
+        return self.send(msg["type"], **msg.get("payload", {}))
+
     def _read_next(self):
         """Non-blocking: the next pending message, None when the
         stream is empty (or stalled), or `_CONSUMED` when a seq was

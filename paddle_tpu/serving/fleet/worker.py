@@ -29,10 +29,24 @@ then worker->host):
                                        stream completes (same types the
                                        donor emits — the supervisor
                                        relays frames verbatim)
+    kv_abort {pull_id}             -> (drop the intake buffer: the
+                                       supervisor gave up on this pull;
+                                       host-side buffers only, no pages
+                                       were allocated yet)
+    kv_release {tokens, drop?}     -> (post-handoff hygiene on the
+                                       DONOR: demote — or with drop,
+                                       free — the shipped radix prefix;
+                                       fire-and-forget, no reply)
 
     ready {pid, geometry}        once, after the engine is built
     events {ev: [[rid,idx,tok]]} after every engine step that emitted
     finish {rid, reason, output_ids}
+    prefill_done {rid, output_ids, prefix_len}
+                                 a prefill-role engine finished a
+                                 request with reason "handoff" (ISSUE
+                                 18): first token(s) + the donated
+                                 radix prefix length ride up for the
+                                 supervisor to drive the kv_pull
     heartbeat {t, steps, load, counters, fired, snapshot}
     failed {snapshot}            EngineFailure; exit 3
 
@@ -76,13 +90,25 @@ from .transport import (Channel, chunk_payloads, connect_store,
                         join_payloads)
 
 __all__ = ["run_worker", "WorkerLoop", "build_model", "build_engine",
-           "build_lora_registry", "FAULT_KILL9"]
+           "build_lora_registry", "FAULT_KILL9",
+           "FAULT_HANDOFF_PARTIAL", "FAULT_DECODE_REJECT"]
 
 # Fires at the TOP of every worker loop iteration (an engine-boundary,
 # so the last shipped heartbeat snapshot is consistent): any payload ->
 # os.kill(getpid(), SIGKILL). The process cannot report the firing; the
 # supervisor proves it by the -SIGKILL returncode.
 FAULT_KILL9 = faults.register_point("worker.kill9")
+
+# ISSUE 18 handoff chaos. `fleet.handoff_partial` fires on the DONOR
+# after each kv_page frame sent: any payload -> SIGKILL mid-stream, so
+# armed with after=k the prefill worker dies with exactly k of n page
+# frames shipped (the receiver's intake never completes; the supervisor
+# phase-timeout re-prefills). `fleet.decode_reject` fires at the top of
+# the adopt handler: any payload -> refuse the whole batch with a
+# typed reject, which the supervisor answers by excluding this worker
+# for those rids and re-routing.
+FAULT_HANDOFF_PARTIAL = faults.register_point("fleet.handoff_partial")
+FAULT_DECODE_REJECT = faults.register_point("fleet.decode_reject")
 
 
 def build_model(model_spec: dict):
@@ -150,6 +176,13 @@ class WorkerLoop:
         # otherwise strand its handle live forever on the supervisor —
         # re-delivery is idempotent there (finalize checks finished)
         self.recent_finished: deque = deque(maxlen=64)
+        # handoff completions (ISSUE 18) ride a SEPARATE deque: a
+        # prefill_done lost on the wire must be healed by heartbeat
+        # re-delivery like a finish, but it must NOT enter
+        # recent_finished — the supervisor finalizes those handles,
+        # while a handoff's handle stays live until the decode side
+        # finishes it. The supervisor dedups re-deliveries by rid.
+        self.recent_handoffs: deque = deque(maxlen=64)
         # in-flight cross-worker prefix pulls, RECEIVER side (ISSUE 17):
         # pull_id -> {tokens, num_chunks, chunks} until the stream
         # completes and the pages adopt
@@ -165,6 +198,13 @@ class WorkerLoop:
         mtype = msg.get("type")
         payload = msg.get("payload", {})
         if mtype == "adopt":
+            if faults.fire(FAULT_DECODE_REJECT) is not None:
+                rids = [int(rec["request_id"])
+                        for rec in payload.get("recs", [])]
+                if rids:
+                    self.chan.send("reject", rids=rids,
+                                   error="decode_reject fault armed")
+                return
             # one rec at a time: a batch adopt that failed mid-way
             # would leave the already-restored records running in this
             # engine while the supervisor re-lands them elsewhere —
@@ -236,6 +276,26 @@ class WorkerLoop:
                            num_chunks=len(chunks))
             for ch in chunks:
                 self.chan.send("kv_page", pull_id=pull_id, **ch)
+                if faults.fire(FAULT_HANDOFF_PARTIAL) is not None:
+                    # die -9 with only part of the stream shipped: the
+                    # chaos case the handoff state machine must survive
+                    os.kill(os.getpid(), signal.SIGKILL)
+        elif mtype == "kv_abort":
+            # supervisor gave up on this pull (timeout/death): drop the
+            # intake buffer. Host-side dicts only — no KV pages were
+            # allocated before adoption, so nothing can leak.
+            self._kv_intake.pop(payload.get("pull_id", 0), None)
+        elif mtype == "kv_release":
+            # DONOR-side release after the decode worker confirmed
+            # adoption (handoff phase 4): demote (default) or drop the
+            # shipped prefix so it becomes the coldest eviction victim
+            # instead of squatting on the pool
+            try:
+                self.engine.release_prefix(
+                    [int(t) for t in payload.get("tokens", [])],
+                    drop=bool(payload.get("drop", False)))
+            except Exception:                             # noqa: BLE001
+                pass    # hygiene only — never kill the worker over it
         elif mtype == "kv_prefix":
             # RECEIVER side: open the intake buffer (an empty pull —
             # the donor held nothing — completes immediately)
@@ -293,6 +353,18 @@ class WorkerLoop:
             if req is None or req.state is RequestState.FINISHED:
                 self.live.discard(rid)
                 self.sent_counts.pop(rid, None)
+                if req is not None and req.finish_reason == "handoff":
+                    # prefill-role completion (ISSUE 18): the request
+                    # is NOT finished fleet-wide — ship the prefill
+                    # result up for the supervisor to drive the
+                    # kv_pull + decode-side adoption
+                    ho = {"rid": int(rid),
+                          "output_ids": [int(t)
+                                         for t in req.output_ids],
+                          "prefix_len": int(req.handoff_prefix_len)}
+                    self.recent_handoffs.append(ho)
+                    self.chan.send("prefill_done", **ho)
+                    continue
                 fin = {"rid": int(rid),
                        "reason": (req.finish_reason if req is not None
                                   else "lost"),
@@ -318,7 +390,8 @@ class WorkerLoop:
             # drain/failure snapshots
             snapshot=self.engine.snapshot(reason="heartbeat",
                                           include_recorder=False),
-            recent_finished=list(self.recent_finished))
+            recent_finished=list(self.recent_finished),
+            recent_handoffs=list(self.recent_handoffs))
         return True
 
     # ---- lifecycle -------------------------------------------------------

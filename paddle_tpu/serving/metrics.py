@@ -111,6 +111,13 @@ class ServingMetrics:
             "radix_evict_dropped": 0,      # eviction rung: dropped
             "kv_pages_exported": 0,        # fleet pull, donor side
             "kv_pages_adopted": 0,         # fleet pull, receiver side
+            # --- disaggregated prefill/decode (ISSUE 18) ---
+            # prefill-role engines: requests finished "handoff" (pages
+            # donated for the fleet's kv_pull) and pages released
+            # (demoted-to-coldest or dropped) after the decode side
+            # confirmed adoption
+            "prefill_handoffs": 0,
+            "kv_pages_released": 0,
             "host_spill_corrupt": 0,       # CRC reject -> recompute
             "host_spill_slow": 0,          # deadline miss -> retry later
             "host_spill_lost": 0,          # buffer gone -> recompute

@@ -132,6 +132,14 @@ class Request:
         self.deadline: Optional[float] = None
         # set by ServingEngine.abort(); honored at the next boundary
         self.aborted = False
+        # --- disaggregated prefill/decode (ISSUE 18) ---
+        # colocate=True pins the request to local decode even on a
+        # prefill-role engine (the fleet's role-starved fallback);
+        # handoff_prefix_len records the block-aligned token span
+        # donated by finish_handoff — the span the fleet's kv_pull
+        # ships to the decode-role adopter
+        self.colocate = False
+        self.handoff_prefix_len = 0
 
     # prompt the next prefill must process (original prompt + anything
     # generated before a preemption — recompute-style resume)
@@ -440,6 +448,23 @@ class Scheduler:
         req.state = RequestState.DECODE
         self.running.append(req)
         self.running.sort(key=lambda r: r.arrival)
+
+    def finish_handoff(self, req: Request) -> int:
+        """Finish a just-prefilled request for cross-worker handoff
+        (ISSUE 18): its computed pages donate to the radix tree exactly
+        like any finish, and the return value is the block-aligned
+        token count of the donated span — the single source for how
+        many tokens of `prompt+output` the fleet's kv_pull can ship.
+        0 when nothing donates (no prefix cache, or a sub-page
+        prompt): the decode side then simply re-prefills."""
+        ids = req.prompt_ids + req.output_ids
+        n = min(req.num_computed, len(ids),
+                req.seq.num_tokens if req.seq is not None else 0)
+        full = (n // self.allocator.page_size) * self.allocator.page_size
+        if self.prefix_cache is None:
+            full = 0
+        self.finish(req, "handoff", donate=True)
+        return full
 
     def finish(self, req: Request, reason: str, donate: bool = True):
         if req in self.running:

@@ -28,9 +28,14 @@ storms, draft storms, radix donation failure, the fleet points
 `transport.stall` on the mailbox channel, `worker.kill9` (SIGKILL of
 the worker's own process; armed INSIDE the worker via its spec — the
 registry is per-process), and `cache.corrupt_entry` on the persistent
-compile cache's read path. `bench.py` uses the BENCH_FAULT_INJECT env
-var instead — its supervisor must stay importable without this
-package.
+compile cache's read path. The disaggregated prefill/decode tier
+(ISSUE 18) adds `fleet.handoff_partial` (donor SIGKILLs itself after
+each armed kv_page send — mid-stream death), `fleet.handoff_stall`
+(the supervisor's kv frame relay eats the frame — phase-deadline
+trigger; host-armed) and `fleet.decode_reject` (the adopt handler
+refuses the batch with a typed reject). `bench.py` uses the
+BENCH_FAULT_INJECT env var instead — its supervisor must stay
+importable without this package.
 """
 from __future__ import annotations
 

@@ -1,0 +1,473 @@
+"""Disaggregated prefill/decode fleet (ISSUE 18).
+
+Layers under test, bottom up:
+
+* the central capability table (`serving/errors.py`): every refusal the
+  engine used to scatter is one typed `UnsupportedFeature` row;
+* prefill-role engine semantics: a request finishes with reason
+  "handoff" after its last prefill chunk + first token, its pages
+  donated to the radix and `handoff_prefix_len` naming the pullable
+  block-aligned prefix; `colocate` bypasses the handoff;
+  `release_prefix` demotes (or drops) a shipped prefix;
+* worker protocol: `prefill_done` ships instead of `finish` (and rides
+  heartbeats via `recent_handoffs`), `kv_abort` drops the intake,
+  `fleet.decode_reject` refuses an adopt with a typed reject;
+* the PR-16 `kv_pull` stream under `transport.drop` / `.duplicate` /
+  `.stall` faults — every degradation leaves BOTH pools clean (the
+  satellite-3 coverage: the loopback test only covered the clean path);
+* cross-process: a 1 prefill + 1 decode fleet streams bit-identical to
+  an in-process engine with pages actually shipped, and a role-starved
+  fleet (prefill worker only) degrades to co-located execution instead
+  of shedding.
+
+The heavyweight chaos ladder (kill -9 mid-handoff, decode death
+mid-adopt, stalls, 3 seeds, TPOT comparison) lives in
+`tools/soak_fleet.py --disagg` / `make soak-disagg`.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import ProcessFleet, ServingEngine
+from paddle_tpu.serving.errors import (FEATURE_CONFLICTS,
+                                       UnsupportedFeature,
+                                       check_feature_conflicts)
+from paddle_tpu.serving.fleet.router import role_candidates
+from paddle_tpu.serving.fleet.transport import (Channel, bind_store,
+                                                free_port)
+from paddle_tpu.serving.fleet.worker import WorkerLoop
+from paddle_tpu.utils import faults
+
+from _env_probes import skip_unless, subprocess_workers
+
+CFG = dict(vocab_size=128, hidden_size=128, intermediate_size=256,
+           num_hidden_layers=2, num_attention_heads=2,
+           num_key_value_heads=1, max_position_embeddings=128)
+ENG = dict(num_pages=40, page_size=8, token_budget=48, batch_buckets=[8],
+           prefill_buckets=[32], pages_buckets=[8], temperature=0.0)
+# prompts long enough that the prefill side donates >= 2 full pages
+# (page_size 8), so the handoff has real KV to ship
+PROMPTS = [(list(range(1, 21)), 6),
+           ([5, 5, 5, 5] + list(range(40, 56)), 5),
+           (list(range(100, 118)), 7)]
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    faults.reset_counts()
+    yield
+    faults.clear()
+    faults.reset_counts()
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    return LlamaForCausalLM(LlamaConfig(**CFG))
+
+
+@pytest.fixture(scope="module")
+def reference(model, tmp_path_factory):
+    """In-process token streams + a warm compile-cache dir; every
+    disaggregated assertion compares against these."""
+    ccdir = str(tmp_path_factory.mktemp("disagg_cc"))
+    eng = ServingEngine(model, compile_cache=ccdir, **ENG)
+    rids = [eng.add_request(p, max_new_tokens=m) for p, m in PROMPTS]
+    out = eng.run()
+    eng.save_compile_cache()
+    eng.shutdown()
+    return {"streams": [out[r] for r in rids], "ccdir": ccdir}
+
+
+# ---------------------------------------- capability table (satellite)
+def test_capability_table_typed_refusals(model):
+    """Every scattered refusal is now ONE table; the raise is typed
+    (UnsupportedFeature subclasses ValueError for old callers) and
+    carries the conflicting pair."""
+    from paddle_tpu.serving.spec import NgramProposer
+    with pytest.raises(UnsupportedFeature) as ei:
+        ServingEngine(model, role="prefill", proposer=NgramProposer(),
+                      **ENG)
+    assert ei.value.features == ("prefill_role", "proposer")
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        ServingEngine(model, role="prefill", decode_steps=2, **ENG)
+    with pytest.raises(UnsupportedFeature):
+        ServingEngine(model, role="prefill", enable_prefix_cache=False,
+                      **ENG)
+    # the checker itself is deterministic and pairwise-complete
+    for pair in FEATURE_CONFLICTS:
+        with pytest.raises(UnsupportedFeature) as ei:
+            check_feature_conflicts(pair)
+        assert ei.value.features == tuple(sorted(pair))
+    check_feature_conflicts(set())       # empty active set passes
+    check_feature_conflicts({"lora"})    # single features always pass
+    with pytest.raises(ValueError, match="role"):
+        ServingEngine(model, role="bogus", **ENG)
+
+
+def test_role_candidates_filter_and_fallback():
+    class W:
+        def __init__(self, role):
+            self.role = role
+
+    ws = [W("prefill"), W("decode"), W("both")]
+    assert [w.role for w in role_candidates(ws, "prefill")] == \
+        ["prefill", "both"]
+    assert [w.role for w in role_candidates(ws, "decode")] == \
+        ["decode", "both"]
+    # starved roles FALL BACK to the full candidate list (degrade to
+    # co-located execution, never shed)
+    only_p = [W("prefill")]
+    assert role_candidates(only_p, "decode") == only_p
+    with pytest.raises(KeyError):
+        role_candidates(ws, "bogus")
+
+
+# ------------------------------------------- engine handoff semantics
+def test_prefill_role_engine_hands_off(model):
+    eng = ServingEngine(model, role="prefill", **ENG)
+    ref = ServingEngine(model, **ENG)
+    try:
+        prompt, m = PROMPTS[0]
+        rid_ref = ref.add_request(prompt, max_new_tokens=m)
+        want = ref.run()[rid_ref]
+
+        rid = eng.add_request(prompt, max_new_tokens=m)
+        steps = 0
+        while eng.has_work() and steps < 200:
+            eng.step()
+            steps += 1
+        req = eng.requests[rid]
+        assert req.finish_reason == "handoff"
+        # first token(s) emitted, never the full decode
+        assert 1 <= len(req.output_ids) < m
+        assert list(req.output_ids) == want[:len(req.output_ids)]
+        # the donated prefix is block-aligned and pullable
+        ps = ENG["page_size"]
+        assert req.handoff_prefix_len == (len(prompt) // ps) * ps
+        toks = (prompt + list(req.output_ids))[:req.handoff_prefix_len]
+        n, payloads = eng.export_prefix(toks)
+        assert n == req.handoff_prefix_len
+        assert len(payloads) == req.handoff_prefix_len // ps
+        assert eng.metrics.counters["prefill_handoffs"] == 1
+
+        # colocate bypasses the handoff: the SAME engine decodes it
+        rec = {"request_id": 777, "prompt_ids": prompt,
+               "output_ids": [], "max_new_tokens": m,
+               "eos_token_id": None, "num_preemptions": 0,
+               "aborted": False, "adapter": None, "colocate": True,
+               "deadline_remaining_s": None}
+        eng.adopt_requests([rec])
+        out = eng.run()[777]
+        assert out == want
+        assert eng.requests[777].finish_reason in ("stop", "length")
+    finally:
+        eng.shutdown()
+        ref.shutdown()
+
+
+def test_release_prefix_demote_then_drop(model):
+    eng = ServingEngine(model, **ENG)
+    try:
+        prompt, m = PROMPTS[2]
+        eng.add_request(prompt, max_new_tokens=m)
+        eng.run()
+        ps = ENG["page_size"]
+        toks = prompt[:(len(prompt) // ps) * ps]
+        assert eng.radix.match_len(toks) == len(toks)
+        used0 = eng.allocator.num_used
+        # demote (default): pages stay matchable — a later shared-
+        # prefix request must still hit — but become the coldest LRU.
+        # Node-granular: the chain's tail node may extend past the
+        # requested cut, so >= the page count of the named prefix.
+        released = eng.release_prefix(toks)
+        assert released >= len(toks) // ps
+        assert eng.allocator.num_used == used0          # nothing freed
+        assert eng.radix.match_len(toks) == len(toks)   # still cached
+        assert eng.metrics.counters["kv_pages_released"] == released
+        # drop: childless chain nodes actually free their pages
+        dropped = eng.release_prefix(toks, drop=True)
+        assert dropped >= 1
+        assert eng.allocator.num_used == used0 - dropped
+        eng.radix.check_invariants()
+        # unknown tokens release nothing, never raise
+        assert eng.release_prefix([99, 98, 97]) == 0
+        eng.reset_prefix_cache()
+        assert eng.allocator.num_used == 0
+    finally:
+        eng.shutdown()
+
+
+# ------------------------------------------------ worker loop protocol
+@pytest.fixture(scope="module")
+def store():
+    return bind_store(f"127.0.0.1:{free_port()}")
+
+
+def _worker(model, store, name, session, **extra):
+    eng = ServingEngine(model, **dict(ENG, **extra))
+    chan = Channel(store, me=name, peer="host", session=session)
+    host_side = Channel(store, me="host", peer=name, session=session)
+    return eng, WorkerLoop(eng, chan, heartbeat_interval_s=1e9), host_side
+
+
+def test_worker_ships_prefill_done_not_finish(model, store):
+    eng, loop, host = _worker(model, store, "p0", "dga",
+                              role="prefill")
+    try:
+        prompt, m = PROMPTS[0]
+        rec = {"request_id": 5, "prompt_ids": prompt, "output_ids": [],
+               "max_new_tokens": m, "eos_token_id": None,
+               "num_preemptions": 0, "aborted": False, "adapter": None,
+               "colocate": False, "deadline_remaining_s": None}
+        loop.handle({"type": "adopt", "payload": {"recs": [rec]}})
+        steps = 0
+        while eng.has_work() and steps < 200:
+            loop.step_once()
+            steps += 1
+        frames = host.recv_all()
+        types = [f["type"] for f in frames]
+        assert "prefill_done" in types
+        assert "finish" not in types        # NOT finished fleet-wide
+        done = [f for f in frames if f["type"] == "prefill_done"][0]
+        assert done["payload"]["rid"] == 5
+        assert len(done["payload"]["output_ids"]) >= 1
+        assert done["payload"]["prefix_len"] == \
+            (len(prompt) // ENG["page_size"]) * ENG["page_size"]
+        # ... and the completion rides heartbeats for wire-loss healing
+        assert list(loop.recent_handoffs) == [done["payload"]]
+        assert not loop.recent_finished
+        loop.heartbeat(force=True)
+        hb = [f for f in host.recv_all() if f["type"] == "heartbeat"][0]
+        assert hb["payload"]["recent_handoffs"] == [done["payload"]]
+    finally:
+        eng.shutdown()
+
+
+def test_worker_kv_abort_and_release(model, store):
+    eng, loop, host = _worker(model, store, "d0", "dgb")
+    try:
+        # open an intake, then abort it mid-stream: buffer dropped,
+        # late frames of the aborted pull are ignored
+        loop.handle({"type": "kv_prefix",
+                     "payload": {"pull_id": 3, "tokens": [1, 2, 3],
+                                 "num_chunks": 2}})
+        assert 3 in loop._kv_intake
+        loop.handle({"type": "kv_abort", "payload": {"pull_id": 3}})
+        assert not loop._kv_intake
+        loop.handle({"type": "kv_page",
+                     "payload": {"pull_id": 3, "idx": 0, "part": 0,
+                                 "parts": 1, "data": "AAAA"}})
+        assert not loop._kv_intake
+        assert not host.recv_all()          # no kv_adopted for aborts
+        assert eng.allocator.num_used == 0
+
+        # kv_release demotes a cached prefix on the donor
+        prompt, m = PROMPTS[1]
+        eng.add_request(prompt, max_new_tokens=m)
+        eng.run()
+        ps = ENG["page_size"]
+        toks = prompt[:(len(prompt) // ps) * ps]
+        loop.handle({"type": "kv_release", "payload": {"tokens": toks}})
+        assert eng.metrics.counters["kv_pages_released"] >= 1
+        assert eng.radix.match_len(toks) == len(toks)   # demoted, kept
+        loop.handle({"type": "kv_release",
+                     "payload": {"tokens": toks, "drop": True}})
+        eng.radix.check_invariants()
+        eng.reset_prefix_cache()
+        assert eng.allocator.num_used == 0
+    finally:
+        eng.shutdown()
+
+
+def test_worker_decode_reject_fault(model, store):
+    eng, loop, host = _worker(model, store, "d1", "dgc")
+    try:
+        rec = {"request_id": 9, "prompt_ids": [1, 2, 3],
+               "output_ids": [], "max_new_tokens": 2,
+               "eos_token_id": None, "num_preemptions": 0,
+               "aborted": False, "adapter": None, "colocate": False,
+               "deadline_remaining_s": None}
+        with faults.injected("fleet.decode_reject", payload=True,
+                             times=1):
+            loop.handle({"type": "adopt", "payload": {"recs": [rec]}})
+            frames = host.recv_all()
+            assert [f["type"] for f in frames] == ["reject"]
+            assert frames[0]["payload"]["rids"] == [9]
+            assert 9 not in eng.requests
+            # the fault is consumed: the next adopt succeeds
+            loop.handle({"type": "adopt", "payload": {"recs": [rec]}})
+            assert [f["type"] for f in host.recv_all()] == ["adopted"]
+        assert faults.fired_counts().get("fleet.decode_reject") == 1
+        eng.abort(9)
+        eng.run()
+    finally:
+        eng.shutdown()
+
+
+# -------------------- kv_pull under transport faults (satellite 3)
+def _pull_frames(eng, loop, host, pull_id, tokens):
+    loop.handle({"type": "kv_pull",
+                 "payload": {"pull_id": pull_id, "tokens": tokens}})
+    return host.recv_all()
+
+
+def test_kv_pull_under_transport_faults(model, store):
+    """drop: the stream wedges (incomplete intake) and kv_abort cleans
+    it; duplicate: reassembly refuses and the adoption degrades to 0;
+    stall: a transient wedge heals by itself. ZERO page leaks on both
+    pools in every case — the stats-probe reclamation check."""
+    rng = np.random.RandomState(4)
+    shared = rng.randint(0, 128, (24,)).tolist()
+    eng0, loop0, host0 = _worker(model, store, "don", "dgf")
+    eng1, loop1, host1 = _worker(model, store, "rcv", "dgf")
+    try:
+        eng0.add_request(shared + [1, 2], max_new_tokens=4)
+        eng0.run()
+
+        # ---- transport.drop eats one kv_page at the host relay ------
+        with faults.injected("transport.drop", payload=True, after=1,
+                             times=1):
+            frames = _pull_frames(eng0, loop0, host0, 1, shared)
+        hdr = frames[0]["payload"]
+        assert hdr["num_chunks"] >= 2
+        assert len(frames) == 1 + hdr["num_chunks"] - 1   # one eaten
+        for fr in frames:
+            loop1.handle(fr)
+        assert not host1.recv_all()       # intake incomplete: no adopt
+        assert 1 in loop1._kv_intake
+        loop1.handle({"type": "kv_abort", "payload": {"pull_id": 1}})
+        assert not loop1._kv_intake
+        assert eng1.allocator.num_used == 0
+        assert faults.fired_counts().get("transport.drop") == 1
+
+        # ---- transport.duplicate: reassembly refuses, adopts 0 ------
+        with faults.injected("transport.duplicate", payload=True,
+                             after=1, times=1):
+            frames = _pull_frames(eng0, loop0, host0, 2, shared)
+        assert len(frames) == 1 + hdr["num_chunks"] + 1   # one doubled
+        for fr in frames:
+            loop1.handle(fr)
+        reply = host1.recv_all()
+        assert [r["type"] for r in reply] == ["kv_adopted"]
+        assert reply[0]["payload"]["adopted_pages"] == 0
+        assert "error" in reply[0]["payload"]
+        assert eng1.allocator.num_used == 0
+        eng1.allocator.check_invariants()
+
+        # ---- transport.stall: transient wedge, then heals -----------
+        with faults.injected("transport.stall", payload=True, times=1):
+            first = host0.recv_all()      # wedged: reads nothing
+            loop0.handle({"type": "kv_pull",
+                          "payload": {"pull_id": 3, "tokens": shared}})
+            frames = host0.recv_all()     # healed: full stream
+        assert first == []
+        assert [f["type"] for f in frames] == \
+            ["kv_prefix"] + ["kv_page"] * frames[0]["payload"]["num_chunks"]
+        for fr in frames:
+            loop1.handle(fr)
+        reply = host1.recv_all()
+        assert reply[0]["payload"]["adopted_pages"] == \
+            frames[0]["payload"]["num_pages"]
+
+        # ---- reclamation on BOTH pools ------------------------------
+        for e in (eng0, eng1):
+            e.radix.check_invariants()
+            e.reset_prefix_cache()
+            assert e.allocator.num_used == 0
+            e.allocator.check_invariants()
+    finally:
+        eng0.shutdown()
+        eng1.shutdown()
+
+
+# ---------------------------------------------- cross-process fleets
+def _wait_ready(pf, timeout=90.0):
+    t0 = time.monotonic()
+    while not all(w.ready for w in pf.workers.values()):
+        pf.pump()
+        if time.monotonic() - t0 > timeout:
+            raise AssertionError(
+                f"workers not ready: "
+                f"{ {n: w.state.value for n, w in pf.workers.items()} }")
+        time.sleep(0.01)
+
+
+@skip_unless(subprocess_workers)
+def test_disagg_fleet_bit_identical(reference, tmp_path):
+    """1 prefill + 1 decode worker: streams bit-identical to the
+    in-process engine, KV pages actually shipped, both pools clean."""
+    base = {"model": {"kind": "llama", "config": CFG, "seed": 0},
+            "engine": ENG, "heartbeat_interval_s": 0.03,
+            "compile_cache_dir": reference["ccdir"]}
+    specs = {"p0": dict(base, role="prefill"),
+             "d0": dict(base, role="decode")}
+    pf = ProcessFleet(specs, dead_after_s=30.0,
+                      stderr_dir=str(tmp_path / "logs"))
+    try:
+        _wait_ready(pf)
+        assert pf.workers["p0"].role == "prefill"
+        handles = [pf.submit(p, max_new_tokens=m) for p, m in PROMPTS]
+        # role-aware admission: everything starts on the prefill worker
+        assert all(pf._assign[h.request_id] == "p0" for h in handles)
+        res = pf.run(timeout_s=180)
+        assert [res[h.request_id] for h in handles] == \
+            reference["streams"]
+        assert pf.counters["requests_lost"] == 0
+        assert pf.counters["funnel_conflicts"] == 0
+        assert pf.counters["handoffs_started"] == len(PROMPTS)
+        assert pf.counters["handoffs_completed"] >= 1
+        assert pf.counters["kv_pages_shipped"] >= 2
+        assert pf.counters["handoffs_colocated"] == 0
+        # per-token stamps for the TPOT criterion rode the funnel
+        assert all(len(h.token_ts) == len(h.tokens) for h in handles)
+        # observability: role labels + handoff counters exposed
+        text = pf.prometheus_text()
+        assert 'worker_role{worker="p0",role="prefill"} 1' in text
+        assert 'worker_role{worker="d0",role="decode"} 1' in text
+        assert "fleet_handoffs_completed" in text
+        assert "fleet_kv_pages_shipped" in text
+        assert pf.summary()["worker_roles"] == {"p0": "prefill",
+                                                "d0": "decode"}
+        # full reclamation on BOTH pools via the stats probe
+        for name in pf.workers:
+            st = pf.request_stats(name, reset_prefix_cache=True)
+            assert st is not None
+            assert st.get("radix_ok", True) and st["allocator_ok"], st
+            assert st["kv_used_pages"] == 0, (name, st)
+    finally:
+        pf.shutdown()
+
+
+@pytest.mark.slow
+@skip_unless(subprocess_workers)
+def test_disagg_role_starved_colocates(reference, tmp_path):
+    """No decode-capable worker at all: the handoff degrades to
+    co-located execution on the donor (colocate=True re-adopt, a radix
+    cache hit) instead of shedding — streams still bit-identical."""
+    specs = {"p0": {"model": {"kind": "llama", "config": CFG,
+                              "seed": 0},
+                    "engine": ENG, "heartbeat_interval_s": 0.03,
+                    "compile_cache_dir": reference["ccdir"],
+                    "role": "prefill"}}
+    pf = ProcessFleet(specs, dead_after_s=30.0,
+                      stderr_dir=str(tmp_path / "logs"))
+    try:
+        _wait_ready(pf)
+        handles = [pf.submit(p, max_new_tokens=m) for p, m in PROMPTS]
+        res = pf.run(timeout_s=180)
+        assert [res[h.request_id] for h in handles] == \
+            reference["streams"]
+        assert pf.counters["handoffs_started"] == len(PROMPTS)
+        assert pf.counters["handoffs_colocated"] == len(PROMPTS)
+        assert pf.counters["handoffs_completed"] == 0
+        assert pf.counters["requests_lost"] == 0
+        assert pf.counters["funnel_conflicts"] == 0
+        st = pf.request_stats("p0", reset_prefix_cache=True)
+        assert st["kv_used_pages"] == 0, st
+    finally:
+        pf.shutdown()

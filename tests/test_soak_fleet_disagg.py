@@ -1,0 +1,30 @@
+"""Slow wrapper for the DISAGGREGATED prefill/decode chaos soak
+(ISSUE 18 acceptance): 2 prefill + 2 decode workers with mid-flight KV
+handoff — prefill worker kill -9 with the kv_page stream half shipped,
+decode worker death mid-adopt, supervisor-relay stalls healed by the
+phase-deadline + capped-backoff re-pull, a typed decode_reject, the
+role-starved co-location fallback, the decode-TPOT p99 comparison
+against chunked-prefill co-location, and the int8-KV variant. Every
+pass bit-identical to the in-process co-located reference with full
+page reclamation. Excluded from tier-1 by the `slow` marker; run with
+`make soak-disagg` or `pytest tests/test_soak_fleet_disagg.py -m
+slow`. Gated on the subprocess capability probe. The ladder runs its
+own 3 chaos seeds internally, so one wrapper invocation suffices."""
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from _env_probes import skip_unless, subprocess_workers
+
+
+@pytest.mark.slow
+@skip_unless(subprocess_workers)
+def test_soak_fleet_disagg():
+    from tools import soak_fleet
+    assert soak_fleet.main(["--disagg", "--requests", "64",
+                            "--seed", "0"]) == 0

@@ -156,7 +156,10 @@ step parity 900 tools/chip_parity.py
 #     spec/multi-step/TP/LoRA probes + the tiered-KV spill probe
 #     (ISSUE 17: forced-spill cached-token rate vs HBM-only, identity
 #     hard-gated, first real-relay run of the promotion host->device
-#     copy)
+#     copy) + the DISAGG probe (ISSUE 18, staged chip-blind: the
+#     prefill-role handoff -> export -> codec round trip -> adopt ->
+#     decode path has only run on CPU; first chip run exercises the
+#     exported page bytes through device fetch + host re-upload)
 step serving 1500 tools/chip_serving.py
 
 # 2e. BASELINE config ladder: ResNet/ERNIE/DiT/Qwen2-MoE train steps

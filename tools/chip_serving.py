@@ -618,4 +618,78 @@ print(f"TIERED_KV_CHIP_OK cached_on={sp_on_snap['cached_tokens_served']} "
       f"cached_off={sp_off_snap['cached_tokens_served']} "
       f"corrupt_recomputes={sp_chaos_snap['host_spill_corrupt']}")
 
+# --- disaggregated prefill/decode probe (ISSUE 18) ---------------------
+# The handoff round trip ON the real chip, in ONE process (the chip's
+# single-process rule forbids spawning role workers here, so this
+# drives the same engine-level machinery the fleet supervisor
+# orchestrates): a prefill-role engine runs admission + chunked
+# prefill + first token and finishes "handoff"; the donated prefix
+# exports, rides the real chunk/join payload codec (FRAME_CAP
+# chunking, CRC per page), and a SECOND engine adopts the pages and
+# streams the rest. Bit-identity vs the co-located engine is a HARD
+# gate everywhere (single-bucket grid + greedy: the adopted
+# continuation replays the preemption-resume path); on chip this is
+# the first time the exported page bytes round-trip through device
+# fetch + host re-upload over the real relay.
+from paddle_tpu.serving.fleet.transport import (chunk_payloads,
+                                                join_payloads)
+
+DG_KW = dict(num_pages=48, page_size=16, token_budget=64,
+             batch_buckets=[8], prefill_buckets=[64], pages_buckets=[8],
+             temperature=0.0)
+dg_rng = np.random.RandomState(18)
+DG_WORK = [(dg_rng.randint(0, cfg.vocab_size, (dg_rng.randint(32, 48),))
+            .tolist(), 12) for _ in range(8)]
+
+dg_ref_eng = ServingEngine(model, **DG_KW)
+dg_ref_rids = [dg_ref_eng.add_request(p, max_new_tokens=m)
+               for p, m in DG_WORK]
+dg_t0 = time.perf_counter()
+dg_ref = dg_ref_eng.run()
+dg_coloc_wall = time.perf_counter() - dg_t0
+dg_ref_eng.shutdown()
+
+dg_pre = ServingEngine(model, role="prefill", **DG_KW)
+dg_dec = ServingEngine(model, **DG_KW)
+dg_t0 = time.perf_counter()
+dg_rids = [dg_pre.add_request(p, max_new_tokens=m) for p, m in DG_WORK]
+while dg_pre.has_work():
+    dg_pre.step()
+dg_shipped = 0
+dg_recs = []
+for (p, m), rid in zip(DG_WORK, dg_rids):
+    req = dg_pre.requests[rid]
+    assert req.finish_reason == "handoff", req.finish_reason
+    toks = (p + list(req.output_ids))[:req.handoff_prefix_len]
+    n, payloads = dg_pre.export_prefix(toks)
+    assert n == req.handoff_prefix_len, (n, req.handoff_prefix_len)
+    adopted = dg_dec.adopt_prefix(
+        toks[:n], join_payloads(chunk_payloads(payloads)))
+    assert adopted == len(payloads), (adopted, len(payloads))
+    dg_shipped += adopted
+    dg_pre.release_prefix(toks[:n])
+    dg_recs.append({"request_id": rid, "prompt_ids": p,
+                    "output_ids": list(req.output_ids),
+                    "max_new_tokens": m, "eos_token_id": None,
+                    "num_preemptions": 0, "aborted": False,
+                    "adapter": None, "colocate": False,
+                    "deadline_remaining_s": None})
+dg_dec.adopt_requests(dg_recs)
+dg_out = dg_dec.run()
+dg_wall = time.perf_counter() - dg_t0
+# adopted records fold the pre-handoff tokens back in, so the decode
+# engine's output IS the full stream
+assert [dg_out[r] for r in dg_rids] == \
+    [dg_ref[r] for r in dg_ref_rids], \
+    "disaggregated handoff changed greedy tokens"
+assert dg_pre.metrics.counters["prefill_handoffs"] == len(DG_WORK)
+assert dg_dec.metrics.counters["kv_pages_adopted"] == dg_shipped
+for e in (dg_pre, dg_dec):
+    e.reset_prefix_cache()
+    assert e.allocator.num_used == 0
+    e.shutdown()
+print(f"DISAGG_CHIP_OK pages_shipped={dg_shipped} "
+      f"handoffs={len(DG_WORK)} coloc_wall={dg_coloc_wall:.3f}s "
+      f"disagg_wall={dg_wall:.3f}s")
+
 print("CHIP_SERVING_ALL_OK")

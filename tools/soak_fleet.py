@@ -58,6 +58,32 @@ mailbox —
 * rolling restart: drain -> respawn -> adopt with exactly-once
   delivery, the successor warm-starting from the disk cache (zero
   recompiles), heartbeat gaps visible in the Prometheus text.
+
+`--disagg` (ISSUE 18, `make soak-disagg`) runs the DISAGGREGATED
+prefill/decode ladder: 2 prefill-role + 2 decode-role workers with
+mid-flight KV handoff —
+
+* clean pass: a 16-request prefill-heavy mixed load (shared-prefix
+  hits included) streams BIT-IDENTICAL to the in-process co-located
+  reference, with real KV pages shipped (handoffs_completed >= 1);
+* decode-TPOT comparison: the same load on an all-"both" fleet of the
+  SAME size; steady-state decode inter-token-gap p99 (per-token host
+  stamps, first post-handoff gap excluded) must be LOWER on the
+  disaggregated fleet — prefill chunks no longer interleave with
+  decode steps;
+* 3-seed chaos: kill -9 of a prefill worker MID-HANDOFF
+  (fleet.handoff_partial: dies with only part of the kv_page stream
+  shipped), kill -9 of a decode worker mid-decode (its adopted work
+  re-lands on the surviving decode worker), host-armed
+  fleet.handoff_stall (relay frames eaten -> phase timeout -> capped
+  backoff -> re-pull), and a decode_reject refusal — every pass
+  bit-identical, zero lost, zero funnel conflicts, full reclamation
+  on survivors;
+* role-starved fallback: a prefill-only fleet degrades every handoff
+  to co-located execution (handoffs_colocated == streams) instead of
+  shedding;
+* int8-KV variant: the handoff ships quantized pages + scales,
+  bit-identical to the int8 in-process reference.
 """
 from __future__ import annotations
 
@@ -646,6 +672,305 @@ def run_proc_ladder(args):
         shutil.rmtree(ccdir, ignore_errors=True)
 
 
+# ============== disaggregated prefill/decode ladder (ISSUE 18) =============
+
+def make_disagg_workload(n, seed):
+    """Prefill-heavy mixed load: long prompts (2-4 pages, so every
+    handoff has real KV to ship) with the two shared prefixes still in
+    the mix — the bit-identity pass exercises prefix-cache hits ACROSS
+    the handoff, not just cold pulls."""
+    rng = np.random.RandomState(seed + 1000)
+    prefix_a = rng.randint(0, 128, (16,)).tolist()
+    prefix_b = rng.randint(0, 128, (16,)).tolist()
+    work = [(list(prefix_a), 4), (list(prefix_b), 4)]
+    for _ in range(n - 2):
+        u = rng.random()
+        if u < 0.25:
+            p = prefix_a + rng.randint(0, 128,
+                                       (rng.randint(4, 12),)).tolist()
+        elif u < 0.50:
+            p = prefix_b + rng.randint(0, 128,
+                                       (rng.randint(4, 12),)).tolist()
+        else:
+            p = rng.randint(0, 128, (rng.randint(16, 28),)).tolist()
+        work.append((p, int(rng.randint(6, 12))))
+    return work
+
+
+def _decode_tpot_gaps(handles):
+    """Steady-state decode inter-token gaps (seconds) from the per-
+    token host stamps. The FIRST gap is excluded on purpose: in the
+    disaggregated fleet it contains the handoff itself (pull + adopt),
+    in the co-located fleet the post-prefill scheduling seam — TPOT is
+    the steady decode cadence, not the transition. Catch-up bursts
+    (many tokens on one stamp) only happen in chaos passes, so callers
+    measure CLEAN passes only."""
+    gaps = []
+    for h in handles:
+        ts = h.token_ts
+        gaps.extend(b - a for a, b in zip(ts[1:], ts[2:]))
+    return gaps
+
+
+def run_disagg_pass(work, ref, ccdir, *, label, report, roles,
+                    engine_kw=None, worker_faults=None, host_faults=None,
+                    expect=None):
+    """One cross-process pass with role-tagged workers; asserts
+    bit-identity against `ref`, zero loss, zero funnel conflicts and
+    full reclamation on every surviving worker. `roles` maps worker
+    name -> role; `worker_faults` maps worker name -> spec fault list;
+    `host_faults` arms supervisor-side points once workers are ready;
+    `expect(pf)` runs scenario-specific assertions before shutdown.
+    Returns the decode-TPOT gap samples."""
+    from paddle_tpu.serving import EngineOverloaded, ProcessFleet
+    from paddle_tpu.serving.fleet.errors import NoHealthyReplica
+    from paddle_tpu.serving.fleet.procfleet import WorkerState
+
+    kw = dict(engine_kw or ENGINE_KW)
+    specs = {}
+    for name, role in roles.items():
+        specs[name] = {"model": {"kind": "llama", "config": CFG_DICT,
+                                 "seed": 0},
+                       "engine": kw, "heartbeat_interval_s": 0.05,
+                       "compile_cache_dir": ccdir, "role": role}
+        if worker_faults and name in worker_faults:
+            specs[name]["faults"] = worker_faults[name]
+    pf = ProcessFleet(specs, suspect_after_s=PROC_SUSPECT_S,
+                      dead_after_s=PROC_DEAD_S,
+                      handoff_timeout_s=1.0, handoff_backoff_s=0.1,
+                      max_inflight_per_worker=8,
+                      stderr_dir=os.path.join("profiler_log",
+                                              "soak_disagg_workers"))
+    try:
+        t0 = time.monotonic()
+        while not all(w.ready for w in pf.workers.values()):
+            pf.pump()
+            if time.monotonic() - t0 > 120:
+                raise AssertionError(f"[{label}] workers never ready")
+            time.sleep(0.01)
+        for name, kws in (host_faults or {}).items():
+            faults.inject(name, **kws)
+
+        idx_of = {}
+        pending = list(enumerate(work))
+        t0 = time.monotonic()
+        while pending or pf.has_work():
+            submitted = 0
+            while pending and submitted < 4:
+                i, (p, m) = pending[0]
+                try:
+                    h = pf.submit(p, max_new_tokens=m)
+                except (EngineOverloaded, NoHealthyReplica):
+                    break
+                idx_of[h.request_id] = i
+                pending.pop(0)
+                submitted += 1
+            pf.pump()
+            if time.monotonic() - t0 > 600:
+                raise AssertionError(
+                    f"[{label}] failed to drain after 600s; "
+                    f"{pf.summary()}")
+            time.sleep(2e-3)
+
+        handles = [pf.handles[rid] for rid in idx_of]
+        streams = {}
+        for rid, i in idx_of.items():
+            h = pf.handles[rid]
+            assert h.finished, f"[{label}] request {i} never finished"
+            streams[i] = list(h.tokens)
+        diverged = [i for i in streams if streams[i] != ref.get(i)]
+        assert not diverged, \
+            f"[{label}] disaggregated streams diverged from the " \
+            f"co-located reference: {diverged[:10]}"
+        assert pf.counters["requests_lost"] == 0, pf.summary()
+        assert pf.counters["funnel_conflicts"] == 0, pf.summary()
+
+        # every handoff entry resolved — nothing mid-flight at drain
+        assert not pf._handoffs, pf.summary()
+        # let the suspicion ladder resolve before the reclamation sweep
+        t0 = time.monotonic()
+        while any(w.state is WorkerState.SUSPECT
+                  for w in pf.workers.values()):
+            pf.pump()
+            if time.monotonic() - t0 > PROC_DEAD_S * 3:
+                break
+            time.sleep(0.01)
+        for name, w in pf.workers.items():
+            if w.state is not WorkerState.HEALTHY:
+                continue
+            st = pf.request_stats(name, reset_prefix_cache=True)
+            assert st is not None, f"[{label}] no stats from {name}"
+            assert st.get("radix_ok", True) and st["allocator_ok"], st
+            assert st["kv_used_pages"] == 0, \
+                f"[{label}] {name} leaked KV pages: {st}"
+
+        if expect is not None:
+            expect(pf)
+        report[label] = {
+            "streams": len(streams),
+            "worker_states": {n: w.state.value
+                              for n, w in pf.workers.items()},
+            **{k: v for k, v in pf.counters.items() if v},
+        }
+        return _decode_tpot_gaps(handles)
+    finally:
+        faults.clear()
+        faults.reset_counts()
+        pf.shutdown()
+
+
+def run_disagg_ladder(args):
+    """The --disagg entry: co-located reference + TPOT strawman, clean
+    disaggregated pass, 3-seed chaos, role-starved fallback, int8-KV
+    variant. Returns the report dict (AssertionError on violation)."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.serving.fleet.procfleet import WorkerState
+
+    report = {"requests": args.requests, "seed": args.seed,
+              "mode": "disagg"}
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig(**CFG_DICT))
+    n = max(16, args.requests // 4)   # per-pass size; chaos runs 3 seeds
+    ccdir = tempfile.mkdtemp(prefix="soak_dgcc_")
+    try:
+        def reference(work, **ekw):
+            eng = ServingEngine(model, compile_cache=ccdir,
+                                **dict(ENGINE_KW, **ekw))
+            try:
+                out = _drive_engine(eng, work)
+                eng.save_compile_cache()
+            finally:
+                eng.shutdown()
+            return out
+
+        work = make_disagg_workload(n, args.seed)
+        ref = reference(work)
+
+        # ---- co-located strawman (same worker count, all "both"):
+        # the chunked-prefill interference baseline the decode-TPOT
+        # criterion is measured against
+        coloc_roles = {f"w{i}": "both" for i in range(4)}
+        coloc_gaps = run_disagg_pass(
+            work, ref, ccdir, label="coloc", report=report,
+            roles=coloc_roles)
+
+        # ---- clean disaggregated pass: 2 prefill + 2 decode ----------
+        roles = {"p0": "prefill", "p1": "prefill",
+                 "d0": "decode", "d1": "decode"}
+
+        def expect_clean(pf):
+            assert pf.counters["handoffs_started"] >= len(work) - 2, \
+                pf.summary()
+            assert pf.counters["handoffs_completed"] >= 1, pf.summary()
+            assert pf.counters["kv_pages_shipped"] >= 2, pf.summary()
+            assert pf.counters["handoffs_colocated"] == 0, pf.summary()
+            text = pf.prometheus_text()
+            assert 'role="prefill"' in text and 'role="decode"' in text
+            assert "fleet_kv_pages_shipped" in text
+
+        disagg_gaps = run_disagg_pass(
+            work, ref, ccdir, label="disagg_clean", report=report,
+            roles=roles, expect=expect_clean)
+
+        # ---- decode-TPOT criterion -----------------------------------
+        p99 = lambda g: float(np.percentile(np.asarray(g), 99))  # noqa: E731
+        tpot = {"coloc_p99_ms": round(p99(coloc_gaps) * 1e3, 3),
+                "disagg_p99_ms": round(p99(disagg_gaps) * 1e3, 3),
+                "coloc_samples": len(coloc_gaps),
+                "disagg_samples": len(disagg_gaps)}
+        tpot["ratio"] = round(tpot["coloc_p99_ms"]
+                              / max(tpot["disagg_p99_ms"], 1e-9), 2)
+        report["decode_tpot"] = tpot
+        assert tpot["disagg_p99_ms"] < tpot["coloc_p99_ms"], \
+            f"decode TPOT p99 not improved by disaggregation: {tpot}"
+
+        # ---- 3-seed chaos ladder -------------------------------------
+        for k in range(3):
+            seed = args.seed + k
+            cwork = make_disagg_workload(n, seed)
+            cref = reference(cwork)
+
+            def expect_chaos(pf):
+                # the prefill worker really died -9 MID-HANDOFF...
+                assert pf.workers["p0"].poll() == -9, \
+                    pf.workers["p0"].poll()
+                assert pf.workers["p0"].state is WorkerState.DEAD
+                # ... and the decode worker mid-decode
+                assert pf.workers["d0"].poll() == -9, \
+                    pf.workers["d0"].poll()
+                # interrupted handoffs degraded instead of wedging:
+                # re-prefilled (refetched / migrated) or re-pulled
+                assert (pf.counters["handoffs_refetched"]
+                        + pf.counters["requests_migrated"]) >= 1, \
+                    pf.summary()
+                # the host-armed stall fired and the state machine
+                # noticed (phase deadline -> backoff -> re-pull)
+                assert faults.fired_counts().get(
+                    "fleet.handoff_stall", 0) >= 1
+                assert pf.counters["handoff_stalls"] >= 1, pf.summary()
+
+            run_disagg_pass(
+                cwork, cref, ccdir, label=f"disagg_chaos_s{seed}",
+                report=report, roles=roles,
+                worker_faults={
+                    # p0: SIGKILL itself with only part of the kv_page
+                    # stream shipped (the mid-flight death)
+                    "p0": [{"point": "fleet.handoff_partial",
+                            "after": k, "times": 1}],
+                    # d0: die mid-decode a little into the run, adopted
+                    # work re-lands on d1
+                    "d0": [{"point": "worker.kill9",
+                            "after": 80 + 40 * k, "times": 1}],
+                    # d1: refuse its first adopt batch (typed reject ->
+                    # supervisor re-routes)
+                    "d1": [{"point": "fleet.decode_reject",
+                            "after": k, "times": 1}],
+                },
+                host_faults={
+                    # eat kv_page frames at the supervisor relay: the
+                    # phase deadline must fire and the pull re-issue.
+                    # after= skips the EARLY relays — those pulls tend
+                    # to resolve through the p0/d0 death branches
+                    # (donor-evacuation / target-reroute), which would
+                    # mask the deadline path this scenario is proving
+                    "fleet.handoff_stall": dict(payload=True,
+                                                after=6 + 2 * k,
+                                                times=2),
+                },
+                expect=expect_chaos)
+
+        # ---- role-starved fallback: prefill-only fleet ---------------
+        def expect_starved(pf):
+            assert pf.counters["handoffs_colocated"] >= len(work) - 2, \
+                pf.summary()
+            assert pf.counters["handoffs_completed"] == 0, pf.summary()
+
+        run_disagg_pass(
+            work, ref, ccdir, label="role_starved", report=report,
+            roles={"p0": "prefill", "p1": "prefill"},
+            expect=expect_starved)
+
+        # ---- int8-KV variant: quantized pages + scales ship ----------
+        i8work = make_disagg_workload(8, args.seed + 7)
+        i8ref = reference(i8work, kv_dtype="int8")
+
+        def expect_int8(pf):
+            assert pf.counters["handoffs_completed"] >= 1, pf.summary()
+            assert pf.counters["kv_pages_shipped"] >= 2, pf.summary()
+
+        run_disagg_pass(
+            i8work, i8ref, ccdir, label="disagg_int8", report=report,
+            roles={"p0": "prefill", "d0": "decode"},
+            engine_kw=dict(ENGINE_KW, kv_dtype="int8"),
+            expect=expect_int8)
+        return report
+    finally:
+        shutil.rmtree(ccdir, ignore_errors=True)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--requests", type=int, default=120)
@@ -653,6 +978,10 @@ def main(argv=None):
     ap.add_argument("--procs", action="store_true",
                     help="run the cross-process chaos ladder "
                          "(ISSUE 14) instead of the in-process soak")
+    ap.add_argument("--disagg", action="store_true",
+                    help="run the disaggregated prefill/decode ladder "
+                         "(ISSUE 18): role-split fleet, mid-flight KV "
+                         "handoff chaos, decode-TPOT comparison")
     ap.add_argument("--trace-out",
                     default=os.path.join("profiler_log",
                                          "soak_fleet_trace.json"),
@@ -667,6 +996,14 @@ def main(argv=None):
         report["wall_s"] = round(time.perf_counter() - t0, 2)
         print(json.dumps(report))
         print("SOAK_FLEET_PROC_OK")
+        return 0
+
+    if args.disagg:
+        t0 = time.perf_counter()
+        report = run_disagg_ladder(args)
+        report["wall_s"] = round(time.perf_counter() - t0, 2)
+        print(json.dumps(report))
+        print("SOAK_FLEET_DISAGG_OK")
         return 0
 
     cfg = LlamaConfig(**CFG_DICT)
