@@ -15,6 +15,20 @@ grant). Rule pack:
                   bodies (static half) + runtime promotions recorded by
                   dy2static and the collective layer (purity.py)
 
+B-series (ISSUE 19) — serving/fleet protocol & consistency:
+
+  B1  cache-key   self.<config> read inside a ProgramCache builder but
+                  absent from the cache-key derivation
+  B2  protocol    mailbox message types sent without a receiver
+                  dispatch arm (and dead arms), across the
+                  worker/procfleet pair via `protocol-peer=` hints
+  B3  fault-point fired-but-unregistered fault points; registered
+                  points missing from SERVING.md's fault table
+  B4  refusal     feature-conflict raises outside serving/errors.py's
+                  FEATURE_CONFLICTS table (ROADMAP item 4)
+  B5  metric      counters/reservoirs referenced but absent from their
+                  exposition registries
+
 CLI: tools/tpu_lint.py (`make lint`). Docs: ANALYSIS.md. Fixture
 corpus: tests/lint_fixtures/ via tests/test_tpu_lint.py.
 
@@ -31,6 +45,9 @@ from . import rules_index_map  # noqa: F401
 from . import rules_blockspec  # noqa: F401
 from . import rules_runtime  # noqa: F401
 from . import rules_purity  # noqa: F401
+from . import rules_cachekey  # noqa: F401
+from . import rules_protocol  # noqa: F401
+from . import rules_serving  # noqa: F401
 from .driver import (  # noqa: F401
     FileContext, iter_python_files, lint_file, lint_paths, lint_source)
 

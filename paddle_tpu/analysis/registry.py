@@ -40,9 +40,21 @@ def all_rules():
     return [_RULES[k] for k in sorted(_RULES)]
 
 
+def _matches(rule, tok):
+    """One selector against one rule: exact id/slug match, or a
+    trailing-`*` prefix glob over rule IDS only (`B*` selects the
+    whole B pack; slugs are excluded from globbing so `B*` cannot
+    surprise-match the A2 slug "blockspec")."""
+    if tok.endswith("*"):
+        return rule.id.lower().startswith(tok[:-1])
+    return rule.id.lower() == tok \
+        or any(s.lower() == tok for s in rule.slugs)
+
+
 def select_rules(tokens=None):
     """Rules whose id OR one of whose slugs matches any token
-    (case-insensitive). tokens=None selects everything."""
+    (case-insensitive; a trailing `*` prefix-globs, so `--rules B*`
+    selects a whole pack). tokens=None selects everything."""
     rules = all_rules()
     if not tokens:
         return rules
@@ -51,13 +63,11 @@ def select_rules(tokens=None):
         # "--rules ," / "--rules ''" must not select NOTHING and pass
         # vacuously — an empty selection is a usage error
         raise ValueError("empty rule selection (no ids/slugs given)")
-    out = []
-    for r in rules:
-        if r.id.lower() in toks or any(s.lower() in toks for s in r.slugs):
-            out.append(r)
-    unknown = toks - {r.id.lower() for r in rules} \
-        - {s.lower() for r in rules for s in r.slugs}
+    out = [r for r in rules if any(_matches(r, t) for t in toks)]
+    unknown = {t for t in toks
+               if not any(_matches(r, t) for r in rules)}
     if unknown:
         raise ValueError(f"unknown rule selector(s): {sorted(unknown)}; "
-                         f"known: {[r.id for r in rules]} + slugs")
+                         f"known: {[r.id for r in rules]} + slugs "
+                         f"(+ prefix globs like B*)")
     return out

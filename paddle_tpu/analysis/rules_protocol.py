@@ -1,0 +1,158 @@
+"""Rule B2 — fleet mailbox protocol exhaustiveness.
+
+The worker/supervisor protocol (serving/fleet/worker.py <->
+serving/fleet/procfleet.py over the transport.py Channel) is a hand-
+grown set of `chan.send("type", ...)` frames dispatched by
+string-compare chains (`mtype = msg.get("type")` ... `elif mtype ==`).
+PR-16's torn-send bug class showed how a frame kind added on one side
+without its receiver arm fails: the seq-hole repair waits
+`hole_timeout_s`, heartbeats heal the visible state, and the missing
+handler is a latency mystery instead of an error. This rule makes the
+asymmetry a lint finding.
+
+Activation is explicit: a file opts in with
+    # tpu-lint-hint: protocol-peer=<filename>
+naming its counterpart (resolved relative to the file; `self` for a
+single-file protocol). Both directions are checked with UNION
+semantics — `Channel.relay` re-sends frames verbatim, so a type
+handled by either side counts as handled, a type sent by either side
+counts as live:
+
+* a type SENT anywhere but handled nowhere -> ERROR (dead letter)
+* a type HANDLED here but sent nowhere    -> WARNING (dead arm)
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from . import astutil
+from .diagnostics import Diagnostic, Severity
+from .registry import register_rule
+
+_PEER_CACHE: dict = {}
+
+
+def _type_vars(tree):
+    """Names assigned from `<x>.get("type")` / `<x>["type"]` — the
+    dispatch variables the if/elif chains compare against."""
+    out = set()
+    for n in ast.walk(tree):
+        if not isinstance(n, ast.Assign) or len(n.targets) != 1 \
+                or not isinstance(n.targets[0], ast.Name):
+            continue
+        v = n.value
+        if isinstance(v, ast.Call) and isinstance(v.func, ast.Attribute) \
+                and v.func.attr == "get" and v.args \
+                and isinstance(v.args[0], ast.Constant) \
+                and v.args[0].value == "type":
+            out.add(n.targets[0].id)
+        elif isinstance(v, ast.Subscript) \
+                and isinstance(v.slice, ast.Constant) \
+                and v.slice.value == "type":
+            out.add(n.targets[0].id)
+    return out
+
+
+def _str_consts(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        yield node.value, node
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for elt in node.elts:
+            yield from _str_consts(elt)
+
+
+def _protocol_sets(tree):
+    """(sent, handled): message-type -> first ast node using it."""
+    sent, handled = {}, {}
+    tvars = _type_vars(tree)
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr == "send" and n.args \
+                and isinstance(n.args[0], ast.Constant) \
+                and isinstance(n.args[0].value, str):
+            sent.setdefault(n.args[0].value, n.args[0])
+        elif isinstance(n, ast.Compare) and len(n.ops) == 1:
+            sides = []
+            if isinstance(n.left, ast.Name) and n.left.id in tvars:
+                sides = n.comparators
+            elif len(n.comparators) == 1 \
+                    and isinstance(n.comparators[0], ast.Name) \
+                    and n.comparators[0].id in tvars:
+                sides = [n.left]
+            if not sides:
+                continue
+            if isinstance(n.ops[0], (ast.Eq, ast.In)):
+                for side in sides:
+                    for val, node in _str_consts(side):
+                        handled.setdefault(val, node)
+    return sent, handled
+
+
+def _peer_sets(path):
+    """Parse the peer file once per lint process; missing/unreadable
+    peers contribute empty sets (the hint then degrades to single-file
+    checking, which only ADDS findings — the conservative direction)."""
+    key = os.path.abspath(path)
+    if key not in _PEER_CACHE:
+        try:
+            with open(key, "r", encoding="utf-8") as f:
+                tree = ast.parse(f.read())
+            _PEER_CACHE[key] = _protocol_sets(tree)
+        except (OSError, SyntaxError, ValueError):
+            _PEER_CACHE[key] = ({}, {})
+    return _PEER_CACHE[key]
+
+
+def _peer_hint(ctx):
+    for kv in ctx.hints.values():
+        if "protocol-peer" in kv:
+            return kv["protocol-peer"]
+    return None
+
+
+@register_rule(
+    "B2", ("protocol",), Severity.ERROR,
+    "mailbox message types sent without a receiver dispatch arm "
+    "(or handled but never sent)")
+def check_protocol(ctx):
+    peer = _peer_hint(ctx)
+    if peer is None:
+        return []
+    sent, handled = _protocol_sets(ctx.tree)
+    if peer == "self" or not os.path.isfile(ctx.path):
+        peer_sent, peer_handled = {}, {}
+        peer_label = "this file"
+    else:
+        peer_path = os.path.join(os.path.dirname(ctx.path), peer)
+        peer_sent, peer_handled = _peer_sets(peer_path)
+        peer_label = peer
+    out = []
+    for mtype, node in sorted(sent.items()):
+        if mtype in handled or mtype in peer_handled:
+            continue
+        out.append(Diagnostic(
+            rule="B2", slug="protocol", severity=Severity.ERROR,
+            path=ctx.path, line=node.lineno, col=node.col_offset,
+            message=(f"message type {mtype!r} is sent here but no "
+                     f"dispatch arm handles it (here or in {peer_label}): "
+                     "the frame rides the seq-numbered stream, burns a "
+                     "hole-repair timeout on loss, and is then silently "
+                     "dropped by the receiver"),
+            hint=f"add an `elif mtype == {mtype!r}:` arm to the "
+                 "receiver's dispatch, or delete the send; "
+                 "`# tpu-lint: protocol-ok` for intentionally "
+                 "fire-and-forget frames"))
+    for mtype, node in sorted(handled.items()):
+        if mtype in sent or mtype in peer_sent:
+            continue
+        out.append(Diagnostic(
+            rule="B2", slug="protocol", severity=Severity.WARNING,
+            path=ctx.path, line=node.lineno, col=node.col_offset,
+            message=(f"dispatch arm for message type {mtype!r} but "
+                     f"nothing (here or in {peer_label}) ever sends it: "
+                     "dead protocol arm"),
+            hint="wire up the sender or delete the arm; "
+                 "`# tpu-lint: protocol-ok` if an external client "
+                 "sends it"))
+    return out

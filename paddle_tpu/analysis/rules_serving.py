@@ -1,0 +1,384 @@
+"""Rules B3/B4/B5 — serving-stack consistency invariants.
+
+B3  fault-point   every `utils/faults.py` point name fired/armed by a
+                  literal must be registered somewhere in the package,
+                  and every `register_point("...")` must appear in
+                  SERVING.md's "Fault injection points" table — doc
+                  drift is a finding (PR-18 registered
+                  `serving.engine.multi_decode_step` without a row).
+B4  refusal       typed feature-conflict refusals live in ONE place:
+                  `serving/errors.py::FEATURE_CONFLICTS` +
+                  `check_feature_conflicts` (ROADMAP item 4). A
+                  `raise UnsupportedFeature(...)` — or a
+                  ValueError/RuntimeError worded like one ("mutually
+                  exclusive", "not supported yet") — anywhere else is
+                  a scattered refusal.
+B5  metric        counters incremented against a class's literal
+                  `self.counters = {...}` registry (or against
+                  `*.metrics.counters`, i.e. ServingMetrics) must use
+                  registered keys; reservoir reads must name a
+                  registered reservoir. The static counterpart of
+                  tests/test_metrics_exposition.py's runtime bijection
+                  — an unregistered key KeyErrors at increment time,
+                  on whatever rare path reaches it.
+
+Cross-file context (the fault registry, SERVING.md, the ServingMetrics
+registry) is discovered by walking UP from the linted file and cached
+per lint process; files outside a repo checkout (fixtures fed through
+lint_source with a fake path) simply skip the cross-file halves.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from . import astutil
+from .diagnostics import Diagnostic, Severity
+from .registry import register_rule
+
+_REG_RE = re.compile(r"register_point\(\s*[\"']([^\"']+)[\"']")
+_DOC_ROW_RE = re.compile(r"^\|\s*`([^`]+)`")
+_CONFLICT_PHRASES = ("mutually exclusive", "not supported yet")
+
+_FAULT_ROOT_CACHE: dict = {}
+_DOC_CACHE: dict = {}
+_METRICS_REG_CACHE: dict = {}
+
+
+def _walk_up(path, candidates, max_up=8):
+    """First existing `<ancestor>/<candidate>` above `path`, or None."""
+    d = os.path.dirname(os.path.abspath(path))
+    for _ in range(max_up):
+        for rel in candidates:
+            cand = os.path.join(d, *rel.split("/"))
+            if os.path.isfile(cand):
+                return cand
+        parent = os.path.dirname(d)
+        if parent == d:
+            break
+        d = parent
+    return None
+
+
+# ------------------------------------------------------------------ B3
+def _registered_points(ctx):
+    """Every `register_point("...")` literal in the package owning
+    `ctx.path` (regex sweep, cached per package root), or None when the
+    file is outside a checkout."""
+    faults_py = _walk_up(ctx.path, ("paddle_tpu/utils/faults.py",
+                                    "utils/faults.py"))
+    if faults_py is None:
+        return None
+    root = os.path.dirname(os.path.dirname(faults_py))
+    if root not in _FAULT_ROOT_CACHE:
+        names = set()
+        for dirpath, dirs, files in os.walk(root):
+            dirs[:] = [d for d in dirs if d not in ("__pycache__", ".git")]
+            for fn in files:
+                if not fn.endswith(".py"):
+                    continue
+                try:
+                    with open(os.path.join(dirpath, fn), "r",
+                              encoding="utf-8") as f:
+                        names.update(_REG_RE.findall(f.read()))
+                except OSError:
+                    continue
+        _FAULT_ROOT_CACHE[root] = names
+    return _FAULT_ROOT_CACHE[root]
+
+
+def _documented_points(ctx):
+    """Point names in SERVING.md's "Fault injection points" table, or
+    None when no SERVING.md is reachable from `ctx.path`."""
+    md = _walk_up(ctx.path, ("SERVING.md",))
+    if md is None:
+        return None
+    if md not in _DOC_CACHE:
+        names, in_section = set(), False
+        try:
+            with open(md, "r", encoding="utf-8") as f:
+                for line in f:
+                    if line.startswith("## "):
+                        in_section = "fault injection points" \
+                            in line.lower()
+                        continue
+                    if in_section:
+                        m = _DOC_ROW_RE.match(line)
+                        if m:
+                            names.add(m.group(1))
+        except OSError:
+            names = set()
+        _DOC_CACHE[md] = names
+    return _DOC_CACHE[md]
+
+
+@register_rule(
+    "B3", ("fault-point",), Severity.ERROR,
+    "fault points fired but never registered / registered but missing "
+    "from SERVING.md's fault table")
+def check_fault_points(ctx):
+    if ctx.is_test:
+        return []
+    local_reg = {}      # name -> defining node (this file)
+    uses = []           # (name, node) for fire/inject/injected literals
+    for n in ast.walk(ctx.tree):
+        if not isinstance(n, ast.Call) or not n.args:
+            continue
+        name = astutil.dotted_name(n.func) or ""
+        leaf = name.split(".")[-1]
+        arg = n.args[0]
+        if not (isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)):
+            continue        # module-constant args are registered by
+            # construction (`FAULT_X = faults.register_point("...")`)
+        if leaf == "register_point" and "faults" in name.split("."):
+            local_reg.setdefault(arg.value, arg)
+        elif leaf in ("fire", "inject", "injected") \
+                and "faults" in name.split("."):
+            uses.append((arg.value, arg))
+    if not local_reg and not uses:
+        return []
+    out = []
+    registered = _registered_points(ctx)
+    if registered is not None:
+        known = registered | set(local_reg)
+        for pname, node in uses:
+            if pname in known:
+                continue
+            out.append(Diagnostic(
+                rule="B3", slug="fault-point", severity=Severity.ERROR,
+                path=ctx.path, line=node.lineno, col=node.col_offset,
+                message=(f"fault point {pname!r} is fired/armed but "
+                         "never registered: fire() silently no-ops and "
+                         "inject() raises KeyError, so the fault "
+                         "coverage this site promises does not exist"),
+                hint="faults.register_point(...) it at import time "
+                     "(and document it in SERVING.md's fault table)"))
+    documented = _documented_points(ctx)
+    if documented is not None:
+        for pname, node in sorted(local_reg.items()):
+            if pname in documented:
+                continue
+            out.append(Diagnostic(
+                rule="B3", slug="fault-point", severity=Severity.ERROR,
+                path=ctx.path, line=node.lineno, col=node.col_offset,
+                message=(f"fault point {pname!r} is registered here but "
+                         "missing from SERVING.md's \"Fault injection "
+                         "points\" table: the soak/resilience contract "
+                         "drifts from the docs"),
+                hint="add a table row (site, armed semantics, "
+                     "trace-visible signal) to SERVING.md"))
+    return out
+
+
+# ------------------------------------------------------------------ B4
+def _raise_text(call):
+    """Best-effort literal text of a raise's first argument (plain
+    string, f-string constants, implicit concatenation)."""
+    if not call.args:
+        return ""
+    arg = call.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    if isinstance(arg, ast.JoinedStr):
+        return "".join(v.value for v in arg.values
+                       if isinstance(v, ast.Constant)
+                       and isinstance(v.value, str))
+    return ""
+
+
+@register_rule(
+    "B4", ("refusal",), Severity.ERROR,
+    "feature-conflict refusals raised outside the central "
+    "FEATURE_CONFLICTS table")
+def check_refusals(ctx):
+    if ctx.is_test:
+        return []
+    # the one legitimate home: the module DEFINING the table (errors.py)
+    for n in ctx.tree.body:
+        if isinstance(n, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "FEATURE_CONFLICTS"
+                for t in n.targets):
+            return []
+    out = []
+    for n in ast.walk(ctx.tree):
+        if not isinstance(n, ast.Raise) or not isinstance(n.exc, ast.Call):
+            continue
+        leaf = (astutil.dotted_name(n.exc.func) or "").split(".")[-1]
+        if leaf == "UnsupportedFeature":
+            why = "raises the typed UnsupportedFeature directly"
+        elif leaf in ("ValueError", "RuntimeError"):
+            text = _raise_text(n.exc).lower()
+            if not any(p in text for p in _CONFLICT_PHRASES):
+                continue
+            why = f"{leaf} worded as a feature-conflict refusal"
+        else:
+            continue
+        out.append(Diagnostic(
+            rule="B4", slug="refusal", severity=Severity.ERROR,
+            path=ctx.path, line=n.lineno, col=n.col_offset,
+            message=(f"scattered feature refusal ({why}): capability "
+                     "conflicts must be declared in serving/errors.py::"
+                     "FEATURE_CONFLICTS and raised through "
+                     "check_feature_conflicts so ONE table defines what "
+                     "this build refuses (ROADMAP item 4)"),
+            hint="add the pair to FEATURE_CONFLICTS and call "
+                 "check_feature_conflicts(active_features) instead; "
+                 "`# tpu-lint: refusal-ok` for non-capability raises "
+                 "that merely share the wording"))
+    return out
+
+
+# ------------------------------------------------------------------ B5
+def _subscript_keys(node):
+    """Literal string key(s) of a subscript: a Constant, or both arms
+    of a constant IfExp (procfleet's `"requests_lost" if ... else ...`
+    idiom)."""
+    s = node.slice
+    if isinstance(s, ast.Constant) and isinstance(s.value, str):
+        return [(s.value, s)]
+    if isinstance(s, ast.IfExp):
+        out = []
+        for arm in (s.body, s.orelse):
+            if isinstance(arm, ast.Constant) and isinstance(arm.value, str):
+                out.append((arm.value, arm))
+        return out
+    return []
+
+
+def _dict_str_keys(node):
+    if not isinstance(node, ast.Dict):
+        return None
+    keys = set()
+    for k in node.keys:
+        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+            keys.add(k.value)
+    return keys
+
+
+def _class_counter_registry(cls):
+    """Literal keys of `self.counters = {...}` (plus
+    `self.counters.update({...})`) in the class, or None when the class
+    declares no literal registry — only classes that OWN a registry are
+    checked, so ad-hoc dict plumbing elsewhere stays out of scope."""
+    keys = None
+    for n in ast.walk(cls):
+        if isinstance(n, (ast.Assign, ast.AnnAssign)):
+            targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute) and t.attr == "counters" \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self":
+                    found = _dict_str_keys(n.value)
+                    if found is not None:
+                        keys = (keys or set()) | found
+        elif isinstance(n, ast.Call):
+            name = astutil.dotted_name(n.func) or ""
+            if name == "self.counters.update" and n.args:
+                found = _dict_str_keys(n.args[0])
+                if found is not None:
+                    keys = (keys or set()) | found
+    return keys
+
+
+def _serving_metrics_registry(ctx):
+    """ServingMetrics' counter registry, parsed once from the
+    serving/metrics.py reachable above `ctx.path` (None off-checkout)."""
+    mpath = _walk_up(ctx.path, ("paddle_tpu/serving/metrics.py",
+                                "serving/metrics.py", "metrics.py"))
+    if mpath is None:
+        return None
+    if mpath not in _METRICS_REG_CACHE:
+        reg = None
+        try:
+            with open(mpath, "r", encoding="utf-8") as f:
+                tree = ast.parse(f.read())
+            for cls in ast.walk(tree):
+                if isinstance(cls, ast.ClassDef) \
+                        and cls.name == "ServingMetrics":
+                    reg = _class_counter_registry(cls)
+        except (OSError, SyntaxError, ValueError):
+            reg = None
+        _METRICS_REG_CACHE[mpath] = reg
+    return _METRICS_REG_CACHE[mpath]
+
+
+def _metric_diag(ctx, key, node, registry_desc):
+    return Diagnostic(
+        rule="B5", slug="metric", severity=Severity.ERROR,
+        path=ctx.path, line=node.lineno, col=node.col_offset,
+        message=(f"counter {key!r} is not registered in "
+                 f"{registry_desc}: the increment KeyErrors at runtime "
+                 "on whatever rare path reaches it, and the exposition "
+                 "layer never reports the metric"),
+        hint="add the key (zero-initialized) to the registry dict; "
+             "`# tpu-lint: metric-ok` for deliberately dynamic keys")
+
+
+@register_rule(
+    "B5", ("metric",), Severity.ERROR,
+    "counters/reservoirs referenced but absent from their exposition "
+    "registry")
+def check_metrics(ctx):
+    if ctx.is_test:
+        return []
+    out = []
+    serving_reg = None
+    serving_reg_loaded = False
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        registry = _class_counter_registry(cls)
+        reservoirs = set()
+        for n in ast.walk(cls):
+            if isinstance(n, ast.Call):
+                name = astutil.dotted_name(n.func) or ""
+                if name.endswith(".add_reservoir") and n.args \
+                        and isinstance(n.args[0], ast.Constant) \
+                        and isinstance(n.args[0].value, str):
+                    reservoirs.add(n.args[0].value)
+        for n in ast.walk(cls):
+            if isinstance(n, ast.Subscript):
+                target = astutil.dotted_name(n.value) or ""
+                if target == "self.counters" and registry is not None:
+                    for key, knode in _subscript_keys(n):
+                        if key not in registry:
+                            out.append(_metric_diag(
+                                ctx, key, knode,
+                                f"{cls.name}'s self.counters registry"))
+                elif target.endswith(".metrics.counters"):
+                    if not serving_reg_loaded:
+                        serving_reg = _serving_metrics_registry(ctx)
+                        serving_reg_loaded = True
+                    if serving_reg is not None:
+                        for key, knode in _subscript_keys(n):
+                            if key not in serving_reg:
+                                out.append(_metric_diag(
+                                    ctx, key, knode,
+                                    "ServingMetrics' counter registry "
+                                    "(serving/metrics.py)"))
+            elif isinstance(n, ast.Call) and reservoirs:
+                name = astutil.dotted_name(n.func) or ""
+                if name == "self.reservoir_percentiles" and n.args \
+                        and isinstance(n.args[0], ast.Constant) \
+                        and isinstance(n.args[0].value, str) \
+                        and n.args[0].value not in reservoirs:
+                    out.append(Diagnostic(
+                        rule="B5", slug="metric", severity=Severity.ERROR,
+                        path=ctx.path, line=n.args[0].lineno,
+                        col=n.args[0].col_offset,
+                        message=(f"reservoir {n.args[0].value!r} is read "
+                                 f"but {cls.name} never add_reservoir()s "
+                                 "it: percentiles come back empty "
+                                 "forever"),
+                        hint="register it with add_reservoir(...) next "
+                             "to the others"))
+    # one finding per missing key, not one per reference
+    seen, uniq = set(), []
+    for d in out:
+        if d.message in seen:
+            continue
+        seen.add(d.message)
+        uniq.append(d)
+    return uniq

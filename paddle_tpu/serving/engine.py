@@ -786,9 +786,15 @@ class ServingEngine:
         # two engines with different kv_dtype/wq/TP degree in one
         # process must never share a compiled program, and the
         # bucket-grid compile bound is per-engine (one mesh shape per
-        # engine) so the key suffix costs nothing
+        # engine) so the key suffix costs nothing. The sampling config
+        # rides too (B1): temperature/top_k/top_p are closed over as
+        # Python constants by every builder, so without the key axis a
+        # persistent CompileCache entry written at one temperature
+        # would be served to a restarted worker running another
         self._qkey = (self.kv_dtype or "kv_full", self.wq or "w_full",
-                      ("tp", self.tp))
+                      ("tp", self.tp),
+                      ("sampling", self.temperature, self.top_k,
+                       self.top_p))
         if self.lora is not None:
             # the STATIC lora layout (slots x rank buckets x page
             # geometry) rides every program key; adapter ids never do
@@ -1168,6 +1174,7 @@ class ServingEngine:
     def _build_chunk(self, S: int, P: int):
         """One padded prompt CHUNK -> paged cache + sampled token (the
         token is only consumed when the chunk is the prompt's last)."""
+        # tpu-lint: cache-key-ok (per-engine cache; disk tier keys geometry)
         model = self.model
         temperature, top_k, top_p = self.temperature, self.top_k, self.top_p
         views, split = self._paged_views, self._split_views
@@ -1190,6 +1197,7 @@ class ServingEngine:
             tok = _sample_arr(last[None], key, temperature, top_k, top_p)[0]
             return (tok, ok) + split(caches)
 
+        # tpu-lint: cache-key-ok (donation is backend-constant per process)
         return jax.jit(program, donate_argnums=self._donate)
 
     def _run_chunk(self, chunk):
@@ -1248,6 +1256,7 @@ class ServingEngine:
     # ----------------------------------------------------------- decode
     def _build_decode(self, B: int, P: int):
         """One batched token step over the paged caches."""
+        # tpu-lint: cache-key-ok (per-engine cache; disk tier keys geometry)
         model = self.model
         temperature, top_k, top_p = self.temperature, self.top_k, self.top_p
         views, split = self._paged_views, self._split_views
@@ -1268,6 +1277,7 @@ class ServingEngine:
             toks = _sample_arr(rows, key, temperature, top_k, top_p)
             return (toks, ok) + split(caches)
 
+        # tpu-lint: cache-key-ok (donation is backend-constant per process)
         return jax.jit(program, donate_argnums=self._donate)
 
     def _run_decode(self, reqs: List[Request]):
@@ -1356,6 +1366,7 @@ class ServingEngine:
         (EOS / per-row step cap / non-finite logits). The host fetches
         only (tokens (B, K), emitted counts, finiteness flags) — one
         relay round trip buys up to K tokens per row."""
+        # tpu-lint: cache-key-ok (per-engine cache; disk tier keys geometry)
         model = self.model
         temperature, top_k, top_p = self.temperature, self.top_k, self.top_p
         views, split = self._paged_views, self._split_views
@@ -1376,6 +1387,7 @@ class ServingEngine:
                     temperature=temperature, top_k=top_k, top_p=top_p)
             return (toks._data, n_emit._data, ok._data) + split(caches)
 
+        # tpu-lint: cache-key-ok (donation is backend-constant per process)
         return jax.jit(program, donate_argnums=self._donate)
 
     def _run_multi_decode(self, reqs: List[Request], caps: List[int],
@@ -1567,6 +1579,7 @@ class ServingEngine:
           pre-drawn key, so StepSupervisor retries stay bit-identical.
         """
         S = K + 1
+        # tpu-lint: cache-key-ok (per-engine cache; disk tier keys geometry)
         model = self.model
         temperature, top_k, top_p = self.temperature, self.top_k, self.top_p
         views, split = self._paged_views, self._split_views
@@ -1623,6 +1636,7 @@ class ServingEngine:
                 toks = jnp.where(jpos < n_acc[:, None], idsn, sampled)
             return (toks, n_acc, ok) + split(caches)
 
+        # tpu-lint: cache-key-ok (donation is backend-constant per process)
         return jax.jit(program, donate_argnums=self._donate)
 
     def _extend_slots(self, req: Request, want: int):

@@ -536,13 +536,14 @@ class Fleet:
     def step_all(self) -> int:
         """One fleet iteration: step every healthy replica once, then
         run health checks (stall detection). Returns tokens emitted."""
+        iter_start = self._clock()
         n = 0
         for replica in self.replicas:
             n += len(self.step_replica(replica))
-        self.check_health()
+        self.check_health(iter_start=iter_start)
         return n
 
-    def check_health(self):
+    def check_health(self, iter_start: Optional[float] = None):
         """Stall detection: a HEALTHY replica with work whose heartbeat
         is older than `stall_timeout_s` is marked UNHEALTHY and
         evacuated — from the outside a wedged stepping loop and a dead
@@ -556,11 +557,23 @@ class Fleet:
         loop), and evicting healthy replicas one by one would cascade
         to finalizing all in-flight work "lost" with no real fault.
         Single-replica fleets fall back to the raw timeout (there is
-        nobody to compare against)."""
+        nobody to compare against).
+
+        `iter_start` (step_all passes its loop-entry time): a replica
+        whose heartbeat is AT or PAST it completed a successful step
+        THIS iteration and is exempt — the replicas step sequentially,
+        so one slow sibling step (a cold first-step compile takes >5 s
+        on a cold XLA cache) would otherwise age an earlier, perfectly
+        live replica straight past the timeout. Genuinely wedged
+        replicas never stamp `last_progress` (the fault-stall path
+        skips the engine step without touching the heartbeat), so
+        detection is unchanged."""
         now = self._clock()
         for r in list(self.replicas):
             if r.state is not ReplicaState.HEALTHY or \
                     not r.engine.has_work():
+                continue
+            if iter_start is not None and r.last_progress >= iter_start:
                 continue
             if now - r.last_progress <= self.stall_timeout_s:
                 continue

@@ -94,6 +94,10 @@ from ...utils import faults
 from .router import role_candidates
 from .transport import Channel, TransportError, bind_store, free_port
 
+# The B2 protocol rule cross-checks every message type sent here
+# against the worker's dispatch (and vice versa):
+# tpu-lint-hint: protocol-peer=worker.py
+
 __all__ = ["ProcessFleet", "WorkerProc", "WorkerState",
            "FAULT_HANDOFF_STALL"]
 
@@ -141,6 +145,7 @@ class WorkerProc:
         self.last_beat: Optional[dict] = None
         self.last_snapshot: Optional[dict] = None
         self.last_stats: Optional[dict] = None
+        self.pongs = 0
         self.fired: Dict[str, int] = {}
         self.reported_load = 0
         self.beats = 0
@@ -879,6 +884,11 @@ class ProcessFleet:
             self._on_handoff_frame(worker, mtype, msg)
         elif mtype == "adopted":
             worker.last_beat_host_t = self._clock()
+        elif mtype == "pong":
+            # the ping round-trip's answer: proof the worker LOOP is
+            # alive (not just the process), so it counts as liveness
+            worker.last_beat_host_t = self._clock()
+            worker.pongs += 1
         elif mtype == "stats":
             worker.last_stats = payload
         elif mtype == "reject":
@@ -1190,6 +1200,28 @@ class ProcessFleet:
             self.pump()
             time.sleep(5e-3)
         return worker.last_stats
+
+    def ping(self, name: str, *, timeout_s: float = 10.0) -> bool:
+        """Explicit liveness round-trip on one worker: send `ping`,
+        pump until its `pong` lands (which also refreshes the
+        heartbeat clock). Heartbeats prove liveness passively every
+        interval; ping answers "is the LOOP responsive right now"
+        on demand — e.g. before routing a large adopt batch at a
+        worker whose last beat is aging."""
+        worker = self.workers[name]
+        if worker.state in (WorkerState.DEAD, WorkerState.STOPPED):
+            return False
+        before = worker.pongs
+        try:
+            worker.chan.send("ping")
+        except TransportError:
+            self.counters["transport_errors"] += 1
+            return False
+        deadline = time.monotonic() + timeout_s
+        while worker.pongs == before and time.monotonic() < deadline:
+            self.pump()
+            time.sleep(5e-3)
+        return worker.pongs > before
 
     # ---- observability ----------------------------------------------------
     def fired_counts(self) -> Dict[str, int]:

@@ -89,6 +89,10 @@ from ...utils import faults
 from .transport import (Channel, chunk_payloads, connect_store,
                         join_payloads)
 
+# The B2 protocol rule cross-checks every message type sent here
+# against the supervisor's dispatch (and vice versa):
+# tpu-lint-hint: protocol-peer=procfleet.py
+
 __all__ = ["run_worker", "WorkerLoop", "build_model", "build_engine",
            "build_lora_registry", "FAULT_KILL9",
            "FAULT_HANDOFF_PARTIAL", "FAULT_DECODE_REJECT"]

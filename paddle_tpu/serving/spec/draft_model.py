@@ -111,6 +111,10 @@ class DraftModelProposer(Proposer):
             "draft_decode", lambda: (len(self.batch_buckets)
                                      * len(self.pages_buckets)))
         self._donate = (1, 2) if jax.default_backend() == "tpu" else ()
+        # draft-model structure rides every draft program key (B1):
+        # the builders close over num_layers as a Python constant, so
+        # two proposers of different depth must never share a program
+        self._dkey = (("layers", self.num_layers),)
         self._states: Dict[int, _DraftSeq] = {}
         # drafting turned itself off (see propose()): the engine keeps
         # decoding plainly. `disabled_reason` records why — a silently
@@ -140,6 +144,7 @@ class DraftModelProposer(Proposer):
         the draft cache and return the greedy next token (the first
         draft, when the span reaches the history end)."""
         L = self.num_layers
+        # tpu-lint: cache-key-ok (per-proposer cache, no disk tier)
         model = self.model
 
         def program(state, kcs, vcs, ids, cache_len, live, bt):
@@ -153,11 +158,13 @@ class DraftModelProposer(Proposer):
             return (tok, [c[0]._data for c in caches],
                     [c[1]._data for c in caches])
 
+        # tpu-lint: cache-key-ok (donation is backend-constant per process)
         return jax.jit(program, donate_argnums=self._donate)
 
     def _build_decode(self, B, P):
         """One batched greedy draft step over the draft paged caches."""
         L = self.num_layers
+        # tpu-lint: cache-key-ok (per-proposer cache, no disk tier)
         model = self.model
 
         def program(state, kcs, vcs, ids, bt, sl):
@@ -171,6 +178,7 @@ class DraftModelProposer(Proposer):
             return (toks, [c[0]._data for c in caches],
                     [c[1]._data for c in caches])
 
+        # tpu-lint: cache-key-ok (donation is backend-constant per process)
         return jax.jit(program, donate_argnums=self._donate)
 
     # ------------------------------------------------------------- helpers
@@ -276,7 +284,8 @@ class DraftModelProposer(Proposer):
                     self.allocator.pages_needed(pos + len(span)),
                     self.pages_buckets)
                 prog = self._get_program(
-                    ("draft_chunk", S, P), lambda: self._build_chunk(S, P))
+                    ("draft_chunk", S, P) + self._dkey,
+                    lambda: self._build_chunk(S, P))
                 bt = np.full((P,), PAD_PAGE, np.int32)
                 npages = min(len(st.seq.pages), P)
                 bt[:npages] = st.seq.pages[:npages]
@@ -301,7 +310,8 @@ class DraftModelProposer(Proposer):
             maxp = max(len(st.seq.pages) for _, st in step)
             P = self._bucket_for(maxp, self.pages_buckets)
             prog = self._get_program(
-                ("draft_decode", B, P), lambda: self._build_decode(B, P))
+                ("draft_decode", B, P) + self._dkey,
+                lambda: self._build_decode(B, P))
             ids = np.zeros((B, 1), np.int32)
             sl = np.zeros((B,), np.int32)
             bt = np.full((B, P), PAD_PAGE, np.int32)

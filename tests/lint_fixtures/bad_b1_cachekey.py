@@ -1,0 +1,33 @@
+"""Known-bad B1: program-cache key misses config the builder bakes in.
+
+This is serving/engine.py's decode path as it stood before ISSUE 19:
+`temperature` (and friends) close over the builder as Python constants,
+so the compiled program is sampling-specific — but the cache key only
+carried the batch bucket. Two engines (or one engine plus the
+persistent CompileCache of a previous process) at different
+temperatures would share one compiled program.
+"""
+
+
+class MiniEngine:
+    def __init__(self, model, temperature, top_k):
+        self.model = model
+        self.temperature = temperature
+        self.top_k = top_k
+        self.programs = {}
+
+    def _get_program(self, key, build):
+        if key not in self.programs:
+            self.programs[key] = build()
+        return self.programs[key]
+
+    def decode(self, batch):
+        program = self._get_program(
+            ("decode", batch), lambda: self._build_decode(batch))
+        return program(batch)
+
+    def _build_decode(self, batch):
+        model = self.model              # bad: not keyed, not hatched
+        temp = self.temperature         # bad: sampling axis not keyed
+        k = self.top_k                  # bad: sampling axis not keyed
+        return lambda b: (model, temp, k, b)

@@ -1,0 +1,29 @@
+"""Known-bad B2: mailbox protocol asymmetry (single-file protocol).
+
+`zap` is sent but no dispatch arm handles it — the frame rides the
+seq-numbered stream, burns a hole-repair timeout on loss, and is then
+silently dropped (PR-16's torn-send latency-mystery class). `farewell`
+has a dispatch arm but nothing ever sends it: a dead protocol arm.
+"""
+# tpu-lint-hint: protocol-peer=self
+
+
+def supervisor_side(chan, rid):
+    chan.send("abort", rid=rid)
+    chan.send("zap", rid=rid)            # bad: no handler anywhere
+
+
+def worker_side(chan, msg):
+    mtype = msg.get("type")
+    if mtype == "abort":
+        chan.send("aborted", rid=msg["rid"])
+    elif mtype == "farewell":            # bad: never sent anywhere
+        return None
+    return mtype
+
+
+def supervisor_pump(chan, msg):
+    mtype = msg.get("type")
+    if mtype == "aborted":
+        return msg["rid"]
+    return None

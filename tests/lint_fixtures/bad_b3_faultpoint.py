@@ -1,0 +1,18 @@
+"""Known-bad B3: fault-point drift, both directions.
+
+`fixture.never_registered` is fired but registered nowhere in the
+package: `fire()` silently no-ops, so the fault coverage this site
+promises does not exist. `fixture.undocumented_point` is registered
+but has no row in SERVING.md's fault table — the soak/resilience
+contract drifts from the docs (exactly how
+`serving.engine.multi_decode_step` went missing in PR-18).
+"""
+from paddle_tpu.utils import faults
+
+FAULT_UNDOC = faults.register_point("fixture.undocumented_point")
+
+
+def step():
+    crash = faults.fire("fixture.never_registered")
+    if crash is not None:
+        raise RuntimeError("injected")

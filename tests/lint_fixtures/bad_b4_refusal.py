@@ -1,0 +1,25 @@
+"""Known-bad B4: scattered feature-conflict refusals.
+
+Capability conflicts must live in serving/errors.py::FEATURE_CONFLICTS
+and raise through check_feature_conflicts (ROADMAP item 4) — an inline
+ValueError/RuntimeError worded as a refusal (or a direct
+UnsupportedFeature raise) recreates the pre-PR-17 scatter where each
+build refused a slightly different, undocumented feature set.
+"""
+
+
+class UnsupportedFeature(ValueError):
+    pass
+
+
+def configure(prefix_cache, disagg, speculative, flashmask):
+    if prefix_cache and disagg:
+        raise ValueError(
+            "prefix cache and disaggregated prefill are "
+            "mutually exclusive")
+    if speculative and flashmask:
+        raise RuntimeError(
+            f"speculative decoding with flashmask={flashmask} is "
+            "not supported yet")
+    if disagg and speculative:
+        raise UnsupportedFeature("disagg", "speculative")

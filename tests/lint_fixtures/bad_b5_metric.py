@@ -1,0 +1,35 @@
+"""Known-bad B5: counters incremented past their literal registry.
+
+`requests_lost` / `requests_dropped` (the conditional-subscript idiom)
+never appear in the `self.counters = {...}` registry: the increment
+KeyErrors at runtime on whatever rare path reaches it, and the
+exposition layer never reports the metric. The reservoir read names a
+series that was never add_reservoir()'d — percentiles come back empty
+forever.
+"""
+
+
+class MiniSupervisor:
+    def __init__(self):
+        self.counters = {
+            "requests": 0,
+            "deaths": 0,
+        }
+        self._samples = {}
+
+    def add_reservoir(self, name):
+        self._samples[name] = []
+
+    def reservoir_percentiles(self, name):
+        return sorted(self._samples.get(name, []))
+
+    def start(self):
+        self.add_reservoir("ttft")
+
+    def on_death(self, hard):
+        self.counters["deaths"] += 1
+        self.counters["requests_lost" if hard
+                      else "requests_dropped"] += 1   # bad: unregistered
+
+    def report(self):
+        return self.reservoir_percentiles("queue_wait")   # bad: no such

@@ -1,0 +1,34 @@
+"""Known-good B1: every builder-read config axis rides the key.
+
+The sampling axes ride transitively through the `self._qkey` aggregate
+(the rule's fixpoint over `self.X = <expr>` assignments), and the one
+attr that genuinely cannot alias (`self.model` under a per-engine
+cache) is acknowledged with a justified hatch.
+"""
+
+
+class MiniEngine:
+    def __init__(self, model, temperature, top_k):
+        self.model = model
+        self.temperature = temperature
+        self.top_k = top_k
+        self._qkey = (("sampling", self.temperature, self.top_k),)
+        self.programs = {}
+
+    def _get_program(self, key, build):
+        if key not in self.programs:
+            self.programs[key] = build()
+        return self.programs[key]
+
+    def decode(self, batch):
+        program = self._get_program(
+            ("decode", batch) + self._qkey,
+            lambda: self._build_decode(batch))
+        return program(batch)
+
+    def _build_decode(self, batch):
+        # tpu-lint: cache-key-ok (per-engine cache; no persistent tier)
+        model = self.model
+        temp = self.temperature
+        k = self.top_k
+        return lambda b: (model, temp, k, b)

@@ -1,0 +1,28 @@
+"""Known-good B2: every sent type has a dispatch arm and every arm has
+a sender (union semantics over both directions, including an `in`-tuple
+arm)."""
+# tpu-lint-hint: protocol-peer=self
+
+
+def supervisor_side(chan, rid):
+    chan.send("abort", rid=rid)
+    chan.send("drain")
+    chan.send("shutdown")
+
+
+def worker_side(chan, msg):
+    mtype = msg.get("type")
+    if mtype == "abort":
+        chan.send("aborted", rid=msg["rid"])
+    elif mtype in ("drain", "shutdown"):
+        chan.send("bye")
+    return mtype
+
+
+def supervisor_pump(chan, msg):
+    mtype = msg.get("type")
+    if mtype == "aborted":
+        return msg["rid"]
+    if mtype == "bye":
+        return None
+    return None

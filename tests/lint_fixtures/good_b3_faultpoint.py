@@ -1,0 +1,21 @@
+"""Known-good B3: fired points are registered, registered points are
+documented.
+
+`fleet.stream_stall` and `transport.drop` both exist in the package
+registry AND have rows in SERVING.md's "Fault injection points" table;
+firing through a module constant (the package-wide idiom) is registered
+by construction and never flagged.
+"""
+from paddle_tpu.utils import faults
+
+FAULT_DROP = faults.register_point("transport.drop")
+
+
+def step():
+    stall = faults.fire("fleet.stream_stall")
+    if stall is not None:
+        return []
+    drop = faults.fire(FAULT_DROP)
+    if drop is not None:
+        return None
+    return [1]

@@ -1,0 +1,24 @@
+"""Known-good B4: the one legitimate home of feature refusals — the
+module that DEFINES the FEATURE_CONFLICTS table (serving/errors.py's
+shape) is exempt, because the table is exactly where conflicts are
+supposed to be declared and raised from."""
+
+
+class UnsupportedFeature(ValueError):
+    def __init__(self, a, b, why):
+        super().__init__(f"{a} with {b}: {why}")
+        self.pair = (a, b)
+
+
+FEATURE_CONFLICTS = {
+    ("prefix_cache", "disagg"):
+        "prefix cache and disaggregated prefill are mutually exclusive",
+    ("speculative", "flashmask"):
+        "speculative decoding with flashmask is not supported yet",
+}
+
+
+def check_feature_conflicts(active):
+    for (a, b), why in FEATURE_CONFLICTS.items():
+        if a in active and b in active:
+            raise UnsupportedFeature(a, b, why)

@@ -1,0 +1,31 @@
+"""Known-good B5: every incremented key is registered (including both
+arms of the conditional-subscript idiom) and every reservoir read was
+add_reservoir()'d."""
+
+
+class MiniSupervisor:
+    def __init__(self):
+        self.counters = {
+            "requests": 0,
+            "deaths": 0,
+            "requests_lost": 0,
+        }
+        self.counters.update({"requests_dropped": 0})
+        self._samples = {}
+
+    def add_reservoir(self, name):
+        self._samples[name] = []
+
+    def reservoir_percentiles(self, name):
+        return sorted(self._samples.get(name, []))
+
+    def start(self):
+        self.add_reservoir("ttft")
+
+    def on_death(self, hard):
+        self.counters["deaths"] += 1
+        self.counters["requests_lost" if hard
+                      else "requests_dropped"] += 1
+
+    def report(self):
+        return self.reservoir_percentiles("ttft")

@@ -165,6 +165,71 @@ def test_fleet_summary_merges_replicas(model):
     assert all(h.finished for h in hs)
 
 
+def test_cold_compile_sibling_step_does_not_evict(model):
+    """Regression (ISSUE 19 satellite): `test_fleet_summary_merges_replicas`
+    failed standalone because replica-1's FIRST step pays the cold XLA
+    compile (>5 s on a cold process; the full suite pre-warms the
+    compile cache, which is why the flake only bit standalone). The
+    replicas step sequentially inside `step_all`, so that one slow
+    sibling step aged replica-0's pre-iteration heartbeat past
+    `stall_timeout_s` while replica-1's own stamp was fresh — the
+    saturation guard saw "another replica progressed" and wrongly
+    evicted a replica that had JUST completed a successful step.
+    `step_all` now passes its loop-entry time to `check_health`, which
+    exempts any replica stamped at-or-after it."""
+    t = [100.0]
+    engines = [ServingEngine(model, **KW) for _ in range(2)]
+    fleet = Fleet(engines, clock=lambda: t[0])  # stall_timeout_s=5.0
+    real_step = engines[1].step
+    cold = [True]
+
+    def cold_compile_step():
+        out = real_step()
+        if cold[0]:          # first step compiles: 6 s > stall_timeout_s
+            cold[0] = False
+            t[0] += 6.0
+        return out
+
+    engines[1].step = cold_compile_step
+    hs = [fleet.submit(list(range(1, 9)), max_new_tokens=3)
+          for _ in range(4)]
+    fleet.run()
+    summary = fleet.summary()
+    fleet.shutdown()
+    assert summary["replica_states"] == {"replica-0": "healthy",
+                                         "replica-1": "healthy"}
+    assert fleet.counters["replica_stalls"] == 0
+    assert all(h.finished for h in hs)
+
+
+def test_stall_detection_still_fires_with_iter_start(model):
+    """The exemption must not mask a REAL stall: a wedged replica never
+    stamps `last_progress` (the `fleet.stream_stall` fault path skips
+    the engine step without touching the heartbeat), so it is never
+    exempt and the detector fires exactly as before."""
+    from paddle_tpu.serving.fleet.replica import ReplicaState
+    from paddle_tpu.utils import faults
+    t = [100.0]
+    engines = [ServingEngine(model, **KW) for _ in range(2)]
+    fleet = Fleet(engines, clock=lambda: t[0])
+    h = fleet.submit(list(range(1, 9)), max_new_tokens=4)
+    stalled = fleet._assign[h.request_id]
+    survivor = next(r for r in fleet.replicas if r is not stalled)
+    faults.inject("fleet.stream_stall", payload=stalled.name, times=-1)
+    try:
+        for _ in range(4):
+            t[0] += 2.0                   # wedged for >5 s of fleet time
+            fleet.step_all()
+    finally:
+        faults.clear()
+    assert fleet.counters["replica_stalls"] == 1
+    assert stalled.state is ReplicaState.UNHEALTHY
+    assert survivor.state is ReplicaState.HEALTHY
+    fleet.run()                            # survivor adopts parked work
+    assert h.finished
+    fleet.shutdown()
+
+
 # ------------------------------------- snapshot version (satellite)
 def test_snapshot_is_stamped(model):
     eng = ServingEngine(model, **KW)

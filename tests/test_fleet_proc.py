@@ -94,6 +94,14 @@ def test_cross_process_lifecycle(reference, tmp_path):
         # heartbeats shipped the incremental snapshot machinery
         assert pf.workers["w0"].last_snapshot is not None
         assert pf.workers["w0"].last_snapshot["version"] == 1
+        # explicit liveness round-trip (ISSUE 19: the B2 protocol rule
+        # found `ping` handled by workers but never sent — the
+        # supervisor half of the round-trip was missing)
+        gap_before = pf.workers["w0"].last_beat_host_t
+        assert pf.ping("w0") is True
+        assert pf.workers["w0"].pongs == 1
+        # a pong proves the worker LOOP is alive, so it stamps liveness
+        assert pf.workers["w0"].last_beat_host_t >= gap_before
 
         # ---- (2) duplicated delivery is idempotent --------------------
         with faults.injected("transport.duplicate", payload=True,

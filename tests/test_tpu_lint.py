@@ -1,12 +1,13 @@
 """tpu-lint (paddle_tpu.analysis) test suite.
 
 Covers: the fixture corpus (>= 1 known-bad + known-good file per rule
-A1-A5), the lint-clean-at-HEAD gate over the whole package (with the
-<60 s CPU budget), the A3 VMEM estimator cross-checked against the
-chip-validated block picks in flash_attention.py / fused_norm.py,
-escape hatches, the CLI contract (exit codes, JSON schema, rule
-filters), and the A5 runtime promotions recorded by dy2static and the
-collective layer.
+A1-A5 and B1-B5), the lint-clean-at-HEAD gate over the whole package
+(with the <60 s CPU budget), the A3 VMEM estimator cross-checked
+against the chip-validated block picks in flash_attention.py /
+fused_norm.py, escape hatches, the CLI contract (exit codes, JSON
+schema incl. per-pack summaries, rule filters + `B*` pack globs), the
+B2 protocol gate against the real worker/procfleet pair, and the A5
+runtime promotions recorded by dy2static and the collective layer.
 """
 import json
 import os
@@ -39,6 +40,11 @@ BAD_FIXTURES = {
     "bad_a4_runtime.py": "A4",
     "bad_a4_decode_loop.py": "A4",
     "bad_a5_purity.py": "A5",
+    "bad_b1_cachekey.py": "B1",
+    "bad_b2_protocol.py": "B2",
+    "bad_b3_faultpoint.py": "B3",
+    "bad_b4_refusal.py": "B4",
+    "bad_b5_metric.py": "B5",
 }
 GOOD_FIXTURES = [
     "good_a1_index_map.py",
@@ -50,6 +56,11 @@ GOOD_FIXTURES = [
     "good_a4_runtime.py",
     "good_a4_decode_loop.py",
     "good_a5_purity.py",
+    "good_b1_cachekey.py",
+    "good_b2_protocol.py",
+    "good_b3_faultpoint.py",
+    "good_b4_refusal.py",
+    "good_b5_metric.py",
 ]
 
 
@@ -231,6 +242,63 @@ def test_skip_file_hatch():
     assert not analysis.lint_source(src, "snippet.py", is_test=False)
 
 
+def test_escape_hatch_covers_b_slugs():
+    """The B rules honor the same `# tpu-lint: <slug>-ok` hatch
+    mechanics as the A pack (same line or the line above)."""
+    refusal = ('def configure(a, b):\n'
+               '    if a and b:\n'
+               '        # tpu-lint: refusal-ok\n'
+               '        raise ValueError("a and b are mutually '
+               'exclusive")\n')
+    assert not analysis.lint_source(refusal, "snippet.py", is_test=False)
+    with open(os.path.join(FIXDIR, "bad_b1_cachekey.py")) as f:
+        src = f.read()
+    hatched = src.replace(
+        "        model = self.model",
+        "        # tpu-lint: cache-key-ok\n        model = self.model")
+    diags = analysis.lint_source(hatched, "snippet.py", is_test=False)
+    # the hatch silences ONLY the model line; the sampling axes stay
+    assert {d.rule for d in diags} == {"B1"} and len(diags) == 2
+    assert not any("self.model" in d.message for d in diags)
+
+
+def test_b2_catches_deleted_dispatch_arm(tmp_path):
+    """The acceptance gate: deleting one handler arm from the REAL
+    procfleet dispatch makes B2 fail on the real worker file. Copies of
+    the live pair go to tmpdir (outside any checkout, so B3/B5's
+    cross-file halves stand down) and the procfleet copy's
+    `prefill_done` arm is renamed away."""
+    for fn in ("worker.py", "procfleet.py"):
+        with open(os.path.join(REPO, "paddle_tpu", "serving", "fleet",
+                               fn)) as f:
+            src = f.read()
+        if fn == "procfleet.py":
+            assert 'mtype == "prefill_done"' in src
+            src = src.replace('mtype == "prefill_done"',
+                              'mtype == "prefill_done_disabled"')
+        (tmp_path / fn).write_text(src)
+    diags = analysis.lint_file(str(tmp_path / "worker.py"),
+                               is_test=False)
+    b2 = [d for d in diags if d.rule == "B2"]
+    assert any("'prefill_done'" in d.message
+               and d.severity == "error" for d in b2), \
+        analysis.format_text(diags)
+    # the untampered pair is symmetric: no B2 findings on either side
+    # (fresh file names sidestep the per-path peer cache)
+    for fn in ("worker.py", "procfleet.py"):
+        with open(os.path.join(REPO, "paddle_tpu", "serving", "fleet",
+                               fn)) as f:
+            (tmp_path / ("ok_" + fn)).write_text(
+                f.read().replace("protocol-peer=procfleet.py",
+                                 "protocol-peer=ok_procfleet.py")
+                        .replace("protocol-peer=worker.py",
+                                 "protocol-peer=ok_worker.py"))
+    for fn in ("ok_worker.py", "ok_procfleet.py"):
+        diags = analysis.lint_file(str(tmp_path / fn), is_test=False)
+        assert not [d for d in diags if d.rule == "B2"], \
+            analysis.format_text(diags)
+
+
 def test_rule_selection_and_unknown_selector():
     only_a1 = analysis.select_rules(["A1"])
     assert [r.id for r in only_a1] == ["A1"]
@@ -241,6 +309,14 @@ def test_rule_selection_and_unknown_selector():
     # "--rules ," must not select NOTHING and pass vacuously
     with pytest.raises(ValueError):
         analysis.select_rules(["", " "])
+    # pack globs match rule IDS only: B* is the whole B pack and must
+    # NOT surprise-match A2 via its slug "blockspec"
+    assert {r.id for r in analysis.select_rules(["B*"])} \
+        == {"B1", "B2", "B3", "B4", "B5"}
+    assert {r.id for r in analysis.select_rules(["a*"])} \
+        == {"A1", "A2", "A3", "A4", "A5"}
+    with pytest.raises(ValueError):
+        analysis.select_rules(["Z*"])
 
 
 def test_resolve_int_pow_is_bounded():
@@ -306,8 +382,35 @@ def test_cli_rule_filter_and_exit_codes(tmp_path):
 def test_cli_list_rules():
     r = _run_cli("--list-rules")
     assert r.returncode == 0
-    for rid in ("A1", "A2", "A3", "A4", "A5"):
+    for rid in ("A1", "A2", "A3", "A4", "A5",
+                "B1", "B2", "B3", "B4", "B5"):
         assert rid in r.stdout
+
+
+def test_cli_pack_summary_json_and_text(tmp_path):
+    """The per-pack summary is one assertable line: the driver gate
+    greps `packs["B"]["summary"]` (JSON) or the `tpu-lint[B]:` line
+    (text) instead of re-deriving counts from the findings list."""
+    dst = tmp_path / "snippet_b4.py"
+    shutil.copy(os.path.join(FIXDIR, "bad_b4_refusal.py"), dst)
+    r = _run_cli("--json", str(dst))
+    assert r.returncode == 1, r.stdout + r.stderr
+    payload = json.loads(r.stdout)
+    b = payload["packs"]["B"]
+    assert b["rules"] == ["B1", "B2", "B3", "B4", "B5"]
+    assert b["findings"] == 3 and b["files"] == 1
+    assert b["summary"] == "3 findings, 1 files, 5 rules"
+    assert payload["packs"]["A"]["findings"] == 0
+    # text mode prints the same summary per pack
+    r = _run_cli(str(dst))
+    assert "tpu-lint[B]: 3 findings, 1 files, 5 rules" in r.stdout
+    assert "tpu-lint[A]: 0 findings, 1 files, 5 rules" in r.stdout
+    # a --rules selection narrows the pack bookkeeping with it
+    r = _run_cli("--json", "--rules", "B*", str(dst))
+    payload = json.loads(r.stdout)
+    assert list(payload["packs"]) == ["B"]
+    assert payload["packs"]["B"]["summary"] == \
+        "3 findings, 1 files, 5 rules"
 
 
 # ------------------------------------------------ A5 runtime promotion

@@ -79,11 +79,31 @@ def main(argv=None):
 
     diags, nfiles = analysis.lint_paths(args.paths, rules=rules,
                                         exclude=tuple(args.exclude))
+
+    def pack_of(rule_id):
+        # "A3" -> "A", "B2" -> "B"; parse errors group under "parse"
+        head = "".join(c for c in rule_id if c.isalpha())
+        return head or rule_id
+
+    packs = {}
+    for r in rules:
+        packs.setdefault(pack_of(r.id), {"rules": [], "findings": 0})
+        packs[pack_of(r.id)]["rules"].append(r.id)
+    for d in diags:
+        packs.setdefault(pack_of(d.rule), {"rules": [], "findings": 0})
+        packs[pack_of(d.rule)]["findings"] += 1
+    for name, p in packs.items():
+        p["files"] = nfiles
+        # one assertable line per pack for the driver gate
+        p["summary"] = (f"{p['findings']} findings, {nfiles} files, "
+                        f"{len(p['rules'])} rules")
+
     if args.json:
         print(json.dumps({
             "version": 1,
             "files_scanned": nfiles,
             "rules": [r.id for r in rules],
+            "packs": packs,
             "findings": [d.to_dict() for d in diags],
         }, indent=2))
     else:
@@ -91,6 +111,8 @@ def main(argv=None):
             print(analysis.format_text(diags))
         print(f"tpu-lint: {len(diags)} finding(s) in {nfiles} file(s) "
               f"[rules: {', '.join(r.id for r in rules)}]")
+        for name in sorted(packs):
+            print(f"tpu-lint[{name}]: {packs[name]['summary']}")
     return 1 if diags else 0
 
 
